@@ -1,0 +1,26 @@
+"""singa_tpu — a TPU-native deep learning framework.
+
+A from-scratch, idiomatic JAX/XLA/Pallas re-design with the capabilities of
+Apache SINGA (reference layer map in SURVEY.md). Currently shipped: the
+Tensor/Device core, a define-by-run autograd engine whose graph mode is
+``jax.jit``, the layer / model / optimizer Python API (with checkpoint
+save/load on Model), and a distributed optimizer on mesh collectives.
+
+Import style matches the reference package (``from singa import ...`` →
+``from singa_tpu import ...``).
+"""
+
+__version__ = "0.1.0"
+
+from . import device        # noqa: F401
+from . import tensor        # noqa: F401
+from . import autograd      # noqa: F401
+from . import layer         # noqa: F401
+from . import model         # noqa: F401
+from . import opt           # noqa: F401
+from . import initializer   # noqa: F401
+from . import ops           # noqa: F401
+from . import parallel      # noqa: F401
+
+from .tensor import Tensor  # noqa: F401
+from .model import Model    # noqa: F401

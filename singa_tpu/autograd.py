@@ -1,0 +1,1092 @@
+"""Define-by-run autograd engine + the ~120-op surface, on XLA.
+
+Capability parity with the reference engine (python/singa/autograd.py):
+
+- ``Operator._do_forward`` records ``src`` links exactly like
+  autograd.py:270-314;
+- ``infer_dependency`` ref-counts the upstream graph (autograd.py:71-102);
+- ``backward(y, dy)`` is a lazy generator yielding ``(param, grad)`` in
+  reverse-topological order (autograd.py:128-224) so optimizers can overlap
+  update (and, distributed, all-reduce) with the rest of backward.
+
+TPU-first redesign: every ``forward`` is a pure ``jax.numpy`` function, so a
+whole train step (forward + this tape + optimizer) traces under ``jax.jit``
+into one XLA computation — the reference's buffered C++ Graph
+(src/core/scheduler/scheduler.cc) becomes XLA scheduling/fusion for free.
+Backward rules default to ``jax.vjp`` of the op's own forward, which is both
+exactly consistent with forward and XLA-fused; ops override ``backward`` only
+when vjp semantics are not what the reference specifies.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from .autograd_base import (CTX, Operator, Dummy, backward, gradients,
+                            infer_dependency, is_training, set_training,
+                            _raw)
+
+
+class _AutogradModule(types.ModuleType):
+    """Lets reference-style ``autograd.training = True`` toggle the shared
+    engine context (CTX) that ops and the Model layer consult."""
+
+    @property
+    def training(self):
+        return CTX.training
+
+    @training.setter
+    def training(self, flag):
+        CTX.training = bool(flag)
+
+
+sys.modules[__name__].__class__ = _AutogradModule
+
+
+# ===========================================================================
+# Op library. Classes mirror reference names; snake_case functional wrappers
+# below. Forward bodies are jax.numpy; backwards default to vjp.
+# ===========================================================================
+
+# ---- arithmetic -----------------------------------------------------------
+
+class Add(Operator):
+    def forward(self, a, b):
+        return a + b
+
+
+class Sub(Operator):
+    def forward(self, a, b):
+        return a - b
+
+
+class Mul(Operator):
+    def forward(self, a, b):
+        return a * b
+
+
+class Div(Operator):
+    def forward(self, a, b):
+        return a / b
+
+
+class Pow(Operator):
+    def forward(self, a, b):
+        return a ** b
+
+
+class Negative(Operator):
+    def forward(self, x):
+        return -x
+
+
+class Reciprocal(Operator):
+    def forward(self, x):
+        return 1.0 / x
+
+
+class AddBias(Operator):
+    """y = x + b broadcast along an axis (reference autograd.AddBias)."""
+
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x, b):
+        if self.axis == 0:
+            return x + b.reshape((1,) + b.shape)
+        return x + b.reshape(b.shape + (1,) * (x.ndim - 1 - self.axis))
+
+
+class Matmul(Operator):
+    def forward(self, a, b):
+        return jnp.matmul(a, b)
+
+
+class Gemm(Operator):
+    """alpha*A'@B' + beta*C (reference autograd.Gemm, onnx Gemm)."""
+
+    def __init__(self, alpha=1.0, beta=1.0, transA=0, transB=0):
+        super().__init__()
+        self.alpha, self.beta = alpha, beta
+        self.transA, self.transB = transA, transB
+
+    def forward(self, A, B, C=None):
+        a = A.T if self.transA else A
+        b = B.T if self.transB else B
+        y = self.alpha * (a @ b)
+        if C is not None:
+            y = y + self.beta * C
+        return y
+
+
+class Sum(Operator):
+    """Elementwise sum of N tensors (reference autograd.Sum)."""
+
+    def forward(self, *xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+
+# ---- unary math -----------------------------------------------------------
+
+def _unary_op(name, fn):
+    return type(name, (Operator,), {"forward": staticmethod(fn)})
+
+
+Abs = _unary_op("Abs", jnp.abs)
+Exp = _unary_op("Exp", jnp.exp)
+Log = _unary_op("Log", jnp.log)
+Sqrt = _unary_op("Sqrt", jnp.sqrt)
+Sin = _unary_op("Sin", jnp.sin)
+Cos = _unary_op("Cos", jnp.cos)
+Tan = _unary_op("Tan", jnp.tan)
+Sinh = _unary_op("Sinh", jnp.sinh)
+Cosh = _unary_op("Cosh", jnp.cosh)
+Asin = _unary_op("Asin", jnp.arcsin)
+Acos = _unary_op("Acos", jnp.arccos)
+Atan = _unary_op("Atan", jnp.arctan)
+Asinh = _unary_op("Asinh", jnp.arcsinh)
+Acosh = _unary_op("Acosh", jnp.arccosh)
+Atanh = _unary_op("Atanh", jnp.arctanh)
+Tanh = _unary_op("Tanh", jnp.tanh)
+Erf = _unary_op("Erf", jax.scipy.special.erf)
+
+
+class Ceil(Operator):
+    differentiable = True
+
+    def forward(self, x):
+        return jnp.ceil(x)
+
+    def backward(self, dy):
+        return jnp.zeros_like(dy)
+
+
+class Floor(Operator):
+    def forward(self, x):
+        return jnp.floor(x)
+
+    def backward(self, dy):
+        return jnp.zeros_like(dy)
+
+
+class Round(Operator):
+    def forward(self, x):
+        return jnp.trunc(x + jnp.sign(x) * 0.5)  # round-half-away like ref
+
+    def backward(self, dy):
+        return jnp.zeros_like(dy)
+
+
+class Rounde(Operator):
+    """Round half to even (reference autograd.Rounde)."""
+
+    def forward(self, x):
+        return jnp.round(x)
+
+    def backward(self, dy):
+        return jnp.zeros_like(dy)
+
+
+class Sign(Operator):
+    def forward(self, x):
+        return jnp.sign(x)
+
+    def backward(self, dy):
+        return jnp.zeros_like(dy)
+
+
+# ---- activations ----------------------------------------------------------
+
+class ReLU(Operator):
+    def forward(self, x):
+        return jnp.maximum(x, 0)
+
+
+class LeakyRelu(Operator):
+    def __init__(self, a=0.01):
+        super().__init__()
+        self.a = a
+
+    def forward(self, x):
+        return jnp.where(x >= 0, x, self.a * x)
+
+
+class Elu(Operator):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return jnp.where(x > 0, x, self.alpha * (jnp.exp(jnp.minimum(x, 0)) - 1))
+
+
+class SeLU(Operator):
+    def __init__(self, alpha=1.67326, gamma=1.0507):
+        super().__init__()
+        self.alpha, self.gamma = alpha, gamma
+
+    def forward(self, x):
+        return self.gamma * jnp.where(
+            x > 0, x, self.alpha * (jnp.exp(jnp.minimum(x, 0)) - 1))
+
+
+class Sigmoid(Operator):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class SoftPlus(Operator):
+    def forward(self, x):
+        return jax.nn.softplus(x)
+
+
+class SoftSign(Operator):
+    def forward(self, x):
+        return x / (1 + jnp.abs(x))
+
+
+class HardSigmoid(Operator):
+    def __init__(self, alpha=0.2, gamma=0.5):
+        super().__init__()
+        self.alpha, self.gamma = alpha, gamma
+
+    def forward(self, x):
+        return jnp.clip(self.alpha * x + self.gamma, 0.0, 1.0)
+
+
+class PRelu(Operator):
+    def forward(self, x, slope):
+        return jnp.where(x >= 0, x, slope * x)
+
+
+class SoftMax(Operator):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class GELU(Operator):
+    """TPU extension (used by transformer models; not in reference op set)."""
+
+    def forward(self, x):
+        return jax.nn.gelu(x)
+
+
+# ---- losses ---------------------------------------------------------------
+
+class CrossEntropy(Operator):
+    """-mean(sum(t * log(p))) with probabilities input
+    (reference autograd.py cross_entropy:1212)."""
+
+    def forward(self, x, t):
+        t = jax.lax.stop_gradient(t)
+        eps = 1e-10
+        batch = x.shape[0]
+        return -jnp.sum(t * jnp.log(x + eps)) / batch
+
+
+class SoftMaxCrossEntropy(Operator):
+    """Fused softmax + CE over logits (reference softmax_cross_entropy:1306).
+
+    Targets may be one-hot (same shape) or integer class ids.
+    """
+
+    def forward(self, x, t):
+        t = jax.lax.stop_gradient(t)
+        logp = jax.nn.log_softmax(x, axis=-1)
+        if t.shape == x.shape:
+            ce = -jnp.sum(t * logp, axis=-1)
+        else:
+            tt = t.reshape(t.shape[0:1]) if t.ndim > 1 else t
+            ce = -jnp.take_along_axis(
+                logp, tt.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+        return jnp.mean(ce)
+
+
+class MeanSquareError(Operator):
+    """0.5 * mean over batch of ||x-t||^2 (reference mse_loss:1334)."""
+
+    def forward(self, x, t):
+        t = jax.lax.stop_gradient(t)
+        batch = x.shape[0]
+        return jnp.sum(jnp.square(x - t)) / (2.0 * batch)
+
+
+class BinaryCrossEntropy(Operator):
+    def forward(self, x, t):
+        t = jax.lax.stop_gradient(t)
+        eps = 1e-10
+        per = -(t * jnp.log(x + eps) + (1 - t) * jnp.log(1 - x + eps))
+        return jnp.mean(jnp.sum(per.reshape(per.shape[0], -1), axis=-1))
+
+
+class RankingLoss(Operator):
+    """Margin ranking loss over (pos, neg) scores (reference
+    ranking_loss:1266)."""
+
+    def __init__(self, M=0.2):
+        super().__init__()
+        self.M = M
+
+    def forward(self, pos, neg):
+        return jnp.mean(jnp.maximum(self.M - (pos - neg), 0.0))
+
+
+# ---- reductions / comparisons ---------------------------------------------
+
+class ReduceSum(Operator):
+    def __init__(self, axes=None, keepdims=1):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return jnp.sum(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class ReduceMean(Operator):
+    def __init__(self, axes=None, keepdims=1):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return jnp.mean(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class Mean(Operator):
+    """Elementwise mean of N tensors (reference autograd.Mean)."""
+
+    def forward(self, *xs):
+        return sum(xs) / len(xs)
+
+
+class Max(Operator):
+    def forward(self, *xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out
+
+
+class Min(Operator):
+    def forward(self, *xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.minimum(out, x)
+        return out
+
+
+class Clip(Operator):
+    def __init__(self, min=None, max=None):  # noqa: A002
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return jnp.clip(x, self.min, self.max)
+
+
+def _cmp_op(name, fn):
+    cls = type(name, (Operator,), {
+        "forward": staticmethod(lambda *a, _f=fn: _f(*a).astype(jnp.float32))})
+    cls.differentiable = False
+    return cls
+
+
+Less = _cmp_op("Less", jnp.less)
+Greater = _cmp_op("Greater", jnp.greater)
+Equal = _cmp_op("Equal", jnp.equal)
+And = _cmp_op("And", lambda a, b: jnp.logical_and(a > 0, b > 0))
+Or = _cmp_op("Or", lambda a, b: jnp.logical_or(a > 0, b > 0))
+Xor = _cmp_op("Xor", lambda a, b: jnp.logical_xor(a > 0, b > 0))
+Not = _cmp_op("Not", lambda a: jnp.logical_not(a > 0))
+
+
+# ---- shape ops ------------------------------------------------------------
+
+class Reshape(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+
+    def forward(self, x):
+        return jnp.reshape(x, self.shape)
+
+
+class Flatten(Operator):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        lead = int(np.prod(x.shape[:self.axis])) if self.axis else 1
+        return jnp.reshape(x, (lead, -1))
+
+
+class Transpose(Operator):
+    def __init__(self, perm=None):
+        super().__init__()
+        self.perm = tuple(perm) if perm is not None else None
+
+    def forward(self, x):
+        return jnp.transpose(x, self.perm)
+
+
+class Squeeze(Operator):
+    def __init__(self, axis=None):
+        super().__init__()
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def forward(self, x):
+        return jnp.squeeze(x, self.axis)
+
+
+class Unsqueeze(Operator):
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis if isinstance(axis, (list, tuple)) else [axis]
+
+    def forward(self, x):
+        for a in sorted(self.axis):
+            x = jnp.expand_dims(x, a)
+        return x
+
+
+class Concat(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, *xs):
+        return jnp.concatenate(xs, axis=self.axis)
+
+
+class Split(Operator):
+    def __init__(self, axis, parts=None, num_output=None):
+        super().__init__()
+        self.axis = axis
+        self.parts = parts
+        self.num_output = num_output
+
+    def forward(self, x):
+        if self.parts is not None:
+            idx = np.cumsum(self.parts)[:-1].tolist()
+            return tuple(jnp.split(x, idx, axis=self.axis))
+        return tuple(jnp.split(x, self.num_output, axis=self.axis))
+
+
+class Slice(Operator):
+    def __init__(self, starts, ends, axes=None, steps=None):
+        super().__init__()
+        self.starts, self.ends = list(starts), list(ends)
+        self.axes = list(axes) if axes is not None else None
+        self.steps = list(steps) if steps is not None else None
+
+    def forward(self, x):
+        axes = self.axes if self.axes is not None else list(range(len(self.starts)))
+        steps = self.steps if self.steps is not None else [1] * len(self.starts)
+        idx = [builtins_slice(None)] * x.ndim
+        for s, e, a, st in zip(self.starts, self.ends, axes, steps):
+            idx[a] = builtins_slice(s, e, st)
+        return x[tuple(idx)]
+
+
+builtins_slice = slice  # keep builtin reachable; `slice` fn below shadows it
+
+
+class Gather(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x, indices):
+        return jnp.take(x, indices.astype(jnp.int32), axis=self.axis)
+
+
+class ScatterElements(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x, indices, updates):
+        idx = indices.astype(jnp.int32)
+        # build full index grids along every axis, replace on self.axis
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                             indexing="ij")
+        grids[self.axis] = idx
+        return x.at[tuple(grids)].set(updates)
+
+
+class Tile(Operator):
+    def __init__(self, repeats):
+        super().__init__()
+        self.repeats = repeats
+
+    def forward(self, x):
+        return jnp.tile(x, self.repeats)
+
+
+class Expand(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+
+    def forward(self, x):
+        return jnp.broadcast_to(x, jnp.broadcast_shapes(x.shape, self.shape))
+
+
+class Pad(Operator):
+    def __init__(self, mode, pads, constant=0.0):
+        super().__init__()
+        self.mode = mode
+        self.pads = list(pads)
+        self.constant = constant
+
+    def forward(self, x):
+        n = x.ndim
+        width = [(self.pads[i], self.pads[i + n]) for i in range(n)]
+        if self.mode == "constant":
+            return jnp.pad(x, width, constant_values=self.constant)
+        return jnp.pad(x, width, mode={"reflect": "reflect",
+                                       "edge": "edge"}[self.mode])
+
+
+class UpSample(Operator):
+    """Nearest-neighbour upsample by integer scales (reference
+    autograd.UpSample:5263)."""
+
+    def __init__(self, mode="nearest", scales=None):
+        super().__init__()
+        assert mode.lower() == "nearest"
+        self.scales = scales
+
+    def forward(self, x):
+        for axis, s in enumerate(self.scales):
+            s = int(s)
+            if s != 1:
+                x = jnp.repeat(x, s, axis=axis)
+        return x
+
+
+class DepthToSpace(Operator):
+    def __init__(self, blocksize, mode="DCR"):
+        super().__init__()
+        self.b = blocksize
+        self.mode = mode
+
+    def forward(self, x):
+        N, C, H, W = x.shape
+        b = self.b
+        if self.mode == "DCR":
+            y = x.reshape(N, b, b, C // (b * b), H, W)
+            y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+        else:  # CRD
+            y = x.reshape(N, C // (b * b), b, b, H, W)
+            y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+        return y.reshape(N, C // (b * b), H * b, W * b)
+
+
+class SpaceToDepth(Operator):
+    def __init__(self, blocksize):
+        super().__init__()
+        self.b = blocksize
+
+    def forward(self, x):
+        N, C, H, W = x.shape
+        b = self.b
+        y = x.reshape(N, C, H // b, b, W // b, b)
+        y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+        return y.reshape(N, C * b * b, H // b, W // b)
+
+
+# ---- indexing / generation ------------------------------------------------
+
+class Where(Operator):
+    def forward(self, cond, a, b):
+        return jnp.where(jax.lax.stop_gradient(cond) > 0, a, b)
+
+
+class OneHot(Operator):
+    def __init__(self, axis=-1, depth=None, values=(0.0, 1.0)):
+        super().__init__()
+        self.axis = axis
+        self.depth = depth
+        self.values = values
+
+    differentiable = False
+
+    def forward(self, indices):
+        off, on = self.values
+        oh = jax.nn.one_hot(indices.astype(jnp.int32), self.depth,
+                            axis=self.axis)
+        return oh * (on - off) + off
+
+
+class Embedding(Operator):
+    """Lookup rows of W by integer ids (reference autograd.Embedding:5648)."""
+
+    def forward(self, x, W):
+        return jnp.take(W, jax.lax.stop_gradient(x).astype(jnp.int32), axis=0)
+
+
+class CosSim(Operator):
+    def forward(self, a, b):
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+        return num / (den + 1e-12)
+
+
+class Shape(Operator):
+    differentiable = False
+
+    def forward(self, x):
+        return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+class ConstantOfShape(Operator):
+    differentiable = False
+
+    def __init__(self, value=0.0):
+        super().__init__()
+        self.value = value
+
+    def forward(self, x):
+        shape = tuple(int(v) for v in np.asarray(x))
+        return jnp.full(shape, self.value, dtype=jnp.float32)
+
+
+class NonZero(Operator):
+    """Indices of nonzero entries. Dynamic-shaped ⇒ eager/host only (cannot
+    run under jit; reference computes it on host too)."""
+
+    differentiable = False
+
+    def forward(self, x):
+        idx = np.nonzero(np.asarray(jax.device_get(x)))
+        return jnp.asarray(np.stack(idx), dtype=jnp.int64)
+
+
+class Cast(Operator):
+    differentiable = False
+
+    def __init__(self, to):
+        super().__init__()
+        self.to = to
+
+    def forward(self, x):
+        return x.astype(self.to)
+
+
+class Identity(Operator):
+    def forward(self, x):
+        return x
+
+
+class Dropout(Operator):
+    def __init__(self, ratio=0.5):
+        super().__init__()
+        self.ratio = ratio
+
+    def forward(self, x):
+        if not is_training() or self.ratio <= 0.0:
+            return x
+        key = self.dev.rand_key()
+        keep = 1.0 - self.ratio
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+# ===========================================================================
+# functional wrappers (parity with reference snake_case API)
+# ===========================================================================
+
+def add(a, b):
+    return Add()(a, b)
+
+
+def sub(a, b):
+    return Sub()(a, b)
+
+
+def mul(a, b):
+    return Mul()(a, b)
+
+
+def div(a, b):
+    return Div()(a, b)
+
+
+def pow(a, b):  # noqa: A001
+    return Pow()(a, b)
+
+
+def negative(x):
+    return Negative()(x)
+
+
+def reciprocal(x):
+    return Reciprocal()(x)
+
+
+def add_bias(x, b, axis=0):
+    return AddBias(axis)(x, b)
+
+
+def matmul(a, b):
+    return Matmul()(a, b)
+
+
+def gemm(A, B, C=None, alpha=1.0, beta=1.0, transA=0, transB=0):
+    if C is None:
+        return Gemm(alpha, beta, transA, transB)(A, B)
+    return Gemm(alpha, beta, transA, transB)(A, B, C)
+
+
+def add_all(*xs):
+    return Sum()(*xs)
+
+
+def sum(*xs):  # noqa: A001  (reference autograd.sum = elementwise N-ary sum)
+    return Sum()(*xs)
+
+
+def abs(x):  # noqa: A001
+    return Abs()(x)
+
+
+def exp(x):
+    return Exp()(x)
+
+
+def log(x):
+    return Log()(x)
+
+
+def sqrt(x):
+    return Sqrt()(x)
+
+
+def sin(x):
+    return Sin()(x)
+
+
+def cos(x):
+    return Cos()(x)
+
+
+def tan(x):
+    return Tan()(x)
+
+
+def sinh(x):
+    return Sinh()(x)
+
+
+def cosh(x):
+    return Cosh()(x)
+
+
+def asin(x):
+    return Asin()(x)
+
+
+def acos(x):
+    return Acos()(x)
+
+
+def atan(x):
+    return Atan()(x)
+
+
+def asinh(x):
+    return Asinh()(x)
+
+
+def acosh(x):
+    return Acosh()(x)
+
+
+def atanh(x):
+    return Atanh()(x)
+
+
+def tanh(x):
+    return Tanh()(x)
+
+
+def erf(x):
+    return Erf()(x)
+
+
+def ceil(x):
+    return Ceil()(x)
+
+
+def floor(x):
+    return Floor()(x)
+
+
+def round(x):  # noqa: A001
+    return Round()(x)
+
+
+def rounde(x):
+    return Rounde()(x)
+
+
+def sign(x):
+    return Sign()(x)
+
+
+def relu(x):
+    return ReLU()(x)
+
+
+def leakyrelu(x, a=0.01):
+    return LeakyRelu(a)(x)
+
+
+def elu(x, alpha=1.0):
+    return Elu(alpha)(x)
+
+
+def selu(x, alpha=1.67326, gamma=1.0507):
+    return SeLU(alpha, gamma)(x)
+
+
+def sigmoid(x):
+    return Sigmoid()(x)
+
+
+def softplus(x):
+    return SoftPlus()(x)
+
+
+def softsign(x):
+    return SoftSign()(x)
+
+
+def hardsigmoid(x, alpha=0.2, gamma=0.5):
+    return HardSigmoid(alpha, gamma)(x)
+
+
+def prelu(x, slope):
+    return PRelu()(x, slope)
+
+
+def softmax(x, axis=1):
+    return SoftMax(axis)(x)
+
+
+def gelu(x):
+    return GELU()(x)
+
+
+def cross_entropy(y, t):
+    return CrossEntropy()(y, t)
+
+
+def softmax_cross_entropy(x, t):
+    return SoftMaxCrossEntropy()(x, t)
+
+
+def mse_loss(x, t):
+    return MeanSquareError()(x, t)
+
+
+def binary_cross_entropy(x, t):
+    return BinaryCrossEntropy()(x, t)
+
+
+def ranking_loss(pos, neg, M=0.2):
+    return RankingLoss(M)(pos, neg)
+
+
+def reduce_sum(x, axes=None, keepdims=1):
+    return ReduceSum(axes, keepdims)(x)
+
+
+def reduce_mean(x, axes=None, keepdims=1):
+    return ReduceMean(axes, keepdims)(x)
+
+
+def mean(*xs):
+    return Mean()(*xs)
+
+
+def max(*xs):  # noqa: A001
+    return Max()(*xs)
+
+
+def min(*xs):  # noqa: A001
+    return Min()(*xs)
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return Clip(min, max)(x)
+
+
+def less(a, b):
+    return Less()(a, b)
+
+
+def greater(a, b):
+    return Greater()(a, b)
+
+
+def equal(a, b):
+    return Equal()(a, b)
+
+
+def _and(a, b):
+    return And()(a, b)
+
+
+def _or(a, b):
+    return Or()(a, b)
+
+
+def _xor(a, b):
+    return Xor()(a, b)
+
+
+def _not(a):
+    return Not()(a)
+
+
+def reshape(x, shape):
+    return Reshape(shape)(x)
+
+
+def flatten(x, axis=1):
+    return Flatten(axis)(x)
+
+
+def transpose(x, shape=None):
+    return Transpose(shape)(x)
+
+
+def squeeze(x, axis=None):
+    return Squeeze(axis)(x)
+
+
+def unsqueeze(x, axis):
+    return Unsqueeze(axis)(x)
+
+
+def cat(xs, axis=0):
+    return Concat(axis)(*xs)
+
+
+def split(x, axis, parts=None, num_output=None):
+    return Split(axis, parts, num_output)(x)
+
+
+def slice(x, starts, ends, axes=None, steps=None):  # noqa: A001
+    return Slice(starts, ends, axes, steps)(x)
+
+
+def make_slice(x, axis, idx):
+    """Take index ``idx`` along ``axis`` keeping dims (reference helper)."""
+    return Slice([idx], [idx + 1], [axis])(x)
+
+
+def gather(x, axis, indices):
+    if isinstance(indices, (list, tuple, np.ndarray)):
+        indices = Tensor(data=np.asarray(indices, dtype=np.int32),
+                         requires_grad=False)
+    return Gather(axis)(x, indices)
+
+
+def scatter_elements(x, indices, updates, axis=0):
+    return ScatterElements(axis)(x, indices, updates)
+
+
+def tile(x, repeats):
+    return Tile(repeats)(x)
+
+
+def expand(x, shape):
+    return Expand(shape)(x)
+
+
+def pad(x, mode, pads, constant=0.0):
+    return Pad(mode, pads, constant)(x)
+
+
+def upsample(x, mode="nearest", scales=None):
+    return UpSample(mode, scales)(x)
+
+
+def depth_to_space(x, blocksize, mode="DCR"):
+    return DepthToSpace(blocksize, mode)(x)
+
+
+def space_to_depth(x, blocksize):
+    return SpaceToDepth(blocksize)(x)
+
+
+def where(cond, a, b):
+    return Where()(cond, a, b)
+
+
+def onehot(axis, indices, depth, values=(0.0, 1.0)):
+    return OneHot(axis, depth, values)(indices)
+
+
+def embedding(x, W):
+    return Embedding()(x, W)
+
+
+def cossim(a, b):
+    return CosSim()(a, b)
+
+
+def shape(x):
+    return Shape()(x)
+
+
+def constant_of_shape(x, value=0.0):
+    return ConstantOfShape(value)(x)
+
+
+def nonzero(x):
+    return NonZero()(x)
+
+
+def cast(x, to):
+    return Cast(to)(x)
+
+
+def identity(x):
+    return Identity()(x)
+
+
+def dropout(x, ratio=0.5):
+    return Dropout(ratio)(x)
+
+
+def ctensor2numpy(x):
+    return np.asarray(jax.device_get(_raw(x)))
+
+
+# ---- conv/bn/pool/rnn ops live in singa_tpu.ops; re-export here for parity
+from .ops.conv import (ConvHandle, _Conv2d, conv2d)  # noqa: E402
+from .ops.batchnorm import (BatchNormHandle, _BatchNorm2d,  # noqa: E402
+                            batchnorm_2d)
+from .ops.pooling import (PoolingHandle, _Pooling2d, pooling_2d,  # noqa: E402
+                          globalaveragepool, GlobalAveragePool)
+from .ops.rnn import (CudnnRNNHandle, _RNN, rnn_op)  # noqa: E402

@@ -1,0 +1,216 @@
+"""The autograd tape engine (see singa_tpu/autograd.py for the op library).
+
+Split from autograd.py so the structured ops in ``singa_tpu/ops/`` can
+subclass :class:`Operator` without a circular import. The public surface is
+re-exported by ``singa_tpu.autograd`` for reference parity
+(python/singa/autograd.py:71-314).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from . import device as device_mod
+
+
+class _Context:
+    """Global autograd mode flags (reference: autograd.training module var)."""
+
+    def __init__(self):
+        self.training = False
+
+
+CTX = _Context()
+
+
+def is_training() -> bool:
+    return CTX.training
+
+
+def set_training(flag: bool) -> None:
+    CTX.training = bool(flag)
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x)
+
+
+def _is_float0(g):
+    return g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+
+
+class Operator:
+    """A differentiable operation node on the tape.
+
+    Subclasses implement ``forward(*arrays) -> array | tuple`` with pure
+    jax.numpy; the whole tape therefore traces under ``jax.jit`` into one XLA
+    computation. ``backward`` defaults to the vjp of ``forward`` — exactly
+    consistent with forward and XLA-fused; override only for custom gradient
+    semantics. Mirrors reference ``Operator._do_forward`` (autograd.py:270-314).
+    """
+
+    op_count = 0
+    differentiable = True
+
+    def __init__(self, name: str | None = None):
+        if name is None:
+            name = f"{type(self).__name__}#{Operator.op_count}"
+            Operator.op_count += 1
+        self.name = name
+        self.src = []
+        self.y_ids = ()
+        self.y_shapes = ()
+        self.y_dtypes = ()
+        self._vjp_fn = None
+        self.dev = None
+
+    def __call__(self, *xs):
+        return self._do_forward(*xs)
+
+    def _has_custom_backward(self) -> bool:
+        return type(self).backward is not Operator.backward
+
+    def _do_forward(self, *xs):
+        raws = [_raw(x) for x in xs]
+        self.dev = next((x.device for x in xs if isinstance(x, Tensor)),
+                        device_mod.get_default_device())
+        tape = (CTX.training and self.differentiable and
+                any(isinstance(x, Tensor) and x.requires_grad for x in xs))
+        if tape and not self._has_custom_backward():
+            ys, self._vjp_fn = jax.vjp(self.forward, *raws)
+        else:
+            ys = self.forward(*raws)
+        multiple = isinstance(ys, (tuple, list))
+        ys_t = tuple(ys) if multiple else (ys,)
+
+        outs = []
+        for y in ys_t:
+            t = Tensor.__new__(Tensor)
+            t.data = y
+            t.device = self.dev
+            t.requires_grad = tape
+            t.stores_grad = False
+            t.creator = self if tape else None
+            t.name = None
+            t.grad = None
+            outs.append(t)
+
+        if tape:
+            self.src = []
+            for x in xs:
+                if isinstance(x, Tensor) and x.requires_grad:
+                    if x.creator is None:
+                        x.creator = Dummy(x)
+                    self.src.append((x.creator, id(x),
+                                     x if x.stores_grad else None, True))
+                else:
+                    self.src.append((None, id(x), None, False))
+            self.y_ids = tuple(id(t) for t in outs)
+            self.y_shapes = tuple(y.shape for y in ys_t)
+            self.y_dtypes = tuple(y.dtype for y in ys_t)
+
+        return tuple(outs) if multiple else outs[0]
+
+    def forward(self, *xs):
+        raise NotImplementedError
+
+    def backward(self, *dys):
+        """Default: vjp of forward. Returns one grad per forward input."""
+        assert self._vjp_fn is not None, \
+            f"{self.name}: backward called without a recorded forward"
+        if len(self.y_shapes) > 1:
+            grads = self._vjp_fn(tuple(dys))
+        else:
+            grads = self._vjp_fn(dys[0])
+        return grads if len(grads) > 1 else grads[0]
+
+
+class Dummy(Operator):
+    """Leaf creator marking graph inputs/params (reference autograd.Dummy)."""
+
+    def __init__(self, tensor: Tensor, name=None):
+        super().__init__(name)
+        self.tensor = tensor
+        self.src = []
+        self.y_ids = (id(tensor),)
+        self.y_shapes = (tensor.shape,)
+        self.y_dtypes = (tensor.dtype,)
+
+
+def infer_dependency(op: Operator):
+    """Count, for every upstream op, how many consumer edges reference it
+    (reference autograd.py:71-102)."""
+    dependency = {op: 0}
+    queue = deque([op])
+    while queue:
+        cur = queue.popleft()
+        for (src_op, _xid, _t, requires) in cur.src:
+            if src_op is None or not requires:
+                continue
+            if src_op not in dependency:
+                dependency[src_op] = 0
+                queue.append(src_op)
+            dependency[src_op] += 1
+    return dependency
+
+
+def backward(y: Tensor, dy=None):
+    """Reverse-mode over the tape from ``y``; lazily yields
+    ``(param_tensor, grad_tensor)`` pairs as each parameter's gradient
+    becomes complete (reference autograd.py:128-224), so optimizers can
+    overlap updates / collective all-reduces with the rest of backward."""
+    assert y.creator is not None, "call backward on a tape output"
+    if dy is None:
+        dy = jnp.ones(y.shape, dtype=y.dtype)
+    else:
+        dy = _raw(dy)
+
+    dependency = infer_dependency(y.creator)
+    pending = {y.creator: [None] * len(y.creator.y_ids)}
+    pending[y.creator][y.creator.y_ids.index(id(y))] = dy
+    ready = deque([y.creator])
+    seen_params = set()
+
+    while ready:
+        op = ready.popleft()
+        dys = pending.pop(op)
+        dys = [d if d is not None else jnp.zeros(s, dt)
+               for d, s, dt in zip(dys, op.y_shapes, op.y_dtypes)]
+
+        if isinstance(op, Dummy):
+            t = op.tensor
+            if t.stores_grad and id(t) not in seen_params:
+                seen_params.add(id(t))
+                g = Tensor(data=dys[0], device=t.device, requires_grad=False)
+                t.grad = g
+                yield (t, g)
+            continue
+
+        dxs = op.backward(*dys)
+        if not isinstance(dxs, (tuple, list)):
+            dxs = (dxs,)
+        assert len(dxs) == len(op.src), \
+            f"{op.name}: backward returned {len(dxs)} grads for " \
+            f"{len(op.src)} inputs"
+
+        for (src_op, x_id, _t, requires), dx in zip(op.src, dxs):
+            if src_op is None or not requires or _is_float0(dx):
+                continue
+            slot = pending.setdefault(src_op, [None] * len(src_op.y_ids))
+            pos = src_op.y_ids.index(x_id)
+            slot[pos] = dx if slot[pos] is None else slot[pos] + dx
+            dependency[src_op] -= 1
+            if dependency[src_op] == 0:
+                ready.append(src_op)
+
+
+def gradients(y: Tensor, dy=None):
+    """Materialise all (param, grad) pairs into a dict keyed by param
+    (reference autograd.gradients, autograd.py:105)."""
+    return {p: g for p, g in backward(y, dy)}

@@ -1,0 +1,120 @@
+"""Parameter initializers (parity: reference python/singa/initializer.py).
+
+All fillers mutate the given Tensor in place via the device's functional
+PRNG (jax.random), replacing curand host-side filling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+def _compute_fans(shape):
+    """fan_in/fan_out following the reference's conv-aware convention
+    (initializer.py:_compute_fans)."""
+    shape = tuple(shape)
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) in (3, 4, 5):
+        receptive = int(np.prod(shape[2:]))
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    else:
+        fan_in = fan_out = int(np.sqrt(np.prod(shape)))
+    return float(fan_in), float(fan_out)
+
+
+def _random_fill(t: Tensor, mode: str, scale: float, distribution: str):
+    fan_in, fan_out = _compute_fans(t.shape)
+    n = {"fan_in": fan_in, "fan_out": fan_out,
+         "fan_avg": (fan_in + fan_out) / 2.0}[mode]
+    s = scale / max(1.0, n)
+    if distribution == "normal":
+        std = np.sqrt(s)
+        t.gaussian(0.0, std)
+    else:
+        limit = np.sqrt(3.0 * s)
+        t.uniform(-limit, limit)
+    return t
+
+
+def eye(t: Tensor):
+    assert len(t.shape) == 2, "eye initializer needs a matrix"
+    t.data = jnp.eye(t.shape[0], t.shape[1], dtype=t.dtype)
+    return t
+
+
+def orthogonal(t: Tensor):
+    assert len(t.shape) == 2
+    k = t.device.rand_key()
+    a = jax.random.normal(k, t.shape, dtype=jnp.float32)
+    q, r = jnp.linalg.qr(a if t.shape[0] >= t.shape[1] else a.T)
+    q = q * jnp.sign(jnp.diag(r))
+    if t.shape[0] < t.shape[1]:
+        q = q.T
+    t.data = q.astype(t.dtype)
+    return t
+
+
+def lecun_uniform(t: Tensor):
+    return _random_fill(t, "fan_in", 1.0, "uniform")
+
+
+def lecun_normal(t: Tensor):
+    return _random_fill(t, "fan_in", 1.0, "normal")
+
+
+def glorot_uniform(t: Tensor):
+    return _random_fill(t, "fan_avg", 1.0, "uniform")
+
+
+def glorot_normal(t: Tensor):
+    return _random_fill(t, "fan_avg", 1.0, "normal")
+
+
+def he_uniform(t: Tensor):
+    return _random_fill(t, "fan_in", 2.0, "uniform")
+
+
+def he_normal(t: Tensor):
+    return _random_fill(t, "fan_in", 2.0, "normal")
+
+
+# ---- deprecated reference aliases (initializer.py:gaussian/xavier/...) ----
+
+def uniform(t: Tensor, fan_in=0, fan_out=0):
+    avg = 1
+    x = fan_in + fan_out
+    if fan_in * fan_out == 0:
+        x = max(fan_in, fan_out)
+        avg = 2
+    limit = float(np.sqrt(3.0 * avg / max(1, x)))
+    t.uniform(-limit, limit)
+    return t
+
+
+def gaussian(t: Tensor, fan_in=0, fan_out=0):
+    avg = 1
+    x = fan_in + fan_out
+    if fan_in * fan_out == 0:
+        x = max(fan_in, fan_out)
+        avg = 2
+    std = float(np.sqrt(avg / max(1, x)))
+    t.gaussian(0.0, std)
+    return t
+
+
+def xavier(t: Tensor):
+    return glorot_uniform(t)
+
+
+def glorot(t: Tensor):
+    return glorot_normal(t)
+
+
+def msra(t: Tensor):
+    return he_normal(t)

@@ -1,0 +1,650 @@
+"""Layer API: deferred shape-inferring initialization + hierarchical params.
+
+Capability parity with the reference layer system (python/singa/layer.py):
+``initialize`` runs lazily on the first forward with the input's shapes
+(LayerMeta, layer.py:29-73), parameters/states are exposed as hierarchical
+name→Tensor dicts (layer.py:75+), and the same layer zoo is provided.
+
+TPU-first: layers hold Tensors whose payloads are jax.Arrays; a layer's
+forward builds tape ops that trace under jit. Conv/BN/Pool/RNN use the
+Handle configs from ``singa_tpu.ops`` which lower to MXU-friendly lax
+primitives.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import autograd
+from .autograd_base import CTX
+from .tensor import Tensor
+from .ops.conv import ConvHandle
+from .ops.batchnorm import BatchNormHandle
+from .ops.pooling import PoolingHandle
+from .ops.rnn import CudnnRNNHandle
+
+
+class Layer:
+    """Base layer (reference python/singa/layer.py Layer)."""
+
+    sep = "."
+
+    def __init__(self):
+        self.name = self.__class__.__name__
+        self._initialized = False
+        self._parent = None
+
+    # -- naming / hierarchy ----------------------------------------------
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Layer):
+            value.name = name
+            value._parent = self
+        elif isinstance(value, (list, tuple)):
+            for i, v in enumerate(value):
+                if isinstance(v, Layer):
+                    v.name = f"{name}{self.sep}{i}"
+                    v._parent = self
+        object.__setattr__(self, name, value)
+
+    def _sublayers(self):
+        out = []
+        for k, v in vars(self).items():
+            if k.startswith("_") or k == "name":
+                continue
+            if isinstance(v, Layer):
+                out.append((v.name, v))
+            elif isinstance(v, (list, tuple)):
+                out.extend((s.name, s) for s in v if isinstance(s, Layer))
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+    def initialize(self, *input):  # noqa: A002
+        pass
+
+    def forward(self, *input):  # noqa: A002
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        if not self._initialized:
+            # deferred, shape-inferring init (reference LayerMeta: graph is
+            # disabled during init so param creation is not taped)
+            prev = CTX.training
+            CTX.training = False
+            try:
+                self.initialize(*args, **kwargs)
+            finally:
+                CTX.training = prev
+            self._initialized = True
+        return self.forward(*args, **kwargs)
+
+    @property
+    def training(self):
+        return CTX.training
+
+    # -- params / states ---------------------------------------------------
+    def _own_params(self):
+        """Override: dict of local param name -> Tensor."""
+        return {}
+
+    def _own_states(self):
+        """Override: dict of local state name -> Tensor (includes params)."""
+        return dict(self._own_params())
+
+    def get_params(self):
+        params = {f"{self.name}{self.sep}{k}": v
+                  for k, v in self._own_params().items()}
+        for _, sub in self._sublayers():
+            for k, v in sub.get_params().items():
+                params[f"{self.name}{self.sep}{k}"] = v
+        return params
+
+    def set_params(self, params):
+        for k, v in self._own_params().items():
+            full = f"{self.name}{self.sep}{k}"
+            if full in params:
+                v.copy_from(params[full])
+        for _, sub in self._sublayers():
+            sub.set_params({k[len(self.name) + 1:]: v
+                            for k, v in params.items()
+                            if k.startswith(self.name + self.sep)})
+
+    def get_states(self):
+        states = {f"{self.name}{self.sep}{k}": v
+                  for k, v in self._own_states().items()}
+        for _, sub in self._sublayers():
+            for k, v in sub.get_states().items():
+                states[f"{self.name}{self.sep}{k}"] = v
+        return states
+
+    def set_states(self, states):
+        for k, v in self._own_states().items():
+            full = f"{self.name}{self.sep}{k}"
+            if full in states:
+                v.copy_from(states[full])
+        for _, sub in self._sublayers():
+            sub.set_states({k[len(self.name) + 1:]: v
+                            for k, v in states.items()
+                            if k.startswith(self.name + self.sep)})
+
+    def device_check(self, *tensors):
+        devs = [t.device for t in tensors if isinstance(t, Tensor)]
+        return devs[0] if devs else None
+
+    def register_layers(self, *layers):
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)):
+            layers = layers[0]
+        self._registered = list(layers)
+
+
+def _param(shape, device, init="zeros", dtype=jnp.float32):
+    t = Tensor(shape=shape, device=device, dtype=dtype,
+               requires_grad=True, stores_grad=True)
+    if init == "ones":
+        t.data = jnp.ones(shape, dtype=dtype)
+    return t
+
+
+class Linear(Layer):
+    """y = xW + b (reference layer.Linear:287)."""
+
+    def __init__(self, out_features, bias=True):
+        super().__init__()
+        self.out_features = out_features
+        self.bias = bias
+
+    def initialize(self, x):
+        self.in_features = x.shape[-1]
+        dev = x.device
+        self.W = _param((self.in_features, self.out_features), dev)
+        std = math.sqrt(2.0 / (self.in_features + self.out_features))
+        self.W.gaussian(0.0, std)
+        if self.bias:
+            self.b = _param((self.out_features,), dev)
+
+    def forward(self, x):
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        return y
+
+    def _own_params(self):
+        p = {"W": self.W}
+        if self.bias:
+            p["b"] = self.b
+        return p
+
+
+class Gemm(Layer):
+    """onnx-style Gemm layer (reference layer.Gemm)."""
+
+    def __init__(self, nb_kernels, alpha=1.0, beta=1.0, transA=False,
+                 transB=True, bias=True, bias_shape=None):
+        super().__init__()
+        self.nb_kernels = nb_kernels
+        self.alpha, self.beta = alpha, beta
+        self.transA, self.transB = int(transA), int(transB)
+        self.bias = bias
+        self.bias_shape = bias_shape
+
+    def initialize(self, x):
+        dev = x.device
+        feat = x.shape[0] if self.transA else x.shape[-1]
+        w_shape = (self.nb_kernels, feat) if self.transB \
+            else (feat, self.nb_kernels)
+        self.W = _param(w_shape, dev)
+        std = math.sqrt(2.0 / (feat + self.nb_kernels))
+        self.W.gaussian(0.0, std)
+        if self.bias:
+            self.b = _param(self.bias_shape or (1, self.nb_kernels), dev)
+
+    def forward(self, x):
+        if self.bias:
+            return autograd.gemm(x, self.W, self.b, self.alpha, self.beta,
+                                 self.transA, self.transB)
+        return autograd.gemm(x, self.W, None, self.alpha, self.beta,
+                             self.transA, self.transB)
+
+    def _own_params(self):
+        p = {"W": self.W}
+        if self.bias:
+            p["b"] = self.b
+        return p
+
+
+class Embedding(Layer):
+    """Token embedding lookup (reference layer.Embedding)."""
+
+    def __init__(self, input_dim, output_dim, initializer="gaussian"):
+        super().__init__()
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.initializer = initializer
+
+    def initialize(self, x):
+        self.W = _param((self.input_dim, self.output_dim), x.device)
+        if self.initializer == "gaussian":
+            self.W.gaussian(0.0, 0.02)
+        else:
+            self.W.uniform(-0.05, 0.05)
+
+    def forward(self, x):
+        return autograd.embedding(x, self.W)
+
+    def _own_params(self):
+        return {"W": self.W}
+
+
+class Conv2d(Layer):
+    """2-D convolution layer (reference layer.Conv2d:508)."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 dilation=1, group=1, bias=True, pad_mode="NOTSET",
+                 activation="NOTSET"):
+        super().__init__()
+        self.nb_kernels = nb_kernels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.group = group
+        self.bias = bias
+        self.pad_mode = pad_mode
+        self.activation = activation
+        assert dilation in (1, (1, 1)), "dilation>1 not yet supported"
+
+    def initialize(self, x):
+        self.in_channels = x.shape[1]
+        dev = x.device
+        ks = self.kernel_size if isinstance(self.kernel_size, (tuple, list)) \
+            else (self.kernel_size, self.kernel_size)
+        w_shape = (self.nb_kernels, self.in_channels // self.group, *ks)
+        self.W = _param(w_shape, dev)
+        std = math.sqrt(2.0 / (ks[0] * ks[1] * self.nb_kernels))
+        self.W.gaussian(0.0, std)
+        if self.bias:
+            self.b = _param((self.nb_kernels,), dev)
+        pad = self.padding
+        pad_mode = None
+        if self.pad_mode in ("SAME_UPPER", "SAME_LOWER"):
+            pad_mode = "SAME"
+        elif self.pad_mode == "VALID":
+            pad_mode = "VALID"
+        self.handle = ConvHandle(x, ks, self.stride, pad,
+                                 self.in_channels, self.nb_kernels,
+                                 self.bias, self.group, pad_mode)
+
+    def forward(self, x):
+        from .ops.conv import conv2d
+        y = conv2d(self.handle, x, self.W, self.b if self.bias else None)
+        if self.activation == "RELU":
+            y = autograd.relu(y)
+        return y
+
+    def _own_params(self):
+        p = {"W": self.W}
+        if self.bias:
+            p["b"] = self.b
+        return p
+
+
+class SeparableConv2d(Layer):
+    """Depthwise + pointwise conv (reference layer.SeparableConv2d:740)."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 bias=False):
+        super().__init__()
+        self.depthwise = None
+        self.pointwise = None
+        self.nb_kernels = nb_kernels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.bias = bias
+
+    def initialize(self, x):
+        in_channels = x.shape[1]
+        self.depthwise = Conv2d(in_channels, self.kernel_size, self.stride,
+                                self.padding, group=in_channels,
+                                bias=self.bias)
+        self.pointwise = Conv2d(self.nb_kernels, 1, bias=self.bias)
+        self.depthwise.name = f"{self.name}{self.sep}depthwise"
+        self.pointwise.name = f"{self.name}{self.sep}pointwise"
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+    def get_params(self):
+        out = {}
+        for sub in (self.depthwise, self.pointwise):
+            out.update(sub.get_params())
+        return out
+
+    def set_params(self, params):
+        for sub in (self.depthwise, self.pointwise):
+            sub.set_params(params)
+
+    def get_states(self):
+        return self.get_params()
+
+    def set_states(self, states):
+        self.set_params(states)
+
+
+class BatchNorm2d(Layer):
+    """BN over channel axis (reference layer.BatchNorm2d:802)."""
+
+    def __init__(self, momentum=0.9, eps=1e-5):
+        super().__init__()
+        self.momentum = momentum
+        self.eps = eps
+
+    def initialize(self, x):
+        self.channels = x.shape[1]
+        dev = x.device
+        c = (self.channels,)
+        self.scale = _param(c, dev, init="ones")
+        self.bias = _param(c, dev)
+        self.running_mean = Tensor(shape=c, device=dev, requires_grad=False)
+        self.running_var = Tensor(shape=c, device=dev, requires_grad=False)
+        self.running_var.data = jnp.ones(c, dtype=jnp.float32,
+                                         device=dev.jax_device)
+        self.handle = BatchNormHandle(self.momentum, x, self.eps)
+
+    def forward(self, x):
+        from .ops.batchnorm import batchnorm_2d
+        return batchnorm_2d(self.handle, x, self.scale, self.bias,
+                            self.running_mean, self.running_var)
+
+    def _own_params(self):
+        return {"scale": self.scale, "bias": self.bias}
+
+    def _own_states(self):
+        return {"scale": self.scale, "bias": self.bias,
+                "running_mean": self.running_mean,
+                "running_var": self.running_var}
+
+
+class Pooling2d(Layer):
+    """Base pooling layer (reference layer.Pooling2d:891)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, is_max=True):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+        self.is_max = is_max
+
+    def initialize(self, x):
+        self.handle = PoolingHandle(x, self.kernel_size, self.stride,
+                                    self.padding, self.is_max)
+
+    def forward(self, x):
+        from .ops.pooling import pooling_2d
+        return pooling_2d(self.handle, x)
+
+
+class MaxPool2d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__(kernel_size, stride, padding, True)
+
+
+class AvgPool2d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__(kernel_size, stride, padding, False)
+
+
+class MaxPool1d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        if stride is None:
+            stride = kernel_size
+        super().__init__((1, kernel_size), (1, stride), (0, padding), True)
+
+
+class AvgPool1d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        if stride is None:
+            stride = kernel_size
+        super().__init__((1, kernel_size), (1, stride), (0, padding), False)
+
+
+class RNN_Base(Layer):
+    def step_forward(self, x, h, c=None):
+        raise NotImplementedError
+
+
+class RNN(RNN_Base):
+    """Pure-tape vanilla RNN over a list of per-step tensors
+    (reference layer.RNN:1129)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 nonlinearity="tanh", bias=True, batch_first=False,
+                 dropout=0, bidirectional=False):
+        super().__init__()
+        assert num_layers == 1 and not bidirectional
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.nonlinearity = nonlinearity
+        self.bias = bias
+
+    def initialize(self, xs, h0):
+        dev = h0.device
+        k = 1.0 / math.sqrt(self.hidden_size)
+        self.Wx = _param((self.input_size, self.hidden_size), dev)
+        self.Wh = _param((self.hidden_size, self.hidden_size), dev)
+        self.b = _param((self.hidden_size,), dev)
+        for p in (self.Wx, self.Wh, self.b):
+            p.uniform(-k, k)
+
+    def step_forward(self, x, h):
+        y = autograd.add(autograd.matmul(x, self.Wx),
+                         autograd.matmul(h, self.Wh))
+        y = autograd.add_bias(y, self.b, axis=0)
+        return autograd.tanh(y) if self.nonlinearity == "tanh" \
+            else autograd.relu(y)
+
+    def forward(self, xs, h0):
+        out = []
+        h = h0
+        for x in xs:
+            h = self.step_forward(x, h)
+            out.append(h)
+        return out, h
+
+    def _own_params(self):
+        return {"Wx": self.Wx, "Wh": self.Wh, "b": self.b}
+
+
+class LSTM(RNN_Base):
+    """Pure-tape LSTM over a list of per-step tensors
+    (reference layer.LSTM:1229)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, bias=True,
+                 batch_first=False, dropout=0, bidirectional=False):
+        super().__init__()
+        assert num_layers == 1 and not bidirectional
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.bias = bias
+
+    def initialize(self, xs, hc):
+        h0, _ = hc
+        dev = h0.device
+        k = 1.0 / math.sqrt(self.hidden_size)
+        self.Wx = _param((self.input_size, 4 * self.hidden_size), dev)
+        self.Wh = _param((self.hidden_size, 4 * self.hidden_size), dev)
+        self.b = _param((4 * self.hidden_size,), dev)
+        for p in (self.Wx, self.Wh, self.b):
+            p.uniform(-k, k)
+
+    def step_forward(self, x, h, c):
+        g = autograd.add_bias(
+            autograd.add(autograd.matmul(x, self.Wx),
+                         autograd.matmul(h, self.Wh)), self.b, axis=0)
+        H = self.hidden_size
+        i = autograd.sigmoid(autograd.slice(g, [0], [H], [1]))
+        f = autograd.sigmoid(autograd.slice(g, [H], [2 * H], [1]))
+        gg = autograd.tanh(autograd.slice(g, [2 * H], [3 * H], [1]))
+        o = autograd.sigmoid(autograd.slice(g, [3 * H], [4 * H], [1]))
+        c_new = autograd.add(autograd.mul(f, c), autograd.mul(i, gg))
+        h_new = autograd.mul(o, autograd.tanh(c_new))
+        return h_new, c_new
+
+    def forward(self, xs, hc):
+        h, c = hc
+        out = []
+        for x in xs:
+            h, c = self.step_forward(x, h, c)
+            out.append(h)
+        return out, (h, c)
+
+    def _own_params(self):
+        return {"Wx": self.Wx, "Wh": self.Wh, "b": self.b}
+
+
+class CudnnRNN(Layer):
+    """Packed-weight fused RNN on lax.scan (reference layer.CudnnRNN:1550 —
+    the name is kept for drop-in parity; nothing cuDNN remains)."""
+
+    def __init__(self, hidden_size, activation="tanh", num_layers=1,
+                 bias=True, batch_first=False, dropout=0,
+                 bidirectional=False, rnn_mode="lstm", use_mask=False,
+                 return_sequences=True):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.num_layers = num_layers
+        self.bias = bias
+        self.batch_first = batch_first
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+        self.rnn_mode = rnn_mode if rnn_mode != "vanilla" else activation
+        self.use_mask = use_mask
+        self.return_sequences = return_sequences
+
+    def initialize(self, x, hx=None, cx=None, seq_lengths=None):
+        xs = x.shape if not self.batch_first \
+            else (x.shape[1], x.shape[0], x.shape[2])
+        self.handle = CudnnRNNHandle(
+            type("S", (), {"shape": xs}), self.hidden_size,
+            mode=self.rnn_mode, num_layers=self.num_layers, bias=self.bias,
+            dropout=self.dropout, bidirectional=self.bidirectional)
+        self.W = _param((self.handle.weights_size,), x.device)
+        k = 1.0 / math.sqrt(self.hidden_size)
+        self.W.uniform(-k, k)
+
+    def forward(self, x, hx=None, cx=None, seq_lengths=None):
+        from .ops.rnn import rnn_op
+        h = self.handle
+        if self.batch_first:
+            x = autograd.transpose(x, (1, 0, 2))
+        B = x.shape[1]
+        shape = (h.num_layers * h.num_directions, B, h.hidden_size)
+        if hx is None:
+            hx = Tensor(shape=shape, device=x.device, requires_grad=False)
+        if cx is None:
+            cx = Tensor(shape=shape, device=x.device, requires_grad=False)
+        y, hy, cy = rnn_op(h, x, hx, cx, self.W, seq_lengths)
+        if self.batch_first:
+            y = autograd.transpose(y, (1, 0, 2))
+        if not self.return_sequences:
+            y = autograd.make_slice(y, 0 if not self.batch_first else 1,
+                                    y.shape[0 if not self.batch_first else 1]
+                                    - 1)
+            y = autograd.squeeze(y, 0 if not self.batch_first else 1)
+        return y, hy, cy
+
+    def _own_params(self):
+        return {"W": self.W}
+
+
+# ---- stateless wrappers ---------------------------------------------------
+
+class ReLU(Layer):
+    def forward(self, x):
+        return autograd.relu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return autograd.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return autograd.tanh(x)
+
+
+class Add(Layer):
+    def forward(self, a, b):
+        return autograd.add(a, b)
+
+
+class Flatten(Layer):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.flatten(x, self.axis)
+
+
+class SoftMax(Layer):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.softmax(x, self.axis)
+
+
+class SoftMaxCrossEntropy(Layer):
+    def forward(self, x, t):
+        return autograd.softmax_cross_entropy(x, t)
+
+
+class MeanSquareError(Layer):
+    def forward(self, x, t):
+        return autograd.mse_loss(x, t)
+
+
+class CrossEntropy(Layer):
+    def forward(self, x, t):
+        return autograd.cross_entropy(x, t)
+
+
+class BinaryCrossEntropy(Layer):
+    def forward(self, x, t):
+        return autograd.binary_cross_entropy(x, t)
+
+
+class Dropout(Layer):
+    def __init__(self, ratio=0.5):
+        super().__init__()
+        self.ratio = ratio
+
+    def forward(self, x):
+        return autograd.dropout(x, self.ratio)
+
+
+class Cat(Layer):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, xs):
+        return autograd.cat(xs, self.axis)
+
+
+class Reshape(Layer):
+    def __init__(self, shape=None):
+        super().__init__()
+        self.shape = shape
+
+    def forward(self, x, shape=None):
+        return autograd.reshape(x, shape if shape is not None else self.shape)

@@ -1,0 +1,353 @@
+"""Model API: trace-once-then-replay training steps on XLA.
+
+Capability parity with the reference Model (python/singa/model.py): the user
+subclasses :class:`Model`, defines ``forward`` and ``train_one_batch``, calls
+``compile`` once, then ``model(tx, ty)`` per step. In the reference, graph
+mode buffers ops into the C++ Graph on the first call and replays it after
+(ModelMeta.buffer_operation, model.py:39-100); here graph mode *is*
+``jax.jit``:
+
+- call 1 runs eagerly, materialising deferred layer params and optimizer aux
+  state (the reference's trace-with-graph-enabled pass);
+- call 2 traces ``train_one_batch`` — forward, the autograd tape's backward,
+  and the optimizer update — into ONE XLA computation with all mutable state
+  (params, BN running stats, optimizer moments) threaded functionally and
+  donated, so XLA buffer-assignment reproduces the Graph's memory recycling
+  (scheduler.cc:671-688) and its topological scheduling for free;
+- later calls replay the compiled executable.
+
+Distributed: if the model's optimizer is a ``DistOpt``, the compiled step is
+``shard_map``'d over the mesh 'data' axis — inputs batch-sharded, state
+replicated — and the per-gradient ``psum`` calls inside the tape become ICI
+all-reduces that XLA overlaps with remaining backward compute (the TPU form
+of the reference's stream-overlap design, opt.py:826-865).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import zipfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from .tensor import Tensor
+from .layer import Layer
+from .autograd_base import CTX
+from . import device as device_mod
+
+
+def _flatten(obj, leaves):
+    """Flatten nested tuples/lists/dicts of Tensors into arrays + treedef."""
+    if isinstance(obj, Tensor):
+        leaves.append(obj.data)
+        return ("T", len(leaves) - 1)
+    if isinstance(obj, (list, tuple)):
+        kids = [_flatten(o, leaves) for o in obj]
+        return ("L" if isinstance(obj, list) else "U", kids)
+    if isinstance(obj, dict):
+        return ("D", {k: _flatten(v, leaves) for k, v in obj.items()})
+    leaves.append(jnp.asarray(obj))
+    return ("T", len(leaves) - 1)
+
+
+def _unflatten(tree, leaves, device):
+    kind, val = tree
+    if kind == "T":
+        return Tensor(data=leaves[val], device=device, requires_grad=False)
+    if kind == "U":
+        return tuple(_unflatten(k, leaves, device) for k in val)
+    if kind == "L":
+        return [_unflatten(k, leaves, device) for k in val]
+    return {k: _unflatten(v, leaves, device) for k, v in val.items()}
+
+
+class Model(Layer):
+    """Base user model (reference python/singa/model.py Model)."""
+
+    def __init__(self):
+        super().__init__()
+        self.graph_mode = True
+        self.sequential = False
+        self._train = False
+        self.dev = None
+        self._compiled = False
+        self._step_ready = False   # first (eager) train call done
+        self._jit_step = None
+        self._jit_eval = None
+        self._state_list = None
+        self._dist = None
+        self.step_times = []
+
+    # -- user hooks --------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def train_one_batch(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        self.optimizer = optimizer
+
+    # -- modes -------------------------------------------------------------
+    def train(self, mode=True):
+        self._train = mode
+        CTX.training = mode
+
+    def eval(self):
+        self.train(False)
+
+    def graph(self, mode=True, sequential=False):
+        """Enable/disable compiled-graph execution
+        (reference model.py graph())."""
+        self.graph_mode = mode
+        self.sequential = sequential
+
+    # -- compile -----------------------------------------------------------
+    def compile(self, inputs, is_train=True, use_graph=False,
+                sequential=False):
+        """Shape-infer via a dry forward run (reference model.py:156-184),
+        decide graph (jit) mode, and detect a distributed optimizer."""
+        assert len(inputs) > 0
+        self.dev = inputs[0].device
+        self.graph_mode = use_graph
+        self.sequential = sequential
+        prev = CTX.training
+        CTX.training = False
+        try:
+            self.forward(*inputs)
+        finally:
+            CTX.training = prev
+        # name params/states now so optimizer aux keys are stable between
+        # the eager first step and the traced step
+        for name, t in self.get_states().items():
+            t.name = t.name or name
+        opt = getattr(self, "optimizer", None)
+        from .opt import DistOpt
+        if isinstance(opt, DistOpt):
+            self._dist = opt
+        self._compiled = True
+        self.train(is_train)
+
+    # -- state plumbing ----------------------------------------------------
+    def _state_tensors(self):
+        """Ordered mutable state: layer params+states, then optimizer aux."""
+        seen = {}
+        for name, t in self.get_states().items():
+            if id(t) not in seen:
+                t.name = t.name or name
+                seen[id(t)] = t
+        opt = getattr(self, "optimizer", None)
+        if opt is not None and hasattr(opt, "state_tensors"):
+            for t in opt.state_tensors():
+                if id(t) not in seen:
+                    seen[id(t)] = t
+        return list(seen.values())
+
+    # -- the compiled step -------------------------------------------------
+    def _build_step(self, n_inputs):
+        state_list = self._state_tensors()
+        self._state_list = state_list
+        opt = getattr(self, "optimizer", None)
+        if opt is not None:
+            (opt.opt if hasattr(opt, "opt") else opt)._frozen = True
+        out_tree = {}
+        dist = self._dist
+
+        def fn(state_arrays, rng_key, *input_arrays):
+            if dist is not None:
+                rng_key = jax.random.fold_in(
+                    rng_key, jax.lax.axis_index(dist.axis_name))
+            for t, a in zip(state_list, state_arrays):
+                t.data = a
+            self.dev._set_rng_state(rng_key)
+            ins = [Tensor(data=a, device=self.dev, requires_grad=False)
+                   for a in input_arrays]
+            res = self.train_one_batch(*ins)
+            leaves = []
+            out_tree["tree"] = _flatten(res, leaves)
+            if dist is not None:
+                # outputs that are not batch-leading (loss scalars, metrics,
+                # param snapshots) are averaged across shards so the
+                # replicated out-spec is sound
+                mask = self._shard_mask
+                leaves = [x if mask[i] else jax.lax.pmean(x, dist.axis_name)
+                          for i, x in enumerate(leaves)]
+            new_state = [t.data for t in state_list]
+            return new_state, leaves
+
+        if dist is not None:
+            from .parallel.communicator import (get_mesh,
+                                                collective_context)
+            mesh = dist.communicator.mesh
+            if mesh is None:
+                # mesh over the devices of the model's platform (virtual CPU
+                # devices in tests, TPU chips in production)
+                mesh = get_mesh(
+                    devices=jax.devices(self.dev.jax_device.platform))
+            dist.communicator.mesh = mesh
+            axis = dist.axis_name
+
+            def body(state_arrays, rng_key, *input_arrays):
+                with collective_context(axis):
+                    return fn(state_arrays, rng_key, *input_arrays)
+
+            def build(sample_inputs, rng):
+                # output shapes are known from the first (eager) full-batch
+                # call: an output is batch-sharded iff its leading dim is
+                # the global batch; everything else is pmean'd + replicated
+                leaves = []
+                _flatten(self._eager_out, leaves)
+                full_batch = sample_inputs[0].shape[0]
+                self._shard_mask = [
+                    jnp.asarray(x).ndim >= 1 and
+                    jnp.asarray(x).shape[0] == full_batch for x in leaves]
+                in_specs = ([P()] * len(state_list), P(),
+                            *[P(axis) for _ in range(n_inputs)])
+                out_specs = ([P()] * len(state_list),
+                             [P(axis) if m else P()
+                              for m in self._shard_mask])
+                import inspect
+                kw = {}
+                sig = inspect.signature(shard_map).parameters
+                if "check_vma" in sig:
+                    kw["check_vma"] = False
+                elif "check_rep" in sig:
+                    kw["check_rep"] = False
+                mapped = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                                   out_specs=tuple(out_specs), **kw)
+                return jax.jit(mapped, donate_argnums=(0,))
+
+            self._jit_builder = build
+            self._jit_step = None  # built lazily on first sharded call
+            self._mesh, self._axis = mesh, axis
+        else:
+            self._jit_step = jax.jit(fn, donate_argnums=(0,))
+            self._jit_builder = None
+        self._out_tree = out_tree
+
+    def _run_step(self, *args):
+        """Train-mode step dispatch (reference
+        ModelMeta.buffer_operation wrapper, model.py:56-91)."""
+        if not self.graph_mode:
+            return self.train_one_batch(*args)
+        if not self._step_ready:
+            # first call: eager, materialises params + optimizer aux states
+            res = self.train_one_batch(*args)
+            self._step_ready = True
+            self._eager_out = res
+            return res
+        if self._jit_step is None and getattr(self, "_jit_builder", None) \
+                is None:
+            self._build_step(len(args))
+        input_arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                        for a in args]
+        rng = self.dev.rand_key()
+        host_key = self.dev._get_rng_state()  # tracing clobbers dev rng
+        if self._jit_step is None:
+            self._jit_step = self._jit_builder(input_arrays, rng)
+        state_arrays = [t.data for t in self._state_list]
+        if self._dist is not None:
+            from jax.sharding import NamedSharding
+            rep = NamedSharding(self._mesh, P())
+            shd = NamedSharding(self._mesh, P(self._axis))
+            state_arrays = [jax.device_put(a, rep) for a in state_arrays]
+            input_arrays = [jax.device_put(a, shd) for a in input_arrays]
+            rng = jax.device_put(rng, rep)
+        t0 = time.perf_counter()
+        new_state, leaves = self._jit_step(state_arrays, rng,
+                                           *input_arrays)
+        self.dev._set_rng_state(host_key)
+        if self.dev.verbosity > 0:
+            jax.block_until_ready(new_state)
+            self.dev.time_profiling["train_one_batch"] = \
+                time.perf_counter() - t0
+        for t, a in zip(self._state_list, new_state):
+            t.data = a
+        return _unflatten(self._out_tree["tree"], list(leaves), self.dev)
+
+    def __call__(self, *args, **kwargs):
+        if self._train:
+            if kwargs:
+                raise TypeError(
+                    "train-mode model calls take positional tensors only "
+                    "(the compiled step is positional); got keyword "
+                    f"arguments {sorted(kwargs)}")
+            return self._run_step(*args)
+        prev = CTX.training
+        CTX.training = False
+        try:
+            return self.forward(*args, **kwargs)
+        finally:
+            CTX.training = prev
+
+    # -- persistence (reference model.py:244-330) --------------------------
+    TENSOR_DICT_FILENAME = "/tensor_dict.npz"
+    STATES_ATTR_FILENAME = "/states_attr.json"
+
+    def save_states(self, fpath, aux_states={}):  # noqa: B006 (parity)
+        """Zip of params+states .npz and an attribute JSON, including
+        optimizer aux states (reference model.py:244-295)."""
+        states = {k: v for k, v in self.get_states().items()}
+        attr = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                for k, v in states.items()}
+        arrays = {k: np.asarray(jax.device_get(v.data))
+                  for k, v in states.items()}
+        opt = getattr(self, "optimizer", None)
+        if opt is not None and hasattr(opt, "get_states"):
+            for k, v in opt.get_states().items():
+                arrays[f"optimizer/{k}"] = np.asarray(v)
+                attr[f"optimizer/{k}"] = {
+                    "shape": list(np.shape(v)),
+                    "dtype": str(np.asarray(v).dtype),
+                    "optimizer": True}
+        for k, v in aux_states.items():
+            arrays[f"aux/{k}"] = np.asarray(
+                v.numpy() if isinstance(v, Tensor) else v)
+            attr[f"aux/{k}"] = {"shape": list(arrays[f"aux/{k}"].shape),
+                                "dtype": str(arrays[f"aux/{k}"].dtype),
+                                "aux": True}
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        buf.seek(0)
+        with zipfile.ZipFile(fpath, "w") as zf:
+            zf.writestr(self.TENSOR_DICT_FILENAME.strip("/"), buf.read())
+            zf.writestr(self.STATES_ATTR_FILENAME.strip("/"),
+                        json.dumps(attr))
+
+    def load_states(self, fpath):
+        """Restore params/states (+ optimizer aux) and return aux states
+        (reference model.py:297-330)."""
+        with zipfile.ZipFile(fpath, "r") as zf:
+            attr = json.loads(zf.read(
+                self.STATES_ATTR_FILENAME.strip("/")))
+            with zf.open(self.TENSOR_DICT_FILENAME.strip("/")) as f:
+                data = np.load(io.BytesIO(f.read()))
+                arrays = {k: data[k] for k in data.files}
+        model_states = {k: v for k, v in arrays.items()
+                        if not k.startswith(("optimizer/", "aux/"))}
+        my_states = self.get_states()
+        for k, v in model_states.items():
+            if k in my_states:
+                my_states[k].copy_from_numpy(v)
+        opt = getattr(self, "optimizer", None)
+        if opt is not None and hasattr(opt, "set_states"):
+            opt_states = {k[len("optimizer/"):]: v
+                          for k, v in arrays.items()
+                          if k.startswith("optimizer/")}
+            if opt_states:
+                opt.set_states(opt_states)
+        # invalidate any compiled step: state identity may have changed
+        self._jit_step = None
+        self._jit_builder = None
+        self._state_list = None
+        return {k[len("aux/"):]: Tensor(data=v, requires_grad=False)
+                for k, v in arrays.items() if k.startswith("aux/")}

@@ -1,0 +1,21 @@
+"""Structured op set: shape-static Handle configs + autograd ops.
+
+TPU-native equivalent of the reference's ``src/model/operation/`` kernels
+(convolution.cc, batchnorm.cc, pooling.cc, rnn.cc): each ``*Handle``
+precomputes static shape/config once per layer instance, and the op lowers to
+a ``jax.lax`` primitive that XLA tiles onto the MXU.
+"""
+
+from .conv import ConvHandle, _Conv2d, conv2d
+from .batchnorm import BatchNormHandle, _BatchNorm2d, batchnorm_2d
+from .pooling import (PoolingHandle, _Pooling2d, pooling_2d,
+                      GlobalAveragePool, globalaveragepool)
+from .rnn import CudnnRNNHandle, _RNN, rnn_op
+
+__all__ = [
+    "ConvHandle", "_Conv2d", "conv2d",
+    "BatchNormHandle", "_BatchNorm2d", "batchnorm_2d",
+    "PoolingHandle", "_Pooling2d", "pooling_2d",
+    "GlobalAveragePool", "globalaveragepool",
+    "CudnnRNNHandle", "_RNN", "rnn_op",
+]

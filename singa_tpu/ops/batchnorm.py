@@ -1,0 +1,100 @@
+"""Batch normalization with functional running-stat state.
+
+Capability parity with the reference BN operation
+(src/model/operation/batchnorm.h:49-115): training mode normalises by batch
+statistics and updates the running mean/var "in place" (the reference mutates
+the running blocks on device; here the update rebinds the state Tensors'
+values, which the Model layer threads through jit as donated state), and
+inference mode normalises by the running statistics.
+
+Backward (dx, dscale, dbias) is the vjp of the batch-stat normalisation —
+the same math as cudnnBatchNormalizationBackward, emitted by XLA as a fused
+reduction + elementwise kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd_base import Operator, is_training
+from ..tensor import Tensor
+
+
+class BatchNormHandle:
+    """Static BN config (reference BatchNormHandle batchnorm.h:49-73).
+
+    Supports 2D (N, C) and 4D (N, C, H, W) inputs like the reference.
+    """
+
+    def __init__(self, momentum, x, eps: float = 1e-5):
+        self.factor = float(momentum)
+        xs = x.shape if hasattr(x, "shape") else tuple(x)
+        self.channels = int(xs[1])
+        self.is_2d = len(xs) == 2
+        self.eps = eps
+        self.batchsize = int(xs[0])
+
+    def _axes(self, ndim):
+        return (0,) if ndim == 2 else (0, 2, 3)
+
+    def _bshape(self, ndim):
+        return (1, self.channels) if ndim == 2 else (1, self.channels, 1, 1)
+
+
+class _BatchNorm2d(Operator):
+    """Training-mode BN over batch stats; grads for (x, scale, bias)."""
+
+    def __init__(self, handle: BatchNormHandle):
+        super().__init__()
+        self.handle = handle
+
+    def forward(self, x, scale, bias):
+        h = self.handle
+        axes = h._axes(x.ndim)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        bshape = h._bshape(x.ndim)
+        inv = jax.lax.rsqrt(var + h.eps).reshape(bshape)
+        return (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) \
+            + bias.reshape(bshape)
+
+
+class _BatchNorm2dInference(Operator):
+    """Inference-mode BN with frozen running stats
+    (reference GpuBatchNormForwardInference batchnorm.h:103-115)."""
+
+    def __init__(self, handle: BatchNormHandle):
+        super().__init__()
+        self.handle = handle
+
+    def forward(self, x, scale, bias, rmean, rvar):
+        h = self.handle
+        bshape = h._bshape(x.ndim)
+        rmean = jax.lax.stop_gradient(rmean)
+        rvar = jax.lax.stop_gradient(rvar)
+        inv = jax.lax.rsqrt(rvar + h.eps).reshape(bshape)
+        return (x - rmean.reshape(bshape)) * inv * scale.reshape(bshape) \
+            + bias.reshape(bshape)
+
+
+def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,
+                 running_mean: Tensor, running_var: Tensor):
+    """Functional wrapper (parity: reference autograd.batchnorm_2d:1740).
+
+    In training mode the running statistics are updated in place (rebinding
+    the state Tensors), exactly mirroring the reference's in-place block
+    mutation semantics.
+    """
+    if is_training():
+        h = handle
+        axes = h._axes(x.ndim)
+        xb = x.data if isinstance(x, Tensor) else x
+        batch_mean = jnp.mean(xb, axis=axes)
+        batch_var = jnp.var(xb, axis=axes)
+        m = h.factor
+        running_mean.data = m * running_mean.data + (1 - m) * batch_mean
+        running_var.data = m * running_var.data + (1 - m) * batch_var
+        return _BatchNorm2d(handle)(x, scale, bias)
+    return _BatchNorm2dInference(handle)(x, scale, bias,
+                                         running_mean, running_var)

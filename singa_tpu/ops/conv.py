@@ -1,0 +1,104 @@
+"""2-D convolution on the MXU.
+
+Capability parity with the reference convolution operation
+(src/model/operation/convolution.h:43-141): a :class:`ConvHandle` fixes the
+static geometry once per layer instance (the role of ``CudnnConvHandle``'s
+descriptor/algorithm setup), and the op lowers to
+``lax.conv_general_dilated``, which XLA tiles directly onto the TPU systolic
+array — there is no im2col path and no algorithm search; backward comes from
+the vjp of the same primitive (cudnnConvolutionBackwardData/Filter
+equivalents are emitted by XLA).
+
+Layout: NCHW / OIHW at the API for reference parity; XLA relayouts
+internally for the MXU, so this costs nothing at runtime.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd_base import Operator
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+class ConvHandle:
+    """Static conv config (reference ConvHandle convolution.h:43-90).
+
+    ``padding`` may be an int, an (ph, pw) pair, or an explicit
+    ((ph0, ph1), (pw0, pw1)) for odd/asymmetric padding (the reference's
+    odd-padding helper, python/singa/utils.py).
+    """
+
+    def __init__(self, x, kernel_size, stride, padding, in_channels,
+                 out_channels, bias=True, group=1, pad_mode=None):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        if (isinstance(padding, (tuple, list)) and len(padding) == 2
+                and isinstance(padding[0], (tuple, list))):
+            self.padding = tuple(tuple(int(v) for v in p) for p in padding)
+        else:
+            ph, pw = _pair(padding)
+            self.padding = ((ph, ph), (pw, pw))
+        self.in_channels = int(in_channels)
+        self.out_channels = int(out_channels)
+        self.bias = bool(bias)
+        self.group = int(group)
+        self.pad_mode = pad_mode  # "SAME"/"VALID" override, else explicit
+        xs = x.shape if hasattr(x, "shape") else tuple(x)
+        self.batchsize = int(xs[0]) if len(xs) > 0 else 0
+        if len(xs) == 4:
+            self.height, self.width = int(xs[2]), int(xs[3])
+        self.dimension_numbers = ("NCHW", "OIHW", "NCHW")
+
+    def output_shape(self, x_shape):
+        n, _, h, w = x_shape
+        (p0, p1), (q0, q1) = self.padding
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        oh = (h + p0 + p1 - kh) // sh + 1
+        ow = (w + q0 + q1 - kw) // sw + 1
+        return (n, self.out_channels, oh, ow)
+
+
+class _Conv2d(Operator):
+    """Forward via one MXU conv; backward via vjp (reference
+    GpuConvForward/Backwardx/W/b convolution.h:131-141)."""
+
+    def __init__(self, handle: ConvHandle, odd_padding=None):
+        super().__init__()
+        self.handle = handle
+        self.odd_padding = odd_padding  # extra (t,b,l,r) pad, reference util
+
+    def forward(self, x, W, b=None):
+        h = self.handle
+        padding = h.pad_mode if h.pad_mode else h.padding
+        if self.odd_padding is not None:
+            t, bo, l, r = self.odd_padding
+            (p0, p1), (q0, q1) = h.padding
+            padding = ((p0 + t, p1 + bo), (q0 + l, q1 + r))
+        y = lax.conv_general_dilated(
+            x, W,
+            window_strides=h.stride,
+            padding=padding,
+            dimension_numbers=h.dimension_numbers,
+            feature_group_count=h.group,
+            preferred_element_type=jnp.float32
+            if x.dtype == jnp.bfloat16 else None,
+        )
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        return y.astype(x.dtype)
+
+
+def conv2d(handle: ConvHandle, x, W, b=None, odd_padding=None):
+    """Functional wrapper (parity: reference autograd.conv2d:1721)."""
+    if b is None:
+        return _Conv2d(handle, odd_padding)(x, W)
+    return _Conv2d(handle, odd_padding)(x, W, b)

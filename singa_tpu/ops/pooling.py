@@ -1,0 +1,88 @@
+"""Pooling via windowed reductions.
+
+Capability parity with the reference pooling operation
+(src/model/operation/pooling.h:40-96): a static :class:`PoolingHandle`
+(the role of ``CudnnPoolingHandle``'s descriptors) and forward/backward via
+``lax.reduce_window`` — XLA emits the max-pool argmax routing and avg-pool
+scatter in the vjp, replacing cudnnPoolingBackward.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd_base import Operator
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+class PoolingHandle:
+    """Static pooling config (reference PoolingHandle pooling.h:40-72)."""
+
+    def __init__(self, x, kernel_size, stride=None, padding=0, is_max=True):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride if stride is not None else kernel_size)
+        self.padding = _pair(padding)
+        self.is_max_pooling = bool(is_max)
+        xs = x.shape if hasattr(x, "shape") else tuple(x)
+        self.batchsize = int(xs[0])
+        self.channels = int(xs[1])
+        if len(xs) == 4:
+            self.height, self.width = int(xs[2]), int(xs[3])
+            kh, kw = self.kernel_size
+            sh, sw = self.stride
+            ph, pw = self.padding
+            self.pooled_height = (self.height + 2 * ph - kh) // sh + 1
+            self.pooled_width = (self.width + 2 * pw - kw) // sw + 1
+
+
+class _Pooling2d(Operator):
+    def __init__(self, handle: PoolingHandle):
+        super().__init__()
+        self.handle = handle
+
+    def forward(self, x):
+        h = self.handle
+        kh, kw = h.kernel_size
+        sh, sw = h.stride
+        ph, pw = h.padding
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if h.is_max_pooling:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+        # average pool: divide by true window size (count_include_pad=True
+        # matches the reference cuDNN mode
+        # CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        return s / float(kh * kw)
+
+
+class GlobalAveragePool(Operator):
+    """(N,C,H,W) -> (N,C,1,1) mean (reference autograd.GlobalAveragePool)."""
+
+    def __init__(self, data_format="channels_first"):
+        super().__init__()
+        self.data_format = data_format
+
+    def forward(self, x):
+        if self.data_format == "channels_first":
+            return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+        return jnp.mean(x, axis=tuple(range(1, x.ndim - 1)), keepdims=True)
+
+
+def pooling_2d(handle: PoolingHandle, x):
+    """Functional wrapper (parity: reference autograd.pooling_2d:1847)."""
+    return _Pooling2d(handle)(x)
+
+
+def globalaveragepool(x, data_format="channels_first"):
+    return GlobalAveragePool(data_format)(x)

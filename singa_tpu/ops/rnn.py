@@ -1,0 +1,171 @@
+"""Multi-layer (bi)directional RNN/LSTM/GRU as a `lax.scan` over time.
+
+Capability parity with the reference's cuDNN-only RNN operation
+(src/model/operation/rnn.h:38-131): one flat parameter vector per RNN (the
+cuDNN packed-weights convention, rnn.h:89-92) unpacked by static offsets, and
+variable-length sequence masking equivalent to the packed "Ex" entry points
+(GpuRNNForwardTrainingEx, rnn.h:117-131) via per-step `where` masking.
+
+TPU-first notes: the time loop is a single `lax.scan`, so XLA compiles one
+fused step reused across timesteps; each step's gate matmul is one MXU GEMM
+of shape (batch, in+hidden) @ (in+hidden, gates*hidden). Backward is the vjp
+of the scan (reverse scan), replacing cudnnRNNBackwardData/Weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd_base import Operator, is_training
+
+_GATES = {"lstm": 4, "gru": 3, "tanh": 1, "relu": 1, "vanilla": 1}
+
+
+class CudnnRNNHandle:
+    """Static RNN config + flat-weight layout map (reference CudnnRNNHandle
+    rnn.h:38-93). The name keeps API parity; nothing cuDNN remains.
+
+    Flat layout per layer, per direction:
+      W_ih (G*H, in) | W_hh (G*H, H) | b_ih (G*H) | b_hh (G*H)
+    with gate order i,f,g,o (lstm) / r,z,n (gru).
+    """
+
+    def __init__(self, x, hidden_size, mode="lstm", num_layers=1,
+                 bias=True, dropout=0.0, bidirectional=False):
+        xs = x.shape if hasattr(x, "shape") else tuple(x)
+        self.feature_size = int(xs[-1])
+        self.hidden_size = int(hidden_size)
+        self.mode = mode if isinstance(mode, str) else \
+            {0: "relu", 1: "tanh", 2: "lstm", 3: "gru"}[mode]
+        self.num_layers = int(num_layers)
+        self.bias = bool(bias)
+        self.dropout = float(dropout)
+        self.bidirectional = bool(bidirectional)
+        self.num_directions = 2 if self.bidirectional else 1
+        self.gates = _GATES[self.mode]
+        self.batch_first = False
+
+        # offset map: [(layer, dir)] -> (Wih, Whh, bih, bhh) slices
+        self.offsets = []
+        off = 0
+        G, H = self.gates, self.hidden_size
+        for layer in range(self.num_layers):
+            in_size = self.feature_size if layer == 0 \
+                else H * self.num_directions
+            per_dir = []
+            for _d in range(self.num_directions):
+                shapes = [(G * H, in_size), (G * H, H), (G * H,), (G * H,)]
+                slices = []
+                for s in shapes:
+                    n = int(np.prod(s))
+                    slices.append((off, off + n, s))
+                    off += n
+                per_dir.append(slices)
+            self.offsets.append(per_dir)
+        self.weights_size = off
+
+    def unpack(self, W):
+        """Flat W -> nested [(layer)][(dir)] param tuples."""
+        out = []
+        for per_dir in self.offsets:
+            dirs = []
+            for slices in per_dir:
+                dirs.append(tuple(W[a:b].reshape(s) for a, b, s in slices))
+            out.append(dirs)
+        return out
+
+
+def _step(mode, params, carry, x_t):
+    Wih, Whh, bih, bhh = params
+    h, c = carry
+    if mode == "gru":
+        gi = x_t @ Wih.T + bih
+        gh = h @ Whh.T + bhh
+        H = h.shape[-1]
+        r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
+        z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+        n = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+        h_new = (1 - z) * n + z * h
+        return (h_new, c), h_new
+    g = x_t @ Wih.T + h @ Whh.T + bih + bhh
+    if mode == "lstm":
+        H = h.shape[-1]
+        i = jax.nn.sigmoid(g[:, :H])
+        f = jax.nn.sigmoid(g[:, H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:])
+        c_new = f * c + i * gg
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+    h_new = jnp.tanh(g) if mode == "tanh" or mode == "vanilla" \
+        else jnp.maximum(g, 0)
+    return (h_new, c), h_new
+
+
+def _run_direction(mode, params, x, h0, c0, lengths, reverse):
+    """Scan one direction over (T, B, F) -> (T, B, H), h_T, c_T."""
+    T = x.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x = jnp.flip(x, axis=0)
+        ts = jnp.flip(ts, axis=0)
+
+    def body(carry, inp):
+        x_t, t = inp
+        (h_new, c_new), out = _step(mode, params, carry, x_t)
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            h_new = jnp.where(valid, h_new, carry[0])
+            c_new = jnp.where(valid, c_new, carry[1])
+            out = jnp.where(valid, out, jnp.zeros_like(out))
+        return (h_new, c_new), out
+
+    (hT, cT), ys = lax.scan(body, (h0, c0), (x, ts))
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+class _RNN(Operator):
+    """The RNN op (reference autograd._RNN:4818-4931). Inputs:
+    (x, hx, cx, W[, seq_lengths]); outputs (y, hy, cy)."""
+
+    def __init__(self, handle: CudnnRNNHandle, use_mask=False):
+        super().__init__()
+        self.handle = handle
+        self.use_mask = use_mask
+
+    def forward(self, x, hx, cx, W, seq_lengths=None):
+        h = self.handle
+        lengths = seq_lengths
+        D, L, H = h.num_directions, h.num_layers, h.hidden_size
+        params = h.unpack(W)
+        inp = x
+        h_out, c_out = [], []
+        for layer in range(L):
+            ys = []
+            for d in range(D):
+                idx = layer * D + d
+                y, hT, cT = _run_direction(
+                    h.mode, params[layer][d], inp,
+                    hx[idx], cx[idx], lengths, reverse=(d == 1))
+                ys.append(y)
+                h_out.append(hT)
+                c_out.append(cT)
+            inp = jnp.concatenate(ys, axis=-1) if D == 2 else ys[0]
+            if h.dropout > 0 and layer < L - 1 and is_training():
+                key = self.dev.rand_key()
+                keep = 1.0 - h.dropout
+                mask = jax.random.bernoulli(key, keep, inp.shape)
+                inp = jnp.where(mask, inp / keep, 0.0)
+        return inp, jnp.stack(h_out), jnp.stack(c_out)
+
+
+def rnn_op(handle, x, hx, cx, W, seq_lengths=None):
+    """Functional wrapper (parity: reference autograd.py rnn driver)."""
+    if seq_lengths is None:
+        return _RNN(handle)(x, hx, cx, W)
+    return _RNN(handle, use_mask=True)(x, hx, cx, W, seq_lengths)

@@ -1,0 +1,377 @@
+"""Optimizers and the distributed optimizer driver.
+
+Capability parity with reference python/singa/opt.py:
+- tensor-resident scheduled hyperparameters (DecayScheduler, opt.py:28-68)
+  so the learning rate is a traced value — schedules advance inside the
+  compiled step with no recompilation;
+- SGD/RMSProp/AdaGrad/Adam with the same update math (opt.py:174-660);
+- DistOpt (opt.py:686-1094) whose all-reduce is `jax.lax.psum` over the mesh
+  'data' axis instead of NCCL: the reference's fused-buffer trick
+  (Communicator::fusedSynch) is unnecessary because XLA fuses and overlaps
+  collectives; fp16 comm becomes bf16-cast-before-psum; topK/threshold
+  sparsification is reproduced with mask + error-feedback residuals.
+
+Because ``autograd.backward`` yields (param, grad) lazily, each all-reduce is
+issued as soon as that gradient is complete — inside one jit trace XLA then
+overlaps collectives with remaining backward compute, which is the TPU form
+of the reference's stream-overlap design (opt.py:826-865).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .tensor import Tensor
+
+
+class DecayScheduler:
+    """lr(step) as a traced function (reference opt.py:28-45)."""
+
+    def __init__(self, init_value):
+        self.init_value = init_value
+
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def get_states(self):
+        return {"init_value": self.init_value}
+
+    def set_states(self, states):
+        if "init_value" in states:
+            self.init_value = float(states["init_value"])
+
+
+class Constant(DecayScheduler):
+    def __call__(self, step):
+        return jnp.asarray(self.init_value, dtype=jnp.float32)
+
+
+class ExponentialDecay(DecayScheduler):
+    def __init__(self, init_value, decay_steps, decay_rate, staircase=False):
+        super().__init__(init_value)
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def __call__(self, step):
+        s = step.data if isinstance(step, Tensor) else step
+        s = s.astype(jnp.float32)
+        e = s / self.decay_steps
+        if self.staircase:
+            e = jnp.floor(e)
+        return self.init_value * jnp.power(self.decay_rate, e)
+
+
+class Optimizer:
+    """Base optimizer (reference opt.py:71-173). Aux states are Tensors so
+    the whole update is jit-traceable and thread-able as donated state."""
+
+    def __init__(self, lr):
+        self.lr = lr if isinstance(lr, DecayScheduler) else Constant(lr)
+        self.step_counter = Tensor(shape=(), dtype=jnp.float32,
+                                   requires_grad=False)
+        self.step_counter.name = "step_counter"
+        self._aux = {}  # name -> Tensor, created lazily per param
+
+    # -- lr as a traced value --------------------------------------------
+    @property
+    def lr_value(self):
+        return self.lr(self.step_counter)
+
+    def should_apply_weight_decay(self, name):
+        return True
+
+    # -- train driving -----------------------------------------------------
+    def __call__(self, loss):
+        self.backward_and_update(loss)
+
+    def backward_and_update(self, loss):
+        for p, g in autograd.backward(loss):
+            self.apply(p.name or f"param/{id(p)}", p, g)
+        self.step()
+
+    def step(self):
+        self.step_counter.data = self.step_counter.data + 1.0
+
+    def apply(self, param_name, param_value, param_grad):
+        raise NotImplementedError
+
+    # -- state -------------------------------------------------------------
+    def _get_aux(self, key, like):
+        t = self._aux.get(key)
+        if t is None:
+            if getattr(self, "_frozen", False):
+                raise RuntimeError(
+                    f"optimizer aux state '{key}' created inside a compiled "
+                    "step; it would silently reset every iteration. All aux "
+                    "state must be materialised by the first (eager) step.")
+            t = Tensor(shape=like.shape, device=like.device,
+                       dtype=like.dtype, requires_grad=False)
+            self._aux[key] = t
+        return t
+
+    def state_tensors(self):
+        """All mutable optimizer state, for jit state-threading."""
+        return [self.step_counter] + list(self._aux.values())
+
+    def get_states(self):
+        states = {"step_counter": np.asarray(self.step_counter.data)}
+        for k, v in self._aux.items():
+            states[k] = np.asarray(jax.device_get(v.data))
+        return states
+
+    def set_states(self, states):
+        if "step_counter" in states:
+            self.step_counter.data = jnp.asarray(states["step_counter"])
+        for k, v in states.items():
+            if k == "step_counter":
+                continue
+            if k in self._aux:
+                self._aux[k].data = jnp.asarray(v)
+            else:
+                self._aux[k] = Tensor(data=np.asarray(v),
+                                      requires_grad=False)
+
+
+class SGD(Optimizer):
+    """SGD with momentum / nesterov / weight decay (reference opt.py:174-334,
+    update composed of the same axpy algebra, now one fused XLA kernel)."""
+
+    def __init__(self, lr=0.1, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False):
+        super().__init__(lr)
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires momentum>0 and dampening=0")
+
+    def apply(self, name, p: Tensor, g: Tensor):
+        grad = g.data if isinstance(g, Tensor) else g
+        grad = grad.astype(p.dtype)
+        if self.weight_decay != 0 and self.should_apply_weight_decay(name):
+            grad = grad + self.weight_decay * p.data
+        if self.momentum != 0:
+            buf = self._get_aux(f"{name}:momentum", p)
+            buf.data = self.momentum * buf.data + (1 - self.dampening) * grad
+            grad = grad + self.momentum * buf.data if self.nesterov \
+                else buf.data
+        p.data = p.data - self.lr_value * grad
+
+
+class RMSProp(Optimizer):
+    """(reference opt.py:336-442)"""
+
+    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.rho = rho
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+
+    def apply(self, name, p: Tensor, g: Tensor):
+        grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
+        if self.weight_decay != 0:
+            grad = grad + self.weight_decay * p.data
+        rms = self._get_aux(f"{name}:rms", p)
+        rms.data = self.rho * rms.data + (1 - self.rho) * grad * grad
+        p.data = p.data - self.lr_value * grad / jnp.sqrt(rms.data +
+                                                          self.epsilon)
+
+
+class AdaGrad(Optimizer):
+    """(reference opt.py:444-534)"""
+
+    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+
+    def apply(self, name, p: Tensor, g: Tensor):
+        grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
+        if self.weight_decay != 0:
+            grad = grad + self.weight_decay * p.data
+        hist = self._get_aux(f"{name}:history", p)
+        hist.data = hist.data + grad * grad
+        p.data = p.data - self.lr_value * grad / jnp.sqrt(hist.data +
+                                                          self.epsilon)
+
+
+class Adam(Optimizer):
+    """(reference opt.py:536-660)"""
+
+    def __init__(self, lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+                 weight_decay=0.0, amsgrad=False):
+        super().__init__(lr)
+        self.beta_1 = beta_1
+        self.beta_2 = beta_2
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+        self.amsgrad = amsgrad
+
+    def apply(self, name, p: Tensor, g: Tensor):
+        grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
+        if self.weight_decay != 0:
+            grad = grad + self.weight_decay * p.data
+        m = self._get_aux(f"{name}:m", p)
+        v = self._get_aux(f"{name}:v", p)
+        m.data = self.beta_1 * m.data + (1 - self.beta_1) * grad
+        v.data = self.beta_2 * v.data + (1 - self.beta_2) * grad * grad
+        t = self.step_counter.data + 1.0
+        mhat = m.data / (1 - jnp.power(self.beta_1, t))
+        if self.amsgrad:
+            vmax = self._get_aux(f"{name}:vmax", p)
+            vmax.data = jnp.maximum(vmax.data, v.data)
+            vhat = vmax.data / (1 - jnp.power(self.beta_2, t))
+        else:
+            vhat = v.data / (1 - jnp.power(self.beta_2, t))
+        p.data = p.data - self.lr_value * mhat / (jnp.sqrt(vhat) +
+                                                  self.epsilon)
+
+
+class DistOpt:
+    """Distributed optimizer: data-parallel all-reduce over the mesh 'data'
+    axis (reference DistOpt opt.py:686-1094 + Communicator
+    src/io/communicator.cc, re-expressed as XLA collectives over ICI).
+
+    Inside the compiled (shard_map'd) step, ``all_reduce`` is a
+    ``lax.psum``; outside any mesh context it is the identity (world of 1),
+    which keeps single-chip scripts unchanged.
+    """
+
+    def __init__(self, opt=None, nccl_id=None, local_rank=None,
+                 world_size=None, buffSize=None, axis_name="data"):
+        from .parallel.communicator import Communicator
+        self.opt = opt if opt is not None else SGD()
+        self.communicator = Communicator(axis_name=axis_name,
+                                         world_size=world_size)
+        self.world_size = self.communicator.world_size
+        self.local_rank = local_rank if local_rank is not None \
+            else self.communicator.local_rank
+        self.global_rank = self.communicator.global_rank
+        self.axis_name = axis_name
+        # sparsification error-feedback residuals (reference sparse modes)
+        self._residuals = {}
+
+    # -- mirror underlying optimizer surface ------------------------------
+    @property
+    def step_counter(self):
+        return self.opt.step_counter
+
+    def state_tensors(self):
+        return self.opt.state_tensors() + list(self._residuals.values())
+
+    def get_states(self):
+        states = self.opt.get_states()
+        for k, v in self._residuals.items():
+            states[f"residual/{k}"] = np.asarray(jax.device_get(v.data))
+        return states
+
+    def set_states(self, states):
+        self.opt.set_states({k: v for k, v in states.items()
+                             if not k.startswith("residual/")})
+        for k, v in states.items():
+            if k.startswith("residual/"):
+                name = k[len("residual/"):]
+                if name in self._residuals:
+                    self._residuals[name].data = jnp.asarray(v)
+                else:
+                    self._residuals[name] = Tensor(data=np.asarray(v),
+                                                   requires_grad=False)
+
+    def step(self):
+        self.opt.step()
+
+    def __call__(self, loss):
+        self.backward_and_update(loss)
+
+    # -- collectives -------------------------------------------------------
+    def all_reduce(self, arr):
+        return self.communicator.all_reduce(arr)
+
+    def update(self, p: Tensor, g: Tensor):
+        """Average an already-summed gradient and apply
+        (reference opt.py:738-746: grad /= world_size)."""
+        g.data = g.data / self.communicator.effective_world_size()
+        self.opt.apply(p.name or f"param/{id(p)}", p, g)
+
+    # -- training drivers ---------------------------------------------------
+    def backward_and_update(self, loss, threshold=2097152):
+        """All-reduce each gradient as soon as backward produces it
+        (reference opt.py:826-865). ``threshold`` is accepted for parity;
+        XLA handles small-tensor fusion so no manual fused buffer exists."""
+        for p, g in autograd.backward(loss):
+            g.data = self.all_reduce(g.data)
+            self.update(p, g)
+        self.opt.step()
+
+    def backward_and_update_half(self, loss, threshold=2097152,
+                                 clipping=False, clip_value=2.5):
+        """Reduced-precision communication: cast to bf16 before the
+        all-reduce (reference fp16 comm, opt.py:867-920 — bf16 is the TPU
+        native half type)."""
+        for p, g in autograd.backward(loss):
+            grad = g.data
+            if clipping:
+                grad = jnp.clip(grad, -clip_value, clip_value)
+            half = grad.astype(jnp.bfloat16)
+            g.data = self.all_reduce(half).astype(jnp.float32)
+            self.update(p, g)
+        self.opt.step()
+
+    def backward_and_partial_update(self, loss, threshold=2097152):
+        """Partial synchronisation: each step, only a rotating
+        1/world_size partition of the parameters takes the globally
+        averaged gradient; the rest update locally
+        (reference opt.py:922-992).
+
+        The rotation is keyed on the optimizer's traced step counter, so it
+        keeps rotating under graph (jit) mode where Python-side counters
+        would freeze at their trace-time value. Inside a compiled step the
+        collective still runs for every gradient (XLA cannot skip a
+        collective on a traced predicate); the reference's comm saving is
+        traded for jit compatibility.
+        """
+        n = max(1, self.communicator.effective_world_size())
+        step = self.opt.step_counter.data
+        for i, (p, g) in enumerate(autograd.backward(loss)):
+            summed = self.all_reduce(g.data)
+            sel = jnp.equal(jnp.mod(step + i, n), 0)
+            g.data = jnp.where(sel, summed / n, g.data)
+            self.opt.apply(p.name or f"param/{id(p)}", p, g)
+        self.opt.step()
+
+    def backward_and_sparse_update(self, loss, spars=0.05, topK=False,
+                                   corr=True):
+        """Gradient sparsification with error feedback (reference
+        opt.py:994+ / Communicator::sparsification). On TPU the transport
+        stays dense (masked values + psum ride the ICI all-reduce) while the
+        semantics — threshold or top-K selection, residual accumulation —
+        match the reference."""
+        for p, g in autograd.backward(loss):
+            name = p.name or f"param/{id(p)}"
+            grad = g.data
+            if corr:
+                res = self._residuals.get(name)
+                if res is None:
+                    res = Tensor(shape=p.shape, device=p.device,
+                                 requires_grad=False)
+                    self._residuals[name] = res
+                grad = grad + res.data
+            absg = jnp.abs(grad)
+            if topK:
+                k = max(1, int(spars * grad.size))
+                thresh = jax.lax.top_k(absg.ravel(), k)[0][-1]
+                mask = absg >= thresh
+            else:
+                mask = absg >= spars
+            sparse = jnp.where(mask, grad, 0.0)
+            if corr:
+                self._residuals[name].data = grad - sparse
+            g.data = self.all_reduce(sparse)
+            self.update(p, g)
+        self.opt.step()

@@ -1,0 +1,15 @@
+"""Distributed execution: device meshes + XLA collectives over ICI/DCN.
+
+TPU-native replacement for the reference's NCCL/MPI communicator stack
+(src/io/communicator.cc, include/singa/io/communicator.h): process bootstrap
+via ``jax.distributed`` (replacing MPI rank exchange / NcclIdHolder), and
+data movement via mesh collectives (psum/all_gather/ppermute/reduce_scatter)
+that XLA schedules over ICI.
+"""
+
+from .communicator import (Communicator, NcclIdHolder, get_mesh,
+                           collective_context, active_axis)
+from .mesh import make_mesh, MeshConfig
+
+__all__ = ["Communicator", "NcclIdHolder", "get_mesh", "collective_context",
+           "active_axis", "make_mesh", "MeshConfig"]

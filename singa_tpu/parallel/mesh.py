@@ -1,0 +1,53 @@
+"""Device-mesh construction for dp/tp/pp/sp parallelism axes.
+
+The reference supports data parallelism only (SURVEY §2.4); the mesh here is
+the superset TPU-native form: named axes over which shardings and
+collectives are expressed (scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+@dataclass
+class MeshConfig:
+    """Logical parallelism degrees; -1 on ``data`` means "everything left"."""
+
+    data: int = -1      # dp replicas
+    model: int = 1      # tp shards
+    pipe: int = 1       # pp stages
+    seq: int = 1        # sp shards (long-context)
+
+    axis_order: tuple = ("data", "seq", "pipe", "model")
+
+    def degrees(self, n_devices: int):
+        fixed = {"model": self.model, "pipe": self.pipe, "seq": self.seq}
+        rest = n_devices
+        for v in fixed.values():
+            assert rest % v == 0, \
+                f"{n_devices} devices not divisible by {fixed}"
+            rest //= v
+        data = self.data if self.data != -1 else rest
+        assert data * self.model * self.pipe * self.seq == n_devices, \
+            f"mesh {self} does not cover {n_devices} devices"
+        return {"data": data, "seq": self.seq, "pipe": self.pipe,
+                "model": self.model}
+
+
+def make_mesh(devices=None, config: MeshConfig | None = None) -> Mesh:
+    """Build a named mesh. Axes with degree 1 are kept (size-1 axes are free
+    and let sharding rules stay uniform across configurations)."""
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig()
+    deg = config.degrees(len(devices))
+    shape = tuple(deg[a] for a in config.axis_order)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, config.axis_order)

@@ -1,0 +1,574 @@
+"""Tensor: an nd-array with device placement and autograd hooks, on jax.Array.
+
+Capability parity with the reference Tensor (include/singa/core/tensor.h:55-312
+and python/singa/tensor.py), redesigned TPU-first:
+
+- the payload is a ``jax.Array`` (or an XLA tracer while a model step is being
+  traced), so every op lowers to XLA and fuses — there is no Block, no
+  DeviceMemPool, no TYPE_LANG_SWITCH backend dispatch
+  (src/core/tensor/tensor.cc:760-812); XLA *is* the single backend;
+- "in-place" mutation (``copy_from_numpy``, optimizer axpy into params,
+  BN running stats) rebinds ``self.data`` — under ``jax.jit`` tracing this is
+  pure value threading, which the Model layer turns into donated buffers;
+- autograd fields (``creator``/``requires_grad``/``stores_grad``) match
+  python/singa/tensor.py:91-125 so the define-by-run tape in
+  ``singa_tpu.autograd`` works identically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import device as device_mod
+
+__all__ = [
+    "Tensor", "float16", "bfloat16", "float32", "float64", "int32", "int64",
+    "int8", "uint8", "from_numpy", "to_numpy", "to_host", "from_raw_tensor",
+    "zeros_like", "ones_like", "zeros", "ones", "random", "product", "sizeof",
+    "reshape", "transpose", "contiguous", "copy_data_to_from",
+    "abs", "exp", "ceil", "log", "sigmoid", "sign", "sqrt", "square", "tanh",
+    "relu", "sum", "pow", "average", "softmax", "lt", "le", "gt", "ge", "eq",
+    "add", "sub", "eltwise_mult", "mult", "div", "axpy", "einsum", "repeat",
+    "tensordot", "bernoulli", "gaussian", "uniform", "add_column", "add_row",
+    "sum_columns", "sum_rows", "copy_from_numpy", "concatenate",
+]
+
+# dtype aliases (reference core.proto DataType, src/proto/core.proto:26)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int32 = jnp.int32
+int64 = jnp.int64
+int8 = jnp.int8
+uint8 = jnp.uint8
+
+
+def _raw(x):
+    """Unwrap Tensor → jax array; pass arrays/scalars through."""
+    return x.data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    """nd-array with device placement, dtype, and autograd metadata."""
+
+    def __init__(self, shape=(), device=None, dtype=None, data=None,
+                 requires_grad=True, stores_grad=False, creator=None,
+                 name=None):
+        if device is None:
+            device = device_mod.get_default_device()
+        self.device = device
+        if data is not None:
+            # honor the data's own dtype unless one is given explicitly
+            if isinstance(data, Tensor):
+                data = data.data
+            elif isinstance(data, np.ndarray):
+                data = device.put(data.astype(np.dtype(dtype))
+                                  if dtype is not None else data)
+            else:
+                data = jnp.asarray(data)
+                if dtype is not None:
+                    data = data.astype(dtype)
+            self.data = data
+        else:
+            self.data = jnp.zeros(tuple(shape),
+                                  dtype=dtype if dtype is not None
+                                  else float32,
+                                  device=device.jax_device)
+        self.requires_grad = requires_grad
+        self.stores_grad = stores_grad
+        self.creator = creator
+        self.name = name
+        self.grad = None  # populated by autograd.backward when retained
+
+    # ---- metadata -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def memsize(self):
+        return self.size() * self.data.dtype.itemsize
+
+    def is_empty(self):
+        return self.size() == 0
+
+    def is_transpose(self):
+        # XLA arrays are always materialised contiguously; stride-view
+        # transposes (reference tensor.h:107-127) do not exist here.
+        return False
+
+    def ndim_(self):
+        return self.ndim
+
+    # ---- placement / conversion ----------------------------------------
+    def to_device(self, device):
+        self.device = device
+        if not _is_tracer(self.data):
+            self.data = device.put(self.data)
+        return self
+
+    def to_host(self):
+        return self.to_device(device_mod.get_default_device())
+
+    def as_type(self, dtype):
+        t = self.clone()
+        t.data = t.data.astype(dtype)
+        return t
+
+    def astype(self, dtype):
+        return self.as_type(dtype)
+
+    def numpy(self):
+        return np.asarray(jax.device_get(self.data))
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def item(self):
+        return self.numpy().item()
+
+    # ---- mutation (value rebinding) -------------------------------------
+    def copy_from_numpy(self, np_array, offset=0):
+        assert offset == 0, "offset copy not supported"
+        arr = np.ascontiguousarray(np_array).reshape(self.shape)
+        arr = arr.astype(np.dtype(self.dtype))
+        if _is_tracer(self.data):
+            self.data = jnp.asarray(arr)
+        else:
+            self.data = self.device.put(arr)
+        return self
+
+    def copy_data(self, other: "Tensor"):
+        self.data = jnp.asarray(_raw(other), dtype=self.dtype).reshape(self.shape)
+        return self
+
+    def copy_from(self, other):
+        if isinstance(other, np.ndarray):
+            return self.copy_from_numpy(other)
+        return self.copy_data(other)
+
+    def reset_like(self, other: "Tensor"):
+        self.data = jnp.zeros(other.shape, dtype=other.dtype,
+                              device=self.device.jax_device)
+        return self
+
+    def set_value(self, x):
+        self.data = jnp.full(self.shape, x, dtype=self.dtype,
+                             device=self.device.jax_device)
+        return self
+
+    # ---- random fillers (functional curand; reference tensor.py fillers) --
+    def gaussian(self, mean=0.0, std=1.0):
+        k = self.device.rand_key()
+        self.data = mean + std * jax.random.normal(k, self.shape,
+                                                   dtype=self.dtype)
+        return self
+
+    def uniform(self, low=0.0, high=1.0):
+        k = self.device.rand_key()
+        self.data = jax.random.uniform(k, self.shape, dtype=self.dtype,
+                                       minval=low, maxval=high)
+        return self
+
+    def bernoulli(self, p):
+        k = self.device.rand_key()
+        self.data = jax.random.bernoulli(k, p, self.shape).astype(self.dtype)
+        return self
+
+    # ---- shape ops ------------------------------------------------------
+    def reshape(self, shape):
+        t = self.clone()
+        t.data = jnp.reshape(t.data, shape)
+        return t
+
+    def transpose(self, axes=None):
+        t = self.clone()
+        t.data = jnp.transpose(t.data, axes)
+        return t
+
+    def flatten(self):
+        return self.reshape((self.size(),))
+
+    def repeat(self, repeats, axis):
+        t = self.clone()
+        t.data = jnp.repeat(t.data, repeats, axis=axis)
+        return t
+
+    def clone(self):
+        t = Tensor.__new__(Tensor)
+        t.data = self.data
+        t.device = self.device
+        t.requires_grad = self.requires_grad
+        t.stores_grad = self.stores_grad
+        t.creator = None
+        t.name = self.name
+        t.grad = None
+        return t
+
+    def deepcopy(self):
+        t = self.clone()
+        t.data = jnp.array(self.data) if not _is_tracer(self.data) else self.data
+        return t
+
+    # ---- elementwise / arithmetic (eager; autograd ops live in autograd.py)
+    def __add__(self, o):
+        return _wrap(self.data + _raw(o), self)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return _wrap(self.data - _raw(o), self)
+
+    def __rsub__(self, o):
+        return _wrap(_raw(o) - self.data, self)
+
+    def __mul__(self, o):
+        return _wrap(self.data * _raw(o), self)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return _wrap(self.data / _raw(o), self)
+
+    def __rtruediv__(self, o):
+        return _wrap(_raw(o) / self.data, self)
+
+    __div__ = __truediv__
+
+    def __neg__(self):
+        return _wrap(-self.data, self)
+
+    def __pow__(self, o):
+        return _wrap(self.data ** _raw(o), self)
+
+    def __lt__(self, o):
+        return _wrap((self.data < _raw(o)).astype(float32), self)
+
+    def __le__(self, o):
+        return _wrap((self.data <= _raw(o)).astype(float32), self)
+
+    def __gt__(self, o):
+        return _wrap((self.data > _raw(o)).astype(float32), self)
+
+    def __ge__(self, o):
+        return _wrap((self.data >= _raw(o)).astype(float32), self)
+
+    def __matmul__(self, o):
+        return _wrap(self.data @ _raw(o), self)
+
+    # in-place variants mutate by rebinding (reference += on CTensor)
+    def __iadd__(self, o):
+        self.data = self.data + _raw(o)
+        return self
+
+    def __isub__(self, o):
+        self.data = self.data - _raw(o)
+        return self
+
+    def __imul__(self, o):
+        self.data = self.data * _raw(o)
+        return self
+
+    def __itruediv__(self, o):
+        self.data = self.data / _raw(o)
+        return self
+
+    def __getitem__(self, keys):
+        return _wrap(self.data[keys], self)
+
+    def __len__(self):
+        return self.shape[0] if self.shape else 0
+
+    def __repr__(self):
+        body = ("<traced>" if _is_tracer(self.data)
+                else np.array2string(self.numpy(), threshold=24))
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"device={self.device.name()}, data={body})")
+
+    # misc math used by reference scripts
+    def l2(self):
+        return float(jnp.sqrt(jnp.sum(self.data * self.data)))
+
+    def l1(self):
+        return float(jnp.sum(jnp.abs(self.data)))
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _wrap(arr, like: Tensor) -> Tensor:
+    t = Tensor.__new__(Tensor)
+    t.data = arr
+    t.device = like.device
+    t.requires_grad = like.requires_grad
+    t.stores_grad = False
+    t.creator = None
+    t.name = None
+    t.grad = None
+    return t
+
+
+# ---------------------------------------------------------------------------
+# module-level functional API (parity with python/singa/tensor.py free fns)
+# ---------------------------------------------------------------------------
+
+def from_numpy(np_array, dev=None) -> Tensor:
+    if np_array.dtype == np.float64:
+        np_array = np_array.astype(np.float32)
+    if np_array.dtype == np.int64:
+        np_array = np_array.astype(np.int32)
+    return Tensor(data=np_array, device=dev, dtype=np_array.dtype,
+                  requires_grad=False)
+
+
+def to_numpy(t: Tensor) -> np.ndarray:
+    return t.numpy()
+
+
+def to_host(t: Tensor) -> Tensor:
+    return t.clone().to_host()
+
+
+def from_raw_tensor(arr, dev=None) -> Tensor:
+    return Tensor(data=arr, device=dev)
+
+
+def zeros_like(t: Tensor) -> Tensor:
+    return Tensor(shape=t.shape, device=t.device, dtype=t.dtype)
+
+
+def ones_like(t: Tensor) -> Tensor:
+    out = Tensor(shape=t.shape, device=t.device, dtype=t.dtype)
+    out.data = jnp.ones(t.shape, dtype=t.dtype,
+                        device=out.device.jax_device)
+    return out
+
+
+def zeros(shape, dtype=float32, device=None) -> Tensor:
+    return Tensor(shape=shape, dtype=dtype, device=device)
+
+
+def ones(shape, dtype=float32, device=None) -> Tensor:
+    t = Tensor(shape=shape, dtype=dtype, device=device)
+    t.data = jnp.ones(shape, dtype=dtype, device=t.device.jax_device)
+    return t
+
+
+def random(shape, device=None) -> Tensor:
+    t = Tensor(shape=shape, device=device)
+    t.uniform(0.0, 1.0)
+    return t
+
+
+def product(shape) -> int:
+    return int(np.prod(shape)) if len(shape) else 1
+
+
+def sizeof(dtype) -> int:
+    return np.dtype(dtype).itemsize
+
+
+def contiguous(t: Tensor) -> Tensor:
+    return t.clone()
+
+
+def reshape(t: Tensor, shape) -> Tensor:
+    return t.reshape(shape)
+
+
+def transpose(t: Tensor, axes=None) -> Tensor:
+    return t.transpose(axes)
+
+
+def copy_data_to_from(dst: Tensor, src: Tensor, size=None,
+                      dst_offset=0, src_offset=0) -> None:
+    assert dst_offset == 0 and src_offset == 0
+    if size is None or size == dst.size():
+        dst.copy_data(src)
+    else:
+        flat_src = jnp.ravel(_raw(src))[:size]
+        flat_dst = jnp.ravel(dst.data)
+        dst.data = flat_dst.at[:size].set(flat_src).reshape(dst.shape)
+
+
+def copy_from_numpy(t: Tensor, arr) -> None:
+    t.copy_from_numpy(arr)
+
+
+def _unary(fn):
+    def g(t):
+        return _wrap(fn(_raw(t)), t)
+    return g
+
+
+abs = _unary(jnp.abs)  # noqa: A001 - parity with reference module API
+exp = _unary(jnp.exp)
+ceil = _unary(jnp.ceil)
+log = _unary(jnp.log)
+sign = _unary(jnp.sign)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+tanh = _unary(jnp.tanh)
+
+
+def sigmoid(t):
+    return _wrap(jax.nn.sigmoid(_raw(t)), t)
+
+
+def relu(t):
+    return _wrap(jax.nn.relu(_raw(t)), t)
+
+
+def sum(t, axis=None, out=None):  # noqa: A001
+    r = jnp.sum(_raw(t), axis=axis)
+    if out is not None:
+        out.data = r
+        return out
+    return _wrap(r, t) if r.ndim else float(r)
+
+
+def pow(t, x, out=None):  # noqa: A001
+    r = _raw(t) ** _raw(x)
+    if out is not None:
+        out.data = r
+        return out
+    return _wrap(r, t)
+
+
+def average(t, axis=None):
+    r = jnp.mean(_raw(t), axis=axis)
+    return _wrap(r, t) if r.ndim else float(r)
+
+
+def softmax(t, out=None):
+    r = jax.nn.softmax(_raw(t), axis=-1)
+    if out is not None:
+        out.data = r
+        return out
+    return _wrap(r, t)
+
+
+def _cmp(fn):
+    def g(t, x):
+        return _wrap(fn(_raw(t), _raw(x)).astype(float32), t)
+    return g
+
+
+lt = _cmp(jnp.less)
+le = _cmp(jnp.less_equal)
+gt = _cmp(jnp.greater)
+ge = _cmp(jnp.greater_equal)
+eq = _cmp(jnp.equal)
+
+
+def add(lhs, rhs, ret=None):
+    r = _raw(lhs) + _raw(rhs)
+    if ret is not None:
+        ret.data = r
+        return ret
+    return _wrap(r, lhs if isinstance(lhs, Tensor) else rhs)
+
+
+def sub(lhs, rhs, ret=None):
+    r = _raw(lhs) - _raw(rhs)
+    if ret is not None:
+        ret.data = r
+        return ret
+    return _wrap(r, lhs if isinstance(lhs, Tensor) else rhs)
+
+
+def eltwise_mult(lhs, rhs, ret=None):
+    r = _raw(lhs) * _raw(rhs)
+    if ret is not None:
+        ret.data = r
+        return ret
+    return _wrap(r, lhs if isinstance(lhs, Tensor) else rhs)
+
+
+def div(lhs, rhs, ret=None):
+    r = _raw(lhs) / _raw(rhs)
+    if ret is not None:
+        ret.data = r
+        return ret
+    return _wrap(r, lhs if isinstance(lhs, Tensor) else rhs)
+
+
+def mult(A, B, C=None, alpha=1.0, beta=0.0):
+    """GEMM: C = alpha*A@B + beta*C (reference tensor.py Mult/GEMM)."""
+    r = alpha * (_raw(A) @ _raw(B))
+    if C is not None:
+        r = r + beta * _raw(C)
+        C.data = r
+        return C
+    return _wrap(r, A)
+
+
+def axpy(alpha, x, y):
+    """y += alpha * x, in place on y (cuBLAS axpy equivalent; the optimizer
+    hot path, reference opt.py:269-310)."""
+    y.data = y.data + alpha * _raw(x)
+    return y
+
+
+def einsum(ops, *args):
+    arrs = [_raw(a) for a in args]
+    like = next(a for a in args if isinstance(a, Tensor))
+    return _wrap(jnp.einsum(ops, *arrs), like)
+
+
+def tensordot(A, B, axes=2):
+    return _wrap(jnp.tensordot(_raw(A), _raw(B), axes=axes), A)
+
+
+def repeat(t, repeats, axis=None):
+    return _wrap(jnp.repeat(_raw(t), repeats, axis=axis), t)
+
+
+def concatenate(tensors, axis=0):
+    arrs = [_raw(t) for t in tensors]
+    return _wrap(jnp.concatenate(arrs, axis=axis), tensors[0])
+
+
+def bernoulli(p, t: Tensor):
+    return t.bernoulli(p)
+
+
+def gaussian(mean, std, t: Tensor):
+    return t.gaussian(mean, std)
+
+
+def uniform(low, high, t: Tensor):
+    return t.uniform(low, high)
+
+
+def add_column(alpha, v, beta, M):
+    """M = alpha*v (as column, broadcast) + beta*M."""
+    M.data = alpha * _raw(v)[:, None] + beta * M.data
+    return M
+
+
+def add_row(alpha, v, beta, M):
+    M.data = alpha * _raw(v)[None, :] + beta * M.data
+    return M
+
+
+def sum_columns(M):
+    return _wrap(jnp.sum(_raw(M), axis=1), M)
+
+
+def sum_rows(M):
+    return _wrap(jnp.sum(_raw(M), axis=0), M)

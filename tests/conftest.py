@@ -1,0 +1,27 @@
+"""Test harness: hermetic multi-device CPU mesh.
+
+The reference cannot test distributed paths without a GPU cluster
+(SURVEY.md §4); we can — 8 virtual XLA host devices stand in for an 8-chip
+slice, so DP/collective tests run on any machine.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Some accelerator plugins ignore JAX_PLATFORMS; pin the default device so
+# tests run hermetically on the virtual CPU mesh regardless.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
