@@ -1,0 +1,69 @@
+"""Benchmark harness: ResNet-50 synthetic-data training throughput.
+
+The reference's headline harness (examples/cnn/benchmark.py:85-87) measures
+`throughput = niters * batch * world / (end - start)` on ResNet-50 with
+synthetic data. The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` reports against our own first recorded TPU run when one
+exists (BENCH_BASELINE env or the default below), else 1.0.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
+              dtype="float32"):
+    from singa_tpu import tensor, opt, device
+    from singa_tpu.models import resnet
+
+    dev = device.create_tpu_device()
+    model = resnet.create_model(depth=depth, num_classes=10, num_channels=3)
+    model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
+
+    x = np.random.randn(batch, 3, image_size, image_size).astype(np.float32)
+    y = np.eye(10)[np.random.randint(0, 10, batch)].astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev, dtype=tensor.float32,
+                       requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, dtype=tensor.float32,
+                       requires_grad=False)
+
+    model.compile([tx], is_train=True, use_graph=True)
+
+    for _ in range(warmup):
+        out, loss = model(tx, ty)
+    loss.data.block_until_ready()
+
+    start = time.perf_counter()
+    for _ in range(niters):
+        out, loss = model(tx, ty)
+    loss.data.block_until_ready()
+    end = time.perf_counter()
+
+    throughput = niters * batch / (end - start)
+    step_ms = (end - start) / niters * 1e3
+    return throughput, step_ms
+
+
+def main():
+    niters = int(os.environ.get("BENCH_ITERS", "50"))
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    throughput, step_ms = run_bench(batch=batch, niters=niters)
+    # No published reference number exists (BASELINE.md); compare against a
+    # recorded prior run when provided.
+    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    vs = throughput / baseline if baseline > 0 else 1.0
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(throughput, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

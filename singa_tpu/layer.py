@@ -153,9 +153,18 @@ def _param(shape, device, init="zeros", dtype=jnp.float32):
 class Linear(Layer):
     """y = xW + b (reference layer.Linear:287)."""
 
-    def __init__(self, out_features, bias=True):
+    def __init__(self, out_features, *args, bias=True):
         super().__init__()
         self.out_features = out_features
+        # legacy two-positional form Linear(in_features, out_features[, bias])
+        # (reference layer.py:305-312); in_features is re-inferred at init.
+        # A bool second positional is the new-API bias, not out_features.
+        if len(args) > 0 and not isinstance(args[0], bool):
+            self.out_features = args[0]
+            if len(args) > 1:
+                bias = args[1]
+        elif len(args) > 0:
+            bias = args[0]
         self.bias = bias
 
     def initialize(self, x):
@@ -243,10 +252,19 @@ class Embedding(Layer):
 class Conv2d(Layer):
     """2-D convolution layer (reference layer.Conv2d:508)."""
 
-    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+    def __init__(self, nb_kernels, kernel_size, *args, stride=1, padding=0,
                  dilation=1, group=1, bias=True, pad_mode="NOTSET",
                  activation="NOTSET"):
         super().__init__()
+        # legacy form Conv2d(in_ch, nb_kernels, k[, stride[, padding]])
+        # (reference layer.py:552-560); in_channels is inferred at init
+        if len(args) > 0:
+            nb_kernels = kernel_size
+            kernel_size = args[0]
+        if len(args) > 1:
+            stride = args[1]
+        if len(args) > 2:
+            padding = args[2]
         self.nb_kernels = nb_kernels
         self.kernel_size = kernel_size
         self.stride = stride
@@ -256,7 +274,6 @@ class Conv2d(Layer):
         self.bias = bias
         self.pad_mode = pad_mode
         self.activation = activation
-        assert dilation in (1, (1, 1)), "dilation>1 not yet supported"
 
     def initialize(self, x):
         self.in_channels = x.shape[1]
@@ -271,13 +288,16 @@ class Conv2d(Layer):
             self.b = _param((self.nb_kernels,), dev)
         pad = self.padding
         pad_mode = None
-        if self.pad_mode in ("SAME_UPPER", "SAME_LOWER"):
+        if self.pad_mode == "SAME_UPPER":
             pad_mode = "SAME"
+        elif self.pad_mode == "SAME_LOWER":
+            pad_mode = "SAME_LOWER"  # lax places the odd pad at the start
         elif self.pad_mode == "VALID":
             pad_mode = "VALID"
         self.handle = ConvHandle(x, ks, self.stride, pad,
                                  self.in_channels, self.nb_kernels,
-                                 self.bias, self.group, pad_mode)
+                                 self.bias, self.group, pad_mode,
+                                 dilation=self.dilation)
 
     def forward(self, x):
         from .ops.conv import conv2d
@@ -296,9 +316,17 @@ class Conv2d(Layer):
 class SeparableConv2d(Layer):
     """Depthwise + pointwise conv (reference layer.SeparableConv2d:740)."""
 
-    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+    def __init__(self, nb_kernels, kernel_size, *args, stride=1, padding=0,
                  bias=False):
         super().__init__()
+        # legacy form SeparableConv2d(in_ch, nb_kernels, k[, stride[, pad]])
+        if len(args) > 0:
+            nb_kernels = kernel_size
+            kernel_size = args[0]
+        if len(args) > 1:
+            stride = args[1]
+        if len(args) > 2:
+            padding = args[2]
         self.depthwise = None
         self.pointwise = None
         self.nb_kernels = nb_kernels
@@ -309,9 +337,9 @@ class SeparableConv2d(Layer):
 
     def initialize(self, x):
         in_channels = x.shape[1]
-        self.depthwise = Conv2d(in_channels, self.kernel_size, self.stride,
-                                self.padding, group=in_channels,
-                                bias=self.bias)
+        self.depthwise = Conv2d(in_channels, self.kernel_size,
+                                stride=self.stride, padding=self.padding,
+                                group=in_channels, bias=self.bias)
         self.pointwise = Conv2d(self.nb_kernels, 1, bias=self.bias)
         self.depthwise.name = f"{self.name}{self.sep}depthwise"
         self.pointwise.name = f"{self.name}{self.sep}pointwise"
@@ -339,8 +367,15 @@ class SeparableConv2d(Layer):
 class BatchNorm2d(Layer):
     """BN over channel axis (reference layer.BatchNorm2d:802)."""
 
-    def __init__(self, momentum=0.9, eps=1e-5):
+    def __init__(self, *args, momentum=0.9, eps=1e-5):
         super().__init__()
+        # legacy form BatchNorm2d(channels[, momentum]); channels is
+        # re-inferred from the input at initialize time. A lone float
+        # positional is a momentum (the pre-channel-arg API).
+        if len(args) == 1 and isinstance(args[0], float):
+            momentum = args[0]
+        elif len(args) > 1:
+            momentum = args[1]
         self.momentum = momentum
         self.eps = eps
 

@@ -155,6 +155,12 @@ class Model(Layer):
     # -- the compiled step -------------------------------------------------
     def _build_step(self, n_inputs):
         state_list = self._state_tensors()
+        # unify placement: optimizer scalars (step counter, schedules) are
+        # born on the host default device; move all state to the model device
+        for t in state_list:
+            if not isinstance(t.data, jax.core.Tracer):
+                t.data = self.dev.put(t.data)
+                t.device = self.dev
         self._state_list = state_list
         opt = getattr(self, "optimizer", None)
         if opt is not None:

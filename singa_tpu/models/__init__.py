@@ -1,0 +1,34 @@
+"""Model zoo — the reference's example model families, rebuilt on the
+TPU-native layer/model API (reference examples/cnn/model/*.py,
+examples/mlp/model.py).
+
+Every model exposes ``create_model(**kwargs)`` and a ``train_one_batch``
+supporting the reference's distributed options
+(examples/cnn/model/cnn.py:52-70): plain | half | partialUpdate |
+sparseTopK | sparseThreshold.
+"""
+
+
+class TrainStepMixin:
+    """Shared dist-option dispatch for train_one_batch
+    (reference examples/cnn/model/cnn.py:52-70)."""
+
+    def _apply_optimizer(self, loss, dist_option="plain", spars=None):
+        if dist_option == "plain" or not hasattr(
+                self.optimizer, "backward_and_update_half"):
+            self.optimizer(loss)
+        elif dist_option == "half":
+            self.optimizer.backward_and_update_half(loss)
+        elif dist_option == "partialUpdate":
+            self.optimizer.backward_and_partial_update(loss)
+        elif dist_option == "sparseTopK":
+            self.optimizer.backward_and_sparse_update(
+                loss, topK=True, spars=spars)
+        elif dist_option == "sparseThreshold":
+            self.optimizer.backward_and_sparse_update(
+                loss, topK=False, spars=spars)
+        else:
+            raise ValueError(f"unknown dist_option {dist_option!r}")
+
+
+from . import mlp, cnn, alexnet, resnet, xceptionnet  # noqa: F401,E402

@@ -37,9 +37,11 @@ class ConvHandle:
     """
 
     def __init__(self, x, kernel_size, stride, padding, in_channels,
-                 out_channels, bias=True, group=1, pad_mode=None):
+                 out_channels, bias=True, group=1, pad_mode=None,
+                 dilation=1):
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
+        self.dilation = _pair(dilation)
         if (isinstance(padding, (tuple, list)) and len(padding) == 2
                 and isinstance(padding[0], (tuple, list))):
             self.padding = tuple(tuple(int(v) for v in p) for p in padding)
@@ -62,8 +64,9 @@ class ConvHandle:
         (p0, p1), (q0, q1) = self.padding
         kh, kw = self.kernel_size
         sh, sw = self.stride
-        oh = (h + p0 + p1 - kh) // sh + 1
-        ow = (w + q0 + q1 - kw) // sw + 1
+        dh, dw = self.dilation
+        oh = (h + p0 + p1 - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (w + q0 + q1 - (dw * (kw - 1) + 1)) // sw + 1
         return (n, self.out_channels, oh, ow)
 
 
@@ -87,6 +90,7 @@ class _Conv2d(Operator):
             x, W,
             window_strides=h.stride,
             padding=padding,
+            rhs_dilation=h.dilation,
             dimension_numbers=h.dimension_numbers,
             feature_group_count=h.group,
             preferred_element_type=jnp.float32
