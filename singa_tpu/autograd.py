@@ -371,7 +371,10 @@ class Mean(Operator):
     """Elementwise mean of N tensors (reference autograd.Mean)."""
 
     def forward(self, *xs):
-        return sum(xs) / len(xs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out / len(xs)
 
 
 class Max(Operator):

@@ -300,10 +300,13 @@ class Tensor:
 
     # misc math used by reference scripts
     def l2(self):
-        return float(jnp.sqrt(jnp.sum(self.data * self.data)))
+        # reference: nrm2 / Size() (src/core/tensor/tensor.cc:833-843)
+        return float(jnp.sqrt(jnp.sum(self.data * self.data)) /
+                     max(1, self.size()))
 
     def l1(self):
-        return float(jnp.sum(jnp.abs(self.data)))
+        # reference: asum / Size() (src/core/tensor/tensor.cc:815-827)
+        return float(jnp.sum(jnp.abs(self.data)) / max(1, self.size()))
 
 
 def _is_tracer(x):

@@ -7,7 +7,7 @@ slice, so DP/collective tests run on any machine.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
@@ -17,8 +17,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
-# Some accelerator plugins ignore JAX_PLATFORMS; pin the default device so
-# tests run hermetically on the virtual CPU mesh regardless.
+# The environment's sitecustomize registers a TPU PJRT plugin at interpreter
+# startup and pins jax_platforms=axon via jax.config — overriding the env
+# var set above, and its backend init can block on a network tunnel. Force
+# the config back so tests run hermetically on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 

@@ -1,0 +1,247 @@
+"""Layer zoo: shape inference, forward oracles, params/states plumbing
+(reference test/python/test_layer.py + test_operation conv/bn/pool cases)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from singa_tpu import autograd, device, layer
+from singa_tpu.tensor import Tensor
+
+
+DEV = device.create_cpu_device()
+
+
+def t(arr, rg=False):
+    return Tensor(data=np.asarray(arr, np.float32), device=DEV,
+                  requires_grad=rg, stores_grad=rg)
+
+
+class TestLinear:
+    def test_shapes_and_params(self):
+        x = t(np.random.randn(3, 7))
+        fc = layer.Linear(4)
+        y = fc(x)
+        assert y.shape == (3, 4)
+        params = fc.get_params()
+        assert set(params) == {"Linear.W", "Linear.b"}
+        assert params["Linear.W"].shape == (7, 4)
+
+    def test_forward_oracle(self):
+        x = t(np.random.randn(3, 7))
+        fc = layer.Linear(4)
+        y = fc(x)
+        W = np.asarray(fc.W.data)
+        b = np.asarray(fc.b.data)
+        np.testing.assert_allclose(np.asarray(y.data),
+                                   np.asarray(x.data) @ W + b, rtol=1e-5)
+
+    def test_legacy_two_arg_form(self):
+        fc = layer.Linear(7, 4)
+        y = fc(t(np.random.randn(3, 7)))
+        assert y.shape == (3, 4)
+
+    def test_set_get_params_roundtrip(self):
+        fc = layer.Linear(4)
+        fc(t(np.random.randn(3, 7)))
+        p = fc.get_params()
+        newW = t(np.ones((7, 4)))
+        fc.set_params({"Linear.W": newW})
+        np.testing.assert_array_equal(np.asarray(fc.W.data), 1.0)
+
+
+class TestConv2d:
+    def test_identity_kernel(self):
+        x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+        conv = layer.Conv2d(3, 1, bias=False)
+        y = conv(t(x))
+        # set 1x1 identity weights: out c = in c
+        W = np.zeros((3, 3, 1, 1), np.float32)
+        for c in range(3):
+            W[c, c, 0, 0] = 1.0
+        conv.W.copy_from_numpy(W)
+        y = conv(t(x))
+        np.testing.assert_allclose(np.asarray(y.data), x, rtol=1e-5)
+
+    def test_vs_lax_oracle(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        conv = layer.Conv2d(5, 3, stride=2, padding=1)
+        y = conv(t(x))
+        W = np.asarray(conv.W.data)
+        b = np.asarray(conv.b.data)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(W), (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        ref = ref + jnp.asarray(b)[None, :, None, None]
+        np.testing.assert_allclose(np.asarray(y.data), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        assert y.shape == (2, 5, 4, 4)
+
+    def test_grouped(self):
+        x = np.random.randn(2, 4, 6, 6).astype(np.float32)
+        conv = layer.Conv2d(4, 3, padding=1, group=4, bias=False)
+        y = conv(t(x))
+        assert y.shape == (2, 4, 6, 6)
+
+    def test_separable(self):
+        x = np.random.randn(2, 4, 6, 6).astype(np.float32)
+        sep = layer.SeparableConv2d(8, 3, padding=1)
+        y = sep(t(x))
+        assert y.shape == (2, 8, 6, 6)
+        names = set(sep.get_params())
+        assert any("depthwise" in n for n in names)
+        assert any("pointwise" in n for n in names)
+
+
+class TestBatchNorm:
+    def test_train_normalizes(self):
+        autograd.training = True
+        try:
+            x = np.random.RandomState(0).randn(8, 3, 4, 4) * 3 + 5
+            bn = layer.BatchNorm2d()
+            y = bn(t(x.astype(np.float32), rg=True))
+            vals = np.asarray(y.data)
+            np.testing.assert_allclose(vals.mean(axis=(0, 2, 3)), 0.0,
+                                       atol=1e-4)
+            np.testing.assert_allclose(vals.std(axis=(0, 2, 3)), 1.0,
+                                       atol=1e-2)
+        finally:
+            autograd.training = False
+
+    def test_running_stats_update_and_eval(self):
+        autograd.training = True
+        try:
+            rs = np.random.RandomState(1)
+            bn = layer.BatchNorm2d(momentum=0.0)  # running <- batch stats
+            x = rs.randn(16, 2, 3, 3).astype(np.float32) * 2 + 1
+            bn(t(x, rg=True))
+            rm = np.asarray(bn.running_mean.data)
+            np.testing.assert_allclose(rm, x.mean(axis=(0, 2, 3)), atol=1e-4)
+        finally:
+            autograd.training = False
+        # eval mode uses running stats
+        y = bn(t(x))
+        expect = (x - rm[None, :, None, None]) / np.sqrt(
+            np.asarray(bn.running_var.data)[None, :, None, None] + bn.eps)
+        np.testing.assert_allclose(np.asarray(y.data), expect, atol=1e-3)
+
+    def test_states_include_running(self):
+        bn = layer.BatchNorm2d()
+        bn(t(np.random.randn(2, 3, 4, 4).astype(np.float32)))
+        st = bn.get_states()
+        assert "BatchNorm2d.running_mean" in st
+        assert "BatchNorm2d.running_var" in st
+        assert set(bn.get_params()) == {"BatchNorm2d.scale",
+                                        "BatchNorm2d.bias"}
+
+
+class TestPooling:
+    def test_maxpool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = layer.MaxPool2d(2, 2)(t(x))
+        np.testing.assert_array_equal(
+            np.asarray(y.data).reshape(2, 2), [[5, 7], [13, 15]])
+
+    def test_avgpool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = layer.AvgPool2d(2, 2)(t(x))
+        np.testing.assert_allclose(
+            np.asarray(y.data).reshape(2, 2), [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_pool1d(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 1, 8)
+        y = layer.MaxPool1d(2, 2)(t(x))
+        np.testing.assert_array_equal(np.asarray(y.data).ravel(),
+                                      [1, 3, 5, 7])
+
+    def test_padded_max(self):
+        x = np.ones((1, 1, 3, 3), np.float32)
+        y = layer.MaxPool2d(2, 2, padding=1)(t(x))
+        assert y.shape == (1, 1, 2, 2)
+
+
+class TestRNNLayers:
+    def test_vanilla_rnn(self):
+        autograd.training = True
+        try:
+            rnn = layer.RNN(4, 6)
+            xs = [t(np.random.randn(2, 4), rg=True) for _ in range(3)]
+            h0 = t(np.zeros((2, 6)))
+            out, h = rnn(xs, h0)
+            assert len(out) == 3 and h.shape == (2, 6)
+        finally:
+            autograd.training = False
+
+    def test_lstm(self):
+        autograd.training = True
+        try:
+            lstm = layer.LSTM(4, 6)
+            xs = [t(np.random.randn(2, 4), rg=True) for _ in range(3)]
+            h0, c0 = t(np.zeros((2, 6))), t(np.zeros((2, 6)))
+            out, (h, c) = lstm(xs, (h0, c0))
+            assert len(out) == 3 and h.shape == (2, 6) and c.shape == (2, 6)
+        finally:
+            autograd.training = False
+
+    def test_fused_lstm_shapes(self):
+        autograd.training = True
+        try:
+            rnn = layer.CudnnRNN(8, rnn_mode="lstm")
+            x = t(np.random.randn(5, 2, 3), rg=True)  # (seq, batch, feat)
+            y, hy, cy = rnn(x)
+            assert y.shape == (5, 2, 8)
+            assert hy.shape == (1, 2, 8)
+        finally:
+            autograd.training = False
+
+    def test_fused_gru_and_tanh(self):
+        autograd.training = True
+        try:
+            for mode in ("gru", "tanh", "relu"):
+                rnn = layer.CudnnRNN(4, rnn_mode=mode)
+                y, hy, cy = rnn(t(np.random.randn(3, 2, 5), rg=True))
+                assert y.shape == (3, 2, 4), mode
+        finally:
+            autograd.training = False
+
+    def test_bidirectional(self):
+        autograd.training = True
+        try:
+            rnn = layer.CudnnRNN(4, rnn_mode="lstm", bidirectional=True)
+            y, hy, cy = rnn(t(np.random.randn(3, 2, 5), rg=True))
+            assert y.shape == (3, 2, 8)
+            assert hy.shape == (2, 2, 4)
+        finally:
+            autograd.training = False
+
+
+class TestMisc:
+    def test_embedding_layer(self):
+        emb = layer.Embedding(10, 4)
+        ids = t(np.array([[1, 2], [3, 4]], np.float32))
+        y = emb(ids)
+        assert y.shape == (2, 2, 4)
+
+    def test_stateless_layers(self):
+        x = t(np.random.randn(3, 4))
+        assert layer.ReLU()(x).shape == (3, 4)
+        assert layer.Sigmoid()(x).shape == (3, 4)
+        assert layer.Tanh()(x).shape == (3, 4)
+        assert layer.SoftMax()(x).shape == (3, 4)
+        assert layer.Flatten()(t(np.random.randn(3, 2, 2))).shape == (3, 4)
+
+    def test_nested_param_names(self):
+        class Block(layer.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = layer.Linear(4)
+                self.fc2 = layer.Linear(2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        b = Block()
+        b(t(np.random.randn(3, 7)))
+        names = set(b.get_params())
+        assert names == {"Block.fc1.W", "Block.fc1.b",
+                         "Block.fc2.W", "Block.fc2.b"}

@@ -1,0 +1,381 @@
+"""Every autograd op: forward vs a numpy/jax oracle, backward vs jax.grad
+of the oracle (the reference checks each op against numpy the same way,
+test/python/test_operation.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from singa_tpu import autograd, tensor
+from singa_tpu.tensor import Tensor
+
+
+def t(arr, rg=True):
+    return Tensor(data=np.asarray(arr, dtype=np.float32),
+                  requires_grad=rg, stores_grad=rg)
+
+
+def check(op_fn, ref_fn, *arrays, rtol=1e-5, atol=1e-6, grad=True,
+          grad_args=None):
+    """Forward parity + gradient parity against jax.grad of the oracle.
+
+    ``grad_args`` limits which inputs' gradients are compared (losses
+    stop-gradient their target, matching the reference)."""
+    autograd.training = True
+    try:
+        ts = [t(a) for a in arrays]
+        y = op_fn(*ts)
+        ref = ref_fn(*[jnp.asarray(a, jnp.float32) for a in arrays])
+        np.testing.assert_allclose(np.asarray(y.data), np.asarray(ref),
+                                   rtol=rtol, atol=atol)
+        if not grad:
+            return
+        if grad_args is None:
+            grad_args = tuple(range(len(arrays)))
+        grads = {id(p): g for p, g in autograd.backward(y)}
+        ref_grads = jax.grad(
+            lambda *xs: jnp.sum(ref_fn(*xs)),
+            argnums=tuple(grad_args))(
+                *[jnp.asarray(a, jnp.float32) for a in arrays])
+        for i, rg_ in zip(grad_args, ref_grads):
+            tt = ts[i]
+            assert id(tt) in grads, "missing grad"
+            np.testing.assert_allclose(np.asarray(grads[id(tt)].data),
+                                       np.asarray(rg_), rtol=rtol, atol=atol)
+    finally:
+        autograd.training = False
+
+
+A = np.random.RandomState(3).randn(4, 5).astype(np.float32)
+B = np.random.RandomState(4).randn(4, 5).astype(np.float32)
+P = np.abs(A) + 0.5  # positive operand
+
+
+class TestArithmetic:
+    def test_add(self):
+        check(autograd.add, jnp.add, A, B)
+
+    def test_sub(self):
+        check(autograd.sub, jnp.subtract, A, B)
+
+    def test_mul(self):
+        check(autograd.mul, jnp.multiply, A, B)
+
+    def test_div(self):
+        check(autograd.div, jnp.divide, A, P)
+
+    def test_pow(self):
+        check(autograd.pow, jnp.power, P, B)
+
+    def test_negative(self):
+        check(autograd.negative, jnp.negative, A)
+
+    def test_reciprocal(self):
+        check(autograd.reciprocal, lambda x: 1.0 / x, P)
+
+    def test_matmul(self):
+        check(autograd.matmul, jnp.matmul, A, B.T)
+
+    def test_gemm(self):
+        check(lambda a, b, c: autograd.gemm(a, b, c, alpha=2.0, beta=3.0,
+                                            transA=0, transB=1),
+              lambda a, b, c: 2.0 * (a @ b.T) + 3.0 * c, A, B,
+              np.ones((4, 4), np.float32))
+
+    def test_sum_nary(self):
+        check(autograd.sum, lambda a, b, c: a + b + c, A, B, A)
+
+    def test_add_bias(self):
+        b = np.random.randn(5).astype(np.float32)
+        check(lambda x, bb: autograd.add_bias(x, bb, axis=0),
+              lambda x, bb: x + bb[None, :], A, b)
+
+
+class TestUnaryMath:
+    @pytest.mark.parametrize("name,ref,arg", [
+        ("abs", jnp.abs, A), ("exp", jnp.exp, A), ("log", jnp.log, P),
+        ("sqrt", jnp.sqrt, P), ("sin", jnp.sin, A), ("cos", jnp.cos, A),
+        ("tan", jnp.tan, A * 0.3), ("sinh", jnp.sinh, A),
+        ("cosh", jnp.cosh, A), ("tanh", jnp.tanh, A),
+        ("asin", jnp.arcsin, A * 0.19), ("acos", jnp.arccos, A * 0.19),
+        ("atan", jnp.arctan, A), ("asinh", jnp.arcsinh, A),
+        ("acosh", jnp.arccosh, P + 1.0), ("atanh", jnp.arctanh, A * 0.19),
+        ("erf", jax.scipy.special.erf, A),
+    ])
+    def test_fn(self, name, ref, arg):
+        check(getattr(autograd, name), ref, arg, rtol=2e-5, atol=2e-5)
+
+    def test_rounding_zero_grad(self):
+        autograd.training = True
+        try:
+            for fn in (autograd.ceil, autograd.floor, autograd.sign,
+                       autograd.rounde):
+                x = t(A)
+                y = fn(x)
+                grads = {id(p): g for p, g in autograd.backward(y)}
+                np.testing.assert_array_equal(
+                    np.asarray(grads[id(x)].data), np.zeros_like(A))
+        finally:
+            autograd.training = False
+
+    def test_round_half_away(self):
+        x = np.array([0.5, -0.5, 1.5, 2.4, -2.5], np.float32)
+        y = autograd.round(t(x, rg=False))
+        np.testing.assert_array_equal(np.asarray(y.data),
+                                      [1.0, -1.0, 2.0, 2.0, -3.0])
+
+
+class TestActivations:
+    def test_relu(self):
+        check(autograd.relu, lambda x: jnp.maximum(x, 0), A)
+
+    def test_leakyrelu(self):
+        check(lambda x: autograd.leakyrelu(x, 0.1),
+              lambda x: jnp.where(x >= 0, x, 0.1 * x), A)
+
+    def test_elu(self):
+        check(lambda x: autograd.elu(x, 1.5),
+              lambda x: jnp.where(x > 0, x, 1.5 * (jnp.exp(x) - 1)), A)
+
+    def test_selu(self):
+        a, g = 1.67326, 1.0507
+        check(autograd.selu,
+              lambda x: g * jnp.where(x > 0, x, a * (jnp.exp(x) - 1)), A)
+
+    def test_sigmoid(self):
+        check(autograd.sigmoid, jax.nn.sigmoid, A)
+
+    def test_softplus(self):
+        check(autograd.softplus, jax.nn.softplus, A)
+
+    def test_softsign(self):
+        check(autograd.softsign, lambda x: x / (1 + jnp.abs(x)), A)
+
+    def test_hardsigmoid(self):
+        check(autograd.hardsigmoid,
+              lambda x: jnp.clip(0.2 * x + 0.5, 0, 1), A)
+
+    def test_prelu(self):
+        s = np.full((5,), 0.25, np.float32)
+        check(autograd.prelu,
+              lambda x, sl: jnp.where(x >= 0, x, sl * x), A, s)
+
+    def test_softmax(self):
+        check(lambda x: autograd.softmax(x, axis=1),
+              lambda x: jax.nn.softmax(x, axis=1), A)
+
+    def test_gelu(self):
+        check(autograd.gelu, jax.nn.gelu, A, rtol=1e-4)
+
+
+class TestLosses:
+    def test_softmax_cross_entropy_onehot(self):
+        logits = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+        target = np.eye(4, dtype=np.float32)[[0, 1, 2, 3, 1, 2]]
+        check(autograd.softmax_cross_entropy,
+              lambda x, tt: jnp.mean(-jnp.sum(
+                  tt * jax.nn.log_softmax(x, -1), -1)),
+              logits, target, grad_args=(0,))
+
+    def test_cross_entropy(self):
+        p = np.random.RandomState(1).rand(6, 4).astype(np.float32)
+        p /= p.sum(1, keepdims=True)
+        target = np.eye(4, dtype=np.float32)[[0, 1, 2, 3, 1, 2]]
+        check(autograd.cross_entropy,
+              lambda x, tt: -jnp.sum(tt * jnp.log(x + 1e-10)) / x.shape[0],
+              p, target, grad_args=(0,))
+
+    def test_mse(self):
+        check(autograd.mse_loss,
+              lambda x, tt: jnp.sum((x - tt) ** 2) / (2 * x.shape[0]),
+              A, B, grad_args=(0,))
+
+    def test_bce(self):
+        p = np.random.RandomState(1).rand(6, 4).astype(np.float32)
+        q = (np.random.RandomState(2).rand(6, 4) > 0.5).astype(np.float32)
+        check(autograd.binary_cross_entropy,
+              lambda x, tt: jnp.mean(jnp.sum(
+                  -(tt * jnp.log(x + 1e-10) +
+                    (1 - tt) * jnp.log(1 - x + 1e-10)), -1)), p, q,
+              grad_args=(0,))
+
+    def test_ranking(self):
+        pos = np.random.RandomState(5).rand(8).astype(np.float32)
+        neg = np.random.RandomState(6).rand(8).astype(np.float32)
+        check(lambda p_, n_: autograd.ranking_loss(p_, n_, M=0.3),
+              lambda p_, n_: jnp.mean(jnp.maximum(0.3 - (p_ - n_), 0)),
+              pos, neg)
+
+
+class TestReductions:
+    def test_reduce_sum(self):
+        check(lambda x: autograd.reduce_sum(x, axes=[1], keepdims=0),
+              lambda x: jnp.sum(x, axis=1), A)
+
+    def test_reduce_mean(self):
+        check(lambda x: autograd.reduce_mean(x, axes=[0], keepdims=1),
+              lambda x: jnp.mean(x, axis=0, keepdims=True), A)
+
+    def test_mean_nary(self):
+        check(autograd.mean, lambda a, b: (a + b) / 2, A, B)
+
+    def test_max_min(self):
+        check(autograd.max, jnp.maximum, A, B)
+        check(autograd.min, jnp.minimum, A, B)
+
+    def test_clip(self):
+        check(lambda x: autograd.clip(x, -0.5, 0.5),
+              lambda x: jnp.clip(x, -0.5, 0.5), A)
+
+    def test_comparisons(self):
+        for fn, ref in [(autograd.less, jnp.less),
+                        (autograd.greater, jnp.greater),
+                        (autograd.equal, jnp.equal)]:
+            y = fn(t(A, rg=False), t(B, rg=False))
+            np.testing.assert_array_equal(
+                np.asarray(y.data), np.asarray(ref(A, B), np.float32))
+
+
+class TestShapeOps:
+    def test_reshape(self):
+        check(lambda x: autograd.reshape(x, (5, 4)),
+              lambda x: jnp.reshape(x, (5, 4)), A)
+
+    def test_flatten(self):
+        x3 = np.random.randn(2, 3, 4).astype(np.float32)
+        check(lambda x: autograd.flatten(x, axis=1),
+              lambda x: jnp.reshape(x, (2, 12)), x3)
+
+    def test_transpose(self):
+        check(lambda x: autograd.transpose(x, (1, 0)), lambda x: x.T, A)
+
+    def test_squeeze_unsqueeze(self):
+        x = np.random.randn(1, 4, 1, 5).astype(np.float32)
+        check(lambda v: autograd.squeeze(v, (0, 2)),
+              lambda v: jnp.squeeze(v, (0, 2)), x)
+        check(lambda v: autograd.unsqueeze(v, [0, 2]),
+              lambda v: jnp.expand_dims(jnp.expand_dims(v, 0), 2), A)
+
+    def test_cat(self):
+        autograd.training = True
+        try:
+            a, b = t(A), t(B)
+            y = autograd.cat([a, b], axis=0)
+            np.testing.assert_allclose(np.asarray(y.data),
+                                       np.concatenate([A, B], 0))
+            grads = {id(p): g for p, g in autograd.backward(y)}
+            assert np.asarray(grads[id(a)].data).shape == A.shape
+        finally:
+            autograd.training = False
+
+    def test_split(self):
+        autograd.training = True
+        try:
+            a = t(A)
+            y1, y2 = autograd.split(a, axis=1, parts=[2, 3])
+            np.testing.assert_allclose(np.asarray(y1.data), A[:, :2])
+            np.testing.assert_allclose(np.asarray(y2.data), A[:, 2:])
+        finally:
+            autograd.training = False
+
+    def test_slice(self):
+        check(lambda x: autograd.slice(x, [1], [3], [0]),
+              lambda x: x[1:3], A)
+
+    def test_gather(self):
+        idx = np.array([0, 2], np.int32)
+        check(lambda x: autograd.gather(x, 1, idx),
+              lambda x: jnp.take(x, jnp.asarray(idx), axis=1), A)
+
+    def test_tile(self):
+        check(lambda x: autograd.tile(x, [2, 1]),
+              lambda x: jnp.tile(x, (2, 1)), A)
+
+    def test_pad(self):
+        check(lambda x: autograd.pad(x, "constant", [1, 0, 0, 2], 1.5),
+              lambda x: jnp.pad(x, ((1, 0), (0, 2)), constant_values=1.5), A)
+
+    def test_upsample(self):
+        x = np.random.randn(1, 2, 3, 3).astype(np.float32)
+        check(lambda v: autograd.upsample(v, "nearest", [1, 1, 2, 2]),
+              lambda v: jnp.repeat(jnp.repeat(v, 2, 2), 2, 3), x)
+
+    def test_depth_space_roundtrip(self):
+        x = np.random.randn(2, 8, 3, 3).astype(np.float32)
+        y = autograd.depth_to_space(t(x, rg=False), 2)
+        z = autograd.space_to_depth(y, 2)
+        np.testing.assert_allclose(np.asarray(z.data), x)
+
+    def test_expand(self):
+        x = np.random.randn(1, 5).astype(np.float32)
+        check(lambda v: autograd.expand(v, (4, 5)),
+              lambda v: jnp.broadcast_to(v, (4, 5)), x)
+
+
+class TestIndexing:
+    def test_where(self):
+        cond = (A > 0).astype(np.float32)
+        check(lambda a, b: autograd.where(t(cond, rg=False), a, b),
+              lambda a, b: jnp.where(jnp.asarray(cond) > 0, a, b), A, B)
+
+    def test_onehot(self):
+        idx = np.array([0, 2, 1], np.float32)
+        y = autograd.onehot(-1, t(idx, rg=False), 3)
+        np.testing.assert_array_equal(np.asarray(y.data), np.eye(3)[[0, 2, 1]])
+
+    def test_embedding(self):
+        W = np.random.randn(7, 3).astype(np.float32)
+        ids = np.array([1, 4, 6], np.float32)
+        autograd.training = True
+        try:
+            w = t(W)
+            y = autograd.embedding(t(ids, rg=False), w)
+            np.testing.assert_allclose(np.asarray(y.data), W[[1, 4, 6]])
+            grads = {id(p): g for p, g in autograd.backward(y)}
+            gw = np.asarray(grads[id(w)].data)
+            assert gw[1].sum() == 3.0 and gw[0].sum() == 0.0
+        finally:
+            autograd.training = False
+
+    def test_cossim(self):
+        check(autograd.cossim,
+              lambda a, b: jnp.sum(a * b, -1) /
+              (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+               + 1e-12), A, B, rtol=1e-4)
+
+    def test_shape_cast_identity(self):
+        y = autograd.shape(t(A, rg=False))
+        np.testing.assert_array_equal(np.asarray(y.data), [4, 5])
+        y = autograd.cast(t(A, rg=False), jnp.int32)
+        assert y.data.dtype == jnp.int32
+        check(autograd.identity, lambda x: x, A)
+
+    def test_scatter_elements(self):
+        x = np.zeros((3, 3), np.float32)
+        idx = np.array([[0, 1, 2]], np.float32)
+        upd = np.array([[1.0, 2.0, 3.0]], np.float32)
+        y = autograd.scatter_elements(t(x, rg=False), t(idx, rg=False),
+                                      t(upd, rg=False), axis=0)
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 0], expect[1, 1], expect[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(np.asarray(y.data), expect)
+
+
+class TestDropout:
+    def test_eval_passthrough(self):
+        autograd.training = False
+        y = autograd.dropout(t(A, rg=False), 0.5)
+        np.testing.assert_array_equal(np.asarray(y.data), A)
+
+    def test_train_scales(self):
+        autograd.training = True
+        try:
+            x = np.ones((1000,), np.float32)
+            y = autograd.dropout(t(x), 0.4)
+            vals = np.asarray(y.data)
+            kept = vals[vals != 0]
+            np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)
+            assert 0.45 < (vals != 0).mean() < 0.75
+        finally:
+            autograd.training = False

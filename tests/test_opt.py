@@ -1,0 +1,140 @@
+"""Optimizer update math vs hand-computed numpy (reference
+test/python/test_opt.py) + scheduler + state roundtrips."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from singa_tpu import opt
+from singa_tpu.tensor import Tensor
+
+
+def mkparam(val):
+    p = Tensor(data=np.asarray(val, np.float32), requires_grad=True,
+               stores_grad=True)
+    p.name = "w"
+    return p
+
+
+def mkgrad(val):
+    return Tensor(data=np.asarray(val, np.float32), requires_grad=False)
+
+
+class TestSGD:
+    def test_vanilla(self):
+        p = mkparam([1.0, 2.0])
+        sgd = opt.SGD(lr=0.1)
+        sgd.apply("w", p, mkgrad([0.5, -0.5]))
+        np.testing.assert_allclose(np.asarray(p.data), [0.95, 2.05])
+
+    def test_weight_decay(self):
+        p = mkparam([1.0])
+        sgd = opt.SGD(lr=0.1, weight_decay=0.1)
+        sgd.apply("w", p, mkgrad([0.0]))
+        np.testing.assert_allclose(np.asarray(p.data), [1.0 - 0.1 * 0.1])
+
+    def test_momentum(self):
+        p = mkparam([0.0])
+        sgd = opt.SGD(lr=1.0, momentum=0.9)
+        g = mkgrad([1.0])
+        sgd.apply("w", p, g)           # buf=1, p=-1
+        sgd.apply("w", p, g)           # buf=1.9, p=-2.9
+        np.testing.assert_allclose(np.asarray(p.data), [-2.9], rtol=1e-6)
+
+    def test_nesterov(self):
+        p = mkparam([0.0])
+        sgd = opt.SGD(lr=1.0, momentum=0.5, nesterov=True)
+        sgd.apply("w", p, mkgrad([1.0]))
+        # buf=1; update = g + m*buf = 1.5
+        np.testing.assert_allclose(np.asarray(p.data), [-1.5])
+
+
+class TestRMSProp:
+    def test_update(self):
+        p = mkparam([1.0])
+        o = opt.RMSProp(lr=0.1, rho=0.9, epsilon=1e-8)
+        o.apply("w", p, mkgrad([2.0]))
+        rms = 0.1 * 4.0
+        expect = 1.0 - 0.1 * 2.0 / np.sqrt(rms + 1e-8)
+        np.testing.assert_allclose(np.asarray(p.data), [expect], rtol=1e-6)
+
+
+class TestAdaGrad:
+    def test_update(self):
+        p = mkparam([1.0])
+        o = opt.AdaGrad(lr=0.1, epsilon=1e-8)
+        o.apply("w", p, mkgrad([2.0]))
+        expect = 1.0 - 0.1 * 2.0 / np.sqrt(4.0 + 1e-8)
+        np.testing.assert_allclose(np.asarray(p.data), [expect], rtol=1e-6)
+
+
+class TestAdam:
+    def test_update(self):
+        p = mkparam([1.0])
+        o = opt.Adam(lr=0.01, beta_1=0.9, beta_2=0.999, epsilon=1e-8)
+        g = 2.0
+        o.apply("w", p, mkgrad([g]))
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        expect = 1.0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(np.asarray(p.data), [expect], rtol=1e-5)
+
+    def test_amsgrad_monotone_vmax(self):
+        p = mkparam([1.0])
+        o = opt.Adam(lr=0.01, amsgrad=True)
+        o.apply("w", p, mkgrad([5.0]))
+        o.step()
+        vmax1 = float(o._aux["w:vmax"].data[0])
+        o.apply("w", p, mkgrad([0.1]))
+        vmax2 = float(o._aux["w:vmax"].data[0])
+        assert vmax2 >= vmax1
+
+
+class TestSchedulers:
+    def test_constant(self):
+        s = opt.Constant(0.25)
+        assert float(s(jnp.asarray(10.0))) == 0.25
+
+    def test_exponential(self):
+        s = opt.ExponentialDecay(1.0, decay_steps=10, decay_rate=0.5)
+        np.testing.assert_allclose(float(s(jnp.asarray(10.0))), 0.5)
+        np.testing.assert_allclose(float(s(jnp.asarray(5.0))),
+                                   0.5 ** 0.5, rtol=1e-6)
+
+    def test_exponential_staircase(self):
+        s = opt.ExponentialDecay(1.0, 10, 0.5, staircase=True)
+        np.testing.assert_allclose(float(s(jnp.asarray(9.0))), 1.0)
+        np.testing.assert_allclose(float(s(jnp.asarray(19.0))), 0.5)
+
+    def test_optimizer_uses_schedule(self):
+        o = opt.SGD(lr=opt.ExponentialDecay(1.0, 1, 0.5, staircase=True))
+        p = mkparam([0.0])
+        o.apply("w", p, mkgrad([1.0]))   # lr=1 at step 0
+        o.step()
+        o.apply("w", p, mkgrad([1.0]))   # lr=0.5 at step 1
+        np.testing.assert_allclose(np.asarray(p.data), [-1.5])
+
+
+class TestStates:
+    def test_roundtrip(self):
+        o = opt.Adam(lr=0.01)
+        p = mkparam([1.0, 2.0])
+        o.apply("w", p, mkgrad([0.1, 0.2]))
+        o.step()
+        states = o.get_states()
+        o2 = opt.Adam(lr=0.01)
+        o2.set_states(states)
+        assert float(o2.step_counter.data) == 1.0
+        np.testing.assert_allclose(np.asarray(o2._aux["w:m"].data),
+                                   np.asarray(o._aux["w:m"].data))
+
+    def test_dist_states_roundtrip(self):
+        d = opt.DistOpt(opt.SGD(lr=0.1), world_size=1)
+        p = mkparam([1.0])
+        d.opt.apply("w", p, mkgrad([1.0]))
+        d.step()
+        s = d.get_states()
+        d2 = opt.DistOpt(opt.SGD(lr=0.1), world_size=1)
+        d2.set_states(s)
+        assert float(d2.step_counter.data) == 1.0

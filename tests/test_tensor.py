@@ -1,0 +1,174 @@
+"""Tensor surface: creation, numpy interop, math free functions, random
+fillers (reference test/python/test_tensor.py)."""
+
+import numpy as np
+
+from singa_tpu import device, tensor
+from singa_tpu.tensor import Tensor
+
+
+DEV = device.create_cpu_device()
+
+
+class TestCreation:
+    def test_shape_ctor(self):
+        t = Tensor(shape=(2, 3), device=DEV)
+        assert t.shape == (2, 3)
+        assert t.size() == 6
+        np.testing.assert_array_equal(t.numpy(), np.zeros((2, 3)))
+
+    def test_from_numpy(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = tensor.from_numpy(a)
+        np.testing.assert_array_equal(t.numpy(), a)
+
+    def test_zeros_ones(self):
+        np.testing.assert_array_equal(tensor.zeros((2, 2)).numpy(),
+                                      np.zeros((2, 2)))
+        np.testing.assert_array_equal(tensor.ones((2, 2)).numpy(),
+                                      np.ones((2, 2)))
+
+    def test_astype(self):
+        t = tensor.ones((2, 2))
+        ti = t.as_type(tensor.int32)
+        assert "int32" in str(ti.dtype)
+
+
+class TestNumpyInterop:
+    def test_copy_from_numpy(self):
+        t = Tensor(shape=(2, 2), device=DEV)
+        t.copy_from_numpy(np.full((2, 2), 7.0, np.float32))
+        np.testing.assert_array_equal(t.numpy(), 7.0)
+
+    def test_to_numpy_roundtrip(self):
+        a = np.random.randn(5).astype(np.float32)
+        np.testing.assert_array_equal(tensor.to_numpy(tensor.from_numpy(a)),
+                                      a)
+
+    def test_item(self):
+        t = tensor.from_numpy(np.asarray(3.5, np.float32))
+        assert t.item() == 3.5
+
+
+class TestMath:
+    def test_operators(self):
+        a = tensor.from_numpy(np.array([1.0, 2.0], np.float32))
+        b = tensor.from_numpy(np.array([3.0, 4.0], np.float32))
+        np.testing.assert_array_equal((a + b).numpy(), [4, 6])
+        np.testing.assert_array_equal((a - b).numpy(), [-2, -2])
+        np.testing.assert_array_equal((a * b).numpy(), [3, 8])
+        np.testing.assert_allclose((a / b).numpy(), [1 / 3, 0.5], rtol=1e-6)
+        np.testing.assert_array_equal((-a).numpy(), [-1, -2])
+        np.testing.assert_array_equal((a ** 2).numpy(), [1, 4])
+        np.testing.assert_array_equal((a + 1.0).numpy(), [2, 3])
+
+    def test_inplace_ops(self):
+        a = tensor.from_numpy(np.array([1.0, 2.0], np.float32))
+        a += 1.0
+        np.testing.assert_array_equal(a.numpy(), [2, 3])
+        a *= 2.0
+        np.testing.assert_array_equal(a.numpy(), [4, 6])
+
+    def test_matmul_mult(self):
+        A = np.random.randn(3, 4).astype(np.float32)
+        B = np.random.randn(4, 2).astype(np.float32)
+        ta, tb = tensor.from_numpy(A), tensor.from_numpy(B)
+        np.testing.assert_allclose(tensor.mult(ta, tb).numpy(), A @ B,
+                                   rtol=1e-5)
+        np.testing.assert_allclose((ta @ tb).numpy(), A @ B, rtol=1e-5)
+
+    def test_free_functions(self):
+        a = tensor.from_numpy(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        assert tensor.sum(a) == 10.0
+        np.testing.assert_array_equal(tensor.sum(a, axis=0).numpy(), [4, 6])
+        np.testing.assert_allclose(float(tensor.average(a)), 2.5)
+        np.testing.assert_allclose(
+            tensor.softmax(a).numpy().sum(axis=1), [1.0, 1.0], rtol=1e-6)
+        np.testing.assert_array_equal(tensor.relu(
+            tensor.from_numpy(np.array([-1.0, 2.0], np.float32))).numpy(),
+            [0, 2])
+
+    def test_axpy(self):
+        x = tensor.from_numpy(np.array([1.0, 1.0], np.float32))
+        y = tensor.from_numpy(np.array([1.0, 2.0], np.float32))
+        tensor.axpy(2.0, x, y)
+        np.testing.assert_array_equal(y.numpy(), [3, 4])
+
+    def test_einsum_tensordot(self):
+        A = np.random.randn(3, 4).astype(np.float32)
+        B = np.random.randn(4, 5).astype(np.float32)
+        out = tensor.einsum("ij,jk->ik", tensor.from_numpy(A),
+                            tensor.from_numpy(B))
+        np.testing.assert_allclose(out.numpy(), A @ B, rtol=1e-5)
+        out = tensor.tensordot(tensor.from_numpy(A), tensor.from_numpy(B),
+                               axes=([1], [0]))
+        np.testing.assert_allclose(out.numpy(), A @ B, rtol=1e-5)
+
+    def test_row_column_helpers(self):
+        M = tensor.from_numpy(np.zeros((2, 3), np.float32))
+        v = tensor.from_numpy(np.array([1.0, 2.0, 3.0], np.float32))
+        out = tensor.add_row(1.0, v, 1.0, M)
+        np.testing.assert_array_equal(out.numpy(), [[1, 2, 3], [1, 2, 3]])
+        np.testing.assert_array_equal(tensor.sum_rows(out).numpy(),
+                                      [2, 4, 6])
+
+    def test_norms(self):
+        a = tensor.from_numpy(np.array([3.0, 4.0], np.float32))
+        np.testing.assert_allclose(a.l2(), 2.5, rtol=1e-5)
+        np.testing.assert_allclose(a.l1(), 3.5, rtol=1e-6)
+
+
+class TestShape:
+    def test_reshape_transpose(self):
+        a = tensor.from_numpy(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert a.reshape((3, 2)).shape == (3, 2)
+        assert a.transpose().shape == (3, 2)
+        assert tensor.reshape(a, (6,)).shape == (6,)
+
+    def test_getitem(self):
+        a = tensor.from_numpy(np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_array_equal(a[0].numpy(), [0, 1, 2])
+
+    def test_repeat_concat(self):
+        a = tensor.from_numpy(np.array([[1.0, 2.0]], np.float32))
+        assert tensor.repeat(a, 3, axis=0).shape == (3, 2)
+        c = tensor.concatenate([a, a], axis=0)
+        assert c.shape == (2, 2)
+
+    def test_clone_independent(self):
+        a = tensor.from_numpy(np.array([1.0], np.float32))
+        b = a.clone()
+        a += 1.0
+        np.testing.assert_array_equal(b.numpy(), [1.0])
+
+
+class TestRandomFillers:
+    def test_gaussian(self):
+        t = Tensor(shape=(5000,), device=DEV)
+        t.gaussian(1.0, 2.0)
+        v = t.numpy()
+        assert abs(v.mean() - 1.0) < 0.15
+        assert abs(v.std() - 2.0) < 0.15
+
+    def test_uniform(self):
+        t = Tensor(shape=(5000,), device=DEV)
+        t.uniform(-1.0, 1.0)
+        v = t.numpy()
+        assert v.min() >= -1.0 and v.max() <= 1.0
+        assert abs(v.mean()) < 0.1
+
+    def test_bernoulli(self):
+        t = Tensor(shape=(5000,), device=DEV)
+        t.bernoulli(0.3)
+        v = t.numpy()
+        assert set(np.unique(v)) <= {0.0, 1.0}
+        assert 0.2 < v.mean() < 0.4
+
+    def test_seed_reproducible(self):
+        DEV.SetRandSeed(7)
+        t1 = Tensor(shape=(10,), device=DEV)
+        t1.gaussian(0, 1)
+        DEV.SetRandSeed(7)
+        t2 = Tensor(shape=(10,), device=DEV)
+        t2.gaussian(0, 1)
+        np.testing.assert_array_equal(t1.numpy(), t2.numpy())
