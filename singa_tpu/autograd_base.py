@@ -18,10 +18,15 @@ from . import device as device_mod
 
 
 class _Context:
-    """Global autograd mode flags (reference: autograd.training module var)."""
+    """Global autograd mode flags (reference: autograd.training module var).
+
+    ``recording`` tapes ops without training semantics (ONNX export traces
+    the inference path: BN uses running stats, dropout is identity).
+    """
 
     def __init__(self):
         self.training = False
+        self.recording = False
 
 
 CTX = _Context()
@@ -80,7 +85,7 @@ class Operator:
         raws = [_raw(x) for x in xs]
         self.dev = next((x.device for x in xs if isinstance(x, Tensor)),
                         device_mod.get_default_device())
-        tape = (CTX.training and self.differentiable and
+        tape = ((CTX.training or CTX.recording) and self.differentiable and
                 any(isinstance(x, Tensor) and x.requires_grad for x in xs))
         if tape and not self._has_custom_backward():
             ys, self._vjp_fn = jax.vjp(self.forward, *raws)
@@ -110,7 +115,11 @@ class Operator:
                     self.src.append((x.creator, id(x),
                                      x if x.stores_grad else None, True))
                 else:
-                    self.src.append((None, id(x), None, False))
+                    # keep the constant value reachable (ONNX export emits
+                    # it as an initializer); backward ignores this entry
+                    self.src.append((None, id(x),
+                                     x if isinstance(x, Tensor) else None,
+                                     False))
             self.y_ids = tuple(id(t) for t in outs)
             self.y_shapes = tuple(y.shape for y in ys_t)
             self.y_dtypes = tuple(y.dtype for y in ys_t)

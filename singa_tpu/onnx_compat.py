@@ -1,0 +1,245 @@
+"""ONNX interop layer: real ``onnx`` package when installed, else the
+bundled wire-compatible protos (singa_tpu/onnx_proto/onnx.proto).
+
+Exposes the tiny slice of the onnx python API that ``singa_tpu.sonnx``
+needs — ``helper.make_*``, ``numpy_helper.to_array/from_array``,
+``TensorProto`` dtype ids, ``load/save`` — with identical serialized bytes
+either way, so models exported here open in stock onnx tooling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # prefer the real package when present
+    import onnx as _onnx
+    from onnx import helper, numpy_helper  # noqa: F401
+    TensorProto = _onnx.TensorProto
+    AttributeProto = _onnx.AttributeProto
+    ModelProto = _onnx.ModelProto
+    GraphProto = _onnx.GraphProto
+    NodeProto = _onnx.NodeProto
+    load = _onnx.load
+    save = _onnx.save
+    HAS_REAL_ONNX = True
+except ImportError:
+    from . import onnx_proto as _pb
+    TensorProto = _pb.TensorProto
+    AttributeProto = _pb.AttributeProto
+    ModelProto = _pb.ModelProto
+    GraphProto = _pb.GraphProto
+    NodeProto = _pb.NodeProto
+    HAS_REAL_ONNX = False
+
+    _NP_TO_ONNX = {
+        np.dtype(np.float32): TensorProto.FLOAT,
+        np.dtype(np.uint8): TensorProto.UINT8,
+        np.dtype(np.int8): TensorProto.INT8,
+        np.dtype(np.uint16): TensorProto.UINT16,
+        np.dtype(np.int16): TensorProto.INT16,
+        np.dtype(np.int32): TensorProto.INT32,
+        np.dtype(np.int64): TensorProto.INT64,
+        np.dtype(np.bool_): TensorProto.BOOL,
+        np.dtype(np.float16): TensorProto.FLOAT16,
+        np.dtype(np.float64): TensorProto.DOUBLE,
+        np.dtype(np.uint32): TensorProto.UINT32,
+        np.dtype(np.uint64): TensorProto.UINT64,
+    }
+    _ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+    class _Helper:
+        """make_* builders mirroring onnx.helper semantics."""
+
+        @staticmethod
+        def np_dtype_to_tensor_dtype(dtype):
+            return _NP_TO_ONNX[np.dtype(dtype)]
+
+        @staticmethod
+        def tensor_dtype_to_np_dtype(tensor_dtype):
+            return _ONNX_TO_NP[tensor_dtype]
+
+        @staticmethod
+        def make_attribute(name, value):
+            a = AttributeProto(name=name)
+            if isinstance(value, float):
+                a.f = value
+                a.type = AttributeProto.FLOAT
+            elif isinstance(value, bool):
+                a.i = int(value)
+                a.type = AttributeProto.INT
+            elif isinstance(value, (int, np.integer)):
+                a.i = int(value)
+                a.type = AttributeProto.INT
+            elif isinstance(value, str):
+                a.s = value.encode("utf-8")
+                a.type = AttributeProto.STRING
+            elif isinstance(value, bytes):
+                a.s = value
+                a.type = AttributeProto.STRING
+            elif isinstance(value, TensorProto):
+                a.t.CopyFrom(value)
+                a.type = AttributeProto.TENSOR
+            elif isinstance(value, (list, tuple, np.ndarray)):
+                vals = list(value)
+                if all(isinstance(v, (int, np.integer)) for v in vals):
+                    a.ints.extend(int(v) for v in vals)
+                    a.type = AttributeProto.INTS
+                elif all(isinstance(v, (int, float, np.floating, np.integer))
+                         for v in vals):
+                    a.floats.extend(float(v) for v in vals)
+                    a.type = AttributeProto.FLOATS
+                elif all(isinstance(v, (str, bytes)) for v in vals):
+                    a.strings.extend(
+                        v.encode("utf-8") if isinstance(v, str) else v
+                        for v in vals)
+                    a.type = AttributeProto.STRINGS
+                else:
+                    raise ValueError(
+                        f"unsupported attribute list for {name}: {vals!r}")
+            else:
+                raise ValueError(
+                    f"unsupported attribute value for {name}: {value!r}")
+            return a
+
+        @classmethod
+        def make_node(cls, op_type, inputs, outputs, name=None, domain=None,
+                      **attrs):
+            n = NodeProto(op_type=op_type, input=list(inputs),
+                          output=list(outputs))
+            if name:
+                n.name = name
+            if domain:
+                n.domain = domain
+            for k in sorted(attrs):
+                if attrs[k] is not None:
+                    n.attribute.append(cls.make_attribute(k, attrs[k]))
+            return n
+
+        @staticmethod
+        def make_tensor_value_info(name, elem_type, shape):
+            v = _pb.ValueInfoProto(name=name)
+            v.type.tensor_type.elem_type = elem_type
+            if shape is not None:
+                for d in shape:
+                    dim = v.type.tensor_type.shape.dim.add()
+                    if isinstance(d, (int, np.integer)):
+                        dim.dim_value = int(d)
+                    elif d is not None:
+                        dim.dim_param = str(d)
+            return v
+
+        @staticmethod
+        def make_tensor(name, data_type, dims, vals, raw=False):
+            t = TensorProto(name=name, data_type=data_type,
+                            dims=list(dims))
+            if raw:
+                t.raw_data = vals if isinstance(vals, bytes) else bytes(vals)
+            else:
+                np_dtype = _ONNX_TO_NP[data_type]
+                arr = np.asarray(vals, dtype=np_dtype).ravel()
+                t.raw_data = arr.tobytes()
+            return t
+
+        @staticmethod
+        def make_graph(nodes, name, inputs, outputs, initializer=None,
+                       value_info=None):
+            g = GraphProto(name=name)
+            g.node.extend(nodes)
+            g.input.extend(inputs)
+            g.output.extend(outputs)
+            if initializer:
+                g.initializer.extend(initializer)
+            if value_info:
+                g.value_info.extend(value_info)
+            return g
+
+        @staticmethod
+        def make_operatorsetid(domain, version):
+            return _pb.OperatorSetIdProto(domain=domain, version=version)
+
+        @staticmethod
+        def make_model(graph, producer_name="singa_tpu",
+                       opset_imports=None, ir_version=6, **kwargs):
+            m = ModelProto(ir_version=ir_version,
+                           producer_name=producer_name)
+            m.graph.CopyFrom(graph)
+            if opset_imports is None:
+                opset_imports = [
+                    _pb.OperatorSetIdProto(domain="", version=11)]
+            m.opset_import.extend(opset_imports)
+            return m
+
+        @staticmethod
+        def get_attribute_value(attr):
+            return _get_attribute_value(attr)
+
+    helper = _Helper()
+
+    class _NumpyHelper:
+        @staticmethod
+        def from_array(arr, name=None):
+            arr = np.asarray(arr)
+            t = TensorProto(data_type=_NP_TO_ONNX[arr.dtype],
+                            dims=list(arr.shape),
+                            raw_data=np.ascontiguousarray(arr).tobytes())
+            if name:
+                t.name = name
+            return t
+
+        @staticmethod
+        def to_array(t):
+            dtype = _ONNX_TO_NP[t.data_type]
+            shape = tuple(t.dims)
+            if t.raw_data:
+                return np.frombuffer(t.raw_data, dtype=dtype).reshape(shape)
+            if t.float_data:
+                return np.asarray(t.float_data, np.float32).astype(
+                    dtype).reshape(shape)
+            if t.int64_data:
+                return np.asarray(t.int64_data, np.int64).astype(
+                    dtype).reshape(shape)
+            if t.int32_data:
+                return np.asarray(t.int32_data, np.int32).astype(
+                    dtype).reshape(shape)
+            if t.double_data:
+                return np.asarray(t.double_data, np.float64).astype(
+                    dtype).reshape(shape)
+            return np.zeros(shape, dtype)
+
+    numpy_helper = _NumpyHelper()
+
+    def load(path):
+        m = ModelProto()
+        with open(path, "rb") as f:
+            m.ParseFromString(f.read())
+        return m
+
+    def save(model, path):
+        with open(path, "wb") as f:
+            f.write(model.SerializeToString())
+
+
+def _get_attribute_value(attr):
+    """AttributeProto -> python value (works for both backends)."""
+    AT = AttributeProto
+    if attr.type == AT.FLOAT:
+        return attr.f
+    if attr.type == AT.INT:
+        return attr.i
+    if attr.type == AT.STRING:
+        return attr.s.decode("utf-8") if isinstance(attr.s, bytes) else attr.s
+    if attr.type == AT.TENSOR:
+        return attr.t
+    if attr.type == AT.FLOATS:
+        return list(attr.floats)
+    if attr.type == AT.INTS:
+        return list(attr.ints)
+    if attr.type == AT.STRINGS:
+        return [s.decode("utf-8") if isinstance(s, bytes) else s
+                for s in attr.strings]
+    raise ValueError(f"unsupported attribute type {attr.type}")
+
+
+def attribute_dict(node):
+    """All of a node's attributes as a name->value dict."""
+    return {a.name: _get_attribute_value(a) for a in node.attribute}

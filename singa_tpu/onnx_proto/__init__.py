@@ -1,0 +1,12 @@
+"""Wire-compatible minimal ONNX protos (see onnx.proto)."""
+
+from .onnx_pb2 import (AttributeProto, GraphProto, ModelProto, NodeProto,
+                       OperatorSetIdProto, StringStringEntryProto,
+                       TensorProto, TensorShapeProto, TypeProto,
+                       ValueInfoProto)
+
+__all__ = [
+    "AttributeProto", "GraphProto", "ModelProto", "NodeProto",
+    "OperatorSetIdProto", "StringStringEntryProto", "TensorProto",
+    "TensorShapeProto", "TypeProto", "ValueInfoProto",
+]

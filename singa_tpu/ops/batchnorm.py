@@ -95,6 +95,10 @@ def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,
         m = h.factor
         running_mean.data = m * running_mean.data + (1 - m) * batch_mean
         running_var.data = m * running_var.data + (1 - m) * batch_var
-        return _BatchNorm2d(handle)(x, scale, bias)
-    return _BatchNorm2dInference(handle)(x, scale, bias,
-                                         running_mean, running_var)
+        op, args = _BatchNorm2d(handle), (x, scale, bias)
+    else:
+        op, args = _BatchNorm2dInference(handle), \
+            (x, scale, bias, running_mean, running_var)
+    # keep references for ONNX export (BatchNormalization's mean/var inputs)
+    op.running_mean, op.running_var = running_mean, running_var
+    return op(*args)

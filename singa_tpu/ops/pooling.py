@@ -23,12 +23,23 @@ def _pair(v):
 
 
 class PoolingHandle:
-    """Static pooling config (reference PoolingHandle pooling.h:40-72)."""
+    """Static pooling config (reference PoolingHandle pooling.h:40-72).
+
+    ``padding`` may be an int, an (ph, pw) pair, or an explicit
+    ((ph0, ph1), (pw0, pw1)) for asymmetric padding (ONNX import).
+    """
 
     def __init__(self, x, kernel_size, stride=None, padding=0, is_max=True):
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride if stride is not None else kernel_size)
-        self.padding = _pair(padding)
+        if (isinstance(padding, (tuple, list)) and len(padding) == 2
+                and isinstance(padding[0], (tuple, list))):
+            self.pad_pairs = tuple(tuple(int(v) for v in p) for p in padding)
+            self.padding = (self.pad_pairs[0][0], self.pad_pairs[1][0])
+        else:
+            ph, pw = _pair(padding)
+            self.pad_pairs = ((ph, ph), (pw, pw))
+            self.padding = (ph, pw)
         self.is_max_pooling = bool(is_max)
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.batchsize = int(xs[0])
@@ -37,9 +48,9 @@ class PoolingHandle:
             self.height, self.width = int(xs[2]), int(xs[3])
             kh, kw = self.kernel_size
             sh, sw = self.stride
-            ph, pw = self.padding
-            self.pooled_height = (self.height + 2 * ph - kh) // sh + 1
-            self.pooled_width = (self.width + 2 * pw - kw) // sw + 1
+            (p0, p1), (q0, q1) = self.pad_pairs
+            self.pooled_height = (self.height + p0 + p1 - kh) // sh + 1
+            self.pooled_width = (self.width + q0 + q1 - kw) // sw + 1
 
 
 class _Pooling2d(Operator):
@@ -51,10 +62,9 @@ class _Pooling2d(Operator):
         h = self.handle
         kh, kw = h.kernel_size
         sh, sw = h.stride
-        ph, pw = h.padding
         dims = (1, 1, kh, kw)
         strides = (1, 1, sh, sw)
-        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        pads = ((0, 0), (0, 0), h.pad_pairs[0], h.pad_pairs[1])
         if h.is_max_pooling:
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
                 else jnp.iinfo(x.dtype).min

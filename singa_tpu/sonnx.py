@@ -1,0 +1,758 @@
+"""ONNX import/export for the TPU-native framework.
+
+Capability parity with the reference ONNX bridge (python/singa/sonnx.py):
+
+- :class:`SingaFrontend` — export a taped computation to an ONNX
+  ``ModelProto`` (reference SingaFrontend, sonnx.py:75-1035);
+- :class:`SingaBackend` / :class:`SingaRep` — import an ONNX model and run
+  (or fine-tune) it on our ops (reference SingaBackend.prepare sonnx.py:1911,
+  SingaRep.run :1951);
+- :class:`SONNXModel` — wrap an imported graph as a trainable
+  :class:`~singa_tpu.model.Model` (reference SONNXModel sonnx.py:2196).
+
+TPU-first redesign: the reference converts node-by-node into SWIG handles;
+here every imported node lowers to our jax-backed autograd ops, so an
+imported graph jits into a single XLA computation exactly like a native
+model. Works against the real ``onnx`` package when installed, else the
+bundled wire-compatible protos (singa_tpu/onnx_proto).
+"""
+
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from . import autograd
+from .autograd_base import CTX, Dummy, Operator
+from .tensor import Tensor
+from . import device as device_mod
+from .onnx_compat import (TensorProto, helper, numpy_helper, load, save,
+                          attribute_dict)
+from .ops.conv import ConvHandle, conv2d
+from .ops.pooling import PoolingHandle, pooling_2d, globalaveragepool
+from .ops.batchnorm import BatchNormHandle, batchnorm_2d
+
+
+def _sanitize(name):
+    return name.replace("#", "_").replace(":", "_")
+
+
+_DTYPE_TO_ONNX = {
+    "float32": TensorProto.FLOAT, "float64": TensorProto.DOUBLE,
+    "float16": TensorProto.FLOAT16, "bfloat16": TensorProto.BFLOAT16,
+    "int32": TensorProto.INT32, "int64": TensorProto.INT64,
+    "int8": TensorProto.INT8, "uint8": TensorProto.UINT8,
+    "bool": TensorProto.BOOL,
+}
+
+
+def _onnx_dtype(t):
+    return _DTYPE_TO_ONNX.get(str(np.dtype(t.dtype)), TensorProto.FLOAT)
+
+
+# ===========================================================================
+# Frontend: tape -> ONNX
+# ===========================================================================
+
+class SingaFrontend:
+    """Exports a taped forward computation to ONNX (reference sonnx.py:75).
+
+    Usage::
+
+        x.requires_grad = True      # record input edges on the tape
+        autograd.training = True
+        y = model.forward(x)
+        onnx_model = SingaFrontend.singa_to_onnx_model([x], [y], "net")
+    """
+
+    _target_opset_version = 11
+
+    # our Operator class name -> onnx op_type
+    _rename_operators = {
+        "_Conv2d": "Conv",
+        "ReLU": "Relu",
+        "_Pooling2d": None,  # resolved to MaxPool/AveragePool per handle
+        "SoftMax": "Softmax",
+        "Sigmoid": "Sigmoid",
+        "Add": "Add",
+        "Matmul": "MatMul",
+        "_BatchNorm2d": "BatchNormalization",
+        "_BatchNorm2dInference": "BatchNormalization",
+        "Concat": "Concat",
+        "Flatten": "Flatten",
+        "AddBias": "Add",
+        "Gemm": "Gemm",
+        "Reshape": "Reshape",
+        "Sum": "Sum",
+        "Cos": "Cos", "Cosh": "Cosh", "Sin": "Sin", "Sinh": "Sinh",
+        "Tan": "Tan", "Tanh": "Tanh", "Acos": "Acos", "Acosh": "Acosh",
+        "Asin": "Asin", "Asinh": "Asinh", "Atan": "Atan", "Atanh": "Atanh",
+        "SeLU": "Selu", "Elu": "Elu", "Equal": "Equal", "Less": "Less",
+        "Sign": "Sign", "Div": "Div", "Sub": "Sub", "Sqrt": "Sqrt",
+        "Log": "Log", "Greater": "Greater", "HardSigmoid": "HardSigmoid",
+        "Identity": "Identity", "SoftPlus": "Softplus",
+        "SoftSign": "Softsign", "Mean": "Mean", "Pow": "Pow",
+        "Clip": "Clip", "PRelu": "PRelu", "Mul": "Mul",
+        "Transpose": "Transpose", "Max": "Max", "Min": "Min",
+        "Shape": "Shape", "And": "And", "Or": "Or", "Xor": "Xor",
+        "Not": "Not", "Negative": "Neg", "Reciprocal": "Reciprocal",
+        "ConstantOfShape": "ConstantOfShape", "Dropout": "Dropout",
+        "ReduceSum": "ReduceSum", "ReduceMean": "ReduceMean",
+        "LeakyRelu": "LeakyRelu", "GlobalAveragePool": "GlobalAveragePool",
+        "Squeeze": "Squeeze", "Unsqueeze": "Unsqueeze", "Slice": "Slice",
+        "Ceil": "Ceil", "Floor": "Floor", "Abs": "Abs", "Split": "Split",
+        "Gather": "Gather", "Tile": "Tile", "NonZero": "NonZero",
+        "Cast": "Cast", "OneHot": "OneHot", "Erf": "Erf",
+        "Where": "Where", "Expand": "Expand", "Pad": "Pad",
+        "UpSample": "Upsample", "DepthToSpace": "DepthToSpace",
+        "SpaceToDepth": "SpaceToDepth", "Embedding": "Gather",
+        "ScatterElements": "ScatterElements",
+    }
+
+    @classmethod
+    def _topo_ops(cls, ys):
+        """Reverse tape -> topological op order (inputs first)."""
+        visited = set()
+        order = []
+
+        for y in ys:
+            stack = [(y.creator, False)]
+            while stack:
+                op, expanded = stack.pop()
+                if op is None:
+                    continue
+                if expanded:
+                    order.append(op)
+                    continue
+                if id(op) in visited:
+                    continue
+                visited.add(id(op))
+                stack.append((op, True))
+                for (src_op, _xid, _t, _req) in op.src:
+                    if src_op is not None and id(src_op) not in visited:
+                        stack.append((src_op, False))
+        return order
+
+    @classmethod
+    def _node_attrs_and_extra(cls, op, op_name, input_names, extras):
+        """(op_type, attrs dict); may append extra initializer inputs."""
+        ty = type(op).__name__
+        attrs = {}
+
+        def extra_int64(suffix, values):
+            nm = f"{op_name}_{suffix}"
+            extras.append(numpy_helper.from_array(
+                np.asarray(values, np.int64), nm))
+            input_names.append(nm)
+
+        if ty == "_Conv2d":
+            h = op.handle
+            (p0, p1), (q0, q1) = h.padding
+            attrs = {"kernel_shape": list(h.kernel_size),
+                     "strides": list(h.stride),
+                     "dilations": list(h.dilation),
+                     "group": h.group,
+                     "pads": [p0, q0, p1, q1]}
+            return "Conv", attrs
+        if ty == "_Pooling2d":
+            h = op.handle
+            (p0, p1), (q0, q1) = h.pad_pairs
+            attrs = {"kernel_shape": list(h.kernel_size),
+                     "strides": list(h.stride),
+                     "pads": [p0, q0, p1, q1]}
+            if h.is_max_pooling:
+                return "MaxPool", attrs
+            attrs["count_include_pad"] = 1
+            return "AveragePool", attrs
+        if ty in ("_BatchNorm2d", "_BatchNorm2dInference"):
+            h = op.handle
+            return "BatchNormalization", {"epsilon": float(h.eps),
+                                          "momentum": float(h.factor)}
+        if ty == "Gemm":
+            return "Gemm", {"alpha": float(op.alpha), "beta": float(op.beta),
+                            "transA": int(op.transA),
+                            "transB": int(op.transB)}
+        if ty == "SoftMax":
+            return "Softmax", {"axis": op.axis}
+        if ty == "Concat":
+            return "Concat", {"axis": op.axis}
+        if ty == "Flatten":
+            return "Flatten", {"axis": op.axis}
+        if ty == "Reshape":
+            extra_int64("shape", op.shape)
+            return "Reshape", {}
+        if ty == "Transpose":
+            return "Transpose", {"perm": list(op.perm)} if op.perm else {}
+        if ty == "Squeeze":
+            ax = op.axis
+            if ax is None:
+                return "Squeeze", {}
+            return "Squeeze", {"axes": list(ax) if isinstance(
+                ax, (tuple, list)) else [ax]}
+        if ty == "Unsqueeze":
+            return "Unsqueeze", {"axes": list(op.axis)}
+        if ty == "Slice":
+            extra_int64("starts", op.starts)
+            extra_int64("ends", op.ends)
+            if op.axes is not None:
+                extra_int64("axes", op.axes)
+            if op.steps is not None:
+                if op.axes is None:
+                    extra_int64("axes", list(range(len(op.starts))))
+                extra_int64("steps", op.steps)
+            return "Slice", {}
+        if ty == "Clip":
+            for suffix, v in (("min", op.min), ("max", op.max)):
+                if v is not None:
+                    nm = f"{op_name}_{suffix}"
+                    extras.append(numpy_helper.from_array(
+                        np.asarray(v, np.float32), nm))
+                    input_names.append(nm)
+                else:
+                    input_names.append("")
+            return "Clip", {}
+        if ty in ("ReduceSum", "ReduceMean"):
+            attrs = {"keepdims": int(op.keepdims)}
+            if op.axes is not None:
+                attrs["axes"] = list(op.axes)
+            return ty, attrs
+        if ty == "LeakyRelu":
+            return "LeakyRelu", {"alpha": float(op.a)}
+        if ty == "Elu":
+            return "Elu", {"alpha": float(op.alpha)}
+        if ty == "SeLU":
+            return "Selu", {"alpha": float(op.alpha),
+                            "gamma": float(op.gamma)}
+        if ty == "HardSigmoid":
+            return "HardSigmoid", {"alpha": float(op.alpha),
+                                   "beta": float(op.gamma)}
+        if ty == "Dropout":
+            return "Dropout", {"ratio": float(op.ratio)}
+        if ty == "Split":
+            attrs = {"axis": op.axis}
+            if op.parts is not None:
+                attrs["split"] = list(op.parts)
+            return "Split", attrs
+        if ty == "Gather":
+            return "Gather", {"axis": op.axis}
+        if ty == "Embedding":
+            # our Embedding(x_ids, W) == onnx Gather(W, ids) on axis 0
+            input_names.reverse()
+            return "Gather", {"axis": 0}
+        if ty == "Tile":
+            extra_int64("repeats", op.repeats)
+            return "Tile", {}
+        if ty == "Expand":
+            extra_int64("shape", op.shape)
+            return "Expand", {}
+        if ty == "Pad":
+            extra_int64("pads", op.pads)
+            if op.mode == "constant":
+                nm = f"{op_name}_value"
+                extras.append(numpy_helper.from_array(
+                    np.asarray(op.constant, np.float32), nm))
+                input_names.append(nm)
+            return "Pad", {"mode": op.mode}
+        if ty == "UpSample":
+            nm = f"{op_name}_scales"
+            extras.append(numpy_helper.from_array(
+                np.asarray(op.scales, np.float32), nm))
+            input_names.append(nm)
+            return "Upsample", {"mode": "nearest"}
+        if ty == "ConstantOfShape":
+            attrs["value"] = numpy_helper.from_array(
+                np.asarray([op.value], np.float32), "value")
+            return "ConstantOfShape", attrs
+        if ty == "Cast":
+            return "Cast", {
+                "to": int(helper.np_dtype_to_tensor_dtype(np.dtype(op.to)))}
+        if ty == "OneHot":
+            extra_int64("depth", op.depth)
+            nm = f"{op_name}_values"
+            extras.append(numpy_helper.from_array(
+                np.asarray(op.values, np.float32), nm))
+            input_names.append(nm)
+            return "OneHot", {"axis": op.axis}
+        if ty in ("DepthToSpace", "SpaceToDepth"):
+            attrs = {"blocksize": op.b}
+            if ty == "DepthToSpace":
+                attrs["mode"] = op.mode
+            return ty, attrs
+        if ty == "ScatterElements":
+            return "ScatterElements", {"axis": op.axis}
+        onnx_ty = cls._rename_operators.get(ty)
+        if onnx_ty is None:
+            raise NotImplementedError(
+                f"cannot export op {ty} to ONNX")
+        return onnx_ty, attrs
+
+    @classmethod
+    def singa_to_onnx_graph(cls, inputs, y, model_name="sonnx"):
+        ys = y if isinstance(y, (list, tuple)) else [y]
+        ops = cls._topo_ops(ys)
+
+        input_ids = {id(t): i for i, t in enumerate(inputs)}
+        names = {}          # tensor-id -> value name
+        initializers = []
+        graph_inputs = []
+        nodes = []
+
+        # Dummy leaves: user inputs, params (stores_grad), or constants
+        for op in ops:
+            if not isinstance(op, Dummy):
+                continue
+            t = op.tensor
+            if id(t) in input_ids:
+                nm = t.name or f"input_{input_ids[id(t)]}"
+                names[id(t)] = nm
+            else:
+                nm = _sanitize(t.name or f"const_{len(initializers)}")
+                names[id(t)] = nm
+                initializers.append(numpy_helper.from_array(
+                    np.asarray(t.numpy()), nm))
+        # ALL caller inputs, in the caller's order (run() binds
+        # positionally; unused inputs stay declared so positions hold)
+        for i, t in enumerate(inputs):
+            if id(t) not in names:
+                names[id(t)] = t.name or f"input_{i}"
+            graph_inputs.append(helper.make_tensor_value_info(
+                names[id(t)], _onnx_dtype(t), list(t.shape)))
+
+        # BN running stats are referenced by the node but live off-tape
+        def bn_state_name(op, which):
+            t = getattr(op, which)
+            if id(t) not in names:
+                nm = _sanitize(t.name or f"{_sanitize(op.name)}_{which}")
+                names[id(t)] = nm
+                initializers.append(numpy_helper.from_array(
+                    np.asarray(t.numpy()), nm))
+            return names[id(t)]
+
+        for op in ops:
+            if isinstance(op, Dummy):
+                continue
+            op_name = _sanitize(op.name)
+            in_names = []
+            for (src_op, x_id, t_ref, _req) in op.src:
+                if x_id not in names:
+                    if src_op is None and t_ref is not None:
+                        # constant consumed by the op: emit an initializer
+                        nm = _sanitize(t_ref.name or
+                                       f"const_{len(initializers)}")
+                        names[x_id] = nm
+                        initializers.append(numpy_helper.from_array(
+                            np.asarray(t_ref.numpy()), nm))
+                    else:
+                        raise ValueError(
+                            f"op {op.name}: input tensor not on the tape — "
+                            "mark graph inputs requires_grad=True before "
+                            "export")
+                in_names.append(names[x_id])
+            out_names = []
+            for pos, yid in enumerate(op.y_ids):
+                nm = f"{op_name}_out{pos}" if len(op.y_ids) > 1 \
+                    else op_name
+                names[yid] = nm
+                out_names.append(nm)
+
+            ty = type(op).__name__
+            if ty in ("_BatchNorm2d", "_BatchNorm2dInference"):
+                # onnx BatchNormalization: X, scale, B, mean, var
+                in_names = in_names[:3] + [bn_state_name(op, "running_mean"),
+                                           bn_state_name(op, "running_var")]
+            onnx_ty, attrs = cls._node_attrs_and_extra(
+                op, op_name, in_names, initializers)
+            nodes.append(helper.make_node(onnx_ty, in_names, out_names,
+                                          name=op_name, **attrs))
+
+        graph_outputs = []
+        for i, yy in enumerate(ys):
+            graph_outputs.append(helper.make_tensor_value_info(
+                names[id(yy)], _onnx_dtype(yy), list(yy.shape)))
+
+        return helper.make_graph(nodes, model_name, graph_inputs,
+                                 graph_outputs, initializer=initializers)
+
+    @classmethod
+    def singa_to_onnx_model(cls, inputs, y, model_name="sonnx"):
+        graph = cls.singa_to_onnx_graph(inputs, y, model_name)
+        return helper.make_model(
+            graph, producer_name="singa_tpu",
+            opset_imports=[helper.make_operatorsetid(
+                "", cls._target_opset_version)]
+            if hasattr(helper, "make_operatorsetid") else None)
+
+
+def to_onnx(model, inputs, model_name="sonnx"):
+    """Trace ``model.forward(*inputs)`` and export it
+    (reference sonnx.to_onnx, sonnx.py:2227)."""
+    tape_inputs = []
+    for i, t in enumerate(inputs):
+        ti = Tensor(data=t.data if isinstance(t, Tensor) else np.asarray(t),
+                    device=getattr(t, "device", None), requires_grad=True,
+                    stores_grad=False)
+        ti.name = t.name if isinstance(t, Tensor) and t.name else f"input_{i}"
+        tape_inputs.append(ti)
+    # record the tape with INFERENCE semantics: BN reads (and must not
+    # mutate) running stats, dropout is identity — the exported graph
+    # reproduces model.eval() behaviour
+    prev_t, prev_r = CTX.training, CTX.recording
+    CTX.training, CTX.recording = False, True
+    try:
+        y = model.forward(*tape_inputs)
+    finally:
+        CTX.training, CTX.recording = prev_t, prev_r
+    if hasattr(model, "get_states"):
+        # stable initializer names (params are anonymous until compile())
+        for name, st in model.get_states().items():
+            st.name = st.name or name
+    return SingaFrontend.singa_to_onnx_model(tape_inputs, y, model_name)
+
+
+# ===========================================================================
+# Backend: ONNX -> our ops
+# ===========================================================================
+
+class OnnxNode:
+    """Light view of a NodeProto (reference sonnx.OnnxNode)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.name = _sanitize(node.name) or _sanitize("_".join(node.output))
+        self.op_type = node.op_type
+        self.inputs = list(node.input)
+        self.outputs = list(node.output)
+        self.attrs = attribute_dict(node)
+        self.cache = {}  # shape-specialised handles, filled on first run
+
+
+def _arr(t: Tensor):
+    return np.asarray(t.numpy())
+
+
+def _ints(t: Tensor):
+    return [int(v) for v in np.asarray(t.numpy()).ravel()]
+
+
+class SingaBackend:
+    """ONNX graph -> executable ops (reference SingaBackend sonnx.py:1037).
+
+    Each handler is ``(node, tensors) -> output Tensor(s)``; ``tensors``
+    maps value names to Tensors (initializers included). Handles for
+    shape-specialised ops (Conv/Pool/BN) are cached per node on first run.
+    """
+
+    _opset_version = 11
+    _ir_version = 8
+
+    # onnx op_type -> our functional op (simple 1:1 cases)
+    _direct = {
+        "Relu": autograd.relu, "Sigmoid": autograd.sigmoid,
+        "Add": autograd.add, "MatMul": autograd.matmul,
+        "Cos": autograd.cos, "Cosh": autograd.cosh, "Sin": autograd.sin,
+        "Sinh": autograd.sinh, "Tan": autograd.tan, "Tanh": autograd.tanh,
+        "Acos": autograd.acos, "Acosh": autograd.acosh,
+        "Asin": autograd.asin, "Asinh": autograd.asinh,
+        "Atan": autograd.atan, "Atanh": autograd.atanh,
+        "Equal": autograd.equal, "Less": autograd.less,
+        "Sign": autograd.sign, "Div": autograd.div, "Sub": autograd.sub,
+        "Sqrt": autograd.sqrt, "Log": autograd.log,
+        "Greater": autograd.greater, "Identity": autograd.identity,
+        "Softplus": autograd.softplus, "Softsign": autograd.softsign,
+        "Mean": autograd.mean, "Pow": autograd.pow,
+        "PRelu": autograd.prelu, "Mul": autograd.mul,
+        "Max": autograd.max, "Min": autograd.min,
+        "Shape": autograd.shape, "And": autograd._and,
+        "Or": autograd._or, "Xor": autograd._xor, "Not": autograd._not,
+        "Neg": autograd.negative, "Reciprocal": autograd.reciprocal,
+        "Sum": autograd.sum, "NonZero": autograd.nonzero,
+        "Ceil": autograd.ceil, "Floor": autograd.floor,
+        "Abs": autograd.abs, "Erf": autograd.erf, "Where": autograd.where,
+    }
+
+    @classmethod
+    def _handle(cls, node: OnnxNode, ins, tensors):
+        ty = node.op_type
+        a = node.attrs
+        if ty in cls._direct:
+            return cls._direct[ty](*ins)
+        if ty == "Conv":
+            handle = node.cache.get("handle")
+            if handle is None:
+                ks = a["kernel_shape"]
+                pads = a.get("pads", [0] * 4)
+                handle = ConvHandle(
+                    ins[0], tuple(ks),
+                    tuple(a.get("strides", [1] * len(ks))),
+                    ((pads[0], pads[2]), (pads[1], pads[3])),
+                    in_channels=ins[0].shape[1],
+                    out_channels=ins[1].shape[0],
+                    bias=len(ins) > 2, group=a.get("group", 1),
+                    dilation=tuple(a.get("dilations", [1] * len(ks))))
+                node.cache["handle"] = handle
+            return conv2d(handle, ins[0], ins[1],
+                          ins[2] if len(ins) > 2 else None)
+        if ty in ("MaxPool", "AveragePool"):
+            handle = node.cache.get("handle")
+            if handle is None:
+                ks = a["kernel_shape"]
+                pads = a.get("pads", [0] * 4)
+                handle = PoolingHandle(
+                    ins[0], tuple(ks),
+                    tuple(a.get("strides", ks)),
+                    ((pads[0], pads[2]), (pads[1], pads[3])),
+                    is_max=(ty == "MaxPool"))
+                node.cache["handle"] = handle
+            return pooling_2d(handle, ins[0])
+        if ty == "GlobalAveragePool":
+            return globalaveragepool(ins[0])
+        if ty == "BatchNormalization":
+            handle = node.cache.get("handle")
+            if handle is None:
+                handle = BatchNormHandle(a.get("momentum", 0.9), ins[0],
+                                         a.get("epsilon", 1e-5))
+                node.cache["handle"] = handle
+            x, scale, bias, mean, var = ins
+            return batchnorm_2d(handle, x, scale, bias, mean, var)
+        if ty == "Gemm":
+            C = ins[2] if len(ins) > 2 else None
+            return autograd.gemm(ins[0], ins[1], C,
+                                 a.get("alpha", 1.0), a.get("beta", 1.0),
+                                 a.get("transA", 0), a.get("transB", 0))
+        if ty == "Softmax":
+            return autograd.softmax(ins[0], a.get("axis", 1))
+        if ty == "Concat":
+            return autograd.cat(list(ins), a.get("axis", 0))
+        if ty == "Flatten":
+            return autograd.flatten(ins[0], a.get("axis", 1))
+        if ty == "Reshape":
+            return autograd.reshape(ins[0], _ints(ins[1]))
+        if ty == "Transpose":
+            return autograd.transpose(ins[0], a.get("perm"))
+        if ty == "Squeeze":
+            return autograd.squeeze(ins[0], tuple(a["axes"])
+                                    if "axes" in a else None)
+        if ty == "Unsqueeze":
+            return autograd.unsqueeze(ins[0], list(a["axes"]))
+        if ty == "Slice":
+            starts = _ints(ins[1])
+            ends = _ints(ins[2])
+            axes = _ints(ins[3]) if len(ins) > 3 else None
+            steps = _ints(ins[4]) if len(ins) > 4 else None
+            return autograd.slice(ins[0], starts, ends, axes, steps)
+        if ty == "Clip":
+            mn = float(_arr(ins[1])) if len(ins) > 1 and ins[1] is not None \
+                else None
+            mx = float(_arr(ins[2])) if len(ins) > 2 and ins[2] is not None \
+                else None
+            return autograd.clip(ins[0], mn, mx)
+        if ty in ("ReduceSum", "ReduceMean"):
+            fn = autograd.reduce_sum if ty == "ReduceSum" \
+                else autograd.reduce_mean
+            return fn(ins[0], a.get("axes"), a.get("keepdims", 1))
+        if ty == "LeakyRelu":
+            return autograd.leakyrelu(ins[0], a.get("alpha", 0.01))
+        if ty == "Elu":
+            return autograd.elu(ins[0], a.get("alpha", 1.0))
+        if ty == "Selu":
+            return autograd.selu(ins[0], a.get("alpha", 1.67326),
+                                 a.get("gamma", 1.0507))
+        if ty == "HardSigmoid":
+            return autograd.hardsigmoid(ins[0], a.get("alpha", 0.2),
+                                        a.get("beta", 0.5))
+        if ty == "Dropout":
+            return autograd.dropout(ins[0], a.get("ratio", 0.5))
+        if ty == "Split":
+            return autograd.split(ins[0], a.get("axis", 0),
+                                  list(a["split"]) if "split" in a else None,
+                                  num_output=len(node.outputs)
+                                  if "split" not in a else None)
+        if ty == "Gather":
+            return autograd.gather(ins[0], a.get("axis", 0),
+                                   _arr(ins[1]).astype(np.int32))
+        if ty == "Tile":
+            return autograd.tile(ins[0], _ints(ins[1]))
+        if ty == "Expand":
+            return autograd.expand(ins[0], _ints(ins[1]))
+        if ty == "Pad":
+            pads = _ints(ins[1])
+            const = float(_arr(ins[2])) \
+                if len(ins) > 2 and ins[2] is not None else 0.0
+            return autograd.pad(ins[0], a.get("mode", "constant"), pads,
+                                const)
+        if ty in ("Upsample", "Resize"):
+            if ty == "Resize":
+                # Resize(X, roi, scales[, sizes]): prefer scales; derive
+                # them from sizes when only sizes is given
+                scales_t = ins[2] if len(ins) > 2 else None
+                if scales_t is not None and scales_t.size():
+                    scales = _arr(scales_t).ravel()
+                elif len(ins) > 3 and ins[3] is not None:
+                    sizes = _arr(ins[3]).ravel()
+                    scales = [s / d for s, d in zip(sizes, ins[0].shape)]
+                else:
+                    raise ValueError("Resize needs scales or sizes")
+            else:
+                scales = _arr(ins[-1]).ravel()
+            int_scales = [int(round(float(s))) for s in scales]
+            if any(abs(i - float(s)) > 1e-6 for i, s in zip(int_scales,
+                                                            scales)):
+                raise NotImplementedError(
+                    f"{ty}: only integer nearest-neighbour scales are "
+                    f"supported, got {list(map(float, scales))}")
+            return autograd.upsample(ins[0], "nearest", int_scales)
+        if ty == "ConstantOfShape":
+            v = a.get("value")
+            val = float(numpy_helper.to_array(v).ravel()[0]) \
+                if v is not None else 0.0
+            return autograd.constant_of_shape(ins[0], val)
+        if ty == "Cast":
+            return autograd.cast(
+                ins[0], helper.tensor_dtype_to_np_dtype(a["to"]))
+        if ty == "OneHot":
+            depth = int(_arr(ins[1]).ravel()[0])
+            values = tuple(float(v) for v in _arr(ins[2]).ravel())
+            return autograd.onehot(a.get("axis", -1), ins[0], depth, values)
+        if ty == "DepthToSpace":
+            return autograd.depth_to_space(ins[0], a["blocksize"],
+                                           a.get("mode", "DCR"))
+        if ty == "SpaceToDepth":
+            return autograd.space_to_depth(ins[0], a["blocksize"])
+        if ty == "ScatterElements":
+            return autograd.scatter_elements(ins[0], ins[1], ins[2],
+                                             a.get("axis", 0))
+        if ty == "Constant":
+            v = a["value"]
+            return Tensor(data=numpy_helper.to_array(v),
+                          requires_grad=False)
+        raise NotImplementedError(f"ONNX op {ty} is not supported")
+
+    @classmethod
+    def prepare(cls, model, device="CPU", init_inputs=None, **kwargs):
+        """Parse an ONNX ModelProto into a runnable :class:`SingaRep`
+        (reference SingaBackend.prepare sonnx.py:1911)."""
+        for imp in model.opset_import:
+            if imp.domain == "" and imp.version > cls._opset_version:
+                warnings.warn(
+                    f"opset {imp.version} is newer than supported "
+                    f"({cls._opset_version})")
+        if model.ir_version > cls._ir_version:
+            warnings.warn(
+                f"ir_version {model.ir_version} is newer than supported "
+                f"({cls._ir_version})")
+        graph = model.graph
+        dev = device_mod.create_tpu_device() if device in ("TPU", "GPU",
+                                                           "CUDA") \
+            else device_mod.create_cpu_device()
+
+        # initializers that are op configuration, not learned weights:
+        # BN running stats and the "attribute-as-input" operands of
+        # shape-manipulating ops must never be marked trainable
+        non_weight = set()
+        for n in graph.node:
+            if n.op_type == "BatchNormalization":
+                non_weight.update(n.input[3:5])
+            elif n.op_type in ("Reshape", "Expand", "Tile", "Pad", "Slice",
+                               "Clip", "OneHot", "Upsample", "Resize",
+                               "Gather", "ConstantOfShape"):
+                non_weight.update(n.input[1:])
+
+        params = OrderedDict()
+        for init in graph.initializer:
+            arr = numpy_helper.to_array(init)
+            trainable = (arr.dtype == np.float32 and arr.ndim >= 1
+                         and init.name not in non_weight)
+            t = Tensor(data=np.ascontiguousarray(arr), device=dev,
+                       requires_grad=trainable, stores_grad=trainable)
+            t.name = init.name
+            params[init.name] = t
+
+        inputs = [vi for vi in graph.input if vi.name not in params]
+        outputs = list(graph.output)
+        nodes = [OnnxNode(n) for n in graph.node]
+        return SingaRep(params, inputs, outputs, nodes, dev)
+
+
+class SingaRep:
+    """Executable representation of an imported graph
+    (reference SingaRep sonnx.py:1951)."""
+
+    def __init__(self, params, inputs, outputs, nodes, dev):
+        self.states = params
+        self.inputs = inputs
+        self.outputs = outputs
+        self.nodes = nodes
+        self.dev = dev
+        self.is_graph = False
+
+    # reference API: layers is [(node, operator)]
+    @property
+    def layers(self):
+        return [(n, None) for n in self.nodes]
+
+    def get_states(self):
+        return dict(self.states)
+
+    def run(self, input, aux_output=(), **kwargs):  # noqa: A002
+        """Topologically execute the graph
+        (reference SingaRep.run sonnx.py:1998)."""
+        tensors = dict(self.states)
+        ins = list(input)
+        for vi, t in zip(self.inputs, ins):
+            if not isinstance(t, Tensor):
+                t = Tensor(data=np.asarray(t), device=self.dev,
+                           requires_grad=False)
+            tensors[vi.name] = t
+        for node in self.nodes:
+            resolved = [tensors[nm] if nm else None for nm in node.inputs]
+            out = SingaBackend._handle(node, resolved, tensors)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for nm, t in zip(node.outputs, outs):
+                tensors[nm] = t
+        result = [tensors[o.name] for o in self.outputs]
+        for nm in aux_output:
+            result.append(tensors[nm])
+        return result
+
+
+from .model import Model as _Model  # noqa: E402  (after backend defs)
+
+
+class SONNXModel(_Model):
+    """Imported ONNX graph as a trainable Model
+    (reference SONNXModel sonnx.py:2196). Subclass and override
+    ``train_one_batch`` to fine-tune; the imported weights are parameters.
+    """
+
+    def __init__(self, onnx_model, device="CPU"):
+        super().__init__()
+        self.sg_ir = prepare(onnx_model, device=device)
+
+    def forward(self, *input, aux_output=(), **kwargs):  # noqa: A002
+        outs = self.sg_ir.run(list(input), aux_output=aux_output, **kwargs)
+        return outs if len(outs) > 1 else outs[0]
+
+    def get_params(self):
+        return {k: v for k, v in self.sg_ir.states.items()
+                if v.requires_grad}
+
+    def set_params(self, params):
+        for k, v in params.items():
+            if k in self.sg_ir.states:
+                self.sg_ir.states[k].copy_from(v)
+
+    def get_states(self):
+        return dict(self.sg_ir.states)
+
+    def set_states(self, states):
+        for k, v in states.items():
+            if k in self.sg_ir.states:
+                self.sg_ir.states[k].copy_from(v)
+
+
+# reference-parity module-level API (sonnx.py:2223-2228)
+prepare = SingaBackend.prepare
+get_op = SingaBackend._handle
+run_node = None  # per-node execution happens through SingaRep

@@ -1,0 +1,226 @@
+"""ONNX export/import roundtrips (reference test/python/test_onnx.py):
+export a taped model, reimport with the backend, outputs must match."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device, layer, model, sonnx, tensor, opt
+from singa_tpu.tensor import Tensor
+
+
+DEV = device.create_cpu_device()
+
+
+def t(arr, rg=False):
+    return Tensor(data=np.asarray(arr, np.float32), device=DEV,
+                  requires_grad=rg, stores_grad=rg)
+
+
+def roundtrip(m, inputs, rtol=1e-5, atol=1e-6):
+    """export -> serialize -> parse -> run, compare with direct forward."""
+    onnx_model = sonnx.to_onnx(m, inputs, "test")
+    raw = onnx_model.SerializeToString()
+    onnx_model2 = type(onnx_model)()
+    onnx_model2.ParseFromString(raw)
+    rep = sonnx.prepare(onnx_model2, device="CPU")
+    outs = rep.run(inputs)
+    direct = m.forward(*inputs)
+    directs = direct if isinstance(direct, (list, tuple)) else [direct]
+    for got, want in zip(outs, directs):
+        np.testing.assert_allclose(np.asarray(got.data),
+                                   np.asarray(want.data),
+                                   rtol=rtol, atol=atol)
+    return onnx_model
+
+
+class MLPNet(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(8)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(3)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class CNNNet(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.conv = layer.Conv2d(4, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.pool = layer.MaxPool2d(2, 2)
+        self.flat = layer.Flatten()
+        self.fc = layer.Linear(5)
+
+    def forward(self, x):
+        y = self.pool(self.relu(self.bn(self.conv(x))))
+        return self.fc(self.flat(y))
+
+
+class TestFrontendBackend:
+    def test_mlp_roundtrip(self):
+        m = MLPNet()
+        x = t(np.random.randn(4, 6))
+        m.forward(x)  # materialise params
+        roundtrip(m, [t(np.random.randn(4, 6))])
+
+    def test_cnn_roundtrip(self):
+        m = CNNNet()
+        x = t(np.random.randn(2, 3, 8, 8))
+        m.forward(x)
+        mp = roundtrip(m, [t(np.random.randn(2, 3, 8, 8))], rtol=1e-4,
+                       atol=1e-5)
+        ops = [n.op_type for n in mp.graph.node]
+        assert "Conv" in ops and "BatchNormalization" in ops \
+            and "MaxPool" in ops
+
+    def test_elementwise_graph(self):
+        class Net(model.Model):
+            def forward(self, a, b):
+                y = autograd.mul(autograd.tanh(a), autograd.sigmoid(b))
+                return autograd.reduce_mean(y, axes=[1], keepdims=0)
+
+        m = Net()
+        roundtrip(m, [t(np.random.randn(3, 5)), t(np.random.randn(3, 5))])
+
+    def test_shape_ops_graph(self):
+        class Net(model.Model):
+            def forward(self, x):
+                y = autograd.reshape(x, (2, 6))
+                y = autograd.transpose(y, (1, 0))
+                y = autograd.unsqueeze(y, [0])
+                return autograd.squeeze(y, 0)
+
+        m = Net()
+        roundtrip(m, [t(np.random.randn(3, 4))])
+
+    def test_avgpool_gemm(self):
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.pool = layer.AvgPool2d(2, 2)
+                self.gemm = layer.Gemm(4, transB=True)
+                self.flat = layer.Flatten()
+
+            def forward(self, x):
+                return self.gemm(self.flat(self.pool(x)))
+
+        m = Net()
+        x = t(np.random.randn(2, 3, 4, 4))
+        m.forward(x)
+        roundtrip(m, [t(np.random.randn(2, 3, 4, 4))], rtol=1e-4)
+
+    def test_concat_slice(self):
+        class Net(model.Model):
+            def forward(self, a, b):
+                y = autograd.cat([a, b], axis=1)
+                return autograd.slice(y, [0], [3], [1])
+
+        m = Net()
+        roundtrip(m, [t(np.random.randn(2, 3)), t(np.random.randn(2, 2))])
+
+    def test_constant_operand(self):
+        const = t(np.full((3, 5), 2.5, np.float32))  # requires_grad=False
+
+        class Net(model.Model):
+            def forward(self, x):
+                return autograd.mul(autograd.add(x, const), const)
+
+        m = Net()
+        mp = roundtrip(m, [t(np.random.randn(3, 5))])
+        assert len(mp.graph.initializer) >= 1  # const exported
+
+    def test_unused_input_binding(self):
+        class Net(model.Model):
+            def forward(self, a, b):
+                return autograd.relu(b)  # 'a' unused
+
+        m = Net()
+        a = t(np.random.randn(2, 3))
+        b = t(np.random.randn(2, 3))
+        mp = sonnx.to_onnx(m, [a, b], "net")
+        assert len(mp.graph.input) == 2  # unused input still declared
+        rep = sonnx.prepare(mp)
+        out = rep.run([a, b])[0]
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.maximum(np.asarray(b.data), 0))
+
+    def test_asymmetric_pool_pads(self):
+        from singa_tpu.onnx_compat import helper, numpy_helper, TensorProto
+        x = np.random.randn(1, 1, 5, 5).astype(np.float32)
+        node = helper.make_node("MaxPool", ["x"], ["y"], name="p",
+                                kernel_shape=[2, 2], strides=[1, 1],
+                                pads=[0, 0, 1, 1])
+        graph = helper.make_graph(
+            [node], "g",
+            [helper.make_tensor_value_info("x", TensorProto.FLOAT,
+                                           [1, 1, 5, 5])],
+            [helper.make_tensor_value_info("y", TensorProto.FLOAT,
+                                           [1, 1, 5, 5])])
+        mp = helper.make_model(graph)
+        rep = sonnx.prepare(mp)
+        out = rep.run([t(x)])[0]
+        assert out.shape == (1, 1, 5, 5)  # (5+0+1-2)//1+1
+
+
+class TestSONNXModel:
+    def test_inference_and_finetune(self):
+        m = MLPNet()
+        x = t(np.random.randn(4, 6))
+        m.forward(x)
+        onnx_model = sonnx.to_onnx(m, [x], "mlp")
+
+        class Tuned(sonnx.SONNXModel):
+            def __init__(self, om):
+                super().__init__(om)
+                self.loss_fn = layer.SoftMaxCrossEntropy()
+
+            def train_one_batch(self, xx, yy):
+                out = self.forward(xx)
+                loss = self.loss_fn(out, yy)
+                self.optimizer(loss)
+                return out, loss
+
+        tuned = Tuned(onnx_model)
+        out = tuned.forward(x)
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.asarray(m.forward(x).data), rtol=1e-5)
+
+        y = t(np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 4)])
+        tuned.set_optimizer(opt.SGD(lr=0.1))
+        tuned.compile([x], is_train=True, use_graph=False)
+        losses = []
+        for _ in range(10):
+            _, loss = tuned(x, y)
+            losses.append(float(loss.data))
+        assert losses[-1] < losses[0], losses
+
+
+class TestPersistence:
+    def test_save_load_file(self, tmp_path):
+        m = MLPNet()
+        x = t(np.random.randn(4, 6))
+        m.forward(x)
+        onnx_model = sonnx.to_onnx(m, [x], "mlp")
+        path = str(tmp_path / "m.onnx")
+        sonnx.save(onnx_model, path)
+        loaded = sonnx.load(path)
+        rep = sonnx.prepare(loaded)
+        out = rep.run([x])[0]
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.asarray(m.forward(x).data), rtol=1e-5)
+
+    def test_wire_compat_fields(self):
+        """Serialized model exposes standard ONNX structure."""
+        m = MLPNet()
+        x = t(np.random.randn(2, 6))
+        m.forward(x)
+        mp = sonnx.to_onnx(m, [x], "net")
+        assert mp.graph.name == "net"
+        assert mp.opset_import[0].version == 11
+        assert len(mp.graph.input) == 1
+        assert len(mp.graph.initializer) == 4  # 2x(W, b)
+        names = {i.name for i in mp.graph.initializer}
+        assert any("W" in n for n in names)
