@@ -1,0 +1,145 @@
+"""Data loading pipeline with background prefetch.
+
+Capability parity with the reference pipeline (python/singa/data.py:60-124):
+:class:`ImageBatchIter` streams (image, label) batches from an image-list
+file through a worker process and a bounded queue, overlapping JPEG decode +
+augmentation with device compute. On TPU this hides host-side input cost
+behind the XLA step, the same role the reference's prefetch plays for CUDA.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from multiprocessing import Process, Queue
+from queue import Empty, Full, Queue as _TQueue
+from threading import Thread
+
+import numpy as np
+
+
+class ImageBatchIter:
+    """Iterate over (images, labels) batches from an image list file.
+
+    ``img_list_file``: each line is ``<relative path><delimiter><label>``.
+    ``image_transform``: path -> list of augmented numpy images (multiple
+    augmentations multiply the effective batch, like the reference).
+    """
+
+    def __init__(self, img_list_file, batch_size, image_transform,
+                 shuffle=True, delimiter=" ", image_folder=None,
+                 capacity=10, use_process=False):
+        """``use_process=False`` (default) prefetches on a daemon thread —
+        fork()ing a multi-threaded XLA process is deadlock-prone, and PIL /
+        numpy release the GIL for the heavy work. ``use_process=True``
+        matches the reference's separate-process behaviour."""
+        self.img_list_file = img_list_file
+        self.use_process = use_process
+        self.queue = Queue(capacity) if use_process else _TQueue(capacity)
+        self.batch_size = batch_size
+        self.image_transform = image_transform
+        self.shuffle = shuffle
+        self.delimiter = delimiter
+        self.image_folder = image_folder or ""
+        self.stop = False
+        self.p = None
+        with open(img_list_file, "r") as fd:
+            self.num_samples = sum(1 for line in fd if line.strip())
+
+    def start(self):
+        if self.use_process:
+            self.p = Process(target=self.run)
+        else:
+            self.p = Thread(target=self.run)
+        self.p.daemon = True
+        self.p.start()
+
+    def __next__(self):
+        assert self.p is not None, "call start() before next()"
+        while True:
+            try:
+                return self.queue.get(timeout=1.0)
+            except Empty:
+                if not self.p.is_alive():
+                    raise RuntimeError(
+                        "ImageBatchIter worker died (bad image path or "
+                        "malformed list line?)") from None
+
+    next = __next__
+
+    def __iter__(self):
+        if self.p is None:
+            self.start()
+        return self
+
+    def end(self):
+        if self.p is not None:
+            if self.use_process:
+                self.p.terminate()
+            else:
+                self.stop = True
+                # unblock a queue.put-blocked worker
+                try:
+                    while True:
+                        self.queue.get_nowait()
+                except Empty:
+                    pass
+            self.p = None
+
+    def run(self):
+        with open(self.img_list_file, "r") as fd:
+            samples = [line.strip().split(self.delimiter, 1)
+                       for line in fd if line.strip()]
+        while not self.stop:
+            if self.shuffle:
+                random.shuffle(samples)
+            pos = 0
+            while pos < len(samples):
+                images, labels = [], []
+                while len(images) < self.batch_size and pos < len(samples):
+                    path, label = samples[pos]
+                    pos += 1
+                    full = os.path.join(self.image_folder, path)
+                    augmented = self.image_transform(full)
+                    for img in augmented:
+                        images.append(np.asarray(img, np.float32))
+                        labels.append(int(float(label)))
+                if not images:
+                    continue
+                batch = (np.stack(images), np.asarray(labels, np.int32))
+                while not self.stop:
+                    try:
+                        self.queue.put(batch, timeout=0.1)
+                        break
+                    except Full:
+                        continue
+
+
+class NumpyBatchIter:
+    """Batches over in-memory arrays with epoch shuffle — the synthetic /
+    pre-loaded data path used by examples (reference examples load cifar
+    into numpy then slice batches in the train loop)."""
+
+    def __init__(self, x, y, batch_size, shuffle=True, drop_last=True,
+                 seed=0):
+        self.x = np.asarray(x)
+        self.y = np.asarray(y)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def num_batches(self):
+        n = len(self.x) // self.batch_size
+        if not self.drop_last and len(self.x) % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self):
+        idx = np.arange(len(self.x))
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        for b in range(self.num_batches):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            yield self.x[sel], self.y[sel]

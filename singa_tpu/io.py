@@ -1,0 +1,375 @@
+"""IO: record files, text files, LMDB (optional), codecs, image transformer.
+
+Capability parity with the reference IO stack (src/io/): BinFile/TextFile
+readers and writers (reference include/singa/io/reader.h:70, writer.h),
+LMDB reader/writer gated on the lmdb package, JPG and CSV codecs
+(src/io/{jpg,csv}_{encoder,decoder}.cc — PIL replaces OpenCV), and the
+crop/resize/flip ImageTransformer (src/io/image_transformer.cc). The byte
+paths run in the native C++ runtime (native/singa_native.cc) via ctypes.
+"""
+
+from __future__ import annotations
+
+import io as _stdio
+import os
+
+import numpy as np
+
+from . import native
+from .tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# binary record files (native)
+# ---------------------------------------------------------------------------
+
+class BinFileWriter:
+    """KV record-file writer (reference src/io/binfile_writer.cc)."""
+
+    def __init__(self, path=None, mode="create"):
+        self._w = None
+        if path is not None:
+            self.Open(path, mode)
+
+    def Open(self, path, mode="create"):
+        self._w = native.RecordWriter(path, append=(mode == "append"))
+        return True
+
+    def Write(self, key, value):
+        self._w.write(key, value)
+        return True
+
+    def Flush(self):
+        self._w.flush()
+
+    def Close(self):
+        if self._w:
+            self._w.close()
+            self._w = None
+
+    write = Write
+    flush = Flush
+    close = Close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.Close()
+
+
+class BinFileReader:
+    """KV record-file reader w/ optional background prefetch thread
+    (reference src/io/binfile_reader.cc)."""
+
+    def __init__(self, path=None, prefetch=64):
+        self._r = None
+        self._prefetch = prefetch
+        if path is not None:
+            self.Open(path)
+
+    def Open(self, path, capacity=None):
+        self._r = native.RecordReader(path, prefetch=self._prefetch)
+        return True
+
+    def Read(self):
+        """(key, value) bytes or None at end."""
+        return self._r.read()
+
+    def Count(self):
+        return self._r.count()
+
+    def SeekToFirst(self):
+        self._r.seek_to_first()
+
+    def Close(self):
+        if self._r:
+            self._r.close()
+            self._r = None
+
+    read = Read
+    count = Count
+    seek_to_first = SeekToFirst
+    close = Close
+
+    def __iter__(self):
+        return iter(self._r)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.Close()
+
+
+# ---------------------------------------------------------------------------
+# text files
+# ---------------------------------------------------------------------------
+
+class TextFileWriter:
+    """Line-per-record writer (reference src/io/textfile_writer.cc)."""
+
+    def __init__(self, path=None, mode="create"):
+        self._f = None
+        if path is not None:
+            self.Open(path, mode)
+
+    def Open(self, path, mode="create"):
+        self._f = open(path, "a" if mode == "append" else "w")
+        return True
+
+    def Write(self, key, value):
+        if isinstance(value, bytes):
+            value = value.decode("utf-8")
+        self._f.write(value.rstrip("\n") + "\n")
+        return True
+
+    def Flush(self):
+        self._f.flush()
+
+    def Close(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+class TextFileReader:
+    """Line-per-record reader; key is the line number
+    (reference src/io/textfile_reader.cc)."""
+
+    def __init__(self, path=None):
+        self._f = None
+        self._lineno = 0
+        if path is not None:
+            self.Open(path)
+
+    def Open(self, path, capacity=None):
+        self._f = open(path, "r")
+        self._lineno = 0
+        return True
+
+    def Read(self):
+        line = self._f.readline()
+        if not line:
+            return None
+        key = str(self._lineno)
+        self._lineno += 1
+        return key, line.rstrip("\n")
+
+    def Count(self):
+        pos = self._f.tell()
+        self._f.seek(0)
+        n = sum(1 for _ in self._f)
+        self._f.seek(pos)
+        return n
+
+    def SeekToFirst(self):
+        self._f.seek(0)
+        self._lineno = 0
+
+    def Close(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+# ---------------------------------------------------------------------------
+# LMDB (optional dependency, like the reference's USE_LMDB build flag)
+# ---------------------------------------------------------------------------
+
+try:
+    import lmdb as _lmdb
+    HAS_LMDB = True
+except ImportError:
+    _lmdb = None
+    HAS_LMDB = False
+
+
+class LMDBWriter:
+    """(reference src/io/lmdb_writer.cc; requires the lmdb package)"""
+
+    def __init__(self, path=None, mode="create"):
+        if not HAS_LMDB:
+            raise ImportError("LMDBWriter requires the 'lmdb' package")
+        self._env = None
+        if path is not None:
+            self.Open(path, mode)
+
+    def Open(self, path, mode="create"):
+        self._env = _lmdb.open(path, map_size=1 << 30)
+        self._txn = self._env.begin(write=True)
+        return True
+
+    def Write(self, key, value):
+        key = key.encode() if isinstance(key, str) else key
+        value = value.encode() if isinstance(value, str) else value
+        # one long-lived write txn; commit happens in Flush/Close (a txn
+        # per record would fsync per record)
+        self._txn.put(key, value)
+        return True
+
+    def Flush(self):
+        self._txn.commit()
+        self._env.sync()
+        self._txn = self._env.begin(write=True)
+
+    def Close(self):
+        if self._env:
+            self._txn.commit()
+            self._env.close()
+            self._env = None
+
+
+class LMDBReader:
+    """(reference src/io/lmdb_reader.cc; requires the lmdb package)"""
+
+    def __init__(self, path=None):
+        if not HAS_LMDB:
+            raise ImportError("LMDBReader requires the 'lmdb' package")
+        self._env = None
+        self._cursor = None
+        if path is not None:
+            self.Open(path)
+
+    def Open(self, path, capacity=None):
+        self._env = _lmdb.open(path, readonly=True, lock=False)
+        self._txn = self._env.begin()
+        self._cursor = self._txn.cursor()
+        self._cursor.first()
+        self._exhausted = not self._cursor.key()
+        return True
+
+    def Read(self):
+        if self._exhausted:
+            return None
+        key, value = self._cursor.key(), self._cursor.value()
+        if not self._cursor.next():
+            self._exhausted = True
+        return bytes(key), bytes(value)
+
+    def Count(self):
+        return self._env.stat()["entries"]
+
+    def SeekToFirst(self):
+        self._cursor.first()
+        self._exhausted = not self._cursor.key()
+
+    def Close(self):
+        if self._env:
+            self._env.close()
+            self._env = None
+
+
+# ---------------------------------------------------------------------------
+# codecs
+# ---------------------------------------------------------------------------
+
+class CSVEncoder:
+    """label,feature,... -> csv line (reference src/io/csv_encoder.cc)."""
+
+    def Encode(self, data, label=None):
+        arr = np.asarray(data.numpy() if isinstance(data, Tensor)
+                         else data).ravel()
+        parts = [] if label is None else [str(int(label))]
+        parts += [repr(float(v)) for v in arr]
+        return ",".join(parts)
+
+
+class CSVDecoder:
+    """csv line -> (label, features) (reference src/io/csv_decoder.cc)."""
+
+    def __init__(self, has_label=True):
+        self.has_label = has_label
+
+    def Decode(self, line):
+        if isinstance(line, bytes):
+            line = line.decode("utf-8")
+        vals = [v for v in line.strip().split(",") if v != ""]
+        if self.has_label:
+            return int(float(vals[0])), np.asarray(
+                [float(v) for v in vals[1:]], np.float32)
+        return None, np.asarray([float(v) for v in vals], np.float32)
+
+
+class JPGEncoder:
+    """image array -> jpeg bytes (reference src/io/jpg_encoder.cc;
+    PIL replaces OpenCV)."""
+
+    def __init__(self, quality=95):
+        self.quality = quality
+
+    def Encode(self, image):
+        from PIL import Image
+        arr = np.asarray(image)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+                arr.shape[0] < arr.shape[2]:
+            arr = np.transpose(arr, (1, 2, 0))  # CHW -> HWC
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+        if arr.ndim == 3 and arr.shape[2] == 1:
+            arr = arr[:, :, 0]
+        buf = _stdio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=self.quality)
+        return buf.getvalue()
+
+
+class JPGDecoder:
+    """jpeg bytes -> float32 CHW array (reference src/io/jpg_decoder.cc)."""
+
+    def Decode(self, raw):
+        from PIL import Image
+        img = Image.open(_stdio.BytesIO(raw))
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return native.hwc_to_chw(arr)
+
+
+# ---------------------------------------------------------------------------
+# image transformer
+# ---------------------------------------------------------------------------
+
+class ImageTransformer:
+    """Crop/resize/flip augmentation (reference src/io/image_transformer.cc).
+
+    Operates on float32 images; accepts HWC or CHW via ``image_dim_order``.
+    ``Apply(flag, image)``: flag "train" randomises crop offset and flip,
+    "eval"/"test" center-crops deterministically, like the reference.
+    """
+
+    def __init__(self, resize_height=0, resize_width=0, crop_shape=(),
+                 horizontal_mirror=False, image_dim_order="CHW",
+                 rescale=0.0):
+        self.resize_height = resize_height
+        self.resize_width = resize_width
+        self.crop_shape = tuple(crop_shape)
+        self.horizontal_mirror = horizontal_mirror
+        self.image_dim_order = image_dim_order
+        self.rescale = rescale
+        self._rng = np.random.RandomState()
+
+    def Apply(self, flag, image):
+        arr = np.asarray(image, np.float32)
+        if self.image_dim_order == "CHW":
+            arr = native.chw_to_hwc(arr)
+        if self.resize_height and self.resize_width:
+            arr = native.resize_bilinear(arr, self.resize_height,
+                                         self.resize_width)
+        if self.crop_shape:
+            ch, cw = self.crop_shape
+            h, w = arr.shape[:2]
+            if flag in ("train", 1, "kTrain"):
+                top = self._rng.randint(0, max(1, h - ch + 1))
+                left = self._rng.randint(0, max(1, w - cw + 1))
+            else:
+                top, left = (h - ch) // 2, (w - cw) // 2
+            arr = native.crop(arr, top, left, ch, cw)
+        if self.horizontal_mirror and flag in ("train", 1, "kTrain") \
+                and self._rng.rand() < 0.5:
+            arr = native.hflip(arr)
+        if self.rescale:
+            arr = arr * self.rescale
+        if self.image_dim_order == "CHW":
+            arr = native.hwc_to_chw(arr)
+        return arr
+
+    apply = Apply

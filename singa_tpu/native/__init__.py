@@ -1,0 +1,348 @@
+"""ctypes binding to the native IO runtime (native/singa_native.cc).
+
+Replaces the reference's SWIG layer (src/api/*.i) for the components the
+reference implements natively: record-file IO, prefetching reader, image
+transforms, logging, timer. The library is built lazily with the in-tree
+Makefile on first import and cached; when no C++ toolchain is available
+every entry point gets a numpy/pure-python fallback so the package still
+works (``AVAILABLE`` tells which path is active).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsinga_native.so")
+
+_lib = None
+
+
+def _build():
+    src = os.path.join(_NATIVE_DIR, "singa_native.cc")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=300)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    u32 = ctypes.c_uint32
+    lib.sg_recwriter_open.restype = ctypes.c_void_p
+    lib.sg_recwriter_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.sg_recwriter_write.restype = ctypes.c_int
+    lib.sg_recwriter_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       u32, ctypes.c_char_p, u32]
+    lib.sg_recwriter_flush.argtypes = [ctypes.c_void_p]
+    lib.sg_recwriter_close.argtypes = [ctypes.c_void_p]
+
+    lib.sg_recreader_open.restype = ctypes.c_void_p
+    lib.sg_recreader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.sg_recreader_read.restype = ctypes.c_int
+    lib.sg_recreader_read.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(u32), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(u32)]
+    lib.sg_recreader_count.restype = ctypes.c_int
+    lib.sg_recreader_count.argtypes = [ctypes.c_char_p]
+    lib.sg_recreader_seek_to_first.argtypes = [ctypes.c_void_p]
+    lib.sg_recreader_close.argtypes = [ctypes.c_void_p]
+    lib.sg_free.argtypes = [ctypes.c_void_p]
+
+    fptr = ctypes.POINTER(ctypes.c_float)
+    lib.sg_image_resize_bilinear.restype = ctypes.c_int
+    lib.sg_image_resize_bilinear.argtypes = [fptr] + [ctypes.c_int] * 3 + \
+        [fptr] + [ctypes.c_int] * 2
+    lib.sg_image_crop.restype = ctypes.c_int
+    lib.sg_image_crop.argtypes = [fptr] + [ctypes.c_int] * 3 + [fptr] + \
+        [ctypes.c_int] * 4
+    lib.sg_image_hflip.restype = ctypes.c_int
+    lib.sg_image_hflip.argtypes = [fptr] + [ctypes.c_int] * 3 + [fptr]
+    lib.sg_image_hwc_to_chw.argtypes = [fptr] + [ctypes.c_int] * 3 + [fptr]
+    lib.sg_image_chw_to_hwc.argtypes = [fptr] + [ctypes.c_int] * 3 + [fptr]
+
+    lib.sg_log.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.sg_set_log_level.argtypes = [ctypes.c_int]
+    lib.sg_monotonic_seconds.restype = ctypes.c_double
+    lib.sg_version.restype = ctypes.c_char_p
+
+    _lib = lib
+    return lib
+
+
+_load()
+AVAILABLE = _lib is not None
+
+
+def _as_f32(arr):
+    return np.ascontiguousarray(arr, dtype=np.float32)
+
+
+def _fp(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class RecordWriter:
+    """Key/value record-file writer (reference BinFileWriter,
+    src/io/binfile_writer.cc). Native when available, else pure python
+    with the identical on-disk format."""
+
+    MAGIC = b"SGTPREC0"
+
+    def __init__(self, path, append=False):
+        self.path = path
+        self._h = None
+        self._f = None
+        if AVAILABLE:
+            self._h = _lib.sg_recwriter_open(path.encode(),
+                                             1 if append else 0)
+            if not self._h:
+                raise IOError(f"cannot open {path}")
+        else:
+            mode = "ab" if append else "wb"
+            self._f = open(path, mode)
+            if not append or self._f.tell() == 0:
+                self._f.write(self.MAGIC)
+
+    def write(self, key, value):
+        key = key.encode() if isinstance(key, str) else bytes(key)
+        value = value.encode() if isinstance(value, str) else bytes(value)
+        if self._h:
+            ok = _lib.sg_recwriter_write(self._h, key, len(key), value,
+                                         len(value))
+            if not ok:
+                raise IOError(f"write failed on {self.path}")
+        else:
+            self._f.write(len(key).to_bytes(4, "little"))
+            self._f.write(key)
+            self._f.write(len(value).to_bytes(4, "little"))
+            self._f.write(value)
+
+    def flush(self):
+        if self._h:
+            _lib.sg_recwriter_flush(self._h)
+        else:
+            self._f.flush()
+
+    def close(self):
+        if self._h:
+            _lib.sg_recwriter_close(self._h)
+            self._h = None
+        elif self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordReader:
+    """Key/value record-file reader with optional background prefetch
+    (reference BinFileReader src/io/binfile_reader.cc + SafeQueue)."""
+
+    def __init__(self, path, prefetch=0):
+        self.path = path
+        self._h = None
+        self._f = None
+        if AVAILABLE:
+            self._h = _lib.sg_recreader_open(path.encode(), int(prefetch))
+            if not self._h:
+                raise IOError(f"cannot open {path}")
+        else:
+            self._f = open(path, "rb")
+            if self._f.read(8) != RecordWriter.MAGIC:
+                raise IOError(f"bad record-file magic in {path}")
+
+    def read(self):
+        """Next (key, value) bytes pair, or None at end of file."""
+        if self._h:
+            key_p = ctypes.c_void_p()
+            val_p = ctypes.c_void_p()
+            klen = ctypes.c_uint32()
+            vlen = ctypes.c_uint32()
+            ok = _lib.sg_recreader_read(self._h, ctypes.byref(key_p),
+                                        ctypes.byref(klen),
+                                        ctypes.byref(val_p),
+                                        ctypes.byref(vlen))
+            if not ok:
+                return None
+            key = ctypes.string_at(key_p, klen.value)
+            val = ctypes.string_at(val_p, vlen.value)
+            _lib.sg_free(key_p)
+            _lib.sg_free(val_p)
+            return key, val
+        raw = self._f.read(4)
+        if len(raw) < 4:
+            return None
+        klen = int.from_bytes(raw, "little")
+        key = self._f.read(klen)
+        vraw = self._f.read(4)
+        if len(key) < klen or len(vraw) < 4:
+            raise IOError(f"truncated record file {self.path}")
+        vlen = int.from_bytes(vraw, "little")
+        val = self._f.read(vlen)
+        if len(val) < vlen:
+            raise IOError(f"truncated record file {self.path}")
+        return key, val
+
+    def seek_to_first(self):
+        if self._h:
+            _lib.sg_recreader_seek_to_first(self._h)
+        else:
+            self._f.seek(8)
+
+    def count(self):
+        if AVAILABLE:
+            return _lib.sg_recreader_count(self.path.encode())
+        pos = self._f.tell()
+        self._f.seek(8)
+        n = 0
+        while self.read() is not None:
+            n += 1
+        self._f.seek(pos)
+        return n
+
+    def close(self):
+        if self._h:
+            _lib.sg_recreader_close(self._h)
+            self._h = None
+        elif self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+
+# ---------------------------------------------------------------------------
+# image transforms (float32 HWC)
+# ---------------------------------------------------------------------------
+
+def resize_bilinear(img, out_h, out_w):
+    """(H, W, C) float32 -> (out_h, out_w, C) bilinear resize."""
+    img = _as_f32(img)
+    h, w, c = img.shape
+    out = np.empty((out_h, out_w, c), np.float32)
+    if AVAILABLE:
+        if not _lib.sg_image_resize_bilinear(_fp(img), h, w, c, _fp(out),
+                                             out_h, out_w):
+            raise ValueError("resize failed")
+        return out
+    ys = np.linspace(0, h - 1, out_h)
+    xs = np.linspace(0, w - 1, out_w)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    return (img[y0][:, x0] * (1 - wy) * (1 - wx) +
+            img[y0][:, x1] * (1 - wy) * wx +
+            img[y1][:, x0] * wy * (1 - wx) +
+            img[y1][:, x1] * wy * wx).astype(np.float32)
+
+
+def crop(img, top, left, ch, cw):
+    img = _as_f32(img)
+    h, w, c = img.shape
+    if AVAILABLE:
+        out = np.empty((ch, cw, c), np.float32)
+        if not _lib.sg_image_crop(_fp(img), h, w, c, _fp(out), top, left,
+                                  ch, cw):
+            raise ValueError("crop out of bounds")
+        return out
+    if top < 0 or left < 0 or top + ch > h or left + cw > w:
+        raise ValueError("crop out of bounds")
+    return img[top:top + ch, left:left + cw].copy()
+
+
+def hflip(img):
+    img = _as_f32(img)
+    h, w, c = img.shape
+    if AVAILABLE:
+        out = np.empty_like(img)
+        _lib.sg_image_hflip(_fp(img), h, w, c, _fp(out))
+        return out
+    return img[:, ::-1].copy()
+
+
+def hwc_to_chw(img):
+    img = _as_f32(img)
+    h, w, c = img.shape
+    if AVAILABLE:
+        out = np.empty((c, h, w), np.float32)
+        _lib.sg_image_hwc_to_chw(_fp(img), h, w, c, _fp(out))
+        return out
+    return np.transpose(img, (2, 0, 1)).copy()
+
+
+def chw_to_hwc(img):
+    img = _as_f32(img)
+    c, h, w = img.shape
+    if AVAILABLE:
+        out = np.empty((h, w, c), np.float32)
+        _lib.sg_image_chw_to_hwc(_fp(img), c, h, w, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)))
+        return out
+    return np.transpose(img, (1, 2, 0)).copy()
+
+
+# ---------------------------------------------------------------------------
+# logging / timer
+# ---------------------------------------------------------------------------
+
+DEBUG, INFO, WARNING, ERROR = 0, 1, 2, 3
+
+
+def log(severity, msg):
+    if AVAILABLE:
+        _lib.sg_log(severity, str(msg).encode())
+    else:
+        import sys
+        names = ["DEBUG", "INFO", "WARNING", "ERROR"]
+        print(f"[singa_native {names[severity]}] {msg}", file=sys.stderr)
+
+
+def set_log_level(level):
+    if AVAILABLE:
+        _lib.sg_set_log_level(int(level))
+
+
+def monotonic_seconds():
+    if AVAILABLE:
+        return float(_lib.sg_monotonic_seconds())
+    import time
+    return time.monotonic()

@@ -1,0 +1,112 @@
+"""Versioned parameter checkpoints.
+
+Capability parity with the reference Snapshot (src/io/snapshot.cc:33-80 and
+python/singa/snapshot.py:42-66): ``<prefix>.bin`` holds named tensors as
+key/value records through the native record-file runtime, and
+``<prefix>.desc`` is a human-readable description (name, shape, dtype) —
+the reference's TensorProto payload is replaced by a compact self-describing
+binary header, and the version tag is carried in the desc file.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .native import RecordReader, RecordWriter
+from .tensor import Tensor
+
+VERSION = 1
+
+
+def _encode_array(arr: np.ndarray) -> bytes:
+    """dtype-str-len u8 | dtype str | ndim u8 | dims u32* | raw bytes"""
+    dt = arr.dtype.str.encode("ascii")
+    out = bytearray()
+    out += len(dt).to_bytes(1, "little")
+    out += dt
+    out += arr.ndim.to_bytes(1, "little")
+    for d in arr.shape:
+        out += int(d).to_bytes(4, "little")
+    out += np.ascontiguousarray(arr).tobytes()
+    return bytes(out)
+
+
+def _decode_array(raw: bytes) -> np.ndarray:
+    n = raw[0]
+    dt = np.dtype(raw[1:1 + n].decode("ascii"))
+    off = 1 + n
+    ndim = raw[off]
+    off += 1
+    shape = []
+    for _ in range(ndim):
+        shape.append(int.from_bytes(raw[off:off + 4], "little"))
+        off += 4
+    return np.frombuffer(raw, dtype=dt, offset=off).reshape(shape).copy()
+
+
+class Snapshot:
+    """Write or read a parameter checkpoint (reference
+    python/singa/snapshot.py:42; kWrite/kRead modes)."""
+
+    kRead = False
+    kWrite = True
+
+    def __init__(self, prefix: str, mode: bool, buffer_size: int = 10):
+        self.prefix = prefix
+        self.mode = mode
+        if mode == self.kWrite:
+            self._writer = RecordWriter(prefix + ".bin")
+            self._desc = open(prefix + ".desc", "w")
+            self._desc.write(f"version: {VERSION}\n")
+        else:
+            if not os.path.exists(prefix + ".bin"):
+                raise FileNotFoundError(prefix + ".bin")
+            self._reader = RecordReader(prefix + ".bin")
+
+    def write(self, param_name: str, param_val) -> None:
+        assert self.mode == self.kWrite, "snapshot opened for read"
+        arr = np.asarray(param_val.numpy()
+                         if isinstance(param_val, Tensor) else param_val)
+        self._writer.write(param_name, _encode_array(arr))
+        self._desc.write(
+            f"name: {param_name} shape: {list(arr.shape)} "
+            f"dtype: {arr.dtype.name}\n")
+
+    def read(self):
+        """All params as an OrderedDict name -> Tensor (reference
+        Snapshot.Read)."""
+        assert self.mode == self.kRead, "snapshot opened for write"
+        from collections import OrderedDict
+        out = OrderedDict()
+        self._reader.seek_to_first()
+        for key, val in self._reader:
+            out[key.decode("utf-8")] = Tensor(data=_decode_array(val),
+                                              requires_grad=False)
+        return out
+
+    def done(self) -> None:
+        if self.mode == self.kWrite:
+            self._writer.close()
+            self._desc.close()
+        else:
+            self._reader.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.done()
+
+
+def save_states(prefix: str, states: dict) -> None:
+    """Convenience: dict of name->Tensor/ndarray to a snapshot."""
+    with Snapshot(prefix, Snapshot.kWrite) as s:
+        for k, v in states.items():
+            s.write(k, v)
+
+
+def load_states(prefix: str) -> dict:
+    with Snapshot(prefix, Snapshot.kRead) as s:
+        return s.read()

@@ -1,0 +1,266 @@
+"""Native IO runtime + codecs + snapshot + data pipeline
+(reference test/singa/test_binfile_rw.cc, test_snapshot.cc,
+test/python data paths)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu import data, image_tool, io, native, snapshot
+from singa_tpu.tensor import Tensor
+
+
+class TestNative:
+    def test_library_loaded(self):
+        # the toolchain is present in CI; the native path must be active
+        assert native.AVAILABLE
+
+    def test_record_roundtrip(self, tmp_path):
+        path = str(tmp_path / "rec.bin")
+        with native.RecordWriter(path) as w:
+            for i in range(100):
+                w.write(f"key{i}", bytes([i % 256]) * (i + 1))
+        with native.RecordReader(path) as r:
+            assert r.count() == 100
+            for i in range(100):
+                k, v = r.read()
+                assert k == f"key{i}".encode()
+                assert v == bytes([i % 256]) * (i + 1)
+            assert r.read() is None
+
+    def test_record_prefetch_thread(self, tmp_path):
+        path = str(tmp_path / "rec.bin")
+        with native.RecordWriter(path) as w:
+            for i in range(500):
+                w.write(f"k{i}", os.urandom(128))
+        with native.RecordReader(path, prefetch=16) as r:
+            n = sum(1 for _ in r)
+        assert n == 500
+
+    def test_seek_to_first(self, tmp_path):
+        path = str(tmp_path / "rec.bin")
+        with native.RecordWriter(path) as w:
+            w.write("a", b"1")
+            w.write("b", b"2")
+        r = native.RecordReader(path)
+        assert r.read()[0] == b"a"
+        r.seek_to_first()
+        assert r.read()[0] == b"a"
+        r.close()
+
+    def test_append_mode(self, tmp_path):
+        path = str(tmp_path / "rec.bin")
+        with native.RecordWriter(path) as w:
+            w.write("a", b"1")
+        with native.RecordWriter(path, append=True) as w:
+            w.write("b", b"2")
+        with native.RecordReader(path) as r:
+            assert r.count() == 2
+
+    def test_resize_bilinear(self):
+        img = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+        out = native.resize_bilinear(img, 2, 2)
+        assert out.shape == (2, 2, 1)
+        np.testing.assert_allclose(out.ravel(), [0, 3, 12, 15])
+
+    def test_crop_hflip(self):
+        img = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        c = native.crop(img, 0, 1, 2, 2)
+        np.testing.assert_array_equal(c, img[:, 1:3])
+        f = native.hflip(img)
+        np.testing.assert_array_equal(f, img[:, ::-1])
+        with pytest.raises(ValueError):
+            native.crop(img, 0, 3, 2, 2)
+
+    def test_layout_swap(self):
+        img = np.random.randn(3, 4, 2).astype(np.float32)
+        chw = native.hwc_to_chw(img)
+        np.testing.assert_array_equal(chw, np.transpose(img, (2, 0, 1)))
+        back = native.chw_to_hwc(chw)
+        np.testing.assert_array_equal(back, img)
+
+    def test_timer_and_log(self):
+        t0 = native.monotonic_seconds()
+        assert native.monotonic_seconds() >= t0
+        native.log(native.INFO, "test message")  # no crash
+
+
+class TestIOClasses:
+    def test_binfile(self, tmp_path):
+        path = str(tmp_path / "f.bin")
+        w = io.BinFileWriter(path)
+        w.Write("k1", b"v1")
+        w.Write("k2", b"v2")
+        w.Close()
+        r = io.BinFileReader(path)
+        assert r.Count() == 2
+        assert r.Read() == (b"k1", b"v1")
+        r.SeekToFirst()
+        assert r.Read() == (b"k1", b"v1")
+        r.Close()
+
+    def test_textfile(self, tmp_path):
+        path = str(tmp_path / "f.txt")
+        w = io.TextFileWriter(path)
+        w.Write(None, "line one")
+        w.Write(None, "line two")
+        w.Close()
+        r = io.TextFileReader(path)
+        assert r.Count() == 2
+        assert r.Read() == ("0", "line one")
+        assert r.Read() == ("1", "line two")
+        assert r.Read() is None
+        r.SeekToFirst()
+        assert r.Read() == ("0", "line one")
+        r.Close()
+
+    def test_lmdb_gated(self):
+        if not io.HAS_LMDB:
+            with pytest.raises(ImportError):
+                io.LMDBWriter("/tmp/x")
+        else:
+            pytest.skip("lmdb installed; gating path not exercised")
+
+    def test_csv_codec(self):
+        enc = io.CSVEncoder()
+        line = enc.Encode(np.array([1.5, -2.25]), label=3)
+        label, feats = io.CSVDecoder().Decode(line)
+        assert label == 3
+        np.testing.assert_allclose(feats, [1.5, -2.25])
+        label, feats = io.CSVDecoder(has_label=False).Decode("0.5,1.5")
+        assert label is None
+        np.testing.assert_allclose(feats, [0.5, 1.5])
+
+    def test_jpg_codec(self):
+        img = (np.random.rand(16, 16, 3) * 255).astype(np.float32)
+        raw = io.JPGEncoder().Encode(img)
+        assert raw[:2] == b"\xff\xd8"  # JPEG SOI
+        dec = io.JPGDecoder().Decode(raw)
+        assert dec.shape == (3, 16, 16)  # CHW
+        # lossy codec: just check the ballpark
+        assert abs(dec.mean() - img.mean()) < 16
+
+    def test_image_transformer_train_eval(self):
+        tr = io.ImageTransformer(resize_height=8, resize_width=8,
+                                 crop_shape=(4, 4), horizontal_mirror=True,
+                                 image_dim_order="CHW")
+        img = np.random.rand(3, 10, 12).astype(np.float32)
+        out = tr.Apply("train", img)
+        assert out.shape == (3, 4, 4)
+        out = tr.Apply("eval", img)
+        assert out.shape == (3, 4, 4)
+        # eval is deterministic
+        np.testing.assert_array_equal(out, tr.Apply("eval", img))
+
+
+class TestSnapshot:
+    def test_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "ckpt")
+        params = {
+            "conv1.W": np.random.randn(4, 3, 3, 3).astype(np.float32),
+            "fc.b": np.random.randn(10).astype(np.float32),
+            "step": np.asarray(7, np.int64),
+        }
+        with snapshot.Snapshot(prefix, snapshot.Snapshot.kWrite) as s:
+            for k, v in params.items():
+                s.write(k, v)
+        assert os.path.exists(prefix + ".bin")
+        assert os.path.exists(prefix + ".desc")
+        loaded = snapshot.load_states(prefix)
+        assert set(loaded) == set(params)
+        for k in params:
+            np.testing.assert_array_equal(loaded[k].numpy(), params[k])
+        desc = open(prefix + ".desc").read()
+        assert "conv1.W" in desc and "version" in desc
+
+    def test_tensor_values(self, tmp_path):
+        prefix = str(tmp_path / "ck2")
+        t = Tensor(data=np.array([1.0, 2.0], np.float32),
+                   requires_grad=False)
+        snapshot.save_states(prefix, {"w": t})
+        out = snapshot.load_states(prefix)
+        np.testing.assert_array_equal(out["w"].numpy(), [1.0, 2.0])
+
+
+class TestImageTool:
+    def _img(self, w=32, h=24):
+        from PIL import Image
+        arr = (np.random.rand(h, w, 3) * 255).astype(np.uint8)
+        return Image.fromarray(arr)
+
+    def test_crops(self):
+        img = self._img()
+        for pos in ("left_top", "center", "right_bottom"):
+            c = image_tool.crop(img, (8, 8), pos)
+            assert c.size == (8, 8)
+        c = image_tool.crop_and_resize(img, (8, 8), "center")
+        assert c.size == (8, 8)
+
+    def test_resize(self):
+        img = self._img(40, 20)
+        out = image_tool.resize(img, 10)
+        assert min(out.size) == 10
+        out = image_tool.resize_by_hw(img, (6, 8))
+        assert out.size == (8, 6)
+
+    def test_chain(self):
+        tool = image_tool.ImageTool()
+        tool.set([self._img()])
+        tool.resize_by_list([16]).crop5((8, 8), num_case=5)
+        assert tool.num_augmentation() == 5
+        assert all(im.size == (8, 8) for im in tool.get())
+
+    def test_flip_and_photometric(self):
+        tool = image_tool.ImageTool().set([self._img()])
+        out = tool.flip(num_case=2, inplace=False)
+        assert len(out) == 2
+        tool.color_cast(offset=10).enhance(scale=0.1)
+        assert tool.num_augmentation() == 1
+
+    def test_random_crops(self):
+        tool = image_tool.ImageTool().set([self._img()])
+        tool.random_crop((8, 8))
+        assert tool.get()[0].size == (8, 8)
+        tool.set([self._img()]).random_crop_resize((5, 5))
+        assert tool.get()[0].size == (5, 5)
+
+
+class TestDataPipeline:
+    def test_numpy_batch_iter(self):
+        x = np.arange(100, dtype=np.float32).reshape(50, 2)
+        y = np.arange(50)
+        it = data.NumpyBatchIter(x, y, batch_size=8)
+        batches = list(it)
+        assert len(batches) == 6
+        assert batches[0][0].shape == (8, 2)
+        seen = np.concatenate([b[1] for b in batches])
+        assert len(set(seen.tolist())) == 48  # shuffled, no dup
+
+    def test_image_batch_iter(self, tmp_path):
+        from PIL import Image
+        n = 12
+        for i in range(n):
+            arr = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(tmp_path / f"im{i}.jpg")
+        list_file = tmp_path / "list.txt"
+        with open(list_file, "w") as f:
+            for i in range(n):
+                f.write(f"im{i}.jpg {i % 3}\n")
+
+        def transform(path):
+            img = image_tool.ImageTool().load(path).get()[0]
+            return [np.transpose(np.asarray(img, np.float32), (2, 0, 1))]
+
+        it = data.ImageBatchIter(str(list_file), 4, transform,
+                                 shuffle=True, image_folder=str(tmp_path))
+        assert it.num_samples == n
+        it.start()
+        try:
+            imgs, labels = next(it)
+            assert imgs.shape == (4, 3, 8, 8)
+            assert labels.shape == (4,)
+            imgs2, _ = next(it)
+            assert imgs2.shape == (4, 3, 8, 8)
+        finally:
+            it.end()
