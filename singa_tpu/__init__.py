@@ -1,13 +1,16 @@
 """singa_tpu — a TPU-native deep learning framework.
 
 A from-scratch, idiomatic JAX/XLA/Pallas re-design with the capabilities of
-Apache SINGA (reference layer map in SURVEY.md). Currently shipped: the
-Tensor/Device core, a define-by-run autograd engine whose graph mode is
-``jax.jit``, the layer / model / optimizer Python API (with checkpoint
-save/load on Model), and a distributed optimizer on mesh collectives.
+Apache SINGA (reference layer map in SURVEY.md): the Tensor/Device core, a
+define-by-run autograd engine whose graph mode is ``jax.jit``, the layer /
+model / optimizer Python API (with checkpoint save/load on Model), a
+distributed optimizer on mesh collectives, ONNX import/export, a native
+C++ IO runtime (record files, codecs, image transforms), snapshot
+checkpoints, data pipelines, metrics, and a Sequential-style trainer.
 
 Import style matches the reference package (``from singa import ...`` →
-``from singa_tpu import ...``).
+``from singa_tpu import ...``). Heavier subsystems (sonnx, io, data,
+image_tool, net, snapshot) import lazily via __getattr__.
 """
 
 __version__ = "0.1.0"
@@ -21,6 +24,19 @@ from . import opt           # noqa: F401
 from . import initializer   # noqa: F401
 from . import ops           # noqa: F401
 from . import parallel      # noqa: F401
+from . import metric        # noqa: F401
+from . import utils         # noqa: F401
 
 from .tensor import Tensor  # noqa: F401
 from .model import Model    # noqa: F401
+
+_LAZY = ("sonnx", "io", "data", "image_tool", "net", "snapshot", "native")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,64 @@
+"""Evaluation metrics.
+
+Capability parity with the reference metric stack (include/singa/model/
+metric.h:32-69 ``Metric``/``Accuracy`` and the per-example accuracy helper
+used in the examples, examples/cnn/train_cnn.py:49-54).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Metric:
+    """Base metric (reference include/singa/model/metric.h:32)."""
+
+    def forward(self, prediction, target):
+        """Per-sample scores as a float array."""
+        raise NotImplementedError
+
+    def evaluate(self, prediction, target):
+        """Mean score over the batch."""
+        return float(np.mean(self.forward(prediction, target)))
+
+    # C++-style aliases; delegate so subclass overrides dispatch correctly
+    def Forward(self, prediction, target):
+        return self.forward(prediction, target)
+
+    def Evaluate(self, prediction, target):
+        return self.evaluate(prediction, target)
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference include/singa/model/metric.h:59-77).
+
+    ``target`` may be integer class ids or one-hot rows.
+    """
+
+    def __init__(self, top_k=1):
+        self.top_k = top_k
+
+    def forward(self, prediction, target):
+        pred = _np(prediction)
+        tgt = _np(target)
+        if tgt.ndim == pred.ndim:
+            tgt = np.argmax(tgt, axis=-1)
+        tgt = tgt.astype(np.int64).ravel()
+        if self.top_k == 1:
+            return (np.argmax(pred, axis=-1).ravel() == tgt) \
+                .astype(np.float32)
+        topk = np.argsort(-pred, axis=-1)[:, :self.top_k]
+        return np.any(topk == tgt[:, None], axis=-1).astype(np.float32)
+
+
+def accuracy(pred, target):
+    """Batch accuracy as a float (reference examples/cnn/train_cnn.py:49)."""
+    return Accuracy().evaluate(pred, target)

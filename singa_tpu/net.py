@@ -1,0 +1,154 @@
+"""Sequential convenience trainer.
+
+Capability parity with the reference's legacy C++ training API
+(``FeedForwardNet`` include/singa/model/feed_forward_net.h:63-116:
+``Add``/``Compile``/``Train``/``TrainOnBatch``/``Evaluate``/``Predict``
+with a shuffled epoch loop) — rebuilt on the modern Model machinery so the
+per-batch step jits into one XLA computation instead of a layer-by-layer
+walk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import layer as layer_mod
+from .data import NumpyBatchIter
+from .metric import Accuracy
+from .model import Model
+from .tensor import Tensor
+from .utils import update_progress
+
+
+class FeedForwardNet(Model):
+    """Stack of layers trained with a (loss, optimizer, metric) triple."""
+
+    def __init__(self, loss=None, metric=None):
+        super().__init__()
+        self._layers = []
+        self.loss_fn = loss or layer_mod.SoftMaxCrossEntropy()
+        self.metric = metric or Accuracy()
+        self._verbose = True
+
+    def add(self, lyr):
+        """Append a layer (reference FeedForwardNet::Add)."""
+        self._layers.append(lyr)
+        # register for param naming
+        setattr(self, f"l{len(self._layers) - 1}", lyr)
+        return lyr
+
+    # -- Model hooks -------------------------------------------------------
+    def forward(self, x):
+        for lyr in self._layers:
+            x = lyr(x)
+        return x
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+    # -- reference-style training API --------------------------------------
+    def compile_net(self, optimizer, inputs, loss=None, metric=None,
+                    use_graph=True):
+        """(reference FeedForwardNet::Compile feed_forward_net.h:63-73)"""
+        if loss is not None:
+            self.loss_fn = loss
+        if metric is not None:
+            self.metric = metric
+        self.set_optimizer(optimizer)
+        self.compile([inputs] if isinstance(inputs, Tensor) else inputs,
+                     is_train=True, use_graph=use_graph)
+
+    def fit(self, x, y, batch_size=32, epochs=1, shuffle=True,
+            dev=None, verbose=True):
+        """Epoch loop with shuffling (reference FeedForwardNet::Train
+        feed_forward_net.h:82-90; named ``fit`` because ``Model.train``
+        toggles the mode). Returns (loss, metric) history per epoch."""
+        if not self._compiled:
+            raise RuntimeError("call compile_net(optimizer, sample) first")
+        if len(x) < batch_size:
+            raise ValueError(
+                f"dataset of {len(x)} samples is smaller than batch_size "
+                f"{batch_size}; no full batch to train on (tails are "
+                "dropped to keep compiled-step shapes static)")
+        dev = dev or self.dev
+        history = []
+        for epoch in range(epochs):
+            it = NumpyBatchIter(np.asarray(x), np.asarray(y), batch_size,
+                                shuffle=shuffle, seed=epoch)
+            losses, metrics = [], []
+            nb = it.num_batches
+            for i, (bx, by) in enumerate(it):
+                out, loss = self.train_on_batch(bx, by, dev)
+                losses.append(float(loss.data))
+                metrics.append(self.metric.evaluate(out, by))
+                if verbose:
+                    update_progress(
+                        (i + 1) / nb,
+                        f"epoch {epoch} loss {np.mean(losses):.4f} "
+                        f"metric {np.mean(metrics):.4f}")
+            history.append((float(np.mean(losses)),
+                            float(np.mean(metrics))))
+        return history
+
+    def train_on_batch(self, x, y, dev=None):
+        """(reference FeedForwardNet::TrainOnBatch :92)"""
+        dev = dev or self.dev
+        tx = x if isinstance(x, Tensor) else Tensor(
+            data=np.asarray(x, np.float32), device=dev, requires_grad=False)
+        ty = y if isinstance(y, Tensor) else Tensor(
+            data=np.asarray(y, np.float32), device=dev, requires_grad=False)
+        return self(tx, ty)
+
+    def evaluate(self, x, y, batch_size=32, dev=None):
+        """Mean (loss, metric) without updates
+        (reference FeedForwardNet::Evaluate :103)."""
+        dev = dev or self.dev
+        was_training = self._train
+        self.eval()
+        losses, metrics, weights = [], [], []
+        try:
+            it = NumpyBatchIter(np.asarray(x), np.asarray(y), batch_size,
+                                shuffle=False, drop_last=False)
+            for bx, by in it:
+                tx = Tensor(data=np.asarray(bx, np.float32), device=dev,
+                            requires_grad=False)
+                ty = Tensor(data=np.asarray(by, np.float32), device=dev,
+                            requires_grad=False)
+                out = self(tx)
+                losses.append(float(self.loss_fn(out, ty).data))
+                metrics.append(self.metric.evaluate(out, by))
+                weights.append(len(bx))
+        finally:
+            if was_training:
+                self.train(True)
+        # per-sample average: the tail batch must not be over-weighted
+        return (float(np.average(losses, weights=weights)),
+                float(np.average(metrics, weights=weights)))
+
+    def predict(self, x, batch_size=32, dev=None):
+        """Forward in eval mode (reference FeedForwardNet::Predict :109)."""
+        dev = dev or self.dev
+        was_training = self._train
+        self.eval()
+        outs = []
+        try:
+            n = len(x)
+            for b in range(0, n, batch_size):
+                tx = Tensor(data=np.asarray(x[b:b + batch_size],
+                                            np.float32),
+                            device=dev, requires_grad=False)
+                outs.append(np.asarray(self(tx).data))
+        finally:
+            if was_training:
+                self.train(True)
+        return np.concatenate(outs, axis=0)
+
+    # C++-style aliases (reference FeedForwardNet::Train/Evaluate/Predict)
+    Train = fit
+    TrainOnBatch = train_on_batch
+    Evaluate = evaluate
+    Predict = predict
+    Add = add

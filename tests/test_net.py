@@ -1,0 +1,114 @@
+"""FeedForwardNet trainer, metrics, utils (reference
+src/model/feed_forward_net.cc tests + include/singa/model/metric.h)."""
+
+
+
+
+import numpy as np
+
+from singa_tpu import device, layer, metric, net, opt, utils
+
+
+DEV = device.create_cpu_device()
+
+
+def make_data(n=200, din=10, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype(np.float32)
+    w = rng.randn(din, classes).astype(np.float32)
+    yi = np.argmax(x @ w, axis=1)
+    return x, np.eye(classes, dtype=np.float32)[yi], yi
+
+
+class TestMetric:
+    def test_accuracy_top1(self):
+        pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        target = np.array([1, 0, 0])
+        m = metric.Accuracy()
+        np.testing.assert_array_equal(m.forward(pred, target), [1, 1, 0])
+        assert abs(m.evaluate(pred, target) - 2 / 3) < 1e-6
+
+    def test_accuracy_onehot_target(self):
+        pred = np.array([[0.1, 0.9], [0.8, 0.2]])
+        onehot = np.array([[0, 1], [0, 1]], np.float32)
+        assert metric.Accuracy().evaluate(pred, onehot) == 0.5
+
+    def test_accuracy_topk(self):
+        pred = np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+        target = np.array([1, 0])
+        assert metric.Accuracy(top_k=2).evaluate(pred, target) == 0.5
+        assert metric.Accuracy(top_k=3).evaluate(pred, target) == 1.0
+
+    def test_free_fn(self):
+        pred = np.array([[0.9, 0.1]])
+        assert metric.accuracy(pred, np.array([0])) == 1.0
+
+
+class TestFeedForwardNet:
+    def _build(self, use_graph=True):
+        x, y, _ = make_data()
+        from singa_tpu.tensor import Tensor
+        tx = Tensor(data=x[:32], device=DEV, requires_grad=False)
+        ffn = net.FeedForwardNet()
+        ffn.add(layer.Linear(16))
+        ffn.add(layer.ReLU())
+        ffn.add(layer.Linear(3))
+        ffn.compile_net(opt.SGD(lr=0.3, momentum=0.9), tx,
+                        use_graph=use_graph)
+        return ffn, x, y
+
+    def test_fit_improves(self):
+        ffn, x, y = self._build()
+        hist = ffn.fit(x, y, batch_size=32, epochs=3, verbose=False)
+        assert hist[-1][0] < hist[0][0]      # loss falls
+        assert hist[-1][1] > hist[0][1]      # metric rises
+        assert hist[-1][1] > 0.7
+
+    def test_evaluate_and_predict(self):
+        ffn, x, y = self._build()
+        ffn.fit(x, y, batch_size=32, epochs=3, verbose=False)
+        loss, acc = ffn.evaluate(x, y, batch_size=64)
+        assert acc > 0.7
+        preds = ffn.predict(x[:50], batch_size=16)
+        assert preds.shape == (50, 3)
+        # predict ran in eval mode and left training mode restored
+        assert ffn._train
+
+    def test_cpp_style_aliases(self):
+        ffn, x, y = self._build()
+        out, loss = ffn.TrainOnBatch(x[:32], y[:32])
+        assert out.shape == (32, 3)
+        ffn.Evaluate(x[:64], y[:64])
+        assert ffn.Predict(x[:8]).shape == (8, 3)
+
+
+class TestUtils:
+    def test_update_progress(self, capsys):
+        utils.update_progress(0.5, "info")
+        utils.update_progress(1.0, "info")
+        out = capsys.readouterr().out
+        assert "50.0%" in out and "Done" in out
+
+    def test_same_padding_shape(self):
+        pads = utils.get_padding_shape("SAME_UPPER", (5, 5), (3, 3), (1, 1))
+        assert pads == [(1, 1), (1, 1)]
+        pads = utils.get_padding_shape("SAME_UPPER", (5, 5), (2, 2), (2, 2))
+        assert pads == [(0, 1), (0, 1)]
+        pads = utils.get_padding_shape("SAME_LOWER", (5, 5), (2, 2), (2, 2))
+        assert pads == [(1, 0), (1, 0)]
+
+    def test_output_shape(self):
+        assert utils.get_output_shape("SAME_UPPER", (5, 5), (3, 3),
+                                      (2, 2)) == [3, 3]
+        assert utils.get_output_shape("VALID", (5, 5), (3, 3),
+                                      (1, 1)) == [3, 3]
+
+    def test_odd_pad_fwd(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = utils.handle_odd_pad_fwd(x, (1, 0, 0, 1))
+        assert out.shape == (1, 1, 3, 3)
+        assert float(np.asarray(out)[0, 0, 0, 0]) == 0.0
+
+    def test_force_unicode(self):
+        assert utils.force_unicode(b"abc") == "abc"
+        assert utils.force_unicode("abc") == "abc"
