@@ -698,6 +698,24 @@ class Identity(Operator):
         return x
 
 
+class _LayerNorm(Operator):
+    """Normalise over the trailing dim, then scale+shift (TPU extension
+    used by the transformer family)."""
+
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def forward(self, x, scale, bias):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+
+
+def layernorm(x, scale, bias, eps=1e-5):
+    return _LayerNorm(eps)(x, scale, bias)
+
+
 class Dropout(Operator):
     def __init__(self, ratio=0.5):
         super().__init__()

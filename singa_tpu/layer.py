@@ -405,6 +405,27 @@ class BatchNorm2d(Layer):
                 "running_var": self.running_var}
 
 
+class LayerNorm(Layer):
+    """Layer normalisation over the trailing dim (TPU extension: the
+    transformer family needs it; not in the reference layer zoo)."""
+
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def initialize(self, x):
+        d = (x.shape[-1],)
+        self.scale = _param(d, x.device, init="ones")
+        self.bias = _param(d, x.device)
+
+    def forward(self, x):
+        from .autograd import _LayerNorm
+        return _LayerNorm(self.eps)(x, self.scale, self.bias)
+
+    def _own_params(self):
+        return {"scale": self.scale, "bias": self.bias}
+
+
 class Pooling2d(Layer):
     """Base pooling layer (reference layer.Pooling2d:891)."""
 

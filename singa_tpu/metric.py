@@ -49,13 +49,14 @@ class Accuracy(Metric):
     def forward(self, prediction, target):
         pred = _np(prediction)
         tgt = _np(target)
-        if tgt.ndim == pred.ndim:
-            tgt = np.argmax(tgt, axis=-1)
+        if tgt.shape == pred.shape:
+            tgt = np.argmax(tgt, axis=-1)  # one-hot rows
+        # anything else ((B,), (B,1), (B,S) vs (B,S,V), ...) is int labels
         tgt = tgt.astype(np.int64).ravel()
+        pred2d = pred.reshape(-1, pred.shape[-1])
         if self.top_k == 1:
-            return (np.argmax(pred, axis=-1).ravel() == tgt) \
-                .astype(np.float32)
-        topk = np.argsort(-pred, axis=-1)[:, :self.top_k]
+            return (np.argmax(pred2d, axis=-1) == tgt).astype(np.float32)
+        topk = np.argsort(-pred2d, axis=-1)[:, :self.top_k]
         return np.any(topk == tgt[:, None], axis=-1).astype(np.float32)
 
 

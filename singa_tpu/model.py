@@ -170,8 +170,11 @@ class Model(Layer):
 
         def fn(state_arrays, rng_key, *input_arrays):
             if dist is not None:
-                rng_key = jax.random.fold_in(
-                    rng_key, jax.lax.axis_index(dist.axis_name))
+                # distinct rng per batch-shard (data and, under sequence
+                # parallelism, seq); model-parallel members share the key
+                for ax in dist.communicator.reduce_axes:
+                    rng_key = jax.random.fold_in(
+                        rng_key, jax.lax.axis_index(ax))
             for t, a in zip(state_list, state_arrays):
                 t.data = a
             self.dev._set_rng_state(rng_key)
@@ -181,11 +184,12 @@ class Model(Layer):
             leaves = []
             out_tree["tree"] = _flatten(res, leaves)
             if dist is not None:
-                # outputs that are not batch-leading (loss scalars, metrics,
-                # param snapshots) are averaged across shards so the
-                # replicated out-spec is sound
-                mask = self._shard_mask
-                leaves = [x if mask[i] else jax.lax.pmean(x, dist.axis_name)
+                # output leaves that end up replicated (loss scalars,
+                # metrics, param snapshots) are averaged across batch-like
+                # shards so the replicated out-spec is sound
+                specs = self._leaf_specs
+                raxes = tuple(dist.communicator.reduce_axes)
+                leaves = [x if specs[i] != P() else jax.lax.pmean(x, raxes)
                           for i, x in enumerate(leaves)]
             new_state = [t.data for t in state_list]
             return new_state, leaves
@@ -203,7 +207,9 @@ class Model(Layer):
             axis = dist.axis_name
 
             def body(state_arrays, rng_key, *input_arrays):
-                with collective_context(axis):
+                # register every mesh axis: tensor/sequence-parallel layers
+                # issue collectives on 'model'/'seq', DistOpt on 'data'
+                with collective_context(*mesh.axis_names):
                     return fn(state_arrays, rng_key, *input_arrays)
 
             def build(sample_inputs, rng):
@@ -216,11 +222,25 @@ class Model(Layer):
                 self._shard_mask = [
                     jnp.asarray(x).ndim >= 1 and
                     jnp.asarray(x).shape[0] == full_batch for x in leaves]
-                in_specs = ([P()] * len(state_list), P(),
-                            *[P(axis) for _ in range(n_inputs)])
-                out_specs = ([P()] * len(state_list),
-                             [P(axis) if m else P()
-                              for m in self._shard_mask])
+                # per-state sharding: tensor-parallel weights announce a
+                # PartitionSpec via Tensor.spec; everything else replicates
+                state_specs = [t.spec if t.spec is not None else P()
+                               for t in state_list]
+                self._state_specs = state_specs
+                # per-input layouts: Model.input_specs overrides the default
+                # batch-on-'data' sharding (sequence parallelism shards
+                # dim 1 over 'seq': P('data', 'seq'))
+                user_in = getattr(self, "input_specs", None)
+                self._input_specs = list(user_in) if user_in is not None \
+                    else [P(axis)] * n_inputs
+                in_specs = (state_specs, P(), *self._input_specs)
+                # per-output-leaf layouts: Model.output_specs (flattened
+                # leaf order) overrides the default "batch-leading leaves
+                # shard on 'data', everything else replicates"
+                user_out = getattr(self, "output_specs", None)
+                self._leaf_specs = list(user_out) if user_out is not None \
+                    else [P(axis) if m else P() for m in self._shard_mask]
+                out_specs = (state_specs, self._leaf_specs)
                 import inspect
                 kw = {}
                 sig = inspect.signature(shard_map).parameters
@@ -264,9 +284,16 @@ class Model(Layer):
         if self._dist is not None:
             from jax.sharding import NamedSharding
             rep = NamedSharding(self._mesh, P())
-            shd = NamedSharding(self._mesh, P(self._axis))
-            state_arrays = [jax.device_put(a, rep) for a in state_arrays]
-            input_arrays = [jax.device_put(a, shd) for a in input_arrays]
+            specs = getattr(self, "_state_specs", None) or \
+                [P()] * len(state_arrays)
+            state_arrays = [
+                jax.device_put(a, NamedSharding(self._mesh, s))
+                for a, s in zip(state_arrays, specs)]
+            in_specs = getattr(self, "_input_specs", None) or \
+                [P(self._axis)] * len(input_arrays)
+            input_arrays = [
+                jax.device_put(a, NamedSharding(self._mesh, s))
+                for a, s in zip(input_arrays, in_specs)]
             rng = jax.device_put(rng, rep)
         t0 = time.perf_counter()
         new_state, leaves = self._jit_step(state_arrays, rng,
@@ -280,6 +307,18 @@ class Model(Layer):
             t.data = a
         return _unflatten(self._out_tree["tree"], list(leaves), self.dev)
 
+    def _unshard_state(self):
+        """After mesh-sharded training the live state arrays span the mesh;
+        gather them to the model device so eager (eval) ops can mix them
+        with single-device inputs."""
+        if self._state_list is None:
+            return
+        for t in self._state_list:
+            arr = t.data
+            if hasattr(arr, "devices") and not isinstance(
+                    arr, jax.core.Tracer) and len(arr.devices()) > 1:
+                t.data = self.dev.put(np.asarray(jax.device_get(arr)))
+
     def __call__(self, *args, **kwargs):
         if self._train:
             if kwargs:
@@ -288,6 +327,8 @@ class Model(Layer):
                     "(the compiled step is positional); got keyword "
                     f"arguments {sorted(kwargs)}")
             return self._run_step(*args)
+        if self._dist is not None:
+            self._unshard_state()
         prev = CTX.training
         CTX.training = False
         try:

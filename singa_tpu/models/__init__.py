@@ -31,4 +31,5 @@ class TrainStepMixin:
             raise ValueError(f"unknown dist_option {dist_option!r}")
 
 
-from . import mlp, cnn, alexnet, resnet, xceptionnet  # noqa: F401,E402
+from . import (mlp, cnn, alexnet, resnet, xceptionnet,  # noqa: F401,E402
+               transformer)

@@ -1,0 +1,156 @@
+"""Decoder-only Transformer LM — the flagship long-context model.
+
+TPU-first design (no reference equivalent; the reference's only attention
+is composed from primitive ops in examples/qabot): pre-norm GPT-style
+blocks whose attention is the fused flash kernel (ops/attention.py), with
+three composable parallelism modes driven by the mesh:
+
+- data parallel: batch over 'data' (DistOpt psum, like every model here);
+- tensor parallel (``tp=True``): qkv and MLP-up as ColumnParallelLinear,
+  out-proj and MLP-down as RowParallelLinear — heads shard over 'model',
+  two all-reduces per block (Megatron layout);
+- sequence parallel (``seq_axis='seq'``): tokens shard over 'seq'; the
+  attention switches to ring attention (k/v rotate over ICI) and the
+  caller sets ``Model.input_specs = [P('data', 'seq'), ...]``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import autograd, layer, model
+from ..parallel import tensor_parallel as tp_mod
+from ..ops.attention import attention
+from ..tensor import Tensor
+
+
+class _Positions(autograd.Operator):
+    """Global position ids for a (possibly sequence-sharded) token block."""
+
+    differentiable = False
+
+    def __init__(self, seq_axis=None):
+        super().__init__()
+        self.seq_axis = seq_axis
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+        from jax import lax
+        from ..parallel.communicator import active_axis
+        S = ids.shape[1]
+        pos = jnp.arange(S)
+        if self.seq_axis and active_axis(self.seq_axis):
+            pos = pos + lax.axis_index(self.seq_axis) * S
+        return jnp.broadcast_to(pos[None, :], ids.shape).astype(jnp.float32)
+
+
+class MultiHeadAttention(layer.Layer):
+    """Fused-attention MHA; optionally tensor-parallel over heads and/or
+    sequence-parallel (ring) over tokens."""
+
+    def __init__(self, d_model, n_heads, causal=True, tp=True,
+                 seq_axis=None, axis_name="model"):
+        """``tp`` is accepted for API compatibility but the layout is
+        mesh-driven: the parallel layers degrade to plain Linear on a
+        size-1 'model' axis (or outside any mesh), so there is exactly one
+        code path — and one state-dict layout — for every topology."""
+        super().__init__()
+        assert d_model % n_heads == 0
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.head_dim = d_model // n_heads
+        self.causal = causal
+        self.seq_axis = seq_axis
+        # three separate column-parallel projections: a fused qkv matrix
+        # would shard its columns across the [q|k|v] boundary
+        self.q_proj = tp_mod.ColumnParallelLinear(d_model,
+                                                  axis_name=axis_name)
+        self.k_proj = tp_mod.ColumnParallelLinear(d_model,
+                                                  axis_name=axis_name)
+        self.v_proj = tp_mod.ColumnParallelLinear(d_model,
+                                                  axis_name=axis_name)
+        self.proj = tp_mod.RowParallelLinear(d_model, axis_name=axis_name)
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        q = self.q_proj(x)                      # (B, S, d_local)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        d_local = q.shape[-1]
+        h_local = d_local // self.head_dim      # heads on this shard
+
+        def split_heads(t):
+            t = autograd.reshape(t, (B, S, h_local, self.head_dim))
+            return autograd.transpose(t, (0, 2, 1, 3))  # (B, H, S, D)
+
+        out = attention(split_heads(q), split_heads(k), split_heads(v),
+                        causal=self.causal, seq_axis=self.seq_axis)
+        out = autograd.transpose(out, (0, 2, 1, 3))
+        out = autograd.reshape(out, (B, S, d_local))
+        return self.proj(out)
+
+
+class TransformerBlock(layer.Layer):
+    def __init__(self, d_model, n_heads, d_ff=None, causal=True, tp=True,
+                 seq_axis=None):
+        super().__init__()
+        d_ff = d_ff or 4 * d_model
+        self.ln1 = layer.LayerNorm()
+        self.attn = MultiHeadAttention(d_model, n_heads, causal, tp,
+                                       seq_axis)
+        self.ln2 = layer.LayerNorm()
+        self.mlp = tp_mod.TPMLP(d_ff, d_model, activation="gelu")
+
+    def forward(self, x):
+        x = autograd.add(x, self.attn(self.ln1(x)))
+        return autograd.add(x, self.mlp(self.ln2(x)))
+
+
+class TransformerLM(model.Model):
+    """GPT-style language model with next-token loss.
+
+    ``train_one_batch(ids, targets)`` takes float tensors of token ids and
+    target ids, both (B, S) ((B, S/n) per shard under sequence parallel).
+    """
+
+    def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=2,
+                 max_len=1024, causal=True, tp=True, seq_axis=None):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.tok_emb = layer.Embedding(vocab_size, d_model)
+        self.pos_emb = layer.Embedding(max_len, d_model)
+        self._pos = _Positions(seq_axis)
+        self.blocks = [TransformerBlock(d_model, n_heads, causal=causal,
+                                        tp=tp, seq_axis=seq_axis)
+                       for i in range(n_layers)]
+        self.ln_f = layer.LayerNorm()
+        self.head = layer.Linear(vocab_size)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, ids):
+        pos = self._pos(ids)
+        x = autograd.add(self.tok_emb(ids), self.pos_emb(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.ln_f(x))          # (B, S, vocab)
+
+    def train_one_batch(self, ids, targets):
+        logits = self.forward(ids)
+        B, S, V = logits.shape
+        flat = autograd.reshape(logits, (B * S, V))
+        onehot = autograd.onehot(-1, targets, self.vocab_size)
+        oh_flat = autograd.reshape(onehot, (B * S, V))
+        loss = autograd.softmax_cross_entropy(flat, oh_flat)
+        self.optimizer(loss)
+        return logits, loss
+
+
+def create_model(vocab_size=256, **kwargs):
+    return TransformerLM(vocab_size, **kwargs)
+
+
+__all__ = ["TransformerLM", "TransformerBlock", "MultiHeadAttention",
+           "create_model"]

@@ -11,6 +11,8 @@ from .batchnorm import BatchNormHandle, _BatchNorm2d, batchnorm_2d
 from .pooling import (PoolingHandle, _Pooling2d, pooling_2d,
                       GlobalAveragePool, globalaveragepool)
 from .rnn import CudnnRNNHandle, _RNN, rnn_op
+from .attention import (flash_attention, ring_attention, attention,
+                        _FlashAttention, _RingAttention)
 
 __all__ = [
     "ConvHandle", "_Conv2d", "conv2d",
@@ -18,4 +20,6 @@ __all__ = [
     "PoolingHandle", "_Pooling2d", "pooling_2d",
     "GlobalAveragePool", "globalaveragepool",
     "CudnnRNNHandle", "_RNN", "rnn_op",
+    "flash_attention", "ring_attention", "attention",
+    "_FlashAttention", "_RingAttention",
 ]

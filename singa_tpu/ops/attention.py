@@ -1,0 +1,303 @@
+"""Attention: fused flash kernel + ring (sequence-parallel) attention.
+
+TPU-first components with no reference equivalent (the reference composes
+attention from primitive autograd ops in examples and has no sequence
+parallelism — SURVEY.md §5 'long-context: absent'); these are the
+long-context machinery the TPU build makes first-class:
+
+- :func:`flash_attention` — blocked online-softmax attention. On TPU the
+  forward runs as a Pallas kernel (grid over (batch*heads, q-blocks),
+  streaming k/v blocks through VMEM with running max/sum accumulators, so
+  the S×S score matrix never hits HBM). Elsewhere (CPU mesh tests) an
+  identical-math `lax.scan` implementation runs. Backward recomputes
+  per-block scores (flash style) via the scan path under `jax.custom_vjp`.
+- :func:`ring_attention` — q/k/v sharded over a 'seq' mesh axis inside
+  `shard_map`; k/v blocks rotate around the ICI ring via `lax.ppermute`
+  while each device folds them into its online-softmax accumulator.
+  Communication overlaps compute; memory per chip is O(S/n · S/n).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd_base import Operator
+
+_NEG_INF = -1e30
+
+
+def _block_scan_attention(q, k, v, causal, scale, block_k,
+                          q_offset=0, k_offset=0):
+    """Online-softmax attention, scanning over key blocks.
+
+    q: (B, H, Sq, D), k/v: (B, H, Sk, D). Returns (out, m, l) so partial
+    results can be merged (ring attention needs the accumulators).
+    ``q_offset``/``k_offset`` are global position offsets for causal
+    masking of sharded sequences.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_k = min(block_k, Sk)
+    nblocks = (Sk + block_k - 1) // block_k
+    pad = nblocks * block_k - Sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
+
+    q_pos = q_offset + jnp.arange(Sq)
+
+    def step(carry, inputs):
+        out, m, l = carry
+        blk_idx, kblk, vblk = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk,
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = k_offset + blk_idx * block_k + jnp.arange(block_k)
+        mask = k_pos[None, :] < (Sk + k_offset)  # padding mask
+        if causal:
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        out_new = out * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return (out_new, m_new, l_new), None
+
+    # derive accumulators from q so they carry its shard_map varying-axes
+    # type (fresh zeros would be 'unvarying' and fail the scan typecheck)
+    zero = q.astype(jnp.float32) * 0.0
+    init = (zero,
+            jnp.max(zero, axis=-1) + _NEG_INF,
+            jnp.sum(zero, axis=-1))
+    (out, m, l), _ = lax.scan(
+        step, init, (jnp.arange(nblocks), kb, vb))
+    return out, m, l
+
+
+def _merge_partials(out, m, l):
+    """Normalise a streamed accumulator into the final attention output."""
+    return (out / jnp.maximum(l, 1e-30)[..., None])
+
+
+def _reference_attention(q, k, v, causal, scale, block_k=512):
+    out, m, l = _block_scan_attention(q.astype(jnp.float32),
+                                      k.astype(jnp.float32),
+                                      v.astype(jnp.float32),
+                                      causal, scale, block_k)
+    return _merge_partials(out, m, l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
+                      scale, seq_k, block_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # (block_q, D)
+    nkb = seq_k // block_k
+
+    def body(j, carry):
+        out, m, l = carry
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        out_new = out * alpha[:, None] + jnp.dot(
+            p, vblk, preferred_element_type=jnp.float32)
+        return out_new, m_new, l_new
+
+    D = q.shape[-1]
+    init = (jnp.zeros((q.shape[0], D), jnp.float32),
+            jnp.full((q.shape[0],), _NEG_INF, jnp.float32),
+            jnp.zeros((q.shape[0],), jnp.float32))
+    out, m, l = jax.lax.fori_loop(0, nkb, body, init)
+    o_ref[0] = (out / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+try:  # pallas import is TPU-oriented; keep CPU-only installs working
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+
+def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
+    """(B, H, S, D) fused attention forward on the MXU."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    assert Sq % block_q == 0 and Sk % block_k == 0, \
+        "flash kernel needs sequence divisible by block size"
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               causal=causal, scale=scale, seq_k=Sk,
+                               block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sq, D)
+
+
+def _on_tpu(*arrays):
+    # backend-level dispatch: under jit/shard_map tracing the operands are
+    # Tracers (no .devices()), but the computation compiles for the default
+    # backend, which is what decides whether the Pallas kernel can run
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, scale=None, block_k=512):
+    """Fused multi-head attention: softmax(q·kᵀ·scale [+ causal mask])·v.
+
+    q/k/v: (batch, heads, seq, head_dim). The S×S score matrix is never
+    materialised (blocked online softmax), so memory is O(S·D) — the
+    long-context path. Differentiable (custom vjp recomputes block scores).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _reference_attention(q, k, v, causal, scale, block_k)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if HAS_PALLAS and _on_tpu(q, k, v) and q.shape[2] % 128 == 0 \
+            and k.shape[2] % 128 == 0:
+        out = _pallas_flash_fwd(q, k, v, causal, scale)
+    else:
+        out = _reference_attention(q, k, v, causal, scale, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_k, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, scale,
+                                                block_k), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (sequence parallel over a mesh axis)
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   block_k=512):
+    """Sequence-parallel attention inside ``shard_map``.
+
+    Each device holds the (B, H, S/n, D) shard of q/k/v for its sequence
+    slice. k/v rotate around the ring (`lax.ppermute` over ICI) for n
+    steps; every step folds the visiting block into the local
+    online-softmax accumulator, so activations stay O(S/n) per chip and
+    the transfers overlap the einsums. Causal masking uses global
+    positions, so results equal single-device causal attention.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S_local, D = q.shape
+    q_off = idx * S_local
+
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, r):
+        out, m, l, kr, vr = carry
+        # the (idx - r)-th device's block is visiting us this round
+        src = (idx - r) % n
+        po, pm, plgt = _block_scan_attention(
+            qf, kr, vr, causal, scale, block_k,
+            q_offset=q_off, k_offset=src * S_local)
+        # merge the visiting block's partial into the accumulator
+        m_new = jnp.maximum(m, pm)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(pm - m_new)
+        out = out * a1[..., None] + po * a2[..., None]
+        l = l * a1 + plgt * a2
+        kr = lax.ppermute(kr, axis_name, perm)
+        vr = lax.ppermute(vr, axis_name, perm)
+        return (out, m_new, l, kr, vr), None
+
+    zero = qf * 0.0  # inherits qf's varying-axes type (see above)
+    init = (zero,
+            jnp.max(zero, axis=-1) + _NEG_INF,
+            jnp.sum(zero, axis=-1),
+            k.astype(jnp.float32), v.astype(jnp.float32))
+    (out, m, l, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    return _merge_partials(out, m, l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# tape ops
+# ---------------------------------------------------------------------------
+
+class _FlashAttention(Operator):
+    """Tape op wrapping :func:`flash_attention`."""
+
+    def __init__(self, causal=False, scale=None):
+        super().__init__()
+        self.causal = causal
+        self.scale = scale
+
+    def forward(self, q, k, v):
+        return flash_attention(q, k, v, self.causal, self.scale)
+
+
+class _RingAttention(Operator):
+    """Tape op wrapping :func:`ring_attention` (inside shard_map)."""
+
+    def __init__(self, axis_name, causal=False, scale=None):
+        super().__init__()
+        self.axis_name = axis_name
+        self.causal = causal
+        self.scale = scale
+
+    def forward(self, q, k, v):
+        return ring_attention(q, k, v, self.axis_name, self.causal,
+                              self.scale)
+
+
+def attention(q, k, v, causal=False, scale=None, seq_axis=None):
+    """Functional tape API; picks ring attention when ``seq_axis`` is an
+    active sequence-parallel mesh axis."""
+    from ..parallel.communicator import active_axis
+    if seq_axis is not None and active_axis(seq_axis):
+        return _RingAttention(seq_axis, causal, scale)(q, k, v)
+    return _FlashAttention(causal, scale)(q, k, v)

@@ -110,6 +110,7 @@ class Optimizer:
                     "state must be materialised by the first (eager) step.")
             t = Tensor(shape=like.shape, device=like.device,
                        dtype=like.dtype, requires_grad=False)
+            t.spec = like.spec  # momentum/moments shard like their param
             self._aux[key] = t
         return t
 
@@ -244,11 +245,16 @@ class DistOpt:
     """
 
     def __init__(self, opt=None, nccl_id=None, local_rank=None,
-                 world_size=None, buffSize=None, axis_name="data"):
+                 world_size=None, buffSize=None, axis_name="data",
+                 reduce_axes=None):
+        """``reduce_axes``: mesh axes gradients are summed over (default
+        just the data axis; add 'seq' under sequence parallelism where the
+        token batch is split over that axis too)."""
         from .parallel.communicator import Communicator
         self.opt = opt if opt is not None else SGD()
         self.communicator = Communicator(axis_name=axis_name,
-                                         world_size=world_size)
+                                         world_size=world_size,
+                                         reduce_axes=reduce_axes)
         self.world_size = self.communicator.world_size
         self.local_rank = local_rank if local_rank is not None \
             else self.communicator.local_rank

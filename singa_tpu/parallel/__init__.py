@@ -10,6 +10,15 @@ that XLA schedules over ICI.
 from .communicator import (Communicator, NcclIdHolder, get_mesh,
                            collective_context, active_axis)
 from .mesh import make_mesh, MeshConfig
+from .ops import (all_reduce, all_gather, reduce_scatter, pmean,
+                  copy_to_parallel)
+from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
+                              TPMLP)
+from .pipeline import pipeline_spmd, stack_stage_params, microbatch
 
 __all__ = ["Communicator", "NcclIdHolder", "get_mesh", "collective_context",
-           "active_axis", "make_mesh", "MeshConfig"]
+           "active_axis", "make_mesh", "MeshConfig",
+           "all_reduce", "all_gather", "reduce_scatter", "pmean",
+           "copy_to_parallel",
+           "ColumnParallelLinear", "RowParallelLinear", "TPMLP",
+           "pipeline_spmd", "stack_stage_params", "microbatch"]

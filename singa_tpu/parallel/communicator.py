@@ -92,8 +92,13 @@ class Communicator:
     """
 
     def __init__(self, axis_name: str = "data", world_size=None,
-                 mesh=None):
+                 mesh=None, reduce_axes=None):
         self.axis_name = axis_name
+        # axes gradients are summed over: the data axis plus any other
+        # batch-like axis (sequence parallelism splits the token batch, so
+        # 'seq' joins the reduction there)
+        self.reduce_axes = tuple(reduce_axes) if reduce_axes is not None \
+            else (axis_name,)
         self.mesh = mesh
         self.local_rank = jax.process_index()
         self.global_rank = jax.process_index()
@@ -101,16 +106,22 @@ class Communicator:
             world_size = jax.device_count()
         self.world_size = int(world_size)
 
+    def _active_reduce_axes(self):
+        return tuple(a for a in self.reduce_axes if active_axis(a))
+
     def effective_world_size(self):
         """Replica count actually participating in the current context."""
-        if active_axis(self.axis_name):
-            return lax.axis_size(self.axis_name)
-        return 1
+        axes = self._active_reduce_axes()
+        size = 1
+        for a in axes:
+            size *= lax.axis_size(a)
+        return size
 
     # -- collectives (identity outside a mesh context) ---------------------
     def all_reduce(self, arr):
-        if active_axis(self.axis_name):
-            return lax.psum(arr, self.axis_name)
+        axes = self._active_reduce_axes()
+        if axes:
+            return lax.psum(arr, axes)
         return arr
 
     def all_gather(self, arr, axis=0):

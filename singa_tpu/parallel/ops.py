@@ -1,0 +1,147 @@
+"""Collective operations as tape ops with explicit transposes.
+
+The TPU-native replacement for the reference Communicator's op surface
+(src/io/communicator.cc synch/fusedSynch/...): collectives are ordinary
+differentiable autograd ops that lower to XLA collectives over the mesh
+when tracing inside ``shard_map`` (the Model layer arms the axis context),
+and degrade to identity in single-device eager execution.
+
+Every op pins its own backward (Megatron-style f/g duality) instead of
+relying on ``jax.vjp``: under ``shard_map(..., check_vma=False)`` the
+autodiff transpose of ``lax.psum`` is another ``psum``, which double-counts
+by the axis size when the cotangent is already replicated. The correct
+pairs are:
+
+    AllReduce        fwd psum       bwd identity        ("g")
+    CopyToParallel   fwd identity   bwd psum            ("f")
+    AllGather        fwd gather     bwd take-own-shard
+    ReduceScatter    fwd psum_scatter  bwd all_gather
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd_base import Operator
+from .communicator import active_axis
+
+
+class AllReduce(Operator):
+    """psum over a mesh axis (reference Communicator::synch). Backward is
+    identity: the summed output's cotangent is replicated already."""
+
+    def __init__(self, axis_name="data"):
+        super().__init__()
+        self.axis_name = axis_name
+
+    def forward(self, x):
+        if active_axis(self.axis_name):
+            return lax.psum(x, self.axis_name)
+        return x
+
+    def backward(self, dy):
+        return dy
+
+
+class CopyToParallel(Operator):
+    """Identity forward into a model-parallel region; backward all-reduces
+    the partial input-gradients the shards produce (Megatron's ``f``)."""
+
+    def __init__(self, axis_name="model"):
+        super().__init__()
+        self.axis_name = axis_name
+
+    def forward(self, x):
+        return x
+
+    def backward(self, dy):
+        if active_axis(self.axis_name):
+            return lax.psum(dy, self.axis_name)
+        return dy
+
+
+class AllGather(Operator):
+    """Concatenate shards along ``concat_axis``; backward hands each shard
+    the slice of the cotangent it contributed."""
+
+    def __init__(self, axis_name="model", concat_axis=-1):
+        super().__init__()
+        self.axis_name = axis_name
+        self.concat_axis = concat_axis
+
+    def forward(self, x):
+        self._local = x.shape[self.concat_axis % x.ndim]
+        if active_axis(self.axis_name):
+            return lax.all_gather(x, self.axis_name,
+                                  axis=self.concat_axis % x.ndim,
+                                  tiled=True)
+        return x
+
+    def backward(self, dy):
+        if active_axis(self.axis_name):
+            idx = lax.axis_index(self.axis_name)
+            ax = self.concat_axis % dy.ndim
+            return lax.dynamic_slice_in_dim(dy, idx * self._local,
+                                            self._local, axis=ax)
+        return dy
+
+
+class ReduceScatter(Operator):
+    """psum + scatter along ``scatter_axis``; backward all-gathers."""
+
+    def __init__(self, axis_name="model", scatter_axis=-1):
+        super().__init__()
+        self.axis_name = axis_name
+        self.scatter_axis = scatter_axis
+
+    def forward(self, x):
+        if active_axis(self.axis_name):
+            ax = self.scatter_axis % x.ndim
+            return lax.psum_scatter(x, self.axis_name,
+                                    scatter_dimension=ax, tiled=True)
+        return x
+
+    def backward(self, dy):
+        if active_axis(self.axis_name):
+            ax = self.scatter_axis % dy.ndim
+            return lax.all_gather(dy, self.axis_name, axis=ax, tiled=True)
+        return dy
+
+
+class PMean(Operator):
+    """pmean over a mesh axis (metric averaging)."""
+
+    def __init__(self, axis_name="data"):
+        super().__init__()
+        self.axis_name = axis_name
+
+    def forward(self, x):
+        if active_axis(self.axis_name):
+            return lax.pmean(x, self.axis_name)
+        return x
+
+    def backward(self, dy):
+        if active_axis(self.axis_name):
+            return dy / lax.axis_size(self.axis_name)
+        return dy
+
+
+def all_reduce(x, axis_name="data"):
+    return AllReduce(axis_name)(x)
+
+
+def copy_to_parallel(x, axis_name="model"):
+    return CopyToParallel(axis_name)(x)
+
+
+def all_gather(x, axis_name="model", concat_axis=-1):
+    return AllGather(axis_name, concat_axis)(x)
+
+
+def reduce_scatter(x, axis_name="model", scatter_axis=-1):
+    return ReduceScatter(axis_name, scatter_axis)(x)
+
+
+def pmean(x, axis_name="data"):
+    return PMean(axis_name)(x)

@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+No reference equivalent (the reference is data-parallel only, SURVEY.md
+§2.4). TPU-native design: every pipeline stage is the same jitted program
+(SPMD over the 'pipe' mesh axis inside ``shard_map``); activations hop to
+the next stage with `lax.ppermute` over ICI each schedule tick, and the
+whole schedule is a `lax.scan` — so XLA sees one static program and
+backward-through-the-pipeline falls out of `jax.grad` (the transpose of
+`ppermute` is the reverse-direction `ppermute`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
+    """Run a GPipe forward inside ``shard_map`` over ``axis_name``.
+
+    Args:
+      stage_fn: ``(params, activation) -> activation`` — this device's
+        pipeline stage (all stages must preserve the activation shape).
+      stage_params: this device's stage parameters (pytree; under
+        shard_map give the global stacked params a P(axis_name, ...) spec
+        so each device holds its own stage's slice).
+      x_microbatches: (n_micro, mb, ...) — the microbatched global input
+        (replicated; only stage 0 reads it).
+
+    Returns (n_micro, mb, ...) outputs of the LAST stage, broadcast to all
+    stages (so a replicated loss can follow).
+
+    Schedule: t = 0..n_micro+n_stages-2; stage 0 injects microbatch t,
+    stage s>0 consumes the activation stage s-1 produced at t-1.
+    """
+    n = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    steps = n_micro + n - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    mb_shape = x_microbatches.shape[1:]
+
+    def step(carry, t):
+        prev_y = carry
+        # activation produced upstream last tick arrives over the ring
+        recv = lax.ppermute(prev_y, axis_name, fwd_perm)
+        mb = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        a = jnp.where(sid == 0, mb, recv)
+        y = stage_fn(stage_params, a)
+        return y, y
+
+    # the carry becomes device-varying (stage params differ per pipe
+    # member); mark the init accordingly for shard_map's vma typecheck
+    init = jnp.zeros(mb_shape, x_microbatches.dtype)
+    if hasattr(jax.lax, "pcast"):
+        init = jax.lax.pcast(init, (axis_name,), to="varying")
+    elif hasattr(jax.lax, "pvary"):
+        init = jax.lax.pvary(init, (axis_name,))
+    _, ys = lax.scan(step, init, jnp.arange(steps))
+
+    # last stage's outputs at ticks n-1 .. steps-1 are microbatches 0..M-1
+    outs = lax.dynamic_slice_in_dim(ys, n - 1, n_micro, axis=0)
+    # broadcast them from the last stage to everyone
+    return lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_pytree, stage1_pytree, ...] -> stacked pytree with a leading
+    stage axis, ready for a P('pipe', ...) sharding."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def microbatch(x, n_micro):
+    """(B, ...) -> (n_micro, B/n_micro, ...)"""
+    B = x.shape[0]
+    assert B % n_micro == 0, f"batch {B} not divisible by {n_micro}"
+    return x.reshape((n_micro, B // n_micro) + x.shape[1:])

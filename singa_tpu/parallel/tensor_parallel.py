@@ -1,0 +1,123 @@
+"""Tensor (model) parallel layers — Megatron-style column/row splits.
+
+No reference equivalent (the reference is data-parallel only, SURVEY.md
+§2.4); this is the TPU-native extension that shards the weight matmuls
+over the mesh 'model' axis so a layer larger than one chip's HBM still
+runs, with exactly one psum per column→row pair riding the ICI.
+
+How it composes with the Model layer: weights are created full-size and
+announce their layout via ``Tensor.spec``; the compiled step's shard_map
+passes each device its shard, the tape traces local-shape matmuls, and
+the `RowParallelLinear` output all-reduce is the only cross-chip traffic.
+Outside shard_map (eager or single chip) the collectives degrade to
+identity and the same code computes the full matmul.
+"""
+
+from __future__ import annotations
+
+import math
+
+from jax.sharding import PartitionSpec as P
+
+from .. import autograd
+from ..layer import Layer, _param
+from . import ops as collective
+
+
+class ColumnParallelLinear(Layer):
+    """y_local = x @ W[:, shard] — output features sharded over 'model'.
+
+    Feed its output into a :class:`RowParallelLinear` (no gather needed)
+    or set ``gather_output=True`` to return the full feature dim.
+    """
+
+    def __init__(self, out_features, bias=True, gather_output=False,
+                 axis_name="model"):
+        super().__init__()
+        self.out_features = out_features
+        self.bias = bias
+        self.gather_output = gather_output
+        self.axis_name = axis_name
+
+    def initialize(self, x):
+        in_features = x.shape[-1]
+        self.W = _param((in_features, self.out_features), x.device)
+        std = math.sqrt(2.0 / (in_features + self.out_features))
+        self.W.gaussian(0.0, std)
+        self.W.spec = P(None, self.axis_name)
+        if self.bias:
+            self.b = _param((self.out_features,), x.device)
+            self.b.spec = P(self.axis_name)
+
+    def forward(self, x):
+        # Megatron "f": identity fwd, all-reduce bwd — each shard produces
+        # only its slice's contribution to dx
+        x = collective.copy_to_parallel(x, self.axis_name)
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        if self.gather_output:
+            y = collective.all_gather(y, self.axis_name, concat_axis=-1)
+        return y
+
+    def _own_params(self):
+        p = {"W": self.W}
+        if self.bias:
+            p["b"] = self.b
+        return p
+
+
+class RowParallelLinear(Layer):
+    """y = psum_model(x_local @ W[shard, :]) + b — input features sharded.
+
+    Takes the sharded activations a ColumnParallelLinear produced; the
+    single all-reduce here completes the logical full matmul.
+    """
+
+    def __init__(self, out_features, bias=True, axis_name="model"):
+        super().__init__()
+        self.out_features = out_features
+        self.bias = bias
+        self.axis_name = axis_name
+
+    def initialize(self, x):
+        # x carries the LOCAL shard width when tracing inside shard_map,
+        # but initialize runs on the eager (full) pass, so this is the
+        # full input width
+        in_features = x.shape[-1]
+        self.W = _param((in_features, self.out_features), x.device)
+        std = math.sqrt(2.0 / (in_features + self.out_features))
+        self.W.gaussian(0.0, std)
+        self.W.spec = P(self.axis_name, None)
+        if self.bias:
+            self.b = _param((self.out_features,), x.device)  # replicated
+
+    def forward(self, x):
+        y = autograd.matmul(x, self.W)
+        y = collective.all_reduce(y, self.axis_name)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        return y
+
+    def _own_params(self):
+        p = {"W": self.W}
+        if self.bias:
+            p["b"] = self.b
+        return p
+
+
+class TPMLP(Layer):
+    """Column→activation→Row two-layer MLP: one all-reduce total."""
+
+    def __init__(self, hidden_features, out_features, activation="relu",
+                 axis_name="model"):
+        super().__init__()
+        self.up = ColumnParallelLinear(hidden_features,
+                                       axis_name=axis_name)
+        self.down = RowParallelLinear(out_features, axis_name=axis_name)
+        self.activation = activation
+
+    def forward(self, x):
+        h = self.up(x)
+        h = getattr(autograd, self.activation)(h)
+        return self.down(h)

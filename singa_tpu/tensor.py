@@ -52,7 +52,16 @@ def _raw(x):
 
 
 class Tensor:
-    """nd-array with device placement, dtype, and autograd metadata."""
+    """nd-array with device placement, dtype, and autograd metadata.
+
+    ``spec`` (class default None = replicated) is an optional
+    ``jax.sharding.PartitionSpec`` announcing how this tensor is laid out
+    over the device mesh; the Model layer threads it into the compiled
+    step's shard_map in/out specs (tensor-parallel layers set it on their
+    weights).
+    """
+
+    spec = None
 
     def __init__(self, shape=(), device=None, dtype=None, data=None,
                  requires_grad=True, stores_grad=False, creator=None,

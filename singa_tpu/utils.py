@@ -68,15 +68,17 @@ def get_output_shape(auto_pad, input_spatial_shape, kernel_spatial_shape,
 
 def handle_odd_pad_fwd(x, odd_padding, is_pool=False):
     """Apply an asymmetric (top, bottom, left, right) pad to NCHW data
-    (reference utils.handle_odd_pad_fwd:56). XLA differentiates through
-    the pad, so no explicit backward twin is needed."""
+    (reference utils.handle_odd_pad_fwd:56). Tensor inputs go through the
+    taped Pad op so gradients flow; the reference's explicit backward twin
+    (handle_odd_pad_bwd) is therefore unnecessary."""
     t, b, l, r = odd_padding
-    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-    padded = jnp.pad(arr, ((0, 0), (0, 0), (t, b), (l, r)),
-                     constant_values=float("-inf") if is_pool else 0.0)
+    fill = float("-inf") if is_pool else 0.0
     if isinstance(x, Tensor):
-        return Tensor(data=padded, device=x.device, requires_grad=False)
-    return padded
+        from . import autograd
+        # pads layout: begin per dim, then end per dim (N,C,H,W)
+        return autograd.pad(x, "constant", [0, 0, t, l, 0, 0, b, r], fill)
+    return jnp.pad(jnp.asarray(x), ((0, 0), (0, 0), (t, b), (l, r)),
+                   constant_values=fill)
 
 
 def same_pad_shape_check(handle, pad_mode, x):
