@@ -1,0 +1,96 @@
+"""Character-level LM on a text file (reference examples/rnn/char_rnn.py).
+
+Pass --text yourfile.txt; without one, a small synthetic corpus with
+learnable structure is generated.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+class Data:
+    """(reference char_rnn.py Data:92-123)"""
+
+    def __init__(self, text, batch_size=32, seq_length=50,
+                 train_ratio=0.8):
+        self.raw = text
+        self.vocab = sorted(set(text))
+        self.char_to_idx = {c: i for i, c in enumerate(self.vocab)}
+        self.idx_to_char = dict(enumerate(self.vocab))
+        self.vocab_size = len(self.vocab)
+        data = np.asarray([self.char_to_idx[c] for c in text],
+                          np.int32)
+        n = len(data) // (batch_size * seq_length)
+        data = data[:n * batch_size * seq_length].reshape(
+            batch_size, -1)
+        split = int(data.shape[1] * train_ratio) // seq_length * seq_length
+        self.train_dat = data[:, :split]
+        self.val_dat = data[:, split:]
+        self.batch_size = batch_size
+        self.seq_length = seq_length
+        self.num_train_batch = split // seq_length
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--bs", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=25)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import opt, tensor, device
+    from singa_tpu.models import char_rnn
+
+    if args.text:
+        text = open(args.text, errors="ignore").read()
+    else:
+        rng = np.random.RandomState(0)
+        words = ["the ", "quick ", "brown ", "fox ", "jumps "]
+        text = "".join(rng.choice(words) for _ in range(4000))
+
+    data = Data(text, args.bs, args.seq)
+    print(f"vocab {data.vocab_size}, {data.num_train_batch} batches/epoch")
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    model = char_rnn.CharRNN(data.vocab_size, args.hidden)
+    model.set_optimizer(opt.SGD(lr=0.5, momentum=0.9))
+    model.train()
+
+    eye = np.eye(data.vocab_size, dtype=np.float32)
+    for epoch in range(args.epochs):
+        losses = []
+        model.reset_states() if model._states_ready else None
+        for b in range(data.num_train_batch):
+            s = b * args.seq
+            chunk = data.train_dat[:, s:s + args.seq + 1]
+            if chunk.shape[1] < args.seq + 1:
+                break
+            inputs = [tensor.Tensor(data=eye[chunk[:, i]], device=dev,
+                                    requires_grad=True)
+                      for i in range(args.seq)]
+            labels = [tensor.Tensor(
+                data=chunk[:, i + 1].astype(np.float32), device=dev,
+                requires_grad=False) for i in range(args.seq)]
+            _, loss = model.train_one_batch(inputs, labels)
+            losses.append(float(loss.data))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+    ids = char_rnn.sample(model, [data.char_to_idx[text[0]]],
+                          data.vocab_size, nsamples=60)
+    print("sample:", "".join(data.idx_to_char[i] for i in ids))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Train a CNN-family model (reference examples/cnn/train_cnn.py).
+
+Synthetic data by default (the reference downloads CIFAR-10/MNIST; this
+environment has no egress) — pass --data path/to/npz with arrays x,y to
+train on real data. Supports the reference's distributed options:
+plain | half | partialUpdate | sparseTopK | sparseThreshold.
+
+Usage: python examples/train_cnn.py [cnn|alexnet|resnet|xceptionnet]
+           [--bs 32] [--epochs 2] [--lr 0.05] [--dist]
+           [--dist-option plain] [--spars 0.05] [--cpu]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", nargs="?", default="cnn",
+                    choices=["cnn", "alexnet", "resnet", "xceptionnet"])
+    ap.add_argument("--bs", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--dist", action="store_true")
+    ap.add_argument("--dist-option", default="plain")
+    ap.add_argument("--spars", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--data", default=None)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import device, metric, opt, tensor
+    from singa_tpu import models
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    dev.SetRandSeed(0)
+
+    size = {"cnn": 28, "alexnet": 224, "resnet": 224,
+            "xceptionnet": 299}[args.model]
+    chans = 1 if args.model == "cnn" else 3
+    if args.data:
+        blob = np.load(args.data)
+        x_all, y_all = blob["x"].astype(np.float32), blob["y"]
+    else:
+        rng = np.random.RandomState(0)
+        n = args.bs * args.iters
+        x_all = rng.randn(n, chans, size, size).astype(np.float32)
+        y_all = rng.randint(0, 10, n)
+
+    factory = getattr(models, args.model)
+    model = factory.create_model(num_channels=chans, num_classes=10)
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
+    model.set_optimizer(opt.DistOpt(sgd) if args.dist else sgd)
+
+    tx = tensor.Tensor(data=x_all[:args.bs], device=dev,
+                       requires_grad=False)
+    model.compile([tx], is_train=True, use_graph=True)
+
+    acc = metric.Accuracy()
+    for epoch in range(args.epochs):
+        idx = np.random.permutation(len(x_all))
+        t0, seen, losses, accs = time.time(), 0, [], []
+        for b in range(len(x_all) // args.bs):
+            sel = idx[b * args.bs:(b + 1) * args.bs]
+            bx = tensor.Tensor(data=x_all[sel], device=dev,
+                               requires_grad=False)
+            by = tensor.Tensor(
+                data=np.eye(10, dtype=np.float32)[y_all[sel]],
+                device=dev, requires_grad=False)
+            if args.dist and args.dist_option != "plain":
+                out, loss = model(bx, by, args.dist_option, args.spars)
+            else:
+                out, loss = model(bx, by)
+            losses.append(float(loss.data))
+            accs.append(acc.evaluate(out, y_all[sel]))
+            seen += args.bs
+        dt = time.time() - t0
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
+              f"acc {np.mean(accs):.4f} "
+              f"throughput {seen / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Adversarial training, vanilla or LSGAN
+(reference examples/gan/vanilla.py, lsgan.py). Synthetic 'MNIST-like'
+data unless --data npz with array x is given."""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("kind", nargs="?", default="vanilla",
+                    choices=["vanilla", "lsgan"])
+    ap.add_argument("--bs", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--noise", type=int, default=100)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--data", default=None)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import autograd, device, opt, tensor
+    from singa_tpu.models import gan
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    rng = np.random.RandomState(0)
+    feature = 784
+    if args.data:
+        real_all = np.load(args.data)["x"].reshape(-1, feature)
+        real_all = real_all.astype(np.float32) / real_all.max()
+    else:
+        # blobby fake digits: low-rank structure the G can chase
+        basis = rng.rand(16, feature).astype(np.float32)
+        codes = rng.rand(4096, 16).astype(np.float32)
+        real_all = np.clip(codes @ basis / 4.0, 0, 1)
+
+    model = gan.create_model(args.kind, noise_size=args.noise,
+                             feature_size=feature)
+    model.set_optimizer(opt.SGD(lr=0.01, momentum=0.5))
+    noise0 = tensor.Tensor(data=rng.randn(args.bs, args.noise)
+                           .astype(np.float32), device=dev,
+                           requires_grad=False)
+    real0 = tensor.Tensor(data=real_all[:args.bs], device=dev,
+                          requires_grad=False)
+    model.compile_gan(noise0, real0)
+    model.train()
+
+    ones = np.ones((args.bs, 1), np.float32)
+    zeros = np.zeros((args.bs, 1), np.float32)
+    d_y = tensor.Tensor(data=np.concatenate([ones, zeros]), device=dev,
+                        requires_grad=False)
+    g_y = tensor.Tensor(data=ones, device=dev, requires_grad=False)
+
+    for it in range(args.iters):
+        sel = rng.randint(0, len(real_all), args.bs)
+        real = tensor.Tensor(data=real_all[sel], device=dev,
+                             requires_grad=False)
+        noise = tensor.Tensor(
+            data=rng.randn(args.bs, args.noise).astype(np.float32),
+            device=dev, requires_grad=False)
+        fake = model.forward_gen(noise)
+        d_in = autograd.cat([real, fake], axis=0)
+        _, d_loss = model.train_one_batch_dis(d_in, d_y)
+        _, g_loss = model.train_one_batch(noise, g_y)
+        if it % 20 == 0:
+            print(f"iter {it}: d_loss {float(d_loss.data):.4f} "
+                  f"g_loss {float(g_loss.data):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Train the MLP on a synthetic two-moon-ish dataset
+(reference examples/mlp/train.py — reference generates synthetic data
+from a line boundary the same way)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import device, metric, opt, tensor
+    from singa_tpu.models import mlp
+
+    # reference data: points above/below the line y = 5x + 1
+    # (examples/mlp/train.py)
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (1024, 2)).astype(np.float32)
+    y = (x[:, 1] > 5 * x[:, 0] + 1).astype(np.int64)
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    model = mlp.create_model(num_classes=2)
+    model.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    tx = tensor.Tensor(data=x[:args.bs], device=dev, requires_grad=False)
+    model.compile([tx], is_train=True, use_graph=True)
+
+    acc = metric.Accuracy()
+    for epoch in range(args.epochs):
+        idx = rng.permutation(len(x))
+        losses, accs = [], []
+        for b in range(len(x) // args.bs):
+            sel = idx[b * args.bs:(b + 1) * args.bs]
+            bx = tensor.Tensor(data=x[sel], device=dev,
+                               requires_grad=False)
+            by = tensor.Tensor(data=np.eye(2, dtype=np.float32)[y[sel]],
+                               device=dev, requires_grad=False)
+            out, loss = model(bx, by)
+            losses.append(float(loss.data))
+            accs.append(acc.evaluate(out, y[sel]))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
+              f"acc {np.mean(accs):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""RBM with CD-1 (reference examples/rbm/train.py). Synthetic binary
+patterns unless --data npz with array x is given."""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--hdim", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.0005)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--data", default=None)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import device, opt
+    from singa_tpu.models import rbm
+
+    rng = np.random.RandomState(0)
+    if args.data:
+        x = np.load(args.data)["x"].astype(np.float32)
+        x = x.reshape(len(x), -1) / x.max()
+    else:
+        protos = (rng.rand(10, 784) > 0.6).astype(np.float32)
+        x = np.repeat(protos, 200, axis=0)
+        rng.shuffle(x)
+    vdim = x.shape[1]
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    model = rbm.create_model(vdim=vdim, hdim=args.hdim, device=dev)
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=2e-4)
+
+    nb = len(x) // args.bs
+    for epoch in range(args.epochs):
+        err = 0.0
+        for b in range(nb):
+            err += model.train_on_batch(
+                sgd, x[b * args.bs:(b + 1) * args.bs])
+        print(f"epoch {epoch}: reconstruction error/sample "
+              f"{err / (nb * args.bs):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Long-context Transformer LM with composable dp/sp/tp parallelism —
+the TPU-native flagship (no reference equivalent; SURVEY.md §5 notes the
+reference has no sequence parallelism).
+
+Usage: python examples/train_transformer.py [--seq 512] [--tp 2]
+           [--sp 2] [--layers 4] [--d-model 256] [--cpu]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models import transformer
+    from singa_tpu.parallel import mesh as mesh_mod
+    from singa_tpu.parallel.communicator import set_mesh
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    dev.SetRandSeed(0)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, args.vocab,
+                      (args.bs, args.seq)).astype(np.float32)
+    tgt = np.roll(ids, -1, axis=1)
+    tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=tgt, device=dev, requires_grad=False)
+
+    model = transformer.TransformerLM(
+        args.vocab, d_model=args.d_model, n_heads=args.heads,
+        n_layers=args.layers, max_len=args.seq,
+        seq_axis="seq" if args.sp > 1 else None)
+    dist = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
+                       reduce_axes=("data", "seq"))
+    msh = mesh_mod.make_mesh(
+        jax.devices(), mesh_mod.MeshConfig(model=args.tp, seq=args.sp))
+    print("mesh:", dict(msh.shape))
+    dist.communicator.mesh = msh
+    set_mesh(msh)
+    model.set_optimizer(dist)
+    if args.sp > 1:
+        model.input_specs = [P("data", "seq"), P("data", "seq")]
+        model.output_specs = [P("data", "seq"), P()]
+    model.compile([tx], is_train=True, use_graph=True)
+
+    model(tx, ty)  # eager warm-up
+    t0 = time.time()
+    for step in range(args.steps):
+        _, loss = model(tx, ty)
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss.data):.4f}")
+    toks = args.bs * args.seq * args.steps / (time.time() - t0)
+    print(f"throughput {toks:.0f} tokens/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -367,6 +367,16 @@ class ReduceMean(Operator):
         return jnp.mean(x, axis=self.axes, keepdims=self.keepdims)
 
 
+class ReduceMax(Operator):
+    def __init__(self, axes=None, keepdims=1):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return jnp.max(x, axis=self.axes, keepdims=self.keepdims)
+
+
 class Mean(Operator):
     """Elementwise mean of N tensors (reference autograd.Mean)."""
 
@@ -942,6 +952,10 @@ def reduce_sum(x, axes=None, keepdims=1):
 
 def reduce_mean(x, axes=None, keepdims=1):
     return ReduceMean(axes, keepdims)(x)
+
+
+def reduce_max(x, axes=None, keepdims=1):
+    return ReduceMax(axes, keepdims)(x)
 
 
 def mean(*xs):

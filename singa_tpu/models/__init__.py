@@ -32,4 +32,4 @@ class TrainStepMixin:
 
 
 from . import (mlp, cnn, alexnet, resnet, xceptionnet,  # noqa: F401,E402
-               transformer)
+               transformer, gan, rbm, char_rnn, qabot)

@@ -1,0 +1,126 @@
+"""Character-level LSTM language model.
+
+Capability parity with the reference char-rnn example
+(examples/rnn/char_rnn.py:39-90): a stateful LSTM over per-timestep
+one-hot inputs whose hidden/cell states persist across batches (truncated
+BPTT), a shared dense decoder over all timesteps, and a sampler.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd, layer, model, opt, tensor
+from ..tensor import Tensor
+
+
+class CharRNN(model.Model):
+    """(reference char_rnn.py CharRNN)"""
+
+    def __init__(self, vocab_size, hidden_size=32):
+        super().__init__()
+        self.rnn = layer.LSTM(vocab_size, hidden_size)
+        self.dense = layer.Linear(hidden_size, vocab_size)
+        self.optimizer = opt.SGD(0.01)
+        self.hidden_size = hidden_size
+        self.vocab_size = vocab_size
+        self._states_ready = False
+        self._pending_states = None  # checkpointed hx/cx awaiting creation
+
+    def reset_states(self, dev=None):
+        """Zero the recurrent state; safe before the first forward
+        (states are created lazily)."""
+        if self._states_ready:
+            self.hx.set_value(0.0)
+            self.cx.set_value(0.0)
+
+    def _ensure_states(self, inputs):
+        if not self._states_ready:
+            batch = inputs[0].shape[0]
+            dev = inputs[0].device
+            self.hx = Tensor(shape=(batch, self.hidden_size), device=dev,
+                             requires_grad=False)
+            self.cx = Tensor(shape=(batch, self.hidden_size), device=dev,
+                             requires_grad=False)
+            self.hx.name, self.cx.name = "hx", "cx"
+            self._states_ready = True
+            if self._pending_states is not None:
+                hx, cx = self._pending_states
+                if hx is not None:
+                    self.hx.copy_from(hx)
+                if cx is not None:
+                    self.cx.copy_from(cx)
+                self._pending_states = None
+
+    def forward(self, inputs):
+        """inputs: list of (batch, vocab) one-hot tensors, one per step."""
+        self._ensure_states(inputs)
+        out, (hx, cx) = self.rnn(inputs, (self.hx, self.cx))
+        # persist the running state for truncated BPTT across batches
+        self.hx.copy_data(hx)
+        self.cx.copy_data(cx)
+        x = autograd.cat(out, axis=0)          # (steps*batch, hidden)
+        return self.dense(x)
+
+    def train_one_batch(self, inputs, labels):
+        """labels: list of (batch,) class-id tensors, one per step."""
+        out = self.forward(inputs)
+        y = autograd.cat(labels, axis=0)
+        onehot = autograd.onehot(-1, y, self.vocab_size)
+        loss = autograd.softmax_cross_entropy(out, onehot)
+        self.optimizer(loss)
+        return out, loss
+
+    def get_states(self):
+        ret = super().get_states()
+        if self._states_ready:
+            ret["hx"] = self.hx
+            ret["cx"] = self.cx
+        return ret
+
+    def set_states(self, states):
+        if self._states_ready:
+            if "hx" in states:
+                self.hx.copy_from(states["hx"])
+            if "cx" in states:
+                self.cx.copy_from(states["cx"])
+        elif "hx" in states or "cx" in states:
+            # fresh model: stash the recurrent state until the lazily
+            # created hx/cx exist (checkpoint-resume must not drop it)
+            self._pending_states = (states.get("hx"), states.get("cx"))
+        super().set_states(states)
+
+
+def sample(model, start_ids, vocab_size, nsamples=100, use_max=False,
+           seed=0):
+    """Autoregressive sampling (reference char_rnn.py sample:164)."""
+    rng = np.random.RandomState(seed)
+    ids = list(start_ids)
+    out_ids = []
+    # re-run with batch 1; borrow the layer weights via step_forward
+    h = Tensor(data=np.zeros((1, model.hidden_size), np.float32),
+               requires_grad=False)
+    c = Tensor(data=np.zeros((1, model.hidden_size), np.float32),
+               requires_grad=False)
+    for i in ids:
+        x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[i]],
+                   requires_grad=False)
+        h, c = model.rnn.step_forward(x, h, c)
+    for _ in range(nsamples):
+        logits = model.dense(h)
+        probs = np.asarray(
+            tensor.softmax(logits).numpy()).ravel()
+        cur = int(np.argmax(probs)) if use_max else \
+            int(rng.choice(vocab_size, p=probs / probs.sum()))
+        out_ids.append(cur)
+        x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[cur]],
+                   requires_grad=False)
+        h, c = model.rnn.step_forward(x, h, c)
+    return out_ids
+
+
+def create_model(vocab_size=101, hidden_size=32, **kwargs):
+    return CharRNN(vocab_size, hidden_size, **kwargs)
+
+
+__all__ = ["CharRNN", "sample", "create_model"]

@@ -1,0 +1,93 @@
+"""Generative adversarial networks (vanilla + least-squares).
+
+Capability parity with the reference GAN examples (examples/gan/model/
+gan_mlp.py GAN_MLP and lsgan.py): a cascaded generator/discriminator MLP
+whose two training steps update disjoint parameter subsets by filtering
+the lazily-yielded (param, grad) stream from ``autograd.backward`` on the
+parameter name prefix — the same selective-update pattern, on our tape.
+"""
+
+from __future__ import annotations
+
+from .. import autograd, layer, model
+
+
+class GAN_MLP(model.Model):
+    """Vanilla GAN with BCE losses (reference gan_mlp.py:25-95)."""
+
+    loss_cls = layer.BinaryCrossEntropy
+
+    def __init__(self, noise_size=100, feature_size=784, hidden_size=128):
+        super().__init__()
+        self.noise_size = noise_size
+        self.feature_size = feature_size
+        self.hidden_size = hidden_size
+
+        self.gen_net_fc_0 = layer.Linear(hidden_size)
+        self.gen_net_relu_0 = layer.ReLU()
+        self.gen_net_fc_1 = layer.Linear(feature_size)
+        self.gen_net_sigmoid_1 = layer.Sigmoid()
+
+        self.dis_net_fc_0 = layer.Linear(hidden_size)
+        self.dis_net_relu_0 = layer.ReLU()
+        self.dis_net_fc_1 = layer.Linear(1)
+        self.dis_net_sigmoid_1 = layer.Sigmoid()
+        self.loss_fn = self.loss_cls()
+
+    # -- nets --------------------------------------------------------------
+    def forward_gen(self, x):
+        y = self.gen_net_relu_0(self.gen_net_fc_0(x))
+        return self.gen_net_sigmoid_1(self.gen_net_fc_1(y))
+
+    def forward_dis(self, x):
+        y = self.dis_net_relu_0(self.dis_net_fc_0(x))
+        return self.dis_net_sigmoid_1(self.dis_net_fc_1(y))
+
+    def forward(self, x):
+        return self.forward_dis(self.forward_gen(x))
+
+    # -- selective-update training steps -----------------------------------
+    def _update_subset(self, loss, prefix):
+        for p, g in autograd.backward(loss):
+            if prefix in (p.name or ""):
+                self.optimizer.apply(p.name, p, g)
+        self.optimizer.step()
+
+    def train_one_batch(self, x, y):
+        """Generator step: push D(G(noise)) toward the real label, updating
+        only gen_net params (reference gan_mlp.py:68-76)."""
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._update_subset(loss, "gen_net")
+        return out, loss
+
+    def train_one_batch_dis(self, x, y):
+        """Discriminator step on a real+fake batch, updating only dis_net
+        params (reference gan_mlp.py:78-88)."""
+        out = self.forward_dis(x)
+        loss = self.loss_fn(out, y)
+        self._update_subset(loss, "dis_net")
+        return out, loss
+
+    def compile_gan(self, noise, real=None):
+        """Initialise + name all params so the prefix filters work.
+        ``compile``'s dry forward already runs D(G(noise)), which builds
+        and names both nets; ``real`` is accepted for API symmetry."""
+        self.compile([noise], is_train=True, use_graph=False)
+
+
+class LSGAN_MLP(GAN_MLP):
+    """Least-squares GAN: MSE in place of BCE (reference lsgan.py)."""
+
+    loss_cls = layer.MeanSquareError
+
+
+def create_model(model_type="vanilla", **kwargs):
+    if model_type in ("vanilla", "gan"):
+        return GAN_MLP(**kwargs)
+    if model_type in ("lsgan", "ls"):
+        return LSGAN_MLP(**kwargs)
+    raise ValueError(f"unknown GAN type {model_type!r}")
+
+
+__all__ = ["GAN_MLP", "LSGAN_MLP", "create_model"]

@@ -1,0 +1,126 @@
+"""Question-answer ranking models (answer selection).
+
+Capability parity with the reference QAbot example
+(examples/qabot/qabot_model.py): encode a question and a batch of
+candidate answers with (bi)LSTMs, score by cosine similarity, and train
+with margin ranking loss over (positive, negative) answer pairs. Three
+encoder variants, as in the reference: last-state, mean-pool, max-pool.
+"""
+
+from __future__ import annotations
+
+from .. import autograd, layer, model
+
+
+class QAModelBase(model.Model):
+    def train_one_batch(self, q, a_batch):
+        sim_pos, sim_neg = self.forward(q, a_batch)
+        loss = autograd.ranking_loss(sim_pos, sim_neg)
+        self.optimizer(loss)
+        return sim_pos, sim_neg, loss
+
+    def _score(self, q_enc, a_enc):
+        bs = q_enc.shape[0]
+        a_pos, a_neg = autograd.split(a_enc, 0, [bs, bs])
+        return (autograd.cossim(q_enc, a_pos),
+                autograd.cossim(q_enc, a_neg))
+
+
+class QAModel(QAModelBase):
+    """Last-hidden-state encoders (reference qabot_model.py:46-73)."""
+
+    def __init__(self, hidden_size, num_layers=1, bidirectional=True,
+                 return_sequences=False):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.lstm_q = layer.CudnnRNN(hidden_size=hidden_size,
+                                     bidirectional=bidirectional,
+                                     rnn_mode="lstm",
+                                     return_sequences=return_sequences,
+                                     batch_first=True)
+        self.lstm_a = layer.CudnnRNN(hidden_size=hidden_size,
+                                     bidirectional=bidirectional,
+                                     rnn_mode="lstm",
+                                     return_sequences=return_sequences,
+                                     batch_first=True)
+
+    def forward(self, q, a_batch):
+        q_enc = self.lstm_q(q)[0]          # (bs, 2*hidden)
+        a_enc = self.lstm_a(a_batch)[0]    # (2*bs, 2*hidden)
+        return self._score(q_enc, a_enc)
+
+
+class QAModel_mean(QAModelBase):
+    """Mean-pool over sequence outputs (reference qabot_model.py:75-104)."""
+
+    def __init__(self, hidden_size, bidirectional=True,
+                 return_sequences=True):
+        super().__init__()
+        self.lstm_q = layer.CudnnRNN(hidden_size=hidden_size,
+                                     bidirectional=bidirectional,
+                                     rnn_mode="lstm",
+                                     return_sequences=True,
+                                     batch_first=True)
+        self.lstm_a = layer.CudnnRNN(hidden_size=hidden_size,
+                                     bidirectional=bidirectional,
+                                     rnn_mode="lstm",
+                                     return_sequences=True,
+                                     batch_first=True)
+
+    def forward(self, q, a_batch):
+        q_seq = self.lstm_q(q)[0]          # (bs, S, 2*hidden)
+        a_seq = self.lstm_a(a_batch)[0]
+        q_enc = autograd.reduce_mean(q_seq, axes=[1], keepdims=0)
+        a_enc = autograd.reduce_mean(a_seq, axes=[1], keepdims=0)
+        return self._score(q_enc, a_enc)
+
+
+class QAModel_maxpooling(QAModelBase):
+    """Max-pool over sequence outputs (reference qabot_model.py:106+)."""
+
+    def __init__(self, hidden_size, bidirectional=True,
+                 return_sequences=True):
+        super().__init__()
+        self.lstm_q = layer.CudnnRNN(hidden_size=hidden_size,
+                                     bidirectional=bidirectional,
+                                     rnn_mode="lstm",
+                                     return_sequences=True,
+                                     batch_first=True)
+        self.lstm_a = layer.CudnnRNN(hidden_size=hidden_size,
+                                     bidirectional=bidirectional,
+                                     rnn_mode="lstm",
+                                     return_sequences=True,
+                                     batch_first=True)
+
+    def forward(self, q, a_batch):
+        q_seq = self.lstm_q(q)[0]
+        a_seq = self.lstm_a(a_batch)[0]
+        q_enc = autograd.reduce_max(q_seq, axes=[1], keepdims=0)
+        a_enc = autograd.reduce_max(a_seq, axes=[1], keepdims=0)
+        return self._score(q_enc, a_enc)
+
+
+class QAModel_mlp(QAModelBase):
+    """Flatten + MLP encoders (reference qabot_model.py:23-44)."""
+
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.flat_q = layer.Flatten()
+        self.flat_a = layer.Flatten()
+        self.enc_q = layer.Linear(hidden_size)
+        self.enc_a = layer.Linear(hidden_size)
+
+    def forward(self, q, a_batch):
+        q_enc = self.enc_q(self.flat_q(q))
+        a_enc = self.enc_a(self.flat_a(a_batch))
+        return self._score(q_enc, a_enc)
+
+
+def create_model(kind="lstm", hidden_size=64, **kwargs):
+    return {"lstm": QAModel, "mean": QAModel_mean,
+            "max": QAModel_maxpooling, "mlp": QAModel_mlp}[kind](
+                hidden_size, **kwargs)
+
+
+__all__ = ["QAModel", "QAModel_mean", "QAModel_maxpooling", "QAModel_mlp",
+           "create_model"]

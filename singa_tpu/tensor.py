@@ -203,6 +203,9 @@ class Tensor:
         t.data = jnp.reshape(t.data, shape)
         return t
 
+    def T(self):  # noqa: N802 - reference API (tensor.h Tensor::T)
+        return self.transpose()
+
     def transpose(self, axes=None):
         t = self.clone()
         t.data = jnp.transpose(t.data, axes)

@@ -1,0 +1,171 @@
+"""Model zoo: every family builds, trains a few steps, loss decreases
+(reference examples/ gan, rbm, rnn, qabot + cnn zoo smoke tests)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device, opt, tensor
+from singa_tpu.models import (alexnet, char_rnn, cnn, gan, mlp, qabot,
+                              rbm, resnet, xceptionnet)
+from singa_tpu.tensor import Tensor
+
+
+DEV = device.create_cpu_device()
+
+
+def t(arr, rg=False):
+    return Tensor(data=np.asarray(arr, np.float32), device=DEV,
+                  requires_grad=rg)
+
+
+class TestGAN:
+    @pytest.mark.parametrize("kind", ["vanilla", "lsgan"])
+    def test_adversarial_steps(self, kind):
+        rng = np.random.RandomState(0)
+        m = gan.create_model(kind, noise_size=8, feature_size=16,
+                             hidden_size=12)
+        m.set_optimizer(opt.SGD(lr=0.05))
+        bs = 8
+        noise = t(rng.randn(bs, 8))
+        real = t(rng.rand(bs, 16))
+        m.compile_gan(noise, real)
+        m.train()
+
+        # discriminator step on real+fake
+        fake = m.forward_gen(noise)
+        d_in = autograd.cat([real, fake], axis=0)
+        d_y = t(np.concatenate([np.ones((bs, 1)), np.zeros((bs, 1))]))
+        pre_gen = np.asarray(m.gen_net_fc_0.W.data).copy()
+        pre_dis = np.asarray(m.dis_net_fc_0.W.data).copy()
+        out, dloss = m.train_one_batch_dis(d_in, d_y)
+        # only dis params moved
+        np.testing.assert_array_equal(np.asarray(m.gen_net_fc_0.W.data),
+                                      pre_gen)
+        assert not np.array_equal(np.asarray(m.dis_net_fc_0.W.data),
+                                  pre_dis)
+
+        # generator step
+        pre_dis = np.asarray(m.dis_net_fc_0.W.data).copy()
+        out, gloss = m.train_one_batch(noise, t(np.ones((bs, 1))))
+        np.testing.assert_array_equal(np.asarray(m.dis_net_fc_0.W.data),
+                                      pre_dis)
+        assert float(gloss.data) > 0
+
+    def test_gan_learns_direction(self):
+        """A few D steps should reduce the discriminator loss."""
+        rng = np.random.RandomState(1)
+        m = gan.create_model("vanilla", noise_size=4, feature_size=8,
+                             hidden_size=16)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        noise = t(rng.randn(16, 4))
+        real = t(rng.rand(16, 8) * 0.1 + 0.9)
+        m.compile_gan(noise, real)
+        m.train()
+        losses = []
+        y = t(np.concatenate([np.ones((16, 1)), np.zeros((16, 1))]))
+        for _ in range(10):
+            fake = m.forward_gen(noise)
+            d_in = autograd.cat([real, fake], axis=0)
+            _, l = m.train_one_batch_dis(d_in, y)
+            losses.append(float(l.data))
+        assert losses[-1] < losses[0], losses
+
+
+class TestRBM:
+    def test_cd1_reduces_reconstruction_error(self):
+        rng = np.random.RandomState(0)
+        # two clusters of binary patterns
+        protos = (rng.rand(2, 32) > 0.5).astype(np.float32)
+        data = np.repeat(protos, 32, axis=0)
+        data += 0.05 * rng.randn(*data.shape)
+        data = np.clip(data, 0, 1).astype(np.float32)
+
+        m = rbm.create_model(vdim=32, hdim=24, device=DEV)
+        sgd = opt.SGD(lr=0.01, momentum=0.8)
+        errs = []
+        for epoch in range(20):
+            err = m.train_on_batch(sgd, data)
+            errs.append(err)
+        assert errs[-1] < errs[0] * 0.1, errs
+
+    def test_reconstruct_and_states(self):
+        m = rbm.create_model(vdim=16, hdim=8, device=DEV)
+        x = (np.random.rand(4, 16) > 0.5).astype(np.float32)
+        recon = m.reconstruct(x)
+        assert recon.shape == (4, 16)
+        st = m.get_states()
+        m2 = rbm.create_model(vdim=16, hdim=8, device=DEV)
+        m2.set_states(st)
+        np.testing.assert_array_equal(np.asarray(m2.w.data),
+                                      np.asarray(m.w.data))
+
+
+class TestCharRNN:
+    def test_train_loss_decreases(self):
+        vocab, steps, bs = 12, 5, 4
+        m = char_rnn.CharRNN(vocab, hidden_size=16)
+        m.set_optimizer(opt.SGD(lr=1.0, momentum=0.9))
+        rng = np.random.RandomState(0)
+        seq = rng.randint(0, vocab, (steps + 1, bs))
+        inputs = [t(np.eye(vocab, dtype=np.float32)[seq[i]], rg=True)
+                  for i in range(steps)]
+        labels = [t(seq[i + 1].astype(np.float32)) for i in range(steps)]
+        m.train()
+        losses = []
+        for _ in range(30):
+            m.reset_states() if m._states_ready else None
+            _, loss = m.train_one_batch(inputs, labels)
+            losses.append(float(loss.data))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_sampling(self):
+        vocab = 8
+        m = char_rnn.CharRNN(vocab, hidden_size=8)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        x = [t(np.eye(vocab, dtype=np.float32)[[0, 1]], rg=True)]
+        y = [t(np.array([1.0, 2.0]))]
+        m.train()
+        m.train_one_batch(x, y)  # materialise weights
+        out = char_rnn.sample(m, [0, 1], vocab, nsamples=5)
+        assert len(out) == 5
+        assert all(0 <= i < vocab for i in out)
+
+
+class TestQABot:
+    @pytest.mark.parametrize("kind", ["lstm", "mean", "max", "mlp"])
+    def test_ranking_improves(self, kind):
+        rng = np.random.RandomState(0)
+        bs, S, E = 6, 5, 10
+        q = t(rng.randn(bs, S, E), rg=True)
+        # positive answers correlate with q, negatives are noise
+        a_pos = np.asarray(q.data) + 0.1 * rng.randn(bs, S, E)
+        a_neg = rng.randn(bs, S, E)
+        a = t(np.concatenate([a_pos, a_neg], 0), rg=True)
+
+        m = qabot.create_model(kind, hidden_size=12)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.train()
+        losses = []
+        for _ in range(10):
+            sp, sn, loss = m.train_one_batch(q, a)
+            losses.append(float(loss.data))
+        assert losses[-1] <= losses[0], (kind, losses)
+        assert sp.shape == (bs,) and sn.shape == (bs,)
+
+
+class TestZooSmoke:
+    @pytest.mark.parametrize("factory,shape", [
+        (lambda: mlp.create_model(), (4, 8)),
+        (lambda: cnn.create_model(num_channels=1), (2, 1, 28, 28)),
+    ])
+    def test_forward_and_train(self, factory, shape):
+        m = factory()
+        m.set_optimizer(opt.SGD(lr=0.05))
+        x = t(np.random.randn(*shape))
+        classes = 10
+        y = t(np.eye(classes, dtype=np.float32)[
+            np.random.randint(0, classes, shape[0])])
+        m.compile([x], is_train=True, use_graph=False)
+        _, loss1 = m(x, y)
+        _, loss2 = m(x, y)
+        assert float(loss2.data) < float(loss1.data) * 1.5  # sane step
