@@ -4,24 +4,54 @@ The reference's headline harness (examples/cnn/benchmark.py:85-87) measures
 `throughput = niters * batch * world / (end - start)` on ResNet-50 with
 synthetic data. The reference publishes no numbers (BASELINE.md), so
 ``vs_baseline`` reports against our own first recorded TPU run when one
-exists (BENCH_BASELINE env or the default below), else 1.0.
+exists (BENCH_BASELINE env), else 1.0.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Structure: the parent process is a pure orchestrator — it launches the
+actual benchmark in a child subprocess with a hard timeout, retries with
+backoff when the TPU backend is unavailable (the backend's init can hang or
+fail transiently), and falls back to a clearly-labeled CPU measurement as a
+last resort, so this script ALWAYS exits 0 with ONE parseable JSON line:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+# ResNet-50 @224x224: ~4.09 GMACs forward per image; 2 flops/MAC; a training
+# step (fwd + bwd wrt activations + bwd wrt weights) is ~3x forward.
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 4.09e9 * 2 * 3
+
+# Peak dense fp32/bf16 FLOP/s per chip by TPU generation (public figures),
+# for the MFU estimate. Overridable via BENCH_PEAK_TFLOPS.
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v5lite", 197e12), ("v5", 459e12), ("v4", 275e12), ("v3", 123e12),
+    ("v2", 45e12),
+]
 
 
-def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
-              dtype="float32"):
+def _peak_flops(device_kind: str):
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = (device_kind or "").lower()
+    for tag, peak in PEAK_FLOPS_BY_KIND:
+        if tag in kind:
+            return peak
+    return None
+
+
+def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
+    import jax
     from singa_tpu import tensor, opt, device
     from singa_tpu.models import resnet
+    import numpy as np
 
     dev = device.create_tpu_device()
+    platform = dev.jax_device.platform
     model = resnet.create_model(depth=depth, num_classes=10, num_channels=3)
     model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
 
@@ -46,24 +76,100 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
 
     throughput = niters * batch / (end - start)
     step_ms = (end - start) / niters * 1e3
-    return throughput, step_ms
+    peak = _peak_flops(getattr(dev.jax_device, "device_kind", ""))
+    mfu = (throughput * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+           if peak else None)
+    return {
+        "throughput": throughput,
+        "step_ms": step_ms,
+        "mfu": mfu,
+        "platform": platform,
+        "device_kind": getattr(dev.jax_device, "device_kind", "unknown"),
+    }
+
+
+def child_main(platform):
+    """Run the real benchmark; print ONE result JSON line on stdout."""
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        niters = int(os.environ.get("BENCH_ITERS", "3"))
+        warmup = 1
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        niters = int(os.environ.get("BENCH_ITERS", "50"))
+        warmup = 8
+    res = run_bench(batch=batch, niters=niters, warmup=warmup)
+    print(json.dumps(res), flush=True)
+
+
+def _attempt(platform, timeout):
+    """One child attempt; returns the parsed result dict or an error str."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return None, f"rc={proc.returncode}: {tail[-1] if tail else '?'}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, "no JSON in child output"
 
 
 def main():
-    niters = int(os.environ.get("BENCH_ITERS", "50"))
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
-    throughput, step_ms = run_bench(batch=batch, niters=niters)
-    # No published reference number exists (BASELINE.md); compare against a
-    # recorded prior run when provided.
+    errors = []
+    res = None
+    # TPU attempts with backoff; the backend is observably flaky, and a
+    # hung init is bounded by the per-attempt subprocess timeout.
+    timeouts = [480, 360]
+    for i, timeout in enumerate(timeouts):
+        res, err = _attempt("tpu", timeout)
+        if res is not None:
+            break
+        errors.append(f"tpu#{i + 1}: {err}")
+        print(f"bench: tpu attempt {i + 1} failed ({err})", file=sys.stderr)
+        if i + 1 < len(timeouts):
+            time.sleep(10 * (i + 1))
+    if res is None:
+        # last resort: a CPU number, clearly labeled, so the round still
+        # records a real measurement instead of a traceback
+        res, err = _attempt("cpu", 600)
+        if res is None:
+            errors.append(f"cpu: {err}")
+            print(json.dumps({
+                "metric": "resnet50_synthetic_images_per_sec_per_chip",
+                "value": None, "unit": "images/sec", "vs_baseline": 0.0,
+                "error": "; ".join(errors),
+            }))
+            return
     baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
-    vs = throughput / baseline if baseline > 0 else 1.0
-    print(json.dumps({
+    vs = res["throughput"] / baseline if baseline > 0 else 1.0
+    out = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
-        "value": round(throughput, 2),
+        "value": round(res["throughput"], 2),
         "unit": "images/sec",
         "vs_baseline": round(vs, 3),
-    }))
+        "step_ms": round(res["step_ms"], 2),
+        "platform": res["platform"],
+        "device_kind": res["device_kind"],
+    }
+    if res.get("mfu") is not None:
+        out["mfu"] = round(res["mfu"], 4)
+    if errors:
+        out["retries"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main(sys.argv[2] if len(sys.argv) > 2 else "tpu")
+    else:
+        main()

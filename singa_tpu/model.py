@@ -45,6 +45,17 @@ from .autograd_base import CTX
 from . import device as device_mod
 
 
+class _TensorSlot:
+    """Marker for a traced-tensor position in a step-arg layout (distinct
+    from a static ``None`` arg such as the default ``spars``)."""
+
+    def __repr__(self):
+        return "<tensor>"
+
+
+_TENSOR = _TensorSlot()
+
+
 def _flatten(obj, leaves):
     """Flatten nested tuples/lists/dicts of Tensors into arrays + treedef."""
     if isinstance(obj, Tensor):
@@ -81,8 +92,7 @@ class Model(Layer):
         self.dev = None
         self._compiled = False
         self._step_ready = False   # first (eager) train call done
-        self._jit_step = None
-        self._jit_eval = None
+        self._steps = {}           # static-arg signature -> compiled step
         self._state_list = None
         self._dist = None
         self.step_times = []
@@ -153,10 +163,33 @@ class Model(Layer):
         return list(seen.values())
 
     # -- the compiled step -------------------------------------------------
-    def _build_step(self, n_inputs):
+    @staticmethod
+    def _split_step_args(args):
+        """Split positional args into traced tensor inputs and static
+        config. Tensors/arrays are traced; strings, None and python
+        scalars — the reference calling convention
+        ``model(tx, ty, dist_option, spars)``
+        (reference examples/cnn/train_cnn.py:219) — are closed over into
+        the compiled step and key its cache, so each distinct dist option
+        gets its own executable instead of crashing ``jnp.asarray``."""
+        arrays, layout = [], []
+        for a in args:
+            if isinstance(a, Tensor):
+                arrays.append(a.data)
+                layout.append(_TENSOR)
+            elif isinstance(a, (np.ndarray, jax.Array)):
+                arrays.append(jnp.asarray(a))
+                layout.append(_TENSOR)
+            else:
+                layout.append(a)
+        return arrays, tuple(layout)
+
+    def _ensure_state(self):
+        """Collect mutable state once; move it to the model device
+        (optimizer scalars are born on the host default device)."""
+        if self._state_list is not None:
+            return
         state_list = self._state_tensors()
-        # unify placement: optimizer scalars (step counter, schedules) are
-        # born on the host default device; move all state to the model device
         for t in state_list:
             if not isinstance(t.data, jax.core.Tracer):
                 t.data = self.dev.put(t.data)
@@ -165,8 +198,14 @@ class Model(Layer):
         opt = getattr(self, "optimizer", None)
         if opt is not None:
             (opt.opt if hasattr(opt, "opt") else opt)._frozen = True
-        out_tree = {}
+
+    def _build_step(self, layout):
+        self._ensure_state()
+        state_list = self._state_list
+        rec = {"jit": None, "builder": None, "out_tree": {},
+               "leaf_specs": None, "input_specs": None}
         dist = self._dist
+        n_inputs = sum(1 for s in layout if s is _TENSOR)
 
         def fn(state_arrays, rng_key, *input_arrays):
             if dist is not None:
@@ -178,16 +217,18 @@ class Model(Layer):
             for t, a in zip(state_list, state_arrays):
                 t.data = a
             self.dev._set_rng_state(rng_key)
-            ins = [Tensor(data=a, device=self.dev, requires_grad=False)
-                   for a in input_arrays]
+            it = iter(input_arrays)
+            ins = [Tensor(data=next(it), device=self.dev,
+                          requires_grad=False) if s is _TENSOR else s
+                   for s in layout]
             res = self.train_one_batch(*ins)
             leaves = []
-            out_tree["tree"] = _flatten(res, leaves)
+            rec["out_tree"]["tree"] = _flatten(res, leaves)
             if dist is not None:
                 # output leaves that end up replicated (loss scalars,
                 # metrics, param snapshots) are averaged across batch-like
                 # shards so the replicated out-spec is sound
-                specs = self._leaf_specs
+                specs = rec["leaf_specs"]
                 raxes = tuple(dist.communicator.reduce_axes)
                 leaves = [x if specs[i] != P() else jax.lax.pmean(x, raxes)
                           for i, x in enumerate(leaves)]
@@ -219,7 +260,7 @@ class Model(Layer):
                 leaves = []
                 _flatten(self._eager_out, leaves)
                 full_batch = sample_inputs[0].shape[0]
-                self._shard_mask = [
+                shard_mask = [
                     jnp.asarray(x).ndim >= 1 and
                     jnp.asarray(x).shape[0] == full_batch for x in leaves]
                 # per-state sharding: tensor-parallel weights announce a
@@ -231,16 +272,16 @@ class Model(Layer):
                 # batch-on-'data' sharding (sequence parallelism shards
                 # dim 1 over 'seq': P('data', 'seq'))
                 user_in = getattr(self, "input_specs", None)
-                self._input_specs = list(user_in) if user_in is not None \
+                rec["input_specs"] = list(user_in) if user_in is not None \
                     else [P(axis)] * n_inputs
-                in_specs = (state_specs, P(), *self._input_specs)
+                in_specs = (state_specs, P(), *rec["input_specs"])
                 # per-output-leaf layouts: Model.output_specs (flattened
                 # leaf order) overrides the default "batch-leading leaves
                 # shard on 'data', everything else replicates"
                 user_out = getattr(self, "output_specs", None)
-                self._leaf_specs = list(user_out) if user_out is not None \
-                    else [P(axis) if m else P() for m in self._shard_mask]
-                out_specs = (state_specs, self._leaf_specs)
+                rec["leaf_specs"] = list(user_out) if user_out is not None \
+                    else [P(axis) if m else P() for m in shard_mask]
+                out_specs = (state_specs, rec["leaf_specs"])
                 import inspect
                 kw = {}
                 sig = inspect.signature(shard_map).parameters
@@ -252,13 +293,11 @@ class Model(Layer):
                                    out_specs=tuple(out_specs), **kw)
                 return jax.jit(mapped, donate_argnums=(0,))
 
-            self._jit_builder = build
-            self._jit_step = None  # built lazily on first sharded call
+            rec["builder"] = build
             self._mesh, self._axis = mesh, axis
         else:
-            self._jit_step = jax.jit(fn, donate_argnums=(0,))
-            self._jit_builder = None
-        self._out_tree = out_tree
+            rec["jit"] = jax.jit(fn, donate_argnums=(0,))
+        return rec
 
     def _run_step(self, *args):
         """Train-mode step dispatch (reference
@@ -271,15 +310,27 @@ class Model(Layer):
             self._step_ready = True
             self._eager_out = res
             return res
-        if self._jit_step is None and getattr(self, "_jit_builder", None) \
-                is None:
-            self._build_step(len(args))
-        input_arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
-                        for a in args]
+        input_arrays, layout = self._split_step_args(args)
+        try:
+            hash(layout)
+            key = layout
+        except TypeError:
+            key = repr(layout)
+        rec = self._steps.get(key)
+        if rec is None:
+            rec = self._build_step(layout)
+            self._steps[key] = rec
+            if len(self._steps) == 9:
+                import warnings
+                warnings.warn(
+                    "9th distinct static-arg signature compiled for this "
+                    "model; each costs a full trace+compile and is cached. "
+                    "Pass per-step-varying values as Tensors, not python "
+                    "scalars.", stacklevel=3)
         rng = self.dev.rand_key()
         host_key = self.dev._get_rng_state()  # tracing clobbers dev rng
-        if self._jit_step is None:
-            self._jit_step = self._jit_builder(input_arrays, rng)
+        if rec["jit"] is None:
+            rec["jit"] = rec["builder"](input_arrays, rng)
         state_arrays = [t.data for t in self._state_list]
         if self._dist is not None:
             from jax.sharding import NamedSharding
@@ -289,15 +340,14 @@ class Model(Layer):
             state_arrays = [
                 jax.device_put(a, NamedSharding(self._mesh, s))
                 for a, s in zip(state_arrays, specs)]
-            in_specs = getattr(self, "_input_specs", None) or \
+            in_specs = rec["input_specs"] or \
                 [P(self._axis)] * len(input_arrays)
             input_arrays = [
                 jax.device_put(a, NamedSharding(self._mesh, s))
                 for a, s in zip(input_arrays, in_specs)]
             rng = jax.device_put(rng, rep)
         t0 = time.perf_counter()
-        new_state, leaves = self._jit_step(state_arrays, rng,
-                                           *input_arrays)
+        new_state, leaves = rec["jit"](state_arrays, rng, *input_arrays)
         self.dev._set_rng_state(host_key)
         if self.dev.verbosity > 0:
             jax.block_until_ready(new_state)
@@ -305,7 +355,7 @@ class Model(Layer):
                 time.perf_counter() - t0
         for t, a in zip(self._state_list, new_state):
             t.data = a
-        return _unflatten(self._out_tree["tree"], list(leaves), self.dev)
+        return _unflatten(rec["out_tree"]["tree"], list(leaves), self.dev)
 
     def _unshard_state(self):
         """After mesh-sharded training the live state arrays span the mesh;
@@ -393,8 +443,7 @@ class Model(Layer):
             if opt_states:
                 opt.set_states(opt_states)
         # invalidate any compiled step: state identity may have changed
-        self._jit_step = None
-        self._jit_builder = None
+        self._steps = {}
         self._state_list = None
         return {k[len("aux/"):]: Tensor(data=v, requires_grad=False)
                 for k, v in arrays.items() if k.startswith("aux/")}

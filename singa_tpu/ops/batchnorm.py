@@ -42,6 +42,33 @@ class BatchNormHandle:
         return (1, self.channels) if ndim == 2 else (1, self.channels, 1, 1)
 
 
+# Mesh axes that shard the batch dimension BN statistics span. Inside a
+# shard_map'd data-parallel step each replica sees only its local batch
+# shard; sync-BN pmeans the moments over these axes so both normalisation
+# and the running-stat update use GLOBAL batch statistics — making the
+# sharded step numerically identical to a single-device full-batch step
+# (the SPMD-correct form of the reference's in-place running stats,
+# src/model/operation/batchnorm.h:103-115).
+BATCH_AXES = ("data",)
+
+
+def _global_moments(xb, axes):
+    """Batch mean/var, pmean-synchronised across data-parallel shards
+    (identity outside a mesh context). Two-pass: variance is the mean
+    squared deviation around the GLOBAL mean — numerically stable (never
+    negative) and, with equal-sized shards, exactly the full-batch biased
+    variance."""
+    from ..parallel.communicator import active_axis
+    paxes = tuple(a for a in BATCH_AXES if active_axis(a))
+    mean = jnp.mean(xb, axis=axes)
+    if paxes:
+        mean = jax.lax.pmean(mean, paxes)
+    var = jnp.mean(jnp.square(xb - jnp.expand_dims(mean, axes)), axis=axes)
+    if paxes:
+        var = jax.lax.pmean(var, paxes)
+    return mean, var
+
+
 class _BatchNorm2d(Operator):
     """Training-mode BN over batch stats; grads for (x, scale, bias)."""
 
@@ -52,8 +79,7 @@ class _BatchNorm2d(Operator):
     def forward(self, x, scale, bias):
         h = self.handle
         axes = h._axes(x.ndim)
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        mean, var = _global_moments(x, axes)
         bshape = h._bshape(x.ndim)
         inv = jax.lax.rsqrt(var + h.eps).reshape(bshape)
         return (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) \
@@ -90,8 +116,7 @@ def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,
         h = handle
         axes = h._axes(x.ndim)
         xb = x.data if isinstance(x, Tensor) else x
-        batch_mean = jnp.mean(xb, axis=axes)
-        batch_var = jnp.var(xb, axis=axes)
+        batch_mean, batch_var = _global_moments(xb, axes)
         m = h.factor
         running_mean.data = m * running_mean.data + (1 - m) * batch_mean
         running_var.data = m * running_var.data + (1 - m) * batch_var

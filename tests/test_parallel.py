@@ -204,3 +204,143 @@ class TestPipeline:
         gref = jax.grad(ref_loss)(jnp.asarray(Ws))
         np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestDistOptions:
+    """Every reference dist option (examples/cnn/model/cnn.py:52-70 →
+    DistOpt variants, reference opt.py:867-1094) through the COMPILED
+    graph-mode path on the 8-device CPU mesh. Step 1 is the eager trace;
+    step >= 2 runs the jitted shard_map step, which is exactly where the
+    static string args used to crash (``dist_option`` flattened through
+    jnp.asarray)."""
+
+    def _train(self, dist_option, spars=None, steps=6, use_graph=True,
+               distributed=True, seed=11, lr=0.1):
+        from singa_tpu.models import mlp as mlp_mod
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(seed)
+        x, y = make_data(n=64, din=8, classes=4, seed=2)
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = mlp_mod.create_model(data_size=8, perceptron_size=16,
+                                 num_classes=4)
+        if distributed:
+            d = opt.DistOpt(opt.SGD(lr=lr, momentum=0.9))
+            msh = mesh_mod.make_mesh(jax.devices("cpu"),
+                                     mesh_mod.MeshConfig())
+            d.communicator.mesh = msh
+            m.set_optimizer(d)
+        else:
+            m.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=use_graph)
+        losses = []
+        for _ in range(steps):
+            out, loss = m(tx, ty, dist_option, spars)
+            losses.append(float(np.asarray(loss.data)))
+        return losses
+
+    def test_half_compiled_trains(self):
+        losses = self._train("half")
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_half_close_to_single_device(self):
+        # bf16 gradient comm rounds mantissas; trajectories stay close to
+        # the fp32 single-device run but not bit-identical
+        dist_losses = self._train("half")
+        ref_losses = self._train("plain", distributed=False)
+        np.testing.assert_allclose(dist_losses, ref_losses, rtol=0.05)
+
+    def test_plain_matches_single_device(self):
+        dist_losses = self._train("plain")
+        ref_losses = self._train("plain", distributed=False)
+        np.testing.assert_allclose(dist_losses, ref_losses, rtol=2e-4)
+
+    def test_partial_update_compiled_trains(self):
+        losses = self._train("partialUpdate", steps=10)
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_sparse_topk_compiled_trains(self):
+        losses = self._train("sparseTopK", spars=0.3, steps=10)
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_sparse_threshold_compiled_trains(self):
+        losses = self._train("sparseThreshold", spars=1e-3, steps=10)
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_static_arg_cache_switches_options(self):
+        # alternating static signatures must hit distinct compiled steps,
+        # not crash or cross-contaminate
+        from singa_tpu.models import mlp as mlp_mod
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(5)
+        x, y = make_data(n=64, din=8, classes=4, seed=2)
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = mlp_mod.create_model(data_size=8, perceptron_size=16,
+                                 num_classes=4)
+        d = opt.DistOpt(opt.SGD(lr=0.05))
+        d.communicator.mesh = mesh_mod.make_mesh(jax.devices("cpu"),
+                                                 mesh_mod.MeshConfig())
+        m.set_optimizer(d)
+        m.compile([tx], is_train=True, use_graph=True)
+        for option in ["plain", "half", "plain", "half"]:
+            out, loss = m(tx, ty, option, None)
+            assert np.isfinite(float(np.asarray(loss.data)))
+        assert len(m._steps) == 2
+
+
+class BNModel(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.conv = layer.Conv2d(4, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.flat = layer.Flatten()
+        self.fc = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc(self.flat(self.bn(self.conv(x))))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+class TestSyncBatchNorm:
+    """Sync-BN: inside the DP shard_map step each replica sees 1/N of the
+    batch; the op pmeans moments over the 'data' axis so normalisation AND
+    running stats use global batch statistics — the sharded step must be
+    numerically identical to a single-device full-batch run (the sound SPMD
+    form of reference batchnorm.h:103-115 in-place running stats)."""
+
+    def _train(self, distributed, steps=4):
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(9)
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 3, 8, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+        m = BNModel()
+        if distributed:
+            d = opt.DistOpt(opt.SGD(lr=0.1))
+            d.communicator.mesh = mesh_mod.make_mesh(
+                jax.devices("cpu"), mesh_mod.MeshConfig())
+            m.set_optimizer(d)
+        else:
+            m.set_optimizer(opt.SGD(lr=0.1))
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+        losses = [float(np.asarray(m(tx, ty)[1].data))
+                  for _ in range(steps)]
+        rmean = np.asarray(jax.device_get(m.bn.running_mean.data))
+        rvar = np.asarray(jax.device_get(m.bn.running_var.data))
+        return losses, rmean, rvar
+
+    def test_dp_bn_matches_single_device(self):
+        dl, dmean, dvar = self._train(True)
+        sl, smean, svar = self._train(False)
+        np.testing.assert_allclose(dl, sl, rtol=1e-4)
+        np.testing.assert_allclose(dmean, smean, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dvar, svar, rtol=1e-4, atol=1e-6)
