@@ -8,6 +8,7 @@ re-exported by ``singa_tpu.autograd`` for reference parity
 
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import jax
@@ -15,6 +16,14 @@ import jax.numpy as jnp
 
 from .tensor import Tensor
 from . import device as device_mod
+
+
+def _profiling(dev, arrays) -> bool:
+    """Per-op timing is on when the device asks for verbosity>=2 and the
+    values are concrete (timing a traced abstract op is meaningless — the
+    compiled step's cost is captured by XLA cost analysis instead)."""
+    return (dev is not None and dev.verbosity >= 2 and
+            not any(isinstance(a, jax.core.Tracer) for a in arrays))
 
 
 class _Context:
@@ -87,10 +96,18 @@ class Operator:
                         device_mod.get_default_device())
         tape = ((CTX.training or CTX.recording) and self.differentiable and
                 any(isinstance(x, Tensor) and x.requires_grad for x in xs))
+        prof = _profiling(self.dev, raws)
+        if prof:
+            jax.block_until_ready(raws)   # exclude producers' async work
+            t0 = time.perf_counter()
         if tape and not self._has_custom_backward():
             ys, self._vjp_fn = jax.vjp(self.forward, *raws)
         else:
             ys = self.forward(*raws)
+        if prof:
+            jax.block_until_ready(ys)
+            self.dev._record_time(f"fwd/{type(self).__name__}",
+                                  time.perf_counter() - t0)
         multiple = isinstance(ys, (tuple, list))
         ys_t = tuple(ys) if multiple else (ys,)
 
@@ -201,9 +218,17 @@ def backward(y: Tensor, dy=None):
                 yield (t, g)
             continue
 
+        prof = _profiling(op.dev, dys)
+        if prof:
+            jax.block_until_ready(dys)
+            t0 = time.perf_counter()
         dxs = op.backward(*dys)
         if not isinstance(dxs, (tuple, list)):
             dxs = (dxs,)
+        if prof:
+            jax.block_until_ready([d for d in dxs if not _is_float0(d)])
+            op.dev._record_time(f"bwd/{type(op).__name__}",
+                                time.perf_counter() - t0)
         assert len(dxs) == len(op.src), \
             f"{op.name}: backward returned {len(dxs)} grads for " \
             f"{len(op.src)} inputs"

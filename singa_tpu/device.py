@@ -101,13 +101,35 @@ class Device:
     def ResetGraph(self) -> None:
         pass
 
+    def _record_time(self, name: str, seconds: float) -> None:
+        """Accumulate a timing sample (count, total seconds) under a name.
+        Sample sources: whole compiled steps at verbosity>=1, per-op
+        fwd/bwd at verbosity>=2 (reference per-node cudaEvent timing,
+        src/core/device/cuda_gpu.cc:117, scheduler.cc:240-298)."""
+        rec = self.time_profiling.setdefault(name, [0, 0.0])
+        rec[0] += 1
+        rec[1] += seconds
+
     def PrintTimeProfiling(self) -> None:
+        """Print the aggregated timing table (reference
+        Graph::PrintTimeProfiling, src/core/scheduler/scheduler.cc:240-298:
+        verbosity 1 = whole step, verbosity 2 = per-op rows)."""
         if not self.time_profiling:
             print("No time profiling data collected; "
-                  "set verbosity>0 and run a compiled model step.")
+                  "set verbosity>0 and run model steps.")
             return
-        for name, secs in sorted(self.time_profiling.items()):
-            print(f"  {name}: {secs * 1e3:.3f} ms")
+        rows = sorted(self.time_profiling.items(),
+                      key=lambda kv: -kv[1][1])
+        width = max(len(k) for k, _ in rows)
+        print(f"  {'op':<{width}}  {'calls':>6}  {'total ms':>10}  "
+              f"{'avg ms':>9}")
+        for name, (count, total) in rows:
+            avg = total / count if count else 0.0
+            print(f"  {name:<{width}}  {count:>6}  {total * 1e3:>10.3f}  "
+                  f"{avg * 1e3:>9.3f}")
+
+    def ResetTimeProfiling(self) -> None:
+        self.time_profiling = {}
 
     def SetVerbosity(self, verbosity: int) -> None:
         self.verbosity = int(verbosity)

@@ -95,6 +95,7 @@ class Model(Layer):
         self._steps = {}           # static-arg signature -> compiled step
         self._state_list = None
         self._dist = None
+        self._step_count = 0
         self.step_times = []
 
     # -- user hooks --------------------------------------------------------
@@ -346,16 +347,96 @@ class Model(Layer):
                 jax.device_put(a, NamedSharding(self._mesh, s))
                 for a, s in zip(input_arrays, in_specs)]
             rng = jax.device_put(rng, rep)
+        if self.dev.verbosity >= 2 and "cost" not in rec:
+            # one-time XLA cost analysis of this step signature (the
+            # compiled-world per-op metric: flops / bytes, reference
+            # per-node profiling scheduler.cc:240-298). The AOT-compiled
+            # executable replaces the jit wrapper so the signature is
+            # compiled exactly once.
+            rec["cost"] = None
+            try:
+                compiled = rec["jit"].lower(
+                    state_arrays, rng, *input_arrays).compile()
+                rec["cost"] = compiled.cost_analysis()
+                rec["jit"] = compiled
+            except Exception:   # cost analysis is backend-best-effort
+                pass
         t0 = time.perf_counter()
         new_state, leaves = rec["jit"](state_arrays, rng, *input_arrays)
         self.dev._set_rng_state(host_key)
-        if self.dev.verbosity > 0:
+        self._step_count += 1
+        if self.dev.verbosity > 0 and \
+                self._step_count > self.dev.skip_iteration:
+            # reference semantics: timing starts after skip_iteration
+            # steps (include/singa/core/device.h:115-129)
             jax.block_until_ready(new_state)
-            self.dev.time_profiling["train_one_batch"] = \
-                time.perf_counter() - t0
+            self.dev._record_time("train_one_batch",
+                                  time.perf_counter() - t0)
         for t, a in zip(self._state_list, new_state):
             t.data = a
         return _unflatten(rec["out_tree"]["tree"], list(leaves), self.dev)
+
+    # -- profiling / debugging --------------------------------------------
+    def cost_analysis(self):
+        """XLA cost analysis (flops, bytes accessed, ...) per compiled
+        step signature, captured at verbosity>=2. The compiled-world form
+        of the reference's per-op profiling (scheduler.cc:240-298): XLA
+        fuses ops, so per-fusion costs replace per-node times."""
+        out = {}
+        for key, rec in self._steps.items():
+            c = rec.get("cost")
+            if isinstance(c, (list, tuple)):
+                c = c[0] if c else None
+            out[key] = c
+        return out
+
+    def graph_debug(self, *args, print_out=True, max_rows=None):
+        """Dump the traced training step as a jaxpr op table — the XLA-era
+        ``Graph::Debug`` (reference src/core/scheduler/scheduler.cc:109-238
+        dumps nodes/edges/blocks; here each jaxpr equation is a node and
+        its avals are the blocks). Call with the same args as a step."""
+        if not self._step_ready:
+            raise ValueError(
+                "graph_debug needs materialised state: run one training "
+                "step first (the eager first call creates optimizer aux)")
+        input_arrays, layout = self._split_step_args(args)
+        self._ensure_state()
+        state_arrays = [t.data for t in self._state_list]
+        backup = list(state_arrays)
+        host_key = self.dev._get_rng_state()
+
+        def fn(state_arrays, *input_arrays):
+            for t, a in zip(self._state_list, state_arrays):
+                t.data = a
+            it = iter(input_arrays)
+            ins = [Tensor(data=next(it), device=self.dev,
+                          requires_grad=False) if s is _TENSOR else s
+                   for s in layout]
+            res = self.train_one_batch(*ins)
+            leaves = []
+            _flatten(res, leaves)
+            return [t.data for t in self._state_list], leaves
+
+        try:
+            jaxpr = jax.make_jaxpr(fn)(state_arrays, *input_arrays)
+        finally:
+            for t, a in zip(self._state_list, backup):
+                t.data = a
+            self.dev._set_rng_state(host_key)
+        eqns = jaxpr.jaxpr.eqns
+        lines = [f"step graph: {len(eqns)} ops, "
+                 f"{len(jaxpr.jaxpr.invars)} inputs, "
+                 f"{len(jaxpr.jaxpr.outvars)} outputs"]
+        shown = eqns if max_rows is None else eqns[:max_rows]
+        for i, eqn in enumerate(shown):
+            outs = ", ".join(str(v.aval) for v in eqn.outvars)
+            lines.append(f"{i:4d}  {eqn.primitive.name:<28} -> {outs}")
+        if max_rows is not None and len(eqns) > max_rows:
+            lines.append(f"... {len(eqns) - max_rows} more ops")
+        text = "\n".join(lines)
+        if print_out:
+            print(text)
+        return text
 
     def _unshard_state(self):
         """After mesh-sharded training the live state arrays span the mesh;

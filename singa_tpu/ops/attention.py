@@ -88,92 +88,350 @@ def _merge_partials(out, m, l):
     return (out / jnp.maximum(l, 1e-30)[..., None])
 
 
-def _reference_attention(q, k, v, causal, scale, block_k=512):
+def _scan_flash_fwd(q, k, v, causal, scale, block_k=512):
+    """Scan-path forward returning (out, lse). lse = m + log(l) is the
+    log-sum-exp of each query row — the O(S) residual the flash backward
+    rebuilds probabilities from."""
     out, m, l = _block_scan_attention(q.astype(jnp.float32),
                                       k.astype(jnp.float32),
                                       v.astype(jnp.float32),
                                       causal, scale, block_k)
-    return _merge_partials(out, m, l).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return _merge_partials(out, m, l).astype(q.dtype), lse
 
 
-# ---------------------------------------------------------------------------
-# Pallas TPU forward kernel
-# ---------------------------------------------------------------------------
+def _reference_attention(q, k, v, causal, scale, block_k=512):
+    return _scan_flash_fwd(q, k, v, causal, scale, block_k)[0]
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
-                      scale, seq_k, block_q):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)            # (block_q, D)
-    nkb = seq_k // block_k
 
-    def body(j, carry):
-        out, m, l = carry
-        kblk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+def _scan_flash_bwd(q, k, v, out, lse, g, causal, scale, block_k):
+    """Blocked flash backward (everywhere-correct math; the Pallas TPU
+    kernels below implement the same recurrence). Probabilities are
+    recomputed per k-block from (q, k, lse) — never an S×S matrix — so
+    residual memory stays O(S·D):
+
+        delta = rowsum(dO * O)
+        P     = exp(S - lse)           (block recompute)
+        dV    = Pᵀ dO
+        dS    = P * (dO Vᵀ - delta) * scale
+        dQ    = dS K ;  dK = dSᵀ Q
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_k = min(block_k, Sk)
+    nblocks = (Sk + block_k - 1) // block_k
+    pad = nblocks * block_k - Sk
+    kp, vp = k, v
+    if pad:
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kp.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(B, H, nblocks, block_k, D).transpose(2, 0, 1, 3, 4)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # (B, H, Sq)
+    q_pos = jnp.arange(Sq)
+
+    def step(dq, inputs):
+        blk, kblk, vblk = inputs
+        kblk = kblk.astype(jnp.float32)
+        vblk = vblk.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk,
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = blk * block_k + jnp.arange(block_k)
+        mask = k_pos[None, :] < Sk
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        out_new = out * alpha[:, None] + jnp.dot(
-            p, vblk, preferred_element_type=jnp.float32)
-        return out_new, m_new, l_new
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])           # masked entries -> 0
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk,
+                             preferred_element_type=jnp.float32)
+        dkb = jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+                         preferred_element_type=jnp.float32)
+        dvb = jnp.einsum("bhqk,bhqd->bhkd", p, gf,
+                         preferred_element_type=jnp.float32)
+        return dq, (dkb, dvb)
 
-    D = q.shape[-1]
-    init = (jnp.zeros((q.shape[0], D), jnp.float32),
-            jnp.full((q.shape[0],), _NEG_INF, jnp.float32),
-            jnp.zeros((q.shape[0],), jnp.float32))
-    out, m, l = jax.lax.fori_loop(0, nkb, body, init)
-    o_ref[0] = (out / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    # qf * 0.0 (not fresh zeros) so the carry inherits qf's shard_map
+    # varying-axes type — same workaround as the forward scan init
+    dq, (dkbs, dvbs) = lax.scan(
+        step, qf * 0.0, (jnp.arange(nblocks), kb, vb))
+    dk = dkbs.transpose(1, 2, 0, 3, 4).reshape(
+        B, H, nblocks * block_k, D)[:, :, :Sk]
+    dv = dvbs.transpose(1, 2, 0, 3, 4).reshape(
+        B, H, nblocks * block_k, D)[:, :, :Sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels (forward + backward)
+#
+# All kernels run a 3-D grid whose innermost dimension streams the far-side
+# blocks through VMEM — K/V blocks for the forward/dQ kernels, Q blocks for
+# the dK/dV kernel — so VMEM holds O(block · D) regardless of sequence
+# length (the whole point of the long-context path). TPU grids iterate the
+# trailing dimension sequentially, which is what makes the scratch-ref
+# accumulator pattern below sound.
+# ---------------------------------------------------------------------------
 
 try:  # pallas import is TPU-oriented; keep CPU-only installs working
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu
     HAS_PALLAS = True
 except ImportError:  # pragma: no cover
     HAS_PALLAS = False
 
+# Test hook: run kernels in interpreter mode so CPU CI validates the exact
+# kernel math the TPU executes (tests/test_attention.py flips this).
+FORCE_PALLAS_INTERPRET = False
+
+
+def _use_pallas(q, k, block_q, block_k):
+    if not HAS_PALLAS:
+        return False
+    bq = min(block_q, q.shape[2])
+    bk = min(block_k, k.shape[2])
+    if q.shape[2] % bq or k.shape[2] % bk:
+        return False
+    return jax.default_backend() == "tpu" or FORCE_PALLAS_INTERPRET
+
+
+def _interpret():
+    return FORCE_PALLAS_INTERPRET or jax.default_backend() != "tpu"
+
+
+def _causal_positions(qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos, k_pos
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      causal, scale, block_q, block_k, nkb):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else kj >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+        kblk = k_ref[0].astype(jnp.float32)       # (block_k, D)
+        vblk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
+            p, vblk, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    # the last k-block this q-block attends to writes the result
+    last = jnp.minimum(nkb - 1, ((qi + 1) * block_q - 1) // block_k) \
+        if causal else nkb - 1
+
+    @pl.when(kj == last)
+    def _write():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *,
+                         causal, scale, block_q, block_k, nkb):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else kj >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jnp.dot(g, vblk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        acc_ref[...] += jnp.dot(ds, kblk,
+                                preferred_element_type=jnp.float32)
+
+    last = jnp.minimum(nkb - 1, ((qi + 1) * block_q - 1) // block_k) \
+        if causal else nkb - 1
+
+    @pl.when(kj == last)
+    def _write():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          causal, scale, block_q, block_k, nqb):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = ((qi + 1) * block_q - 1 >= kj * block_k) if causal else qi >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jnp.dot(g, vblk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[...] += jnp.dot(ds.T, q,
+                               preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(p.T, g,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nqb - 1)
+    def _write():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
 
 def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
-    """(B, H, S, D) fused attention forward on the MXU."""
+    """(B, H, S, D) fused attention forward on the MXU -> (out, lse)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
     assert Sq % block_q == 0 and Sk % block_k == 0, \
         "flash kernel needs sequence divisible by block size"
+    nkb = Sk // block_k
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, scale=scale, seq_k=Sk,
-                               block_q=block_q)
-    out = pl.pallas_call(
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal,
+                               scale=scale, block_q=block_q,
+                               block_k=block_k, nkb=nkb)
+    out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, Sq // block_q),
+        grid=(B * H, Sq // block_q, nkb),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
+        interpret=_interpret(),
     )(qr, kr, vr)
-    return out.reshape(B, H, Sq, D)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
 
 
-def _on_tpu(*arrays):
-    # backend-level dispatch: under jit/shard_map tracing the operands are
-    # Tracers (no .devices()), but the computation compiles for the default
-    # backend, which is what decides whether the Pallas kernel can run
-    return jax.default_backend() == "tpu"
+def _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale,
+                      block_q=128, block_k=128):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    assert Sq % block_q == 0 and Sk % block_k == 0, \
+        "flash kernel needs sequence divisible by block size"
+    nqb, nkb = Sq // block_q, Sk // block_k
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    gr = g.reshape(B * H, Sq, D)
+    lser = lse.reshape(B * H, Sq)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(B * H, Sq)
+
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0))
+    rowspec = pl.BlockSpec((1, block_q), lambda b, x, y: (b, x))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, nkb=nkb),
+        grid=(B * H, nqb, nkb),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            qspec, rowspec, rowspec,
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lser, delta)
+
+    kvspec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, nqb=nqb),
+        grid=(B * H, nkb, nqb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            kvspec, kvspec,
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lser, delta)
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_k):
+    if _use_pallas(q, k, 128, 128):
+        return _pallas_flash_fwd(q, k, v, causal, scale)
+    return _scan_flash_fwd(q, k, v, causal, scale, block_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -181,33 +439,31 @@ def flash_attention(q, k, v, causal=False, scale=None, block_k=512):
     """Fused multi-head attention: softmax(q·kᵀ·scale [+ causal mask])·v.
 
     q/k/v: (batch, heads, seq, head_dim). The S×S score matrix is never
-    materialised (blocked online softmax), so memory is O(S·D) — the
-    long-context path. Differentiable (custom vjp recomputes block scores).
+    materialised in either direction — forward keeps online-softmax
+    accumulators, backward recomputes per-block probabilities from the
+    saved lse — so train-mode memory is O(S·D). On TPU both directions run
+    as Pallas kernels (primal path included, so inference uses the fused
+    kernel too); elsewhere identical-math `lax.scan` implementations run.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _reference_attention(q, k, v, causal, scale, block_k)
+    return _flash_fwd_impl(q, k, v, causal, scale, block_k)[0]
 
 
 def _flash_fwd(q, k, v, causal, scale, block_k):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if HAS_PALLAS and _on_tpu(q, k, v) and q.shape[2] % 128 == 0 \
-            and k.shape[2] % 128 == 0:
-        out = _pallas_flash_fwd(q, k, v, causal, scale)
-    else:
-        out = _reference_attention(q, k, v, causal, scale, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_k, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, scale,
-                                                block_k), q, k, v)
-    return vjp(g)
+    if _use_pallas(q, k, 128, 128):
+        return _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale)
+    return _scan_flash_bwd(q, k, v, out, lse, g, causal, scale, block_k)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

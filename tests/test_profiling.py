@@ -1,0 +1,111 @@
+"""Per-op profiling and graph debugging (reference verbosity 1/2 timing
+src/core/scheduler/scheduler.cc:240-298, Graph::Debug scheduler.cc:109-238,
+device knobs include/singa/core/device.h:115-129)."""
+
+import numpy as np
+
+from singa_tpu import device, layer, model, opt, tensor
+
+
+class SmallNet(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def make_model(verbosity, skip=0, use_graph=True):
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(3)
+    dev.SetVerbosity(verbosity)
+    dev.SetSkipIteration(skip)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m = SmallNet()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    m.compile([tx], is_train=True, use_graph=use_graph)
+    return m, dev, tx, ty
+
+
+class TestPerOpProfiling:
+    def test_verbosity2_records_per_op_fwd_and_bwd(self):
+        m, dev, tx, ty = make_model(verbosity=2)
+        m(tx, ty)   # eager first step: per-op timing active
+        fwd = [k for k in dev.time_profiling if k.startswith("fwd/")]
+        bwd = [k for k in dev.time_profiling if k.startswith("bwd/")]
+        assert any("Matmul" in k or "Linear" in k or "AddBias" in k
+                   for k in fwd), fwd
+        assert bwd, dev.time_profiling
+        count, total = next(iter(dev.time_profiling.values()))
+        assert count >= 1 and total >= 0.0
+
+    def test_verbosity1_no_per_op_rows(self):
+        m, dev, tx, ty = make_model(verbosity=1)
+        m(tx, ty)
+        assert not any(k.startswith(("fwd/", "bwd/"))
+                       for k in dev.time_profiling)
+
+    def test_compiled_step_timing_honors_skip_iteration(self):
+        m, dev, tx, ty = make_model(verbosity=1, skip=3)
+        for _ in range(5):   # call 1 eager + 4 compiled steps
+            m(tx, ty)
+        # compiled steps 1..4; only those past skip=3 are recorded
+        assert dev.time_profiling["train_one_batch"][0] == 1
+
+    def test_print_time_profiling_table(self, capsys):
+        m, dev, tx, ty = make_model(verbosity=2)
+        for _ in range(3):
+            m(tx, ty)
+        dev.PrintTimeProfiling()
+        out = capsys.readouterr().out
+        assert "train_one_batch" in out and "avg ms" in out
+        assert "fwd/" in out
+
+    def test_reset(self):
+        m, dev, tx, ty = make_model(verbosity=1)
+        for _ in range(3):
+            m(tx, ty)
+        dev.ResetTimeProfiling()
+        assert dev.time_profiling == {}
+
+
+class TestCostAnalysisAndGraphDebug:
+    def test_cost_analysis_captured_at_verbosity2(self):
+        m, dev, tx, ty = make_model(verbosity=2)
+        for _ in range(2):
+            m(tx, ty)
+        costs = m.cost_analysis()
+        assert len(costs) == 1
+        c = next(iter(costs.values()))
+        if c is not None:   # backend-best-effort
+            assert c.get("flops", 0) > 0
+
+    def test_graph_debug_lists_ops(self):
+        m, dev, tx, ty = make_model(verbosity=0)
+        m(tx, ty)
+        text = m.graph_debug(tx, ty, print_out=False)
+        assert "dot_general" in text
+        assert "step graph:" in text
+        # state must be restored (no tracers leaked)
+        loss = float(np.asarray(m(tx, ty)[1].data))
+        assert np.isfinite(loss)
+
+    def test_graph_debug_max_rows(self):
+        m, dev, tx, ty = make_model(verbosity=0)
+        m(tx, ty)
+        text = m.graph_debug(tx, ty, print_out=False, max_rows=3)
+        assert "more ops" in text
