@@ -11,9 +11,41 @@ backward-through-the-pipeline falls out of `jax.grad` (the transpose of
 
 from __future__ import annotations
 
+import functools
+
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..autograd_base import Operator
+from ..layer import Layer
+from ..tensor import Tensor
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _pipe_descale(x, axis_name):
+    """Identity whose transpose divides the cotangent by the pipe degree.
+
+    In the Model's shard_map (replication checks off) every pipe member
+    computes the downstream loss redundantly and injects a full cotangent;
+    the last-stage psum broadcast's transpose then sums them, inflating
+    every in-pipeline gradient by the pipe degree. This normalises at the
+    pipeline boundary so stage-param and upstream grads equal the
+    single-program values."""
+    return x
+
+
+def _pipe_descale_fwd(x, axis_name):
+    return x, None
+
+
+def _pipe_descale_bwd(axis_name, _res, g):
+    return (g / lax.axis_size(axis_name),)
+
+
+_pipe_descale.defvjp(_pipe_descale_fwd, _pipe_descale_bwd)
 
 
 def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
@@ -80,3 +112,86 @@ def microbatch(x, n_micro):
     B = x.shape[0]
     assert B % n_micro == 0, f"batch {B} not divisible by {n_micro}"
     return x.reshape((n_micro, B // n_micro) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Layer/Model API integration
+# ---------------------------------------------------------------------------
+
+class _Pipeline(Operator):
+    """Tape op running the GPipe schedule. Inside the compiled shard_map'd
+    step (mesh 'pipe' axis active) each pipe member holds its stage's
+    (1, ...) slice of the stacked params and activations ride the ring;
+    outside a mesh (the eager first step, eval, single-device) the stages
+    run sequentially — identical math, so eager/compiled parity holds."""
+
+    def __init__(self, stage_apply, n_stages, n_micro, axis="pipe"):
+        super().__init__()
+        self.stage_apply = stage_apply
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.axis = axis
+        self._mesh_branch = False
+
+    def forward(self, x, *stacked):
+        from .communicator import active_axis
+        if active_axis(self.axis):
+            self._mesh_branch = True
+            assert stacked[0].shape[0] == 1, \
+                f"mesh 'pipe' axis must have degree n_stages=" \
+                f"{self.n_stages}; got param slice {stacked[0].shape}"
+            local = [s[0] for s in stacked]
+            x_mb = microbatch(x, self.n_micro)
+            out = pipeline_spmd(
+                lambda params, a: self.stage_apply(params, a),
+                local, x_mb, self.axis)
+            return _pipe_descale(out.reshape((-1,) + out.shape[2:]),
+                                 self.axis)
+        self._mesh_branch = False
+        a = x
+        for i in range(self.n_stages):
+            a = self.stage_apply([s[i] for s in stacked], a)
+        return a
+
+
+class PipelineModule(Layer):
+    """A pipeline-parallel stack of ``n_stages`` structurally identical
+    stages, reachable from the Layer/Model API: drop it into a Model's
+    forward and give the DistOpt mesh a 'pipe' axis of degree n_stages.
+
+    ``stage_init(rng, x_shape) -> [arrays]`` builds one stage's params;
+    ``stage_apply(params, a) -> a`` applies a stage (must preserve the
+    activation shape — the GPipe ring rotates a fixed-shape buffer).
+    Stage params are stacked on a leading axis and sharded P('pipe', ...),
+    so each pipe member materialises only its own stage (optimizer
+    moments inherit the spec and shard the same way).
+    """
+
+    def __init__(self, stage_apply, stage_init, n_stages, n_micro,
+                 axis="pipe"):
+        super().__init__()
+        self.stage_apply = stage_apply
+        self.stage_init = stage_init
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.axis = axis
+
+    def initialize(self, x):
+        rng = np.random.RandomState(0)
+        per_stage = [list(self.stage_init(rng, x.shape))
+                     for _ in range(self.n_stages)]
+        self._params = []
+        for j in range(len(per_stage[0])):
+            stacked = jnp.stack([jnp.asarray(per_stage[i][j])
+                                 for i in range(self.n_stages)])
+            t = Tensor(data=stacked, device=x.device, requires_grad=True)
+            t.stores_grad = True
+            t.spec = P(self.axis)
+            self._params.append(t)
+
+    def forward(self, x):
+        return _Pipeline(self.stage_apply, self.n_stages, self.n_micro,
+                         self.axis)(x, *self._params)
+
+    def _own_params(self):
+        return {f"stage_param{j}": t for j, t in enumerate(self._params)}
