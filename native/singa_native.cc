@@ -22,6 +22,8 @@
 #include <cstring>
 #include <deque>
 #include <fstream>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -120,6 +122,7 @@ class BoundedQueue {
 struct RecordReader {
   std::ifstream in;
   std::string path;
+  int prefetch_depth = 0;
   // prefetch machinery (nullptr when prefetch is off)
   std::unique_ptr<BoundedQueue> queue;
   std::thread worker;
@@ -134,6 +137,8 @@ struct RecordReader {
       prefetching = false;
     }
   }
+
+  void start_prefetch();
 };
 
 bool read_u32(std::ifstream& in, uint32_t* v) {
@@ -155,6 +160,19 @@ bool read_record(std::ifstream& in, Record* r) {
   return true;
 }
 
+void RecordReader::start_prefetch() {
+  queue.reset(new BoundedQueue(static_cast<size_t>(prefetch_depth)));
+  prefetching = true;
+  RecordReader* r = this;
+  worker = std::thread([r] {
+    Record rec;
+    while (read_record(r->in, &rec)) {
+      if (!r->queue->push(std::move(rec))) break;
+    }
+    r->queue->set_done();
+  });
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -172,6 +190,93 @@ SG_EXPORT void sg_log(int severity, const char* msg) {
 SG_EXPORT double sg_monotonic_seconds() {
   auto now = std::chrono::steady_clock::now().time_since_epoch();
   return std::chrono::duration<double>(now).count();
+}
+
+// ---------------------------------------------------------------------------
+// C ABI: named log channels (reference include/singa/utils/channel.h:35-77,
+// src/utils/channel.cc) — append metric/progress lines to a per-channel
+// file (default: <dir>/<name>) and/or stderr.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct LogChannel {
+  std::string name;
+  bool to_stderr = false;
+  bool to_file = true;
+  std::ofstream os;
+  std::mutex mu;
+};
+
+struct ChannelManager {
+  std::mutex mu;
+  std::string dir;
+  std::map<std::string, LogChannel*> chans;
+};
+
+ChannelManager& channel_manager() {
+  static ChannelManager mgr;
+  return mgr;
+}
+
+void channel_open_file(LogChannel* ch, const std::string& path) {
+  if (ch->os.is_open()) ch->os.close();
+  {
+    std::ifstream fin(path.c_str());
+    if (fin.good())
+      log_msg(2, "channel messages will be appended to existing file: " +
+                     path);
+  }
+  ch->os.open(path.c_str(), std::ios::app);
+  if (!ch->os.is_open())
+    log_msg(2, "cannot open channel file: " + path);
+}
+
+}  // namespace
+
+SG_EXPORT void sg_set_channel_directory(const char* dir) {
+  ChannelManager& mgr = channel_manager();
+  std::lock_guard<std::mutex> lk(mgr.mu);
+  mgr.dir = dir ? dir : "";
+  if (!mgr.dir.empty() && mgr.dir.back() != '/') mgr.dir += '/';
+}
+
+SG_EXPORT void* sg_channel_get(const char* name) {
+  ChannelManager& mgr = channel_manager();
+  std::lock_guard<std::mutex> lk(mgr.mu);
+  std::string nm = name ? name : "";
+  auto it = mgr.chans.find(nm);
+  if (it != mgr.chans.end()) return it->second;
+  auto* ch = new LogChannel();
+  ch->name = nm;
+  channel_open_file(ch, mgr.dir + nm);
+  mgr.chans[nm] = ch;
+  return ch;
+}
+
+SG_EXPORT void sg_channel_enable_stderr(void* handle, int enable) {
+  static_cast<LogChannel*>(handle)->to_stderr = enable != 0;
+}
+
+SG_EXPORT void sg_channel_enable_file(void* handle, int enable) {
+  static_cast<LogChannel*>(handle)->to_file = enable != 0;
+}
+
+SG_EXPORT void sg_channel_set_dest_file(void* handle, const char* path) {
+  auto* ch = static_cast<LogChannel*>(handle);
+  std::lock_guard<std::mutex> lk(ch->mu);
+  channel_open_file(ch, path ? path : "");
+}
+
+SG_EXPORT void sg_channel_send(void* handle, const char* msg) {
+  auto* ch = static_cast<LogChannel*>(handle);
+  std::lock_guard<std::mutex> lk(ch->mu);
+  std::string m = msg ? msg : "";
+  if (ch->to_stderr) std::fprintf(stderr, "%s\n", m.c_str());
+  if (ch->to_file && ch->os.is_open()) {
+    ch->os << m << "\n";
+    ch->os.flush();
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -230,17 +335,8 @@ SG_EXPORT void* sg_recreader_open(const char* path, int prefetch_depth) {
     delete r;
     return nullptr;
   }
-  if (prefetch_depth > 0) {
-    r->queue.reset(new BoundedQueue(static_cast<size_t>(prefetch_depth)));
-    r->prefetching = true;
-    r->worker = std::thread([r] {
-      Record rec;
-      while (read_record(r->in, &rec)) {
-        if (!r->queue->push(std::move(rec))) break;
-      }
-      r->queue->set_done();
-    });
-  }
+  r->prefetch_depth = prefetch_depth;
+  if (prefetch_depth > 0) r->start_prefetch();
   return r;
 }
 
@@ -278,6 +374,9 @@ SG_EXPORT void sg_recreader_seek_to_first(void* handle) {
   r->stop();
   r->in.clear();
   r->in.seekg(sizeof(kMagic), std::ios::beg);
+  // A reader opened with prefetching must keep prefetching across rewinds
+  // (multi-epoch iteration), not silently degrade to synchronous reads.
+  if (r->prefetch_depth > 0) r->start_prefetch();
 }
 
 SG_EXPORT void sg_recreader_close(void* handle) {

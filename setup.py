@@ -1,0 +1,40 @@
+"""pip packaging for singa-tpu (capability parity with the reference's
+setup.py wheel build, reference setup.py:140-222 — but with no SWIG/nvcc
+machinery: the only native artifact is the C-ABI IO runtime, compiled with
+the in-tree Makefile and shipped inside ``singa_tpu/native``).
+
+The native build is best-effort: when no C++ toolchain is available the
+wheel still works — every native entry point has a pure-python fallback
+(see singa_tpu/native/__init__.py AVAILABLE).
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+class build_py_with_native(build_py):
+    """Build libsinga_native.so via the Makefile and ship it in-package."""
+
+    def run(self):
+        super().run()
+        native_dir = os.path.join(ROOT, "native")
+        lib = os.path.join(native_dir, "libsinga_native.so")
+        try:
+            subprocess.run(["make", "-C", native_dir], check=True)
+        except (subprocess.SubprocessError, OSError) as e:
+            self.warn(f"native build skipped ({e}); the package will use "
+                      "pure-python fallbacks")
+            return
+        dest_dir = os.path.join(self.build_lib, "singa_tpu", "native")
+        os.makedirs(dest_dir, exist_ok=True)
+        shutil.copy2(lib, dest_dir)
+
+
+setup(cmdclass={"build_py": build_py_with_native})

@@ -30,7 +30,8 @@ from . import utils         # noqa: F401
 from .tensor import Tensor  # noqa: F401
 from .model import Model    # noqa: F401
 
-_LAZY = ("sonnx", "io", "data", "image_tool", "net", "snapshot", "native")
+_LAZY = ("sonnx", "io", "data", "image_tool", "net", "snapshot", "native",
+         "channel")
 
 
 def __getattr__(name):

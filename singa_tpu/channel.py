@@ -1,0 +1,114 @@
+"""Named log channels (reference include/singa/utils/channel.h:35-77,
+src/utils/channel.cc).
+
+A :class:`Channel` appends metric/progress lines to a per-channel file
+(named ``<directory>/<name>`` by default) and/or stderr. Channels are
+process-wide singletons obtained via :func:`get_channel`; the sink lives in
+the native runtime (native/singa_native.cc) so C++ and Python writers share
+one file handle, with a pure-python fallback when the native library is
+unavailable.
+
+API parity: ``init_channel``/``InitChannel``, ``set_channel_directory``/
+``SetChannelDirectory``, ``get_channel``/``GetChannel``; per-channel
+``enable_dest_stderr``/``enable_dest_file``/``set_dest_file_path``/``send``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+from . import native
+
+_lock = threading.Lock()
+_channels = {}
+_directory = ""
+
+
+class Channel:
+    """One named output channel. File dest enabled by default, stderr
+    disabled by default (reference channel.h:40-46, channel.cc:46-56)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._handle = None
+        self._file = None
+        self._to_stderr = False
+        self._to_file = True
+        if native.AVAILABLE:
+            self._handle = native._lib.sg_channel_get(name.encode())
+        else:
+            self._open(os.path.join(_directory, name) if _directory
+                       else name)
+
+    # -- destinations ----------------------------------------------------
+    def enable_dest_stderr(self, enable=True):
+        self._to_stderr = bool(enable)
+        if self._handle is not None:
+            native._lib.sg_channel_enable_stderr(self._handle, int(enable))
+
+    def enable_dest_file(self, enable=True):
+        self._to_file = bool(enable)
+        if self._handle is not None:
+            native._lib.sg_channel_enable_file(self._handle, int(enable))
+
+    def set_dest_file_path(self, path):
+        if self._handle is not None:
+            native._lib.sg_channel_set_dest_file(self._handle,
+                                                 str(path).encode())
+        else:
+            self._open(path)
+
+    def _open(self, path):
+        if self._file is not None:
+            self._file.close()
+        try:
+            self._file = open(path, "a")
+        except OSError:
+            self._file = None
+
+    # -- output ----------------------------------------------------------
+    def send(self, message):
+        msg = str(message)
+        if self._handle is not None:
+            native._lib.sg_channel_send(self._handle, msg.encode())
+            return
+        if self._to_stderr:
+            print(msg, file=sys.stderr)
+        if self._to_file and self._file is not None:
+            self._file.write(msg + "\n")
+            self._file.flush()
+
+
+def init_channel(argv=None):
+    """Global channel-system init (reference InitChannel, channel.cc:95)."""
+    return None
+
+
+def set_channel_directory(path):
+    """Directory for default per-channel files (reference
+    SetChannelDirectory, channel.cc:100). Affects channels created after
+    the call."""
+    global _directory
+    with _lock:
+        _directory = str(path)
+        if native.AVAILABLE:
+            native._lib.sg_set_channel_directory(_directory.encode())
+
+
+def get_channel(name):
+    """Get-or-create the channel singleton (reference GetChannel,
+    channel.cc:105)."""
+    with _lock:
+        ch = _channels.get(name)
+        if ch is None:
+            ch = Channel(name)
+            _channels[name] = ch
+        return ch
+
+
+# reference-style aliases
+InitChannel = init_channel
+SetChannelDirectory = set_channel_directory
+GetChannel = get_channel

@@ -19,6 +19,9 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
                            "native")
+# a wheel build (setup.py) ships the .so inside the package; a source
+# checkout builds it in-tree via the Makefile
+_PACKAGED_LIB = os.path.join(_HERE, "libsinga_native.so")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libsinga_native.so")
 
 _lib = None
@@ -40,10 +43,18 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build():
+    if os.path.exists(_PACKAGED_LIB):
+        path = _PACKAGED_LIB
+    else:
+        # source checkout: always invoke make — a no-op when the .so is
+        # fresh, and it rebuilds a stale one (the target depends on the
+        # source), so a new ABI symbol is never missing from an old build
+        _build()
+        path = _LIB_PATH
+    if not os.path.exists(path):
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(path)
     except OSError:
         return None
 
@@ -85,6 +96,15 @@ def _load():
     lib.sg_set_log_level.argtypes = [ctypes.c_int]
     lib.sg_monotonic_seconds.restype = ctypes.c_double
     lib.sg_version.restype = ctypes.c_char_p
+
+    lib.sg_set_channel_directory.argtypes = [ctypes.c_char_p]
+    lib.sg_channel_get.restype = ctypes.c_void_p
+    lib.sg_channel_get.argtypes = [ctypes.c_char_p]
+    lib.sg_channel_enable_stderr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sg_channel_enable_file.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sg_channel_set_dest_file.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p]
+    lib.sg_channel_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
 
     _lib = lib
     return lib

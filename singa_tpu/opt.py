@@ -65,9 +65,57 @@ class ExponentialDecay(DecayScheduler):
         return self.init_value * jnp.power(self.decay_rate, e)
 
 
+class Regularizer:
+    """Parameter-gradient regularizer (reference
+    include/singa/model/optimizer.h:151-244, src/model/optimizer/
+    optimizer.cc:92-99: L2 is ``grad += coefficient * value``).
+
+    Functional: ``apply`` returns the new gradient array so it composes
+    inside a jit-traced update."""
+
+    def __init__(self, type="l2", coefficient=0.0):
+        self.type = type.lower()
+        if self.type not in ("l1", "l2", "notset"):
+            raise ValueError(f"unknown regularizer type {type!r}")
+        self.coefficient = coefficient
+
+    def apply(self, value, grad):
+        if self.type == "l2":
+            return grad + self.coefficient * value
+        if self.type == "l1":
+            return grad + self.coefficient * jnp.sign(value)
+        return grad
+
+
+class Constraint:
+    """Parameter-gradient constraint (reference optimizer.h:101-144: clip
+    the gradient's L2 norm to a threshold; the reference declares the API
+    and documents the semantics but stubs the math — here it is real)."""
+
+    def __init__(self, type="l2", threshold=1.0):
+        self.type = type.lower()
+        if self.type not in ("l2", "value", "notset"):
+            raise ValueError(f"unknown constraint type {type!r}")
+        self.threshold = threshold
+
+    def apply(self, value, grad):
+        if self.type == "l2":
+            norm = jnp.sqrt(jnp.sum(grad.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(1.0, self.threshold / (norm + 1e-12))
+            return grad * scale.astype(grad.dtype)
+        if self.type == "value":
+            return jnp.clip(grad, -self.threshold, self.threshold)
+        return grad
+
+
 class Optimizer:
     """Base optimizer (reference opt.py:71-173). Aux states are Tensors so
-    the whole update is jit-traceable and thread-able as donated state."""
+    the whole update is jit-traceable and thread-able as donated state.
+
+    Regularizer/Constraint/lr-multiplier registration mirrors reference
+    Optimizer::Register + ApplyRegularizerConstraint (include/singa/model/
+    optimizer.h:44-100, src/model/optimizer/optimizer.cc:36-77): per-param
+    entries win over the global default."""
 
     def __init__(self, lr):
         self.lr = lr if isinstance(lr, DecayScheduler) else Constant(lr)
@@ -75,6 +123,37 @@ class Optimizer:
                                    requires_grad=False)
         self.step_counter.name = "step_counter"
         self._aux = {}  # name -> Tensor, created lazily per param
+        self.regularizer = None       # global default
+        self.constraint = None        # global default
+        self._regularizers = {}       # per-param overrides
+        self._constraints = {}
+        self._lr_multipliers = {}
+
+    def register(self, name, regularizer=None, constraint=None,
+                 lr_multiplier=None):
+        """Attach a per-param regularizer/constraint/lr multiplier
+        (reference Optimizer::Register, optimizer.cc:36-56)."""
+        if regularizer is not None:
+            self._regularizers[name] = regularizer
+        if constraint is not None:
+            self._constraints[name] = constraint
+        if lr_multiplier is not None:
+            self._lr_multipliers[name] = float(lr_multiplier)
+
+    def apply_regularizer_constraint(self, name, value, grad):
+        """Regularizer first, then constraint (reference
+        Optimizer::ApplyRegularizerConstraint, optimizer.cc:63-77)."""
+        reg = self._regularizers.get(name, self.regularizer)
+        if reg is not None:
+            grad = reg.apply(value, grad)
+        con = self._constraints.get(name, self.constraint)
+        if con is not None:
+            grad = con.apply(value, grad)
+        return grad
+
+    def _scaled_lr(self, name):
+        mult = self._lr_multipliers.get(name)
+        return self.lr_value * mult if mult is not None else self.lr_value
 
     # -- lr as a traced value --------------------------------------------
     @property
@@ -157,12 +236,13 @@ class SGD(Optimizer):
         grad = grad.astype(p.dtype)
         if self.weight_decay != 0 and self.should_apply_weight_decay(name):
             grad = grad + self.weight_decay * p.data
+        grad = self.apply_regularizer_constraint(name, p.data, grad)
         if self.momentum != 0:
             buf = self._get_aux(f"{name}:momentum", p)
             buf.data = self.momentum * buf.data + (1 - self.dampening) * grad
             grad = grad + self.momentum * buf.data if self.nesterov \
                 else buf.data
-        p.data = p.data - self.lr_value * grad
+        p.data = p.data - self._scaled_lr(name) * grad
 
 
 class RMSProp(Optimizer):
@@ -178,9 +258,10 @@ class RMSProp(Optimizer):
         grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
         if self.weight_decay != 0:
             grad = grad + self.weight_decay * p.data
+        grad = self.apply_regularizer_constraint(name, p.data, grad)
         rms = self._get_aux(f"{name}:rms", p)
         rms.data = self.rho * rms.data + (1 - self.rho) * grad * grad
-        p.data = p.data - self.lr_value * grad / jnp.sqrt(rms.data +
+        p.data = p.data - self._scaled_lr(name) * grad / jnp.sqrt(rms.data +
                                                           self.epsilon)
 
 
@@ -196,9 +277,10 @@ class AdaGrad(Optimizer):
         grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
         if self.weight_decay != 0:
             grad = grad + self.weight_decay * p.data
+        grad = self.apply_regularizer_constraint(name, p.data, grad)
         hist = self._get_aux(f"{name}:history", p)
         hist.data = hist.data + grad * grad
-        p.data = p.data - self.lr_value * grad / jnp.sqrt(hist.data +
+        p.data = p.data - self._scaled_lr(name) * grad / jnp.sqrt(hist.data +
                                                           self.epsilon)
 
 
@@ -218,6 +300,7 @@ class Adam(Optimizer):
         grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
         if self.weight_decay != 0:
             grad = grad + self.weight_decay * p.data
+        grad = self.apply_regularizer_constraint(name, p.data, grad)
         m = self._get_aux(f"{name}:m", p)
         v = self._get_aux(f"{name}:v", p)
         m.data = self.beta_1 * m.data + (1 - self.beta_1) * grad
@@ -230,7 +313,7 @@ class Adam(Optimizer):
             vhat = vmax.data / (1 - jnp.power(self.beta_2, t))
         else:
             vhat = v.data / (1 - jnp.power(self.beta_2, t))
-        p.data = p.data - self.lr_value * mhat / (jnp.sqrt(vhat) +
+        p.data = p.data - self._scaled_lr(name) * mhat / (jnp.sqrt(vhat) +
                                                   self.epsilon)
 
 

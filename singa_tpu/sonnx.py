@@ -362,6 +362,14 @@ class SingaFrontend:
                 # onnx BatchNormalization: X, scale, B, mean, var
                 in_names = in_names[:3] + [bn_state_name(op, "running_mean"),
                                            bn_state_name(op, "running_var")]
+            if ty == "Embedding":
+                # ONNX Gather requires int32/int64 indices; our ids tensor
+                # is float-typed on the tape, so cast it in-graph
+                cast_nm = f"{op_name}_ids_i64"
+                nodes.append(helper.make_node(
+                    "Cast", [in_names[0]], [cast_nm],
+                    name=f"{op_name}_cast", to=int(TensorProto.INT64)))
+                in_names[0] = cast_nm
             onnx_ty, attrs = cls._node_attrs_and_extra(
                 op, op_name, in_names, initializers)
             nodes.append(helper.make_node(onnx_ty, in_names, out_names,

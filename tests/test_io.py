@@ -49,6 +49,20 @@ class TestNative:
         assert r.read()[0] == b"a"
         r.close()
 
+    def test_seek_to_first_restarts_prefetch(self, tmp_path):
+        # A prefetching reader must keep working across rewinds (multi-epoch
+        # iteration), yielding the full record stream each epoch.
+        path = str(tmp_path / "rec.bin")
+        with native.RecordWriter(path) as w:
+            for i in range(200):
+                w.write(f"k{i}", os.urandom(64))
+        with native.RecordReader(path, prefetch=8) as r:
+            for epoch in range(3):
+                keys = [k for k, _ in r]
+                assert len(keys) == 200, f"epoch {epoch}: {len(keys)}"
+                assert keys[0] == b"k0" and keys[-1] == b"k199"
+                r.seek_to_first()
+
     def test_append_mode(self, tmp_path):
         path = str(tmp_path / "rec.bin")
         with native.RecordWriter(path) as w:

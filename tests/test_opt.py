@@ -91,6 +91,102 @@ class TestAdam:
         assert vmax2 >= vmax1
 
 
+class TestRegularizerConstraint:
+    """Reference include/singa/model/optimizer.h:151-244 +
+    src/model/optimizer/optimizer.cc:63-99."""
+
+    def test_l2_regularizer(self):
+        p = mkparam([2.0])
+        sgd = opt.SGD(lr=0.1)
+        sgd.regularizer = opt.Regularizer("l2", coefficient=0.5)
+        sgd.apply("w", p, mkgrad([1.0]))
+        # grad = 1 + 0.5*2 = 2 ; p = 2 - 0.1*2
+        np.testing.assert_allclose(np.asarray(p.data), [1.8], rtol=1e-6)
+
+    def test_l1_regularizer(self):
+        p = mkparam([-3.0])
+        sgd = opt.SGD(lr=0.1)
+        sgd.regularizer = opt.Regularizer("l1", coefficient=0.5)
+        sgd.apply("w", p, mkgrad([1.0]))
+        # grad = 1 + 0.5*sign(-3) = 0.5 ; p = -3 - 0.05
+        np.testing.assert_allclose(np.asarray(p.data), [-3.05], rtol=1e-6)
+
+    def test_l2_norm_constraint_clips(self):
+        p = mkparam([0.0, 0.0])
+        sgd = opt.SGD(lr=1.0)
+        sgd.constraint = opt.Constraint("l2", threshold=1.0)
+        sgd.apply("w", p, mkgrad([3.0, 4.0]))   # norm 5 -> scaled to 1
+        np.testing.assert_allclose(np.asarray(p.data), [-0.6, -0.8],
+                                   rtol=1e-6)
+
+    def test_l2_norm_constraint_noop_below_threshold(self):
+        p = mkparam([0.0])
+        sgd = opt.SGD(lr=1.0)
+        sgd.constraint = opt.Constraint("l2", threshold=10.0)
+        sgd.apply("w", p, mkgrad([0.5]))
+        np.testing.assert_allclose(np.asarray(p.data), [-0.5], rtol=1e-6)
+
+    def test_value_constraint(self):
+        p = mkparam([0.0, 0.0])
+        sgd = opt.SGD(lr=1.0)
+        sgd.constraint = opt.Constraint("value", threshold=0.25)
+        sgd.apply("w", p, mkgrad([3.0, -4.0]))
+        np.testing.assert_allclose(np.asarray(p.data), [-0.25, 0.25])
+
+    def test_per_param_registration_wins(self):
+        sgd = opt.SGD(lr=1.0)
+        sgd.regularizer = opt.Regularizer("l2", coefficient=100.0)
+        sgd.register("w", regularizer=opt.Regularizer("notset"),
+                     lr_multiplier=0.5)
+        p = mkparam([1.0])
+        sgd.apply("w", p, mkgrad([1.0]))
+        # per-param notset regularizer overrides global; lr scaled by 0.5
+        np.testing.assert_allclose(np.asarray(p.data), [0.5], rtol=1e-6)
+        # unregistered param takes the global regularizer
+        q = mkparam([1.0])
+        q.name = "v"
+        sgd.apply("v", q, mkgrad([0.0]))
+        np.testing.assert_allclose(np.asarray(q.data), [-99.0], rtol=1e-5)
+
+    def test_constraint_in_compiled_step(self):
+        """Clipping must survive jit (traced, no python branching)."""
+        from singa_tpu import device, layer, model
+
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(2)
+                self.loss_fn = layer.MeanSquareError()
+
+            def forward(self, x):
+                return self.fc(x)
+
+            def train_one_batch(self, x, y):
+                o = self.forward(x)
+                ls = self.loss_fn(o, y)
+                self.optimizer(ls)
+                return o, ls
+
+        dev = device.create_cpu_device()
+        m = Net()
+        sgd = opt.SGD(lr=0.1)
+        sgd.constraint = opt.Constraint("l2", threshold=1e-3)
+        m.set_optimizer(sgd)
+        x = Tensor(data=np.random.randn(4, 3).astype(np.float32),
+                   device=dev, requires_grad=False)
+        y = Tensor(data=np.random.randn(4, 2).astype(np.float32) * 100,
+                   device=dev, requires_grad=False)
+        m.compile([x], is_train=True, use_graph=True)
+        w0 = {k: np.asarray(v.data).copy()
+              for k, v in m.get_states().items()}
+        m(x, y)
+        m(x, y)  # compiled step
+        for k, v in m.get_states().items():
+            delta = np.linalg.norm(np.asarray(v.data) - w0[k])
+            # 2 steps, each grad clipped to 1e-3, lr 0.1
+            assert delta <= 2 * 0.1 * 1e-3 * 1.01, (k, delta)
+
+
 class TestSchedulers:
     def test_constant(self):
         s = opt.Constant(0.25)

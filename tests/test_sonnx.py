@@ -198,6 +198,35 @@ class TestSONNXModel:
         assert losses[-1] < losses[0], losses
 
 
+class TestEmbedding:
+    def test_embedding_exports_gather_with_int64_cast(self):
+        """Embedding exports as Cast(INT64) -> Gather so stock ONNX
+        tooling (which rejects float indices) accepts the graph."""
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.emb = layer.Embedding(11, 6)
+                self.fc = layer.Linear(3)
+
+            def forward(self, x):
+                return self.fc(self.emb(x))
+
+        m = Net()
+        ids = t(np.random.randint(0, 11, (4, 5)).astype(np.float32))
+        m.forward(ids)
+        mp = roundtrip(m, [ids])
+        by_out = {n.output[0]: n for n in mp.graph.node}
+        gathers = [n for n in mp.graph.node if n.op_type == "Gather"]
+        assert gathers, [n.op_type for n in mp.graph.node]
+        g = gathers[0]
+        # Gather(W, indices): the indices input must come from an
+        # int64 Cast, not the raw float graph input
+        cast = by_out.get(g.input[1])
+        assert cast is not None and cast.op_type == "Cast"
+        to = dict((a.name, a.i) for a in cast.attribute)["to"]
+        assert to == sonnx.TensorProto.INT64
+
+
 class TestPersistence:
     def test_save_load_file(self, tmp_path):
         m = MLPNet()
