@@ -124,6 +124,12 @@ class Operator:
             outs.append(t)
 
         if tape:
+            if CTX.recording:
+                # Export traces address tensors by id(); hold a strong ref
+                # to every input so no intermediate is garbage-collected
+                # mid-trace and its id reused by a later tensor (which
+                # would silently mis-wire the exported graph).
+                self._export_refs = xs
             self.src = []
             for x in xs:
                 if isinstance(x, Tensor) and x.requires_grad:

@@ -170,11 +170,12 @@ class Linear(Layer):
     def initialize(self, x):
         self.in_features = x.shape[-1]
         dev = x.device
-        self.W = _param((self.in_features, self.out_features), dev)
+        self.W = _param((self.in_features, self.out_features), dev,
+                        dtype=x.dtype)
         std = math.sqrt(2.0 / (self.in_features + self.out_features))
         self.W.gaussian(0.0, std)
         if self.bias:
-            self.b = _param((self.out_features,), dev)
+            self.b = _param((self.out_features,), dev, dtype=x.dtype)
 
     def forward(self, x):
         y = autograd.matmul(x, self.W)
@@ -281,11 +282,11 @@ class Conv2d(Layer):
         ks = self.kernel_size if isinstance(self.kernel_size, (tuple, list)) \
             else (self.kernel_size, self.kernel_size)
         w_shape = (self.nb_kernels, self.in_channels // self.group, *ks)
-        self.W = _param(w_shape, dev)
+        self.W = _param(w_shape, dev, dtype=x.dtype)
         std = math.sqrt(2.0 / (ks[0] * ks[1] * self.nb_kernels))
         self.W.gaussian(0.0, std)
         if self.bias:
-            self.b = _param((self.nb_kernels,), dev)
+            self.b = _param((self.nb_kernels,), dev, dtype=x.dtype)
         pad = self.padding
         pad_mode = None
         if self.pad_mode == "SAME_UPPER":
@@ -305,6 +306,52 @@ class Conv2d(Layer):
         if self.activation == "RELU":
             y = autograd.relu(y)
         return y
+
+    def _own_params(self):
+        p = {"W": self.W}
+        if self.bias:
+            p["b"] = self.b
+        return p
+
+
+class ConvTranspose2d(Layer):
+    """2-D transposed convolution (the ConvTranspose capability the
+    reference exposes through its ONNX backend, python/singa/sonnx.py).
+    Weight layout (C_in, C_out/group, kH, kW), ONNX/torch convention."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, group=1, bias=True):
+        super().__init__()
+        self.nb_kernels = nb_kernels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.group = group
+        self.bias = bias
+
+    def initialize(self, x):
+        from .ops.conv import ConvTransposeHandle
+        self.in_channels = x.shape[1]
+        dev = x.device
+        ks = self.kernel_size if isinstance(self.kernel_size, (tuple, list)) \
+            else (self.kernel_size, self.kernel_size)
+        w_shape = (self.in_channels, self.nb_kernels // self.group, *ks)
+        self.W = _param(w_shape, dev, dtype=x.dtype)
+        std = math.sqrt(2.0 / (ks[0] * ks[1] * self.nb_kernels))
+        self.W.gaussian(0.0, std)
+        if self.bias:
+            self.b = _param((self.nb_kernels,), dev, dtype=x.dtype)
+        self.handle = ConvTransposeHandle(
+            x, ks, self.stride, self.padding, self.in_channels,
+            self.nb_kernels, self.bias, self.group,
+            dilation=self.dilation, output_padding=self.output_padding)
+
+    def forward(self, x):
+        from .ops.conv import conv_transpose2d
+        return conv_transpose2d(self.handle, x, self.W,
+                                self.b if self.bias else None)
 
     def _own_params(self):
         p = {"W": self.W}

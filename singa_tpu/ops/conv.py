@@ -106,3 +106,92 @@ def conv2d(handle: ConvHandle, x, W, b=None, odd_padding=None):
     if b is None:
         return _Conv2d(handle, odd_padding)(x, W)
     return _Conv2d(handle, odd_padding)(x, W, b)
+
+
+class ConvTransposeHandle:
+    """Static transposed-conv config (ONNX ConvTranspose semantics — the
+    capability the reference exposes through its ONNX backend,
+    python/singa/sonnx.py ConvTranspose handling).
+
+    Weight layout is (C_in, C_out/group, kH, kW) (ONNX/torch convention).
+    """
+
+    def __init__(self, x, kernel_size, stride, padding, in_channels,
+                 out_channels, bias=True, group=1, dilation=1,
+                 output_padding=0):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.dilation = _pair(dilation)
+        self.output_padding = _pair(output_padding)
+        if (isinstance(padding, (tuple, list)) and len(padding) == 2
+                and isinstance(padding[0], (tuple, list))):
+            self.padding = tuple(tuple(int(v) for v in p) for p in padding)
+        else:
+            ph, pw = _pair(padding)
+            self.padding = ((ph, ph), (pw, pw))
+        self.in_channels = int(in_channels)
+        self.out_channels = int(out_channels)
+        self.bias = bool(bias)
+        self.group = int(group)
+        self.dimension_numbers = ("NCHW", "OIHW", "NCHW")
+
+    def output_shape(self, x_shape):
+        n, _, h, w = x_shape
+        (p0, p1), (q0, q1) = self.padding
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        dh, dw = self.dilation
+        oph, opw = self.output_padding
+        oh = (h - 1) * sh - p0 - p1 + dh * (kh - 1) + 1 + oph
+        ow = (w - 1) * sw - q0 - q1 + dw * (kw - 1) + 1 + opw
+        return (n, self.out_channels, oh, ow)
+
+
+class _ConvTranspose2d(Operator):
+    """Transposed conv = input-dilated conv with a spatially-flipped,
+    IO-swapped kernel: one `conv_general_dilated` with ``lhs_dilation`` —
+    the gradient-of-conv primitive XLA already maps onto the MXU, so
+    forward and (vjp) backward are both single fused convs."""
+
+    def __init__(self, handle: ConvTransposeHandle):
+        super().__init__()
+        self.handle = handle
+
+    def forward(self, x, W, b=None):
+        h = self.handle
+        kh, kw = h.kernel_size
+        dh, dw = h.dilation
+        keh, kew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        (p0, p1), (q0, q1) = h.padding
+        oph, opw = h.output_padding
+        Wf = jnp.flip(W, axis=(-2, -1))
+        if h.group > 1:
+            cg = h.in_channels // h.group
+            og = h.out_channels // h.group
+            Wf = Wf.reshape(h.group, cg, og, kh, kw)
+            Wf = Wf.transpose(0, 2, 1, 3, 4).reshape(
+                h.out_channels, cg, kh, kw)
+        else:
+            Wf = Wf.transpose(1, 0, 2, 3)
+        y = lax.conv_general_dilated(
+            x, Wf,
+            window_strides=(1, 1),
+            padding=((keh - 1 - p0, keh - 1 - p1 + oph),
+                     (kew - 1 - q0, kew - 1 - q1 + opw)),
+            lhs_dilation=h.stride,
+            rhs_dilation=h.dilation,
+            dimension_numbers=h.dimension_numbers,
+            feature_group_count=h.group,
+            preferred_element_type=jnp.float32
+            if x.dtype == jnp.bfloat16 else None,
+        )
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        return y.astype(x.dtype)
+
+
+def conv_transpose2d(handle: ConvTransposeHandle, x, W, b=None):
+    """Functional wrapper for transposed convolution."""
+    if b is None:
+        return _ConvTranspose2d(handle)(x, W)
+    return _ConvTranspose2d(handle)(x, W, b)

@@ -34,7 +34,8 @@ class CudnnRNNHandle:
     """
 
     def __init__(self, x, hidden_size, mode="lstm", num_layers=1,
-                 bias=True, dropout=0.0, bidirectional=False):
+                 bias=True, dropout=0.0, bidirectional=False,
+                 gru_linear_before_reset=True):
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.feature_size = int(xs[-1])
         self.hidden_size = int(hidden_size)
@@ -44,6 +45,9 @@ class CudnnRNNHandle:
         self.bias = bool(bias)
         self.dropout = float(dropout)
         self.bidirectional = bool(bidirectional)
+        # True = torch/cuDNN convention (n-gate bias inside the reset
+        # product); False = ONNX GRU default linear_before_reset=0
+        self.gru_linear_before_reset = bool(gru_linear_before_reset)
         self.num_directions = 2 if self.bidirectional else 1
         self.gates = _GATES[self.mode]
         self.batch_first = False
@@ -78,16 +82,26 @@ class CudnnRNNHandle:
         return out
 
 
-def _step(mode, params, carry, x_t):
+def _step(mode, params, carry, x_t, gru_lbr=True):
     Wih, Whh, bih, bhh = params
     h, c = carry
     if mode == "gru":
         gi = x_t @ Wih.T + bih
-        gh = h @ Whh.T + bhh
         H = h.shape[-1]
-        r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
-        z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
-        n = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+        if gru_lbr:
+            gh = h @ Whh.T + bhh
+            r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
+            z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+            n = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+        else:
+            # ONNX linear_before_reset=0: reset gates the hidden STATE
+            # before the recurrent matmul (bias outside the product); only
+            # the r/z gate columns go through the plain recurrent matmul
+            gh = h @ Whh[:2 * H].T + bhh[:2 * H]
+            r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
+            z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:])
+            n = jnp.tanh(gi[:, 2 * H:] + (r * h) @ Whh[2 * H:, :].T
+                         + bhh[2 * H:])
         h_new = (1 - z) * n + z * h
         return (h_new, c), h_new
     g = x_t @ Wih.T + h @ Whh.T + bih + bhh
@@ -105,7 +119,8 @@ def _step(mode, params, carry, x_t):
     return (h_new, c), h_new
 
 
-def _run_direction(mode, params, x, h0, c0, lengths, reverse):
+def _run_direction(mode, params, x, h0, c0, lengths, reverse,
+                   gru_lbr=True):
     """Scan one direction over (T, B, F) -> (T, B, H), h_T, c_T."""
     T = x.shape[0]
     ts = jnp.arange(T)
@@ -115,7 +130,8 @@ def _run_direction(mode, params, x, h0, c0, lengths, reverse):
 
     def body(carry, inp):
         x_t, t = inp
-        (h_new, c_new), out = _step(mode, params, carry, x_t)
+        (h_new, c_new), out = _step(mode, params, carry, x_t,
+                                    gru_lbr=gru_lbr)
         if lengths is not None:
             valid = (t < lengths)[:, None]
             h_new = jnp.where(valid, h_new, carry[0])
@@ -151,7 +167,8 @@ class _RNN(Operator):
                 idx = layer * D + d
                 y, hT, cT = _run_direction(
                     h.mode, params[layer][d], inp,
-                    hx[idx], cx[idx], lengths, reverse=(d == 1))
+                    hx[idx], cx[idx], lengths, reverse=(d == 1),
+                    gru_lbr=h.gru_linear_before_reset)
                 ys.append(y)
                 h_out.append(hT)
                 c_out.append(cT)

@@ -239,10 +239,14 @@ class SGD(Optimizer):
         grad = self.apply_regularizer_constraint(name, p.data, grad)
         if self.momentum != 0:
             buf = self._get_aux(f"{name}:momentum", p)
-            buf.data = self.momentum * buf.data + (1 - self.dampening) * grad
+            buf.data = (self.momentum * buf.data
+                        + (1 - self.dampening) * grad).astype(buf.dtype)
             grad = grad + self.momentum * buf.data if self.nesterov \
                 else buf.data
-        p.data = p.data - self._scaled_lr(name) * grad
+        # update math promotes to f32 for low-precision params (the traced
+        # lr is f32); store back in the param's dtype so bf16/fp16 training
+        # keeps its precision class instead of silently upcasting
+        p.data = (p.data - self._scaled_lr(name) * grad).astype(p.dtype)
 
 
 class RMSProp(Optimizer):
@@ -260,9 +264,10 @@ class RMSProp(Optimizer):
             grad = grad + self.weight_decay * p.data
         grad = self.apply_regularizer_constraint(name, p.data, grad)
         rms = self._get_aux(f"{name}:rms", p)
-        rms.data = self.rho * rms.data + (1 - self.rho) * grad * grad
-        p.data = p.data - self._scaled_lr(name) * grad / jnp.sqrt(rms.data +
-                                                          self.epsilon)
+        rms.data = (self.rho * rms.data
+                    + (1 - self.rho) * grad * grad).astype(rms.dtype)
+        p.data = (p.data - self._scaled_lr(name) * grad
+                  / jnp.sqrt(rms.data + self.epsilon)).astype(p.dtype)
 
 
 class AdaGrad(Optimizer):
@@ -279,9 +284,9 @@ class AdaGrad(Optimizer):
             grad = grad + self.weight_decay * p.data
         grad = self.apply_regularizer_constraint(name, p.data, grad)
         hist = self._get_aux(f"{name}:history", p)
-        hist.data = hist.data + grad * grad
-        p.data = p.data - self._scaled_lr(name) * grad / jnp.sqrt(hist.data +
-                                                          self.epsilon)
+        hist.data = (hist.data + grad * grad).astype(hist.dtype)
+        p.data = (p.data - self._scaled_lr(name) * grad
+                  / jnp.sqrt(hist.data + self.epsilon)).astype(p.dtype)
 
 
 class Adam(Optimizer):
@@ -303,8 +308,10 @@ class Adam(Optimizer):
         grad = self.apply_regularizer_constraint(name, p.data, grad)
         m = self._get_aux(f"{name}:m", p)
         v = self._get_aux(f"{name}:v", p)
-        m.data = self.beta_1 * m.data + (1 - self.beta_1) * grad
-        v.data = self.beta_2 * v.data + (1 - self.beta_2) * grad * grad
+        m.data = (self.beta_1 * m.data
+                  + (1 - self.beta_1) * grad).astype(m.dtype)
+        v.data = (self.beta_2 * v.data
+                  + (1 - self.beta_2) * grad * grad).astype(v.dtype)
         t = self.step_counter.data + 1.0
         mhat = m.data / (1 - jnp.power(self.beta_1, t))
         if self.amsgrad:
@@ -313,8 +320,8 @@ class Adam(Optimizer):
             vhat = vmax.data / (1 - jnp.power(self.beta_2, t))
         else:
             vhat = v.data / (1 - jnp.power(self.beta_2, t))
-        p.data = p.data - self._scaled_lr(name) * mhat / (jnp.sqrt(vhat) +
-                                                  self.epsilon)
+        p.data = (p.data - self._scaled_lr(name) * mhat
+                  / (jnp.sqrt(vhat) + self.epsilon)).astype(p.dtype)
 
 
 class DistOpt:

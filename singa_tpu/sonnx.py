@@ -30,7 +30,8 @@ from .tensor import Tensor
 from . import device as device_mod
 from .onnx_compat import (TensorProto, helper, numpy_helper, load, save,
                           attribute_dict)
-from .ops.conv import ConvHandle, conv2d
+from .ops.conv import (ConvHandle, conv2d, ConvTransposeHandle,
+                       conv_transpose2d)
 from .ops.pooling import PoolingHandle, pooling_2d, globalaveragepool
 from .ops.batchnorm import BatchNormHandle, batchnorm_2d
 
@@ -109,6 +110,10 @@ class SingaFrontend:
         "UpSample": "Upsample", "DepthToSpace": "DepthToSpace",
         "SpaceToDepth": "SpaceToDepth", "Embedding": "Gather",
         "ScatterElements": "ScatterElements",
+        # mesh-collective ops are identity in a single-program export
+        # (their collectives only act inside an active shard_map region)
+        "CopyToParallel": "Identity", "AllReduce": "Identity",
+        "PMean": "Identity",
     }
 
     @classmethod
@@ -156,6 +161,16 @@ class SingaFrontend:
                      "group": h.group,
                      "pads": [p0, q0, p1, q1]}
             return "Conv", attrs
+        if ty == "_ConvTranspose2d":
+            h = op.handle
+            (p0, p1), (q0, q1) = h.padding
+            attrs = {"kernel_shape": list(h.kernel_size),
+                     "strides": list(h.stride),
+                     "dilations": list(h.dilation),
+                     "group": h.group,
+                     "pads": [p0, q0, p1, q1],
+                     "output_padding": list(h.output_padding)}
+            return "ConvTranspose", attrs
         if ty == "_Pooling2d":
             h = op.handle
             (p0, p1), (q0, q1) = h.pad_pairs
@@ -288,6 +303,218 @@ class SingaFrontend:
                 f"cannot export op {ty} to ONNX")
         return onnx_ty, attrs
 
+    # our gate-block order -> onnx gate-block order
+    _rnn_perm_to_onnx = {"lstm": [0, 3, 1, 2],   # ifgo -> iofc
+                         "gru": [1, 0, 2]}        # rzn  -> zrh
+
+    @classmethod
+    def _export_rnn(cls, op, op_name, in_names, out_names, nodes,
+                    initializers):
+        """Emit one ONNX RNN/LSTM/GRU node per layer for a (possibly
+        multi-layer, bidirectional) `_RNN` op, slicing its flat packed
+        weight vector into the per-layer W/R/B initializers the ONNX spec
+        expects (reference frontend RNN export, python/singa/sonnx.py)."""
+        h = op.handle
+        Wt = op.src[3][2]
+        if Wt is None:
+            raise ValueError(
+                f"RNN {op_name}: flat weights must be a parameter or "
+                "constant to export")
+        flat = np.asarray(Wt.numpy()).ravel()
+        G, H, D, L = h.gates, h.hidden_size, h.num_directions, h.num_layers
+        perm = cls._rnn_perm_to_onnx.get(h.mode, [0])
+        node_ty = {"lstm": "LSTM", "gru": "GRU"}.get(h.mode, "RNN")
+
+        def reorder(mat):
+            return np.concatenate([mat[g * H:(g + 1) * H] for g in perm], 0)
+
+        seq_name = ""
+        if op.use_mask and len(in_names) > 4:
+            seq_name = f"{op_name}_seq_i32"
+            nodes.append(helper.make_node(
+                "Cast", [in_names[4]], [seq_name],
+                name=f"{op_name}_seqcast", to=int(TensorProto.INT32)))
+
+        def state_slice(src, l, which):
+            """initial_h/c rows for layer l: src[(l*D):(l+1)*D]."""
+            if L == 1:
+                return src
+            nm = f"{op_name}_l{l}_{which}"
+            for suffix, vals in (("starts", [l * D]), ("ends", [(l + 1) * D]),
+                                 ("axes", [0])):
+                initializers.append(numpy_helper.from_array(
+                    np.asarray(vals, np.int64), f"{nm}_{suffix}"))
+            nodes.append(helper.make_node(
+                "Slice", [src, f"{nm}_starts", f"{nm}_ends", f"{nm}_axes"],
+                [nm], name=nm))
+            return nm
+
+        x_name = in_names[0]
+        yh_names, yc_names = [], []
+        for l in range(L):
+            Ws, Rs, bihs, bhhs = [], [], [], []
+            for d in range(D):
+                sl = h.offsets[l][d]
+                parts = [flat[a:b].reshape(s) for a, b, s in sl]
+                Ws.append(reorder(parts[0]))
+                Rs.append(reorder(parts[1]))
+                bihs.append(reorder(parts[2][:, None])[:, 0])
+                bhhs.append(reorder(parts[3][:, None])[:, 0])
+            prefix = f"{op_name}_l{l}"
+            for nm, arr in ((f"{prefix}_W", np.stack(Ws)),
+                            (f"{prefix}_R", np.stack(Rs)),
+                            (f"{prefix}_B", np.stack(
+                                [np.concatenate([bi, bh]) for bi, bh
+                                 in zip(bihs, bhhs)]))):
+                initializers.append(
+                    numpy_helper.from_array(arr.astype(np.float32), nm))
+
+            attrs = {"hidden_size": H,
+                     "direction": "bidirectional" if D == 2 else "forward"}
+            if node_ty == "GRU":
+                attrs["linear_before_reset"] = \
+                    int(h.gru_linear_before_reset)
+            if node_ty == "RNN":
+                attrs["activations"] = \
+                    ["Relu" if h.mode == "relu" else "Tanh"] * D
+            node_ins = [x_name, f"{prefix}_W", f"{prefix}_R",
+                        f"{prefix}_B", seq_name,
+                        state_slice(in_names[1], l, "h0")]
+            node_outs = [f"{prefix}_Y", f"{prefix}_Yh"]
+            if node_ty == "LSTM":
+                node_ins.append(state_slice(in_names[2], l, "c0"))
+                node_outs.append(f"{prefix}_Yc")
+                yc_names.append(f"{prefix}_Yc")
+            yh_names.append(f"{prefix}_Yh")
+            nodes.append(helper.make_node(node_ty, node_ins, node_outs,
+                                          name=f"{prefix}_{node_ty}",
+                                          **attrs))
+            # (T, D, B, H) -> (T, B, D*H) for the next layer / final y
+            is_last = (l == L - 1)
+            tr = f"{prefix}_Ytr"
+            nodes.append(helper.make_node(
+                "Transpose", [f"{prefix}_Y"], [tr], name=tr,
+                perm=[0, 2, 1, 3]))
+            flat_nm = out_names[0] if is_last else f"{prefix}_Yflat"
+            shape_nm = f"{prefix}_yshape"
+            initializers.append(numpy_helper.from_array(
+                np.asarray([0, 0, D * H], np.int64), shape_nm))
+            nodes.append(helper.make_node(
+                "Reshape", [tr, shape_nm], [flat_nm],
+                name=f"{prefix}_reshape"))
+            x_name = flat_nm
+
+        # hy / cy tape outputs: stack of per-layer final states
+        if L == 1:
+            # rename by aliasing: emit Identity to the tape output names
+            nodes.append(helper.make_node(
+                "Identity", [yh_names[0]], [out_names[1]],
+                name=f"{op_name}_hy"))
+        else:
+            nodes.append(helper.make_node(
+                "Concat", yh_names, [out_names[1]],
+                name=f"{op_name}_hy", axis=0))
+        if node_ty == "LSTM":
+            if L == 1:
+                nodes.append(helper.make_node(
+                    "Identity", [yc_names[0]], [out_names[2]],
+                    name=f"{op_name}_cy"))
+            else:
+                nodes.append(helper.make_node(
+                    "Concat", yc_names, [out_names[2]],
+                    name=f"{op_name}_cy", axis=0))
+        else:
+            # non-LSTM modes carry c through unchanged: cy == cx
+            nodes.append(helper.make_node(
+                "Identity", [in_names[2]], [out_names[2]],
+                name=f"{op_name}_cy"))
+
+    @classmethod
+    def _export_layernorm(cls, op, op_name, in_names, out_names, nodes,
+                          initializers):
+        """Decompose `_LayerNorm` into primitive ONNX nodes (opset 11 has
+        no LayerNormalization): (x-mean)/sqrt(var+eps)*scale+bias."""
+        x, scale, bias = in_names[:3]
+        eps_nm = f"{op_name}_eps"
+        initializers.append(numpy_helper.from_array(
+            np.asarray(op.eps, np.float32), eps_nm))
+
+        def n(op_ty, ins, out, **attrs):
+            nodes.append(helper.make_node(op_ty, ins, [out], name=out,
+                                          **attrs))
+            return out
+
+        mean = n("ReduceMean", [x], f"{op_name}_mean", axes=[-1],
+                 keepdims=1)
+        cen = n("Sub", [x, mean], f"{op_name}_cen")
+        sq = n("Mul", [cen, cen], f"{op_name}_sq")
+        var = n("ReduceMean", [sq], f"{op_name}_var", axes=[-1], keepdims=1)
+        veps = n("Add", [var, eps_nm], f"{op_name}_veps")
+        std = n("Sqrt", [veps], f"{op_name}_std")
+        norm = n("Div", [cen, std], f"{op_name}_norm")
+        scaled = n("Mul", [norm, scale], f"{op_name}_scaled")
+        n("Add", [scaled, bias], out_names[0])
+
+    @classmethod
+    def _export_attention(cls, op, op_name, in_names, out_names, nodes,
+                          initializers):
+        """Decompose fused attention into ONNX matmul/softmax nodes:
+        softmax(q·kᵀ·scale [+ causal mask])·v. The fused kernel is a
+        runtime optimisation; on the wire the semantics are primitive."""
+        q_nm, k_nm, v_nm = in_names[:3]
+        q = op._export_refs[0]
+        S = int(q.shape[-2])
+        scale = op.scale if op.scale is not None \
+            else 1.0 / float(np.sqrt(q.shape[-1]))
+
+        def n(op_ty, ins, out, **attrs):
+            nodes.append(helper.make_node(op_ty, ins, [out], name=out,
+                                          **attrs))
+            return out
+
+        scale_nm = f"{op_name}_scale"
+        initializers.append(numpy_helper.from_array(
+            np.asarray(scale, np.float32), scale_nm))
+        kt = n("Transpose", [k_nm], f"{op_name}_kT", perm=[0, 1, 3, 2])
+        logits = n("MatMul", [q_nm, kt], f"{op_name}_qk")
+        scaled = n("Mul", [logits, scale_nm], f"{op_name}_qks")
+        if op.causal:
+            mask = np.triu(np.full((S, S), -1e9, np.float32), k=1)
+            mask_nm = f"{op_name}_mask"
+            initializers.append(numpy_helper.from_array(mask, mask_nm))
+            scaled = n("Add", [scaled, mask_nm], f"{op_name}_masked")
+        probs = n("Softmax", [scaled], f"{op_name}_p", axis=3)
+        n("MatMul", [probs, v_nm], out_names[0])
+
+    @classmethod
+    def _export_gelu(cls, op, op_name, in_names, out_names, nodes,
+                     initializers):
+        """Decompose GELU (tanh approximation, matching jax.nn.gelu's
+        default) into primitive nodes — opset 11 has no Gelu:
+        0.5*x*(1 + tanh(sqrt(2/pi)*(x + 0.044715*x^3)))."""
+        x = in_names[0]
+
+        def const(suffix, v):
+            nm = f"{op_name}_{suffix}"
+            initializers.append(numpy_helper.from_array(
+                np.asarray(v, np.float32), nm))
+            return nm
+
+        def n(op_ty, ins, out):
+            nodes.append(helper.make_node(op_ty, ins, [out], name=out))
+            return out
+
+        x2 = n("Mul", [x, x], f"{op_name}_x2")
+        x3 = n("Mul", [x2, x], f"{op_name}_x3")
+        cx3 = n("Mul", [const("c2", 0.044715), x3], f"{op_name}_cx3")
+        inner = n("Mul", [const("c1", float(np.sqrt(2.0 / np.pi))),
+                          n("Add", [x, cx3], f"{op_name}_xpc")],
+                  f"{op_name}_inner")
+        t = n("Tanh", [inner], f"{op_name}_t")
+        onept = n("Add", [const("one", 1.0), t], f"{op_name}_1pt")
+        halfx = n("Mul", [const("half", 0.5), x], f"{op_name}_hx")
+        n("Mul", [halfx, onept], out_names[0])
+
     @classmethod
     def singa_to_onnx_graph(cls, inputs, y, model_name="sonnx"):
         ys = y if isinstance(y, (list, tuple)) else [y]
@@ -370,6 +597,22 @@ class SingaFrontend:
                     "Cast", [in_names[0]], [cast_nm],
                     name=f"{op_name}_cast", to=int(TensorProto.INT64)))
                 in_names[0] = cast_nm
+            if ty == "_RNN":
+                cls._export_rnn(op, op_name, in_names, out_names, nodes,
+                                initializers)
+                continue
+            if ty == "_LayerNorm":
+                cls._export_layernorm(op, op_name, in_names, out_names,
+                                      nodes, initializers)
+                continue
+            if ty == "_FlashAttention":
+                cls._export_attention(op, op_name, in_names, out_names,
+                                      nodes, initializers)
+                continue
+            if ty == "GELU":
+                cls._export_gelu(op, op_name, in_names, out_names,
+                                 nodes, initializers)
+                continue
             onnx_ty, attrs = cls._node_attrs_and_extra(
                 op, op_name, in_names, initializers)
             nodes.append(helper.make_node(onnx_ty, in_names, out_names,
@@ -502,6 +745,25 @@ class SingaBackend:
                 node.cache["handle"] = handle
             return conv2d(handle, ins[0], ins[1],
                           ins[2] if len(ins) > 2 else None)
+        if ty == "ConvTranspose":
+            handle = node.cache.get("handle")
+            if handle is None:
+                ks = a["kernel_shape"]
+                pads = a.get("pads", [0] * 4)
+                group = a.get("group", 1)
+                handle = ConvTransposeHandle(
+                    ins[0], tuple(ks),
+                    tuple(a.get("strides", [1] * len(ks))),
+                    ((pads[0], pads[2]), (pads[1], pads[3])),
+                    in_channels=ins[0].shape[1],
+                    out_channels=ins[1].shape[1] * group,
+                    bias=len(ins) > 2, group=group,
+                    dilation=tuple(a.get("dilations", [1] * len(ks))),
+                    output_padding=tuple(
+                        a.get("output_padding", [0] * len(ks))))
+                node.cache["handle"] = handle
+            return conv_transpose2d(handle, ins[0], ins[1],
+                                    ins[2] if len(ins) > 2 else None)
         if ty in ("MaxPool", "AveragePool"):
             handle = node.cache.get("handle")
             if handle is None:
@@ -536,7 +798,11 @@ class SingaBackend:
         if ty == "Flatten":
             return autograd.flatten(ins[0], a.get("axis", 1))
         if ty == "Reshape":
-            return autograd.reshape(ins[0], _ints(ins[1]))
+            shape = _ints(ins[1])
+            # ONNX spec (allowzero=0 default): 0 copies the input dim
+            shape = [ins[0].shape[i] if v == 0 and i < len(ins[0].shape)
+                     else v for i, v in enumerate(shape)]
+            return autograd.reshape(ins[0], shape)
         if ty == "Transpose":
             return autograd.transpose(ins[0], a.get("perm"))
         if ty == "Squeeze":
@@ -635,7 +901,126 @@ class SingaBackend:
             v = a["value"]
             return Tensor(data=numpy_helper.to_array(v),
                           requires_grad=False)
+        if ty in ("RNN", "LSTM", "GRU"):
+            return cls._handle_rnn_family(node, ins)
         raise NotImplementedError(f"ONNX op {ty} is not supported")
+
+    # onnx gate-block order -> our gate order (rows of W/R in H-blocks):
+    # LSTM onnx iofc -> ours ifgo (g==c); GRU onnx zrh -> ours rzn
+    _rnn_gate_perm = {"LSTM": [0, 2, 3, 1], "GRU": [1, 0, 2], "RNN": [0]}
+
+    @classmethod
+    def _handle_rnn_family(cls, node, ins):
+        """ONNX RNN/LSTM/GRU node -> our scan-based RNN op (reference
+        python/singa/sonnx.py RNN-family backend handling; semantics from
+        the ONNX operator spec).
+
+        W/R/B are repacked into the op's flat-weight layout WITH taped
+        autograd ops, so gradients flow back to the original initializers
+        and an imported model fine-tunes like a native one.
+        """
+        from .ops.rnn import CudnnRNNHandle, rnn_op
+
+        ty, a = node.op_type, node.attrs
+        X, W, R = ins[0], ins[1], ins[2]
+        B = ins[3] if len(ins) > 3 else None
+        seq_lens = ins[4] if len(ins) > 4 else None
+        init_h = ins[5] if len(ins) > 5 else None
+        init_c = ins[6] if len(ins) > 6 else None
+        if ty == "LSTM" and len(ins) > 7 and ins[7] is not None:
+            raise NotImplementedError("LSTM peephole input P")
+
+        H = int(a["hidden_size"])
+        direction = a.get("direction", "forward")
+        if isinstance(direction, bytes):
+            direction = direction.decode()
+        D = 2 if direction == "bidirectional" else 1
+        perm = cls._rnn_gate_perm[ty]
+        G = len(perm)
+        acts = [v.decode() if isinstance(v, bytes) else v
+                for v in a.get("activations", [])]
+        if ty == "RNN":
+            base = acts[0] if acts else "Tanh"
+            if any(v != base for v in acts):
+                raise NotImplementedError(f"mixed RNN activations {acts}")
+            mode = {"Tanh": "tanh", "Relu": "relu"}.get(base)
+            if mode is None:
+                raise NotImplementedError(f"RNN activation {base}")
+        else:
+            defaults = {"LSTM": ["Sigmoid", "Tanh", "Tanh"],
+                        "GRU": ["Sigmoid", "Tanh"]}[ty]
+            # spec-default activation lists come per direction (len 3*D)
+            # or abbreviated (len 3); both mean "defaults"
+            if acts and acts != defaults and acts != defaults * D:
+                raise NotImplementedError(
+                    f"non-default {ty} activations {acts}")
+            mode = ty.lower()
+        lbr = bool(a.get("linear_before_reset", 0)) if ty == "GRU" else True
+
+        if direction == "reverse":
+            if seq_lens is not None:
+                raise NotImplementedError(
+                    "direction=reverse with sequence_lens")
+            T = X.shape[0]
+            X = autograd.slice(X, [T - 1], [-(T + 1)], [0], [-1])
+
+        def rows(mat2d, g):
+            return autograd.slice(mat2d, [g * H], [(g + 1) * H], [0])
+
+        def vec(v1d, base, g):
+            return autograd.slice(v1d, [base + g * H], [base + (g + 1) * H],
+                                  [0])
+
+        Bsz = X.shape[1]
+        pieces = []
+        for d in range(D):
+            Wd = autograd.reshape(
+                autograd.slice(W, [d], [d + 1], [0]), (G * H, W.shape[2]))
+            Rd = autograd.reshape(
+                autograd.slice(R, [d], [d + 1], [0]), (G * H, H))
+            Wih = autograd.cat([rows(Wd, g) for g in perm], 0)
+            Whh = autograd.cat([rows(Rd, g) for g in perm], 0)
+            if B is not None:
+                Bd = autograd.reshape(
+                    autograd.slice(B, [d], [d + 1], [0]), (2 * G * H,))
+                bih = autograd.cat([vec(Bd, 0, g) for g in perm], 0)
+                bhh = autograd.cat([vec(Bd, G * H, g) for g in perm], 0)
+            else:
+                zz = Tensor(data=np.zeros(G * H, np.float32),
+                            device=X.device, requires_grad=False)
+                bih = bhh = zz
+            pieces += [autograd.reshape(Wih, (G * H * W.shape[2],)),
+                       autograd.reshape(Whh, (G * H * H,)), bih, bhh]
+        flatW = autograd.cat(pieces, 0) if len(pieces) > 1 else pieces[0]
+
+        handle = node.cache.get("handle")
+        if handle is None:
+            handle = CudnnRNNHandle(
+                X, H, mode=mode, num_layers=1,
+                bidirectional=(direction == "bidirectional"),
+                gru_linear_before_reset=lbr)
+            node.cache["handle"] = handle
+
+        def state(t):
+            if t is None:
+                return Tensor(data=np.zeros((D, Bsz, H), np.float32),
+                              device=X.device, requires_grad=False)
+            return t
+
+        lens = None
+        if seq_lens is not None:
+            lens = autograd.cast(seq_lens, np.int32)
+        y, hy, cy = rnn_op(handle, X, state(init_h), state(init_c), flatW,
+                           seq_lengths=lens)
+        # ours: y (T, B, D*H); ONNX: Y (T, D, B, H), Y_h/Y_c (D, B, H)
+        T = X.shape[0]
+        Y = autograd.transpose(
+            autograd.reshape(y, (T, Bsz, D, H)), (0, 2, 1, 3))
+        if direction == "reverse":
+            Y = autograd.slice(Y, [T - 1], [-(T + 1)], [0], [-1])
+        if ty == "LSTM":
+            return Y, hy, cy
+        return Y, hy
 
     @classmethod
     def prepare(cls, model, device="CPU", init_inputs=None, **kwargs):
@@ -666,6 +1051,9 @@ class SingaBackend:
                                "Clip", "OneHot", "Upsample", "Resize",
                                "Gather", "ConstantOfShape"):
                 non_weight.update(n.input[1:])
+            elif n.op_type in ("RNN", "LSTM", "GRU"):
+                # sequence_lens / initial states are config, not weights
+                non_weight.update(n.input[4:7])
 
         params = OrderedDict()
         for init in graph.initializer:
@@ -718,7 +1106,8 @@ class SingaRep:
             out = SingaBackend._handle(node, resolved, tensors)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             for nm, t in zip(node.outputs, outs):
-                tensors[nm] = t
+                if nm:  # optional outputs may be omitted as ""
+                    tensors[nm] = t
         result = [tensors[o.name] for o in self.outputs]
         for nm in aux_output:
             result.append(tensors[nm])

@@ -1,0 +1,209 @@
+"""bf16 end-to-end training, broadcast/edge-shape op sweeps, and
+save->train(compiled DistOpt)->load->resume round-trips (VERDICT r1 #9;
+models reference test/python/test_operation.py broadcast sweeps and
+test_model.py:476-495 save/load)."""
+
+import os
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from singa_tpu import autograd, device, layer, model, opt
+from singa_tpu.parallel import mesh as mesh_mod
+from singa_tpu.tensor import Tensor
+
+DEV = device.create_cpu_device()
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=16, classes=4):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def make_data(n=32, din=8, classes=4, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype(np.float32)
+    w = rng.randn(din, classes)
+    y = np.eye(classes)[np.argmax(x @ w, 1)].astype(np.float32)
+    return x.astype(dtype), y.astype(dtype)
+
+
+class TestBf16Training:
+    """Params follow the input dtype (the reference's fp16 path,
+    examples/cnn/train_cnn.py:109-174, with bf16 as the TPU-native type)."""
+
+    def test_bf16_params_follow_input(self):
+        m = MLP()
+        x = Tensor(data=np.zeros((4, 8), np.float32), device=DEV)
+        x = x.as_type(jnp.bfloat16)
+        m.forward(x)
+        for name, p in m.get_states().items():
+            assert p.dtype == jnp.bfloat16, (name, p.dtype)
+
+    def test_bf16_compiled_train_decreases_loss(self):
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        x, y = make_data(seed=1)
+        tx = Tensor(data=x, device=dev).as_type(jnp.bfloat16)
+        ty = Tensor(data=y, device=dev)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.2, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=True)
+        losses = [float(np.asarray(m(tx, ty)[1].data.astype(jnp.float32)))
+                  for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.8, losses
+        # params and optimizer momentum stay bf16 through compiled steps
+        for name, p in m.get_states().items():
+            assert p.dtype == jnp.bfloat16, (name, p.dtype)
+        for key, aux in m.optimizer._aux.items():
+            assert aux.dtype == jnp.bfloat16, (key, aux.dtype)
+
+    def test_bf16_conv_forward_backward(self):
+        conv = layer.Conv2d(4, 3, padding=1)
+        x = Tensor(data=np.random.randn(2, 3, 8, 8).astype(np.float32),
+                   device=DEV, requires_grad=True).as_type(jnp.bfloat16)
+        y = conv(x)
+        assert y.dtype == jnp.bfloat16
+        assert conv.W.dtype == jnp.bfloat16
+
+
+class TestBroadcastSweep:
+    """Binary-op broadcasting across rank/shape combos (reference
+    test_operation.py's broadcast sweeps)."""
+
+    SHAPES = [
+        ((3, 4), (4,)),
+        ((3, 4), (1,)),
+        ((3, 4), ()),
+        ((2, 3, 4), (3, 4)),
+        ((2, 3, 4), (1, 4)),
+        ((2, 3, 4), (2, 1, 1)),
+        ((1, 3), (4, 1)),
+        ((5, 1, 2), (1, 6, 2)),
+    ]
+    OPS = [
+        (autograd.add, np.add), (autograd.sub, np.subtract),
+        (autograd.mul, np.multiply), (autograd.div, np.divide),
+        (autograd.pow, lambda a, b: np.power(np.abs(a) + 0.5, b)),
+    ]
+
+    @pytest.mark.parametrize("sa,sb", SHAPES)
+    def test_binary_broadcast_fwd_bwd(self, sa, sb):
+        rng = np.random.RandomState(hash((sa, sb)) % 2**31)
+        a = np.asarray(rng.randn(*sa), np.float32) + 2.0
+        b = np.asarray(rng.randn(*sb), np.float32) + 2.0
+        for fn, ref in self.OPS:
+            ta = Tensor(data=a, device=DEV, requires_grad=True,
+                        stores_grad=True)
+            tb = Tensor(data=b, device=DEV, requires_grad=True,
+                        stores_grad=True)
+            if fn is autograd.pow:
+                ta2 = Tensor(data=np.abs(a) + 0.5, device=DEV,
+                             requires_grad=True, stores_grad=True)
+                out = fn(ta2, tb)
+                want = ref(a, b)
+            else:
+                out = fn(ta, tb)
+                want = ref(a, b)
+            assert out.shape == np.broadcast_shapes(sa, sb)
+            np.testing.assert_allclose(np.asarray(out.data), want,
+                                       rtol=1e-4, atol=1e-4)
+            # backward reduces grads to the operand shapes
+            s = autograd.reduce_sum(out, None, 0)
+            grads = dict(autograd.backward(s))
+            for t, shape in ((ta2 if fn is autograd.pow else ta, sa),
+                             (tb, sb)):
+                g = t.grad
+                assert g is not None and tuple(g.shape) == tuple(shape), \
+                    (fn.__name__, shape, None if g is None else g.shape)
+
+    def test_matmul_batched_broadcast(self):
+        a = np.random.randn(5, 2, 3, 4).astype(np.float32)
+        b = np.random.randn(4, 6).astype(np.float32)
+        out = autograd.matmul(
+            Tensor(data=a, device=DEV, requires_grad=True),
+            Tensor(data=b, device=DEV, requires_grad=True))
+        np.testing.assert_allclose(np.asarray(out.data), a @ b, rtol=1e-4,
+                                   atol=1e-4)
+
+    @pytest.mark.parametrize("shape", [(1,), (1, 1), (3, 0), (7,)])
+    def test_unary_edge_shapes(self, shape):
+        x = np.random.randn(*shape).astype(np.float32)
+        for fn, ref in ((autograd.relu, lambda v: np.maximum(v, 0)),
+                        (autograd.tanh, np.tanh),
+                        (autograd.abs, np.abs)):
+            out = fn(Tensor(data=x, device=DEV, requires_grad=True))
+            np.testing.assert_allclose(np.asarray(out.data), ref(x),
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestDistOptSaveResume:
+    """save -> train through the COMPILED DistOpt step -> load -> resume:
+    the resumed trajectory must equal the uninterrupted one exactly
+    (params AND optimizer momentum restored) — reference
+    test_model.py:476-495 extended through the distributed compiled path."""
+
+    def _fresh(self, seed=7):
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(seed)
+        x, y = make_data(n=64, seed=2)
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = MLP()
+        d = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9))
+        d.communicator.mesh = mesh_mod.make_mesh(jax.devices("cpu"),
+                                                 mesh_mod.MeshConfig())
+        m.set_optimizer(d)
+        m.compile([tx], is_train=True, use_graph=True)
+        return m, tx, ty
+
+    def test_resume_trajectory_identical(self, tmp_path):
+        path = str(tmp_path / "ck.zip")
+        # uninterrupted run: 3 + 4 steps
+        m, tx, ty = self._fresh()
+        for _ in range(3):
+            m(tx, ty)
+        m.save_states(path)
+        ref_losses = [float(np.asarray(m(tx, ty)[1].data))
+                      for _ in range(4)]
+
+        # resumed run: fresh model + optimizer, load, same 4 steps
+        m2, tx2, ty2 = self._fresh(seed=99)   # different init on purpose
+        m2(tx2, ty2)  # materialise params + optimizer aux state
+        m2.load_states(path)
+        got_losses = [float(np.asarray(m2(tx2, ty2)[1].data))
+                      for _ in range(4)]
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5)
+
+    def test_save_restores_momentum(self, tmp_path):
+        path = str(tmp_path / "ck.zip")
+        m, tx, ty = self._fresh()
+        for _ in range(3):
+            m(tx, ty)
+        m.save_states(path)
+        mom_keys = [k for k in m.optimizer.get_states() if "momentum" in k]
+        assert mom_keys, "momentum aux expected"
+
+        m2, tx2, ty2 = self._fresh(seed=5)
+        m2(tx2, ty2)
+        m2.load_states(path)
+        s1 = m.optimizer.get_states()
+        s2 = m2.optimizer.get_states()
+        for k in mom_keys:
+            np.testing.assert_allclose(s2[k], s1[k], rtol=1e-6)
