@@ -285,6 +285,33 @@ class GELU(Operator):
         return jax.nn.gelu(x)
 
 
+class LRN(Operator):
+    """Across-channel local response normalisation on NCHW
+    (reference src/model/layer/lrn.cc; AlexNet-era caffe semantics):
+    y = x / (k + alpha/n * sum_{window n}(x^2))^beta."""
+
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.size = int(size)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.k = float(k)
+
+    def forward(self, x):
+        half = self.size // 2
+        win = jax.lax.reduce_window(
+            x * x, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)))
+        return x * jnp.power(self.k + self.alpha / self.size * win,
+                             -self.beta)
+
+
+def lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    return LRN(size, alpha, beta, k)(x)
+
+
 # ---- losses ---------------------------------------------------------------
 
 class CrossEntropy(Operator):
@@ -717,9 +744,12 @@ class _LayerNorm(Operator):
         self.eps = eps
 
     def forward(self, x, scale, bias):
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        return (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        # norm math in f32; activations keep the input's precision class
+        return y.astype(x.dtype)
 
 
 def layernorm(x, scale, bias, eps=1e-5):

@@ -1,0 +1,2 @@
+"""Vendored wire-compatible Caffe proto subset (see caffe.proto)."""
+from . import caffe_pb2  # noqa: F401

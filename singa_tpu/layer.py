@@ -637,7 +637,8 @@ class CudnnRNN(Layer):
             type("S", (), {"shape": xs}), self.hidden_size,
             mode=self.rnn_mode, num_layers=self.num_layers, bias=self.bias,
             dropout=self.dropout, bidirectional=self.bidirectional)
-        self.W = _param((self.handle.weights_size,), x.device)
+        self.W = _param((self.handle.weights_size,), x.device,
+                        dtype=x.dtype)
         k = 1.0 / math.sqrt(self.hidden_size)
         self.W.uniform(-k, k)
 
@@ -649,9 +650,11 @@ class CudnnRNN(Layer):
         B = x.shape[1]
         shape = (h.num_layers * h.num_directions, B, h.hidden_size)
         if hx is None:
-            hx = Tensor(shape=shape, device=x.device, requires_grad=False)
+            hx = Tensor(shape=shape, device=x.device, dtype=x.dtype,
+                        requires_grad=False)
         if cx is None:
-            cx = Tensor(shape=shape, device=x.device, requires_grad=False)
+            cx = Tensor(shape=shape, device=x.device, dtype=x.dtype,
+                        requires_grad=False)
         y, hy, cy = rnn_op(h, x, hx, cx, self.W, seq_lengths)
         if self.batch_first:
             y = autograd.transpose(y, (1, 0, 2))
@@ -724,6 +727,18 @@ class CrossEntropy(Layer):
 class BinaryCrossEntropy(Layer):
     def forward(self, x, t):
         return autograd.binary_cross_entropy(x, t)
+
+
+class LRN(Layer):
+    """Across-channel local response normalisation
+    (reference src/model/layer/lrn.cc:150)."""
+
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        return autograd.lrn(x, self.size, self.alpha, self.beta, self.k)
 
 
 class Dropout(Layer):

@@ -82,8 +82,11 @@ class _BatchNorm2d(Operator):
         mean, var = _global_moments(x, axes)
         bshape = h._bshape(x.ndim)
         inv = jax.lax.rsqrt(var + h.eps).reshape(bshape)
-        return (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) \
+        y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) \
             + bias.reshape(bshape)
+        # stats/params stay f32 for stability; activations keep the
+        # input's precision class (bf16 nets must not upcast here)
+        return y.astype(x.dtype)
 
 
 class _BatchNorm2dInference(Operator):
@@ -100,8 +103,9 @@ class _BatchNorm2dInference(Operator):
         rmean = jax.lax.stop_gradient(rmean)
         rvar = jax.lax.stop_gradient(rvar)
         inv = jax.lax.rsqrt(rvar + h.eps).reshape(bshape)
-        return (x - rmean.reshape(bshape)) * inv * scale.reshape(bshape) \
+        y = (x - rmean.reshape(bshape)) * inv * scale.reshape(bshape) \
             + bias.reshape(bshape)
+        return y.astype(x.dtype)
 
 
 def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,

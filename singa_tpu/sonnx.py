@@ -297,6 +297,10 @@ class SingaFrontend:
             return ty, attrs
         if ty == "ScatterElements":
             return "ScatterElements", {"axis": op.axis}
+        if ty == "LRN":
+            # ONNX LRN uses the same alpha/size pre-division as ours
+            return "LRN", {"size": op.size, "alpha": float(op.alpha),
+                           "beta": float(op.beta), "bias": float(op.k)}
         onnx_ty = cls._rename_operators.get(ty)
         if onnx_ty is None:
             raise NotImplementedError(
@@ -623,6 +627,14 @@ class SingaFrontend:
             graph_outputs.append(helper.make_tensor_value_info(
                 names[id(yy)], _onnx_dtype(yy), list(yy.shape)))
 
+        # drop unreferenced initializers: multi-node decompositions
+        # (e.g. _export_rnn's per-layer W/R/B) replace the raw leaf
+        # tensors, which would otherwise ship as dead payload
+        used = {o.name for o in graph_outputs}
+        for n in nodes:
+            used.update(n.input)
+        initializers = [i for i in initializers if i.name in used]
+
         return helper.make_graph(nodes, model_name, graph_inputs,
                                  graph_outputs, initializer=initializers)
 
@@ -903,6 +915,10 @@ class SingaBackend:
                           requires_grad=False)
         if ty in ("RNN", "LSTM", "GRU"):
             return cls._handle_rnn_family(node, ins)
+        if ty == "LRN":
+            return autograd.lrn(ins[0], a.get("size", 5),
+                                a.get("alpha", 1e-4), a.get("beta", 0.75),
+                                a.get("bias", 1.0))
         raise NotImplementedError(f"ONNX op {ty} is not supported")
 
     # onnx gate-block order -> our gate order (rows of W/R in H-blocks):

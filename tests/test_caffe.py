@@ -1,0 +1,218 @@
+"""Caffe import: prototxt parse, layer conversion, caffemodel weight
+loading, numeric parity vs a numpy oracle (capability the reference
+declares via its vendored src/proto/caffe.proto)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import caffe, device
+from singa_tpu.caffe_proto import caffe_pb2
+from singa_tpu.tensor import Tensor
+
+DEV = device.create_cpu_device()
+RNG = np.random.RandomState(3)
+
+
+LENET_PROTOTXT = """
+name: "MiniLeNet"
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 12 dim: 12 }
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 1 pad: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "ip1"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+def make_caffemodel():
+    """Binary NetParameter with trained blobs for MiniLeNet."""
+    w = caffe_pb2.NetParameter()
+    conv = w.layer.add()
+    conv.name, conv.type = "conv1", "Convolution"
+    Wc = RNG.randn(4, 1, 3, 3).astype(np.float32) * 0.5
+    bc = RNG.randn(4).astype(np.float32) * 0.1
+    for arr in (Wc, bc):
+        b = conv.blobs.add()
+        b.shape.dim.extend(arr.shape)
+        b.data.extend(arr.ravel().tolist())
+    ip = w.layer.add()
+    ip.name, ip.type = "ip1", "InnerProduct"
+    Wi = RNG.randn(5, 4 * 6 * 6).astype(np.float32) * 0.1
+    bi = RNG.randn(5).astype(np.float32) * 0.1
+    for arr in (Wi, bi):
+        b = ip.blobs.add()
+        b.shape.dim.extend(arr.shape)
+        b.data.extend(arr.ravel().tolist())
+    return w.SerializeToString(), (Wc, bc, Wi, bi)
+
+
+def manual_forward(x, Wc, bc, Wi, bi):
+    n, _, h, w = x.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((n, 4, h, w), np.float32)
+    for o in range(4):
+        for i in range(1):
+            for dy in range(3):
+                for dx in range(3):
+                    conv[:, o] += Wc[o, i, dy, dx] * \
+                        xp[:, i, dy:dy + h, dx:dx + w]
+        conv[:, o] += bc[o]
+    relu = np.maximum(conv, 0)
+    pooled = relu.reshape(n, 4, h // 2, 2, w // 2, 2).max(5).max(3)
+    flat = pooled.reshape(n, -1)
+    logits = flat @ Wi.T + bi
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    return e / e.sum(1, keepdims=True)
+
+
+class TestCaffeImport:
+    def test_prototxt_parse_and_forward_shapes(self, tmp_path):
+        p = tmp_path / "net.prototxt"
+        p.write_text(LENET_PROTOTXT)
+        net = caffe.load(str(p))
+        x = Tensor(data=RNG.randn(2, 1, 12, 12).astype(np.float32),
+                   device=DEV, requires_grad=False)
+        out = net.forward(x)
+        assert out.shape == (2, 5)
+        probs = np.asarray(out.data)
+        np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-5)
+
+    def test_caffemodel_weights_numeric_parity(self, tmp_path):
+        p = tmp_path / "net.prototxt"
+        p.write_text(LENET_PROTOTXT)
+        raw, (Wc, bc, Wi, bi) = make_caffemodel()
+        m = tmp_path / "net.caffemodel"
+        m.write_bytes(raw)
+        net = caffe.load(str(p), str(m))
+        x = RNG.randn(2, 1, 12, 12).astype(np.float32)
+        out = net.forward(Tensor(data=x, device=DEV, requires_grad=False))
+        want = manual_forward(x, Wc, bc, Wi, bi)
+        np.testing.assert_allclose(np.asarray(out.data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_imported_net_trains(self, tmp_path):
+        from singa_tpu import opt
+
+        p = tmp_path / "net.prototxt"
+        # training net: no trailing Softmax (train_one_batch adds the loss)
+        p.write_text(LENET_PROTOTXT.replace(
+            'layer { name: "prob" type: "Softmax" bottom: "ip1" '
+            'top: "prob" }', ""))
+        net = caffe.load(str(p))
+        net.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        x = Tensor(data=RNG.randn(8, 1, 12, 12).astype(np.float32),
+                   device=DEV, requires_grad=False)
+        y = Tensor(data=np.eye(5)[RNG.randint(0, 5, 8)].astype(np.float32),
+                   device=DEV, requires_grad=False)
+        net.compile([x], is_train=True, use_graph=True)
+        losses = [float(np.asarray(net(x, y)[1].data)) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+    def test_batchnorm_scale_pair(self):
+        npz = caffe_pb2.NetParameter()
+        txt = """
+        name: "bn"
+        layer { name: "bn1" type: "BatchNorm" bottom: "d" top: "b"
+                batch_norm_param { eps: 1e-5 } }
+        layer { name: "sc1" type: "Scale" bottom: "b" top: "s"
+                scale_param { bias_term: true } }
+        """
+        from google.protobuf import text_format
+        net_def = text_format.Parse(txt, npz)
+        w = caffe_pb2.NetParameter()
+        mean = np.asarray([1.0, -2.0], np.float32)
+        var = np.asarray([4.0, 9.0], np.float32)
+        bn = w.layer.add()
+        bn.name, bn.type = "bn1", "BatchNorm"
+        for arr in (mean * 2, var * 2, np.asarray([2.0], np.float32)):
+            b = bn.blobs.add()
+            b.shape.dim.extend(arr.shape)
+            b.data.extend(np.ravel(arr).tolist())
+        sc = w.layer.add()
+        sc.name, sc.type = "sc1", "Scale"
+        gamma = np.asarray([1.5, 0.5], np.float32)
+        beta = np.asarray([0.1, -0.1], np.float32)
+        for arr in (gamma, beta):
+            b = sc.blobs.add()
+            b.shape.dim.extend(arr.shape)
+            b.data.extend(arr.tolist())
+
+        cv = caffe.CaffeConverter(net_def, w.SerializeToString())
+        net = cv.create_net()
+        x = RNG.randn(3, 2, 4, 4).astype(np.float32)
+        tx = Tensor(data=x, device=DEV, requires_grad=False)
+        cv.load_weights(net, tx)
+        net.eval()
+        out = np.asarray(net.forward(tx).data)
+        want = ((x - mean[None, :, None, None])
+                / np.sqrt(var[None, :, None, None] + 1e-5)
+                * gamma[None, :, None, None] + beta[None, :, None, None])
+        np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-3)
+
+    def test_lrn_numeric(self):
+        from singa_tpu import autograd
+        x = RNG.randn(2, 6, 3, 3).astype(np.float32)
+        size, alpha, beta, k = 5, 1e-2, 0.75, 1.0
+        out = autograd.lrn(Tensor(data=x, device=DEV, requires_grad=True),
+                           size, alpha, beta, k)
+        # naive numpy oracle
+        want = np.empty_like(x)
+        half = size // 2
+        for c in range(6):
+            lo, hi = max(0, c - half), min(6, c + size - half)
+            s = (x[:, lo:hi] ** 2).sum(1)
+            want[:, c] = x[:, c] / (k + alpha / size * s) ** beta
+        np.testing.assert_allclose(np.asarray(out.data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_caffe_net_with_lrn_exports_to_onnx(self, tmp_path):
+        """caffe import -> ONNX export round-trip (LRN maps to the native
+        ONNX LRN op)."""
+        from singa_tpu import sonnx
+
+        txt = LENET_PROTOTXT.replace(
+            'layer { name: "relu1" type: "ReLU" bottom: "conv1" '
+            'top: "conv1" }',
+            'layer { name: "relu1" type: "ReLU" bottom: "conv1" '
+            'top: "conv1" }\n'
+            'layer { name: "norm1" type: "LRN" bottom: "conv1" '
+            'top: "conv1" lrn_param { local_size: 3 alpha: 0.01 } }')
+        p = tmp_path / "net.prototxt"
+        p.write_text(txt)
+        net = caffe.load(str(p))
+        x = Tensor(data=RNG.randn(2, 1, 12, 12).astype(np.float32),
+                   device=DEV, requires_grad=True)
+        net.forward(x)
+        mp = sonnx.to_onnx(net, [x], "caffe_lrn")
+        assert "LRN" in [n.op_type for n in mp.graph.node]
+        rep = sonnx.prepare(mp, device="CPU")
+        got = rep.run([x])[0]
+        np.testing.assert_allclose(np.asarray(got.data),
+                                   np.asarray(net.forward(x).data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unknown_layer_raises(self):
+        from google.protobuf import text_format
+        net = text_format.Parse(
+            'layer { name: "x" type: "Embed" }', caffe_pb2.NetParameter())
+        with pytest.raises(NotImplementedError):
+            caffe.CaffeConverter(net).create_net()

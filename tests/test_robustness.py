@@ -74,6 +74,37 @@ class TestBf16Training:
         for key, aux in m.optimizer._aux.items():
             assert aux.dtype == jnp.bfloat16, (key, aux.dtype)
 
+    def test_bf16_survives_bn_and_layernorm(self):
+        """BN/LayerNorm compute stats in f32 but must hand activations
+        back in the input's precision class, so conv->bn->conv nets stay
+        bf16 end to end."""
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.c1 = layer.Conv2d(4, 3, padding=1)
+                self.bn = layer.BatchNorm2d()
+                self.c2 = layer.Conv2d(4, 3, padding=1)
+                self.ln = layer.LayerNorm()
+
+            def forward(self, x):
+                y = self.c2(self.bn(self.c1(x)))
+                return self.ln(autograd.flatten(y))
+
+        m = Net()
+        x = Tensor(data=np.random.randn(2, 3, 8, 8).astype(np.float32),
+                   device=DEV, requires_grad=True).as_type(jnp.bfloat16)
+        y = m.forward(x)
+        assert y.dtype == jnp.bfloat16
+        assert m.get_states()["Net.c2.W"].dtype == jnp.bfloat16
+
+    def test_bf16_rnn_params_follow_input(self):
+        rnn = layer.CudnnRNN(4, rnn_mode="lstm")
+        x = Tensor(data=np.random.randn(3, 2, 5).astype(np.float32),
+                   device=DEV, requires_grad=True).as_type(jnp.bfloat16)
+        y, hy, cy = rnn(x)
+        assert rnn.W.dtype == jnp.bfloat16
+        assert y.dtype == jnp.bfloat16
+
     def test_bf16_conv_forward_backward(self):
         conv = layer.Conv2d(4, 3, padding=1)
         x = Tensor(data=np.random.randn(2, 3, 8, 8).astype(np.float32),

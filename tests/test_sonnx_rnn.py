@@ -283,6 +283,20 @@ class TestOnnxRnnExport:
                                    np.asarray(want.data),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_no_dead_flat_weight_initializer(self):
+        """_export_rnn slices the flat W into per-layer W/R/B; the raw
+        flat vector must not also ship as an unreferenced initializer."""
+        m = RnnNet(5, mode="lstm", layers=2, bidir=True)
+        x = Tensor(data=RNG.randn(4, 2, 3).astype(np.float32), device=DEV,
+                   requires_grad=True)
+        m.forward(x)
+        mp = sonnx.to_onnx(m, [x], "rnn")
+        used = set()
+        for n in mp.graph.node:
+            used.update(n.input)
+        for init in mp.graph.initializer:
+            assert init.name in used, f"dead initializer {init.name}"
+
     def test_char_rnn_style_model(self):
         """Embedding -> LSTM -> Linear (the reference's char_rnn shape)."""
         class CharRnn(model.Model):
