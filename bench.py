@@ -44,14 +44,12 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
-    import jax
-    from singa_tpu import tensor, opt, device
+def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name):
+    from singa_tpu import tensor, opt, device  # noqa: F401
     from singa_tpu.models import resnet
+    import jax.numpy as jnp
     import numpy as np
 
-    dev = device.create_tpu_device()
-    platform = dev.jax_device.platform
     model = resnet.create_model(depth=depth, num_classes=10, num_channels=3)
     model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
 
@@ -59,6 +57,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
     y = np.eye(10)[np.random.randint(0, 10, batch)].astype(np.float32)
     tx = tensor.Tensor(data=x, device=dev, dtype=tensor.float32,
                        requires_grad=False)
+    if dtype_name == "bfloat16":
+        tx = tx.as_type(jnp.bfloat16)
     ty = tensor.Tensor(data=y, device=dev, dtype=tensor.float32,
                        requires_grad=False)
 
@@ -73,19 +73,41 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
         out, loss = model(tx, ty)
     loss.data.block_until_ready()
     end = time.perf_counter()
+    return (niters * batch / (end - start),
+            (end - start) / niters * 1e3)
 
-    throughput = niters * batch / (end - start)
-    step_ms = (end - start) / niters * 1e3
+
+def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
+    from singa_tpu import device
+
+    dev = device.create_tpu_device()
+    platform = dev.jax_device.platform
     peak = _peak_flops(getattr(dev.jax_device, "device_kind", ""))
-    mfu = (throughput * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
-           if peak else None)
-    return {
+
+    throughput, step_ms = _measure(dev, batch, niters, warmup, image_size,
+                                   depth, "float32")
+    res = {
         "throughput": throughput,
         "step_ms": step_ms,
-        "mfu": mfu,
+        "mfu": (throughput * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+                if peak else None),
         "platform": platform,
         "device_kind": getattr(dev.jax_device, "device_kind", "unknown"),
     }
+    # bf16 variant: params follow the input dtype, so the whole train step
+    # (fwd+bwd+SGD) runs in the MXU's native precision — the TPU-first
+    # counterpart of the reference's fp16 precision flag
+    if os.environ.get("BENCH_BF16", "1") != "0":
+        try:
+            bt, bs = _measure(dev, batch, niters, warmup, image_size,
+                              depth, "bfloat16")
+            res["bf16_throughput"] = bt
+            res["bf16_step_ms"] = bs
+            if peak:
+                res["bf16_mfu"] = bt * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+        except Exception as e:   # the fp32 number still stands
+            res["bf16_error"] = str(e)[:200]
+    return res
 
 
 def child_main(platform):
@@ -95,7 +117,8 @@ def child_main(platform):
         import jax
         jax.config.update("jax_platforms", "cpu")
         batch = int(os.environ.get("BENCH_BATCH", "4"))
-        niters = int(os.environ.get("BENCH_ITERS", "3"))
+        niters = int(os.environ.get("BENCH_ITERS", "2"))
+        os.environ.setdefault("BENCH_BF16", "0")  # CPU emulated bf16 is slow
         warmup = 1
     else:
         batch = int(os.environ.get("BENCH_BATCH", "32"))
@@ -129,7 +152,7 @@ def main():
     res = None
     # TPU attempts with backoff; the backend is observably flaky, and a
     # hung init is bounded by the per-attempt subprocess timeout.
-    timeouts = [480, 360]
+    timeouts = [600, 420]
     for i, timeout in enumerate(timeouts):
         res, err = _attempt("tpu", timeout)
         if res is not None:
@@ -141,7 +164,7 @@ def main():
     if res is None:
         # last resort: a CPU number, clearly labeled, so the round still
         # records a real measurement instead of a traceback
-        res, err = _attempt("cpu", 600)
+        res, err = _attempt("cpu", 480)
         if res is None:
             errors.append(f"cpu: {err}")
             print(json.dumps({
@@ -163,6 +186,9 @@ def main():
     }
     if res.get("mfu") is not None:
         out["mfu"] = round(res["mfu"], 4)
+    for k in ("bf16_throughput", "bf16_step_ms", "bf16_mfu", "bf16_error"):
+        if res.get(k) is not None:
+            out[k] = round(res[k], 4) if isinstance(res[k], float) else res[k]
     if errors:
         out["retries"] = errors
     print(json.dumps(out))

@@ -156,6 +156,12 @@ class Operator:
         """Default: vjp of forward. Returns one grad per forward input."""
         assert self._vjp_fn is not None, \
             f"{self.name}: backward called without a recorded forward"
+        # cotangents must match the primal output dtypes: ops whose
+        # backward crosses a precision boundary (e.g. an f32 loss feeding
+        # a bf16 net) would otherwise hand mismatched dtypes to vjp rules
+        dys = tuple(
+            dy.astype(dt) if hasattr(dy, "astype") and dy.dtype != dt
+            else dy for dy, dt in zip(dys, self.y_dtypes))
         if len(self.y_shapes) > 1:
             grads = self._vjp_fn(tuple(dys))
         else:

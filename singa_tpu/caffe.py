@@ -5,13 +5,15 @@ wired converter; here the import path is real and tested).
 ``load(prototxt[, caffemodel])`` parses a Caffe net definition (protobuf
 text format) plus optional trained weights (binary ``NetParameter``) and
 returns a :class:`CaffeNet` — a normal :class:`~singa_tpu.model.Model`
-whose forward chains our layers, so the imported net jits, trains, and
-exports to ONNX like a native model.
+whose forward chains our layers, so the imported net jits, trains,
+checkpoints (all converted params appear in ``get_states``), and exports
+to ONNX like a native model.
 
-Supported layer types: Convolution, Pooling (MAX/AVE, global), InnerProduct,
-ReLU (incl. negative_slope), Sigmoid, TanH, Softmax, Dropout, Flatten, LRN,
-BatchNorm (+ folded Scale), Eltwise-free linear chains. Data/Input layers
-define the input; unknown config fields are skipped by protobuf.
+Supported layer types: Convolution, Pooling (MAX/AVE, global, caffe's CEIL
+output sizing), InnerProduct, ReLU (incl. negative_slope), Sigmoid, TanH,
+Softmax, Dropout, Flatten, LRN, BatchNorm (eps/use_global_stats honored)
++ Scale pairs. Data/Input layers define the input; unknown config fields
+are skipped by protobuf.
 """
 
 from __future__ import annotations
@@ -47,10 +49,100 @@ class CaffeNet(Model):
         return x
 
     def train_one_batch(self, x, y):
-        out = self.forward(x)
+        # deploy-style prototxts end in a Softmax layer; train on the
+        # LOGITS (softmax_cross_entropy applies its own softmax) and
+        # return the probabilities the net advertises
+        entries = self._entries
+        has_prob = entries and isinstance(entries[-1][1], layer_mod.SoftMax)
+        body = entries[:-1] if has_prob else entries
+        out = x
+        for _name, fn in body:
+            out = fn(out)
         loss = autograd.softmax_cross_entropy(out, y)
         self.optimizer(loss)
+        if has_prob:
+            out = entries[-1][1](out)
         return out, loss
+
+
+class _CaffeInnerProduct(layer_mod.Layer):
+    """caffe InnerProduct: implicit flatten from axis 1, W is (out, in)."""
+
+    def __init__(self, p):
+        super().__init__()
+        self.flat = layer_mod.Flatten()
+        self.fc = layer_mod.Linear(p.num_output, bias=p.bias_term)
+        self.transpose = bool(p.transpose)
+
+    def forward(self, x):
+        if len(x.shape) > 2:
+            x = self.flat(x)
+        return self.fc(x)
+
+    def load_blobs(self, blobs):
+        W = blobs[0]                     # caffe: (out, in)
+        self.fc.W.copy_from_numpy(W if self.transpose
+                                  else np.ascontiguousarray(W.T))
+        if self.fc.bias and len(blobs) > 1:
+            self.fc.b.copy_from_numpy(blobs[1])
+
+
+class _CaffeScale(layer_mod.Layer):
+    """caffe Scale: per-channel gamma (+ beta), usually after BatchNorm."""
+
+    def __init__(self, bias_term):
+        super().__init__()
+        self.bias_term = bool(bias_term)
+
+    def initialize(self, x):
+        c = x.shape[1]
+        dev = x.device
+        self.gamma = Tensor(data=np.ones((1, c, 1, 1), np.float32),
+                            device=dev, requires_grad=True,
+                            stores_grad=True)
+        self.beta = Tensor(data=np.zeros((1, c, 1, 1), np.float32),
+                           device=dev, requires_grad=True, stores_grad=True)
+
+    def forward(self, x):
+        y = autograd.mul(x, self.gamma)
+        return autograd.add(y, self.beta) if self.bias_term else y
+
+    def load_blobs(self, blobs):
+        c = blobs[0].size
+        self.gamma.copy_from_numpy(
+            blobs[0].reshape(1, c, 1, 1).astype(np.float32))
+        if self.bias_term and len(blobs) > 1:
+            self.beta.copy_from_numpy(
+                np.asarray(blobs[1]).reshape(1, c, 1, 1).astype(np.float32))
+
+    def _own_params(self):
+        p = {"gamma": self.gamma}
+        if self.bias_term:
+            p["beta"] = self.beta
+        return p
+
+
+class _CaffePool(layer_mod.Layer):
+    """caffe pooling computes output sizes with CEIL; reproduce it with
+    asymmetric extra padding so the window grid matches exactly (MAX pads
+    with -inf, AVE with zeros and caffe's count-include-pad division)."""
+
+    def __init__(self, is_max, ks, st, pad):
+        super().__init__()
+        self.is_max = is_max
+        self.ks, self.st, self.pad = ks, st, pad
+
+    def initialize(self, x):
+        (kh, kw), (sh, sw), (ph, pw) = self.ks, self.st, self.pad
+        h, w = x.shape[2], x.shape[3]
+        eh = (sh - (h + 2 * ph - kh) % sh) % sh
+        ew = (sw - (w + 2 * pw - kw) % sw) % sw
+        self.pool = layer_mod.Pooling2d(
+            (kh, kw), (sh, sw), ((ph, ph + eh), (pw, pw + ew)),
+            is_max=self.is_max)
+
+    def forward(self, x):
+        return self.pool(x)
 
 
 def _pair_of(param, scalar_field, h_field, w_field, default):
@@ -66,7 +158,8 @@ def _pair_of(param, scalar_field, h_field, w_field, default):
 
 
 def _convert_layer(lp):
-    """LayerParameter -> (callable, param_loader) or None to skip."""
+    """LayerParameter -> Layer/callable, or None to skip. Layers with
+    loadable caffemodel blobs expose ``load_blobs``."""
     ty = lp.type
     if ty in _SKIP_TYPES:
         return None
@@ -85,59 +178,44 @@ def _convert_layer(lp):
             lay.W.copy_from_numpy(blobs[0])      # (out, in/g, kh, kw)
             if pp.bias_term and len(blobs) > 1:
                 lay.b.copy_from_numpy(blobs[1])
-        return conv, load
+        conv.load_blobs = load
+        return conv
     if ty == "Pooling":
         p = lp.pooling_param
         if p.global_pooling:
             if p.pool == caffe_pb2.PoolingParameter.AVE:
-                return (lambda x: autograd.globalaveragepool(x)), None
+                return lambda x: autograd.globalaveragepool(x)
             raise NotImplementedError("global MAX pooling")
         ks = _pair_of(p, "kernel_size", "kernel_h", "kernel_w", (2, 2))
         st = _pair_of(p, "stride", "stride_h", "stride_w", (1, 1))
         pad = (p.pad_h or p.pad, p.pad_w or p.pad)
-        cls = layer_mod.MaxPool2d \
-            if p.pool == caffe_pb2.PoolingParameter.MAX \
-            else layer_mod.AvgPool2d
-        return cls(ks, st, pad), None
+        return _CaffePool(p.pool == caffe_pb2.PoolingParameter.MAX,
+                          ks, st, pad)
     if ty == "InnerProduct":
-        p = lp.inner_product_param
-        fc = layer_mod.Linear(p.num_output, bias=p.bias_term)
-        flat = layer_mod.Flatten()
-
-        def apply(x, fc=fc, flat=flat):
-            if len(x.shape) > 2:
-                x = flat(x)          # caffe IP flattens from axis 1
-            return fc(x)
-
-        def load(blobs, lay=fc, pp=p):
-            W = blobs[0]             # caffe: (out, in)
-            lay.W.copy_from_numpy(np.ascontiguousarray(W.T)
-                                  if not pp.transpose else W)
-            if pp.bias_term and len(blobs) > 1:
-                lay.b.copy_from_numpy(blobs[1])
-        apply._layers = (flat, fc)
-        return apply, load
+        return _CaffeInnerProduct(lp.inner_product_param)
     if ty == "ReLU":
         slope = lp.relu_param.negative_slope
         if slope:
-            return (lambda x, s=slope: autograd.leakyrelu(x, s)), None
-        return layer_mod.ReLU(), None
+            return lambda x, s=slope: autograd.leakyrelu(x, s)
+        return layer_mod.ReLU()
     if ty == "Sigmoid":
-        return layer_mod.Sigmoid(), None
+        return layer_mod.Sigmoid()
     if ty == "TanH":
-        return layer_mod.Tanh(), None
+        return layer_mod.Tanh()
     if ty == "Softmax":
-        return layer_mod.SoftMax(), None
+        return layer_mod.SoftMax()
     if ty == "Dropout":
-        return layer_mod.Dropout(lp.dropout_param.dropout_ratio), None
+        return layer_mod.Dropout(lp.dropout_param.dropout_ratio)
     if ty == "Flatten":
-        return layer_mod.Flatten(lp.flatten_param.axis), None
+        return layer_mod.Flatten(lp.flatten_param.axis)
     if ty == "LRN":
         p = lp.lrn_param
-        return layer_mod.LRN(p.local_size, p.alpha, p.beta, p.k), None
+        return layer_mod.LRN(p.local_size, p.alpha, p.beta, p.k)
     if ty == "BatchNorm":
         p = lp.batch_norm_param
-        bn = layer_mod.BatchNorm2d(momentum=p.moving_average_fraction)
+        freeze = p.HasField("use_global_stats") and p.use_global_stats
+        bn = layer_mod.BatchNorm2d(momentum=p.moving_average_fraction,
+                                   eps=p.eps, freeze_stats=freeze)
 
         def load(blobs, lay=bn):
             # caffe blobs: mean, var, scale_factor (a 1-element blob)
@@ -147,39 +225,10 @@ def _convert_layer(lp):
                 np.asarray(blobs[0] * sf, np.float32))
             lay.running_var.copy_from_numpy(
                 np.asarray(blobs[1] * sf, np.float32))
-        return bn, load
+        bn.load_blobs = load
+        return bn
     if ty == "Scale":
-        p = lp.scale_param
-        # standalone channel-wise scale after BatchNorm: gamma (+ beta)
-        state = {}
-
-        def apply(x, state=state):
-            g = state.get("gamma")
-            if g is None:
-                c = x.shape[1]
-                state["gamma"] = g = Tensor(
-                    data=np.ones((1, c, 1, 1), np.float32),
-                    device=x.device, requires_grad=True, stores_grad=True)
-                state["beta"] = Tensor(
-                    data=np.zeros((1, c, 1, 1), np.float32),
-                    device=x.device, requires_grad=True, stores_grad=True)
-            y = autograd.mul(x, g)
-            if state.get("beta") is not None:
-                y = autograd.add(y, state["beta"])
-            return y
-
-        def load(blobs, state=state, pp=p):
-            c = blobs[0].size
-            state["gamma"] = Tensor(
-                data=blobs[0].reshape(1, c, 1, 1).astype(np.float32),
-                requires_grad=True, stores_grad=True)
-            beta = blobs[1] if pp.bias_term and len(blobs) > 1 \
-                else np.zeros(c, np.float32)
-            state["beta"] = Tensor(
-                data=np.asarray(beta).reshape(1, c, 1, 1).astype(
-                    np.float32),
-                requires_grad=True, stores_grad=True)
-        return apply, load
+        return _CaffeScale(lp.scale_param.bias_term)
     raise NotImplementedError(f"caffe layer type {ty!r}")
 
 
@@ -212,18 +261,12 @@ class CaffeConverter:
         return None
 
     def create_net(self):
-        entries, loaders = [], {}
+        entries = []
         for lp in self.net.layer:
-            conv = _convert_layer(lp)
-            if conv is None:
-                continue
-            fn, loader = conv
-            entries.append((lp.name, fn))
-            if loader is not None:
-                loaders[lp.name] = loader
-        net = CaffeNet(entries)
-        net._param_loaders = loaders
-        return net
+            fn = _convert_layer(lp)
+            if fn is not None:
+                entries.append((lp.name, fn))
+        return CaffeNet(entries)
 
     def load_weights(self, net, x):
         """Materialise layer params (one forward on ``x``) then copy the
@@ -232,9 +275,10 @@ class CaffeConverter:
             return net
         net.forward(x)
         by_name = {lp.name: lp for lp in self.weights.layer}
-        for name, loader in net._param_loaders.items():
+        for name, fn in net._entries:
+            loader = getattr(fn, "load_blobs", None)
             lp = by_name.get(name)
-            if lp is None or not lp.blobs:
+            if loader is None or lp is None or not lp.blobs:
                 continue
             blobs = []
             for b in lp.blobs:

@@ -414,7 +414,7 @@ class SeparableConv2d(Layer):
 class BatchNorm2d(Layer):
     """BN over channel axis (reference layer.BatchNorm2d:802)."""
 
-    def __init__(self, *args, momentum=0.9, eps=1e-5):
+    def __init__(self, *args, momentum=0.9, eps=1e-5, freeze_stats=False):
         super().__init__()
         # legacy form BatchNorm2d(channels[, momentum]); channels is
         # re-inferred from the input at initialize time. A lone float
@@ -425,6 +425,8 @@ class BatchNorm2d(Layer):
             momentum = args[1]
         self.momentum = momentum
         self.eps = eps
+        # caffe use_global_stats: always normalise with running stats
+        self.freeze_stats = freeze_stats
 
     def initialize(self, x):
         self.channels = x.shape[1]
@@ -441,7 +443,8 @@ class BatchNorm2d(Layer):
     def forward(self, x):
         from .ops.batchnorm import batchnorm_2d
         return batchnorm_2d(self.handle, x, self.scale, self.bias,
-                            self.running_mean, self.running_var)
+                            self.running_mean, self.running_var,
+                            freeze_stats=self.freeze_stats)
 
     def _own_params(self):
         return {"scale": self.scale, "bias": self.bias}

@@ -109,14 +109,16 @@ class _BatchNorm2dInference(Operator):
 
 
 def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,
-                 running_mean: Tensor, running_var: Tensor):
+                 running_mean: Tensor, running_var: Tensor,
+                 freeze_stats=False):
     """Functional wrapper (parity: reference autograd.batchnorm_2d:1740).
 
     In training mode the running statistics are updated in place (rebinding
     the state Tensors), exactly mirroring the reference's in-place block
-    mutation semantics.
+    mutation semantics. ``freeze_stats`` forces the frozen-stats inference
+    path even in training (caffe's use_global_stats).
     """
-    if is_training():
+    if is_training() and not freeze_stats:
         h = handle
         axes = h._axes(x.ndim)
         xb = x.data if isinstance(x, Tensor) else x

@@ -93,8 +93,6 @@ class _Conv2d(Operator):
             rhs_dilation=h.dilation,
             dimension_numbers=h.dimension_numbers,
             feature_group_count=h.group,
-            preferred_element_type=jnp.float32
-            if x.dtype == jnp.bfloat16 else None,
         )
         if b is not None:
             y = y + b.reshape(1, -1, 1, 1)
@@ -182,8 +180,6 @@ class _ConvTranspose2d(Operator):
             rhs_dilation=h.dilation,
             dimension_numbers=h.dimension_numbers,
             feature_group_count=h.group,
-            preferred_element_type=jnp.float32
-            if x.dtype == jnp.bfloat16 else None,
         )
         if b is not None:
             y = y + b.reshape(1, -1, 1, 1)

@@ -109,6 +109,79 @@ class TestCaffeImport:
         np.testing.assert_allclose(np.asarray(out.data), want,
                                    rtol=1e-4, atol=1e-5)
 
+    def test_states_include_all_converted_params(self, tmp_path):
+        """InnerProduct (and every converted layer) must appear in
+        get_states so checkpointing an imported net is lossless."""
+        p = tmp_path / "net.prototxt"
+        p.write_text(LENET_PROTOTXT)
+        raw, (Wc, bc, Wi, bi) = make_caffemodel()
+        m = tmp_path / "net.caffemodel"
+        m.write_bytes(raw)
+        net = caffe.load(str(p), str(m))
+        states = net.get_states()
+        ip_w = [k for k in states if "ip1" in k and k.endswith(".W")]
+        assert ip_w, list(states)
+        np.testing.assert_allclose(np.asarray(states[ip_w[0]].data),
+                                   Wi.T, rtol=1e-6)
+        conv_w = [k for k in states if "conv1" in k and k.endswith(".W")]
+        assert conv_w, list(states)
+
+    def test_ceil_pooling_shape(self):
+        """caffe pools with CEIL output sizing: 3x3 stride-2 on 6x6 is
+        3x3 (floor would give 2x2), last window clipped at the border."""
+        from google.protobuf import text_format
+        net_def = text_format.Parse("""
+        layer { name: "p" type: "Pooling" bottom: "d" top: "p"
+                pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+        """, caffe_pb2.NetParameter())
+        net = caffe.CaffeConverter(net_def).create_net()
+        x = RNG.randn(1, 2, 6, 6).astype(np.float32)
+        out = net.forward(Tensor(data=x, device=DEV, requires_grad=False))
+        assert out.shape == (1, 2, 3, 3), out.shape
+        # values: max over each (border-clipped) 3x3 window on the grid
+        want = np.full((1, 2, 3, 3), -np.inf, np.float32)
+        for i in range(3):
+            for j in range(3):
+                want[:, :, i, j] = x[:, :, 2 * i:2 * i + 3,
+                                     2 * j:2 * j + 3].max((2, 3))
+        np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-6)
+
+    def test_batchnorm_eps_honored(self):
+        from google.protobuf import text_format
+        net_def = text_format.Parse("""
+        layer { name: "bn" type: "BatchNorm" bottom: "d" top: "b"
+                batch_norm_param { eps: 0.1 use_global_stats: true } }
+        """, caffe_pb2.NetParameter())
+        net = caffe.CaffeConverter(net_def).create_net()
+        x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+        out = np.asarray(net.forward(
+            Tensor(data=x, device=DEV, requires_grad=False)).data)
+        # fresh stats: mean 0, var 1 -> y = x / sqrt(1 + 0.1)
+        np.testing.assert_allclose(out, x / np.sqrt(1.1), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_train_with_trailing_softmax(self, tmp_path):
+        """Deploy prototxts end in Softmax; training must use the logits
+        (no double softmax) while forward still returns probabilities."""
+        from singa_tpu import opt
+
+        p = tmp_path / "net.prototxt"
+        p.write_text(LENET_PROTOTXT)
+        net = caffe.load(str(p))
+        net.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        x = Tensor(data=RNG.randn(8, 1, 12, 12).astype(np.float32),
+                   device=DEV, requires_grad=False)
+        y = Tensor(data=np.eye(5)[RNG.randint(0, 5, 8)].astype(np.float32),
+                   device=DEV, requires_grad=False)
+        net.compile([x], is_train=True, use_graph=True)
+        losses = []
+        for _ in range(8):
+            out, loss = net(x, y)
+            losses.append(float(np.asarray(loss.data)))
+        assert losses[-1] < losses[0], losses
+        np.testing.assert_allclose(np.asarray(out.data).sum(1), 1.0,
+                                   rtol=1e-4)
+
     def test_imported_net_trains(self, tmp_path):
         from singa_tpu import opt
 

@@ -105,6 +105,29 @@ class TestBf16Training:
         assert rnn.W.dtype == jnp.bfloat16
         assert y.dtype == jnp.bfloat16
 
+    def test_bf16_resnet_block_trains(self):
+        """The bench's bf16 mode end-to-end on a small ResNet: conv vjp
+        must keep operand dtypes consistent (no preferred_element_type
+        mixing in the transpose rules)."""
+        from singa_tpu.models import resnet
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(5)
+        m = resnet.create_model(depth=18, num_classes=4, num_channels=3)
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+        y = np.eye(4)[np.random.randint(0, 4, 2)].astype(np.float32)
+        tx = Tensor(data=x, device=dev).as_type(jnp.bfloat16)
+        ty = Tensor(data=y, device=dev)
+        m.compile([tx], is_train=True, use_graph=True)
+        m(tx, ty)
+        out, loss = m(tx, ty)   # compiled step
+        assert np.isfinite(float(np.asarray(loss.data, np.float32)))
+        # weights must stay bf16 through compiled fwd+bwd+update
+        for k, v in m.get_states().items():
+            if k.endswith(".W"):
+                assert v.dtype == jnp.bfloat16, (k, v.dtype)
+
     def test_bf16_conv_forward_backward(self):
         conv = layer.Conv2d(4, 3, padding=1)
         x = Tensor(data=np.random.randn(2, 3, 8, 8).astype(np.float32),
