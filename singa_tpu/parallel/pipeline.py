@@ -1,12 +1,22 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+"""Pipeline parallelism: GPipe and 1F1B microbatch schedules over a mesh
+axis.
 
 No reference equivalent (the reference is data-parallel only, SURVEY.md
 §2.4). TPU-native design: every pipeline stage is the same jitted program
 (SPMD over the 'pipe' mesh axis inside ``shard_map``); activations hop to
 the next stage with `lax.ppermute` over ICI each schedule tick, and the
-whole schedule is a `lax.scan` — so XLA sees one static program and
-backward-through-the-pipeline falls out of `jax.grad` (the transpose of
-`ppermute` is the reverse-direction `ppermute`).
+whole schedule is a `lax.scan` — so XLA sees one static program.
+
+Two schedules:
+- GPipe (:func:`pipeline_spmd` / :class:`PipelineModule`): forward only;
+  backward falls out of `jax.grad` of the scan (the transpose of
+  `ppermute` is the reverse-direction `ppermute`) — simple, but autodiff
+  stores every tick's activations, O(n_micro).
+- 1F1B (:func:`pipeline_1f1b` / :class:`PipelineModule1F1B`): forward and
+  backward micro-steps interleave in ONE scan with the per-microbatch
+  loss inside the schedule; backward recomputes each stage from a saved
+  input-activation ring of depth 2(S-1)+1, so activation memory is
+  bounded by the pipe depth, not the microbatch count.
 """
 
 from __future__ import annotations
@@ -48,6 +58,16 @@ def _pipe_descale_bwd(axis_name, _res, g):
 _pipe_descale.defvjp(_pipe_descale_fwd, _pipe_descale_bwd)
 
 
+def _mark_varying(v, axis_name):
+    """Mark a value device-varying over ``axis_name`` for shard_map's
+    vma typecheck (API renamed across JAX versions)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(v, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(v, (axis_name,))
+    return v
+
+
 def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
     """Run a GPipe forward inside ``shard_map`` over ``axis_name``.
 
@@ -86,11 +106,8 @@ def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
 
     # the carry becomes device-varying (stage params differ per pipe
     # member); mark the init accordingly for shard_map's vma typecheck
-    init = jnp.zeros(mb_shape, x_microbatches.dtype)
-    if hasattr(jax.lax, "pcast"):
-        init = jax.lax.pcast(init, (axis_name,), to="varying")
-    elif hasattr(jax.lax, "pvary"):
-        init = jax.lax.pvary(init, (axis_name,))
+    init = _mark_varying(jnp.zeros(mb_shape, x_microbatches.dtype),
+                         axis_name)
     _, ys = lax.scan(step, init, jnp.arange(steps))
 
     # last stage's outputs at ticks n-1 .. steps-1 are microbatches 0..M-1
@@ -98,6 +115,108 @@ def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
     # broadcast them from the last stage to everyone
     return lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
+
+
+def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
+                  y_microbatches, axis_name="pipe"):
+    """One-forward-one-backward schedule inside ``shard_map``: loss and
+    gradients in ONE pass with activation memory bounded by the pipe
+    depth, not the microbatch count (GPipe autodiff stores every tick).
+
+    Each scan tick runs one forward micro-step and one backward
+    micro-step per stage. Stage ``s`` forwards microbatch ``t - s`` and
+    backwards microbatch ``t - 2(S-1) + s``; activations hop forward and
+    cotangents hop backward over the ICI ring each tick, and the backward
+    recomputes the stage forward from the saved *input* activation (vjp
+    residuals are never carried across ticks) — so the live state per
+    stage is a ring of at most ``2(S-1)+1`` input activations.
+
+    Args:
+      stage_fn: ``(params, a) -> a`` shape-preserving stage.
+      loss_fn: ``(a, y_mb) -> scalar`` applied at the LAST stage per
+        microbatch (mean-reduced over microbatches in the result).
+      stage_params: this device's stage params (pytree).
+      x_microbatches / y_microbatches: (M, mb, ...) replicated inputs.
+
+    Returns ``(loss, param_grads, dx_microbatches)`` — loss is the mean
+    over microbatches (broadcast to all stages), ``param_grads`` is the
+    gradient of that mean loss wrt THIS stage's params, and
+    ``dx_microbatches`` is the cotangent reaching the pipeline input
+    (nonzero on every stage after the final psum) for upstream layers.
+    """
+    S = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    R = 2 * (S - 1) + 1                       # max in-flight per stage
+    steps = M + 2 * (S - 1)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+    mb_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    is_last = sid == S - 1
+
+    def step(carry, t):
+        fwd_out, cot_out, ring, gacc, lacc, dxbuf = carry
+
+        # ---- forward tick: mb (t - sid) -----------------------------
+        recv_act = lax.ppermute(fwd_out, axis_name, fwd_perm)
+        m_f = t - sid
+        f_on = (m_f >= 0) & (m_f < M)
+        mb = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+        a_in = jnp.where(sid == 0, mb, recv_act)
+        slot_f = jnp.clip(m_f, 0, M - 1) % R
+        ring = jnp.where(
+            f_on,
+            lax.dynamic_update_index_in_dim(ring, a_in, slot_f, 0), ring)
+        y_new = stage_fn(stage_params, a_in)
+        fwd_out = jnp.where(f_on, y_new, fwd_out)
+
+        # ---- backward tick: mb (t - 2(S-1) + sid) -------------------
+        recv_cot = lax.ppermute(cot_out, axis_name, bwd_perm)
+        m_b = t - 2 * (S - 1) + sid
+        b_on = (m_b >= 0) & (m_b < M)
+        slot_b = jnp.clip(m_b, 0, M - 1) % R
+        a_saved = lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
+        y_mb = lax.dynamic_index_in_dim(
+            y_microbatches, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
+
+        out, vjp_fn = jax.vjp(stage_fn, stage_params, a_saved)
+        loss_mb, dout = jax.value_and_grad(loss_fn)(out, y_mb)
+        cot_eff = jnp.where(is_last, dout, recv_cot)
+        dp, da = vjp_fn(cot_eff)
+
+        gacc = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(b_on, d, jnp.zeros_like(d)),
+            gacc, dp)
+        lacc = lacc + jnp.where(is_last & b_on, loss_mb, 0.0)
+        dxbuf = jnp.where(
+            (sid == 0) & b_on,
+            lax.dynamic_update_index_in_dim(
+                dxbuf, da, jnp.clip(m_b, 0, M - 1), 0), dxbuf)
+        cot_out = jnp.where(b_on, da, jnp.zeros_like(da))
+
+        return (fwd_out, cot_out, ring, gacc, lacc, dxbuf), None
+
+    init = (
+        _mark_varying(jnp.zeros(mb_shape, dtype), axis_name),
+        _mark_varying(jnp.zeros(mb_shape, dtype), axis_name),
+        _mark_varying(jnp.zeros((R,) + mb_shape, dtype), axis_name),
+        jax.tree_util.tree_map(
+            lambda p: _mark_varying(jnp.zeros_like(p), axis_name),
+            stage_params),
+        _mark_varying(jnp.asarray(0.0, jnp.float32), axis_name),
+        _mark_varying(jnp.zeros((M,) + mb_shape, dtype), axis_name),
+    )
+    (fwd_out, cot_out, ring, gacc, lacc, dxbuf), _ = \
+        lax.scan(step, init, jnp.arange(steps))
+
+    loss = lax.psum(jnp.where(is_last, lacc, 0.0), axis_name) / M
+    grads = jax.tree_util.tree_map(lambda g: g / M, gacc)
+    dx = lax.psum(jnp.where(sid == 0, dxbuf, jnp.zeros_like(dxbuf)),
+                  axis_name) / M
+    return loss, grads, dx
 
 
 def stack_stage_params(per_stage_params):
@@ -154,6 +273,66 @@ class _Pipeline(Operator):
         return a
 
 
+def _make_1f1b_loss(stage_fn, loss_fn, axis_name):
+    """Wrap the 1F1B schedule as a custom-vjp scalar-loss function, so
+    differentiating it hands back the schedule's OWN gradients instead of
+    autodiffing through the scan (which would re-materialise every tick's
+    activations — the exact cost 1F1B exists to avoid)."""
+
+    @jax.custom_vjp
+    def f(params_local, x_mb, y_mb):
+        loss, _, _ = pipeline_1f1b(stage_fn, loss_fn, params_local,
+                                   x_mb, y_mb, axis_name)
+        return loss
+
+    def f_fwd(params_local, x_mb, y_mb):
+        loss, grads, dx = pipeline_1f1b(stage_fn, loss_fn, params_local,
+                                        x_mb, y_mb, axis_name)
+        return loss, (grads, dx, y_mb)
+
+    def f_bwd(res, ct):
+        grads, dx, y_mb = res
+        return (jax.tree_util.tree_map(lambda g: g * ct, grads),
+                dx * ct, jnp.zeros_like(y_mb))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+class _Pipeline1F1B(Operator):
+    """Tape op: (x, y, *stacked_params) -> scalar loss via the 1F1B
+    schedule when the 'pipe' mesh axis is active; sequential identical
+    math otherwise (eager first step / single device)."""
+
+    def __init__(self, stage_apply, loss_fn, n_stages, n_micro,
+                 axis="pipe"):
+        super().__init__()
+        self.stage_apply = stage_apply
+        self.loss_fn = loss_fn
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.axis = axis
+
+    def forward(self, x, y, *stacked):
+        from .communicator import active_axis
+        x_mb = microbatch(x, self.n_micro)
+        y_mb = microbatch(y, self.n_micro)
+        if active_axis(self.axis):
+            assert stacked[0].shape[0] == 1, \
+                f"mesh 'pipe' axis must have degree n_stages=" \
+                f"{self.n_stages}; got param slice {stacked[0].shape}"
+            local = tuple(s[0] for s in stacked)
+            f = _make_1f1b_loss(self.stage_apply, self.loss_fn, self.axis)
+            return f(local, x_mb, y_mb)
+        losses = []
+        for m in range(self.n_micro):
+            a = x_mb[m]
+            for i in range(self.n_stages):
+                a = self.stage_apply(tuple(s[i] for s in stacked), a)
+            losses.append(self.loss_fn(a, y_mb[m]))
+        return jnp.mean(jnp.stack(losses))
+
+
 class PipelineModule(Layer):
     """A pipeline-parallel stack of ``n_stages`` structurally identical
     stages, reachable from the Layer/Model API: drop it into a Model's
@@ -195,3 +374,25 @@ class PipelineModule(Layer):
 
     def _own_params(self):
         return {f"stage_param{j}": t for j, t in enumerate(self._params)}
+
+
+class PipelineModule1F1B(PipelineModule):
+    """Pipeline stack trained with the 1F1B schedule: the per-microbatch
+    loss lives INSIDE the schedule, so ``forward(x, y)`` returns the mean
+    loss directly (activation memory bounded by pipe depth). ``forward(x)``
+    without targets falls back to the GPipe forward for inference."""
+
+    def __init__(self, stage_apply, stage_init, loss_fn, n_stages, n_micro,
+                 axis="pipe"):
+        super().__init__(stage_apply, stage_init, n_stages, n_micro, axis)
+        self.loss_fn = loss_fn
+
+    def initialize(self, x, y=None):
+        super().initialize(x)
+
+    def forward(self, x, y=None):
+        if y is None:
+            return super().forward(x)
+        return _Pipeline1F1B(self.stage_apply, self.loss_fn,
+                             self.n_stages, self.n_micro,
+                             self.axis)(x, y, *self._params)
