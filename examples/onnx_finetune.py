@@ -1,0 +1,100 @@
+"""ONNX interchange example: train a CNN, export to ONNX, reimport it as
+a SONNXModel, and fine-tune the imported graph (the reference's
+examples/onnx/*.py fine-tune pretrained zoo models fetched from the
+network; this environment has no egress, so the same user flow is shown
+on a locally-trained model — the interchange mechanics are identical).
+
+Usage: python examples/onnx_finetune.py [--cpu] [--steps 10]
+"""
+
+import argparse
+import sys
+import tempfile
+import os
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import device, layer, model, opt, sonnx, tensor
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    dev.SetRandSeed(0)
+
+    class Net(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.conv = layer.Conv2d(8, 3, padding=1)
+            self.relu = layer.ReLU()
+            self.pool = layer.MaxPool2d(2, 2)
+            self.flat = layer.Flatten()
+            self.fc = layer.Linear(10)
+
+        def forward(self, x):
+            return self.fc(self.flat(self.pool(self.relu(self.conv(x)))))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            from singa_tpu import autograd
+            loss = autograd.softmax_cross_entropy(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(args.bs, 3, 16, 16).astype(np.float32)
+    labels = rng.randint(0, 10, args.bs)
+    y = np.eye(10)[labels].astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+
+    # 1) pre-train briefly
+    m = Net()
+    m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=True)
+    for i in range(args.steps):
+        out, loss = m(tx, ty)
+    print(f"pretrained: loss {float(np.asarray(loss.data)):.4f}")
+
+    # 2) export to an .onnx file
+    ex = tensor.Tensor(data=x, device=dev, requires_grad=True)
+    onnx_model = sonnx.to_onnx(m, [ex], "cnn")
+    path = os.path.join(tempfile.gettempdir(), "cnn.onnx")
+    sonnx.save(onnx_model, path)
+    print(f"exported {len(onnx_model.graph.node)} nodes -> {path}")
+
+    # 3) reimport and fine-tune the IMPORTED graph
+    loaded = sonnx.load(path)
+
+    class FineTune(sonnx.SONNXModel):
+        def train_one_batch(self, x, y):
+            from singa_tpu import autograd
+            out = self.forward(x)
+            loss = autograd.softmax_cross_entropy(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    ft = FineTune(loaded)
+    ft.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    for i in range(args.steps):
+        out, loss = ft.train_one_batch(tx, ty)
+    acc = float((np.argmax(np.asarray(out.data), 1) == labels).mean())
+    print(f"fine-tuned imported model: loss "
+          f"{float(np.asarray(loss.data)):.4f}, train acc {acc:.2f}")
+
+
+if __name__ == "__main__":
+    main()

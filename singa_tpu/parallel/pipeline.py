@@ -324,13 +324,13 @@ class _Pipeline1F1B(Operator):
             local = tuple(s[0] for s in stacked)
             f = _make_1f1b_loss(self.stage_apply, self.loss_fn, self.axis)
             return f(local, x_mb, y_mb)
-        losses = []
-        for m in range(self.n_micro):
-            a = x_mb[m]
+        def one(xm, ym):
+            a = xm
             for i in range(self.n_stages):
                 a = self.stage_apply(tuple(s[i] for s in stacked), a)
-            losses.append(self.loss_fn(a, y_mb[m]))
-        return jnp.mean(jnp.stack(losses))
+            return self.loss_fn(a, ym)
+        # vmap over microbatches: trace size stays O(n_stages)
+        return jnp.mean(jax.vmap(one)(x_mb, y_mb))
 
 
 class PipelineModule(Layer):

@@ -61,6 +61,11 @@ class TestExamples:
                            "--iters", "2", "--bs", "8"])
         assert "loss" in out.lower() or "d_loss" in out.lower(), out[-500:]
 
+    def test_onnx_finetune(self):
+        out = run_example(["examples/onnx_finetune.py", "--cpu",
+                           "--steps", "3"])
+        assert "fine-tuned imported model" in out, out[-500:]
+
     def test_train_rbm(self):
         out = run_example(["examples/train_rbm.py", "--cpu", "--epochs",
                            "1", "--bs", "16", "--hdim", "32"])

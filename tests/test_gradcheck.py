@@ -1,0 +1,185 @@
+"""Finite-difference gradient checks across the autograd op table
+(the role of reference test/python/test_operation.py's per-op backward
+assertions, done generically: analytic tape grads vs central differences
+on a random projection)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, device
+from singa_tpu.tensor import Tensor
+
+DEV = device.create_cpu_device()
+RNG = np.random.RandomState(7)
+
+
+@pytest.fixture(autouse=True)
+def _training_mode():
+    from singa_tpu.autograd_base import CTX
+    prev = CTX.training
+    CTX.training = True
+    yield
+    CTX.training = prev
+
+
+def gradcheck(fn, arrays, eps=1e-2, rtol=2e-2, atol=2e-3):
+    """fn(*Tensors) -> Tensor. Checks d(sum(w*fn))/d(input) for every
+    input against central differences (f32: generous eps/tolerance)."""
+    def run(raws):
+        ts = [Tensor(data=a.astype(np.float32), device=DEV,
+                     requires_grad=True, stores_grad=True) for a in raws]
+        out = fn(*ts)
+        return ts, out
+
+    ts, out = run(arrays)
+    w = np.asarray(RNG.randn(*out.shape), np.float32)
+    wt = Tensor(data=w, device=DEV, requires_grad=False)
+    s = autograd.reduce_sum(autograd.mul(out, wt), None, 0)
+    for _p, _g in autograd.backward(s):
+        pass
+
+    def scalar(raws):
+        ts2 = [Tensor(data=a.astype(np.float32), device=DEV,
+                      requires_grad=True, stores_grad=True) for a in raws]
+        o = fn(*ts2)
+        return float(np.sum(np.asarray(o.data) * w))
+
+    for i, t in enumerate(ts):
+        if t.grad is None:
+            continue
+        analytic = np.asarray(t.grad.data)
+        a = arrays[i].astype(np.float64)
+        num = np.zeros_like(a)
+        it = np.nditer(a, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            ap, am = a.copy(), a.copy()
+            ap[idx] += eps
+            am[idx] -= eps
+            raws_p = [x if j != i else ap for j, x in enumerate(arrays)]
+            raws_m = [x if j != i else am for j, x in enumerate(arrays)]
+            num[idx] = (scalar(raws_p) - scalar(raws_m)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(
+            analytic, num, rtol=rtol, atol=atol,
+            err_msg=f"input {i} of {getattr(fn, '__name__', fn)}")
+
+
+def a(*shape, lo=-1.5, hi=1.5):
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+UNARY = [
+    ("sin", lambda x: autograd.sin(x), a(3, 4)),
+    ("cosh", lambda x: autograd.cosh(x), a(3, 4)),
+    ("tanh", lambda x: autograd.tanh(x), a(3, 4)),
+    ("sigmoid", lambda x: autograd.sigmoid(x), a(3, 4)),
+    ("softplus", lambda x: autograd.softplus(x), a(3, 4)),
+    ("erf", lambda x: autograd.erf(x), a(3, 4)),
+    ("log", lambda x: autograd.log(x), a(3, 4, lo=0.5, hi=2.0)),
+    ("sqrt", lambda x: autograd.sqrt(x), a(3, 4, lo=0.5, hi=2.0)),
+    ("elu", lambda x: autograd.elu(x, 0.9), a(3, 4)),
+    ("selu", lambda x: autograd.selu(x), a(3, 4)),
+    ("hardsigmoid", lambda x: autograd.hardsigmoid(x), a(3, 4)),
+    ("gelu", lambda x: autograd.gelu(x), a(3, 4)),
+    ("softmax", lambda x: autograd.softmax(x, -1), a(3, 5)),
+    ("logsoftmax_chain", lambda x: autograd.log(
+        autograd.softmax(x, -1)), a(2, 4)),
+    ("reduce_mean_axes", lambda x: autograd.reduce_mean(x, [1], 1),
+     a(3, 4, 2)),
+    ("reduce_sum_axes", lambda x: autograd.reduce_sum(x, [0, 2], 0),
+     a(3, 4, 2)),
+    ("transpose_reshape", lambda x: autograd.reshape(
+        autograd.transpose(x, (1, 0, 2)), (4, 6)), a(3, 4, 2)),
+    ("lrn", lambda x: autograd.lrn(x, 3, 0.1, 0.75, 1.0), a(2, 5, 2, 2)),
+    ("globalavgpool", lambda x: autograd.globalaveragepool(x),
+     a(2, 3, 4, 4)),
+    ("flatten", lambda x: autograd.flatten(x), a(2, 3, 2)),
+    ("slice_step", lambda x: autograd.slice(x, [0], [4], [1], [2]),
+     a(3, 5)),
+    ("pad", lambda x: autograd.pad(x, "constant", [0, 1, 0, 1], 0.5),
+     a(2, 3)),
+    ("tile", lambda x: autograd.tile(x, [2, 1]), a(2, 3)),
+]
+
+BINARY = [
+    ("matmul", lambda x, y: autograd.matmul(x, y), (a(3, 4), a(4, 2))),
+    ("gemm_trans", lambda x, y: autograd.gemm(x, y, None, 0.5, 0.0, 1, 1),
+     (a(4, 3), a(2, 4))),
+    ("div", lambda x, y: autograd.div(x, y),
+     (a(3, 4), a(3, 4, lo=0.5, hi=2.0))),
+    ("pow", lambda x, y: autograd.pow(x, y),
+     (a(3, 4, lo=0.5, hi=2.0), a(3, 4))),
+    ("prelu", lambda x, s: autograd.prelu(x, s), (a(3, 4), a(3, 4,
+                                                             lo=0.1,
+                                                             hi=0.9))),
+    ("cossim", lambda x, y: autograd.cossim(x, y), (a(3, 5), a(3, 5))),
+    ("sub", lambda x, y: autograd.sub(x, y), (a(3, 4), a(3, 4))),
+]
+
+
+class TestGradcheck:
+    @pytest.mark.parametrize("name,fn,arr", UNARY,
+                             ids=[u[0] for u in UNARY])
+    def test_unary(self, name, fn, arr):
+        gradcheck(fn, [arr])
+
+    @pytest.mark.parametrize("name,fn,arrs", BINARY,
+                             ids=[b[0] for b in BINARY])
+    def test_binary(self, name, fn, arrs):
+        gradcheck(fn, list(arrs))
+
+    def test_conv2d(self):
+        from singa_tpu.ops.conv import ConvHandle, conv2d
+        x = a(2, 2, 5, 5)
+        W = a(3, 2, 3, 3)
+        b = a(3)
+        h = ConvHandle(x, 3, 1, 1, 2, 3)
+        gradcheck(lambda xx, ww, bb: conv2d(h, xx, ww, bb), [x, W, b])
+
+    def test_conv_transpose2d(self):
+        from singa_tpu.ops.conv import (ConvTransposeHandle,
+                                        conv_transpose2d)
+        x = a(1, 2, 4, 4)
+        W = a(2, 3, 3, 3)
+        h = ConvTransposeHandle(x, 3, 2, 1, 2, 3, output_padding=1)
+        gradcheck(lambda xx, ww: conv_transpose2d(h, xx, ww), [x, W])
+
+    def test_avgpool(self):
+        from singa_tpu.ops.pooling import PoolingHandle, pooling_2d
+        x = a(2, 2, 4, 4)
+        h = PoolingHandle(x, 2, 2, 0, is_max=False)
+        gradcheck(lambda xx: pooling_2d(h, xx), [x])
+
+    def test_layernorm(self):
+        x = a(3, 6)
+        scale = a(6, lo=0.5, hi=1.5)
+        bias = a(6)
+        gradcheck(lambda xx, s, b: autograd.layernorm(xx, s, b),
+                  [x, scale, bias], rtol=3e-2, atol=3e-3)
+
+    def test_softmax_cross_entropy(self):
+        x = a(4, 5)
+        y = np.eye(5, dtype=np.float32)[RNG.randint(0, 5, 4)]
+
+        def fn(xx):
+            yt = Tensor(data=y, device=DEV, requires_grad=False)
+            return autograd.softmax_cross_entropy(xx, yt)
+        gradcheck(fn, [x])
+
+    def test_mse_loss(self):
+        """Targets are stop-gradient (reference MSE backward computes only
+        dx), so only the prediction input is checked."""
+        x = a(4, 3)
+        y = a(4, 3)
+
+        def fn(xx):
+            yt = Tensor(data=y, device=DEV, requires_grad=False)
+            return autograd.mse_loss(xx, yt)
+        gradcheck(fn, [x])
+
+    def test_attention(self):
+        from singa_tpu.ops.attention import attention
+        q, k, v = a(1, 2, 4, 3), a(1, 2, 4, 3), a(1, 2, 4, 3)
+        gradcheck(lambda qq, kk, vv: attention(qq, kk, vv, causal=True),
+                  [q, k, v], rtol=3e-2, atol=3e-3)
