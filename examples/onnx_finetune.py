@@ -89,6 +89,7 @@ def main():
 
     ft = FineTune(loaded)
     ft.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    ft.train(True)   # enable the tape (don't rely on ambient mode)
     for i in range(args.steps):
         out, loss = ft.train_one_batch(tx, ty)
     acc = float((np.argmax(np.asarray(out.data), 1) == labels).mean())

@@ -78,7 +78,17 @@ class Device:
         self.SetRandSeed(seed)
 
     def rand_key(self):
-        """Split and return a fresh PRNG key (functional curand equivalent)."""
+        """Split and return a fresh PRNG key (functional curand equivalent).
+
+        Self-heals if a traced consumer leaked its in-trace key into this
+        host-side state (the stored key would be a dead tracer): hops to
+        a fresh per-device stream (device identity + leak counter)."""
+        if isinstance(self._key, jax.core.Tracer) and \
+                not isinstance(jnp.zeros(()), jax.core.Tracer):
+            self._leaks = getattr(self, "_leaks", 0) + 1
+            self._key = jax.random.fold_in(
+                jax.random.PRNGKey(id(self) & 0x7fffffff),
+                0x5eed + self._leaks)
         self._key, sub = jax.random.split(self._key)
         return sub
 

@@ -73,11 +73,18 @@ class Layer:
     def __call__(self, *args, **kwargs):
         if not self._initialized:
             # deferred, shape-inferring init (reference LayerMeta: graph is
-            # disabled during init so param creation is not taped)
+            # disabled during init so param creation is not taped). Under
+            # an abstract dry run (Model._abstract_call's eval_shape) the
+            # compile-time-eval scope makes param creation execute
+            # CONCRETELY — inits read only static shapes and concrete rng
+            # keys, so real weights materialise while the surrounding
+            # forward stays traced.
+            import jax as _jax
             prev = CTX.training
             CTX.training = False
             try:
-                self.initialize(*args, **kwargs)
+                with _jax.ensure_compile_time_eval():
+                    self.initialize(*args, **kwargs)
             finally:
                 CTX.training = prev
             self._initialized = True
@@ -96,16 +103,24 @@ class Layer:
         """Override: dict of local state name -> Tensor (includes params)."""
         return dict(self._own_params())
 
+    def _own(self, which):
+        """_own_params/_own_states tolerant of deferred init: a layer
+        whose ``initialize`` has not run yet simply has no state."""
+        try:
+            return which()
+        except AttributeError:
+            return {}
+
     def get_params(self):
         params = {f"{self.name}{self.sep}{k}": v
-                  for k, v in self._own_params().items()}
+                  for k, v in self._own(self._own_params).items()}
         for _, sub in self._sublayers():
             for k, v in sub.get_params().items():
                 params[f"{self.name}{self.sep}{k}"] = v
         return params
 
     def set_params(self, params):
-        for k, v in self._own_params().items():
+        for k, v in self._own(self._own_params).items():
             full = f"{self.name}{self.sep}{k}"
             if full in params:
                 v.copy_from(params[full])
@@ -116,14 +131,14 @@ class Layer:
 
     def get_states(self):
         states = {f"{self.name}{self.sep}{k}": v
-                  for k, v in self._own_states().items()}
+                  for k, v in self._own(self._own_states).items()}
         for _, sub in self._sublayers():
             for k, v in sub.get_states().items():
                 states[f"{self.name}{self.sep}{k}"] = v
         return states
 
     def set_states(self, states):
-        for k, v in self._own_states().items():
+        for k, v in self._own(self._own_states).items():
             full = f"{self.name}{self.sep}{k}"
             if full in states:
                 v.copy_from(states[full])

@@ -134,6 +134,17 @@ class Model(Layer):
         prev = CTX.training
         CTX.training = False
         try:
+            # abstract dry run: layer.initialize still executes (params
+            # materialise concretely) but the inter-layer compute traces
+            # with zero device work — on a network-tunneled accelerator
+            # an eager dry run costs one round trip PER OP
+            self._abstract_call(inputs, lambda: self.forward(*inputs))
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"abstract dry run failed ({type(e).__name__}: {e}); "
+                "falling back to an eager forward — host-side effects in "
+                "forward may have run twice", stacklevel=2)
             self.forward(*inputs)
         finally:
             CTX.training = prev
@@ -147,6 +158,65 @@ class Model(Layer):
             self._dist = opt
         self._compiled = True
         self.train(is_train)
+
+    # -- abstract (zero-compute) materialisation ---------------------------
+    def _abstract_call(self, inputs, body):
+        """Run ``body`` under ``jax.eval_shape`` with the input tensors'
+        payloads abstracted, so python side effects (layer init, optimizer
+        aux creation) happen while NO device computation is issued; any
+        pre-existing state the body mutated is restored afterwards and
+        tracer-valued leftovers are replaced with zeros.
+
+        This is the reference's buffered-first-call semantics
+        (model.py:56-91: the first call records, it does not execute) —
+        and on a network-tunneled accelerator it turns O(ops) round trips
+        into none. RNG keys consumed by the run (param inits, dropout)
+        stay consumed, exactly as an eager first call would leave them.
+        Returns the body result with concrete zero-filled leaves
+        (shapes/dtypes preserved)."""
+        from .device import get_default_device
+        snapshot = [(t, t.data) for t in self._state_tensors()]
+        datas = [t.data for t in inputs]
+        devs = list({id(self.dev): self.dev,
+                     id(get_default_device()): get_default_device()
+                     }.values())
+        prev_rngs = [d._get_rng_state() for d in devs]
+        captured = {}
+
+        def absfn(arrs):
+            for t, a in zip(inputs, arrs):
+                t.data = a
+            res = body()
+            leaves = []
+            captured["tree"] = _flatten(res, leaves)
+            return leaves
+
+        try:
+            out_avals = jax.eval_shape(
+                absfn, [jax.ShapeDtypeStruct(np.shape(d), d.dtype)
+                        for d in datas])
+        finally:
+            for t, d in zip(inputs, datas):
+                t.data = d
+            for t, d in snapshot:
+                t.data = d
+            # state born during the abstract run (optimizer aux, freshly
+            # initialised layer stats) may hold dead tracers: zero it
+            for t in self._state_tensors():
+                if isinstance(t.data, jax.core.Tracer):
+                    t.data = np.zeros(t.data.shape,
+                                      t.data.dtype)
+            # keys consumed concretely (param inits) stay consumed; if
+            # TRACED draws (dropout) left a device rng holding a dead
+            # tracer, hop each such device to its OWN fresh stream (a
+            # rewind would replay init keys; sharing one repaired key
+            # would correlate the devices' draws). Ops fall back to the
+            # process-wide default device, so it is covered too.
+            for i, (d, prev) in enumerate(zip(devs, prev_rngs)):
+                if isinstance(d._get_rng_state(), jax.core.Tracer):
+                    d._set_rng_state(jax.random.fold_in(prev, 0x5eed + i))
+        leaves = [np.zeros(a.shape, a.dtype) for a in out_avals]
+        return _unflatten(captured["tree"], list(leaves), self.dev)
 
     # -- state plumbing ----------------------------------------------------
     def _state_tensors(self):
@@ -306,11 +376,33 @@ class Model(Layer):
         if not self.graph_mode:
             return self.train_one_batch(*args)
         if not self._step_ready:
-            # first call: eager, materialises params + optimizer aux states
-            res = self.train_one_batch(*args)
-            self._step_ready = True
-            self._eager_out = res
-            return res
+            # first call materialises params + optimizer aux states.
+            # Preferred: abstractly (zero device compute — the reference's
+            # buffered first call, model.py:56-91); then THIS call already
+            # runs compiled. Fallback: the eager step (host-side ops or
+            # data-dependent python in train_one_batch).
+            import os
+            # verbosity>=2 requests per-op wall times, which only the
+            # eager dispatch can record (reference per-node timing)
+            if self.dev.verbosity < 2 and \
+                    os.environ.get("SINGA_EAGER_FIRST_STEP", "0") != "1":
+                try:
+                    tensor_args = [a for a in args if isinstance(a, Tensor)]
+                    self._eager_out = self._abstract_call(
+                        tensor_args, lambda: self.train_one_batch(*args))
+                    self._step_ready = True
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        "abstract first-step rehearsal failed "
+                        f"({type(e).__name__}: {e}); falling back to an "
+                        "eager first step — note any host-side effects in "
+                        "train_one_batch may have run twice", stacklevel=3)
+            if not self._step_ready:
+                res = self.train_one_batch(*args)
+                self._step_ready = True
+                self._eager_out = res
+                return res
         input_arrays, layout = self._split_step_args(args)
         try:
             hash(layout)

@@ -61,10 +61,10 @@ class TestPerOpProfiling:
 
     def test_compiled_step_timing_honors_skip_iteration(self):
         m, dev, tx, ty = make_model(verbosity=1, skip=3)
-        for _ in range(5):   # call 1 eager + 4 compiled steps
+        for _ in range(5):   # all 5 are compiled (abstract first call)
             m(tx, ty)
-        # compiled steps 1..4; only those past skip=3 are recorded
-        assert dev.time_profiling["train_one_batch"][0] == 1
+        # only the steps past skip=3 are recorded
+        assert dev.time_profiling["train_one_batch"][0] == 2
 
     def test_print_time_profiling_table(self, capsys):
         m, dev, tx, ty = make_model(verbosity=2)
