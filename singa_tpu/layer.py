@@ -298,7 +298,13 @@ class Conv2d(Layer):
             else (self.kernel_size, self.kernel_size)
         w_shape = (self.nb_kernels, self.in_channels // self.group, *ks)
         self.W = _param(w_shape, dev, dtype=x.dtype)
-        std = math.sqrt(2.0 / (ks[0] * ks[1] * self.nb_kernels))
+        # reference layer.py:636-638: glorot-style over fan_in+fan_out so
+        # channel-reducing convs (e.g. squeeze layers) don't inflate
+        # variance; fan_out is per-group so depthwise convs aren't
+        # under-initialized by the total channel count
+        std = math.sqrt(
+            2.0 / (w_shape[1] * ks[0] * ks[1]
+                   + self.nb_kernels / self.group))
         self.W.gaussian(0.0, std)
         if self.bias:
             self.b = _param((self.nb_kernels,), dev, dtype=x.dtype)
@@ -354,7 +360,9 @@ class ConvTranspose2d(Layer):
             else (self.kernel_size, self.kernel_size)
         w_shape = (self.in_channels, self.nb_kernels // self.group, *ks)
         self.W = _param(w_shape, dev, dtype=x.dtype)
-        std = math.sqrt(2.0 / (ks[0] * ks[1] * self.nb_kernels))
+        std = math.sqrt(
+            2.0 / (w_shape[1] * ks[0] * ks[1]
+                   + self.nb_kernels / self.group))
         self.W.gaussian(0.0, std)
         if self.bias:
             self.b = _param((self.nb_kernels,), dev, dtype=x.dtype)

@@ -137,6 +137,22 @@ class TestGradcheck:
         h = ConvHandle(x, 3, 1, 1, 2, 3)
         gradcheck(lambda xx, ww, bb: conv2d(h, xx, ww, bb), [x, W, b])
 
+    def test_conv2d_grouped(self):
+        from singa_tpu.ops.conv import ConvHandle, conv2d
+        x = a(2, 4, 5, 5)
+        W = a(6, 2, 3, 3)        # 6 out channels, group=2 -> 2 in each
+        b = a(6)
+        h = ConvHandle(x, 3, 1, 1, 4, 6, group=2)
+        gradcheck(lambda xx, ww, bb: conv2d(h, xx, ww, bb), [x, W, b])
+
+    def test_conv2d_depthwise(self):
+        from singa_tpu.ops.conv import ConvHandle, conv2d
+        x = a(2, 4, 5, 5)
+        W = a(4, 1, 3, 3)        # depthwise: group == channels
+        b = a(4)
+        h = ConvHandle(x, 3, 1, 1, 4, 4, group=4)
+        gradcheck(lambda xx, ww, bb: conv2d(h, xx, ww, bb), [x, W, b])
+
     def test_conv_transpose2d(self):
         from singa_tpu.ops.conv import (ConvTransposeHandle,
                                         conv_transpose2d)

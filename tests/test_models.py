@@ -6,7 +6,8 @@ import pytest
 
 from singa_tpu import autograd, device, opt, tensor
 from singa_tpu.models import (alexnet, char_rnn, cnn, gan, mlp, qabot,
-                              rbm, resnet, xceptionnet)
+                              rbm, resnet, xceptionnet, vgg, squeezenet,
+                              mobilenet, densenet, shufflenet)
 from singa_tpu.tensor import Tensor
 
 
@@ -169,3 +170,56 @@ class TestZooSmoke:
         _, loss1 = m(x, y)
         _, loss2 = m(x, y)
         assert float(loss2.data) < float(loss1.data) * 1.5  # sane step
+
+
+class TestImageNetZoo:
+    """New-in-this-framework native builds of the families the reference
+    ships as ONNX zoo examples (examples/onnx/{vgg16,squeezenet,mobilenet,
+    densenet121,shufflenetv2}.py): build, compile in graph mode, train a
+    few steps, loss stays finite and parameters move."""
+
+    @pytest.mark.parametrize("name,factory,size", [
+        ("vgg11bn",
+         lambda: vgg.create_model(depth=11, batch_norm=True), 32),
+        ("squeezenet11",
+         lambda: squeezenet.create_model(version="1.1"), 64),
+        ("mobilenetv2",
+         lambda: mobilenet.create_model(width_mult=0.25), 32),
+        ("shufflenetv2",
+         lambda: shufflenet.create_model(width="0.5"), 32),
+        ("densenet-tiny",
+         lambda: densenet.create_model(block_config=(2, 2),
+                                       growth_rate=8,
+                                       num_init_features=16), 32),
+    ])
+    def test_train_steps(self, name, factory, size):
+        rng = np.random.RandomState(3)
+        m = factory()
+        m.set_optimizer(opt.SGD(lr=0.01, momentum=0.9))
+        x = t(rng.randn(2, 3, size, size))
+        y = t(np.eye(10, dtype=np.float32)[rng.randint(0, 10, 2)])
+        m.compile([x], is_train=True, use_graph=True)
+        # trainable params only — BN running stats would move from the
+        # forward pass alone and mask a broken optimizer update
+        before = {k: np.asarray(v.data).copy()
+                  for k, v in m.get_params().items()}
+        losses = []
+        for _ in range(3):
+            out, loss = m(x, y)
+            losses.append(float(loss.numpy()))
+        assert out.shape == (2, 10)
+        assert all(np.isfinite(l) for l in losses), (name, losses)
+        after = m.get_params()
+        moved = [k for k in before
+                 if not np.array_equal(before[k], np.asarray(after[k].data))]
+        assert moved, f"{name}: no parameter moved"
+
+    def test_squeezenet_init_scale(self):
+        """Channel-reducing squeeze convs must not inflate activation
+        variance (glorot-style conv init, reference layer.py:636-638)."""
+        rng = np.random.RandomState(0)
+        m = squeezenet.create_model()
+        x = t(rng.randn(2, 3, 64, 64))
+        m.compile([x], is_train=False, use_graph=False)
+        out = m.forward(x)
+        assert float(np.abs(np.asarray(out.data)).max()) < 100.0
