@@ -1,0 +1,567 @@
+// TCP message-passing layer: the tpu-native peer of the reference's
+// EndPoint network (include/singa/io/network.h:62-136,
+// src/io/network/endpoint.cc) — a control-plane side channel for
+// multi-host deployments (the data plane is XLA collectives over ICI/DCN).
+//
+// Design differences from the reference (which uses libev): one background
+// thread multiplexes every connection with poll(2); messages are framed as
+//   u8 type | u32 id | u64 msize | u64 psize | meta bytes | payload bytes
+// DATA messages are acknowledged with an ACK frame carrying the same id so
+// senders can await delivery (sg_ep_pending); C ABI for ctypes binding.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define SG_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+constexpr uint8_t kMsgData = 0;
+constexpr uint8_t kMsgAck = 1;
+constexpr size_t kHeaderSize = 1 + 4 + 8 + 8;
+
+enum ConnStatus { kConnInit = 0, kConnPending = 1, kConnEst = 2,
+                  kConnError = 3 };
+
+struct Msg {
+  uint8_t type = kMsgData;
+  uint32_t id = 0;
+  std::string meta, payload;
+};
+
+std::string frame(const Msg& m) {
+  std::string out;
+  out.reserve(kHeaderSize + m.meta.size() + m.payload.size());
+  out.push_back(static_cast<char>(m.type));
+  uint32_t id = m.id;
+  uint64_t ms = m.meta.size(), ps = m.payload.size();
+  out.append(reinterpret_cast<char*>(&id), 4);
+  out.append(reinterpret_cast<char*>(&ms), 8);
+  out.append(reinterpret_cast<char*>(&ps), 8);
+  out += m.meta;
+  out += m.payload;
+  return out;
+}
+
+// A peer claiming a single frame larger than this is treated as a protocol
+// violation (malformed/hostile client) and its connection is dropped — the
+// sizes come off the wire and must never drive an allocation unchecked.
+constexpr uint64_t kMaxFrameBody = 1ull << 30;  // 1 GiB each for meta/payload
+
+struct EndPoint {
+  int fd = -1;
+  int status = kConnInit;
+  uint32_t next_id = 1;
+  int pending_acks = 0;            // sent DATA frames not yet ACKed
+  int waiters = 0;                 // threads blocked on cv right now
+  std::deque<std::string> sendq;   // framed bytes awaiting the socket
+  size_t send_off = 0;             // offset into sendq.front()
+  std::deque<Msg> recvq;
+  std::condition_variable cv;
+  // wire-read state machine
+  std::string rbuf;
+  // identity for diagnostics
+  std::string peer;
+};
+
+struct Net {
+  int listen_fd = -1;
+  int port = 0;
+  int wake[2] = {-1, -1};
+  std::thread thr;
+  std::atomic<bool> stop{false};
+  bool closing = false;            // guarded by mtx; wakes blocked waiters
+  std::mutex mtx;                  // guards eps, new_eps, every EndPoint
+  std::map<int64_t, EndPoint*> eps;
+  std::vector<EndPoint*> graveyard;  // closed endpoints; freed in ~Net so
+                                     // woken waiters never touch freed mem
+  std::deque<int64_t> new_eps;     // inbound endpoints not yet claimed
+  std::condition_variable new_cv;
+  int64_t next_handle = 1;
+
+  ~Net() {
+    for (auto& kv : eps) {
+      if (kv.second->fd >= 0) ::close(kv.second->fd);
+      delete kv.second;
+    }
+    for (auto* ep : graveyard) delete ep;
+    if (listen_fd >= 0) ::close(listen_fd);
+    if (wake[0] >= 0) ::close(wake[0]);
+    if (wake[1] >= 0) ::close(wake[1]);
+  }
+
+  void poke() { char c = 1; (void)!::write(wake[1], &c, 1); }
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void mark_error(EndPoint* ep);
+
+// Parse as many complete frames out of ep->rbuf as possible.
+// DATA frames go to recvq (and enqueue an ACK); ACK frames decrement
+// pending_acks. Caller holds net->mtx.
+void drain_frames(Net* net, EndPoint* ep) {
+  for (;;) {
+    if (ep->rbuf.size() < kHeaderSize) return;
+    const char* p = ep->rbuf.data();
+    uint8_t type = static_cast<uint8_t>(p[0]);
+    uint32_t id;
+    uint64_t ms, ps;
+    std::memcpy(&id, p + 1, 4);
+    std::memcpy(&ms, p + 5, 8);
+    std::memcpy(&ps, p + 13, 8);
+    if (type != kMsgData && type != kMsgAck) {
+      // not our protocol (e.g. a stray HTTP client) — drop immediately
+      // instead of buffering garbage while waiting for a bogus frame
+      mark_error(ep);
+      return;
+    }
+    if (ms > kMaxFrameBody || ps > kMaxFrameBody) {
+      // hostile or corrupt frame: sizes would wrap/overallocate
+      mark_error(ep);
+      return;
+    }
+    size_t total = kHeaderSize + static_cast<size_t>(ms) +
+                   static_cast<size_t>(ps);
+    if (ep->rbuf.size() < total) return;
+    if (type == kMsgAck) {
+      if (ep->pending_acks > 0) --ep->pending_acks;
+      ep->cv.notify_all();
+    } else {
+      Msg m;
+      m.type = type;
+      m.id = id;
+      m.meta.assign(p + kHeaderSize, ms);
+      m.payload.assign(p + kHeaderSize + ms, ps);
+      ep->recvq.push_back(std::move(m));
+      Msg ack;
+      ack.type = kMsgAck;
+      ack.id = id;
+      ep->sendq.push_back(frame(ack));
+      ep->cv.notify_all();
+    }
+    ep->rbuf.erase(0, total);
+  }
+}
+
+void mark_error(EndPoint* ep) {
+  if (ep->fd >= 0) ::close(ep->fd);
+  ep->fd = -1;
+  ep->status = kConnError;
+  ep->cv.notify_all();
+}
+
+void io_loop(Net* net) {
+  std::vector<pollfd> pfds;
+  std::vector<EndPoint*> pfd_eps;
+  char buf[1 << 16];
+  for (;;) {
+    if (net->stop.load()) return;
+    pfds.clear();
+    pfd_eps.clear();
+    pfds.push_back({net->wake[0], POLLIN, 0});
+    pfd_eps.push_back(nullptr);
+    if (net->listen_fd >= 0) {
+      pfds.push_back({net->listen_fd, POLLIN, 0});
+      pfd_eps.push_back(nullptr);
+    }
+    {
+      std::lock_guard<std::mutex> lk(net->mtx);
+      for (auto& kv : net->eps) {
+        EndPoint* ep = kv.second;
+        if (ep->fd < 0) continue;
+        short ev = POLLIN;
+        if (!ep->sendq.empty() || ep->status == kConnPending) ev |= POLLOUT;
+        pfds.push_back({ep->fd, ev, 0});
+        pfd_eps.push_back(ep);
+      }
+    }
+    int rc = ::poll(pfds.data(), pfds.size(), 200);
+    if (rc < 0 && errno != EINTR) return;
+    if (net->stop.load()) return;
+    for (size_t i = 0; i < pfds.size(); ++i) {
+      if (!pfds[i].revents) continue;
+      if (pfds[i].fd == net->wake[0]) {
+        (void)!::read(net->wake[0], buf, sizeof(buf));
+        continue;
+      }
+      if (net->listen_fd >= 0 && pfds[i].fd == net->listen_fd) {
+        sockaddr_in cli{};
+        socklen_t len = sizeof(cli);
+        int cfd = ::accept(net->listen_fd,
+                           reinterpret_cast<sockaddr*>(&cli), &len);
+        if (cfd >= 0) {
+          set_nonblock(cfd);
+          set_nodelay(cfd);
+          auto* ep = new EndPoint();
+          ep->fd = cfd;
+          ep->status = kConnEst;
+          char ipbuf[64];
+          inet_ntop(AF_INET, &cli.sin_addr, ipbuf, sizeof(ipbuf));
+          ep->peer = std::string(ipbuf) + ":" +
+                     std::to_string(ntohs(cli.sin_port));
+          std::lock_guard<std::mutex> lk(net->mtx);
+          int64_t h = net->next_handle++;
+          net->eps[h] = ep;
+          net->new_eps.push_back(h);
+          net->new_cv.notify_all();
+        }
+        continue;
+      }
+      EndPoint* ep = pfd_eps[i];
+      if (!ep) continue;
+      std::lock_guard<std::mutex> lk(net->mtx);
+      if (ep->fd < 0) continue;
+      if (pfds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) {
+        // flush whatever was readable before the peer closed
+        ssize_t n;
+        while ((n = ::read(ep->fd, buf, sizeof(buf))) > 0)
+          ep->rbuf.append(buf, n);
+        drain_frames(net, ep);
+        mark_error(ep);
+        continue;
+      }
+      if (ep->status == kConnPending && (pfds[i].revents & POLLOUT)) {
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(ep->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+        if (err != 0) {
+          mark_error(ep);
+          continue;
+        }
+        ep->status = kConnEst;
+        ep->cv.notify_all();
+      }
+      if (pfds[i].revents & POLLIN) {
+        ssize_t n;
+        bool closed = false;
+        while ((n = ::read(ep->fd, buf, sizeof(buf))) > 0)
+          ep->rbuf.append(buf, n);
+        if (n == 0) closed = true;
+        if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) closed = true;
+        drain_frames(net, ep);
+        if (closed) {
+          mark_error(ep);
+          continue;
+        }
+      }
+      if ((pfds[i].revents & POLLOUT) && ep->status == kConnEst) {
+        while (!ep->sendq.empty()) {
+          const std::string& front = ep->sendq.front();
+          ssize_t n = ::write(ep->fd, front.data() + ep->send_off,
+                              front.size() - ep->send_off);
+          if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            mark_error(ep);
+            break;
+          }
+          ep->send_off += n;
+          if (ep->send_off == front.size()) {
+            ep->sendq.pop_front();
+            ep->send_off = 0;
+          }
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+SG_EXPORT void* sg_net_create(int port) {
+  auto* net = new Net();
+  if (::pipe(net->wake) != 0) {
+    delete net;
+    return nullptr;
+  }
+  set_nonblock(net->wake[0]);
+  if (port >= 0) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(fd, 64) != 0) {
+      ::close(fd);
+      delete net;
+      return nullptr;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    net->port = ntohs(addr.sin_port);
+    set_nonblock(fd);
+    net->listen_fd = fd;
+  }
+  net->thr = std::thread(io_loop, net);
+  return net;
+}
+
+SG_EXPORT int sg_net_port(void* h) {
+  return static_cast<Net*>(h)->port;
+}
+
+SG_EXPORT void sg_net_destroy(void* h) {
+  auto* net = static_cast<Net*>(h);
+  {
+    // wake every blocked recv/drain/connect/accept and wait for them to
+    // leave before tearing the Net down (no use-after-free on close race)
+    std::unique_lock<std::mutex> lk(net->mtx);
+    net->closing = true;
+    net->new_cv.notify_all();
+    for (auto& kv : net->eps) kv.second->cv.notify_all();
+    for (int spin = 0; spin < 100; ++spin) {
+      bool busy = false;
+      for (auto& kv : net->eps)
+        if (kv.second->waiters > 0) busy = true;
+      for (auto* ep : net->graveyard)
+        if (ep->waiters > 0) busy = true;
+      if (!busy) break;
+      lk.unlock();
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      lk.lock();
+      for (auto& kv : net->eps) kv.second->cv.notify_all();
+    }
+  }
+  net->stop.store(true);
+  net->poke();
+  if (net->thr.joinable()) net->thr.join();
+  delete net;
+}
+
+// Connect to host:port. The connect is NON-blocking — the io thread
+// completes it via the kConnPending -> POLLOUT -> SO_ERROR path — and this
+// call waits (with retries, reference MAX_RETRY_CNT) for establishment.
+// Returns an endpoint handle > 0, or 0 on failure.
+SG_EXPORT int64_t sg_net_connect(void* h, const char* host, int port) {
+  auto* net = static_cast<Net*>(h);
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string ports = std::to_string(port);
+  if (getaddrinfo(host, ports.c_str(), &hints, &res) != 0 || !res) return 0;
+  int64_t handle = 0;
+  for (int attempt = 0; attempt < 3 && handle == 0; ++attempt) {
+    if (attempt > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(50 << attempt));
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) break;
+    set_nonblock(fd);
+    set_nodelay(fd);
+    int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+    if (rc != 0 && errno != EINPROGRESS) {
+      ::close(fd);
+      continue;
+    }
+    auto* ep = new EndPoint();
+    ep->fd = fd;
+    ep->status = rc == 0 ? kConnEst : kConnPending;
+    ep->peer = std::string(host) + ":" + std::to_string(port);
+    std::unique_lock<std::mutex> lk(net->mtx);
+    int64_t cand = net->next_handle++;
+    net->eps[cand] = ep;
+    net->poke();
+    // wait for the io thread to finish the handshake
+    ++ep->waiters;
+    ep->cv.wait_for(lk, std::chrono::seconds(5), [&] {
+      return ep->status != kConnPending || net->closing;
+    });
+    --ep->waiters;
+    if (ep->status == kConnEst) {
+      handle = cand;
+    } else {
+      // failed attempt: retire the endpoint and retry
+      if (ep->fd >= 0) ::close(ep->fd);
+      ep->fd = -1;
+      ep->status = kConnError;
+      net->eps.erase(cand);
+      net->graveyard.push_back(ep);
+      if (net->closing) break;
+    }
+  }
+  freeaddrinfo(res);
+  return handle;
+}
+
+// Close one endpoint: drop its socket and queues and retire it. Any thread
+// blocked in recv/drain wakes with an error. The EndPoint struct itself is
+// kept on a graveyard until sg_net_destroy so waiters never race a free.
+SG_EXPORT void sg_ep_close(void* h, int64_t ep_h) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return;
+  EndPoint* ep = it->second;
+  mark_error(ep);
+  ep->sendq.clear();
+  ep->recvq.clear();
+  ep->rbuf.clear();
+  ep->rbuf.shrink_to_fit();
+  net->eps.erase(it);
+  net->graveyard.push_back(ep);
+  net->poke();
+}
+
+// Claim the next inbound endpoint (created by a peer's connect), waiting
+// up to timeout_ms. Returns 0 on timeout. (reference
+// EndPointFactory::getNewEps)
+SG_EXPORT int64_t sg_net_accept_ep(void* h, int timeout_ms) {
+  auto* net = static_cast<Net*>(h);
+  std::unique_lock<std::mutex> lk(net->mtx);
+  if (!net->new_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                            [&] {
+                              return !net->new_eps.empty() || net->closing;
+                            }) ||
+      net->new_eps.empty())
+    return 0;
+  int64_t handle = net->new_eps.front();
+  net->new_eps.pop_front();
+  return handle;
+}
+
+// Queue a message for sending; returns its id (>0), or -1 when the
+// endpoint is in error state.
+SG_EXPORT int64_t sg_ep_send(void* h, int64_t ep_h, const void* meta,
+                             uint64_t msize, const void* payload,
+                             uint64_t psize) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return -1;
+  EndPoint* ep = it->second;
+  if (ep->status == kConnError) return -1;
+  Msg m;
+  m.type = kMsgData;
+  m.id = ep->next_id++;
+  if (meta && msize) m.meta.assign(static_cast<const char*>(meta), msize);
+  if (payload && psize)
+    m.payload.assign(static_cast<const char*>(payload), psize);
+  ep->sendq.push_back(frame(m));
+  ++ep->pending_acks;
+  net->poke();
+  return m.id;
+}
+
+// Blocking receive with timeout. On success fills sizes and returns 1 and
+// the caller then copies out via sg_ep_recv_copy; returns 0 on timeout,
+// -1 when the endpoint errored and its queue is empty. The wait/copy pair
+// is not atomic — concurrent receivers on ONE endpoint must serialize
+// (the Python EndPoint wrapper holds a per-endpoint lock across the pair;
+// recv_copy additionally truncates to the caller's capacities so a racy
+// caller can never overflow its buffers).
+SG_EXPORT int sg_ep_recv_wait(void* h, int64_t ep_h, int timeout_ms,
+                              uint64_t* msize, uint64_t* psize) {
+  auto* net = static_cast<Net*>(h);
+  std::unique_lock<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return -1;
+  EndPoint* ep = it->second;
+  ++ep->waiters;
+  bool got = ep->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    return !ep->recvq.empty() || ep->status == kConnError || net->closing;
+  });
+  --ep->waiters;
+  if (!got) return 0;
+  if (ep->recvq.empty()) return -1;
+  *msize = ep->recvq.front().meta.size();
+  *psize = ep->recvq.front().payload.size();
+  return 1;
+}
+
+SG_EXPORT int sg_ep_recv_copy(void* h, int64_t ep_h, void* meta,
+                              uint64_t meta_cap, void* payload,
+                              uint64_t payload_cap) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end() || it->second->recvq.empty()) return -1;
+  Msg& m = it->second->recvq.front();
+  if (meta && !m.meta.empty())
+    std::memcpy(meta, m.meta.data(),
+                m.meta.size() < meta_cap ? m.meta.size() : meta_cap);
+  if (payload && !m.payload.empty())
+    std::memcpy(payload, m.payload.data(),
+                m.payload.size() < payload_cap ? m.payload.size()
+                                               : payload_cap);
+  int truncated = (m.meta.size() > meta_cap ||
+                   m.payload.size() > payload_cap) ? 1 : 0;
+  it->second->recvq.pop_front();
+  return truncated;
+}
+
+// DATA frames sent on this endpoint not yet acknowledged by the peer.
+SG_EXPORT int sg_ep_pending(void* h, int64_t ep_h) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return -1;
+  return it->second->pending_acks;
+}
+
+// Wait until every sent DATA frame has been ACKed (or timeout/error).
+// Returns 1 on fully-acked, 0 on timeout, -1 on error.
+SG_EXPORT int sg_ep_drain(void* h, int64_t ep_h, int timeout_ms) {
+  auto* net = static_cast<Net*>(h);
+  std::unique_lock<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return -1;
+  EndPoint* ep = it->second;
+  ++ep->waiters;
+  bool ok = ep->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    return ep->pending_acks == 0 || ep->status == kConnError ||
+           net->closing;
+  });
+  --ep->waiters;
+  if (!ok) return 0;
+  return ep->status == kConnError ? -1
+         : ep->pending_acks == 0  ? 1
+                                  : 0;
+}
+
+SG_EXPORT int sg_ep_status(void* h, int64_t ep_h) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return kConnError;
+  return it->second->status;
+}
+
+SG_EXPORT int sg_ep_peer(void* h, int64_t ep_h, char* out, int cap) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  auto it = net->eps.find(ep_h);
+  if (it == net->eps.end()) return -1;
+  const std::string& p = it->second->peer;
+  int n = static_cast<int>(p.size()) < cap - 1
+              ? static_cast<int>(p.size()) : cap - 1;
+  std::memcpy(out, p.data(), n);
+  out[n] = 0;
+  return n;
+}

@@ -25,7 +25,8 @@ class build_py_with_native(build_py):
     def run(self):
         super().run()
         native_dir = os.path.join(ROOT, "native")
-        lib = os.path.join(native_dir, "libsinga_native.so")
+        libs = [os.path.join(native_dir, n)
+            for n in ("libsinga_native.so", "libsinga_network.so")]
         try:
             subprocess.run(["make", "-C", native_dir], check=True)
         except (subprocess.SubprocessError, OSError) as e:
@@ -34,7 +35,8 @@ class build_py_with_native(build_py):
             return
         dest_dir = os.path.join(self.build_lib, "singa_tpu", "native")
         os.makedirs(dest_dir, exist_ok=True)
-        shutil.copy2(lib, dest_dir)
+        for lib in libs:
+            shutil.copy2(lib, dest_dir)
 
 
 setup(cmdclass={"build_py": build_py_with_native})

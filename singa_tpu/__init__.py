@@ -31,7 +31,7 @@ from .tensor import Tensor  # noqa: F401
 from .model import Model    # noqa: F401
 
 _LAZY = ("sonnx", "io", "data", "image_tool", "net", "snapshot", "native",
-         "channel", "caffe")
+         "channel", "caffe", "network")
 
 
 def __getattr__(name):

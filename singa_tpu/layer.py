@@ -360,8 +360,10 @@ class ConvTranspose2d(Layer):
             else (self.kernel_size, self.kernel_size)
         w_shape = (self.in_channels, self.nb_kernels // self.group, *ks)
         self.W = _param(w_shape, dev, dtype=x.dtype)
+        # transpose-conv weight is (in, out/group, kh, kw): the fan_in term
+        # is the per-group INPUT channels (w_shape[0]/group), not w_shape[1]
         std = math.sqrt(
-            2.0 / (w_shape[1] * ks[0] * ks[1]
+            2.0 / ((self.in_channels // self.group) * ks[0] * ks[1]
                    + self.nb_kernels / self.group))
         self.W.gaussian(0.0, std)
         if self.bias:

@@ -1,0 +1,249 @@
+"""TCP message-passing network: Python API over the native endpoint layer
+(native/singa_network.cc).
+
+The capability peer of the reference's EndPoint network
+(include/singa/io/network.h:62-136, src/io/network/endpoint.cc): tagged
+messages with separate metadata and payload, per-peer endpoints with
+queued non-blocking sends and blocking receives, a factory that surfaces
+inbound connections, and delivery acknowledgements. In this framework it
+is the control-plane side channel for multi-host deployments — tensor
+traffic rides XLA collectives over ICI/DCN (parallel/communicator.py),
+never this socket layer.
+
+Usage::
+
+    srv = NetworkThread(port=0)            # port 0 -> ephemeral
+    cli = NetworkThread(port=-1)           # -1 -> no listener (client only)
+    ep = cli.connect("127.0.0.1", srv.port)
+    ep.send(Message(b"meta", b"payload"))
+    peer = srv.accept(timeout=5.0)         # EndPoint for the inbound side
+    msg = peer.recv(timeout=5.0)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(_HERE), "native")
+_PACKAGED_LIB = os.path.join(_HERE, "native", "libsinga_network.so")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsinga_network.so")
+
+CONN_INIT = 0
+CONN_PENDING = 1
+CONN_EST = 2
+CONN_ERROR = 3
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = None
+    if os.path.exists(_PACKAGED_LIB):
+        path = _PACKAGED_LIB
+    else:
+        src = os.path.join(_NATIVE_DIR, "singa_network.cc")
+        if os.path.exists(src):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR,
+                                "libsinga_network.so"],
+                               check=True, capture_output=True, timeout=300)
+            except (subprocess.SubprocessError, OSError):
+                pass
+        if os.path.exists(_LIB_PATH):
+            path = _LIB_PATH
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.sg_net_create.restype = ctypes.c_void_p
+    lib.sg_net_create.argtypes = [ctypes.c_int]
+    lib.sg_net_port.restype = ctypes.c_int
+    lib.sg_net_port.argtypes = [ctypes.c_void_p]
+    lib.sg_net_destroy.argtypes = [ctypes.c_void_p]
+    lib.sg_net_connect.restype = ctypes.c_int64
+    lib.sg_net_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+    lib.sg_net_accept_ep.restype = ctypes.c_int64
+    lib.sg_net_accept_ep.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sg_ep_send.restype = ctypes.c_int64
+    lib.sg_ep_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_char_p, ctypes.c_uint64,
+                               ctypes.c_char_p, ctypes.c_uint64]
+    lib.sg_ep_recv_wait.restype = ctypes.c_int
+    lib.sg_ep_recv_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_uint64),
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.sg_ep_recv_copy.restype = ctypes.c_int
+    lib.sg_ep_recv_copy.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_void_p, ctypes.c_uint64,
+                                    ctypes.c_void_p, ctypes.c_uint64]
+    lib.sg_ep_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.sg_ep_pending.restype = ctypes.c_int
+    lib.sg_ep_pending.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.sg_ep_drain.restype = ctypes.c_int
+    lib.sg_ep_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                ctypes.c_int]
+    lib.sg_ep_status.restype = ctypes.c_int
+    lib.sg_ep_status.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.sg_ep_peer.restype = ctypes.c_int
+    lib.sg_ep_peer.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_char_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    """True when the native network layer built/loaded."""
+    return _load() is not None
+
+
+class Message:
+    """A tagged message: metadata + payload byte strings (reference
+    Message include/singa/io/network.h:62-89)."""
+
+    def __init__(self, meta: bytes = b"", payload: bytes = b""):
+        self.meta = bytes(meta)
+        self.payload = bytes(payload)
+        self.id = None
+
+    def __repr__(self):
+        return (f"Message(meta={len(self.meta)}B, "
+                f"payload={len(self.payload)}B, id={self.id})")
+
+
+class EndPoint:
+    """One peer connection: queued sends, blocking receives, delivery
+    tracking (reference EndPoint include/singa/io/network.h:92-117).
+
+    ``recv`` is safe to call from several threads — a per-endpoint lock
+    serializes the wait/copy pair against the C layer.
+    """
+
+    def __init__(self, net: "NetworkThread", handle: int):
+        self._net = net
+        self._h = handle
+        self._recv_lock = threading.Lock()
+
+    def _nh(self):
+        h = self._net._h
+        if not h:
+            raise ConnectionError("NetworkThread is closed")
+        return h
+
+    def send(self, msg: Message) -> int:
+        """Queue ``msg``; returns its id. Raises on a dead endpoint."""
+        mid = _load().sg_ep_send(self._nh(), self._h, msg.meta,
+                                 len(msg.meta), msg.payload,
+                                 len(msg.payload))
+        if mid < 0:
+            raise ConnectionError("endpoint is in error state")
+        msg.id = mid
+        return mid
+
+    def recv(self, timeout: float = 5.0) -> Message | None:
+        """Next message, or None on timeout. Raises when the connection
+        died and nothing is queued."""
+        with self._recv_lock:
+            ms = ctypes.c_uint64()
+            ps = ctypes.c_uint64()
+            rc = _load().sg_ep_recv_wait(self._nh(), self._h,
+                                         int(timeout * 1000),
+                                         ctypes.byref(ms), ctypes.byref(ps))
+            if rc == 0:
+                return None
+            if rc < 0:
+                raise ConnectionError("endpoint closed")
+            meta = ctypes.create_string_buffer(max(1, ms.value))
+            payload = ctypes.create_string_buffer(max(1, ps.value))
+            _load().sg_ep_recv_copy(self._nh(), self._h, meta, ms.value,
+                                    payload, ps.value)
+            return Message(meta.raw[:ms.value], payload.raw[:ps.value])
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Wait until every sent message has been acknowledged."""
+        return _load().sg_ep_drain(self._nh(), self._h,
+                                   int(timeout * 1000)) == 1
+
+    def close(self):
+        """Drop this connection and free its queues (the NetworkThread
+        stays up for other endpoints)."""
+        if self._net._h:
+            _load().sg_ep_close(self._net._h, self._h)
+
+    @property
+    def pending(self) -> int:
+        return _load().sg_ep_pending(self._nh(), self._h)
+
+    @property
+    def status(self) -> int:
+        return _load().sg_ep_status(self._nh(), self._h)
+
+    @property
+    def peer(self) -> str:
+        buf = ctypes.create_string_buffer(128)
+        _load().sg_ep_peer(self._nh(), self._h, buf, 128)
+        return buf.value.decode()
+
+
+class NetworkThread:
+    """Background IO thread multiplexing every endpoint (reference
+    NetworkThread include/singa/io/network.h:136+ over libev; here
+    poll(2) in native code).
+
+    ``port=0`` listens on an ephemeral port (read ``.port``); ``port=-1``
+    runs client-only with no listener.
+    """
+
+    def __init__(self, port: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native network layer unavailable (no C++ toolchain?)")
+        self._h = lib.sg_net_create(port)
+        if not self._h:
+            raise OSError(f"could not bind port {port}")
+
+    @property
+    def port(self) -> int:
+        return _load().sg_net_port(self._h)
+
+    def connect(self, host: str, port: int) -> EndPoint:
+        if not self._h:
+            raise ConnectionError("NetworkThread is closed")
+        h = _load().sg_net_connect(self._h, host.encode(), port)
+        if h == 0:
+            raise ConnectionError(f"could not connect to {host}:{port}")
+        return EndPoint(self, h)
+
+    def accept(self, timeout: float = 5.0) -> EndPoint | None:
+        """Next inbound endpoint, or None on timeout (reference
+        EndPointFactory::getNewEps)."""
+        if not self._h:
+            raise ConnectionError("NetworkThread is closed")
+        h = _load().sg_net_accept_ep(self._h, int(timeout * 1000))
+        return EndPoint(self, h) if h else None
+
+    def close(self):
+        if self._h:
+            _load().sg_net_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+__all__ = ["Message", "EndPoint", "NetworkThread", "available",
+           "CONN_INIT", "CONN_PENDING", "CONN_EST", "CONN_ERROR"]
