@@ -77,10 +77,8 @@ class Device:
     def set_rand_seed(self, seed: int) -> None:
         self.SetRandSeed(seed)
 
-    def rand_key(self):
-        """Split and return a fresh PRNG key (functional curand equivalent).
-
-        Self-heals if a traced consumer leaked its in-trace key into this
+    def _heal_key(self):
+        """Self-heal if a traced consumer leaked its in-trace key into this
         host-side state (the stored key would be a dead tracer): hops to
         a fresh per-device stream (device identity + leak counter)."""
         if isinstance(self._key, jax.core.Tracer) and \
@@ -89,8 +87,20 @@ class Device:
             self._key = jax.random.fold_in(
                 jax.random.PRNGKey(id(self) & 0x7fffffff),
                 0x5eed + self._leaks)
+
+    def rand_key(self):
+        """Split and return a fresh PRNG key (functional curand
+        equivalent)."""
+        self._heal_key()
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def current_key(self):
+        """The current key WITHOUT splitting — for consumers that advance
+        the stream themselves (the compiled train step splits in-trace and
+        hands the next key back, avoiding a host-side split per step)."""
+        self._heal_key()
+        return self._key
 
     # rng state threading for jit (model.py swaps these in/out of the trace)
     def _get_rng_state(self):

@@ -279,6 +279,11 @@ class Model(Layer):
         n_inputs = sum(1 for s in layout if s is _TENSOR)
 
         def fn(state_arrays, rng_key, *input_arrays):
+            # advance the RNG stream inside the trace: one half drives this
+            # step's random ops, the other is handed back as the next
+            # step's key — no host-side eager split per step (it cost more
+            # than the whole dispatch of a small compiled step)
+            rng_key, next_key = jax.random.split(rng_key)
             if dist is not None:
                 # distinct rng per batch-shard (data and, under sequence
                 # parallelism, seq); model-parallel members share the key
@@ -304,7 +309,7 @@ class Model(Layer):
                 leaves = [x if specs[i] != P() else jax.lax.pmean(x, raxes)
                           for i, x in enumerate(leaves)]
             new_state = [t.data for t in state_list]
-            return new_state, leaves
+            return new_state, leaves, next_key
 
         if dist is not None:
             from .parallel.communicator import (get_mesh,
@@ -352,7 +357,7 @@ class Model(Layer):
                 user_out = getattr(self, "output_specs", None)
                 rec["leaf_specs"] = list(user_out) if user_out is not None \
                     else [P(axis) if m else P() for m in shard_mask]
-                out_specs = (state_specs, rec["leaf_specs"])
+                out_specs = (state_specs, rec["leaf_specs"], P())
                 import inspect
                 kw = {}
                 sig = inspect.signature(shard_map).parameters
@@ -420,8 +425,7 @@ class Model(Layer):
                     "model; each costs a full trace+compile and is cached. "
                     "Pass per-step-varying values as Tensors, not python "
                     "scalars.", stacklevel=3)
-        rng = self.dev.rand_key()
-        host_key = self.dev._get_rng_state()  # tracing clobbers dev rng
+        rng = self.dev.current_key()  # advanced in-trace; next key returned
         if rec["jit"] is None:
             rec["jit"] = rec["builder"](input_arrays, rng)
         state_arrays = [t.data for t in self._state_list]
@@ -438,7 +442,8 @@ class Model(Layer):
             input_arrays = [
                 jax.device_put(a, NamedSharding(self._mesh, s))
                 for a, s in zip(input_arrays, in_specs)]
-            rng = jax.device_put(rng, rep)
+            if getattr(rng, "sharding", None) != rep:
+                rng = jax.device_put(rng, rep)
         if self.dev.verbosity >= 2 and "cost" not in rec:
             # one-time XLA cost analysis of this step signature (the
             # compiled-world per-op metric: flops / bytes, reference
@@ -454,8 +459,9 @@ class Model(Layer):
             except Exception:   # cost analysis is backend-best-effort
                 pass
         t0 = time.perf_counter()
-        new_state, leaves = rec["jit"](state_arrays, rng, *input_arrays)
-        self.dev._set_rng_state(host_key)
+        new_state, leaves, next_key = rec["jit"](state_arrays, rng,
+                                                 *input_arrays)
+        self.dev._set_rng_state(next_key)  # tracing clobbered dev rng
         self._step_count += 1
         if self.dev.verbosity > 0 and \
                 self._step_count > self.dev.skip_iteration:

@@ -76,6 +76,11 @@ class Tensor:
             elif isinstance(data, np.ndarray):
                 data = device.put(data.astype(np.dtype(dtype))
                                   if dtype is not None else data)
+            elif isinstance(data, jax.Array):
+                # already on device (the common hot path: every compiled
+                # step output) — no asarray/dtype-lattice work needed
+                if dtype is not None and data.dtype != jnp.dtype(dtype):
+                    data = data.astype(dtype)
             else:
                 data = jnp.asarray(data)
                 if dtype is not None:
