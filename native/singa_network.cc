@@ -124,11 +124,14 @@ void mark_error(EndPoint* ep);
 
 // Parse as many complete frames out of ep->rbuf as possible.
 // DATA frames go to recvq (and enqueue an ACK); ACK frames decrement
-// pending_acks. Caller holds net->mtx.
+// pending_acks. Parsing advances an offset and compacts the buffer once
+// at the end (erasing per-frame would be O(n^2) across a burst of small
+// frames). Caller holds net->mtx.
 void drain_frames(Net* net, EndPoint* ep) {
+  size_t off = 0;
   for (;;) {
-    if (ep->rbuf.size() < kHeaderSize) return;
-    const char* p = ep->rbuf.data();
+    if (ep->rbuf.size() - off < kHeaderSize) break;
+    const char* p = ep->rbuf.data() + off;
     uint8_t type = static_cast<uint8_t>(p[0]);
     uint32_t id;
     uint64_t ms, ps;
@@ -148,7 +151,7 @@ void drain_frames(Net* net, EndPoint* ep) {
     }
     size_t total = kHeaderSize + static_cast<size_t>(ms) +
                    static_cast<size_t>(ps);
-    if (ep->rbuf.size() < total) return;
+    if (ep->rbuf.size() - off < total) break;
     if (type == kMsgAck) {
       if (ep->pending_acks > 0) --ep->pending_acks;
       ep->cv.notify_all();
@@ -165,8 +168,9 @@ void drain_frames(Net* net, EndPoint* ep) {
       ep->sendq.push_back(frame(ack));
       ep->cv.notify_all();
     }
-    ep->rbuf.erase(0, total);
+    off += total;
   }
+  if (off > 0) ep->rbuf.erase(0, off);
 }
 
 void mark_error(EndPoint* ep) {
@@ -328,6 +332,20 @@ SG_EXPORT int sg_net_port(void* h) {
   return static_cast<Net*>(h)->port;
 }
 
+// Begin teardown WITHOUT freeing: refuse new waits and wake every blocked
+// recv/drain/connect/accept so in-flight callers unwind. The Python layer
+// calls this, waits for its in-flight count to hit zero, then calls
+// sg_net_destroy — which makes the free race-proof without the C layer
+// needing handle refcounts.
+SG_EXPORT void sg_net_shutdown(void* h) {
+  auto* net = static_cast<Net*>(h);
+  std::lock_guard<std::mutex> lk(net->mtx);
+  net->closing = true;
+  net->new_cv.notify_all();
+  for (auto& kv : net->eps) kv.second->cv.notify_all();
+  for (auto* ep : net->graveyard) ep->cv.notify_all();
+}
+
 SG_EXPORT void sg_net_destroy(void* h) {
   auto* net = static_cast<Net*>(h);
   {
@@ -371,40 +389,45 @@ SG_EXPORT int64_t sg_net_connect(void* h, const char* host, int port) {
   for (int attempt = 0; attempt < 3 && handle == 0; ++attempt) {
     if (attempt > 0)
       std::this_thread::sleep_for(std::chrono::milliseconds(50 << attempt));
-    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-    if (fd < 0) break;
-    set_nonblock(fd);
-    set_nodelay(fd);
-    int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
-    if (rc != 0 && errno != EINPROGRESS) {
-      ::close(fd);
-      continue;
+    // walk every resolved address each attempt (multi-homed hosts)
+    bool stop = false;
+    for (addrinfo* ai = res; ai && handle == 0 && !stop; ai = ai->ai_next) {
+      int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      set_nonblock(fd);
+      set_nodelay(fd);
+      int rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+      if (rc != 0 && errno != EINPROGRESS) {
+        ::close(fd);
+        continue;
+      }
+      auto* ep = new EndPoint();
+      ep->fd = fd;
+      ep->status = rc == 0 ? kConnEst : kConnPending;
+      ep->peer = std::string(host) + ":" + std::to_string(port);
+      std::unique_lock<std::mutex> lk(net->mtx);
+      int64_t cand = net->next_handle++;
+      net->eps[cand] = ep;
+      net->poke();
+      // wait for the io thread to finish the handshake
+      ++ep->waiters;
+      ep->cv.wait_for(lk, std::chrono::seconds(5), [&] {
+        return ep->status != kConnPending || net->closing;
+      });
+      --ep->waiters;
+      if (ep->status == kConnEst) {
+        handle = cand;
+      } else {
+        // failed address: retire the endpoint, try the next one
+        if (ep->fd >= 0) ::close(ep->fd);
+        ep->fd = -1;
+        ep->status = kConnError;
+        net->eps.erase(cand);
+        net->graveyard.push_back(ep);
+        if (net->closing) stop = true;
+      }
     }
-    auto* ep = new EndPoint();
-    ep->fd = fd;
-    ep->status = rc == 0 ? kConnEst : kConnPending;
-    ep->peer = std::string(host) + ":" + std::to_string(port);
-    std::unique_lock<std::mutex> lk(net->mtx);
-    int64_t cand = net->next_handle++;
-    net->eps[cand] = ep;
-    net->poke();
-    // wait for the io thread to finish the handshake
-    ++ep->waiters;
-    ep->cv.wait_for(lk, std::chrono::seconds(5), [&] {
-      return ep->status != kConnPending || net->closing;
-    });
-    --ep->waiters;
-    if (ep->status == kConnEst) {
-      handle = cand;
-    } else {
-      // failed attempt: retire the endpoint and retry
-      if (ep->fd >= 0) ::close(ep->fd);
-      ep->fd = -1;
-      ep->status = kConnError;
-      net->eps.erase(cand);
-      net->graveyard.push_back(ep);
-      if (net->closing) break;
-    }
+    if (stop) break;
   }
   freeaddrinfo(res);
   return handle;

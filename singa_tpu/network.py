@@ -22,6 +22,7 @@ Usage::
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import subprocess
@@ -38,12 +39,15 @@ CONN_EST = 2
 CONN_ERROR = 3
 
 _lib = None
+_load_failed = False            # cache a failed probe: never re-spawn make
 
 
 def _load():
-    global _lib
+    global _lib, _load_failed
     if _lib is not None:
         return _lib
+    if _load_failed:
+        return None
     path = None
     if os.path.exists(_PACKAGED_LIB):
         path = _PACKAGED_LIB
@@ -59,15 +63,18 @@ def _load():
         if os.path.exists(_LIB_PATH):
             path = _LIB_PATH
     if path is None:
+        _load_failed = True
         return None
     try:
         lib = ctypes.CDLL(path)
     except OSError:
+        _load_failed = True
         return None
     lib.sg_net_create.restype = ctypes.c_void_p
     lib.sg_net_create.argtypes = [ctypes.c_int]
     lib.sg_net_port.restype = ctypes.c_int
     lib.sg_net_port.argtypes = [ctypes.c_void_p]
+    lib.sg_net_shutdown.argtypes = [ctypes.c_void_p]
     lib.sg_net_destroy.argtypes = [ctypes.c_void_p]
     lib.sg_net_connect.restype = ctypes.c_int64
     lib.sg_net_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
@@ -134,17 +141,12 @@ class EndPoint:
         self._h = handle
         self._recv_lock = threading.Lock()
 
-    def _nh(self):
-        h = self._net._h
-        if not h:
-            raise ConnectionError("NetworkThread is closed")
-        return h
-
     def send(self, msg: Message) -> int:
         """Queue ``msg``; returns its id. Raises on a dead endpoint."""
-        mid = _load().sg_ep_send(self._nh(), self._h, msg.meta,
-                                 len(msg.meta), msg.payload,
-                                 len(msg.payload))
+        with self._net._guard() as h:
+            mid = _load().sg_ep_send(h, self._h, msg.meta,
+                                     len(msg.meta), msg.payload,
+                                     len(msg.payload))
         if mid < 0:
             raise ConnectionError("endpoint is in error state")
         msg.id = mid
@@ -153,11 +155,10 @@ class EndPoint:
     def recv(self, timeout: float = 5.0) -> Message | None:
         """Next message, or None on timeout. Raises when the connection
         died and nothing is queued."""
-        with self._recv_lock:
+        with self._recv_lock, self._net._guard() as h:
             ms = ctypes.c_uint64()
             ps = ctypes.c_uint64()
-            rc = _load().sg_ep_recv_wait(self._nh(), self._h,
-                                         int(timeout * 1000),
+            rc = _load().sg_ep_recv_wait(h, self._h, int(timeout * 1000),
                                          ctypes.byref(ms), ctypes.byref(ps))
             if rc == 0:
                 return None
@@ -165,34 +166,44 @@ class EndPoint:
                 raise ConnectionError("endpoint closed")
             meta = ctypes.create_string_buffer(max(1, ms.value))
             payload = ctypes.create_string_buffer(max(1, ps.value))
-            _load().sg_ep_recv_copy(self._nh(), self._h, meta, ms.value,
-                                    payload, ps.value)
+            rc2 = _load().sg_ep_recv_copy(h, self._h, meta, ms.value,
+                                          payload, ps.value)
+            if rc2 < 0:
+                # endpoint was closed between the wait and the copy
+                raise ConnectionError("endpoint closed")
             return Message(meta.raw[:ms.value], payload.raw[:ps.value])
 
     def drain(self, timeout: float = 5.0) -> bool:
         """Wait until every sent message has been acknowledged."""
-        return _load().sg_ep_drain(self._nh(), self._h,
-                                   int(timeout * 1000)) == 1
+        with self._net._guard() as h:
+            return _load().sg_ep_drain(h, self._h,
+                                       int(timeout * 1000)) == 1
 
     def close(self):
         """Drop this connection and free its queues (the NetworkThread
         stays up for other endpoints)."""
-        if self._net._h:
-            _load().sg_ep_close(self._net._h, self._h)
+        try:
+            with self._net._guard() as h:
+                _load().sg_ep_close(h, self._h)
+        except ConnectionError:
+            pass                 # the whole NetworkThread is already gone
 
     @property
     def pending(self) -> int:
-        return _load().sg_ep_pending(self._nh(), self._h)
+        with self._net._guard() as h:
+            return _load().sg_ep_pending(h, self._h)
 
     @property
     def status(self) -> int:
-        return _load().sg_ep_status(self._nh(), self._h)
+        with self._net._guard() as h:
+            return _load().sg_ep_status(h, self._h)
 
     @property
     def peer(self) -> str:
-        buf = ctypes.create_string_buffer(128)
-        _load().sg_ep_peer(self._nh(), self._h, buf, 128)
-        return buf.value.decode()
+        with self._net._guard() as h:
+            buf = ctypes.create_string_buffer(128)
+            _load().sg_ep_peer(h, self._h, buf, 128)
+            return buf.value.decode()
 
 
 class NetworkThread:
@@ -209,18 +220,37 @@ class NetworkThread:
         if lib is None:
             raise RuntimeError(
                 "native network layer unavailable (no C++ toolchain?)")
+        self._cond = threading.Condition()
+        self._inflight = 0
         self._h = lib.sg_net_create(port)
         if not self._h:
             raise OSError(f"could not bind port {port}")
 
+    @contextlib.contextmanager
+    def _guard(self):
+        """Enter a native call: refuses when closed, and keeps the Net
+        alive until the call leaves (close() frees only after the
+        in-flight count drains — no use-after-free on a close race)."""
+        with self._cond:
+            if not self._h:
+                raise ConnectionError("NetworkThread is closed")
+            self._inflight += 1
+            h = self._h
+        try:
+            yield h
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+
     @property
     def port(self) -> int:
-        return _load().sg_net_port(self._h)
+        with self._guard() as h:
+            return _load().sg_net_port(h)
 
     def connect(self, host: str, port: int) -> EndPoint:
-        if not self._h:
-            raise ConnectionError("NetworkThread is closed")
-        h = _load().sg_net_connect(self._h, host.encode(), port)
+        with self._guard() as nh:
+            h = _load().sg_net_connect(nh, host.encode(), port)
         if h == 0:
             raise ConnectionError(f"could not connect to {host}:{port}")
         return EndPoint(self, h)
@@ -228,15 +258,21 @@ class NetworkThread:
     def accept(self, timeout: float = 5.0) -> EndPoint | None:
         """Next inbound endpoint, or None on timeout (reference
         EndPointFactory::getNewEps)."""
-        if not self._h:
-            raise ConnectionError("NetworkThread is closed")
-        h = _load().sg_net_accept_ep(self._h, int(timeout * 1000))
+        with self._guard() as nh:
+            h = _load().sg_net_accept_ep(nh, int(timeout * 1000))
         return EndPoint(self, h) if h else None
 
     def close(self):
-        if self._h:
-            _load().sg_net_destroy(self._h)
-            self._h = None
+        """Tear down: refuse new calls, wake + drain every blocked call,
+        then free the native Net."""
+        with self._cond:
+            if not self._h:
+                return
+            h, self._h = self._h, None       # no new entries
+            _load().sg_net_shutdown(h)       # wake blocked waiters
+            while self._inflight > 0:
+                self._cond.wait()
+        _load().sg_net_destroy(h)
 
     def __enter__(self):
         return self

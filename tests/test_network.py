@@ -182,3 +182,28 @@ class TestNetwork:
         for g in got:
             i = int(g.meta[1:])
             assert len(g.payload) == i * 17 % 97
+
+    def test_close_races_blocked_recv(self):
+        """close() while another thread is blocked in recv(): the blocked
+        call unwinds (error or None) and nothing crashes."""
+        import threading
+        srv = net.NetworkThread(port=0)
+        cli = net.NetworkThread(port=-1)
+        ep = cli.connect("127.0.0.1", srv.port)
+        results = []
+
+        def blocked():
+            try:
+                results.append(ep.recv(timeout=30.0))
+            except ConnectionError:
+                results.append("conn-error")
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        import time
+        time.sleep(0.2)          # let it block inside the native wait
+        cli.close()              # must wake + drain it, then free
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "blocked recv never unwound"
+        assert results and results[0] in ("conn-error", None)
+        srv.close()
