@@ -1,0 +1,141 @@
+"""Multi-process data-parallel training — one OS process per host,
+bootstrapped with ``jax.distributed`` (the reference launches one process
+per GPU with python multiprocessing + an NcclIdHolder,
+examples/cnn/train_multiprocess.py, or mpirun, examples/cnn/train_mpi.py;
+here the coordinator address plays the NCCL-id role and XLA collectives
+replace the NCCL ring).
+
+Run standalone (spawns the workers itself):
+
+    python examples/train_multiprocess.py --procs 2 --steps 5
+
+or launch one rank per host, SPMD-style:
+
+    python examples/train_multiprocess.py --rank 0 --procs 2 \
+        --coordinator host0:29500 &
+    python examples/train_multiprocess.py --rank 1 --procs 2 \
+        --coordinator host0:29500
+
+On machines without accelerators each process simulates a host with
+``--devices-per-proc`` CPU devices, so the full multi-host code path —
+coordination service, global mesh, cross-process psum — runs anywhere.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_rank(args):
+    if args.platform == "cpu":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count="
+            f"{args.devices_per_proc}")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    import numpy as np
+    from singa_tpu import device, opt, tensor
+    from singa_tpu.models import cnn
+    from singa_tpu.parallel import communicator, mesh as mesh_mod
+
+    # rank exchange / process bootstrap (reference communicator.cc:73-103)
+    communicator.init_process(
+        communicator.NcclIdHolder(args.coordinator),
+        rank=args.rank, world=args.procs)
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    print(f"rank {args.rank}/{args.procs}: {n_local} local / "
+          f"{n_global} global devices", flush=True)
+
+    mesh = mesh_mod.make_mesh(jax.devices(), mesh_mod.MeshConfig())
+    communicator.set_mesh(mesh)
+    dev = device.Device(jax.local_devices()[0])
+    dev.SetRandSeed(7)
+
+    model = cnn.create_model(num_channels=1)
+    dist = opt.DistOpt(opt.SGD(lr=args.lr, momentum=0.9),
+                       world_size=n_global)
+    dist.communicator.mesh = mesh
+    model.set_optimizer(dist)
+
+    # SPMD convention: every process feeds the same GLOBAL batch; the
+    # device_put inside the compiled step keeps only the local shard
+    rng = np.random.RandomState(0)
+    gb = args.bs * n_global
+    x = rng.randn(gb, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, gb)]
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+
+    model.compile([tx], is_train=True, use_graph=True)
+    model(tx, ty)                       # materialise + compile
+    t0 = time.time()
+    loss = None
+    for _ in range(args.steps):
+        out, loss = model(tx, ty)
+    lv = float(np.asarray(jax.device_get(loss.data)))
+    dt = time.time() - t0
+    print(f"rank {args.rank}: {args.steps} steps, loss {lv:.4f}, "
+          f"{args.steps * gb / dt:.1f} img/s global", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, default=None,
+                    help="this process's rank; omit to spawn all ranks")
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank 0's coordination service; "
+                         "launcher mode defaults to an ephemeral free port")
+    ap.add_argument("--devices-per-proc", type=int, default=2)
+    ap.add_argument("--platform", default="cpu",
+                    choices=["cpu", "tpu"])
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--bs", type=int, default=8,
+                    help="per-device batch size")
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    if args.rank is not None:
+        if args.coordinator is None:
+            args.coordinator = "127.0.0.1:29512"
+        run_rank(args)
+        return
+
+    if args.coordinator is None:
+        # ephemeral free port so concurrent runs / stale workers on the
+        # default port can't collide
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        args.coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+
+    # launcher mode: one subprocess per rank (the reference's
+    # multiprocessing.Process loop, train_multiprocess.py)
+    procs = []
+    for r in range(args.procs):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--rank", str(r)]
+        for k in ("procs", "coordinator", "devices_per_proc", "platform",
+                  "steps", "bs", "lr"):
+            cmd += [f"--{k.replace('_', '-')}", str(getattr(args, k))]
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(cmd, env=env))
+    rcs = [p.wait() for p in procs]
+    if any(rcs):
+        raise SystemExit(f"worker failure: rcs={rcs}")
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,22 @@ void mark_error(EndPoint* ep) {
   ep->cv.notify_all();
 }
 
+// Free retired endpoints nobody is blocked on (caller holds net->mtx).
+// Handles already erased from net->eps can gain no new waiters — lookups
+// fail — so waiters == 0 means the struct is provably unreachable.
+void reap_graveyard(Net* net) {
+  auto& g = net->graveyard;
+  for (size_t i = 0; i < g.size();) {
+    if (g[i]->waiters == 0) {
+      delete g[i];
+      g[i] = g.back();
+      g.pop_back();
+    } else {
+      ++i;
+    }
+  }
+}
+
 void io_loop(Net* net) {
   std::vector<pollfd> pfds;
   std::vector<EndPoint*> pfd_eps;
@@ -406,6 +422,7 @@ SG_EXPORT int64_t sg_net_connect(void* h, const char* host, int port) {
       ep->status = rc == 0 ? kConnEst : kConnPending;
       ep->peer = std::string(host) + ":" + std::to_string(port);
       std::unique_lock<std::mutex> lk(net->mtx);
+      reap_graveyard(net);
       int64_t cand = net->next_handle++;
       net->eps[cand] = ep;
       net->poke();
@@ -449,6 +466,7 @@ SG_EXPORT void sg_ep_close(void* h, int64_t ep_h) {
   ep->rbuf.shrink_to_fit();
   net->eps.erase(it);
   net->graveyard.push_back(ep);
+  reap_graveyard(net);
   net->poke();
 }
 

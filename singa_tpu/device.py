@@ -178,9 +178,15 @@ class CppCPU(Device):
     """Host CPU device (reference src/core/device/cpp_cpu.cc)."""
 
     def __init__(self, device_id: int = 0):
-        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        # local (addressable) devices only: under a multi-process
+        # jax.distributed mesh, jax.devices() lists other hosts' devices,
+        # which this process cannot allocate on
+        cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
         if not cpus:
-            cpus = jax.devices("cpu")
+            try:
+                cpus = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                cpus = jax.devices("cpu")   # single-process: all local
         super().__init__(cpus[0], device_id, lang="kCpp")
 
 
@@ -190,11 +196,12 @@ class TpuDevice(Device):
 
     def __init__(self, device_id: int = 0, jax_device=None):
         if jax_device is None:
-            accel = [d for d in jax.devices() if d.platform != "cpu"]
+            local = jax.local_devices()
+            accel = [d for d in local if d.platform != "cpu"]
             if accel:
                 jax_device = accel[device_id % len(accel)]
             else:  # CPU fallback keeps the API usable off-TPU
-                jax_device = jax.devices()[device_id % len(jax.devices())]
+                jax_device = local[device_id % len(local)]
         super().__init__(jax_device, device_id, lang="kTpu")
 
 

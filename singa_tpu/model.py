@@ -432,18 +432,31 @@ class Model(Layer):
         if self._dist is not None:
             from jax.sharding import NamedSharding
             rep = NamedSharding(self._mesh, P())
+
+            def place(a, sharding):
+                # multi-process mesh: the sharding spans devices of other
+                # hosts, which device_put cannot reach — each process
+                # contributes its addressable shards from its (SPMD-
+                # identical) host copy instead
+                if getattr(a, "sharding", None) == sharding:
+                    return a
+                if sharding.is_fully_addressable:
+                    return jax.device_put(a, sharding)
+                val = np.asarray(jax.device_get(a))
+                return jax.make_array_from_callback(
+                    val.shape, sharding, lambda idx: val[idx])
+
             specs = getattr(self, "_state_specs", None) or \
                 [P()] * len(state_arrays)
             state_arrays = [
-                jax.device_put(a, NamedSharding(self._mesh, s))
+                place(a, NamedSharding(self._mesh, s))
                 for a, s in zip(state_arrays, specs)]
             in_specs = rec["input_specs"] or \
                 [P(self._axis)] * len(input_arrays)
             input_arrays = [
-                jax.device_put(a, NamedSharding(self._mesh, s))
+                place(a, NamedSharding(self._mesh, s))
                 for a, s in zip(input_arrays, in_specs)]
-            if getattr(rng, "sharding", None) != rep:
-                rng = jax.device_put(rng, rep)
+            rng = place(rng, rep)
         if self.dev.verbosity >= 2 and "cost" not in rec:
             # one-time XLA cost analysis of this step signature (the
             # compiled-world per-op metric: flops / bytes, reference

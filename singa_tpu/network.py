@@ -67,9 +67,17 @@ def _load():
         return None
     try:
         lib = ctypes.CDLL(path)
-    except OSError:
+        _bind(lib)
+    except (OSError, AttributeError):
+        # unloadable, or a stale prebuilt .so missing a newer symbol —
+        # degrade to "unavailable" rather than crashing callers
         _load_failed = True
         return None
+    _lib = lib
+    return lib
+
+
+def _bind(lib):
     lib.sg_net_create.restype = ctypes.c_void_p
     lib.sg_net_create.argtypes = [ctypes.c_int]
     lib.sg_net_port.restype = ctypes.c_int
@@ -105,8 +113,6 @@ def _load():
     lib.sg_ep_peer.restype = ctypes.c_int
     lib.sg_ep_peer.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                ctypes.c_char_p, ctypes.c_int]
-    _lib = lib
-    return lib
 
 
 def available() -> bool:

@@ -71,8 +71,17 @@ class NcclIdHolder:
 def init_process(nccl_id: NcclIdHolder | None = None, rank: int = 0,
                  world: int = 1):
     """Multi-host bootstrap (replaces the reference's MPI_Bcast rank
-    exchange, communicator.cc:73-103)."""
+    exchange, communicator.cc:73-103).
+
+    On TPU pods the collectives ride ICI/DCN natively; on the CPU backend
+    cross-process collectives need an explicit transport, so gloo is
+    enabled best-effort (this is what makes the multi-process examples and
+    tests runnable on any machine — the reference needs real GPUs+NCCL)."""
     if world > 1:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:            # unknown option on this jax version
+            pass
         jax.distributed.initialize(
             coordinator_address=(nccl_id or NcclIdHolder()).
             coordinator_address,
