@@ -180,9 +180,13 @@ void mark_error(EndPoint* ep) {
   ep->cv.notify_all();
 }
 
-// Free retired endpoints nobody is blocked on (caller holds net->mtx).
-// Handles already erased from net->eps can gain no new waiters — lookups
-// fail — so waiters == 0 means the struct is provably unreachable.
+// Free retired endpoints nobody can reach anymore. ONLY the io thread may
+// call this, at the top of its loop BEFORE rebuilding its pollfd snapshot:
+// that snapshot holds raw EndPoint* across the (unlocked) poll() window,
+// so endpoints retired mid-iteration must survive until the next rebuild.
+// Handles already erased from net->eps can gain no new cv waiters —
+// lookups fail — so waiters == 0 there means unreachable. Caller holds
+// net->mtx.
 void reap_graveyard(Net* net) {
   auto& g = net->graveyard;
   for (size_t i = 0; i < g.size();) {
@@ -212,6 +216,7 @@ void io_loop(Net* net) {
     }
     {
       std::lock_guard<std::mutex> lk(net->mtx);
+      reap_graveyard(net);
       for (auto& kv : net->eps) {
         EndPoint* ep = kv.second;
         if (ep->fd < 0) continue;
@@ -422,7 +427,6 @@ SG_EXPORT int64_t sg_net_connect(void* h, const char* host, int port) {
       ep->status = rc == 0 ? kConnEst : kConnPending;
       ep->peer = std::string(host) + ":" + std::to_string(port);
       std::unique_lock<std::mutex> lk(net->mtx);
-      reap_graveyard(net);
       int64_t cand = net->next_handle++;
       net->eps[cand] = ep;
       net->poke();
@@ -466,8 +470,7 @@ SG_EXPORT void sg_ep_close(void* h, int64_t ep_h) {
   ep->rbuf.shrink_to_fit();
   net->eps.erase(it);
   net->graveyard.push_back(ep);
-  reap_graveyard(net);
-  net->poke();
+  net->poke();                    // io thread reaps on its next rebuild
 }
 
 // Claim the next inbound endpoint (created by a peer's connect), waiting

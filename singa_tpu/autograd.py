@@ -1148,6 +1148,85 @@ def ctensor2numpy(x):
     return np.asarray(jax.device_get(_raw(x)))
 
 
+class _Checkpointed(Operator):
+    """Run a sub-network under ``jax.checkpoint``: its activations are NOT
+    saved for backward — the block is recomputed from its inputs during the
+    gradient pass. The TPU-first answer to activation memory on long
+    sequences / deep stacks (trade FLOPs for HBM); no reference counterpart
+    (SINGA recycles block buffers in its Graph scheduler instead,
+    src/core/scheduler/scheduler.cc:671-688, which cannot help with
+    autograd residuals).
+
+    Params enter as explicit operator inputs so their gradients ride the
+    ordinary tape; the device RNG is re-seeded from an input key inside the
+    wrapped function so dropout masks agree between the forward and the
+    recompute pass.
+    """
+
+    def __init__(self, run):
+        super().__init__()
+        self._run = run          # (x_arr, *param_arrs) -> out_arr, via ops
+        self._ck = jax.checkpoint(self._pure)
+
+    def _pure(self, key, x, *params):
+        dev = self.dev
+        saved = dev._get_rng_state()
+        dev._set_rng_state(key)
+        try:
+            return self._run(x, *params)
+        finally:
+            dev._set_rng_state(saved)
+
+    def forward(self, key, x, *params):
+        return self._ck(key, x, *params)
+
+
+def checkpoint(block, x):
+    """Apply ``block`` (a Layer) to Tensor ``x`` with rematerialized
+    backward: ``y = checkpoint(blk, x)`` is numerically ``blk(x)`` but
+    stores only the block's inputs, recomputing its inside during the
+    gradient pass (``jax.checkpoint``).
+
+    On the first call (shape-inferring initialization) the block runs
+    un-checkpointed so its parameters materialize; every later call —
+    including under jit/graph mode — is rematerialized.
+    """
+    from .layer import Layer
+    if not isinstance(block, Layer):
+        raise TypeError("checkpoint() wraps a Layer; for plain functions "
+                        "use jax.checkpoint directly")
+    if not block._initialized:
+        return block(x)
+    params = block.get_params()
+    if len(block.get_states()) != len(params):
+        # running statistics (BatchNorm) are updated in the forward pass;
+        # under recompute they would be written from a closed-over inner
+        # trace — unsound. LayerNorm-style blocks are the supported shape.
+        raise ValueError(
+            "checkpoint() cannot wrap blocks holding non-parameter state "
+            "(e.g. BatchNorm running stats); use normalization without "
+            "running statistics (LayerNorm) inside checkpointed blocks")
+    names = sorted(params)
+    tensors = [params[n] for n in names]
+
+    def run(x_arr, *param_arrs):
+        backup = [t.data for t in tensors]
+        for t, a in zip(tensors, param_arrs):
+            t.data = a
+        try:
+            xin = Tensor(data=x_arr, device=x.device, requires_grad=False)
+            out = block(xin)
+            return out.data if isinstance(out, Tensor) else out
+        finally:
+            for t, a in zip(tensors, backup):
+                t.data = a
+
+    op = _Checkpointed(run)
+    key = x.device.rand_key()
+    kt = Tensor(data=key, device=x.device, requires_grad=False)
+    return op(kt, x, *tensors)
+
+
 # ---- conv/bn/pool/rnn ops live in singa_tpu.ops; re-export here for parity
 from .ops.conv import (ConvHandle, _Conv2d, conv2d)  # noqa: E402
 from .ops.batchnorm import (BatchNormHandle, _BatchNorm2d,  # noqa: E402

@@ -116,10 +116,15 @@ class TransformerLM(model.Model):
     """
 
     def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=2,
-                 max_len=1024, causal=True, tp=True, seq_axis=None):
+                 max_len=1024, causal=True, tp=True, seq_axis=None,
+                 remat=False):
         super().__init__()
         self.vocab_size = vocab_size
         self.d_model = d_model
+        # remat: rematerialize each block in backward (jax.checkpoint) —
+        # activation memory O(n_layers * block-boundary) instead of
+        # O(n_layers * everything), the standard long-context trade
+        self.remat = remat
         self.tok_emb = layer.Embedding(vocab_size, d_model)
         self.pos_emb = layer.Embedding(max_len, d_model)
         self._pos = _Positions(seq_axis)
@@ -134,7 +139,7 @@ class TransformerLM(model.Model):
         pos = self._pos(ids)
         x = autograd.add(self.tok_emb(ids), self.pos_emb(pos))
         for blk in self.blocks:
-            x = blk(x)
+            x = autograd.checkpoint(blk, x) if self.remat else blk(x)
         return self.head(self.ln_f(x))          # (B, S, vocab)
 
     def train_one_batch(self, ids, targets):

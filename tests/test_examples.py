@@ -70,3 +70,15 @@ class TestExamples:
         out = run_example(["examples/train_rbm.py", "--cpu", "--epochs",
                            "1", "--bs", "16", "--hdim", "32"])
         assert "err" in out.lower() or "loss" in out.lower(), out[-500:]
+
+    def test_train_qabot(self):
+        out = run_example(["examples/train_qabot.py", "--epochs", "2",
+                           "--n", "32", "--bs", "8", "--hidden", "16",
+                           "--seq-len", "6", "--embed", "16"])
+        assert "top1" in out, out[-500:]
+
+    def test_train_largedataset(self):
+        out = run_example(["examples/train_largedataset.py", "--n", "64",
+                           "--shards", "2", "--bs", "8", "--epochs", "2",
+                           "--size", "12"])
+        assert "epoch 1" in out, out[-500:]

@@ -3,9 +3,11 @@ three parallel modes must match plain DP numerically."""
 
 import numpy as np
 import jax
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from singa_tpu import device, model, opt, tensor
+from singa_tpu.tensor import Tensor
 from singa_tpu.models import transformer
 from singa_tpu.parallel import mesh as mesh_mod
 from singa_tpu.parallel.communicator import set_mesh
@@ -83,3 +85,61 @@ class TestTransformerLM:
         tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
         logits = m(tx)
         assert logits.shape == (2, 8, VOCAB)
+
+
+class TestRemat:
+    """autograd.checkpoint / TransformerLM(remat=True): rematerialized
+    backward matches the stored-activation run exactly (no reference
+    counterpart — the TPU-first activation-memory trade)."""
+
+    def _train(self, remat, steps=3):
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 23, (4, 10)).astype(np.float32)
+        tgt = np.roll(ids, -1, 1)
+        m = transformer.TransformerLM(23, d_model=16, n_heads=2,
+                                      n_layers=2, max_len=32, tp=False,
+                                      remat=remat)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        ti = Tensor(data=ids, device=dev, requires_grad=False)
+        tt = Tensor(data=tgt, device=dev, requires_grad=False)
+        m.compile([ti], is_train=True, use_graph=True)
+        return [float(m(ti, tt)[1].numpy()) for _ in range(steps)], m, ti, tt
+
+    def test_remat_matches_baseline(self):
+        base, _, _, _ = self._train(False)
+        rem, _, _, _ = self._train(True)
+        np.testing.assert_allclose(base, rem, rtol=1e-5)
+
+    def test_remat_marks_the_jaxpr(self):
+        _, m, ti, tt = self._train(True, steps=1)
+        table = m.graph_debug(ti, tt, print_out=False)
+        assert "remat" in str(table) or "checkpoint" in str(table)
+
+    def test_checkpoint_rejects_batchnorm_state(self):
+        from singa_tpu import autograd, layer
+        from singa_tpu.autograd_base import CTX
+
+        class BNBlock(layer.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c = layer.Conv2d(4, 3, padding=1)
+                self.bn = layer.BatchNorm2d()
+
+            def forward(self, x):
+                return self.bn(self.c(x))
+
+        dev = device.create_cpu_device()
+        rng = np.random.RandomState(0)
+        b = BNBlock()
+        x = Tensor(data=rng.randn(2, 3, 8, 8).astype(np.float32),
+                   device=dev)
+        b(x)
+        prev = CTX.training
+        CTX.training = True
+        try:
+            with pytest.raises(ValueError, match="running stat"):
+                autograd.checkpoint(b, x)
+        finally:
+            CTX.training = prev
