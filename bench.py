@@ -107,7 +107,39 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
                 res["bf16_mfu"] = bt * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
         except Exception as e:   # the fp32 number still stands
             res["bf16_error"] = str(e)[:200]
+    # transformer-LM leg (accelerator only — secondary metric exercising
+    # the Pallas flash-attention path; the headline stays ResNet-50)
+    if platform != "cpu" and os.environ.get("BENCH_LM", "1") != "0":
+        try:
+            res["lm_tokens_per_sec"] = _measure_lm(dev)
+        except Exception as e:
+            res["lm_error"] = str(e)[:200]
     return res
+
+
+def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3):
+    from singa_tpu import tensor, opt
+    from singa_tpu.models import transformer
+    import numpy as np
+
+    m = transformer.TransformerLM(32000, d_model=512, n_heads=8,
+                                  n_layers=6, max_len=seq, tp=False,
+                                  remat=False)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 32000, (batch, seq)).astype(np.float32)
+    tgt = np.roll(ids, -1, 1)
+    ti = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+    tt = tensor.Tensor(data=tgt, device=dev, requires_grad=False)
+    m.compile([ti], is_train=True, use_graph=True)
+    for _ in range(warmup):
+        _, loss = m(ti, tt)
+    loss.data.block_until_ready()
+    start = time.perf_counter()
+    for _ in range(niters):
+        _, loss = m(ti, tt)
+    loss.data.block_until_ready()
+    return niters * batch * seq / (time.perf_counter() - start)
 
 
 def child_main(platform):

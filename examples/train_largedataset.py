@@ -78,9 +78,13 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--size", type=int, default=16)
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (hermetic runs)")
     args = ap.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the config update matters even with the env var set: an
+    # environment sitecustomize may pin another backend over it
+    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
 

@@ -42,11 +42,11 @@ def main():
     ap.add_argument("--embed", type=int, default=32)
     ap.add_argument("--hidden", type=int, default=32)
     ap.add_argument("--lr", type=float, default=0.05)
-    ap.add_argument("--platform", default=None, choices=[None, "cpu"],
-                    nargs="?")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (hermetic runs)")
     args = ap.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu" or args.platform == "cpu":
+    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
 

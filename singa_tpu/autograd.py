@@ -1216,7 +1216,12 @@ def checkpoint(block, x):
         try:
             xin = Tensor(data=x_arr, device=x.device, requires_grad=False)
             out = block(xin)
-            return out.data if isinstance(out, Tensor) else out
+            if not isinstance(out, Tensor):
+                raise TypeError(
+                    "checkpoint() supports single-Tensor-output blocks; "
+                    f"{type(block).__name__}.forward returned "
+                    f"{type(out).__name__}")
+            return out.data
         finally:
             for t, a in zip(tensors, backup):
                 t.data = a

@@ -253,3 +253,53 @@ class TestPersistence:
         assert len(mp.graph.initializer) == 4  # 2x(W, b)
         names = {i.name for i in mp.graph.initializer}
         assert any("W" in n for n in names)
+
+
+class TestZooExport:
+    """The new model families round-trip through ONNX: grouped/depthwise
+    Conv (group attr), channel Cat, Fire squeeze/expand — the op shapes
+    the reference exercises only through its ONNX model zoo
+    (examples/onnx/{squeezenet,mobilenet,shufflenetv2}.py)."""
+
+    def _eval_roundtrip(self, m, x, rtol=1e-4):
+        m.eval()
+        m.forward(x)                # materialise params (inference mode)
+        mp = roundtrip(m, [x], rtol=rtol, atol=1e-5)
+        return [n.op_type for n in mp.graph.node]
+
+    def test_squeezenet_roundtrip(self):
+        from singa_tpu.models import squeezenet
+        m = squeezenet.create_model()
+        ops = self._eval_roundtrip(m, t(np.random.randn(1, 3, 64, 64)))
+        assert "Concat" in ops and "Conv" in ops
+
+    def test_mobilenet_block_roundtrip(self):
+        from singa_tpu.models import mobilenet
+
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.blk = mobilenet.InvertedResidual(8, 8, 1, 2)
+
+            def forward(self, x):
+                return self.blk(x)
+
+        m = Net()
+        ops = self._eval_roundtrip(m, t(np.random.randn(1, 8, 10, 10)))
+        assert "Conv" in ops and "Clip" in ops  # depthwise + relu6
+
+    def test_shufflenet_unit_roundtrip(self):
+        from singa_tpu.models import shufflenet
+
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.u = shufflenet.ShuffleUnit(8)
+
+            def forward(self, x):
+                return self.u(x)
+
+        m = Net()
+        ops = self._eval_roundtrip(m, t(np.random.randn(1, 8, 10, 10)))
+        assert "Split" in ops and "Concat" in ops and \
+            "Transpose" in ops  # channel split + shuffle
