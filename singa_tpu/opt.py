@@ -386,12 +386,37 @@ class DistOpt:
         self.backward_and_update(loss)
 
     # -- collectives -------------------------------------------------------
-    def all_reduce(self, arr):
-        return self.communicator.all_reduce(arr)
+    @staticmethod
+    def _shard_axes(p):
+        """Mesh axes ``p`` is sharded over (its Tensor.spec): per-shard
+        gradients on those axes are distinct values, not replicas, so they
+        are excluded from the gradient all-reduce — expert weights on
+        'expert', tensor-parallel weights on 'model'."""
+        spec = getattr(p, "spec", None)
+        if spec is None:
+            return ()
+        axes = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.update(entry)
+            else:
+                axes.add(entry)
+        return tuple(axes)
+
+    def all_reduce(self, arr, exclude=()):
+        return self.communicator.all_reduce(arr, exclude=exclude)
 
     def update(self, p: Tensor, g: Tensor):
         """Average an already-summed gradient and apply
-        (reference opt.py:738-746: grad /= world_size)."""
+        (reference opt.py:738-746: grad /= world_size).
+
+        The divisor is the FULL batch-shard count over every reduce axis,
+        even for shard-excluded params: an expert-sharded weight's gradient
+        already accumulates its expert-axis peers' token contributions
+        through the all-to-all transpose, so only the psum skips the axis —
+        the per-token averaging does not."""
         g.data = g.data / self.communicator.effective_world_size()
         self.opt.apply(p.name or f"param/{id(p)}", p, g)
 
@@ -401,7 +426,7 @@ class DistOpt:
         (reference opt.py:826-865). ``threshold`` is accepted for parity;
         XLA handles small-tensor fusion so no manual fused buffer exists."""
         for p, g in autograd.backward(loss):
-            g.data = self.all_reduce(g.data)
+            g.data = self.all_reduce(g.data, exclude=self._shard_axes(p))
             self.update(p, g)
         self.opt.step()
 
@@ -415,7 +440,8 @@ class DistOpt:
             if clipping:
                 grad = jnp.clip(grad, -clip_value, clip_value)
             half = grad.astype(jnp.bfloat16)
-            g.data = self.all_reduce(half).astype(jnp.float32)
+            g.data = self.all_reduce(
+                half, exclude=self._shard_axes(p)).astype(jnp.float32)
             self.update(p, g)
         self.opt.step()
 
@@ -435,7 +461,8 @@ class DistOpt:
         n = max(1, self.communicator.effective_world_size())
         step = self.opt.step_counter.data
         for i, (p, g) in enumerate(autograd.backward(loss)):
-            summed = self.all_reduce(g.data)
+            summed = self.all_reduce(g.data,
+                                     exclude=self._shard_axes(p))
             sel = jnp.equal(jnp.mod(step + i, n), 0)
             g.data = jnp.where(sel, summed / n, g.data)
             self.opt.apply(p.name or f"param/{id(p)}", p, g)
@@ -468,6 +495,7 @@ class DistOpt:
             sparse = jnp.where(mask, grad, 0.0)
             if corr:
                 self._residuals[name].data = grad - sparse
-            g.data = self.all_reduce(sparse)
+            g.data = self.all_reduce(sparse,
+                                     exclude=self._shard_axes(p))
             self.update(p, g)
         self.opt.step()

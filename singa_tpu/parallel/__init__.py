@@ -11,14 +11,15 @@ from .communicator import (Communicator, NcclIdHolder, get_mesh,
                            collective_context, active_axis)
 from .mesh import make_mesh, MeshConfig
 from .ops import (all_reduce, all_gather, reduce_scatter, pmean,
-                  copy_to_parallel)
+                  copy_to_parallel, all_to_all)
 from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
                               TPMLP)
 from .pipeline import pipeline_spmd, stack_stage_params, microbatch
+from .moe import MoEFFN
 
 __all__ = ["Communicator", "NcclIdHolder", "get_mesh", "collective_context",
            "active_axis", "make_mesh", "MeshConfig",
            "all_reduce", "all_gather", "reduce_scatter", "pmean",
-           "copy_to_parallel",
+           "copy_to_parallel", "all_to_all", "MoEFFN",
            "ColumnParallelLinear", "RowParallelLinear", "TPMLP",
            "pipeline_spmd", "stack_stage_params", "microbatch"]

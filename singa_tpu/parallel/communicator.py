@@ -115,20 +115,23 @@ class Communicator:
             world_size = jax.device_count()
         self.world_size = int(world_size)
 
-    def _active_reduce_axes(self):
-        return tuple(a for a in self.reduce_axes if active_axis(a))
+    def _active_reduce_axes(self, exclude=()):
+        return tuple(a for a in self.reduce_axes
+                     if active_axis(a) and a not in exclude)
 
-    def effective_world_size(self):
-        """Replica count actually participating in the current context."""
-        axes = self._active_reduce_axes()
+    def effective_world_size(self, exclude=()):
+        """Replica count actually participating in the current context.
+        ``exclude``: axes a parameter is SHARDED over (its per-shard values
+        are distinct, not replicas — e.g. expert weights on 'expert')."""
+        axes = self._active_reduce_axes(exclude)
         size = 1
         for a in axes:
             size *= lax.axis_size(a)
         return size
 
     # -- collectives (identity outside a mesh context) ---------------------
-    def all_reduce(self, arr):
-        axes = self._active_reduce_axes()
+    def all_reduce(self, arr, exclude=()):
+        axes = self._active_reduce_axes(exclude)
         if axes:
             return lax.psum(arr, axes)
         return arr

@@ -23,21 +23,24 @@ class MeshConfig:
     model: int = 1      # tp shards
     pipe: int = 1       # pp stages
     seq: int = 1        # sp shards (long-context)
+    expert: int = 1     # ep shards (MoE experts)
 
-    axis_order: tuple = ("data", "seq", "pipe", "model")
+    axis_order: tuple = ("data", "expert", "seq", "pipe", "model")
 
     def degrees(self, n_devices: int):
-        fixed = {"model": self.model, "pipe": self.pipe, "seq": self.seq}
+        fixed = {"model": self.model, "pipe": self.pipe, "seq": self.seq,
+                 "expert": self.expert}
         rest = n_devices
         for v in fixed.values():
             assert rest % v == 0, \
                 f"{n_devices} devices not divisible by {fixed}"
             rest //= v
         data = self.data if self.data != -1 else rest
-        assert data * self.model * self.pipe * self.seq == n_devices, \
+        assert (data * self.model * self.pipe * self.seq * self.expert
+                == n_devices), \
             f"mesh {self} does not cover {n_devices} devices"
-        return {"data": data, "seq": self.seq, "pipe": self.pipe,
-                "model": self.model}
+        return {"data": data, "expert": self.expert, "seq": self.seq,
+                "pipe": self.pipe, "model": self.model}
 
 
 def make_mesh(devices=None, config: MeshConfig | None = None) -> Mesh:

@@ -1,0 +1,171 @@
+"""Mixture-of-Experts FFN with expert parallelism (GShard-style).
+
+No reference equivalent (the reference is data-parallel only, SURVEY.md
+§2.4); this is the TPU-native 'ep' axis: expert weights shard over the
+mesh 'expert' axis (one expert group per peer), tokens shard over the
+batch-like axes, and two tiled ``lax.all_to_all`` exchanges carry each
+token to its expert's peer and back — the canonical MoE layout where the
+dispatch rides the ICI.
+
+Capacity-factor token dropping, top-1/top-2 gating with normalized
+combine weights, and the load-balance auxiliary loss follow the GShard
+formulation (einsum dispatch/combine over static shapes, so the whole
+layer jits into one XLA computation). Outside an active mesh context the
+all-to-alls degrade to identity and the same code computes the dense
+(single-device) MoE.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..autograd_base import Operator
+from ..layer import Layer, _param
+from ..tensor import Tensor
+from .communicator import active_axis
+
+
+class _MoEFFN(Operator):
+    """(T, D) tokens -> (T, D) expert-mixed output + scalar aux loss."""
+
+    def __init__(self, n_experts, top_k, capacity_factor, axis_name,
+                 batch_axes):
+        super().__init__()
+        self.E = n_experts
+        self.k = top_k
+        self.cf = capacity_factor
+        self.axis_name = axis_name
+        self.batch_axes = batch_axes
+
+    def forward(self, x, wg, w1, b1, w2, b2):
+        T, D = x.shape
+        E, k = self.E, self.k
+        C = max(1, math.ceil(k * T * self.cf / E))
+        f32 = jnp.float32
+        gates = jax.nn.softmax(jnp.dot(x.astype(f32), wg.astype(f32)))
+
+        # iterative top-k: pick, reserve capacity, mask out, repeat
+        masked = gates
+        count = jnp.zeros((E,), f32)          # tokens already queued
+        dispatch = jnp.zeros((T, E, C), f32)
+        picked_gates = []
+        picked_hot = []
+        first_mask = None
+        for _ in range(k):
+            idx = jnp.argmax(masked, axis=1)              # (T,)
+            hot = jax.nn.one_hot(idx, E, dtype=f32)       # (T, E)
+            if first_mask is None:
+                first_mask = hot
+            pos = jnp.cumsum(hot, axis=0) - hot + count   # queue position
+            keep = (pos < C).astype(f32) * hot
+            count = count + keep.sum(axis=0)
+            chot = jax.nn.one_hot(
+                (pos * hot).sum(axis=1).astype(jnp.int32), C,
+                dtype=f32)                                # (T, C)
+            dispatch = dispatch + keep[:, :, None] * chot[:, None, :]
+            picked_gates.append((gates * hot).sum(axis=1))  # (T,)
+            picked_hot.append(keep)
+            masked = masked * (1.0 - hot)
+
+        # combine weights: raw gate for top-1 (Switch — the gate gradient
+        # flows through the output scale), normalized across picks for
+        # top-k>=2 (GShard)
+        denom = sum(picked_gates) + 1e-9 if k > 1 else 1.0
+        combine = jnp.zeros((T, E, C), f32)
+        pos_of = dispatch.argmax(axis=2).astype(jnp.int32)  # (T, E)
+        chot_all = jax.nn.one_hot(pos_of, C, dtype=f32)     # (T, E, C)
+        for g, kept in zip(picked_gates, picked_hot):
+            w = (g / denom)[:, None] * kept                 # (T, E)
+            combine = combine + w[:, :, None] * chot_all
+
+        # dispatch -> expert-major buffer, exchange over the expert axis
+        ein = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        if active_axis(self.axis_name):
+            ep = lax.axis_size(self.axis_name)
+            if E % ep != 0:
+                raise ValueError(
+                    f"n_experts={E} must divide by the '{self.axis_name}' "
+                    f"mesh degree {ep}")
+            ein = lax.all_to_all(ein, self.axis_name, 0, 1, tiled=True)
+        # expert FFN on the local expert group (g = local experts)
+        h = jnp.einsum("gcd,gdf->gcf", ein, w1) + b1[:, None, :]
+        h = jax.nn.gelu(h)
+        out_e = jnp.einsum("gcf,gfd->gcd", h, w2) + b2[:, None, :]
+        if active_axis(self.axis_name):
+            out_e = lax.all_to_all(out_e, self.axis_name, 1, 0, tiled=True)
+        y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out_e)
+
+        # load-balance aux (GShard): E * sum_e mean_t(gate_e)*mean_t(pick1_e)
+        # — the means must be GLOBAL over the token batch: under sharding,
+        # a mean of per-shard products is not the product of global means
+        gmean = gates.mean(axis=0)
+        mmean = first_mask.mean(axis=0)
+        for ax in self.batch_axes:
+            if active_axis(ax):
+                gmean = lax.pmean(gmean, ax)
+                mmean = lax.pmean(mmean, ax)
+        aux = E * jnp.sum(gmean * mmean)
+        return y, aux.astype(x.dtype)
+
+
+class MoEFFN(Layer):
+    """Drop-in FFN block whose experts shard over the mesh 'expert' axis.
+
+    ``forward`` returns the mixed output; the load-balance auxiliary loss
+    of the latest call is exposed as ``self.aux_loss`` (a Tensor on the
+    tape — add ``alpha * aux_loss`` to the training loss).
+
+    ``n_experts`` must divide by the expert-axis degree; with no active
+    mesh the same layer computes the dense MoE on one device.
+    """
+
+    def __init__(self, n_experts, d_ff, top_k=2, capacity_factor=1.25,
+                 axis_name="expert", batch_axes=("data", "expert", "seq")):
+        super().__init__()
+        self.n_experts = n_experts
+        self.d_ff = d_ff
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.axis_name = axis_name
+        self.batch_axes = batch_axes
+        self.aux_loss = None
+
+    def initialize(self, x):
+        D, F, E = x.shape[-1], self.d_ff, self.n_experts
+        dev = x.device
+        self.wg = _param((D, E), dev)
+        self.wg.gaussian(0.0, math.sqrt(1.0 / D))
+        self.w1 = _param((E, D, F), dev)
+        self.w1.gaussian(0.0, math.sqrt(2.0 / (D + F)))
+        self.b1 = _param((E, F), dev)
+        self.w2 = _param((E, F, D), dev)
+        self.w2.gaussian(0.0, math.sqrt(2.0 / (D + F)))
+        self.b2 = _param((E, D), dev)
+        if self.axis_name:
+            for t in (self.w1, self.b1, self.w2, self.b2):
+                t.spec = P(self.axis_name)
+
+    def forward(self, x):
+        from .. import autograd
+        shape = x.shape
+        if len(shape) > 2:
+            x = autograd.reshape(x, (-1, shape[-1]))
+        y, aux = _MoEFFN(self.n_experts, self.top_k, self.capacity_factor,
+                         self.axis_name, self.batch_axes)(
+            x, self.wg, self.w1, self.b1, self.w2, self.b2)
+        self.aux_loss = aux
+        if len(shape) > 2:
+            y = autograd.reshape(y, shape)
+        return y
+
+    def _own_params(self):
+        return {"wg": self.wg, "w1": self.w1, "b1": self.b1,
+                "w2": self.w2, "b2": self.b2}
+
+
+__all__ = ["MoEFFN"]

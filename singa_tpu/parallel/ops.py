@@ -127,6 +127,31 @@ class PMean(Operator):
         return dy
 
 
+class AllToAll(Operator):
+    """Tiled all-to-all over a mesh axis (expert-parallel token dispatch:
+    split ``split_axis`` across the axis peers, concatenate what each peer
+    sends back along ``concat_axis``). Backward is the reverse exchange.
+    Identity outside an active mesh context (world of 1)."""
+
+    def __init__(self, axis_name="expert", split_axis=0, concat_axis=1):
+        super().__init__()
+        self.axis_name = axis_name
+        self.split_axis = split_axis
+        self.concat_axis = concat_axis
+
+    def forward(self, x):
+        if active_axis(self.axis_name):
+            return lax.all_to_all(x, self.axis_name, self.split_axis,
+                                  self.concat_axis, tiled=True)
+        return x
+
+    def backward(self, dy):
+        if active_axis(self.axis_name):
+            return lax.all_to_all(dy, self.axis_name, self.concat_axis,
+                                  self.split_axis, tiled=True)
+        return dy
+
+
 def all_reduce(x, axis_name="data"):
     return AllReduce(axis_name)(x)
 
@@ -145,3 +170,7 @@ def reduce_scatter(x, axis_name="model", scatter_axis=-1):
 
 def pmean(x, axis_name="data"):
     return PMean(axis_name)(x)
+
+
+def all_to_all(x, axis_name="expert", split_axis=0, concat_axis=1):
+    return AllToAll(axis_name, split_axis, concat_axis)(x)

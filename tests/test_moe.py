@@ -1,0 +1,174 @@
+"""Mixture-of-Experts FFN with expert parallelism (parallel/moe.py).
+
+No reference counterpart (the reference is data-parallel only,
+SURVEY.md §2.4) — this is the TPU-native 'ep' axis. The key invariant:
+an ep-sharded run computes the same mixture as the dense single-device
+run with the same weights, and expert-sharded gradients are reduced over
+the batch-like axes only.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from singa_tpu import autograd, device, layer, model, opt, tensor
+from singa_tpu.parallel import mesh as mesh_mod, moe
+from singa_tpu.parallel.communicator import set_mesh
+from singa_tpu.tensor import Tensor
+
+DEV = device.create_cpu_device()
+
+
+def t(arr, rg=False):
+    return Tensor(data=np.asarray(arr, np.float32), device=DEV,
+                  requires_grad=rg, stores_grad=rg)
+
+
+class MoENet(model.Model):
+    """x -> MoEFFN -> mean-square 'loss' against targets, plus the
+    load-balance aux term (the standard MoE training recipe)."""
+
+    def __init__(self, n_experts, d_ff, top_k=1, capacity_factor=8.0,
+                 axis_name="expert"):
+        super().__init__()
+        self.ffn = moe.MoEFFN(n_experts, d_ff, top_k=top_k,
+                              capacity_factor=capacity_factor,
+                              axis_name=axis_name)
+        self.loss_fn = layer.MeanSquareError()
+
+    def forward(self, x):
+        return self.ffn(x)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        loss = autograd.add(loss, autograd.mul(
+            self.ffn.aux_loss,
+            t(np.asarray(0.01, np.float32))))
+        self.optimizer(loss)
+        return out, loss
+
+
+class TestDenseMoE:
+    @pytest.fixture(autouse=True)
+    def _training(self):
+        from singa_tpu.autograd_base import CTX
+        prev = CTX.training
+        CTX.training = True
+        yield
+        CTX.training = prev
+
+    def test_top1_routes_to_best_expert(self):
+        """With huge capacity, every token reaches its argmax expert and
+        the output equals that expert's FFN weighted by its gate."""
+        rng = np.random.RandomState(0)
+        ffn = moe.MoEFFN(4, 16, top_k=1, capacity_factor=8.0,
+                         axis_name=None)
+        x = t(rng.randn(12, 8))
+        y = ffn(x)
+        gates = jax.nn.softmax(
+            np.asarray(x.data) @ np.asarray(ffn.wg.data))
+        choice = gates.argmax(1)
+        w1, b1 = np.asarray(ffn.w1.data), np.asarray(ffn.b1.data)
+        w2, b2 = np.asarray(ffn.w2.data), np.asarray(ffn.b2.data)
+        for i in range(12):
+            e = choice[i]
+            h = np.asarray(jax.nn.gelu(
+                np.asarray(x.data)[i] @ w1[e] + b1[e]))
+            want = (h @ w2[e] + b2[e]) * gates[i, e]
+            np.testing.assert_allclose(np.asarray(y.data)[i], want,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_top2_combines_normalized(self):
+        """Top-2 output is a convex mix of the two best experts."""
+        rng = np.random.RandomState(1)
+        ffn = moe.MoEFFN(4, 16, top_k=2, capacity_factor=8.0,
+                         axis_name=None)
+        x = t(rng.randn(6, 8))
+        y = ffn(x)
+        gates = jax.nn.softmax(
+            np.asarray(x.data) @ np.asarray(ffn.wg.data))
+        order = np.argsort(-gates, axis=1)
+        w1, b1 = np.asarray(ffn.w1.data), np.asarray(ffn.b1.data)
+        w2, b2 = np.asarray(ffn.w2.data), np.asarray(ffn.b2.data)
+        for i in range(6):
+            e1, e2 = order[i, 0], order[i, 1]
+            g1, g2 = gates[i, e1], gates[i, e2]
+            want = np.zeros(8, np.float32)
+            for e, g in ((e1, g1), (e2, g2)):
+                h = np.asarray(jax.nn.gelu(
+                    np.asarray(x.data)[i] @ w1[e] + b1[e]))
+                want += (h @ w2[e] + b2[e]) * (g / (g1 + g2))
+            np.testing.assert_allclose(np.asarray(y.data)[i], want,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_capacity_drops_overflow_tokens(self):
+        """With capacity 1 slot per expert, surplus tokens produce zero
+        output rows (GShard token dropping)."""
+        rng = np.random.RandomState(2)
+        # tiny capacity: C = ceil(1 * T * cf / E) with cf small
+        ffn = moe.MoEFFN(2, 8, top_k=1, capacity_factor=2.0 / 16.0,
+                         axis_name=None)
+        x = t(rng.randn(16, 4))
+        y = np.asarray(ffn(x).data)
+        zero_rows = (np.abs(y).sum(axis=1) < 1e-12).sum()
+        assert zero_rows >= 16 - 2 * 1  # at most C=1 token per expert
+
+    def test_aux_loss_scalar(self):
+        rng = np.random.RandomState(3)
+        ffn = moe.MoEFFN(4, 8, axis_name=None)
+        ffn(t(rng.randn(8, 4)))
+        assert ffn.aux_loss.shape == ()
+        assert np.isfinite(float(ffn.aux_loss.data))
+
+
+class TestExpertParallel:
+    def _train(self, axis_name, mesh_cfg, steps=4, seed=11):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 8).astype(np.float32)
+        DEV.SetRandSeed(seed)
+        m = MoENet(4, 16, top_k=2, capacity_factor=8.0,
+                   axis_name=axis_name)
+        if mesh_cfg is not None:
+            mesh = mesh_mod.make_mesh(jax.devices("cpu"), mesh_cfg)
+            set_mesh(mesh)
+            d = opt.DistOpt(opt.SGD(lr=0.1),
+                            reduce_axes=("data", "expert"))
+            d.communicator.mesh = mesh
+            m.set_optimizer(d)
+            m.input_specs = [P(("data", "expert")),
+                             P(("data", "expert"))]
+        else:
+            m.set_optimizer(opt.SGD(lr=0.1))
+        try:
+            tx = t(x)
+            ty = t(y)
+            m.compile([tx], is_train=True, use_graph=True)
+            losses = [float(m(tx, ty)[1].numpy()) for _ in range(steps)]
+            states = {k: np.asarray(jax.device_get(v.data))
+                      for k, v in m.get_states().items()}
+        finally:
+            set_mesh(None)
+        return losses, states
+
+    def test_ep_matches_dense(self):
+        """dp2 x ep4 training matches the single-device dense run: same
+        losses, same final weights (incl. expert-sharded ones)."""
+        base_losses, base_states = self._train(None, None)
+        ep_losses, ep_states = self._train(
+            "expert", mesh_mod.MeshConfig(expert=4))
+        np.testing.assert_allclose(ep_losses, base_losses, rtol=2e-4)
+        for k in base_states:
+            np.testing.assert_allclose(
+                ep_states[k], base_states[k], rtol=2e-3, atol=1e-5,
+                err_msg=k)
+
+    def test_ep_with_data_axis(self):
+        """ep2 composed with dp4 (tokens sharded over both axes)."""
+        base_losses, _ = self._train(None, None)
+        ep_losses, _ = self._train(
+            "expert", mesh_mod.MeshConfig(expert=2))
+        np.testing.assert_allclose(ep_losses, base_losses, rtol=2e-4)
