@@ -94,14 +94,22 @@ class MultiHeadAttention(layer.Layer):
 
 class TransformerBlock(layer.Layer):
     def __init__(self, d_model, n_heads, d_ff=None, causal=True, tp=True,
-                 seq_axis=None):
+                 seq_axis=None, moe=None):
+        """``moe``: number of experts; replaces the dense FFN with a
+        :class:`~singa_tpu.parallel.moe.MoEFFN` sharded over the mesh
+        'expert' axis (``self.mlp.aux_loss`` is valid only inside the
+        same train_one_batch trace)."""
         super().__init__()
         d_ff = d_ff or 4 * d_model
         self.ln1 = layer.LayerNorm()
         self.attn = MultiHeadAttention(d_model, n_heads, causal, tp,
                                        seq_axis)
         self.ln2 = layer.LayerNorm()
-        self.mlp = tp_mod.TPMLP(d_ff, d_model, activation="gelu")
+        if moe:
+            from ..parallel.moe import MoEFFN
+            self.mlp = MoEFFN(moe, d_ff)
+        else:
+            self.mlp = tp_mod.TPMLP(d_ff, d_model, activation="gelu")
 
     def forward(self, x):
         x = autograd.add(x, self.attn(self.ln1(x)))
@@ -117,7 +125,10 @@ class TransformerLM(model.Model):
 
     def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=2,
                  max_len=1024, causal=True, tp=True, seq_axis=None,
-                 remat=False):
+                 remat=False, moe=None, moe_aux_weight=0.01):
+        """``moe``: experts per block (MoE FFN over the 'expert' mesh
+        axis); the blocks' load-balance aux losses join the training loss
+        scaled by ``moe_aux_weight``."""
         super().__init__()
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -125,11 +136,19 @@ class TransformerLM(model.Model):
         # activation memory O(n_layers * block-boundary) instead of
         # O(n_layers * everything), the standard long-context trade
         self.remat = remat
+        if moe and remat:
+            # checkpoint() recomputes the block in an inner trace; the
+            # stashed aux_loss would escape it as a dead tracer
+            raise ValueError("moe and remat cannot combine yet: the MoE "
+                             "aux loss is stashed outside the "
+                             "rematerialized region")
+        self.moe = moe
+        self.moe_aux_weight = moe_aux_weight
         self.tok_emb = layer.Embedding(vocab_size, d_model)
         self.pos_emb = layer.Embedding(max_len, d_model)
         self._pos = _Positions(seq_axis)
         self.blocks = [TransformerBlock(d_model, n_heads, causal=causal,
-                                        tp=tp, seq_axis=seq_axis)
+                                        tp=tp, seq_axis=seq_axis, moe=moe)
                        for i in range(n_layers)]
         self.ln_f = layer.LayerNorm()
         self.head = layer.Linear(vocab_size)
@@ -149,6 +168,11 @@ class TransformerLM(model.Model):
         onehot = autograd.onehot(-1, targets, self.vocab_size)
         oh_flat = autograd.reshape(onehot, (B * S, V))
         loss = autograd.softmax_cross_entropy(flat, oh_flat)
+        if self.moe:
+            w = Tensor(data=np.asarray(self.moe_aux_weight, np.float32),
+                       device=ids.device, requires_grad=False)
+            for blk in self.blocks:
+                loss = autograd.add(loss, autograd.mul(blk.mlp.aux_loss, w))
         self.optimizer(loss)
         return logits, loss
 

@@ -49,12 +49,14 @@ class _MoEFFN(Operator):
         f32 = jnp.float32
         gates = jax.nn.softmax(jnp.dot(x.astype(f32), wg.astype(f32)))
 
-        # iterative top-k: pick, reserve capacity, mask out, repeat
+        # iterative top-k: pick, reserve capacity, mask out, repeat;
+        # dispatch and (unnormalized) combine accumulate per round from
+        # the same keep/slot increment
         masked = gates
         count = jnp.zeros((E,), f32)          # tokens already queued
         dispatch = jnp.zeros((T, E, C), f32)
+        combine = jnp.zeros((T, E, C), f32)
         picked_gates = []
-        picked_hot = []
         first_mask = None
         for _ in range(k):
             idx = jnp.argmax(masked, axis=1)              # (T,)
@@ -67,21 +69,19 @@ class _MoEFFN(Operator):
             chot = jax.nn.one_hot(
                 (pos * hot).sum(axis=1).astype(jnp.int32), C,
                 dtype=f32)                                # (T, C)
-            dispatch = dispatch + keep[:, :, None] * chot[:, None, :]
-            picked_gates.append((gates * hot).sum(axis=1))  # (T,)
-            picked_hot.append(keep)
+            inc = keep[:, :, None] * chot[:, None, :]     # (T, E, C)
+            dispatch = dispatch + inc
+            g = (gates * hot).sum(axis=1)                 # (T,)
+            combine = combine + g[:, None, None] * inc
+            picked_gates.append(g)
             masked = masked * (1.0 - hot)
 
         # combine weights: raw gate for top-1 (Switch — the gate gradient
         # flows through the output scale), normalized across picks for
         # top-k>=2 (GShard)
-        denom = sum(picked_gates) + 1e-9 if k > 1 else 1.0
-        combine = jnp.zeros((T, E, C), f32)
-        pos_of = dispatch.argmax(axis=2).astype(jnp.int32)  # (T, E)
-        chot_all = jax.nn.one_hot(pos_of, C, dtype=f32)     # (T, E, C)
-        for g, kept in zip(picked_gates, picked_hot):
-            w = (g / denom)[:, None] * kept                 # (T, E)
-            combine = combine + w[:, :, None] * chot_all
+        if k > 1:
+            denom = sum(picked_gates) + 1e-9              # (T,)
+            combine = combine / denom[:, None, None]
 
         # dispatch -> expert-major buffer, exchange over the expert axis
         ein = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
@@ -117,8 +117,11 @@ class MoEFFN(Layer):
     """Drop-in FFN block whose experts shard over the mesh 'expert' axis.
 
     ``forward`` returns the mixed output; the load-balance auxiliary loss
-    of the latest call is exposed as ``self.aux_loss`` (a Tensor on the
-    tape — add ``alpha * aux_loss`` to the training loss).
+    of the call is exposed as ``self.aux_loss`` — a tape Tensor that is
+    only valid INSIDE the same ``train_one_batch`` (add
+    ``alpha * aux_loss`` to the loss there; under graph mode it is a
+    traced value that dies with the trace, so it cannot be read for
+    logging after a compiled step).
 
     ``n_experts`` must divide by the expert-axis degree; with no active
     mesh the same layer computes the dense MoE on one device.
@@ -127,6 +130,9 @@ class MoEFFN(Layer):
     def __init__(self, n_experts, d_ff, top_k=2, capacity_factor=1.25,
                  axis_name="expert", batch_axes=("data", "expert", "seq")):
         super().__init__()
+        if top_k > n_experts:
+            raise ValueError(
+                f"top_k={top_k} cannot exceed n_experts={n_experts}")
         self.n_experts = n_experts
         self.d_ff = d_ff
         self.top_k = top_k

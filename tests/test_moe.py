@@ -172,3 +172,41 @@ class TestExpertParallel:
         ep_losses, _ = self._train(
             "expert", mesh_mod.MeshConfig(expert=2))
         np.testing.assert_allclose(ep_losses, base_losses, rtol=2e-4)
+
+
+class TestMoETransformer:
+    def test_moe_lm_trains_ep2(self):
+        """TransformerLM(moe=4) over a dp4 x ep2 mesh: compiled training
+        decreases loss; expert weights carry the 'expert' spec."""
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 23, (8, 10)).astype(np.float32)
+        tgt = np.roll(ids, -1, 1)
+        from singa_tpu.models import transformer
+        DEV.SetRandSeed(5)
+        mesh = mesh_mod.make_mesh(jax.devices("cpu"),
+                                  mesh_mod.MeshConfig(expert=2))
+        set_mesh(mesh)
+        try:
+            m = transformer.TransformerLM(23, d_model=16, n_heads=2,
+                                          n_layers=2, max_len=32,
+                                          tp=False, moe=4)
+            d = opt.DistOpt(opt.SGD(lr=0.1),
+                            reduce_axes=("data", "expert"))
+            d.communicator.mesh = mesh
+            m.set_optimizer(d)
+            m.input_specs = [P(("data", "expert")),
+                             P(("data", "expert"))]
+            ti = t(ids)
+            tt = t(tgt)
+            m.compile([ti], is_train=True, use_graph=True)
+            losses = [float(m(ti, tt)[1].numpy()) for _ in range(6)]
+            assert losses[-1] < losses[0], losses
+            w1 = m.blocks[0].mlp.w1
+            assert w1.spec == P("expert")
+        finally:
+            set_mesh(None)
+
+    def test_moe_remat_rejected(self):
+        from singa_tpu.models import transformer
+        with pytest.raises(ValueError, match="remat"):
+            transformer.TransformerLM(23, moe=4, remat=True)
