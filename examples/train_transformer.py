@@ -26,6 +26,10 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree (with --moe)")
+    ap.add_argument("--moe", type=int, default=0,
+                    help="experts per block (0 = dense FFN)")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -53,18 +57,26 @@ def main():
     model = transformer.TransformerLM(
         args.vocab, d_model=args.d_model, n_heads=args.heads,
         n_layers=args.layers, max_len=args.seq,
-        seq_axis="seq" if args.sp > 1 else None)
+        seq_axis="seq" if args.sp > 1 else None,
+        moe=args.moe or None)
     dist = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
-                       reduce_axes=("data", "seq"))
+                       reduce_axes=("data", "expert", "seq"))
     msh = mesh_mod.make_mesh(
-        jax.devices(), mesh_mod.MeshConfig(model=args.tp, seq=args.sp))
+        jax.devices(), mesh_mod.MeshConfig(model=args.tp, seq=args.sp,
+                                           expert=args.ep))
     print("mesh:", dict(msh.shape))
     dist.communicator.mesh = msh
     set_mesh(msh)
     model.set_optimizer(dist)
+    # tokens shard over every batch-like axis in use: data, expert
+    # (MoE peers hold distinct tokens), and seq on dim 1
+    batch_ax = ("data", "expert") if args.ep > 1 else "data"
     if args.sp > 1:
-        model.input_specs = [P("data", "seq"), P("data", "seq")]
-        model.output_specs = [P("data", "seq"), P()]
+        model.input_specs = [P(batch_ax, "seq"), P(batch_ax, "seq")]
+        model.output_specs = [P(batch_ax, "seq"), P()]
+    elif args.ep > 1:
+        model.input_specs = [P(batch_ax), P(batch_ax)]
+        model.output_specs = [P(batch_ax), P()]
     model.compile([tx], is_train=True, use_graph=True)
 
     model(tx, ty)  # eager warm-up

@@ -94,11 +94,13 @@ class MultiHeadAttention(layer.Layer):
 
 class TransformerBlock(layer.Layer):
     def __init__(self, d_model, n_heads, d_ff=None, causal=True, tp=True,
-                 seq_axis=None, moe=None):
+                 seq_axis=None, moe=None, moe_top_k=None,
+                 moe_capacity_factor=1.25):
         """``moe``: number of experts; replaces the dense FFN with a
         :class:`~singa_tpu.parallel.moe.MoEFFN` sharded over the mesh
         'expert' axis (``self.mlp.aux_loss`` is valid only inside the
-        same train_one_batch trace)."""
+        same train_one_batch trace). ``moe_top_k`` defaults to 2 clamped
+        to the expert count (so moe=1 means Switch-style top-1)."""
         super().__init__()
         d_ff = d_ff or 4 * d_model
         self.ln1 = layer.LayerNorm()
@@ -107,7 +109,9 @@ class TransformerBlock(layer.Layer):
         self.ln2 = layer.LayerNorm()
         if moe:
             from ..parallel.moe import MoEFFN
-            self.mlp = MoEFFN(moe, d_ff)
+            top_k = moe_top_k if moe_top_k is not None else min(2, moe)
+            self.mlp = MoEFFN(moe, d_ff, top_k=top_k,
+                              capacity_factor=moe_capacity_factor)
         else:
             self.mlp = tp_mod.TPMLP(d_ff, d_model, activation="gelu")
 
@@ -125,10 +129,12 @@ class TransformerLM(model.Model):
 
     def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=2,
                  max_len=1024, causal=True, tp=True, seq_axis=None,
-                 remat=False, moe=None, moe_aux_weight=0.01):
+                 remat=False, moe=None, moe_aux_weight=0.01,
+                 moe_top_k=None, moe_capacity_factor=1.25):
         """``moe``: experts per block (MoE FFN over the 'expert' mesh
         axis); the blocks' load-balance aux losses join the training loss
-        scaled by ``moe_aux_weight``."""
+        scaled by ``moe_aux_weight``. ``moe_top_k`` defaults to
+        min(2, moe)."""
         super().__init__()
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -147,9 +153,11 @@ class TransformerLM(model.Model):
         self.tok_emb = layer.Embedding(vocab_size, d_model)
         self.pos_emb = layer.Embedding(max_len, d_model)
         self._pos = _Positions(seq_axis)
-        self.blocks = [TransformerBlock(d_model, n_heads, causal=causal,
-                                        tp=tp, seq_axis=seq_axis, moe=moe)
-                       for i in range(n_layers)]
+        self.blocks = [TransformerBlock(
+            d_model, n_heads, causal=causal, tp=tp, seq_axis=seq_axis,
+            moe=moe, moe_top_k=moe_top_k,
+            moe_capacity_factor=moe_capacity_factor)
+            for i in range(n_layers)]
         self.ln_f = layer.LayerNorm()
         self.head = layer.Linear(vocab_size)
         self.loss_fn = layer.SoftMaxCrossEntropy()

@@ -829,10 +829,11 @@ class SingaBackend:
             steps = _ints(ins[4]) if len(ins) > 4 else None
             return autograd.slice(ins[0], starts, ends, axes, steps)
         if ty == "Clip":
-            mn = float(_arr(ins[1])) if len(ins) > 1 and ins[1] is not None \
-                else None
-            mx = float(_arr(ins[2])) if len(ins) > 2 and ins[2] is not None \
-                else None
+            # min/max arrive as 0-d or 1-element initializers
+            mn = float(np.asarray(_arr(ins[1])).reshape(-1)[0]) \
+                if len(ins) > 1 and ins[1] is not None else None
+            mx = float(np.asarray(_arr(ins[2])).reshape(-1)[0]) \
+                if len(ins) > 2 and ins[2] is not None else None
             return autograd.clip(ins[0], mn, mx)
         if ty in ("ReduceSum", "ReduceMean"):
             fn = autograd.reduce_sum if ty == "ReduceSum" \

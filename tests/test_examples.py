@@ -82,3 +82,10 @@ class TestExamples:
                            "--shards", "2", "--bs", "8", "--epochs", "2",
                            "--size", "12"])
         assert "epoch 1" in out, out[-500:]
+
+    def test_train_transformer_moe(self):
+        out = run_example(["examples/train_transformer.py", "--cpu",
+                           "--steps", "2", "--seq", "16", "--d-model",
+                           "32", "--heads", "2", "--layers", "1",
+                           "--bs", "8", "--moe", "4", "--ep", "2"])
+        assert "'expert': 2" in out and "loss" in out, out[-500:]
