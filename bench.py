@@ -224,6 +224,14 @@ def main():
             errors.append(f"tpu#{i + 1}: {err}")
             print(f"bench: tpu attempt {i + 1} failed ({err})",
                   file=sys.stderr)
+    elif perr and "timeout" in perr:
+        # a probe TIMEOUT (vs "no accelerator visible") may be a very
+        # slow init rather than a hang: one bounded real attempt
+        res, err = _attempt("tpu", 600)
+        if res is None:
+            errors.append(f"tpu slow-init attempt: {err}")
+            print(f"bench: slow-init tpu attempt failed ({err})",
+                  file=sys.stderr)
     if res is None:
         # last resort: a CPU number, clearly labeled, so the round still
         # records a real measurement instead of a traceback

@@ -1181,11 +1181,31 @@ class _Checkpointed(Operator):
         return self._ck(key, x, *params)
 
 
+def _aux_layers(block):
+    """Layers in ``block``'s tree that stash an ``aux_loss`` Tensor during
+    forward (MoE load-balance losses), in deterministic traversal order."""
+    found = []
+
+    def walk(l):
+        if hasattr(l, "aux_loss"):
+            found.append(l)
+        for _name, sub in sorted(l._sublayers()):
+            walk(sub)
+
+    walk(block)
+    return found
+
+
 def checkpoint(block, x):
     """Apply ``block`` (a Layer) to Tensor ``x`` with rematerialized
     backward: ``y = checkpoint(blk, x)`` is numerically ``blk(x)`` but
     stores only the block's inputs, recomputing its inside during the
     gradient pass (``jax.checkpoint``).
+
+    Auxiliary losses stashed by sublayers during forward (``aux_loss``
+    attributes, e.g. MoE load-balance terms) are threaded out of the
+    rematerialized region as extra op outputs and re-stashed, so
+    ``blk.mlp.aux_loss`` stays usable in the surrounding loss.
 
     On the first call (shape-inferring initialization) the block runs
     un-checkpointed so its parameters materialize; every later call —
@@ -1208,6 +1228,7 @@ def checkpoint(block, x):
             "running statistics (LayerNorm) inside checkpointed blocks")
     names = sorted(params)
     tensors = [params[n] for n in names]
+    aux_layers = _aux_layers(block)
 
     def run(x_arr, *param_arrs):
         backup = [t.data for t in tensors]
@@ -1221,6 +1242,10 @@ def checkpoint(block, x):
                     "checkpoint() supports single-Tensor-output blocks; "
                     f"{type(block).__name__}.forward returned "
                     f"{type(out).__name__}")
+            auxs = tuple(l.aux_loss.data for l in aux_layers
+                         if l.aux_loss is not None)
+            if auxs:
+                return (out.data,) + auxs
             return out.data
         finally:
             for t, a in zip(tensors, backup):
@@ -1229,7 +1254,14 @@ def checkpoint(block, x):
     op = _Checkpointed(run)
     key = x.device.rand_key()
     kt = Tensor(data=key, device=x.device, requires_grad=False)
-    return op(kt, x, *tensors)
+    res = op(kt, x, *tensors)
+    if isinstance(res, (tuple, list)):
+        y, auxs = res[0], list(res[1:])
+        live = [l for l in aux_layers if l.aux_loss is not None]
+        for l, a in zip(live, auxs):
+            l.aux_loss = a
+        return y
+    return res
 
 
 # ---- conv/bn/pool/rnn ops live in singa_tpu.ops; re-export here for parity

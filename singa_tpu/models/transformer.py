@@ -142,12 +142,6 @@ class TransformerLM(model.Model):
         # activation memory O(n_layers * block-boundary) instead of
         # O(n_layers * everything), the standard long-context trade
         self.remat = remat
-        if moe and remat:
-            # checkpoint() recomputes the block in an inner trace; the
-            # stashed aux_loss would escape it as a dead tracer
-            raise ValueError("moe and remat cannot combine yet: the MoE "
-                             "aux loss is stashed outside the "
-                             "rematerialized region")
         self.moe = moe
         self.moe_aux_weight = moe_aux_weight
         self.tok_emb = layer.Embedding(vocab_size, d_model)

@@ -206,7 +206,26 @@ class TestMoETransformer:
         finally:
             set_mesh(None)
 
-    def test_moe_remat_rejected(self):
+    def test_moe_with_remat_matches(self):
+        """MoE blocks under activation checkpointing: the aux losses are
+        threaded out of the rematerialized region, and the training
+        trajectory matches the non-remat run exactly."""
         from singa_tpu.models import transformer
-        with pytest.raises(ValueError, match="remat"):
-            transformer.TransformerLM(23, moe=4, remat=True)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 23, (4, 10)).astype(np.float32)
+        tgt = np.roll(ids, -1, 1)
+
+        def train(remat):
+            DEV.SetRandSeed(9)
+            m = transformer.TransformerLM(23, d_model=16, n_heads=2,
+                                          n_layers=2, max_len=32,
+                                          tp=False, moe=4, remat=remat)
+            m.set_optimizer(opt.SGD(lr=0.1))
+            ti = t(ids)
+            tt = t(tgt)
+            m.compile([ti], is_train=True, use_graph=True)
+            return [float(m(ti, tt)[1].numpy()) for _ in range(4)]
+
+        base = train(False)
+        rem = train(True)
+        np.testing.assert_allclose(rem, base, rtol=1e-5)
