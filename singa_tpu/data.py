@@ -143,3 +143,48 @@ class NumpyBatchIter:
         for b in range(self.num_batches):
             sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
             yield self.x[sel], self.y[sel]
+
+
+class DevicePrefetcher:
+    """Keep the NEXT batch's host-to-device transfer in flight while the
+    current batch computes.
+
+    Wraps any iterator of numpy-array tuples and yields Tensors already
+    resident on ``device``. ``jax.device_put`` is asynchronous, so holding
+    ``depth`` batches ahead overlaps the H2D copies (PCIe/DMA) with the
+    compiled step — the TPU-side counterpart of the host-side prefetch
+    thread above (reference ImageBatchIter, python/singa/data.py:60-124,
+    prefetches into host memory only; there is no device staging in the
+    reference because CUDA streams hide it).
+
+    Usage::
+
+        for tx, ty in DevicePrefetcher(batches, dev):
+            out, loss = model(tx, ty)
+    """
+
+    def __init__(self, iterator, device, depth=2):
+        from .tensor import Tensor
+        self._Tensor = Tensor
+        self.iterator = iterator       # re-iterated per epoch in __iter__
+        self.device = device
+        self.depth = max(1, int(depth))
+
+    def _stage(self, batch):
+        if not isinstance(batch, (tuple, list)):
+            batch = (batch,)
+        # Tensor.__init__ routes numpy input through device.put (async)
+        return tuple(
+            self._Tensor(data=np.asarray(a), device=self.device,
+                         requires_grad=False)
+            for a in batch)
+
+    def __iter__(self):
+        from collections import deque
+        pending = deque()
+        for batch in iter(self.iterator):
+            pending.append(self._stage(batch))
+            if len(pending) >= self.depth:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()

@@ -278,3 +278,41 @@ class TestDataPipeline:
             assert imgs2.shape == (4, 3, 8, 8)
         finally:
             it.end()
+
+
+class TestDevicePrefetcher:
+    def test_yields_all_batches_in_order_on_device(self):
+        from singa_tpu.data import DevicePrefetcher, NumpyBatchIter
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+        x = np.arange(64, dtype=np.float32).reshape(16, 4)
+        y = np.arange(16, dtype=np.float32)
+        it = NumpyBatchIter(x, y, 4, shuffle=False)
+        got = list(DevicePrefetcher(it, dev, depth=2))
+        assert len(got) == 4
+        for b, (tx, ty) in enumerate(got):
+            np.testing.assert_array_equal(tx.numpy(), x[b * 4:(b + 1) * 4])
+            np.testing.assert_array_equal(ty.numpy(), y[b * 4:(b + 1) * 4])
+            assert tx.device is dev
+
+    def test_depth_one_and_short_streams(self):
+        from singa_tpu.data import DevicePrefetcher
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+        got = list(DevicePrefetcher(iter([(np.ones(2, np.float32),)]),
+                                    dev, depth=4))
+        assert len(got) == 1 and got[0][0].shape == (2,)
+        assert list(DevicePrefetcher(iter([]), dev)) == []
+
+    def test_epoch_reiteration(self):
+        """Wrapping a re-iterable source (NumpyBatchIter) survives
+        multi-epoch reuse — each epoch re-pulls fresh batches."""
+        from singa_tpu.data import DevicePrefetcher, NumpyBatchIter
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        y = np.arange(8, dtype=np.float32)
+        pf = DevicePrefetcher(NumpyBatchIter(x, y, 4, shuffle=False),
+                              dev, depth=2)
+        for _epoch in range(3):
+            assert len(list(pf)) == 2
