@@ -181,8 +181,18 @@ class DevicePrefetcher:
 
     def __iter__(self):
         from collections import deque
+        src = iter(self.iterator)
+        if src is self.iterator:
+            # a one-shot iterator/generator: a second epoch over it would
+            # silently yield nothing — make that an actionable error
+            if getattr(self, "_consumed_oneshot", False):
+                raise RuntimeError(
+                    "DevicePrefetcher wrapped a one-shot iterator that is "
+                    "already exhausted; pass a re-iterable (e.g. "
+                    "NumpyBatchIter) for multi-epoch use")
+            self._consumed_oneshot = True
         pending = deque()
-        for batch in iter(self.iterator):
+        for batch in src:
             pending.append(self._stage(batch))
             if len(pending) >= self.depth:
                 yield pending.popleft()

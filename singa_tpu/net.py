@@ -80,7 +80,11 @@ class FeedForwardNet(Model):
                                 shuffle=shuffle, seed=epoch)
             losses, metrics = [], []
             nb = it.num_batches
-            for i, (bx, by) in enumerate(it):
+            # device staging one batch ahead: H2D transfer of the
+            # next batch overlaps the current compiled step
+            from .data import DevicePrefetcher
+            for i, (bx, by) in enumerate(
+                    DevicePrefetcher(it, dev, depth=2)):
                 out, loss = self.train_on_batch(bx, by, dev)
                 losses.append(float(loss.data))
                 metrics.append(self.metric.evaluate(out, by))

@@ -89,3 +89,8 @@ class TestExamples:
                            "32", "--heads", "2", "--layers", "1",
                            "--bs", "8", "--moe", "4", "--ep", "2"])
         assert "'expert': 2" in out and "loss" in out, out[-500:]
+
+    def test_train_ffnet(self):
+        out = run_example(["examples/train_ffnet.py", "--cpu", "--n", "64",
+                           "--epochs", "1", "--size", "12", "--bs", "16"])
+        assert "final eval" in out, out[-500:]
