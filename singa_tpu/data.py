@@ -180,14 +180,18 @@ class DevicePrefetcher:
             for a in batch)
 
     def __iter__(self):
+        import types
         from collections import deque
         src = iter(self.iterator)
-        if src is self.iterator:
-            # a one-shot iterator/generator: a second epoch over it would
-            # silently yield nothing — make that an actionable error
+        if isinstance(self.iterator, types.GeneratorType):
+            # an exhausted generator silently yields nothing — make a
+            # second epoch over it an actionable error. (Live streaming
+            # iterators like ImageBatchIter also return self from
+            # __iter__ but keep producing, so only generators are
+            # flagged.)
             if getattr(self, "_consumed_oneshot", False):
                 raise RuntimeError(
-                    "DevicePrefetcher wrapped a one-shot iterator that is "
+                    "DevicePrefetcher wrapped a generator that is "
                     "already exhausted; pass a re-iterable (e.g. "
                     "NumpyBatchIter) for multi-epoch use")
             self._consumed_oneshot = True

@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from . import layer as layer_mod
-from .data import NumpyBatchIter
+from .data import DevicePrefetcher, NumpyBatchIter
 from .metric import Accuracy
 from .model import Model
 from .tensor import Tensor
@@ -81,11 +81,21 @@ class FeedForwardNet(Model):
             losses, metrics = [], []
             nb = it.num_batches
             # device staging one batch ahead: H2D transfer of the
-            # next batch overlaps the current compiled step
-            from .data import DevicePrefetcher
-            for i, (bx, by) in enumerate(
-                    DevicePrefetcher(it, dev, depth=2)):
-                out, loss = self.train_on_batch(bx, by, dev)
+            # next batch overlaps the current compiled step. Host
+            # label arrays ride alongside so the metric (host-side
+            # numpy) doesn't read labels back from the device.
+            from collections import deque
+            host_y = deque()
+
+            def src():
+                for bx, by in it:
+                    host_y.append(by)
+                    yield bx, by
+
+            for i, (tbx, tby) in enumerate(
+                    DevicePrefetcher(src(), dev, depth=2)):
+                by = host_y.popleft()
+                out, loss = self.train_on_batch(tbx, tby, dev)
                 losses.append(float(loss.data))
                 metrics.append(self.metric.evaluate(out, by))
                 if verbose:

@@ -628,3 +628,29 @@ class Test1F1B:
         sl = self._train_model(False)
         assert dl[-1] < dl[0] * 0.9, dl
         np.testing.assert_allclose(dl, sl, rtol=1e-3)
+
+
+class TestDispatchFlood:
+    def test_rapid_dist_steps_do_not_starve_collectives(self):
+        """A tight host loop over a compiled DistOpt step must not crash
+        the backend: without the in-flight fence, hundreds of queued
+        8-device programs starve XLA's collective rendezvous (the CPU
+        backend aborts the process after 40s)."""
+        dev = device.create_cpu_device()
+        msh = mesh_mod.make_mesh(jax.devices("cpu"),
+                                 mesh_mod.MeshConfig())
+        set_mesh(msh)
+        try:
+            x, y = make_data(n=32)
+            tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+            ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+            m = TPModel()
+            d = opt.DistOpt(opt.SGD(lr=0.05))
+            d.communicator.mesh = msh
+            m.set_optimizer(d)
+            m.compile([tx], is_train=True, use_graph=True)
+            for _ in range(300):      # no blocking between dispatches
+                out, loss = m(tx, ty)
+            assert np.isfinite(float(loss.data))
+        finally:
+            set_mesh(None)
