@@ -43,7 +43,8 @@ def run_rank(args):
         import jax
 
     import numpy as np
-    from singa_tpu import device, opt, tensor
+    from jax.sharding import PartitionSpec as P
+    from singa_tpu import device, layer, model as model_mod, opt, tensor
     from singa_tpu.models import cnn
     from singa_tpu.parallel import communicator, mesh as mesh_mod
 
@@ -56,23 +57,60 @@ def run_rank(args):
     print(f"rank {args.rank}/{args.procs}: {n_local} local / "
           f"{n_global} global devices", flush=True)
 
-    mesh = mesh_mod.make_mesh(jax.devices(), mesh_mod.MeshConfig())
+    rng = np.random.RandomState(0)
+    gb = args.bs * n_global
+    if args.moe:
+        # expert-parallel across HOSTS: the 'expert' axis is made the
+        # OUTERMOST mesh axis so (with process-major device order) each
+        # process owns one expert group — expert weights genuinely shard
+        # cross-process, and save_states gathers them over the process
+        # group
+        from singa_tpu.parallel.moe import MoEFFN
+
+        class MoENet(model_mod.Model):
+            def __init__(self):
+                super().__init__()
+                self.ffn = MoEFFN(args.moe, 32, top_k=2,
+                                  capacity_factor=4.0)
+                self.loss_fn = layer.MeanSquareError()
+
+            def forward(self, xx):
+                return self.ffn(xx)
+
+            def train_one_batch(self, xx, yy):
+                o = self.forward(xx)
+                ls = self.loss_fn(o, yy)
+                self.optimizer(ls)
+                return o, ls
+
+        mesh_cfg = mesh_mod.MeshConfig(
+            expert=args.procs,
+            axis_order=("expert", "data", "seq", "pipe", "model"))
+        dist_kw = {"reduce_axes": ("data", "expert")}
+        make_model = MoENet
+        x = rng.randn(gb, 16).astype(np.float32)
+        y = rng.randn(gb, 16).astype(np.float32)
+    else:
+        mesh_cfg = mesh_mod.MeshConfig()
+        dist_kw = {"world_size": n_global}
+        make_model = lambda: cnn.create_model(num_channels=1)  # noqa: E731
+        x = rng.randn(gb, 1, 28, 28).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, gb)]
+
+    mesh = mesh_mod.make_mesh(jax.devices(), mesh_cfg)
     communicator.set_mesh(mesh)
     dev = device.Device(jax.local_devices()[0])
     dev.SetRandSeed(7)
-
-    model = cnn.create_model(num_channels=1)
-    dist = opt.DistOpt(opt.SGD(lr=args.lr, momentum=0.9),
-                       world_size=n_global)
+    model = make_model()
+    dist = opt.DistOpt(opt.SGD(lr=args.lr, momentum=0.9), **dist_kw)
     dist.communicator.mesh = mesh
     model.set_optimizer(dist)
+    if args.moe:
+        model.input_specs = [P(("data", "expert")),
+                             P(("data", "expert"))]
 
     # SPMD convention: every process feeds the same GLOBAL batch; the
-    # device_put inside the compiled step keeps only the local shard
-    rng = np.random.RandomState(0)
-    gb = args.bs * n_global
-    x = rng.randn(gb, 1, 28, 28).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, gb)]
+    # placement inside the compiled step keeps only the local shard
     tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
     ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
 
@@ -86,6 +124,13 @@ def run_rank(args):
     dt = time.time() - t0
     print(f"rank {args.rank}: {args.steps} steps, loss {lv:.4f}, "
           f"{args.steps * gb / dt:.1f} img/s global", flush=True)
+
+    if args.save:
+        # collective: every rank participates in the cross-process gather
+        # of host-sharded state; each writes its own (identical) copy
+        path = f"{args.save}.rank{args.rank}.zip"
+        model.save_states(path)
+        print(f"rank {args.rank}: saved {path}", flush=True)
 
 
 def main():
@@ -103,6 +148,12 @@ def main():
     ap.add_argument("--bs", type=int, default=8,
                     help="per-device batch size")
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--moe", type=int, default=0,
+                    help="experts for a cross-host expert-parallel MoE "
+                         "run (0 = data-parallel CNN)")
+    ap.add_argument("--save", default="",
+                    help="checkpoint path prefix written after "
+                         "training (collective across ranks)")
     args = ap.parse_args()
 
     if args.rank is not None:
@@ -127,7 +178,7 @@ def main():
         cmd = [sys.executable, os.path.abspath(__file__),
                "--rank", str(r)]
         for k in ("procs", "coordinator", "devices_per_proc", "platform",
-                  "steps", "bs", "lr"):
+                  "steps", "bs", "lr", "moe", "save"):
             cmd += [f"--{k.replace('_', '-')}", str(getattr(args, k))]
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)

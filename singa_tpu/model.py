@@ -588,7 +588,8 @@ class Model(Layer):
             arr = t.data
             if hasattr(arr, "devices") and not isinstance(
                     arr, jax.core.Tracer) and len(arr.devices()) > 1:
-                t.data = self.dev.put(np.asarray(jax.device_get(arr)))
+                from .tensor import to_host
+                t.data = self.dev.put(to_host(arr))
 
     def __call__(self, *args, **kwargs):
         if self._train:
@@ -617,8 +618,9 @@ class Model(Layer):
         states = {k: v for k, v in self.get_states().items()}
         attr = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                 for k, v in states.items()}
-        arrays = {k: np.asarray(jax.device_get(v.data))
-                  for k, v in states.items()}
+        from .tensor import to_host_tree
+        # one batched cross-process gather for every host-sharded param
+        arrays = to_host_tree({k: v.data for k, v in states.items()})
         opt = getattr(self, "optimizer", None)
         if opt is not None and hasattr(opt, "get_states"):
             for k, v in opt.get_states().items():

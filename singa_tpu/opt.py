@@ -198,9 +198,12 @@ class Optimizer:
         return [self.step_counter] + list(self._aux.values())
 
     def get_states(self):
+        from .tensor import to_host_tree
         states = {"step_counter": np.asarray(self.step_counter.data)}
-        for k, v in self._aux.items():
-            states[k] = np.asarray(jax.device_get(v.data))
+        # batched gather: host-sharded aux (e.g. expert momentum) pays
+        # one cross-process collective for the whole dict
+        states.update(to_host_tree({k: v.data
+                                    for k, v in self._aux.items()}))
         return states
 
     def set_states(self, states):

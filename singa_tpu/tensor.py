@@ -592,3 +592,38 @@ def sum_columns(M):
 
 def sum_rows(M):
     return _wrap(jnp.sum(_raw(M), axis=0), M)
+
+
+def to_host(arr):
+    """Host copy of a (possibly mesh-sharded) array. Under multi-process
+    training an array sharded across hosts is gathered over the process
+    group first (collective: every participating process must call this
+    together); replicated or locally-addressable arrays copy directly."""
+    if hasattr(arr, "sharding") and \
+            not getattr(arr, "is_fully_addressable", True) and \
+            not getattr(arr, "is_fully_replicated", False):
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr,
+                                                            tiled=True))
+    return np.asarray(jax.device_get(arr))
+
+
+def to_host_tree(named):
+    """Host copies of a dict of arrays, batching the cross-process
+    gathers of every host-sharded entry into ONE collective (a checkpoint
+    with N sharded params pays one dispatch, not N)."""
+    from jax.experimental import multihost_utils
+    out = {}
+    sharded = {}
+    for k, a in named.items():
+        if hasattr(a, "sharding") and \
+                not getattr(a, "is_fully_addressable", True) and \
+                not getattr(a, "is_fully_replicated", False):
+            sharded[k] = a
+        else:
+            out[k] = np.asarray(jax.device_get(a))
+    if sharded:
+        gathered = multihost_utils.process_allgather(sharded, tiled=True)
+        for k, v in gathered.items():
+            out[k] = np.asarray(v)
+    return out

@@ -43,3 +43,42 @@ def test_two_process_data_parallel_training():
     assert losses[0] == pytest.approx(losses[1], rel=1e-6), losses
     # global device count seen by each rank
     assert out.count("2 local / 4 global devices") == 2, out[-3000:]
+
+
+def test_cross_host_sharded_checkpoint():
+    """MoE expert weights shard ACROSS processes; save_states gathers
+    them over the process group — both ranks write identical full-shape
+    checkpoints (incl. sharded optimizer momentum)."""
+    import io
+    import tempfile
+    import zipfile
+
+    import numpy as np
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "ck")
+        proc = subprocess.run(
+            [sys.executable, EXAMPLE, "--procs", "2", "--steps", "2",
+             "--bs", "4", "--devices-per-proc", "2", "--moe", "4",
+             "--save", prefix,
+             "--coordinator", f"127.0.0.1:{_free_port()}"],
+            capture_output=True, text=True, timeout=540, env=env)
+        out = proc.stdout + proc.stderr
+        assert proc.returncode == 0, out[-3000:]
+
+        def arrs(p):
+            with zipfile.ZipFile(p) as z:
+                d = np.load(io.BytesIO(z.read("tensor_dict.npz")))
+                return {k: d[k] for k in d.files}
+
+        a = arrs(f"{prefix}.rank0.zip")
+        b = arrs(f"{prefix}.rank1.zip")
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+        # expert weights came out full-shape, not the per-process shard
+        w1 = next(v for k, v in a.items()
+                  if k.endswith("ffn.w1") and not k.startswith("optimizer"))
+        assert w1.shape == (4, 16, 32)
