@@ -584,12 +584,18 @@ class Model(Layer):
         with single-device inputs."""
         if self._state_list is None:
             return
+        gather = {}
         for t in self._state_list:
             arr = t.data
             if hasattr(arr, "devices") and not isinstance(
                     arr, jax.core.Tracer) and len(arr.devices()) > 1:
-                from .tensor import to_host
-                t.data = self.dev.put(to_host(arr))
+                gather[id(t)] = (t, arr)
+        if gather:
+            # one batched cross-process gather for everything host-sharded
+            from .tensor import to_host_tree
+            hosts = to_host_tree({k: a for k, (_t, a) in gather.items()})
+            for k, (t, _a) in gather.items():
+                t.data = self.dev.put(hosts[k])
 
     def __call__(self, *args, **kwargs):
         if self._train:

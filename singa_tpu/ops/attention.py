@@ -22,6 +22,8 @@ from __future__ import annotations
 import functools
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -213,7 +215,13 @@ def _causal_positions(qi, kj, block_q, block_k):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *,
-                      causal, scale, block_q, block_k, nkb):
+                      causal, scale, block_q, block_k, nkb,
+                      offset_ref=None):
+    """``offset_ref`` (optional (1,1) i32 input placed before q_ref by the
+    caller): global-position delta ``q_offset - k_offset`` for causal
+    masking when q and k come from different sequence shards (ring
+    attention). With a delta the k-grid is not pruned — masking handles
+    everything — so the write happens at the final k block."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -223,7 +231,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else kj >= 0
+    if causal and offset_ref is None:
+        run = kj * block_k <= (qi + 1) * block_q - 1
+    else:
+        run = kj >= 0
 
     @pl.when(run)
     def _compute():
@@ -231,12 +242,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         kblk = k_ref[0].astype(jnp.float32)       # (block_k, D)
         vblk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        mask = None
         if causal:
             q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            if offset_ref is not None:
+                q_pos = q_pos + offset_ref[0, 0]
+            mask = k_pos <= q_pos
+            s = jnp.where(mask, s, _NEG_INF)
         m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
+        if mask is not None and offset_ref is not None:
+            # a FULLY-masked row has m_new == _NEG_INF (finite), making
+            # exp(s - m_new) == 1 on masked entries — zero them explicitly
+            # (offset grids are not pruned, so such blocks do occur)
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
@@ -245,7 +265,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # the last k-block this q-block attends to writes the result
     last = jnp.minimum(nkb - 1, ((qi + 1) * block_q - 1) // block_k) \
-        if causal else nkb - 1
+        if (causal and offset_ref is None) else nkb - 1
 
     @pl.when(kj == last)
     def _write():
@@ -327,8 +347,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
-    """(B, H, S, D) fused attention forward on the MXU -> (out, lse)."""
+def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128,
+                      pos_delta=None):
+    """(B, H, S, D) fused attention forward on the MXU -> (out, lse).
+
+    ``pos_delta`` (traced i32 scalar, optional): global-position delta
+    ``q_offset - k_offset`` when q and k come from different sequence
+    shards (ring attention feeds the visiting k/v block's offset per ring
+    step). With a delta, causal masking uses global positions and the
+    k grid is not pruned."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
@@ -339,17 +366,33 @@ def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
-    kernel = functools.partial(_flash_fwd_kernel, causal=causal,
-                               scale=scale, block_q=block_q,
-                               block_k=block_k, nkb=nkb)
+    with_off = pos_delta is not None
+
+    def kernel(*refs):
+        if with_off:
+            off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mr, lr = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mr, lr = refs
+            off_ref = None
+        _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                          acc, mr, lr, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, nkb=nkb,
+                          offset_ref=off_ref)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if with_off:
+        in_specs = [pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))] + in_specs
+        operands = [jnp.asarray(pos_delta, jnp.int32).reshape(1, 1)] + \
+            operands
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Sq // block_q, nkb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -364,7 +407,7 @@ def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
             pltpu.VMEM((block_q,), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qr, kr, vr)
+    )(*operands)
     return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
 
 
@@ -473,6 +516,50 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 # ring attention (sequence parallel over a mesh axis)
 # ---------------------------------------------------------------------------
 
+def _ring_partials_scan(qf, kr, vr, delta, causal, scale, block_k):
+    """Normalized block-attention partials via the differentiable scan
+    path: (out / l, m + log l). Only the position DELTA matters for
+    causal masking, so (q_offset=delta, k_offset=0) is equivalent to any
+    (q_off, k_off) with the same difference."""
+    po, pm, pl = _block_scan_attention(qf, kr, vr, causal, scale, block_k,
+                                       q_offset=delta, k_offset=0)
+    lsafe = jnp.maximum(pl, 1e-30)
+    return po / lsafe[..., None], pm + jnp.log(lsafe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_partials(qf, kr, vr, delta, causal, scale, block_k):
+    """One ring step's block attention -> normalized (out, lse) partials.
+
+    Primal dispatches to the fused Pallas kernel when available (the MXU
+    path; the per-step position delta rides in as a traced scalar);
+    backward recomputes through the differentiable scan path — same
+    O(S/n) activation footprint, exact same masking semantics."""
+    if _use_pallas(qf, kr, 128, 128):
+        return _pallas_flash_fwd(qf, kr, vr, causal, scale,
+                                 pos_delta=delta)
+    return _ring_partials_scan(qf, kr, vr, delta, causal, scale, block_k)
+
+
+def _ring_partials_fwd(qf, kr, vr, delta, causal, scale, block_k):
+    out = _ring_partials(qf, kr, vr, delta, causal, scale, block_k)
+    return out, (qf, kr, vr, delta)
+
+
+def _ring_partials_bwd(causal, scale, block_k, res, cots):
+    qf, kr, vr, delta = res
+    _, vjp_fn = jax.vjp(
+        lambda q, kk, vv: _ring_partials_scan(q, kk, vv, delta, causal,
+                                              scale, block_k),
+        qf, kr, vr)
+    dq, dk, dv = vjp_fn(cots)
+    ddelta = np.zeros((), dtype=jax.dtypes.float0)
+    return dq, dk, dv, ddelta
+
+
+_ring_partials.defvjp(_ring_partials_fwd, _ring_partials_bwd)
+
+
 def ring_attention(q, k, v, axis_name, causal=False, scale=None,
                    block_k=512):
     """Sequence-parallel attention inside ``shard_map``.
@@ -498,9 +585,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         out, m, l, kr, vr = carry
         # the (idx - r)-th device's block is visiting us this round
         src = (idx - r) % n
-        po, pm, plgt = _block_scan_attention(
-            qf, kr, vr, causal, scale, block_k,
-            q_offset=q_off, k_offset=src * S_local)
+        o_n, lse = _ring_partials(qf, kr, vr, q_off - src * S_local,
+                                  causal, scale, block_k)
+        # normalized partial + lse is merge-equivalent to
+        # (unnormalized out, m, l) with m := lse, l := 1
+        po, pm, plgt = o_n, lse, jnp.ones_like(lse)
         # merge the visiting block's partial into the accumulator
         m_new = jnp.maximum(m, pm)
         a1 = jnp.exp(m - m_new)

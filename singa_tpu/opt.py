@@ -365,9 +365,10 @@ class DistOpt:
         return self.opt.state_tensors() + list(self._residuals.values())
 
     def get_states(self):
+        from .tensor import to_host_tree
         states = self.opt.get_states()
-        for k, v in self._residuals.items():
-            states[f"residual/{k}"] = np.asarray(jax.device_get(v.data))
+        states.update(to_host_tree({f"residual/{k}": v.data
+                                    for k, v in self._residuals.items()}))
         return states
 
     def set_states(self, states):

@@ -594,11 +594,13 @@ def sum_rows(M):
     return _wrap(jnp.sum(_raw(M), axis=0), M)
 
 
-def to_host(arr):
-    """Host copy of a (possibly mesh-sharded) array. Under multi-process
-    training an array sharded across hosts is gathered over the process
-    group first (collective: every participating process must call this
-    together); replicated or locally-addressable arrays copy directly."""
+def to_host_array(arr):
+    """Host numpy copy of a (possibly mesh-sharded) jax array. Under
+    multi-process training an array sharded across hosts is gathered over
+    the process group first (collective: every participating process must
+    call this together); replicated or locally-addressable arrays copy
+    directly. (Distinct from the reference-parity ``to_host(t)`` above,
+    which moves a Tensor to the host device.)"""
     if hasattr(arr, "sharding") and \
             not getattr(arr, "is_fully_addressable", True) and \
             not getattr(arr, "is_fully_replicated", False):
