@@ -16,10 +16,18 @@ ALWAYS exits 0 with ONE parseable JSON line:
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
 import time
+
+# Every hardware observation (probe outcome, smoke sub-result, full bench)
+# is appended here with a timestamp, by this script AND by the round-long
+# tools/tpu_watch.py loop. With a flaky tunnel, the end-of-round run can
+# then report a number banked earlier in the round instead of losing it.
+OBS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpu_observations.jsonl")
 
 # ResNet-50 @224x224: ~4.09 GMACs forward per image; 2 flops/MAC; a training
 # step (fwd + bwd wrt activations + bwd wrt weights) is ~3x forward.
@@ -143,6 +151,204 @@ def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3):
     return niters * batch * seq / (time.perf_counter() - start)
 
 
+LOCK_PATH = OBS_PATH + ".lock"
+
+
+class _TpuLock:
+    """Cross-process mutex so the watcher's banked benchmark run and a
+    live ``python bench.py`` never hold the (exclusive-access) TPU at the
+    same time — concurrent init makes both measurements fail or lie.
+
+    ``wait_s=0`` is try-lock (watcher cycles just skip); a positive wait
+    polls up to that long and then proceeds anyway, because a crashed
+    holder must not block the round's scored run forever."""
+
+    def __init__(self, wait_s):
+        self.wait_s = wait_s
+        self.fh = None
+        self.acquired = False
+
+    def __enter__(self):
+        import fcntl
+        self.fh = open(LOCK_PATH, "a")
+        deadline = time.time() + self.wait_s
+        while True:
+            try:
+                fcntl.flock(self.fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self.acquired = True
+                return self
+            except OSError:
+                if time.time() >= deadline:
+                    return self
+                time.sleep(10)
+
+    def __exit__(self, *exc):
+        import fcntl
+        try:
+            fcntl.flock(self.fh, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        self.fh.close()
+        return False
+
+
+def _record_obs(event, data):
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "event": event}
+    rec.update(data)
+    try:
+        with open(OBS_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except (OSError, TypeError):
+        pass
+
+
+def _load_obs():
+    """Observations since the LAST ``round_start`` marker (written by
+    tools/tpu_watch.py at launch). Without the scoping, a benchmark
+    banked in a previous round would masquerade as this round's number
+    and hide a perf regression."""
+    out = []
+    try:
+        with open(OBS_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("event") == "round_start":
+                    out = []
+                else:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _obs_age_s(rec):
+    try:
+        return time.time() - time.mktime(
+            time.strptime(rec["ts"], "%Y-%m-%dT%H:%M:%S"))
+    except (KeyError, ValueError, OverflowError):
+        return float("inf")
+
+
+def _record_round_start(max_hours):
+    """Write a round-boundary marker unless a recent one already exists —
+    a watcher RESTART mid-round must not discard evidence banked earlier
+    in the same round. Returns True if a new round window was opened."""
+    for rec in reversed(_raw_obs()):
+        if rec.get("event") == "round_start":
+            if _obs_age_s(rec) < 6 * 3600:
+                return False
+            break
+    _record_obs("round_start", {"max_hours": max_hours})
+    return True
+
+
+def _raw_obs():
+    """All records including round_start markers (``_load_obs`` strips
+    them and everything before the last one)."""
+    out = []
+    try:
+        with open(OBS_PATH) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def smoke_main():
+    """Layered <=60s-per-item hardware smoke. Each sub-result prints (and
+    is flushed) as its own JSON line the moment it exists, so a parent
+    that kills this child on timeout still collects everything completed
+    so far. Order: cheapest evidence first."""
+    import numpy as np
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+
+    def emit(obj):
+        obj["t"] = round(time.time() - t0, 1)
+        print(json.dumps(obj), flush=True)
+
+    ds = jax.devices()
+    d = next((x for x in ds if x.platform != "cpu"), ds[0])
+    emit({"smoke": "device", "platform": d.platform,
+          "device_kind": getattr(d, "device_kind", "?"),
+          "n_devices": len(ds)})
+    if d.platform == "cpu":
+        return
+
+    # 1. bf16 matmul: sustained TFLOP/s — is the MXU actually there?
+    n = 4096
+    a = jnp.asarray(np.random.RandomState(0).randn(n, n), jnp.bfloat16)
+    f = jax.jit(lambda x, y: x @ y)
+    tc = time.time()
+    f(a, a).block_until_ready()
+    compile_s = time.time() - tc
+    iters = 30
+    t1 = time.time()
+    outs = [f(a, a) for _ in range(iters)]
+    outs[-1].block_until_ready()
+    dt = time.time() - t1
+    emit({"smoke": "matmul_bf16_4096", "compile_s": round(compile_s, 2),
+          "tflops": round(2 * n ** 3 * iters / dt / 1e12, 2)})
+
+    # 2. Pallas flash-attention kernel on real hardware vs an fp32
+    # softmax reference — the kernels have otherwise only ever run in
+    # interpreter mode on CPU CI.
+    from singa_tpu.ops import attention
+    B, H, S, D = 2, 4, 512, 64
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+               for _ in range(3))
+    o = jax.jit(lambda q, k, v: attention.flash_attention(
+        q, k, v, causal=True))(q, k, v)
+    sc = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(jnp.where(mask, scores, -jnp.inf)), v)
+    err = float(jnp.max(jnp.abs(o - ref)))
+    emit({"smoke": "flash_attention_pallas_maxerr", "value": err,
+          "ok": bool(err < 2e-3)})
+
+    # 3. one small real train step through the full Model/graph stack
+    from singa_tpu import device as sdev
+    dev = sdev.create_tpu_device()
+    thr, ms = _measure(dev, batch=16, niters=5, warmup=1, image_size=64,
+                       depth=18, dtype_name="float32")
+    emit({"smoke": "resnet18_64px_b16", "step_ms": round(ms, 2),
+          "images_per_sec": round(thr, 1)})
+
+
+def _attempt_smoke(timeout=300):
+    """Run the smoke child; parse every JSON line it managed to print,
+    INCLUDING partial output from a timed-out child."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", "smoke"],
+            capture_output=True, text=True, timeout=timeout)
+        out = proc.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+    lines = []
+    for line in out.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "smoke" in rec:
+            lines.append(rec)
+    return lines
+
+
 def child_main(platform):
     """Run the real benchmark; print ONE result JSON line on stdout."""
     if platform == "cpu":
@@ -183,7 +389,16 @@ def _attempt(platform, timeout):
 def _probe_tpu(timeout):
     """Cheap liveness check: can a child process see a non-CPU device at
     all? Bounds the cost of a hung backend init to ``timeout`` seconds
-    instead of a full benchmark attempt."""
+    instead of a full benchmark attempt.
+
+    Returns (status, err) with status one of:
+      "ok"      — accelerator visible
+      "cpu"     — backend initialised and explicitly reported CPU-only
+      "timeout" — init hung (tunnel down, or a very slow cold start)
+      "error"   — probe crashed (transient import/init failure — says
+                  nothing about whether a chip exists)
+    Only "cpu" is a *confirmed* absence; callers should still make one
+    bounded real attempt for "timeout"/"error"."""
     code = ("import jax\n"
             "ds = jax.devices()\n"
             "print('PROBE_OK' if any(d.platform != 'cpu' for d in ds)"
@@ -193,45 +408,89 @@ def _probe_tpu(timeout):
                               capture_output=True, text=True,
                               timeout=timeout)
     except subprocess.TimeoutExpired:
-        return False, f"probe timeout after {timeout}s"
+        return "timeout", f"probe timeout after {timeout}s"
     if "PROBE_OK" in proc.stdout:
-        return True, None
+        return "ok", None
+    if "PROBE_CPU" in proc.stdout:
+        return "cpu", "no accelerator visible"
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return False, tail[-1] if tail else "no accelerator visible"
+    return "error", tail[-1] if tail else "probe produced no output"
 
 
-def main():
-    errors = []
+def _tpu_phase(errors):
+    """Probe + smoke + full attempts. Returns (res, smoke_lines)."""
     res = None
+    smoke = []
     # a hung backend init must not eat the whole time budget: probe first
     # (generous enough for a slow cold start), and only run the real
     # benchmark when a chip is actually visible
-    alive, perr = _probe_tpu(180)
-    if not alive:
+    status, perr = _probe_tpu(180)
+    _record_obs("probe", {"status": status, "err": perr, "src": "bench"})
+    if status != "ok":
         errors.append(f"tpu probe#1: {perr}")
         print(f"bench: tpu probe failed ({perr}), retrying",
               file=sys.stderr)
         time.sleep(10)
-        alive, perr = _probe_tpu(180)
-        if not alive:
+        status, perr = _probe_tpu(180)
+        _record_obs("probe", {"status": status, "err": perr, "src": "bench"})
+        if status != "ok":
             errors.append(f"tpu probe#2: {perr}")
-    if alive:
-        # two attempts: the backend is observably flaky mid-run too
+    if status == "ok":
+        # layered: bank the cheap smoke evidence FIRST, so a tunnel that
+        # drops mid-benchmark still leaves hardware numbers behind
+        smoke = _attempt_smoke(300)
+        for rec in smoke:
+            _record_obs("smoke", rec)
+        # two full attempts: the backend is observably flaky mid-run too
         for i, timeout in enumerate([900, 420]):
             res, err = _attempt("tpu", timeout)
             if res is not None:
+                _record_obs("bench", res)
                 break
             errors.append(f"tpu#{i + 1}: {err}")
             print(f"bench: tpu attempt {i + 1} failed ({err})",
                   file=sys.stderr)
-    elif perr and "timeout" in perr:
-        # a probe TIMEOUT (vs "no accelerator visible") may be a very
-        # slow init rather than a hang: one bounded real attempt
+    elif status in ("timeout", "error"):
+        # probe inconclusive — a hung init OR a transient probe crash,
+        # neither of which confirms a cpu-only world: one bounded real
+        # attempt regardless
         res, err = _attempt("tpu", 600)
-        if res is None:
-            errors.append(f"tpu slow-init attempt: {err}")
-            print(f"bench: slow-init tpu attempt failed ({err})",
+        if res is not None:
+            _record_obs("bench", res)
+        else:
+            errors.append(f"tpu inconclusive-probe attempt: {err}")
+            print(f"bench: inconclusive-probe tpu attempt failed ({err})",
                   file=sys.stderr)
+    return res, smoke
+
+
+def main():
+    errors = []
+    # serialize against the watcher: if it is mid-benchmark on a live
+    # tunnel, waiting for it both frees the chip for our run and (worst
+    # case) means its result is banked for us to report
+    with _TpuLock(wait_s=1200) as lock:
+        if not lock.acquired:
+            print("bench: tpu lock busy past deadline, proceeding",
+                  file=sys.stderr)
+        res, smoke = _tpu_phase(errors)
+    live = res is not None
+    obs = _load_obs()
+    max_age = float(os.environ.get("BENCH_BANKED_MAX_AGE_H", "14")) * 3600
+    if res is None:
+        # the tunnel is down NOW — but the round-long watcher may have
+        # banked a full benchmark during an earlier window. Both the
+        # round_start marker (via _load_obs) and an age cap guard
+        # against reporting a PREVIOUS round's number.
+        banked = [o for o in obs if o.get("event") == "bench"
+                  and o.get("platform") not in (None, "cpu")
+                  and _obs_age_s(o) < max_age]
+        if banked:
+            res = dict(banked[-1])
+            res["measured_at"] = res.pop("ts")
+    if not smoke:
+        smoke = [o for o in obs if o.get("event") == "smoke"
+                 and _obs_age_s(o) < max_age]
     if res is None:
         # last resort: a CPU number, clearly labeled, so the round still
         # records a real measurement instead of a traceback
@@ -255,11 +514,38 @@ def main():
         "platform": res["platform"],
         "device_kind": res["device_kind"],
     }
+    if res.get("measured_at"):
+        out["measured_at"] = res["measured_at"]
+        out["live"] = False
+    if res["platform"] == "cpu":
+        # tiny batch, 2 timed iters, compile-dominated: a liveness
+        # fallback, NOT a performance trend point — do not compare
+        # rounds on it
+        out["indicative"] = False
     if res.get("mfu") is not None:
         out["mfu"] = round(res["mfu"], 4)
-    for k in ("bf16_throughput", "bf16_step_ms", "bf16_mfu", "bf16_error"):
+    for k in ("bf16_throughput", "bf16_step_ms", "bf16_mfu", "bf16_error",
+              "lm_tokens_per_sec", "lm_error"):
         if res.get(k) is not None:
             out[k] = round(res[k], 4) if isinstance(res[k], float) else res[k]
+    if smoke:
+        # one stable shape for the field, whether the records came from
+        # the live child (no ts/event) or from the banked jsonl
+        norm = [{k: v for k, v in rec.items() if k != "event"}
+                for rec in smoke if rec.get("smoke") != "device"]
+        if norm:
+            out["tpu_smoke"] = norm[-8:]
+    probes = [o for o in obs if o.get("event") == "probe"]
+    if probes and out["platform"] == "cpu":
+        out["tpu_probes"] = {
+            "n": len(probes),
+            "first": probes[0].get("ts"), "last": probes[-1].get("ts"),
+            "statuses": {s: sum(1 for o in probes if o.get("status") == s)
+                         for s in {o.get("status") for o in probes}},
+        }
+    if not live and out["platform"] != "cpu":
+        out["note"] = ("benchmark banked earlier this round by "
+                       "tools/tpu_watch.py; tunnel was down at report time")
     if errors:
         out["retries"] = errors
     print(json.dumps(out))
@@ -267,6 +553,10 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child_main(sys.argv[2] if len(sys.argv) > 2 else "tpu")
+        target = sys.argv[2] if len(sys.argv) > 2 else "tpu"
+        if target == "smoke":
+            smoke_main()
+        else:
+            child_main(target)
     else:
         main()

@@ -1,0 +1,85 @@
+"""Round-long TPU tunnel watcher.
+
+The tunnel to the real chip has been observed down for entire rounds and
+flaky within rounds. This loop probes liveness on a low duty cycle and —
+the moment a window opens — immediately banks layered evidence using
+bench.py's smoke and full-benchmark children, appending every observation
+to ``tpu_observations.jsonl``. The end-of-round ``python bench.py`` folds
+that file into its one-line JSON, so a transient tunnel-up window earlier
+in the round still produces a reported hardware number.
+
+Run detached:  nohup python tools/tpu_watch.py > tpu_watch.log 2>&1 &
+Stop early:    touch tpu_watch.stop   (checked once per cycle)
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402  (stdlib-only at import time)
+
+MAX_HOURS = float(os.environ.get("TPU_WATCH_HOURS", "11.5"))
+IDLE_SLEEP = 8 * 60       # between probes while the tunnel is down
+BANKED_SLEEP = 45 * 60    # once a full benchmark is banked, just refresh
+STOP_FILE = os.path.join(ROOT, "tpu_watch.stop")
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def main():
+    deadline = time.time() + MAX_HOURS * 3600
+    banked = False
+    n = 0
+    # round boundary: bench.py only trusts observations after this
+    # marker. A RESTART mid-round keeps the existing window (and its
+    # banked evidence) instead of discarding it.
+    if bench._record_round_start(MAX_HOURS):
+        log("opened a new round window")
+    else:
+        log("recent round window found; resuming it")
+        banked = any(o.get("event") == "bench"
+                     and o.get("platform") not in (None, "cpu")
+                     for o in bench._load_obs())
+    log(f"watching for TPU windows (max {MAX_HOURS}h, "
+        f"idle interval {IDLE_SLEEP}s)")
+    while time.time() < deadline:
+        if os.path.exists(STOP_FILE):
+            log("stop file present; exiting")
+            return
+        n += 1
+        # try-lock: if a live `python bench.py` holds the chip, just
+        # skip this cycle — interfering would corrupt its measurement
+        with bench._TpuLock(wait_s=0) as lock:
+            if not lock.acquired:
+                log(f"cycle#{n}: bench.py holds the tpu lock; skipping")
+                time.sleep(IDLE_SLEEP)
+                continue
+            status, err = bench._probe_tpu(120)
+            bench._record_obs("probe", {"status": status, "err": err,
+                                        "src": "watch"})
+            log(f"probe#{n}: {status}{' (' + err + ')' if err else ''}")
+            if status == "ok":
+                smoke = bench._attempt_smoke(300)
+                for rec in smoke:
+                    bench._record_obs("smoke", rec)
+                log(f"smoke: {len(smoke)} sub-results banked")
+                res, aerr = bench._attempt("tpu", 900)
+                if res is not None:
+                    bench._record_obs("bench", res)
+                    thr = res.get("throughput")
+                    log(f"FULL BENCH BANKED: {thr} img/s on "
+                        f"{res.get('device_kind')}")
+                    banked = True
+                else:
+                    log(f"full bench attempt failed: {aerr}")
+        time.sleep(BANKED_SLEEP if banked else IDLE_SLEEP)
+    log("watch window closed")
+
+
+if __name__ == "__main__":
+    main()
