@@ -208,19 +208,11 @@ def _load_obs():
     banked in a previous round would masquerade as this round's number
     and hide a perf regression."""
     out = []
-    try:
-        with open(OBS_PATH) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if rec.get("event") == "round_start":
-                    out = []
-                else:
-                    out.append(rec)
-    except OSError:
-        pass
+    for rec in _raw_obs():
+        if rec.get("event") == "round_start":
+            out = []
+        else:
+            out.append(rec)
     return out
 
 
@@ -233,12 +225,13 @@ def _obs_age_s(rec):
 
 
 def _record_round_start(max_hours):
-    """Write a round-boundary marker unless a recent one already exists —
-    a watcher RESTART mid-round must not discard evidence banked earlier
-    in the same round. Returns True if a new round window was opened."""
+    """Write a round-boundary marker unless one younger than the round
+    length already exists — a watcher RESTART mid-round must not discard
+    evidence banked earlier in the same round. Returns True if a new
+    round window was opened."""
     for rec in reversed(_raw_obs()):
         if rec.get("event") == "round_start":
-            if _obs_age_s(rec) < 6 * 3600:
+            if _obs_age_s(rec) < max_hours * 3600:
                 return False
             break
     _record_obs("round_start", {"max_hours": max_hours})
@@ -468,8 +461,10 @@ def main():
     errors = []
     # serialize against the watcher: if it is mid-benchmark on a live
     # tunnel, waiting for it both frees the chip for our run and (worst
-    # case) means its result is banked for us to report
-    with _TpuLock(wait_s=1200) as lock:
+    # case) means its result is banked for us to report. The wait must
+    # exceed the watcher's worst-case lock hold (120s probe + 300s smoke
+    # + 900s full bench)
+    with _TpuLock(wait_s=1500) as lock:
         if not lock.acquired:
             print("bench: tpu lock busy past deadline, proceeding",
                   file=sys.stderr)
