@@ -119,11 +119,12 @@ def main():
     sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
     model.set_optimizer(opt.DistOpt(sgd) if args.dist else sgd)
 
-    world = model.optimizer.world_size if args.dist else 1
+    # Under --dist every process feeds the FULL global batch and the
+    # mesh shards it (shard_map splits dim 0; multi-process placement
+    # assumes an SPMD-identical host copy) — so unlike the reference's
+    # NCCL ranks (train_cnn.py:58-72) the dataset is NOT partitioned
+    # per rank here. datasets.partition remains for host-local loaders.
     rank = model.optimizer.global_rank if args.dist else 0
-    if args.dist and world > 1:
-        train_x, train_y, val_x, val_y = datasets.partition(
-            rank, world, train_x, train_y, val_x, val_y)
 
     input_size = getattr(model, "input_size", None)
     need_resize = (getattr(model, "dimension", 4) == 4

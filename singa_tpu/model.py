@@ -56,6 +56,42 @@ class _TensorSlot:
 _TENSOR = _TensorSlot()
 
 
+def _batch_dim_axes(input_specs, default_axis):
+    """Mesh axes the batch (dim 0 of the first input) is sharded over —
+    the correct default out-spec for batch-leading output leaves."""
+    if input_specs:
+        spec = input_specs[0]
+        if len(spec) > 0 and spec[0] is not None:
+            return spec[0]
+    return default_axis
+
+
+def _resolve_leaf_specs(leaves, full_batch, input_specs, axis, user_out):
+    """Default per-output-leaf layouts, shared by the train and eval
+    builders: a user-supplied spec list wins; otherwise batch-leading
+    leaves shard like the input batch dim (which may span several mesh
+    axes, e.g. ('data','expert') for MoE — P('data') alone would
+    mis-stitch those outputs) and everything else replicates."""
+    if user_out is not None:
+        return list(user_out)
+    shard_mask = [jnp.asarray(x).ndim >= 1 and
+                  jnp.asarray(x).shape[0] == full_batch for x in leaves]
+    batch_ax = _batch_dim_axes(input_specs, axis)
+    return [P(batch_ax) if m else P() for m in shard_mask]
+
+
+def _shard_map_compat_kwargs():
+    """shard_map's replication-check kwarg was renamed across jax
+    versions; disable it under whichever name this jax uses."""
+    import inspect
+    sig = inspect.signature(shard_map).parameters
+    if "check_vma" in sig:
+        return {"check_vma": False}
+    if "check_rep" in sig:
+        return {"check_rep": False}
+    return {}
+
+
 def _flatten(obj, leaves):
     """Flatten nested tuples/lists/dicts of Tensors into arrays + treedef."""
     if isinstance(obj, Tensor):
@@ -96,6 +132,7 @@ class Model(Layer):
         self._state_list = None
         self._dist = None
         self._step_count = 0
+        self._eval_steps = {}      # input signature -> compiled eval step
         self.step_times = []
 
     # -- user hooks --------------------------------------------------------
@@ -336,9 +373,6 @@ class Model(Layer):
                 leaves = []
                 _flatten(self._eager_out, leaves)
                 full_batch = sample_inputs[0].shape[0]
-                shard_mask = [
-                    jnp.asarray(x).ndim >= 1 and
-                    jnp.asarray(x).shape[0] == full_batch for x in leaves]
                 # per-state sharding: tensor-parallel weights announce a
                 # PartitionSpec via Tensor.spec; everything else replicates
                 state_specs = [t.spec if t.spec is not None else P()
@@ -351,22 +385,13 @@ class Model(Layer):
                 rec["input_specs"] = list(user_in) if user_in is not None \
                     else [P(axis)] * n_inputs
                 in_specs = (state_specs, P(), *rec["input_specs"])
-                # per-output-leaf layouts: Model.output_specs (flattened
-                # leaf order) overrides the default "batch-leading leaves
-                # shard on 'data', everything else replicates"
-                user_out = getattr(self, "output_specs", None)
-                rec["leaf_specs"] = list(user_out) if user_out is not None \
-                    else [P(axis) if m else P() for m in shard_mask]
+                rec["leaf_specs"] = _resolve_leaf_specs(
+                    leaves, full_batch, rec["input_specs"], axis,
+                    getattr(self, "output_specs", None))
                 out_specs = (state_specs, rec["leaf_specs"], P())
-                import inspect
-                kw = {}
-                sig = inspect.signature(shard_map).parameters
-                if "check_vma" in sig:
-                    kw["check_vma"] = False
-                elif "check_rep" in sig:
-                    kw["check_rep"] = False
                 mapped = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                                   out_specs=tuple(out_specs), **kw)
+                                   out_specs=tuple(out_specs),
+                                   **_shard_map_compat_kwargs())
                 return jax.jit(mapped, donate_argnums=(0,))
 
             rec["builder"] = build
@@ -432,20 +457,7 @@ class Model(Layer):
         if self._dist is not None:
             from jax.sharding import NamedSharding
             rep = NamedSharding(self._mesh, P())
-
-            def place(a, sharding):
-                # multi-process mesh: the sharding spans devices of other
-                # hosts, which device_put cannot reach — each process
-                # contributes its addressable shards from its (SPMD-
-                # identical) host copy instead
-                if getattr(a, "sharding", None) == sharding:
-                    return a
-                if sharding.is_fully_addressable:
-                    return jax.device_put(a, sharding)
-                val = np.asarray(jax.device_get(a))
-                return jax.make_array_from_callback(
-                    val.shape, sharding, lambda idx: val[idx])
-
+            place = self._place_mesh
             specs = getattr(self, "_state_specs", None) or \
                 [P()] * len(state_arrays)
             state_arrays = [
@@ -578,6 +590,135 @@ class Model(Layer):
             print(text)
         return text
 
+    def _place_mesh(self, a, sharding):
+        """Lay an array out on the mesh. On a multi-process mesh the
+        sharding spans devices of other hosts, which device_put cannot
+        reach — each process contributes its addressable shards from its
+        (SPMD-identical) host copy instead."""
+        if getattr(a, "sharding", None) == sharding:
+            return a
+        if sharding.is_fully_addressable:
+            return jax.device_put(a, sharding)
+        val = np.asarray(jax.device_get(a))
+        return jax.make_array_from_callback(
+            val.shape, sharding, lambda idx: val[idx])
+
+    # -- sharded eval ------------------------------------------------------
+
+    def _eval_input_specs(self, n_inputs):
+        user_in = getattr(self, "input_specs", None)
+        if user_in is not None:
+            # eval usually takes fewer inputs than training (x, no y):
+            # use the leading specs
+            return list(user_in)[:n_inputs]
+        return [P(self._axis)] * n_inputs
+
+    def _eval_divisible(self, input_arrays, in_specs):
+        for a, s in zip(input_arrays, in_specs):
+            shape = np.shape(a)
+            for d, names in enumerate(s):
+                if names is None:
+                    continue
+                names = names if isinstance(names, tuple) else (names,)
+                k = 1
+                for nm in names:
+                    k *= self._mesh.shape[nm]
+                if d >= len(shape) or shape[d] % k:
+                    return False
+        return True
+
+    def _build_eval(self, input_tensors):
+        """Compile an eval forward under the SAME mesh and shardings as
+        the training step, so tp/ep-sharded state is consumed where it
+        lives instead of being gathered to one device — which OOMs for
+        exactly the models model-parallelism exists for. (Reference
+        inference runs on the same device graph, model.py:210-222.)"""
+        from .parallel.communicator import collective_context
+        self._ensure_state()
+        state_list = self._state_list
+        dist = self._dist
+        mesh, axis = self._mesh, self._axis
+        rec = {}
+
+        # leaf shapes via an abstract rehearsal: zero device compute, and
+        # collectives are identity outside the mesh so logical shapes match
+        out = self._abstract_call(
+            list(input_tensors), lambda: self.forward(*input_tensors))
+        leaves0 = []
+        _flatten(out, leaves0)
+        rec["input_specs"] = self._eval_input_specs(len(input_tensors))
+        rec["leaf_specs"] = _resolve_leaf_specs(
+            leaves0, input_tensors[0].shape[0], rec["input_specs"], axis,
+            getattr(self, "eval_output_specs", None))
+        state_specs = getattr(self, "_state_specs", None) or \
+            [t.spec if t.spec is not None else P() for t in state_list]
+        rec["state_specs"] = state_specs
+
+        def fn(state_arrays, *input_arrays):
+            backup = [t.data for t in state_list]
+            for t, a in zip(state_list, state_arrays):
+                t.data = a
+            prev = CTX.training
+            CTX.training = False
+            try:
+                ins = [Tensor(data=a, device=self.dev,
+                              requires_grad=False)
+                       for a in input_arrays]
+                res = self.forward(*ins)
+            finally:
+                CTX.training = prev
+                # eval leaves state untouched: restore the concrete
+                # arrays so no tracer outlives the trace
+                for t, a in zip(state_list, backup):
+                    t.data = a
+            leaves = []
+            rec["tree"] = _flatten(res, leaves)
+            specs = rec["leaf_specs"]
+            raxes = tuple(dist.communicator.reduce_axes)
+            leaves = [x if specs[i] != P() else jax.lax.pmean(x, raxes)
+                      for i, x in enumerate(leaves)]
+            return leaves
+
+        def body(state_arrays, *input_arrays):
+            with collective_context(*mesh.axis_names):
+                return fn(state_arrays, *input_arrays)
+
+        mapped = shard_map(body, mesh=mesh,
+                           in_specs=(state_specs, *rec["input_specs"]),
+                           out_specs=rec["leaf_specs"],
+                           **_shard_map_compat_kwargs())
+        rec["jit"] = jax.jit(mapped)   # state NOT donated: eval reuses it
+        return rec
+
+    def _run_eval(self, *args):
+        """Mesh-resident eval dispatch. Returns NotImplemented when the
+        batch does not divide the mesh — the caller falls back to the
+        gather-and-run-eager path."""
+        input_arrays = [a.data for a in args]
+        if not self._eval_divisible(input_arrays,
+                                    self._eval_input_specs(len(args))):
+            return NotImplemented
+        # the key carries the resolved specs: changing input_specs /
+        # eval_output_specs after a first eval must re-specialize, not
+        # silently reuse the stale layout
+        key = (tuple((tuple(np.shape(a)), str(getattr(a, "dtype", "?")))
+                     for a in input_arrays),
+               repr(self._eval_input_specs(len(args))),
+               repr(getattr(self, "eval_output_specs", None)))
+        rec = self._eval_steps.get(key)
+        if rec is None:
+            rec = self._build_eval(args)
+            self._eval_steps[key] = rec
+        from jax.sharding import NamedSharding
+        place = self._place_mesh
+        state_arrays = [place(t.data, NamedSharding(self._mesh, s))
+                        for t, s in zip(self._state_list,
+                                        rec["state_specs"])]
+        placed = [place(a, NamedSharding(self._mesh, s))
+                  for a, s in zip(input_arrays, rec["input_specs"])]
+        leaves = rec["jit"](state_arrays, *placed)
+        return _unflatten(rec["tree"], list(leaves), self.dev)
+
     def _unshard_state(self):
         """After mesh-sharded training the live state arrays span the mesh;
         gather them to the model device so eager (eval) ops can mix them
@@ -606,6 +747,14 @@ class Model(Layer):
                     f"arguments {sorted(kwargs)}")
             return self._run_step(*args)
         if self._dist is not None:
+            if (not kwargs and self.graph_mode and args
+                    and getattr(self, "_mesh", None) is not None
+                    and all(isinstance(a, Tensor) for a in args)):
+                res = self._run_eval(*args)
+                if res is not NotImplemented:
+                    return res
+            # fallback (no mesh yet / odd batch / kwargs): gather state
+            # to the model device and run the eager forward
             self._unshard_state()
         prev = CTX.training
         CTX.training = False
@@ -673,6 +822,7 @@ class Model(Layer):
                 opt.set_states(opt_states)
         # invalidate any compiled step: state identity may have changed
         self._steps = {}
+        self._eval_steps = {}
         self._state_list = None
         return {k[len("aux/"):]: Tensor(data=v, requires_grad=False)
                 for k, v in arrays.items() if k.startswith("aux/")}
