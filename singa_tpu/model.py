@@ -706,17 +706,35 @@ class Model(Layer):
                repr(self._eval_input_specs(len(args))),
                repr(getattr(self, "eval_output_specs", None)))
         rec = self._eval_steps.get(key)
-        if rec is None:
-            rec = self._build_eval(args)
-            self._eval_steps[key] = rec
-        from jax.sharding import NamedSharding
-        place = self._place_mesh
-        state_arrays = [place(t.data, NamedSharding(self._mesh, s))
-                        for t, s in zip(self._state_list,
-                                        rec["state_specs"])]
-        placed = [place(a, NamedSharding(self._mesh, s))
-                  for a, s in zip(input_arrays, rec["input_specs"])]
-        leaves = rec["jit"](state_arrays, *placed)
+        fresh = rec is None
+        try:
+            if fresh:
+                rec = self._build_eval(args)
+                self._eval_steps[key] = rec
+            if rec is NotImplemented:
+                return NotImplemented
+            from jax.sharding import NamedSharding
+            place = self._place_mesh
+            state_arrays = [place(t.data, NamedSharding(self._mesh, s))
+                            for t, s in zip(self._state_list,
+                                            rec["state_specs"])]
+            placed = [place(a, NamedSharding(self._mesh, s))
+                      for a, s in zip(input_arrays, rec["input_specs"])]
+            leaves = rec["jit"](state_arrays, *placed)
+        except Exception as e:
+            if not fresh:
+                raise
+            # per-shard constraints beyond input divisibility (e.g. a
+            # pipeline's microbatch assert on the LOCAL batch) surface
+            # when the shard_map first traces — fall back to the
+            # gather+eager path, which sees the global batch
+            import warnings
+            warnings.warn(
+                f"sharded eval unavailable for this signature "
+                f"({type(e).__name__}: {e}); falling back to gathered "
+                "eager eval", stacklevel=3)
+            self._eval_steps[key] = NotImplemented
+            return NotImplemented
         return _unflatten(rec["tree"], list(leaves), self.dev)
 
     def _unshard_state(self):

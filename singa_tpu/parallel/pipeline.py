@@ -68,6 +68,40 @@ def _mark_varying(v, axis_name):
     return v
 
 
+def _pipeline_fwd_core(dispatch, stage_params, x_microbatches, wire_shape,
+                       wire_dtype, axis_name):
+    """Generic GPipe forward scan. ``dispatch(params, a_wire, mb) ->
+    a_wire`` is this device's stage applied to the wire activation (or,
+    on stage 0, to the injected microbatch ``mb``). Returns the last
+    stage's wire outputs (n_micro, *wire_shape), broadcast to all
+    stages."""
+    n = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    steps = n_micro + n - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        prev_y = carry
+        # activation produced upstream last tick arrives over the ring
+        recv = lax.ppermute(prev_y, axis_name, fwd_perm)
+        mb = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        y = dispatch(stage_params, recv, mb)
+        return y, y
+
+    # the carry becomes device-varying (stage params differ per pipe
+    # member); mark the init accordingly for shard_map's vma typecheck
+    init = _mark_varying(jnp.zeros(wire_shape, wire_dtype), axis_name)
+    _, ys = lax.scan(step, init, jnp.arange(steps))
+
+    # last stage's outputs at ticks n-1 .. steps-1 are microbatches 0..M-1
+    outs = lax.dynamic_slice_in_dim(ys, n - 1, n_micro, axis=0)
+    # broadcast them from the last stage to everyone
+    return lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+
+
 def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
     """Run a GPipe forward inside ``shard_map`` over ``axis_name``.
 
@@ -86,35 +120,14 @@ def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pipe"):
     Schedule: t = 0..n_micro+n_stages-2; stage 0 injects microbatch t,
     stage s>0 consumes the activation stage s-1 produced at t-1.
     """
-    n = lax.axis_size(axis_name)
-    sid = lax.axis_index(axis_name)
-    n_micro = x_microbatches.shape[0]
-    steps = n_micro + n - 1
-    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
 
-    mb_shape = x_microbatches.shape[1:]
+    def dispatch(params, a_wire, mb):
+        a = jnp.where(lax.axis_index(axis_name) == 0, mb, a_wire)
+        return stage_fn(params, a)
 
-    def step(carry, t):
-        prev_y = carry
-        # activation produced upstream last tick arrives over the ring
-        recv = lax.ppermute(prev_y, axis_name, fwd_perm)
-        mb = lax.dynamic_index_in_dim(
-            x_microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-        a = jnp.where(sid == 0, mb, recv)
-        y = stage_fn(stage_params, a)
-        return y, y
-
-    # the carry becomes device-varying (stage params differ per pipe
-    # member); mark the init accordingly for shard_map's vma typecheck
-    init = _mark_varying(jnp.zeros(mb_shape, x_microbatches.dtype),
-                         axis_name)
-    _, ys = lax.scan(step, init, jnp.arange(steps))
-
-    # last stage's outputs at ticks n-1 .. steps-1 are microbatches 0..M-1
-    outs = lax.dynamic_slice_in_dim(ys, n - 1, n_micro, axis=0)
-    # broadcast them from the last stage to everyone
-    return lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)),
-                    axis_name)
+    return _pipeline_fwd_core(dispatch, stage_params, x_microbatches,
+                              x_microbatches.shape[1:],
+                              x_microbatches.dtype, axis_name)
 
 
 def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
@@ -144,6 +157,33 @@ def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
     ``dx_microbatches`` is the cotangent reaching the pipeline input
     (nonzero on every stage after the final psum) for upstream layers.
     """
+    def dispatch(params, a_wire, mb, _y_mb, _m_idx):
+        a = jnp.where(lax.axis_index(axis_name) == 0, mb, a_wire)
+        return stage_fn(params, a)
+
+    return _pipeline_1f1b_core(
+        dispatch, loss_fn, stage_params, x_microbatches, y_microbatches,
+        x_microbatches.shape[1:], x_microbatches.dtype, axis_name)
+
+
+def _pipeline_1f1b_core(dispatch, loss_fn, stage_params, x_microbatches,
+                        y_microbatches, wire_shape, wire_dtype, axis_name):
+    """Generic 1F1B scan shared by the homogeneous and heterogeneous
+    APIs.
+
+    ``dispatch(params, a_wire, mb, y_mb, m_idx) -> a_wire`` applies this
+    device's stage: stage 0 reads the injected microbatch ``mb``, later
+    stages read the wire activation, and a heterogeneous last stage may
+    fold the per-microbatch loss into its wire output (with ``loss_fn``
+    then just extracting it). ``m_idx`` is the microbatch index — the
+    SAME value reaches the forward tick and that microbatch's backward
+    recompute, so RNG-consuming stages (dropout) can fold a key from it
+    and see identical draws in both (a stateful trace-time key would
+    bake a DIFFERENT mask into the recompute, silently corrupting
+    gradients). The ring stores WIRE inputs only — stage 0's input is
+    re-read from ``x_microbatches`` at backward time, so heterogeneous
+    input shapes never touch the ring.
+    """
     S = lax.axis_size(axis_name)
     sid = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
@@ -152,8 +192,7 @@ def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [((i + 1) % S, i) for i in range(S)]
 
-    mb_shape = x_microbatches.shape[1:]
-    dtype = x_microbatches.dtype
+    x_shape = x_microbatches.shape[1:]
     is_last = sid == S - 1
 
     def step(carry, t):
@@ -165,12 +204,15 @@ def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
         f_on = (m_f >= 0) & (m_f < M)
         mb = lax.dynamic_index_in_dim(
             x_microbatches, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
-        a_in = jnp.where(sid == 0, mb, recv_act)
+        y_f = lax.dynamic_index_in_dim(
+            y_microbatches, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
         slot_f = jnp.clip(m_f, 0, M - 1) % R
         ring = jnp.where(
             f_on,
-            lax.dynamic_update_index_in_dim(ring, a_in, slot_f, 0), ring)
-        y_new = stage_fn(stage_params, a_in)
+            lax.dynamic_update_index_in_dim(ring, recv_act, slot_f, 0),
+            ring)
+        y_new = dispatch(stage_params, recv_act, mb, y_f,
+                         jnp.clip(m_f, 0, M - 1))
         fwd_out = jnp.where(f_on, y_new, fwd_out)
 
         # ---- backward tick: mb (t - 2(S-1) + sid) -------------------
@@ -179,13 +221,18 @@ def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
         b_on = (m_b >= 0) & (m_b < M)
         slot_b = jnp.clip(m_b, 0, M - 1) % R
         a_saved = lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
+        mb_b = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
         y_mb = lax.dynamic_index_in_dim(
             y_microbatches, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
 
-        out, vjp_fn = jax.vjp(stage_fn, stage_params, a_saved)
+        mi_b = jnp.clip(m_b, 0, M - 1)
+        out, vjp_fn = jax.vjp(
+            lambda p, a, x: dispatch(p, a, x, y_mb, mi_b),
+            stage_params, a_saved, mb_b)
         loss_mb, dout = jax.value_and_grad(loss_fn)(out, y_mb)
         cot_eff = jnp.where(is_last, dout, recv_cot)
-        dp, da = vjp_fn(cot_eff)
+        dp, da, dmb = vjp_fn(cot_eff)
 
         gacc = jax.tree_util.tree_map(
             lambda g, d: g + jnp.where(b_on, d, jnp.zeros_like(d)),
@@ -193,21 +240,22 @@ def pipeline_1f1b(stage_fn, loss_fn, stage_params, x_microbatches,
         lacc = lacc + jnp.where(is_last & b_on, loss_mb, 0.0)
         dxbuf = jnp.where(
             (sid == 0) & b_on,
-            lax.dynamic_update_index_in_dim(
-                dxbuf, da, jnp.clip(m_b, 0, M - 1), 0), dxbuf)
+            lax.dynamic_update_index_in_dim(dxbuf, dmb, mi_b, 0), dxbuf)
         cot_out = jnp.where(b_on, da, jnp.zeros_like(da))
 
         return (fwd_out, cot_out, ring, gacc, lacc, dxbuf), None
 
     init = (
-        _mark_varying(jnp.zeros(mb_shape, dtype), axis_name),
-        _mark_varying(jnp.zeros(mb_shape, dtype), axis_name),
-        _mark_varying(jnp.zeros((R,) + mb_shape, dtype), axis_name),
+        _mark_varying(jnp.zeros(wire_shape, wire_dtype), axis_name),
+        _mark_varying(jnp.zeros(wire_shape, wire_dtype), axis_name),
+        _mark_varying(jnp.zeros((R,) + tuple(wire_shape), wire_dtype),
+                      axis_name),
         jax.tree_util.tree_map(
             lambda p: _mark_varying(jnp.zeros_like(p), axis_name),
             stage_params),
         _mark_varying(jnp.asarray(0.0, jnp.float32), axis_name),
-        _mark_varying(jnp.zeros((M,) + mb_shape, dtype), axis_name),
+        _mark_varying(jnp.zeros((M,) + x_shape, x_microbatches.dtype),
+                      axis_name),
     )
     (fwd_out, cot_out, ring, gacc, lacc, dxbuf), _ = \
         lax.scan(step, init, jnp.arange(steps))
@@ -396,3 +444,322 @@ class PipelineModule1F1B(PipelineModule):
         return _Pipeline1F1B(self.stage_apply, self.loss_fn,
                              self.n_stages, self.n_micro,
                              self.axis)(x, y, *self._params)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous stages: embedding -> blocks -> head
+# ---------------------------------------------------------------------------
+
+class _StagePack:
+    """Flat-packing metadata for one stage's params. Each stage's Layer
+    tensors are absorbed into one float32 row of a (S, Lmax) stack
+    (sharded P('pipe'), so a pipe member materialises only its own
+    stage), and unpacked back into the live tensors inside the traced
+    stage apply — different stages may have entirely different param
+    pytrees."""
+
+    def __init__(self, tensors):
+        self.tensors = tensors
+        self.shapes = [tuple(t.shape) for t in tensors]
+        self.dtypes = [jnp.asarray(t.data).dtype for t in tensors]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = np.cumsum([0] + self.sizes[:-1]).tolist()
+        self.size = int(sum(self.sizes))
+
+    def pack(self):
+        if not self.tensors:
+            return jnp.zeros((0,), jnp.float32)
+        # via host: freshly-initialized params may sit on DIFFERENT
+        # device sets (rng-derived ones inherit a mesh-replicated key's
+        # devices, zeros-inits sit on the default device) and a device
+        # concatenate across those sets is an error. One-time init cost.
+        return jnp.asarray(np.concatenate([
+            np.asarray(jax.device_get(t.data), np.float32).reshape(-1)
+            for t in self.tensors]))
+
+    def unpack_into(self, flat):
+        for t, shape, dtype, off, size in zip(
+                self.tensors, self.shapes, self.dtypes, self.offsets,
+                self.sizes):
+            t.data = flat[off:off + size].reshape(shape).astype(dtype)
+
+
+def _feat(shape):
+    return int(np.prod(shape[1:])) if len(shape) > 1 else 1
+
+
+class HeteroPipeline1F1B(Layer):
+    """1F1B pipeline over HETEROGENEOUS stages: a list of per-stage Layer
+    stacks with different parameters and different activation shapes at
+    every boundary (embedding -> transformer blocks -> head, or a ResNet
+    with downsampling at stage boundaries).
+
+    TPU-native design: the program stays SPMD over the 'pipe' mesh axis —
+    activations cross stage boundaries as flat padded (mb, wire) float32
+    buffers riding `lax.ppermute` over ICI, and a `lax.switch` on the
+    stage index applies this member's stage, unflattening its own static
+    shapes. The last stage folds the per-microbatch loss into its wire
+    output, so the schedule core never materialises logits on the wire.
+
+    ``stages``: Layers (or Layer-like callables Tensor -> Tensor), one
+    per pipe member, initialized lazily at microbatch shape.
+    ``loss_fn(out_array, y_mb_array) -> scalar`` applies at the last
+    stage. ``forward(x, y)`` returns the mean microbatch loss;
+    ``forward(x)`` runs the GPipe forward for inference.
+
+    The training input x must be float (LM token ids as float work; the
+    embedding gather's index cast handles them) — integer inputs would
+    need float0 cotangent plumbing.
+    """
+
+    def __init__(self, stages, loss_fn, n_micro, axis="pipe"):
+        super().__init__()
+        self._stages = list(stages)   # underscore: NOT sublayers — the
+        self._loss_fn = loss_fn       # packed stack is the only state
+        self.n_micro = n_micro
+        self.axis = axis
+
+    def initialize(self, x, y=None):
+        B = x.shape[0]
+        assert B % self.n_micro == 0, \
+            f"batch {B} not divisible by n_micro={self.n_micro}"
+        mb = B // self.n_micro
+        self._dev = x.device
+        self._in_shapes, self._out_shapes, self._act_dtypes = [], [], []
+
+        # thread a microbatch ABSTRACTLY through the stages to learn each
+        # boundary's shape: stage param creation still executes concretely
+        # (Layer.__call__ wraps initialize in ensure_compile_time_eval)
+        # but the inter-stage forwards trace with zero device compute —
+        # a concrete rehearsal would also mix devices when the rng key is
+        # mesh-replicated from an earlier compiled step
+        def thread(ab):
+            a = Tensor(data=ab, device=x.device, requires_grad=False)
+            for stage in self._stages:
+                self._in_shapes.append(tuple(a.shape))
+                a = stage(a)
+                self._out_shapes.append(tuple(a.shape))
+                self._act_dtypes.append(jnp.asarray(a.data).dtype)
+            return a.data
+
+        jax.eval_shape(thread, jax.ShapeDtypeStruct(
+            (mb,) + tuple(x.shape[1:]), jnp.asarray(x.data).dtype))
+        self._packs = [_StagePack(list(stage.get_params().values()))
+                       if isinstance(stage, Layer) else _StagePack([])
+                       for stage in self._stages]
+        lmax = max([p.size for p in self._packs] + [1])
+        rows = [jnp.pad(p.pack(), (0, lmax - p.size))
+                for p in self._packs]
+        t = Tensor(data=jnp.stack(rows), device=x.device,
+                   requires_grad=True)
+        t.stores_grad = True
+        t.spec = P(self.axis)
+        self._stacked = t
+        # wire width: largest INTER-stage boundary (the last stage's
+        # output never rides the wire in 1F1B) + one slot for the
+        # per-microbatch loss scalar
+        self._wire_train = max(
+            [_feat(s) for s in self._out_shapes[:-1]] + [1]) + 1
+        # inference wire must carry the last stage's output too
+        self._wire_fwd = max(_feat(s) for s in self._out_shapes)
+
+    def _apply_stage(self, s, a_array):
+        out = self._stages[s](Tensor(data=a_array, device=self._dev,
+                                     requires_grad=False))
+        return out.data
+
+    def _stage_in(self, s, a_wire, mb_x):
+        """This stage's input: the injected microbatch for stage 0, else
+        the wire buffer unflattened to the boundary's shape. Only FEATURE
+        dims are static — under dp the local microbatch is smaller than
+        at init time."""
+        if s == 0:
+            return mb_x
+        in_shape = self._in_shapes[s]
+        return a_wire[:, :_feat(in_shape)] \
+            .reshape((a_wire.shape[0],) + in_shape[1:]) \
+            .astype(self._act_dtypes[s - 1])
+
+    @staticmethod
+    def _to_wire(o, n_rows, wire):
+        of = o.reshape(o.shape[0], -1).astype(jnp.float32)
+        return jnp.zeros((n_rows, wire), jnp.float32) \
+            .at[:, :of.shape[1]].set(of)
+
+    def _branch_train(self, s, n_stages):
+        wire = self._wire_train
+
+        def fn(flat, a_wire, mb_x, y_mb, key_m):
+            # deterministic per-(microbatch, stage) stream: the SAME key
+            # reaches this branch at the forward tick and at that
+            # microbatch's backward recompute, so RNG layers (dropout)
+            # draw identical masks in both — a stateful trace-time key
+            # would bake a different mask into the recompute and
+            # silently corrupt gradients
+            self._dev._set_rng_state(jax.random.fold_in(key_m, s))
+            self._packs[s].unpack_into(flat)
+            o = self._apply_stage(s, self._stage_in(s, a_wire, mb_x))
+            if s == n_stages - 1:
+                loss = self._loss_fn(o, y_mb)
+                return jnp.zeros((a_wire.shape[0], wire), jnp.float32) \
+                    .at[0, -1].set(loss.astype(jnp.float32))
+            return self._to_wire(o, a_wire.shape[0], wire)
+
+        return fn
+
+    def _branch_fwd(self, s, n_stages):
+        wire = self._wire_fwd
+
+        def fn(flat, a_wire, mb_x):
+            self._packs[s].unpack_into(flat)
+            o = self._apply_stage(s, self._stage_in(s, a_wire, mb_x))
+            return self._to_wire(o, a_wire.shape[0], wire)
+
+        return fn
+
+    def _sequential(self, stacked, x_mb, y_mb=None, base_key=None):
+        """Identical math without a mesh (eager first step, single
+        device): unpack every stage once, then vmap over microbatches,
+        folding the SAME per-(microbatch, stage) rng keys as the mesh
+        schedule so dropout draws match across paths."""
+        for row, pack in zip(stacked, self._packs):
+            pack.unpack_into(row)
+        if base_key is None:
+            base_key = self._dev._get_rng_state()
+
+        def stage_seq(xm, idx):
+            a = xm
+            for s in range(len(self._stages)):
+                self._dev._set_rng_state(
+                    jax.random.fold_in(jax.random.fold_in(base_key, idx),
+                                       s))
+                a = self._apply_stage(s, a)
+            return a
+
+        idxs = jnp.arange(x_mb.shape[0])
+        if y_mb is None:
+            return jax.vmap(stage_seq)(x_mb, idxs)
+
+        def one(xm, ym, idx):
+            return self._loss_fn(stage_seq(xm, idx), ym)
+
+        return jnp.mean(jax.vmap(one)(x_mb, y_mb, idxs))
+
+    def forward(self, x, y=None):
+        if y is None:
+            return _PipelineHetFwd(self)(x, self._stacked)
+        return _PipelineHet1F1B(self)(x, y, self._stacked)
+
+    def _own_params(self):
+        return {"stages_packed": self._stacked}
+
+
+def _make_het_1f1b_loss(make_dispatch, wire_shape, axis_name):
+    """custom-vjp wrapper: differentiating the scalar loss hands back the
+    1F1B schedule's OWN gradients instead of autodiffing the scan. The
+    rng base key is an explicit argument (custom_vjp forbids closing
+    over tracers) with a float0 cotangent."""
+    def extract(w, _y):
+        return w[0, -1]
+
+    def run(flat_local, x_mb, y_mb, base_key):
+        return _pipeline_1f1b_core(
+            make_dispatch(base_key), extract, flat_local, x_mb, y_mb,
+            wire_shape, jnp.float32, axis_name)
+
+    @jax.custom_vjp
+    def f(flat_local, x_mb, y_mb, base_key):
+        return run(flat_local, x_mb, y_mb, base_key)[0]
+
+    def f_fwd(flat_local, x_mb, y_mb, base_key):
+        loss, grads, dx = run(flat_local, x_mb, y_mb, base_key)
+        return loss, (grads, dx, y_mb, base_key)
+
+    def f_bwd(res, ct):
+        grads, dx, y_mb, base_key = res
+        return (jax.tree_util.tree_map(lambda g: g * ct, grads),
+                dx * ct, jnp.zeros_like(y_mb),
+                np.zeros(np.shape(base_key), jax.dtypes.float0))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+class _PipelineHet1F1B(Operator):
+    """Tape op: (x, y, stacked_flat) -> scalar loss via the 1F1B schedule
+    over heterogeneous stages when the 'pipe' axis is active; sequential
+    identical math otherwise."""
+
+    def __init__(self, module):
+        super().__init__()
+        self.m = module
+
+    def forward(self, x, y, stacked):
+        from .communicator import active_axis
+        m = self.m
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            raise TypeError(
+                "HeteroPipeline1F1B training input must be float "
+                f"(got {jnp.asarray(x).dtype}); cast token ids to float")
+        x_mb = microbatch(x, m.n_micro)
+        y_mb = microbatch(y, m.n_micro)
+        if active_axis(m.axis):
+            S = len(m._stages)
+            assert stacked.shape[0] == 1, \
+                f"mesh '{m.axis}' axis degree must equal " \
+                f"n_stages={S}; got param slice {stacked.shape}"
+            branches = [m._branch_train(s, S) for s in range(S)]
+
+            def make_dispatch(base_key):
+                def dispatch(flat, a_wire, mb_x, y_m, m_idx):
+                    key_m = jax.random.fold_in(base_key, m_idx)
+                    return lax.switch(lax.axis_index(m.axis), branches,
+                                      flat, a_wire, mb_x, y_m, key_m)
+                return dispatch
+
+            base_key = m._dev._get_rng_state()
+            f = _make_het_1f1b_loss(
+                make_dispatch, (x_mb.shape[1], m._wire_train), m.axis)
+            out = f(stacked[0], x_mb, y_mb, base_key)
+            # branch traces left the device key holding inner tracers;
+            # restore a deterministic continuation of the stream
+            m._dev._set_rng_state(jax.random.fold_in(base_key, 0x8157))
+            return out
+        base_key = m._dev._get_rng_state()
+        out = m._sequential(stacked, x_mb, y_mb, base_key)
+        m._dev._set_rng_state(jax.random.fold_in(base_key, 0x8157))
+        return out
+
+
+class _PipelineHetFwd(Operator):
+    """Tape op: (x, stacked_flat) -> last-stage output via the GPipe
+    forward over heterogeneous stages (inference path)."""
+
+    def __init__(self, module):
+        super().__init__()
+        self.m = module
+
+    def forward(self, x, stacked):
+        from .communicator import active_axis
+        m = self.m
+        x_mb = microbatch(x, m.n_micro)
+        if active_axis(m.axis):
+            S = len(m._stages)
+            assert stacked.shape[0] == 1
+            branches = [m._branch_fwd(s, S) for s in range(S)]
+
+            def dispatch(flat, a_wire, mb_x):
+                return lax.switch(lax.axis_index(m.axis), branches,
+                                  flat, a_wire, mb_x)
+
+            w = _pipeline_fwd_core(dispatch, stacked[0], x_mb,
+                                   (x_mb.shape[1], m._wire_fwd),
+                                   jnp.float32, m.axis)
+            w = _pipe_descale(w, m.axis)
+            out_shape = m._out_shapes[-1]
+            o = w[:, :, :_feat(out_shape)].reshape(
+                (m.n_micro, x_mb.shape[1]) + out_shape[1:]) \
+                .astype(m._act_dtypes[-1])
+            return o.reshape((-1,) + out_shape[1:])
+        out = m._sequential(stacked, x_mb)
+        return out.reshape((-1,) + out.shape[2:])
