@@ -376,7 +376,12 @@ SG_EXPORT void sg_net_destroy(void* h) {
     net->closing = true;
     net->new_cv.notify_all();
     for (auto& kv : net->eps) kv.second->cv.notify_all();
-    for (int spin = 0; spin < 100; ++spin) {
+    // wait UNCONDITIONALLY until every waiter has left: the closing
+    // flag is part of each wait predicate, so the notify above wakes
+    // them all — but a consumer mid-recv with a long timeout may take a
+    // scheduling beat to observe it, and deleting the Net from under a
+    // live waiter is a use-after-free no bounded spin can rule out
+    for (;;) {
       bool busy = false;
       for (auto& kv : net->eps)
         if (kv.second->waiters > 0) busy = true;
@@ -386,7 +391,9 @@ SG_EXPORT void sg_net_destroy(void* h) {
       lk.unlock();
       std::this_thread::sleep_for(std::chrono::milliseconds(10));
       lk.lock();
+      net->new_cv.notify_all();
       for (auto& kv : net->eps) kv.second->cv.notify_all();
+      for (auto* ep : net->graveyard) ep->cv.notify_all();
     }
   }
   net->stop.store(true);

@@ -152,6 +152,16 @@ class Device:
         self.time_profiling = {}
 
     def SetVerbosity(self, verbosity: int) -> None:
+        """0 = off; 1 = whole-step wall times (after skip_iteration);
+        2 = per-op times + static cost analysis + a one-time MEASURED
+        per-fusion profile of the compiled step.
+
+        NOTE: verbosity>=2 forces the FIRST graph-mode train call to run
+        eagerly (per-op wall times only exist op-by-op), skipping the
+        zero-compute abstract rehearsal. On a network-tunneled
+        accelerator that eager pass costs one round trip per op and can
+        look like a hang on a big model — profile small, or at
+        verbosity 1."""
         self.verbosity = int(verbosity)
 
     def SetSkipIteration(self, skip: int) -> None:

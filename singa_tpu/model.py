@@ -66,6 +66,22 @@ def _batch_dim_axes(input_specs, default_axis):
     return default_axis
 
 
+def _mesh_step_context(mesh, input_specs, axis):
+    """Context both step bodies (train and eval) enter: register every
+    mesh axis for collectives AND declare which axes shard the batch
+    (read by cross-replica statistics like sync-BN). One shared helper so
+    the two bodies can never derive different batch axes."""
+    import contextlib
+
+    from .parallel.communicator import batch_shard_axes, collective_context
+
+    stack = contextlib.ExitStack()
+    stack.enter_context(collective_context(*mesh.axis_names))
+    stack.enter_context(batch_shard_axes(
+        _batch_dim_axes(input_specs or [], axis)))
+    return stack
+
+
 def _resolve_leaf_specs(leaves, full_batch, input_specs, axis, user_out):
     """Default per-output-leaf layouts, shared by the train and eval
     builders: a user-supplied spec list wins; otherwise batch-leading
@@ -349,8 +365,7 @@ class Model(Layer):
             return new_state, leaves, next_key
 
         if dist is not None:
-            from .parallel.communicator import (get_mesh,
-                                                collective_context)
+            from .parallel.communicator import get_mesh
             mesh = dist.communicator.mesh
             if mesh is None:
                 # mesh over the devices of the model's platform (virtual CPU
@@ -361,9 +376,7 @@ class Model(Layer):
             axis = dist.axis_name
 
             def body(state_arrays, rng_key, *input_arrays):
-                # register every mesh axis: tensor/sequence-parallel layers
-                # issue collectives on 'model'/'seq', DistOpt on 'data'
-                with collective_context(*mesh.axis_names):
+                with _mesh_step_context(mesh, rec["input_specs"], axis):
                     return fn(state_arrays, rng_key, *input_arrays)
 
             def build(sample_inputs, rng):
@@ -467,7 +480,10 @@ class Model(Layer):
                 [P(self._axis)] * len(input_arrays)
             # identity cache: benchmark/eval loops feed the same arrays
             # every step — skip re-sharding them (one previous batch is
-            # kept alive per slot, the cost of a depth-1 prefetch)
+            # kept alive per slot, the cost of a depth-1 prefetch).
+            # Immutable jax.Arrays ONLY: a host numpy array mutated in
+            # place between steps would hit on object identity and
+            # silently train on the stale device shard.
             cache = rec.setdefault("in_cache", [None] * len(input_arrays))
             placed = []
             for i, (a, s) in enumerate(zip(input_arrays, in_specs)):
@@ -476,7 +492,7 @@ class Model(Layer):
                     placed.append(c[1])
                     continue
                 pa = place(a, NamedSharding(self._mesh, s))
-                if i < len(cache):
+                if i < len(cache) and isinstance(a, jax.Array):
                     cache[i] = (a, pa)
                 placed.append(pa)
             input_arrays = placed
@@ -496,8 +512,29 @@ class Model(Layer):
             except Exception:   # cost analysis is backend-best-effort
                 pass
         t0 = time.perf_counter()
-        new_state, leaves, next_key = rec["jit"](state_arrays, rng,
-                                                 *input_arrays)
+        if self.dev.verbosity >= 2 and not rec.get("fusions_measured"):
+            # one-time MEASURED per-fusion table for this signature (the
+            # compiled-world per-node timing, reference
+            # scheduler.cc:240-298) — this very step runs under a
+            # profiler trace, so no extra compute and no state copies
+            from . import profiling as _prof
+            rec["fusions_measured"] = True
+
+            def run_once():
+                res = rec["jit"](state_arrays, rng, *input_arrays)
+                jax.block_until_ready(res)
+                return res
+
+            (new_state, leaves, next_key), fus = \
+                _prof.measure_step_fusions(run_once)
+            for name, (cnt, tot) in fus.items():
+                c0, t0_ = self.dev.time_profiling.get(
+                    f"fusion/{name}", (0, 0.0))
+                self.dev.time_profiling[f"fusion/{name}"] = (c0 + cnt,
+                                                             t0_ + tot)
+        else:
+            new_state, leaves, next_key = rec["jit"](state_arrays, rng,
+                                                     *input_arrays)
         self.dev._set_rng_state(next_key)  # tracing clobbered dev rng
         if self._dist is not None:
             # bound the async in-flight queue: a host loop can dispatch
@@ -633,7 +670,6 @@ class Model(Layer):
         lives instead of being gathered to one device — which OOMs for
         exactly the models model-parallelism exists for. (Reference
         inference runs on the same device graph, model.py:210-222.)"""
-        from .parallel.communicator import collective_context
         self._ensure_state()
         state_list = self._state_list
         dist = self._dist
@@ -680,7 +716,7 @@ class Model(Layer):
             return leaves
 
         def body(state_arrays, *input_arrays):
-            with collective_context(*mesh.axis_names):
+            with _mesh_step_context(mesh, rec["input_specs"], axis):
                 return fn(state_arrays, *input_arrays)
 
         mapped = shard_map(body, mesh=mesh,

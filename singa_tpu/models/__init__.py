@@ -13,14 +13,22 @@ class TrainStepMixin:
     """Shared dist-option dispatch for train_one_batch
     (reference examples/cnn/model/cnn.py:52-70)."""
 
-    def _apply_optimizer(self, loss, dist_option="plain", spars=None):
+    def _apply_optimizer(self, loss, dist_option="plain", spars=None,
+                         rotation=None):
         if dist_option == "plain" or not hasattr(
                 self.optimizer, "backward_and_update_half"):
             self.optimizer(loss)
         elif dist_option == "half":
             self.optimizer.backward_and_update_half(loss)
         elif dist_option == "partialUpdate":
-            self.optimizer.backward_and_partial_update(loss)
+            # ``rotation`` (a STATIC python int, normally
+            # step % world_size) keys the Model's compiled-step cache: n
+            # small specializations, each issuing the all-reduce ONLY for
+            # its parameter partition — the reference's communication
+            # saving (opt.py:922-992). Without it the traced fallback
+            # reduces every gradient and merely masks the application.
+            self.optimizer.backward_and_partial_update(
+                loss, rotation=rotation)
         elif dist_option == "sparseTopK":
             self.optimizer.backward_and_sparse_update(
                 loss, topK=True, spars=spars)

@@ -31,10 +31,11 @@ class CNN(model.Model, TrainStepMixin):
         y = self.relu(y)
         return self.linear2(y)
 
-    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+    def train_one_batch(self, x, y, dist_option="plain", spars=None,
+                    rotation=None):
         out = self.forward(x)
         loss = self.softmax_cross_entropy(out, y)
-        self._apply_optimizer(loss, dist_option, spars)
+        self._apply_optimizer(loss, dist_option, spars, rotation)
         return out, loss
 
 

@@ -93,10 +93,11 @@ class DenseNet(model.Model, TrainStepMixin):
         x = autograd.reduce_mean(x, axes=[2, 3], keepdims=0)
         return self.fc(x)
 
-    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+    def train_one_batch(self, x, y, dist_option="plain", spars=None,
+                    rotation=None):
         out = self.forward(x)
         loss = self.softmax_cross_entropy(out, y)
-        self._apply_optimizer(loss, dist_option, spars)
+        self._apply_optimizer(loss, dist_option, spars, rotation)
         return out, loss
 
 

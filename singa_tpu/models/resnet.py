@@ -125,10 +125,11 @@ class ResNet(model.Model, TrainStepMixin):
         x = self.flatten(self.avgpool(x))
         return self.fc(x)
 
-    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+    def train_one_batch(self, x, y, dist_option="plain", spars=None,
+                    rotation=None):
         out = self.forward(x)
         loss = self.softmax_cross_entropy(out, y)
-        self._apply_optimizer(loss, dist_option, spars)
+        self._apply_optimizer(loss, dist_option, spars, rotation)
         return out, loss
 
     # registered block lists live in self._registered; expose their params

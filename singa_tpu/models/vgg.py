@@ -58,10 +58,11 @@ class VGG(model.Model, TrainStepMixin):
         x = self.drop2(self.relu2(self.fc2(x)))
         return self.fc3(x)
 
-    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+    def train_one_batch(self, x, y, dist_option="plain", spars=None,
+                    rotation=None):
         out = self.forward(x)
         loss = self.softmax_cross_entropy(out, y)
-        self._apply_optimizer(loss, dist_option, spars)
+        self._apply_optimizer(loss, dist_option, spars, rotation)
         return out, loss
 
 

@@ -90,7 +90,12 @@ class FeedForwardNet(Model):
             def src():
                 for bx, by in it:
                     host_y.append(by)
-                    yield bx, by
+                    # preserve train_on_batch's historical float32
+                    # contract: integer / float64 datasets would
+                    # otherwise reach the compiled step with a new
+                    # dtype (recompile or type error)
+                    yield (np.asarray(bx, np.float32),
+                           np.asarray(by, np.float32))
 
             for i, (tbx, tby) in enumerate(
                     DevicePrefetcher(src(), dev, depth=2)):

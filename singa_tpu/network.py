@@ -160,24 +160,40 @@ class EndPoint:
 
     def recv(self, timeout: float = 5.0) -> Message | None:
         """Next message, or None on timeout. Raises when the connection
-        died and nothing is queued."""
-        with self._recv_lock, self._net._guard() as h:
-            ms = ctypes.c_uint64()
-            ps = ctypes.c_uint64()
-            rc = _load().sg_ep_recv_wait(h, self._h, int(timeout * 1000),
-                                         ctypes.byref(ms), ctypes.byref(ps))
-            if rc == 0:
-                return None
-            if rc < 0:
-                raise ConnectionError("endpoint closed")
-            meta = ctypes.create_string_buffer(max(1, ms.value))
-            payload = ctypes.create_string_buffer(max(1, ps.value))
-            rc2 = _load().sg_ep_recv_copy(h, self._h, meta, ms.value,
-                                          payload, ps.value)
-            if rc2 < 0:
-                # endpoint was closed between the wait and the copy
-                raise ConnectionError("endpoint closed")
-            return Message(meta.raw[:ms.value], payload.raw[:ps.value])
+        died and nothing is queued.
+
+        The native wait runs in SHORT slices with the net guard released
+        between them, so ``NetworkThread.close()`` is never blocked for a
+        caller-chosen recv timeout, and one endpoint's long recv does not
+        serialize the whole Net against close."""
+        import time as _time
+        deadline = _time.monotonic() + max(0.0, timeout)
+        with self._recv_lock:
+            while True:
+                remaining = deadline - _time.monotonic()
+                slice_ms = int(min(max(remaining, 0.0), 0.2) * 1000)
+                with self._net._guard() as h:
+                    ms = ctypes.c_uint64()
+                    ps = ctypes.c_uint64()
+                    rc = _load().sg_ep_recv_wait(
+                        h, self._h, slice_ms,
+                        ctypes.byref(ms), ctypes.byref(ps))
+                    if rc < 0:
+                        raise ConnectionError("endpoint closed")
+                    if rc > 0:
+                        meta = ctypes.create_string_buffer(
+                            max(1, ms.value))
+                        payload = ctypes.create_string_buffer(
+                            max(1, ps.value))
+                        rc2 = _load().sg_ep_recv_copy(
+                            h, self._h, meta, ms.value, payload, ps.value)
+                        if rc2 < 0:
+                            # closed between the wait and the copy
+                            raise ConnectionError("endpoint closed")
+                        return Message(meta.raw[:ms.value],
+                                       payload.raw[:ps.value])
+                if remaining <= 0:
+                    return None
 
     def drain(self, timeout: float = 5.0) -> bool:
         """Wait until every sent message has been acknowledged."""

@@ -191,12 +191,29 @@ except ImportError:  # pragma: no cover
 FORCE_PALLAS_INTERPRET = False
 
 
+_DECLINE_LOGGED = set()
+
+
 def _use_pallas(q, k, block_q, block_k):
     if not HAS_PALLAS:
         return False
     bq = min(block_q, q.shape[2])
     bk = min(block_k, k.shape[2])
     if q.shape[2] % bq or k.shape[2] % bk:
+        if jax.default_backend() == "tpu":
+            # on TPU this silently costs the fused kernel — say so once
+            # per shape so an odd sequence length is a visible choice,
+            # not a hidden perf cliff
+            sig = (q.shape[2], k.shape[2], bq, bk)
+            if sig not in _DECLINE_LOGGED:
+                _DECLINE_LOGGED.add(sig)
+                import warnings
+                warnings.warn(
+                    f"flash attention: sequence lengths q={q.shape[2]} "
+                    f"k={k.shape[2]} not divisible by blocks "
+                    f"({bq},{bk}); using the unfused scan path — pad "
+                    "the sequence to a multiple of 128 to get the "
+                    "Pallas kernel", stacklevel=3)
         return False
     return jax.default_backend() == "tpu" or FORCE_PALLAS_INTERPRET
 

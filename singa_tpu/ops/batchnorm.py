@@ -42,24 +42,22 @@ class BatchNormHandle:
         return (1, self.channels) if ndim == 2 else (1, self.channels, 1, 1)
 
 
-# Mesh axes that shard the batch dimension BN statistics span. Inside a
-# shard_map'd data-parallel step each replica sees only its local batch
-# shard; sync-BN pmeans the moments over these axes so both normalisation
-# and the running-stat update use GLOBAL batch statistics — making the
-# sharded step numerically identical to a single-device full-batch step
-# (the SPMD-correct form of the reference's in-place running stats,
-# src/model/operation/batchnorm.h:103-115).
-BATCH_AXES = ("data",)
-
-
 def _global_moments(xb, axes):
-    """Batch mean/var, pmean-synchronised across data-parallel shards
-    (identity outside a mesh context). Two-pass: variance is the mean
-    squared deviation around the GLOBAL mean — numerically stable (never
-    negative) and, with equal-sized shards, exactly the full-batch biased
-    variance."""
-    from ..parallel.communicator import active_axis
-    paxes = tuple(a for a in BATCH_AXES if active_axis(a))
+    """Batch mean/var, pmean-synchronised across every mesh axis the
+    batch is sharded over (identity outside a mesh context). Inside a
+    shard_map'd step each replica sees only its local batch shard;
+    sync-BN pmeans the moments so both normalisation and the
+    running-stat update use GLOBAL batch statistics — making the sharded
+    step numerically identical to a single-device full-batch step (the
+    SPMD-correct form of the reference's in-place running stats,
+    src/model/operation/batchnorm.h:103-115). The axes come from the
+    Model step's declared input batch sharding, NOT a hardcoded 'data'
+    (the batch may shard over ('data','expert') or a renamed axis).
+    Two-pass: variance is the mean squared deviation around the GLOBAL
+    mean — numerically stable (never negative) and, with equal-sized
+    shards, exactly the full-batch biased variance."""
+    from ..parallel.communicator import active_batch_axes
+    paxes = active_batch_axes()
     mean = jnp.mean(xb, axis=axes)
     if paxes:
         mean = jax.lax.pmean(mean, paxes)

@@ -449,20 +449,35 @@ class DistOpt:
             self.update(p, g)
         self.opt.step()
 
-    def backward_and_partial_update(self, loss, threshold=2097152):
+    def backward_and_partial_update(self, loss, threshold=2097152,
+                                    rotation=None):
         """Partial synchronisation: each step, only a rotating
         1/world_size partition of the parameters takes the globally
         averaged gradient; the rest update locally
         (reference opt.py:922-992).
 
-        The rotation is keyed on the optimizer's traced step counter, so it
-        keeps rotating under graph (jit) mode where Python-side counters
-        would freeze at their trace-time value. Inside a compiled step the
-        collective still runs for every gradient (XLA cannot skip a
-        collective on a traced predicate); the reference's comm saving is
-        traded for jit compatibility.
+        ``rotation`` — a STATIC python int (normally ``step %
+        world_size``) — selects the partition at TRACE time, so the
+        all-reduce is only emitted for the selected parameters: the
+        reference's actual communication saving, at the cost of one
+        compiled-step specialization per rotation value (the Model's
+        static-arg cache holds all n).
+
+        With ``rotation=None`` the selection rides the optimizer's traced
+        step counter instead: a single compiled step that keeps rotating,
+        but XLA cannot skip a collective on a traced predicate, so every
+        gradient is still reduced and only the APPLICATION is masked.
         """
         n = max(1, self.communicator.effective_world_size())
+        if rotation is not None:
+            rot = int(rotation) % n
+            for i, (p, g) in enumerate(autograd.backward(loss)):
+                if i % n == rot:
+                    g.data = self.all_reduce(
+                        g.data, exclude=self._shard_axes(p)) / n
+                self.opt.apply(p.name or f"param/{id(p)}", p, g)
+            self.opt.step()
+            return
         step = self.opt.step_counter.data
         for i, (p, g) in enumerate(autograd.backward(loss)):
             summed = self.all_reduce(g.data,

@@ -42,6 +42,34 @@ def active_axis(axis_name: str) -> bool:
     return axis_name in _ACTIVE_AXES
 
 
+# Mesh axes the BATCH dimension is sharded over inside the current
+# shard_map'd step. Cross-replica statistics (sync-BN) must reduce over
+# exactly these — not a hardcoded ("data",), which silently computes
+# shard-local stats when the batch also shards over 'expert'/'seq' or a
+# renamed axis. The Model's step body sets this from its input specs.
+_BATCH_SHARD_AXES: list[tuple] = []
+
+
+@contextlib.contextmanager
+def batch_shard_axes(axes):
+    """Declare the mesh axes sharding the batch dim for the enclosed
+    trace (normally entered by Model's compiled step body)."""
+    axes = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+    _BATCH_SHARD_AXES.append(axes)
+    try:
+        yield
+    finally:
+        _BATCH_SHARD_AXES.pop()
+
+
+def active_batch_axes() -> tuple:
+    """Axes cross-replica batch statistics should reduce over: the
+    declared batch-shard axes (default 'data') filtered to those
+    actually active."""
+    axes = _BATCH_SHARD_AXES[-1] if _BATCH_SHARD_AXES else ("data",)
+    return tuple(a for a in axes if a is not None and active_axis(a))
+
+
 _global_mesh = None
 
 

@@ -17,6 +17,15 @@ Two schedules:
   loss inside the schedule; backward recomputes each stage from a saved
   input-activation ring of depth 2(S-1)+1, so activation memory is
   bounded by the pipe depth, not the microbatch count.
+
+Prefer 1F1B for training: in the SPMD GPipe form every pipe member also
+recomputes the downstream (post-pipeline) loss redundantly — inherent to
+one-program-per-mesh SPMD, harmless for inference, but wasted compute
+per training step that the in-schedule 1F1B loss avoids entirely.
+
+Heterogeneous stages (different params AND different activation shapes
+per stage — embedding -> blocks -> head) are first-class via
+:class:`HeteroPipeline1F1B`.
 """
 
 from __future__ import annotations

@@ -1,0 +1,106 @@
+"""Measured per-fusion step profiling from ``jax.profiler`` traces.
+
+The reference prints MEASURED per-node times of the graph it actually
+runs (src/core/scheduler/scheduler.cc:240-298). In the XLA world the
+executed graph is a set of fusions, so the honest equivalent is: capture
+a profiler trace of one compiled step and aggregate the per-fusion
+durations. This complements the *static* cost analysis (flops/bytes)
+captured by ``Model.cost_analysis``.
+"""
+
+import glob
+import gzip
+import json
+import os
+
+# host-side runtime/python frames that appear in CPU traces alongside the
+# XLA op events; device lanes (TPU) don't need this
+_RUNTIME_MARKERS = ("(", "::", " ")
+
+
+def _is_xla_op_event(name):
+    if name.startswith("$"):             # python source frames
+        return False
+    return not any(m in name for m in _RUNTIME_MARKERS)
+
+
+def parse_trace_dir(logdir):
+    """Aggregate complete ('X') events from a ``jax.profiler.trace``
+    output directory into ``{op_name: (count, total_seconds)}``.
+
+    Prefers device lanes (``/device:...`` processes — real accelerator
+    timelines); on backends without device lanes (CPU) falls back to the
+    host lane filtered down to XLA op/fusion names.
+    """
+    files = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    out = {}
+    for path in files:
+        try:
+            with gzip.open(path, "rt") as fh:
+                trace = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        events = trace.get("traceEvents", [])
+        lanes = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                lanes[e["pid"]] = e.get("args", {}).get("name", "")
+        device_pids = {pid for pid, name in lanes.items()
+                       if name.startswith("/device:")}
+        for e in events:
+            if e.get("ph") != "X" or not e.get("dur"):
+                continue
+            name = e.get("name", "")
+            pid = e.get("pid")
+            if device_pids:
+                if pid not in device_pids:
+                    continue
+            elif not _is_xla_op_event(name):
+                continue
+            cnt, tot = out.get(name, (0, 0.0))
+            out[name] = (cnt + 1, tot + float(e["dur"]) * 1e-6)
+    return out
+
+
+def measure_step_fusions(run_step, logdir=None):
+    """Run ``run_step()`` (which must block on its outputs) under a
+    profiler trace and return the parsed per-op aggregate. Returns
+    ``(result, {name: (count, total_seconds)})``.
+
+    PROFILER failures degrade to an empty table; a failure of the step
+    itself propagates untouched (re-running an expensive failing step to
+    mask a profiling problem would double the damage and bury the real
+    traceback). The temporary trace dump is deleted unless the caller
+    supplied ``logdir``."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    d = logdir or tempfile.mkdtemp(prefix="sg_prof_")
+    ctx = None
+    try:
+        ctx = jax.profiler.trace(d)
+        ctx.__enter__()
+    except Exception:
+        ctx = None
+    try:
+        result = run_step()
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception:
+                ctx = None
+        if ctx is None and logdir is None:
+            shutil.rmtree(d, ignore_errors=True)
+    table = {}
+    if ctx is not None:
+        try:
+            table = parse_trace_dir(d)
+        except Exception:
+            table = {}
+        if logdir is None:
+            shutil.rmtree(d, ignore_errors=True)
+    return result, table
