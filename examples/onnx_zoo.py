@@ -1,0 +1,147 @@
+"""Run (and optionally fine-tune) a LOCAL ONNX model file.
+
+The reference ships one script per downloaded zoo model
+(examples/onnx/{resnet18,vgg16,vgg19,mobilenet,squeezenet,shufflenetv1,
+shufflenetv2,densenet121,arcface,fer_emotion,tiny_yolov2,
+superresolution,bert,gpt2,ro_bert_a}.py), each doing: download →
+``sonnx.prepare(model)`` → run. This environment has no egress, so this
+single script covers the same capability for ANY ``.onnx`` file already
+on disk — including models exported from this framework's own zoo
+(``--export`` writes one to try the loop end-to-end).
+
+Usage:
+  python examples/onnx_zoo.py model.onnx [--input data.npz]
+      [--batch 1] [--finetune N_STEPS] [--lr 0.05] [--cpu]
+  python examples/onnx_zoo.py --export model.onnx [--arch mlp|cnn]
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def export(path, arch, dev):
+    from singa_tpu import models, sonnx, tensor
+
+    shapes = {"mlp": (2, 64), "cnn": (2, 1, 28, 28)}
+    factory = getattr(models, arch)
+    kwargs = {"data_size": 64} if arch == "mlp" else {}
+    m = factory.create_model(num_classes=10, **kwargs)
+    x = np.zeros(shapes[arch], np.float32)
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    m.compile([tx], is_train=False, use_graph=False)
+    mp = sonnx.to_onnx(m, [tx])
+    with open(path, "wb") as f:
+        f.write(mp.SerializeToString())
+    print(f"exported {arch} -> {path}")
+
+
+def load_model(path):
+    from singa_tpu.onnx_proto import ModelProto
+
+    mp = ModelProto()
+    with open(path, "rb") as f:
+        mp.ParseFromString(f.read())
+    return mp
+
+
+def input_arrays(rep, args):
+    if args.input:
+        blob = np.load(args.input)
+        return [blob[k] for k in blob.files]
+    out = []
+    rng = np.random.RandomState(0)
+    for vi in rep.inputs:
+        dims = [d.dim_value or args.batch
+                for d in vi.type.tensor_type.shape.dim]
+        dims[0] = args.batch
+        out.append(rng.randn(*dims).astype(np.float32))
+        print(f"  input {vi.name}: random {tuple(dims)}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", nargs="?", help="path to a .onnx file")
+    ap.add_argument("--export", default=None,
+                    help="write a model exported from our zoo here")
+    ap.add_argument("--arch", default="mlp", choices=["mlp", "cnn"])
+    ap.add_argument("--input", default=None,
+                    help="npz whose arrays are the graph inputs in order")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--finetune", type=int, default=0,
+                    help="SONNXModel fine-tune steps on synthetic labels")
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import device, sonnx
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    dev.SetRandSeed(0)
+
+    if args.export:
+        export(args.export, args.arch, dev)
+        if not args.model:
+            return
+
+    if not args.model:
+        sys.exit("no model path given (or use --export)")
+
+    mp = load_model(args.model)
+    rep = sonnx.SingaBackend.prepare(
+        mp, device="CPU" if args.cpu else "TPU")
+    print(f"loaded {args.model}: {len(rep.nodes)} nodes, "
+          f"{len(rep.states)} initializers")
+
+    ins = input_arrays(rep, args)
+    outs = rep.run(ins)
+    for o, vi in zip(outs, rep.outputs):
+        arr = np.asarray(o.numpy())
+        print(f"  output {vi.name}: {arr.shape} "
+              f"mean={arr.mean():.4f} std={arr.std():.4f}")
+
+    if args.finetune:
+        from singa_tpu import opt, tensor
+
+        class Tuned(sonnx.SONNXModel):
+            def __init__(self, model_proto):
+                super().__init__(model_proto)
+                from singa_tpu import layer
+                self.loss_fn = layer.SoftMaxCrossEntropy()
+
+            def forward(self, *x):
+                out = super().forward(*x)
+                return out[0] if isinstance(out, (list, tuple)) else out
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = self.loss_fn(out, y)
+                self.optimizer(loss)
+                return out, loss
+
+        m = Tuned(mp)
+        m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+        tx = tensor.Tensor(data=ins[0], device=dev, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+        classes = np.asarray(outs[0].numpy()).shape[-1]
+        rng = np.random.RandomState(1)
+        y = np.eye(classes, dtype=np.float32)[
+            rng.randint(0, classes, len(ins[0]))]
+        ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+        for i in range(args.finetune):
+            out, loss = m(tx, ty)
+            print(f"  finetune step {i}: loss {float(loss.data):.4f}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""IMDB sentiment classification with an embedding + LSTM stack
+(reference examples/rnn/imdb_model.py + imdb_train.py).
+
+Data: pass ``--data imdb.npz`` with arrays ``x`` (N, seq) int token ids
+and ``y`` (N,) 0/1 labels — the output of any standard IMDB
+preprocessing (the reference's imdb_data.py builds exactly such padded
+id sequences; no downloads happen here). Without ``--data`` a synthetic
+separable token dataset is generated so the script always runs.
+
+Usage: python examples/train_imdb.py [--data imdb.npz] [--bs 32]
+           [--epochs 2] [--hidden 64] [--vocab 4000] [--seq 64]
+           [--mode lstm|gru] [--bidirectional] [--cpu]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def synthetic(vocab, seq, n=512, seed=0):
+    """Separable by construction: class 1 sequences oversample the top
+    half of the vocabulary."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 2, n)
+    lo = rng.randint(1, vocab // 2, (n, seq))
+    hi = rng.randint(vocab // 2, vocab, (n, seq))
+    mask = rng.rand(n, seq) < (0.25 + 0.5 * y[:, None])
+    x = np.where(mask, hi, lo)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--bs", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--embed", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=4000)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--mode", default="lstm", choices=["lstm", "gru"])
+    ap.add_argument("--bidirectional", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from singa_tpu import autograd, device, layer, metric, model, opt, \
+        tensor
+
+    class IMDBModel(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.embed = layer.Embedding(args.vocab, args.embed)
+            self.rnn = layer.CudnnRNN(hidden_size=args.hidden,
+                                      rnn_mode=args.mode,
+                                      batch_first=True,
+                                      bidirectional=args.bidirectional,
+                                      return_sequences=False)
+            self.l1 = layer.Linear(64)
+            self.l2 = layer.Linear(2)
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            y, _hy, _cy = self.rnn(self.embed(x))
+            y = autograd.reshape(y, (y.shape[0], -1))
+            return self.l2(autograd.relu(self.l1(y)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    dev.SetRandSeed(0)
+
+    if args.data:
+        blob = np.load(args.data)
+        x_all = blob["x"].astype(np.float32)
+        y_all = blob["y"].astype(np.int32)
+        args.vocab = max(args.vocab, int(x_all.max()) + 1)
+    else:
+        x_all, y_all = synthetic(args.vocab, args.seq)
+    n_val = max(args.bs, len(x_all) // 10)
+    train_x, train_y = x_all[:-n_val], y_all[:-n_val]
+    val_x, val_y = x_all[-n_val:], y_all[-n_val:]
+
+    m = IMDBModel()
+    m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    tx = tensor.Tensor(data=train_x[:args.bs], device=dev,
+                       requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    eye = np.eye(2, dtype=np.float32)
+    acc = metric.Accuracy()
+    rng = np.random.RandomState(1)
+    for epoch in range(args.epochs):
+        idx = rng.permutation(len(train_x))
+        t0, losses, accs = time.time(), [], []
+        m.train()
+        for b in range(len(train_x) // args.bs):
+            sel = idx[b * args.bs:(b + 1) * args.bs]
+            bx = tensor.Tensor(data=train_x[sel], device=dev,
+                               requires_grad=False)
+            by = tensor.Tensor(data=eye[train_y[sel]], device=dev,
+                               requires_grad=False)
+            out, loss = m(bx, by)
+            losses.append(float(loss.data))
+            accs.append(acc.evaluate(out, train_y[sel]))
+        m.eval()
+        vaccs = []
+        for b in range(max(1, len(val_x) // args.bs)):
+            bx = val_x[b * args.bs:(b + 1) * args.bs]
+            by = val_y[b * args.bs:(b + 1) * args.bs]
+            out = m(tensor.Tensor(data=bx, device=dev,
+                                  requires_grad=False))
+            vaccs.append(acc.evaluate(out, by))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
+              f"train_acc {np.mean(accs):.4f} "
+              f"val_acc {np.mean(vaccs):.4f} "
+              f"({time.time() - t0:.1f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -521,12 +521,17 @@ class HeteroPipeline1F1B(Layer):
     need float0 cotangent plumbing.
     """
 
-    def __init__(self, stages, loss_fn, n_micro, axis="pipe"):
+    def __init__(self, stages, loss_fn, n_micro, axis="pipe",
+                 wire_dtype="float32"):
         super().__init__()
         self._stages = list(stages)   # underscore: NOT sublayers — the
         self._loss_fn = loss_fn       # packed stack is the only state
         self.n_micro = n_micro
         self.axis = axis
+        # "bfloat16" halves the ICI bytes of every activation AND
+        # cotangent hop (the pipeline analogue of the 'half' dist
+        # option); params/loss accumulation stay float32
+        self._wire_dtype = jnp.dtype(wire_dtype)
 
     def initialize(self, x, y=None):
         B = x.shape[0]
@@ -589,10 +594,9 @@ class HeteroPipeline1F1B(Layer):
             .reshape((a_wire.shape[0],) + in_shape[1:]) \
             .astype(self._act_dtypes[s - 1])
 
-    @staticmethod
-    def _to_wire(o, n_rows, wire):
-        of = o.reshape(o.shape[0], -1).astype(jnp.float32)
-        return jnp.zeros((n_rows, wire), jnp.float32) \
+    def _to_wire(self, o, n_rows, wire):
+        of = o.reshape(o.shape[0], -1).astype(self._wire_dtype)
+        return jnp.zeros((n_rows, wire), self._wire_dtype) \
             .at[:, :of.shape[1]].set(of)
 
     def _branch_train(self, s, n_stages):
@@ -610,8 +614,9 @@ class HeteroPipeline1F1B(Layer):
             o = self._apply_stage(s, self._stage_in(s, a_wire, mb_x))
             if s == n_stages - 1:
                 loss = self._loss_fn(o, y_mb)
-                return jnp.zeros((a_wire.shape[0], wire), jnp.float32) \
-                    .at[0, -1].set(loss.astype(jnp.float32))
+                return jnp.zeros((a_wire.shape[0], wire),
+                                 self._wire_dtype) \
+                    .at[0, -1].set(loss.astype(self._wire_dtype))
             return self._to_wire(o, a_wire.shape[0], wire)
 
         return fn
@@ -663,18 +668,19 @@ class HeteroPipeline1F1B(Layer):
         return {"stages_packed": self._stacked}
 
 
-def _make_het_1f1b_loss(make_dispatch, wire_shape, axis_name):
+def _make_het_1f1b_loss(make_dispatch, wire_shape, axis_name,
+                        wire_dtype=jnp.float32):
     """custom-vjp wrapper: differentiating the scalar loss hands back the
     1F1B schedule's OWN gradients instead of autodiffing the scan. The
     rng base key is an explicit argument (custom_vjp forbids closing
     over tracers) with a float0 cotangent."""
     def extract(w, _y):
-        return w[0, -1]
+        return w[0, -1].astype(jnp.float32)
 
     def run(flat_local, x_mb, y_mb, base_key):
         return _pipeline_1f1b_core(
             make_dispatch(base_key), extract, flat_local, x_mb, y_mb,
-            wire_shape, jnp.float32, axis_name)
+            wire_shape, wire_dtype, axis_name)
 
     @jax.custom_vjp
     def f(flat_local, x_mb, y_mb, base_key):
@@ -728,7 +734,8 @@ class _PipelineHet1F1B(Operator):
 
             base_key = m._dev._get_rng_state()
             f = _make_het_1f1b_loss(
-                make_dispatch, (x_mb.shape[1], m._wire_train), m.axis)
+                make_dispatch, (x_mb.shape[1], m._wire_train), m.axis,
+                m._wire_dtype)
             out = f(stacked[0], x_mb, y_mb, base_key)
             # branch traces left the device key holding inner tracers;
             # restore a deterministic continuation of the stream
@@ -763,7 +770,7 @@ class _PipelineHetFwd(Operator):
 
             w = _pipeline_fwd_core(dispatch, stacked[0], x_mb,
                                    (x_mb.shape[1], m._wire_fwd),
-                                   jnp.float32, m.axis)
+                                   m._wire_dtype, m.axis)
             w = _pipe_descale(w, m.axis)
             out_shape = m._out_shapes[-1]
             o = w[:, :, :_feat(out_shape)].reshape(

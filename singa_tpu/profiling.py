@@ -79,28 +79,30 @@ def measure_step_fusions(run_step, logdir=None):
     import jax
 
     d = logdir or tempfile.mkdtemp(prefix="sg_prof_")
-    ctx = None
     try:
-        ctx = jax.profiler.trace(d)
-        ctx.__enter__()
-    except Exception:
         ctx = None
-    try:
-        result = run_step()
-    finally:
+        try:
+            ctx = jax.profiler.trace(d)
+            ctx.__enter__()
+        except Exception:
+            ctx = None
+        try:
+            result = run_step()
+        finally:
+            if ctx is not None:
+                try:
+                    ctx.__exit__(None, None, None)
+                except Exception:
+                    ctx = None
+        table = {}
         if ctx is not None:
             try:
-                ctx.__exit__(None, None, None)
+                table = parse_trace_dir(d)
             except Exception:
-                ctx = None
-        if ctx is None and logdir is None:
-            shutil.rmtree(d, ignore_errors=True)
-    table = {}
-    if ctx is not None:
-        try:
-            table = parse_trace_dir(d)
-        except Exception:
-            table = {}
+                table = {}
+        return result, table
+    finally:
+        # the trace dump can be tens of MB per signature; never leave it
+        # behind (including when the step itself raised)
         if logdir is None:
             shutil.rmtree(d, ignore_errors=True)
-    return result, table

@@ -94,3 +94,19 @@ class TestExamples:
         out = run_example(["examples/train_ffnet.py", "--cpu", "--n", "64",
                            "--epochs", "1", "--size", "12", "--bs", "16"])
         assert "final eval" in out, out[-500:]
+
+    def test_train_imdb(self):
+        out = run_example(["examples/train_imdb.py", "--cpu", "--epochs",
+                           "1", "--bs", "16", "--seq", "16", "--vocab",
+                           "200", "--hidden", "16"])
+        assert "val_acc" in out, out[-500:]
+
+    def test_onnx_zoo_roundtrip(self, tmp_path):
+        """Export one of our zoo models to a .onnx FILE, reload it from
+        disk, run inference, and fine-tune — the reference's
+        examples/onnx/*.py loop without the download."""
+        p = str(tmp_path / "m.onnx")
+        out = run_example(["examples/onnx_zoo.py", "--export", p,
+                           "--arch", "mlp", "--cpu", p,
+                           "--finetune", "2"])
+        assert "output" in out and "finetune step 1" in out, out[-800:]

@@ -1040,6 +1040,69 @@ class TestHeteroPipelineStress:
         lc, _ = self._run(True, dropout=0.3, steps=6, seed=10)
         assert not np.allclose(la, lc)
 
+    def test_bf16_wire_trains_close_to_f32_wire(self):
+        """wire_dtype='bfloat16' halves every activation/cotangent hop;
+        training stays close to the f32-wire run."""
+        import singa_tpu.parallel.pipeline as _pl
+
+        def run(wd, steps=6):
+            din, dh, classes = 8, 16, 4
+
+            class S0(layer.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.fc = layer.Linear(dh)
+                    self.act = layer.ReLU()
+
+                def forward(self, a):
+                    return self.act(self.fc(a))
+
+            class S1(layer.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.fc = layer.Linear(classes)
+
+                def forward(self, a):
+                    return self.fc(a)
+
+            dev = device.create_cpu_device()
+            dev.SetRandSeed(21)
+            rng = np.random.RandomState(4)
+            x = rng.randn(16, din).astype(np.float32)
+            w = rng.randn(din, classes).astype(np.float32)
+            y = np.eye(classes, dtype=np.float32)[np.argmax(x @ w, 1)]
+
+            class HP(model.Model):
+                def __init__(inner):
+                    super().__init__()
+                    inner.pipe = _pl.HeteroPipeline1F1B(
+                        [S0(), S1()], self._ce, n_micro=2,
+                        wire_dtype=wd)
+
+                def forward(inner, xx):
+                    return inner.pipe(xx)
+
+                def train_one_batch(inner, xx, yy):
+                    loss = inner.pipe(xx, yy)
+                    inner.optimizer(loss)
+                    return loss, loss
+
+            m = HP()
+            dopt = opt.DistOpt(opt.SGD(lr=0.2, momentum=0.9))
+            dopt.communicator.mesh = mesh_mod.make_mesh(
+                jax.devices("cpu"), mesh_mod.MeshConfig(pipe=2))
+            m.set_optimizer(dopt)
+            tx = Tensor(data=x, device=dev, requires_grad=False)
+            ty = Tensor(data=y, device=dev, requires_grad=False)
+            m.compile([tx], is_train=True, use_graph=True)
+            return [float(np.asarray(m(tx, ty)[1].data))
+                    for _ in range(steps)]
+
+        f32 = run("float32")
+        bf16 = run("bfloat16")
+        assert bf16[-1] < bf16[0] * 0.9, bf16
+        np.testing.assert_allclose(bf16, f32, rtol=0.08)
+
     def test_bf16_stages_train(self):
         lb, _ = self._run(True, dtype=jnp.bfloat16, steps=6)
         assert lb[-1] < lb[0], lb
