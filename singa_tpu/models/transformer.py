@@ -51,7 +51,7 @@ class MultiHeadAttention(layer.Layer):
     sequence-parallel (ring) over tokens."""
 
     def __init__(self, d_model, n_heads, causal=True, tp=True,
-                 seq_axis=None, axis_name="model"):
+                 seq_axis=None, axis_name="model", seq_mode="ring"):
         """``tp`` is accepted for API compatibility but the layout is
         mesh-driven: the parallel layers degrade to plain Linear on a
         size-1 'model' axis (or outside any mesh), so there is exactly one
@@ -63,6 +63,7 @@ class MultiHeadAttention(layer.Layer):
         self.head_dim = d_model // n_heads
         self.causal = causal
         self.seq_axis = seq_axis
+        self.seq_mode = seq_mode
         # three separate column-parallel projections: a fused qkv matrix
         # would shard its columns across the [q|k|v] boundary
         self.q_proj = tp_mod.ColumnParallelLinear(d_model,
@@ -86,7 +87,8 @@ class MultiHeadAttention(layer.Layer):
             return autograd.transpose(t, (0, 2, 1, 3))  # (B, H, S, D)
 
         out = attention(split_heads(q), split_heads(k), split_heads(v),
-                        causal=self.causal, seq_axis=self.seq_axis)
+                        causal=self.causal, seq_axis=self.seq_axis,
+                        seq_mode=self.seq_mode)
         out = autograd.transpose(out, (0, 2, 1, 3))
         out = autograd.reshape(out, (B, S, d_local))
         return self.proj(out)
@@ -95,7 +97,7 @@ class MultiHeadAttention(layer.Layer):
 class TransformerBlock(layer.Layer):
     def __init__(self, d_model, n_heads, d_ff=None, causal=True, tp=True,
                  seq_axis=None, moe=None, moe_top_k=None,
-                 moe_capacity_factor=1.25):
+                 moe_capacity_factor=1.25, seq_mode="ring"):
         """``moe``: number of experts; replaces the dense FFN with a
         :class:`~singa_tpu.parallel.moe.MoEFFN` sharded over the mesh
         'expert' axis (``self.mlp.aux_loss`` is valid only inside the
@@ -105,7 +107,7 @@ class TransformerBlock(layer.Layer):
         d_ff = d_ff or 4 * d_model
         self.ln1 = layer.LayerNorm()
         self.attn = MultiHeadAttention(d_model, n_heads, causal, tp,
-                                       seq_axis)
+                                       seq_axis, seq_mode=seq_mode)
         self.ln2 = layer.LayerNorm()
         if moe:
             from ..parallel.moe import MoEFFN
@@ -130,7 +132,8 @@ class TransformerLM(model.Model):
     def __init__(self, vocab_size, d_model=128, n_heads=4, n_layers=2,
                  max_len=1024, causal=True, tp=True, seq_axis=None,
                  remat=False, moe=None, moe_aux_weight=0.01,
-                 moe_top_k=None, moe_capacity_factor=1.25):
+                 moe_top_k=None, moe_capacity_factor=1.25,
+                 seq_mode="ring"):
         """``moe``: experts per block (MoE FFN over the 'expert' mesh
         axis); the blocks' load-balance aux losses join the training loss
         scaled by ``moe_aux_weight``. ``moe_top_k`` defaults to
@@ -150,7 +153,7 @@ class TransformerLM(model.Model):
         self.blocks = [TransformerBlock(
             d_model, n_heads, causal=causal, tp=tp, seq_axis=seq_axis,
             moe=moe, moe_top_k=moe_top_k,
-            moe_capacity_factor=moe_capacity_factor)
+            moe_capacity_factor=moe_capacity_factor, seq_mode=seq_mode)
             for i in range(n_layers)]
         self.ln_f = layer.LayerNorm()
         self.head = layer.Linear(vocab_size)

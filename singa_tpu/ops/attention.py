@@ -642,6 +642,46 @@ class _FlashAttention(Operator):
         return flash_attention(q, k, v, self.causal, self.scale)
 
 
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      block_k=512):
+    """All-to-all sequence parallelism (Ulysses-style) inside
+    ``shard_map``: each device holds the (B, H, S/n, D) shard of its
+    sequence slice; ONE all_to_all re-shards HEADS over the axis while
+    gathering the FULL sequence locally ((B, H/n, S, D)), the fused
+    flash kernel then runs unchanged on the full sequence — plain causal
+    masking, no position offsets — and a second all_to_all restores
+    sequence sharding.
+
+    Two collectives per attention call versus ring attention's n
+    ppermute hops: the better trade when the axis is large and heads are
+    plentiful; ring wins when H < n or the gathered (S, S)-block
+    workspace per head would not fit. Requires H % n == 0 — the
+    :func:`attention` dispatcher falls back to ring otherwise.
+    """
+    def a2a(x, split, concat):
+        return lax.all_to_all(x, axis_name, split_axis=split,
+                              concat_axis=concat, tiled=True)
+
+    qh, kh, vh = (a2a(t, 1, 2) for t in (q, k, v))
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                          block_k=block_k)
+    return a2a(out, 2, 1)
+
+
+class _UlyssesAttention(Operator):
+    """Tape op wrapping :func:`ulysses_attention` (inside shard_map)."""
+
+    def __init__(self, axis_name, causal=False, scale=None):
+        super().__init__()
+        self.axis_name = axis_name
+        self.causal = causal
+        self.scale = scale
+
+    def forward(self, q, k, v):
+        return ulysses_attention(q, k, v, self.axis_name, self.causal,
+                                 self.scale)
+
+
 class _RingAttention(Operator):
     """Tape op wrapping :func:`ring_attention` (inside shard_map)."""
 
@@ -656,10 +696,31 @@ class _RingAttention(Operator):
                               self.scale)
 
 
-def attention(q, k, v, causal=False, scale=None, seq_axis=None):
-    """Functional tape API; picks ring attention when ``seq_axis`` is an
-    active sequence-parallel mesh axis."""
+def attention(q, k, v, causal=False, scale=None, seq_axis=None,
+              seq_mode="ring"):
+    """Functional tape API. With ``seq_axis`` an active
+    sequence-parallel mesh axis, ``seq_mode`` picks the long-context
+    strategy: ``'ring'`` (k/v rotate over ICI, O(S/n) workspace) or
+    ``'ulysses'`` (one all_to_all head re-shard, full local sequence).
+    Ulysses needs the local head count divisible by the axis size and
+    falls back to ring otherwise (one-time warning)."""
     from ..parallel.communicator import active_axis
+    if seq_mode not in ("ring", "ulysses", "alltoall", "all_to_all"):
+        raise ValueError(f"unknown seq_mode {seq_mode!r} "
+                         "(expected 'ring' or 'ulysses')")
     if seq_axis is not None and active_axis(seq_axis):
+        if seq_mode in ("ulysses", "alltoall", "all_to_all"):
+            n = lax.axis_size(seq_axis)
+            H = q.shape[1]
+            if H % n == 0:
+                return _UlyssesAttention(seq_axis, causal, scale)(q, k, v)
+            sig = ("ulysses-fallback", H, n)
+            if sig not in _DECLINE_LOGGED:
+                _DECLINE_LOGGED.add(sig)
+                import warnings
+                warnings.warn(
+                    f"ulysses attention needs heads ({H}) divisible by "
+                    f"the '{seq_axis}' axis size ({n}); falling back to "
+                    "ring attention", stacklevel=2)
         return _RingAttention(seq_axis, causal, scale)(q, k, v)
     return _FlashAttention(causal, scale)(q, k, v)

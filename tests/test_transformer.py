@@ -24,7 +24,7 @@ def lm_data(B=8, S=16, seed=0):
 
 
 def train(mesh_config=None, tp=False, seq_axis=None, reduce_axes=None,
-          steps=8, seed=5, use_graph=True, dist=True):
+          steps=8, seed=5, use_graph=True, dist=True, seq_mode="ring"):
     dev = device.create_cpu_device()
     dev.SetRandSeed(seed)
     ids, targets = lm_data()
@@ -33,7 +33,7 @@ def train(mesh_config=None, tp=False, seq_axis=None, reduce_axes=None,
 
     m = transformer.TransformerLM(VOCAB, d_model=32, n_heads=2,
                                   n_layers=2, max_len=64, tp=tp,
-                                  seq_axis=seq_axis)
+                                  seq_axis=seq_axis, seq_mode=seq_mode)
     if dist:
         d = opt.DistOpt(opt.SGD(lr=0.3, momentum=0.9),
                         reduce_axes=reduce_axes)
@@ -70,6 +70,15 @@ class TestTransformerLM:
         sp = train(mesh_mod.MeshConfig(seq=2), seq_axis="seq",
                    reduce_axes=("data", "seq"))
         np.testing.assert_allclose(sp, dp, rtol=5e-3)
+
+    def test_sp_ulysses_matches_dp(self):
+        """All-to-all sequence parallelism through the full model: one
+        head re-shard per attention instead of ring hops; must match the
+        dense run like ring does."""
+        dp = train(mesh_mod.MeshConfig())
+        ul = train(mesh_mod.MeshConfig(seq=2), seq_axis="seq",
+                   reduce_axes=("data", "seq"), seq_mode="ulysses")
+        np.testing.assert_allclose(ul, dp, rtol=5e-3)
 
     def test_tp_plus_sp(self):
         dp = train(mesh_mod.MeshConfig())
