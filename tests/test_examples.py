@@ -110,3 +110,9 @@ class TestExamples:
                            "--arch", "mlp", "--cpu", p,
                            "--finetune", "2"])
         assert "output" in out and "finetune step 1" in out, out[-800:]
+
+    def test_benchmark(self):
+        out = run_example(["examples/benchmark.py", "--cpu", "--bs", "4",
+                           "--iters", "2", "--warmup", "1", "--depth",
+                           "18", "--size", "64"])
+        assert "Throughput" in out, out[-500:]
