@@ -627,6 +627,14 @@ class Model(Layer):
             print(text)
         return text
 
+    def _invalidate_compiled(self):
+        """Drop every compiled step/eval specialization: the state
+        tensors' identities changed (load_states / checkpoint restore)
+        and the traced closures are bound to the old ones."""
+        self._steps = {}
+        self._eval_steps = {}
+        self._state_list = None
+
     def _place_mesh(self, a, sharding):
         """Lay an array out on the mesh. On a multi-process mesh the
         sharding spans devices of other hosts, which device_put cannot
@@ -874,9 +882,6 @@ class Model(Layer):
                           if k.startswith("optimizer/")}
             if opt_states:
                 opt.set_states(opt_states)
-        # invalidate any compiled step: state identity may have changed
-        self._steps = {}
-        self._eval_steps = {}
-        self._state_list = None
+        self._invalidate_compiled()
         return {k[len("aux/"):]: Tensor(data=v, requires_grad=False)
                 for k, v in arrays.items() if k.startswith("aux/")}

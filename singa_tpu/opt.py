@@ -197,6 +197,30 @@ class Optimizer:
         """All mutable optimizer state, for jit state-threading."""
         return [self.step_counter] + list(self._aux.values())
 
+    def state_tensor_dict(self):
+        """name -> LIVE state Tensor — no gather, no host copy; the
+        sharded-checkpointing counterpart of get_states (which pulls
+        everything to host for the zip route)."""
+        d = {"step_counter": self.step_counter}
+        d.update(self._aux)
+        return d
+
+    def restore_state_tensor(self, name, array, spec=None):
+        """Set one live state entry from a restored (possibly sharded)
+        array, creating lazily-built aux that does not exist yet (the
+        fresh-process resume path). ``spec`` announces the mesh layout
+        for a freshly created entry (momentum shards like its param)."""
+        if name == "step_counter":
+            self.step_counter.data = jnp.asarray(array)
+            return
+        t = self._aux.get(name)
+        if t is None:
+            t = Tensor(data=array, requires_grad=False)
+            t.spec = spec
+            self._aux[name] = t
+        else:
+            t.data = array
+
     def get_states(self):
         from .tensor import to_host_tree
         states = {"step_counter": np.asarray(self.step_counter.data)}
@@ -363,6 +387,25 @@ class DistOpt:
 
     def state_tensors(self):
         return self.opt.state_tensors() + list(self._residuals.values())
+
+    def state_tensor_dict(self):
+        d = self.opt.state_tensor_dict()
+        d.update({f"residual/{k}": v
+                  for k, v in self._residuals.items()})
+        return d
+
+    def restore_state_tensor(self, name, array, spec=None):
+        if name.startswith("residual/"):
+            nm = name[len("residual/"):]
+            t = self._residuals.get(nm)
+            if t is None:
+                t = Tensor(data=array, requires_grad=False)
+                t.spec = spec
+                self._residuals[nm] = t
+            else:
+                t.data = array
+        else:
+            self.opt.restore_state_tensor(name, array, spec)
 
     def get_states(self):
         from .tensor import to_host_tree
