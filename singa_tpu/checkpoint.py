@@ -18,13 +18,16 @@ steps continue while bytes land on disk.
 Restore is driven by the CHECKPOINT's metadata (not the live state), so
 a freshly constructed process — whose lazily-created optimizer aux does
 not exist yet — restores momentum/moments too and replays the exact
-trajectory. Arrays whose live counterpart exists restore onto that
-array's current sharding.
+trajectory. Every entry restores onto the CURRENT topology: live
+counterparts keep their sharding, fresh optimizer aux adopts its owning
+param's live sharding (never the layout persisted by a possibly
+different mesh).
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 
 import numpy as np
 import jax
@@ -41,6 +44,67 @@ def _state_tensor_dict(model):
         for k, t in opt.state_tensor_dict().items():
             out[f"optimizer/{k}"] = t
     return out
+
+
+def _aux_param_base(name):
+    """'<param>:<kind>' (optionally 'residual/<param>') -> param name."""
+    return name.split("/", 1)[-1].rsplit(":", 1)[0]
+
+
+def _build_restore_template(live, meta_tree):
+    """ShapeDtypeStruct tree for StandardRestore, keyed by the
+    CHECKPOINT's metadata. Sharding targets come from the CURRENT
+    process: a live counterpart's sharding when shapes agree, else —
+    for fresh optimizer aux — the owning param's live sharding (the
+    layout persisted in the checkpoint may belong to a different
+    topology, which orbax itself flags as unsafe to reuse)."""
+    template = {}
+    for k, m in meta_tree.items():
+        shape = tuple(m.shape)
+        sharding = None
+        lt = live.get(k)
+        if lt is not None and tuple(np.shape(lt.data)) == shape:
+            sharding = getattr(lt.data, "sharding", None)
+        elif lt is None and k.startswith("optimizer/"):
+            base = live.get(
+                "model/" + _aux_param_base(k[len("optimizer/"):]))
+            if base is not None and \
+                    tuple(np.shape(base.data)) == shape:
+                sharding = getattr(base.data, "sharding", None)
+        template[k] = jax.ShapeDtypeStruct(shape, m.dtype,
+                                           sharding=sharding)
+    return template
+
+
+def _apply_restored(model, live, restored):
+    """Land restored arrays in the live tensors; create lazily-built
+    optimizer aux that a fresh process has not materialised yet
+    (announcing the owning param's spec so it keeps sharding like its
+    param); skip — loudly — anything without a live home or with a
+    mismatched shape (e.g. resuming into a re-architected model)."""
+    opt = getattr(model, "optimizer", None)
+    for k, arr in restored.items():
+        lt = live.get(k)
+        if lt is not None:
+            if tuple(np.shape(lt.data)) != tuple(np.shape(arr)):
+                warnings.warn(
+                    f"checkpoint entry {k!r} has shape "
+                    f"{tuple(np.shape(arr))} but the live tensor is "
+                    f"{tuple(np.shape(lt.data))}; skipped (did the "
+                    "architecture change since the save?)", stacklevel=3)
+                continue
+            lt.data = arr
+        elif k.startswith("optimizer/") and opt is not None \
+                and hasattr(opt, "restore_state_tensor"):
+            nm = k[len("optimizer/"):]
+            pt = live.get("model/" + _aux_param_base(nm))
+            opt.restore_state_tensor(nm, arr, getattr(pt, "spec", None))
+        else:
+            warnings.warn(f"checkpoint entry {k!r} has no live "
+                          "counterpart in this model; skipped",
+                          stacklevel=3)
+    # compiled steps close over state identity; force a rebind
+    model._invalidate_compiled()
 
 
 class AsyncModelCheckpointer:
@@ -66,50 +130,74 @@ class AsyncModelCheckpointer:
         self._ckptr.wait_until_finished()
 
     def restore(self, path, model):
-        """Load shards back into the model's live tensors.
-
-        The restore template comes from the checkpoint's OWN metadata:
-        every saved entry is restored (lazily-created optimizer aux that
-        a fresh process has not materialised yet included), and entries
-        with a live counterpart restore onto that array's current
-        sharding — so a mesh-sharded model resumes without a gather or
-        re-shard step."""
+        """Load shards back into the model's live tensors (see the
+        module docstring for the template/topology rules)."""
         path = os.path.abspath(str(path))
         live = _state_tensor_dict(model)
-        meta = self._ckptr.metadata(path).item_metadata.tree
-        template = {}
-        for k, m in meta.items():
-            shape = tuple(m.shape)
-            sharding = None
-            lt = live.get(k)
-            if lt is not None and tuple(np.shape(lt.data)) == shape:
-                sharding = getattr(lt.data, "sharding", None)
-            template[k] = jax.ShapeDtypeStruct(shape, m.dtype,
-                                               sharding=sharding)
+        meta = dict(self._ckptr.metadata(path).item_metadata.tree)
         restored = self._ckptr.restore(
-            path, args=self._ocp.args.StandardRestore(template))
-        opt = getattr(model, "optimizer", None)
-        for k, arr in restored.items():
-            lt = live.get(k)
-            if lt is not None:
-                lt.data = arr
-            elif k.startswith("optimizer/") and opt is not None \
-                    and hasattr(opt, "restore_state_tensor"):
-                # aux the fresh process has not lazily created yet;
-                # momentum/moments shard like their param, so hand the
-                # param's spec along (aux keys are '<param>:<kind>')
-                nm = k[len("optimizer/"):]
-                base = nm.split("/", 1)[-1].rsplit(":", 1)[0]
-                pt = model.get_states().get(base)
-                opt.restore_state_tensor(
-                    nm, arr, getattr(pt, "spec", None))
-            else:
-                import warnings
-                warnings.warn(f"checkpoint entry {k!r} has no live "
-                              "counterpart in this model; skipped",
-                              stacklevel=2)
-        # compiled steps close over state identity; force a rebind
-        model._invalidate_compiled()
+            path, args=self._ocp.args.StandardRestore(
+                _build_restore_template(live, meta)))
+        _apply_restored(model, live, restored)
 
     def close(self):
         self._ckptr.close()
+
+
+class CheckpointManager:
+    """Rotated, step-numbered checkpoints over the async sharded route
+    (orbax ``CheckpointManager``): save every ``save_interval_steps``,
+    keep the newest ``max_to_keep``, resume from the latest — the
+    checkpoint-restart loop the reference lacks entirely (its NCCL/MPI
+    failures just exit, include/singa/io/communicator.h:40-67).
+
+        mgr = CheckpointManager(dir, max_to_keep=3, save_interval_steps=50)
+        start = mgr.restore_latest(model)        # 0 on a fresh run
+        for step in range(start, total):
+            model(tx, ty)
+            mgr.save(step, model)                # no-op off-interval
+        mgr.wait(); mgr.close()
+    """
+
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(str(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True),
+            # a FRESH manager (resume path) must know the handler type
+            # before any save, or item metadata cannot be read
+            item_handlers=ocp.StandardCheckpointHandler())
+
+    def save(self, step, model, force=False):
+        arrays = {k: t.data for k, t in _state_tensor_dict(model).items()}
+        return self._mgr.save(int(step),
+                              args=self._ocp.args.StandardSave(arrays),
+                              force=force)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def restore_latest(self, model):
+        """Restore the newest checkpoint into ``model`` and return the
+        NEXT step to run (0 when no checkpoint exists)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return 0
+        live = _state_tensor_dict(model)
+        meta = self._mgr.item_metadata(step)
+        tree = dict(getattr(meta, "tree", None) or meta)
+        restored = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(
+                _build_restore_template(live, tree)))
+        _apply_restored(model, live, restored)
+        return step + 1
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

@@ -185,3 +185,66 @@ class TestAsyncCheckpoint:
             assert os.path.isdir(final)
         finally:
             ck.close()
+
+
+class TestCheckpointManager:
+    def test_rotation_and_resume(self, tmp_path):
+        from singa_tpu.checkpoint import CheckpointManager
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(7)
+        x, y = make_xy()
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=True)
+        mgr = CheckpointManager(tmp_path / "run", max_to_keep=2,
+                                save_interval_steps=1)
+        try:
+            assert mgr.restore_latest(m) == 0     # fresh run
+            for s in range(5):
+                m(tx, ty)
+                mgr.save(s, m)
+            mgr.wait()
+            assert mgr.latest_step() == 4
+            expected = [float(m(tx, ty)[1].data) for _ in range(3)]
+        finally:
+            mgr.close()
+
+        # fresh process: new model, new manager, resume from latest
+        dev.SetRandSeed(99)
+        m2 = MLP()
+        m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m2.compile([tx], is_train=True, use_graph=True)
+        from singa_tpu.checkpoint import CheckpointManager as CM
+        mgr2 = CM(tmp_path / "run")
+        try:
+            assert mgr2.restore_latest(m2) == 5
+            replay = [float(m2(tx, ty)[1].data) for _ in range(3)]
+            np.testing.assert_allclose(replay, expected, rtol=1e-5)
+        finally:
+            mgr2.close()
+
+    def test_max_to_keep_rotates(self, tmp_path):
+        import os
+        from singa_tpu.checkpoint import CheckpointManager
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(1)
+        x, y = make_xy()
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([tx], is_train=True, use_graph=True)
+        mgr = CheckpointManager(tmp_path / "rot", max_to_keep=2,
+                                save_interval_steps=1)
+        try:
+            for s in range(5):
+                m(tx, ty)
+                mgr.save(s, m)
+            mgr.wait()
+            kept = sorted(int(d) for d in os.listdir(tmp_path / "rot")
+                          if d.isdigit())
+            assert kept == [3, 4], kept
+        finally:
+            mgr.close()

@@ -116,3 +116,26 @@ class TestExamples:
                            "--iters", "2", "--warmup", "1", "--depth",
                            "18", "--size", "64"])
         assert "Throughput" in out, out[-500:]
+
+    def test_train_elastic_resumes(self, tmp_path):
+        """Crash-and-restart: second run resumes at crash+1 and
+        completes."""
+        d = str(tmp_path / "ck")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+        args = [sys.executable, "examples/train_elastic.py", "--cpu",
+                "--dir", d, "--steps", "12", "--save-every", "2",
+                "--bs", "8"]
+        p1 = subprocess.run(args + ["--crash-at", "5"], cwd=ROOT,
+                            env=env, capture_output=True, text=True,
+                            timeout=420)
+        assert p1.returncode == 42, p1.stdout + p1.stderr
+        assert "simulated crash at step 5" in p1.stdout
+        p2 = subprocess.run(args, cwd=ROOT, env=env,
+                            capture_output=True, text=True, timeout=420)
+        assert p2.returncode == 0, p2.stdout + p2.stderr
+        # crash happened at step 5 with saves on even steps: the last
+        # committed checkpoint is step 4, so the rerun repeats step 5
+        assert "continuing at step 5" in p2.stdout, p2.stdout
+        assert "training complete" in p2.stdout
