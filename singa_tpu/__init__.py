@@ -30,8 +30,9 @@ from . import utils         # noqa: F401
 from .tensor import Tensor  # noqa: F401
 from .model import Model    # noqa: F401
 
-_LAZY = ("sonnx", "io", "data", "image_tool", "net", "snapshot", "native",
-         "channel", "caffe", "network")
+_LAZY = ("sonnx", "io", "data", "datasets", "image_tool", "net",
+         "snapshot", "native", "channel", "caffe", "network",
+         "checkpoint", "profiling")
 
 
 def __getattr__(name):

@@ -778,6 +778,33 @@ class Dropout(Layer):
         return autograd.dropout(x, self.ratio)
 
 
+class FusedCEHead(Layer):
+    """LM classifier head fused with softmax-cross-entropy: the
+    (tokens, vocab) logits matrix — usually the biggest single HBM
+    allocation of large-vocab LM training — is never materialised;
+    loss AND grads are computed in vocab chunks with an online
+    logsumexp (ops/losses.fused_ce_head). Call as
+    ``loss = head(hidden, target_ids)``."""
+
+    def __init__(self, vocab_size, chunk=8192):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.chunk = chunk
+
+    def initialize(self, h, ids):
+        self.W = _param((h.shape[-1], self.vocab_size), h.device)
+        self.W.gaussian(0.0, 0.02)
+        self.b = _param((self.vocab_size,), h.device)
+
+    def forward(self, h, ids):
+        from .ops.losses import fused_softmax_cross_entropy
+        return fused_softmax_cross_entropy(h, self.W, self.b, ids,
+                                           self.chunk)
+
+    def _own_params(self):
+        return {"W": self.W, "b": self.b}
+
+
 class Cat(Layer):
     def __init__(self, axis=0):
         super().__init__()

@@ -1,0 +1,141 @@
+"""Memory-lean loss kernels.
+
+:func:`fused_ce_head` — the LM head matmul and softmax-cross-entropy
+fused into one chunked computation: the (tokens, vocab) logits matrix —
+the dominant HBM cost of large-vocab LM training (B·S·V floats, often
+bigger than the whole model) — is NEVER materialised. The forward scans
+vocab chunks with an online logsumexp; the backward (custom_vjp)
+rescans, rebuilding each chunk's probabilities from the saved (O(tokens))
+logsumexp, exactly the flash-attention residual trick applied to the
+classifier head. No reference counterpart (the reference computes full
+logits then CrossEntropyFwd, src/model/operation/../autograd).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd_base import Operator
+
+_NEG = -1e30
+
+
+def _chunks(W, b, chunk):
+    """(D, V), (V,) -> per-chunk xs (n, D, c) / (n, c), -inf-padded bias
+    so padded columns never contribute to the logsumexp."""
+    D, V = W.shape
+    n = (V + chunk - 1) // chunk
+    pad = n * chunk - V
+    if pad:
+        W = jnp.pad(W, ((0, 0), (0, pad)))
+        b = jnp.pad(b, (0, pad), constant_values=_NEG)
+    return (W.reshape(D, n, chunk).transpose(1, 0, 2),
+            b.reshape(n, chunk), n, pad)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_ce_head(h, W, b, ids, chunk=8192):
+    """Mean cross-entropy of ``softmax(h @ W + b)`` against ``ids``.
+
+    h: (N, D) flattened tokens; W: (D, V); b: (V,); ids: (N,) integer
+    (or float-encoded) target ids. Peak memory is O(N·chunk), not O(N·V).
+    """
+    return _fwd(h, W, b, ids, chunk)[0]
+
+
+def _zero_ct(x):
+    """Cotangent of a non-differentiable input: float zeros for float
+    encodings of ids, float0 for true integer ids."""
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        return jnp.zeros_like(x)
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
+def _fwd(h, W, b, ids, chunk):
+    hf = h.astype(jnp.float32)
+    idi = ids.astype(jnp.int32)
+    Wc, bc, n, _pad = _chunks(W.astype(jnp.float32),
+                              b.astype(jnp.float32), chunk)
+    N = hf.shape[0]
+
+    def step(carry, inputs):
+        m, l, tgt = carry
+        ci, Wk, bk = inputs
+        logits = hf @ Wk + bk                        # (N, chunk)
+        m_new = jnp.maximum(m, jnp.max(logits, -1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), -1)
+        loc = idi - ci * chunk
+        hit = (loc >= 0) & (loc < chunk)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(loc, 0, chunk - 1)[:, None], 1)[:, 0]
+        tgt = tgt + jnp.where(hit, got, 0.0)
+        return (m_new, l, tgt), None
+
+    zero = jnp.zeros((N,), jnp.float32) + 0.0 * jnp.sum(hf, -1)
+    init = (zero + _NEG, zero, zero)
+    (m, l, tgt), _ = lax.scan(step, init,
+                              (jnp.arange(n), Wc, bc))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    loss = jnp.mean(lse - tgt)
+    return loss, (h, W, b, ids, lse)
+
+
+def _bwd(chunk, res, g):
+    h, W, b, ids, lse = res
+    idi = ids.astype(jnp.int32)
+    hf = h.astype(jnp.float32)
+    Wc, bc, n, pad = _chunks(W.astype(jnp.float32),
+                             b.astype(jnp.float32), chunk)
+    N = hf.shape[0]
+    gN = (g / N).astype(jnp.float32)
+
+    def step(dh, inputs):
+        ci, Wk, bk = inputs
+        logits = hf @ Wk + bk
+        p = jnp.exp(logits - lse[:, None])          # chunk of softmax
+        loc = idi - ci * chunk
+        hit = (loc >= 0) & (loc < chunk)
+        onehot = jax.nn.one_hot(jnp.clip(loc, 0, chunk - 1), chunk,
+                                dtype=jnp.float32) * hit[:, None]
+        dlog = (p - onehot) * gN
+        dh = dh + dlog @ Wk.T
+        dWk = hf.T @ dlog
+        dbk = jnp.sum(dlog, 0)
+        return dh, (dWk, dbk)
+
+    dh, (dWks, dbks) = lax.scan(step, hf * 0.0,
+                                (jnp.arange(n), Wc, bc))
+    V = W.shape[1]
+    dW = dWks.transpose(1, 0, 2).reshape(W.shape[0],
+                                         n * chunk)[:, :V]
+    db = dbks.reshape(n * chunk)[:V]
+    return (dh.astype(h.dtype), dW.astype(W.dtype), db.astype(b.dtype),
+            _zero_ct(ids))
+
+
+fused_ce_head.defvjp(_fwd, _bwd)
+
+
+class _FusedCEHead(Operator):
+    """Tape op: (hidden, W, b, ids) -> scalar mean CE, never
+    materialising the logits."""
+
+    def __init__(self, chunk=8192):
+        super().__init__()
+        self.chunk = chunk
+
+    def forward(self, h, W, b, ids):
+        flat = h.reshape(-1, h.shape[-1])
+        return fused_ce_head(flat, W, b, ids.reshape(-1), self.chunk)
+
+
+def fused_softmax_cross_entropy(hidden, W, b, ids, chunk=8192):
+    """Functional tape API over :class:`_FusedCEHead`; ``hidden`` may be
+    (B, S, D) with (B, S) ids."""
+    return _FusedCEHead(chunk)(hidden, W, b, ids)

@@ -12,14 +12,14 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_example(args, timeout=420):
+def run_example(args, timeout=420, expect_returncode=0):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # stop the environment's sitecustomize from pinning a TPU backend
     env["PYTHONPATH"] = ""
     proc = subprocess.run([sys.executable] + args, cwd=ROOT, env=env,
                           capture_output=True, text=True, timeout=timeout)
-    assert proc.returncode == 0, \
+    assert proc.returncode == expect_returncode, \
         f"{args}:\nstdout:{proc.stdout[-2000:]}\nstderr:{proc.stderr[-2000:]}"
     return proc.stdout
 
@@ -118,24 +118,16 @@ class TestExamples:
         assert "Throughput" in out, out[-500:]
 
     def test_train_elastic_resumes(self, tmp_path):
-        """Crash-and-restart: second run resumes at crash+1 and
-        completes."""
+        """Crash-and-restart: second run resumes from the newest
+        committed checkpoint and completes."""
         d = str(tmp_path / "ck")
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = ""
-        args = [sys.executable, "examples/train_elastic.py", "--cpu",
-                "--dir", d, "--steps", "12", "--save-every", "2",
-                "--bs", "8"]
-        p1 = subprocess.run(args + ["--crash-at", "5"], cwd=ROOT,
-                            env=env, capture_output=True, text=True,
-                            timeout=420)
-        assert p1.returncode == 42, p1.stdout + p1.stderr
-        assert "simulated crash at step 5" in p1.stdout
-        p2 = subprocess.run(args, cwd=ROOT, env=env,
-                            capture_output=True, text=True, timeout=420)
-        assert p2.returncode == 0, p2.stdout + p2.stderr
+        args = ["examples/train_elastic.py", "--cpu", "--dir", d,
+                "--steps", "12", "--save-every", "2", "--bs", "8"]
+        out1 = run_example(args + ["--crash-at", "5"],
+                           expect_returncode=42)
+        assert "simulated crash at step 5" in out1
+        out2 = run_example(args)
         # crash happened at step 5 with saves on even steps: the last
         # committed checkpoint is step 4, so the rerun repeats step 5
-        assert "continuing at step 5" in p2.stdout, p2.stdout
-        assert "training complete" in p2.stdout
+        assert "continuing at step 5" in out2, out2
+        assert "training complete" in out2
