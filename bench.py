@@ -121,6 +121,11 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50):
     if platform != "cpu" and os.environ.get("BENCH_LM", "1") != "0":
         try:
             res["lm_tokens_per_sec"] = _measure_lm(dev)
+            # what the LM leg measured: fused-CE-head or full-logits
+            # path — without this marker, banked numbers from different
+            # modes would read as perf changes between rounds
+            res["lm_fused_head"] = \
+                os.environ.get("BENCH_LM_FUSED", "1") != "0"
         except Exception as e:
             res["lm_error"] = str(e)[:200]
     return res
@@ -131,9 +136,15 @@ def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3):
     from singa_tpu.models import transformer
     import numpy as np
 
+    # fused CE head: the (B,S,32000) logits never materialise in the
+    # train step (1 GiB fp32 at these shapes) — disable via
+    # BENCH_LM_FUSED=0 to measure the full-logits path
+    fused = os.environ.get("BENCH_LM_FUSED", "1") != "0"
     m = transformer.TransformerLM(32000, d_model=512, n_heads=8,
                                   n_layers=6, max_len=seq, tp=False,
-                                  remat=False)
+                                  remat=False,
+                                  fused_head_chunk=8192 if fused
+                                  else None)
     m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 32000, (batch, seq)).astype(np.float32)

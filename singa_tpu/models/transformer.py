@@ -133,7 +133,7 @@ class TransformerLM(model.Model):
                  max_len=1024, causal=True, tp=True, seq_axis=None,
                  remat=False, moe=None, moe_aux_weight=0.01,
                  moe_top_k=None, moe_capacity_factor=1.25,
-                 seq_mode="ring"):
+                 seq_mode="ring", fused_head_chunk=None):
         """``moe``: experts per block (MoE FFN over the 'expert' mesh
         axis); the blocks' load-balance aux losses join the training loss
         scaled by ``moe_aux_weight``. ``moe_top_k`` defaults to
@@ -147,6 +147,7 @@ class TransformerLM(model.Model):
         self.remat = remat
         self.moe = moe
         self.moe_aux_weight = moe_aux_weight
+        self.fused_head_chunk = fused_head_chunk
         self.tok_emb = layer.Embedding(vocab_size, d_model)
         self.pos_emb = layer.Embedding(max_len, d_model)
         self._pos = _Positions(seq_axis)
@@ -159,27 +160,56 @@ class TransformerLM(model.Model):
         self.head = layer.Linear(vocab_size)
         self.loss_fn = layer.SoftMaxCrossEntropy()
 
-    def forward(self, ids):
+    def _hidden(self, ids):
         pos = self._pos(ids)
         x = autograd.add(self.tok_emb(ids), self.pos_emb(pos))
         for blk in self.blocks:
             x = autograd.checkpoint(blk, x) if self.remat else blk(x)
-        return self.head(self.ln_f(x))          # (B, S, vocab)
+        return self.ln_f(x)
+
+    def forward(self, ids):
+        return self.head(self._hidden(ids))     # (B, S, vocab)
 
     def train_one_batch(self, ids, targets):
-        logits = self.forward(ids)
-        B, S, V = logits.shape
-        flat = autograd.reshape(logits, (B * S, V))
-        onehot = autograd.onehot(-1, targets, self.vocab_size)
-        oh_flat = autograd.reshape(onehot, (B * S, V))
-        loss = autograd.softmax_cross_entropy(flat, oh_flat)
+        if self.fused_head_chunk:
+            # large-vocab mode: loss straight from the hidden states via
+            # the chunked fused CE head — the (B,S,V) logits are never
+            # materialised in the TRAINING step (forward/eval still
+            # produces them through the same shared head params).
+            from ..ops.losses import fused_softmax_cross_entropy
+            h = self._hidden(ids)
+            if not self._initialized_head():
+                # compile()'s dry forward normally initializes the head;
+                # direct train_one_batch calls get it here
+                self.head(h)
+            loss = fused_softmax_cross_entropy(
+                h, self.head.W, self.head.b, targets,
+                self.fused_head_chunk)
+            out = None
+        else:
+            logits = self.forward(ids)
+            B, S, V = logits.shape
+            flat = autograd.reshape(logits, (B * S, V))
+            onehot = autograd.onehot(-1, targets, self.vocab_size)
+            oh_flat = autograd.reshape(onehot, (B * S, V))
+            loss = autograd.softmax_cross_entropy(flat, oh_flat)
+            out = logits
         if self.moe:
             w = Tensor(data=np.asarray(self.moe_aux_weight, np.float32),
                        device=ids.device, requires_grad=False)
             for blk in self.blocks:
                 loss = autograd.add(loss, autograd.mul(blk.mlp.aux_loss, w))
         self.optimizer(loss)
-        return logits, loss
+        # fused mode has no logits to return: the TOTAL loss (incl. moe
+        # aux) fills the predictions slot so both outputs agree with
+        # what the optimizer stepped on
+        if out is None:
+            out = loss
+        return out, loss
+
+    def _initialized_head(self):
+        return getattr(self.head, "_initialized", False) and \
+            hasattr(self.head, "W")
 
 
 def create_model(vocab_size=256, **kwargs):
