@@ -70,24 +70,31 @@ class Layer:
     def forward(self, *input):  # noqa: A002
         raise NotImplementedError
 
+    def ensure_initialized(self, *args, **kwargs):
+        """Run the deferred, shape-inferring init (if still pending)
+        WITHOUT executing forward (reference LayerMeta: graph is
+        disabled during init so param creation is not taped). Under
+        an abstract dry run (Model._abstract_call's eval_shape) the
+        compile-time-eval scope makes param creation execute
+        CONCRETELY — inits read only static shapes and concrete rng
+        keys, so real weights materialise while the surrounding
+        forward stays traced. Callers that need params but not outputs
+        (e.g. the fused CE head consuming ``head.W`` directly) use this
+        to avoid materialising a full forward's activations."""
+        if self._initialized:
+            return
+        import jax as _jax
+        prev = CTX.training
+        CTX.training = False
+        try:
+            with _jax.ensure_compile_time_eval():
+                self.initialize(*args, **kwargs)
+        finally:
+            CTX.training = prev
+        self._initialized = True
+
     def __call__(self, *args, **kwargs):
-        if not self._initialized:
-            # deferred, shape-inferring init (reference LayerMeta: graph is
-            # disabled during init so param creation is not taped). Under
-            # an abstract dry run (Model._abstract_call's eval_shape) the
-            # compile-time-eval scope makes param creation execute
-            # CONCRETELY — inits read only static shapes and concrete rng
-            # keys, so real weights materialise while the surrounding
-            # forward stays traced.
-            import jax as _jax
-            prev = CTX.training
-            CTX.training = False
-            try:
-                with _jax.ensure_compile_time_eval():
-                    self.initialize(*args, **kwargs)
-            finally:
-                CTX.training = prev
-            self._initialized = True
+        self.ensure_initialized(*args, **kwargs)
         return self.forward(*args, **kwargs)
 
     @property

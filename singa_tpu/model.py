@@ -96,6 +96,32 @@ def _resolve_leaf_specs(leaves, full_batch, input_specs, axis, user_out):
     return [P(batch_ax) if m else P() for m in shard_mask]
 
 
+def _fit_state_spec(spec, shape, mesh):
+    """A parameter's announced PartitionSpec, with any dim that does not
+    divide its mesh axes replicated instead (e.g. a vocab of 31 over
+    'model'=2: the layer announces P('model', None) unconditionally
+    because it cannot know the mesh at init; sharding such a dim would
+    make shard_map reject the whole step, so the dim falls back to
+    replication and the layers' offset math detects the full-width
+    tensor)."""
+    if spec is None:
+        return P()
+    fitted = []
+    for dim, names in enumerate(spec):
+        if names is None:
+            fitted.append(None)
+            continue
+        tup = names if isinstance(names, tuple) else (names,)
+        size = 1
+        for n in tup:
+            size *= mesh.shape[n]
+        fitted.append(names if dim < len(shape) and
+                      shape[dim] % size == 0 else None)
+    while fitted and fitted[-1] is None:
+        fitted.pop()
+    return P(*fitted)
+
+
 def _shard_map_compat_kwargs():
     """shard_map's replication-check kwarg was renamed across jax
     versions; disable it under whichever name this jax uses."""
@@ -388,7 +414,7 @@ class Model(Layer):
                 full_batch = sample_inputs[0].shape[0]
                 # per-state sharding: tensor-parallel weights announce a
                 # PartitionSpec via Tensor.spec; everything else replicates
-                state_specs = [t.spec if t.spec is not None else P()
+                state_specs = [_fit_state_spec(t.spec, t.shape, mesh)
                                for t in state_list]
                 self._state_specs = state_specs
                 # per-input layouts: Model.input_specs overrides the default
@@ -695,7 +721,7 @@ class Model(Layer):
             leaves0, input_tensors[0].shape[0], rec["input_specs"], axis,
             getattr(self, "eval_output_specs", None))
         state_specs = getattr(self, "_state_specs", None) or \
-            [t.spec if t.spec is not None else P() for t in state_list]
+            [_fit_state_spec(t.spec, t.shape, mesh) for t in state_list]
         rec["state_specs"] = state_specs
 
         def fn(state_arrays, *input_arrays):
@@ -882,6 +908,9 @@ class Model(Layer):
                           if k.startswith("optimizer/")}
             if opt_states:
                 opt.set_states(opt_states)
+                if hasattr(opt, "announce_aux_specs"):
+                    # restored momentum/moments shard like their params
+                    opt.announce_aux_specs(my_states)
         self._invalidate_compiled()
         return {k[len("aux/"):]: Tensor(data=v, requires_grad=False)
                 for k, v in arrays.items() if k.startswith("aux/")}

@@ -8,7 +8,11 @@ three composable parallelism modes driven by the mesh:
 - data parallel: batch over 'data' (DistOpt psum, like every model here);
 - tensor parallel (``tp=True``): qkv and MLP-up as ColumnParallelLinear,
   out-proj and MLP-down as RowParallelLinear — heads shard over 'model',
-  two all-reduces per block (Megatron layout);
+  two all-reduces per block (Megatron layout); the vocab ends shard too:
+  token embedding rows (VocabParallelEmbedding) and LM-head columns
+  (ColumnParallelLinear), and with ``fused_head_chunk`` the chunked CE
+  loss reduces across vocab shards online so per-rank head memory is
+  V/tp without ever materialising logits;
 - sequence parallel (``seq_axis='seq'``): tokens shard over 'seq'; the
   attention switches to ring attention (k/v rotate over ICI) and the
   caller sets ``Model.input_specs = [P('data', 'seq'), ...]``.
@@ -148,7 +152,14 @@ class TransformerLM(model.Model):
         self.moe = moe
         self.moe_aux_weight = moe_aux_weight
         self.fused_head_chunk = fused_head_chunk
-        self.tok_emb = layer.Embedding(vocab_size, d_model)
+        # vocab-parallel ends: token embedding rows and head columns
+        # shard over 'model' (Megatron layout) — at real vocab sizes the
+        # head is the single largest tensor, so it must not replicate.
+        # Both degrade to plain layers outside a mesh with the SAME
+        # full-shape state dict, so there is one layout everywhere.
+        # pos_emb stays replicated: max_len·D is small and every rank
+        # reads every row.
+        self.tok_emb = tp_mod.VocabParallelEmbedding(vocab_size, d_model)
         self.pos_emb = layer.Embedding(max_len, d_model)
         self._pos = _Positions(seq_axis)
         self.blocks = [TransformerBlock(
@@ -157,7 +168,8 @@ class TransformerLM(model.Model):
             moe_capacity_factor=moe_capacity_factor, seq_mode=seq_mode)
             for i in range(n_layers)]
         self.ln_f = layer.LayerNorm()
-        self.head = layer.Linear(vocab_size)
+        self.head = tp_mod.ColumnParallelLinear(vocab_size,
+                                                gather_output=True)
         self.loss_fn = layer.SoftMaxCrossEntropy()
 
     def _hidden(self, ids):
@@ -178,13 +190,17 @@ class TransformerLM(model.Model):
             # produces them through the same shared head params).
             from ..ops.losses import fused_softmax_cross_entropy
             h = self._hidden(ids)
-            if not self._initialized_head():
-                # compile()'s dry forward normally initializes the head;
-                # direct train_one_batch calls get it here
-                self.head(h)
+            # params only, no forward: running head(h) here would
+            # materialise the full (B,S,V) logits the fused mode exists
+            # to avoid
+            self.head.ensure_initialized(h)
+            # a local-width W inside shard_map means the head's columns
+            # are genuinely sharded → turn on the cross-shard reduction
+            ax = self.head.axis_name \
+                if self.head.W.shape[-1] < self.vocab_size else None
             loss = fused_softmax_cross_entropy(
                 h, self.head.W, self.head.b, targets,
-                self.fused_head_chunk)
+                self.fused_head_chunk, axis_name=ax)
             out = None
         else:
             logits = self.forward(ids)
@@ -206,10 +222,6 @@ class TransformerLM(model.Model):
         if out is None:
             out = loss
         return out, loss
-
-    def _initialized_head(self):
-        return getattr(self.head, "_initialized", False) and \
-            hasattr(self.head, "W")
 
 
 def create_model(vocab_size=256, **kwargs):

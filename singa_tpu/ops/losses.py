@@ -9,6 +9,15 @@ rescans, rebuilding each chunk's probabilities from the saved (O(tokens))
 logsumexp, exactly the flash-attention residual trick applied to the
 classifier head. No reference counterpart (the reference computes full
 logits then CrossEntropyFwd, src/model/operation/../autograd).
+
+Vocab-parallel: pass ``axis_name`` when the head weight's columns are
+sharded over a mesh axis (``ColumnParallelLinear``-style). Each rank
+scans only its own V/tp vocab slice; the per-rank online logsumexp
+states are merged with one pmax+psum pair and the target logit with one
+psum, so no rank ever materialises — or even scans — another rank's
+vocab columns. The backward psums the (D-wide) hidden-state cotangent
+only; dW/db stay rank-local. Outside a mesh the collectives vanish and
+the same code is the single-device kernel.
 """
 
 from __future__ import annotations
@@ -38,14 +47,27 @@ def _chunks(W, b, chunk):
             b.reshape(n, chunk), n, pad)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def fused_ce_head(h, W, b, ids, chunk=8192):
+def _shard_ctx(axis_name, W):
+    """(live?, column offset of this rank's vocab slice). ``W`` is the
+    rank-local slice inside shard_map, so the offset is index * local-V."""
+    if not axis_name:
+        return False, 0
+    from ..parallel.communicator import active_axis
+    if not active_axis(axis_name):
+        return False, 0
+    return True, lax.axis_index(axis_name) * W.shape[1]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_ce_head(h, W, b, ids, chunk=8192, axis_name=None):
     """Mean cross-entropy of ``softmax(h @ W + b)`` against ``ids``.
 
     h: (N, D) flattened tokens; W: (D, V); b: (V,); ids: (N,) integer
     (or float-encoded) target ids. Peak memory is O(N·chunk), not O(N·V).
+    With ``axis_name`` and a live mesh axis, W/b hold this rank's vocab
+    slice and ids stay global — see the module docstring.
     """
-    return _fwd(h, W, b, ids, chunk)[0]
+    return _fwd(h, W, b, ids, chunk, axis_name)[0]
 
 
 def _zero_ct(x):
@@ -56,12 +78,19 @@ def _zero_ct(x):
     return np.zeros(np.shape(x), jax.dtypes.float0)
 
 
-def _fwd(h, W, b, ids, chunk):
+def _fwd(h, W, b, ids, chunk, axis_name=None):
+    sharded, offset = _shard_ctx(axis_name, W)
     hf = h.astype(jnp.float32)
-    idi = ids.astype(jnp.int32)
+    idi = ids.astype(jnp.int32) - offset        # local coords of targets
     Wc, bc, n, _pad = _chunks(W.astype(jnp.float32),
                               b.astype(jnp.float32), chunk)
     N = hf.shape[0]
+
+    # a target this rank does not own may still land inside the last
+    # chunk's -1e30-padded tail (local V < n*chunk): without the bound
+    # below it would accumulate the pad bias into tgt and blow up the
+    # loss by ~1e30 after the cross-rank psum
+    owned = (idi >= 0) & (idi < W.shape[1])
 
     def step(carry, inputs):
         m, l, tgt = carry
@@ -71,7 +100,7 @@ def _fwd(h, W, b, ids, chunk):
         l = l * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), -1)
         loc = idi - ci * chunk
-        hit = (loc >= 0) & (loc < chunk)
+        hit = (loc >= 0) & (loc < chunk) & owned
         got = jnp.take_along_axis(
             logits, jnp.clip(loc, 0, chunk - 1)[:, None], 1)[:, 0]
         tgt = tgt + jnp.where(hit, got, 0.0)
@@ -81,26 +110,36 @@ def _fwd(h, W, b, ids, chunk):
     init = (zero + _NEG, zero, zero)
     (m, l, tgt), _ = lax.scan(step, init,
                               (jnp.arange(n), Wc, bc))
+    if sharded:
+        # merge per-rank online-softmax states: one pmax + two psums
+        # total, all O(N) — never O(V)
+        m_all = lax.pmax(m, axis_name)
+        l = lax.psum(l * jnp.exp(m - m_all), axis_name)
+        tgt = lax.psum(tgt, axis_name)          # exactly one rank hit
+        m = m_all
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     loss = jnp.mean(lse - tgt)
     return loss, (h, W, b, ids, lse)
 
 
-def _bwd(chunk, res, g):
+def _bwd(chunk, axis_name, res, g):
     h, W, b, ids, lse = res
-    idi = ids.astype(jnp.int32)
+    sharded, offset = _shard_ctx(axis_name, W)
+    idi = ids.astype(jnp.int32) - offset
     hf = h.astype(jnp.float32)
     Wc, bc, n, pad = _chunks(W.astype(jnp.float32),
                              b.astype(jnp.float32), chunk)
     N = hf.shape[0]
     gN = (g / N).astype(jnp.float32)
 
+    owned = (idi >= 0) & (idi < W.shape[1])   # same bound as forward
+
     def step(dh, inputs):
         ci, Wk, bk = inputs
         logits = hf @ Wk + bk
         p = jnp.exp(logits - lse[:, None])          # chunk of softmax
         loc = idi - ci * chunk
-        hit = (loc >= 0) & (loc < chunk)
+        hit = (loc >= 0) & (loc < chunk) & owned
         onehot = jax.nn.one_hot(jnp.clip(loc, 0, chunk - 1), chunk,
                                 dtype=jnp.float32) * hit[:, None]
         dlog = (p - onehot) * gN
@@ -111,6 +150,10 @@ def _bwd(chunk, res, g):
 
     dh, (dWks, dbks) = lax.scan(step, hf * 0.0,
                                 (jnp.arange(n), Wc, bc))
+    if sharded:
+        # h is replicated over the vocab axis; each rank produced only
+        # its slice's contribution to dh. dW/db stay rank-local.
+        dh = lax.psum(dh, axis_name)
     V = W.shape[1]
     dW = dWks.transpose(1, 0, 2).reshape(W.shape[0],
                                          n * chunk)[:, :V]
@@ -124,18 +167,24 @@ fused_ce_head.defvjp(_fwd, _bwd)
 
 class _FusedCEHead(Operator):
     """Tape op: (hidden, W, b, ids) -> scalar mean CE, never
-    materialising the logits."""
+    materialising the logits. ``axis_name``: vocab-parallel mesh axis
+    (W/b columns sharded over it) or None."""
 
-    def __init__(self, chunk=8192):
+    def __init__(self, chunk=8192, axis_name=None):
         super().__init__()
         self.chunk = chunk
+        self.axis_name = axis_name
 
     def forward(self, h, W, b, ids):
         flat = h.reshape(-1, h.shape[-1])
-        return fused_ce_head(flat, W, b, ids.reshape(-1), self.chunk)
+        return fused_ce_head(flat, W, b, ids.reshape(-1), self.chunk,
+                             self.axis_name)
 
 
-def fused_softmax_cross_entropy(hidden, W, b, ids, chunk=8192):
+def fused_softmax_cross_entropy(hidden, W, b, ids, chunk=8192,
+                                axis_name=None):
     """Functional tape API over :class:`_FusedCEHead`; ``hidden`` may be
-    (B, S, D) with (B, S) ids."""
-    return _FusedCEHead(chunk)(hidden, W, b, ids)
+    (B, S, D) with (B, S) ids. ``axis_name`` turns on the vocab-parallel
+    cross-shard reduction when W's columns live sharded over that mesh
+    axis."""
+    return _FusedCEHead(chunk, axis_name)(hidden, W, b, ids)

@@ -242,6 +242,19 @@ class Optimizer:
                 self._aux[k] = Tensor(data=np.asarray(v),
                                       requires_grad=False)
 
+    def announce_aux_specs(self, params_by_name):
+        """Re-attach mesh layouts to aux entries restored without one
+        (``set_states`` on a fresh optimizer creates bare Tensors): an
+        aux named ``<param>:<kind>`` shards like its param. Without this
+        a restored momentum for a tensor-parallel weight would enter the
+        compiled step replicated at full shape and collide with the
+        local-shard gradient."""
+        for k, t in self._aux.items():
+            if getattr(t, "spec", None) is None:
+                src = params_by_name.get(k.rsplit(":", 1)[0])
+                if src is not None and getattr(src, "spec", None) is not None:
+                    t.spec = src.spec
+
 
 class SGD(Optimizer):
     """SGD with momentum / nesterov / weight decay (reference opt.py:174-334,
@@ -426,6 +439,16 @@ class DistOpt:
                     self._residuals[name] = Tensor(data=np.asarray(v),
                                                    requires_grad=False)
 
+    def announce_aux_specs(self, params_by_name):
+        self.opt.announce_aux_specs(params_by_name)
+        # sparsification error-feedback residuals are keyed by the param
+        # name itself and must shard like it too
+        for k, t in self._residuals.items():
+            if getattr(t, "spec", None) is None:
+                src = params_by_name.get(k)
+                if src is not None and getattr(src, "spec", None) is not None:
+                    t.spec = src.spec
+
     def step(self):
         self.opt.step()
 
@@ -545,6 +568,7 @@ class DistOpt:
                 if res is None:
                     res = Tensor(shape=p.shape, device=p.device,
                                  requires_grad=False)
+                    res.spec = p.spec   # error feedback shards like p
                     self._residuals[name] = res
                 grad = grad + res.data
             absg = jnp.abs(grad)

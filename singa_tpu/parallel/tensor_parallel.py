@@ -49,14 +49,22 @@ class ColumnParallelLinear(Layer):
             self.b = _param((self.out_features,), x.device)
             self.b.spec = P(self.axis_name)
 
+    def _sharded(self):
+        # inside shard_map the payload is the LOCAL shard; a full-width W
+        # means the spec was dropped (no mesh, or out_features does not
+        # divide the axis — Model._fit_state_spec) and every collective
+        # here must vanish or it would double-count
+        return self.W.shape[-1] < self.out_features
+
     def forward(self, x):
-        # Megatron "f": identity fwd, all-reduce bwd — each shard produces
-        # only its slice's contribution to dx
-        x = collective.copy_to_parallel(x, self.axis_name)
+        if self._sharded():
+            # Megatron "f": identity fwd, all-reduce bwd — each shard
+            # produces only its slice's contribution to dx
+            x = collective.copy_to_parallel(x, self.axis_name)
         y = autograd.matmul(x, self.W)
         if self.bias:
             y = autograd.add_bias(y, self.b, axis=0)
-        if self.gather_output:
+        if self.gather_output and self._sharded():
             y = collective.all_gather(y, self.axis_name, concat_axis=-1)
         return y
 
@@ -85,6 +93,7 @@ class RowParallelLinear(Layer):
         # but initialize runs on the eager (full) pass, so this is the
         # full input width
         in_features = x.shape[-1]
+        self.in_features = in_features
         self.W = _param((in_features, self.out_features), x.device)
         std = math.sqrt(2.0 / (in_features + self.out_features))
         self.W.gaussian(0.0, std)
@@ -94,7 +103,8 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         y = autograd.matmul(x, self.W)
-        y = collective.all_reduce(y, self.axis_name)
+        if self.W.shape[0] < self.in_features:   # rows actually sharded
+            y = collective.all_reduce(y, self.axis_name)
         if self.bias:
             y = autograd.add_bias(y, self.b, axis=0)
         return y
@@ -104,6 +114,64 @@ class RowParallelLinear(Layer):
         if self.bias:
             p["b"] = self.b
         return p
+
+
+class _MaskedLookup(autograd.Operator):
+    """Rank-local slice of an embedding lookup: rows of the LOCAL vocab
+    shard for ids that land in this rank's range, zeros elsewhere. The
+    enclosing all-reduce (pinned identity backward) completes the lookup;
+    this op's own vjp scatter-adds only into the local rows, so no psum
+    ever appears inside a transposed region."""
+
+    def __init__(self, axis_name="model", full_rows=None):
+        super().__init__()
+        self.axis_name = axis_name
+        self.full_rows = full_rows
+
+    def forward(self, ids, W):
+        import jax
+        from jax import lax as jlax
+        import jax.numpy as jnp
+        from .communicator import active_axis
+        idi = jax.lax.stop_gradient(ids).astype(jnp.int32)
+        # W at full row count means the spec was dropped (no mesh, or an
+        # indivisible vocab): offset 0 and no masking — a plain lookup
+        if active_axis(self.axis_name) and W.shape[0] < self.full_rows:
+            idi = idi - jlax.axis_index(self.axis_name) * W.shape[0]
+        hit = (idi >= 0) & (idi < W.shape[0])
+        rows = jnp.take(W, jnp.clip(idi, 0, W.shape[0] - 1), axis=0)
+        return jnp.where(hit[..., None], rows, 0.0)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab rows sharded over the 'model' axis —
+    Megatron's VocabParallelEmbedding, the input-side twin of a
+    vocab-sharded LM head. Each rank stores V/tp rows; a lookup is a
+    masked local take + one all-reduce. Degrades to a plain
+    :class:`~singa_tpu.layer.Embedding` outside a mesh (same state-dict
+    layout: one full-shape ``W``). Scales the capability at reference
+    python/singa/layer.py Embedding to vocabularies larger than one
+    chip's HBM slice."""
+
+    def __init__(self, input_dim, output_dim, axis_name="model"):
+        super().__init__()
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.axis_name = axis_name
+
+    def initialize(self, x):
+        self.W = _param((self.input_dim, self.output_dim), x.device)
+        self.W.gaussian(0.0, 0.02)
+        self.W.spec = P(self.axis_name, None)
+
+    def forward(self, x):
+        y = _MaskedLookup(self.axis_name, self.input_dim)(x, self.W)
+        if self.W.shape[0] < self.input_dim:     # rows actually sharded
+            y = collective.all_reduce(y, self.axis_name)
+        return y
+
+    def _own_params(self):
+        return {"W": self.W}
 
 
 class TPMLP(Layer):

@@ -160,12 +160,14 @@ class TestZooSmoke:
         (lambda: cnn.create_model(num_channels=1), (2, 1, 28, 28)),
     ])
     def test_forward_and_train(self, factory, shape):
+        rng = np.random.RandomState(0)
+        DEV.SetRandSeed(0)                          # deterministic init
         m = factory()
         m.set_optimizer(opt.SGD(lr=0.05))
-        x = t(np.random.randn(*shape))
+        x = t(rng.randn(*shape))
         classes = 10
         y = t(np.eye(classes, dtype=np.float32)[
-            np.random.randint(0, classes, shape[0])])
+            rng.randint(0, classes, shape[0])])
         m.compile([x], is_train=True, use_graph=False)
         _, loss1 = m(x, y)
         _, loss2 = m(x, y)
