@@ -812,6 +812,39 @@ class FusedCEHead(Layer):
         return {"W": self.W, "b": self.b}
 
 
+class FusedCEHeadStage(FusedCEHead):
+    """:class:`FusedCEHead` shaped as the TERMINAL stage of a
+    heterogeneous 1F1B pipeline: ``forward(h)`` passes hidden states
+    through unchanged while the pipeline's in-schedule loss calls
+    ``.loss(o, y)`` (raw arrays) against this stage's own packed params —
+    the (tokens, vocab) logits then exist nowhere: not in HBM (fused
+    scan) and not on the pipe wire (a 1F1B last stage's output never
+    rides it). Use as ``HeteroPipeline1F1B([..., head], head.loss,
+    n_micro)``; the head params live in the stage's flat pack like any
+    other stage params, so the schedule's own vjp delivers their
+    gradients."""
+
+    def initialize(self, h):
+        # Linear's glorot std and draw count (FusedCEHead uses 0.02): a
+        # pipeline with this stage must be parity-checkable against the
+        # same pipeline with a dense layer.Linear head, which requires
+        # identical rng draws in identical order
+        self.W = _param((h.shape[-1], self.vocab_size), h.device)
+        self.W.gaussian(0.0, math.sqrt(2.0 / (h.shape[-1]
+                                              + self.vocab_size)))
+        self.b = _param((self.vocab_size,), h.device)
+
+    def forward(self, h):
+        return h
+
+    def loss(self, o, y):
+        """Per-microbatch in-schedule loss: ``o`` (mb, S, D) hidden
+        array, ``y`` (mb, S) float-encoded target ids -> f32 scalar."""
+        from .ops.losses import fused_ce_head
+        return fused_ce_head(o.reshape(-1, o.shape[-1]), self.W.data,
+                             self.b.data, y.reshape(-1), self.chunk)
+
+
 class Cat(Layer):
     def __init__(self, axis=0):
         super().__init__()

@@ -194,10 +194,9 @@ class TransformerLM(model.Model):
             # materialise the full (B,S,V) logits the fused mode exists
             # to avoid
             self.head.ensure_initialized(h)
-            # a local-width W inside shard_map means the head's columns
-            # are genuinely sharded → turn on the cross-shard reduction
-            ax = self.head.axis_name \
-                if self.head.W.shape[-1] < self.vocab_size else None
+            # the layer's own sharded-check decides whether to turn on
+            # the cross-shard reduction (one source of truth)
+            ax = self.head.axis_name if self.head._sharded() else None
             loss = fused_softmax_cross_entropy(
                 h, self.head.W, self.head.b, targets,
                 self.fused_head_chunk, axis_name=ax)

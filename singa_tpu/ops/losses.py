@@ -188,3 +188,8 @@ def fused_softmax_cross_entropy(hidden, W, b, ids, chunk=8192,
     cross-shard reduction when W's columns live sharded over that mesh
     axis."""
     return _FusedCEHead(chunk, axis_name)(hidden, W, b, ids)
+
+
+# the Layer-shaped fused heads live in singa_tpu.layer (FusedCEHead for
+# Model code, FusedCEHeadStage for heterogeneous pipelines); this module
+# stays layer-free so the kernel imports without the zoo

@@ -123,7 +123,7 @@ class _MaskedLookup(autograd.Operator):
     this op's own vjp scatter-adds only into the local rows, so no psum
     ever appears inside a transposed region."""
 
-    def __init__(self, axis_name="model", full_rows=None):
+    def __init__(self, axis_name, full_rows):
         super().__init__()
         self.axis_name = axis_name
         self.full_rows = full_rows
@@ -164,9 +164,12 @@ class VocabParallelEmbedding(Layer):
         self.W.gaussian(0.0, 0.02)
         self.W.spec = P(self.axis_name, None)
 
+    def _sharded(self):
+        return self.W.shape[0] < self.input_dim  # rows actually sharded
+
     def forward(self, x):
         y = _MaskedLookup(self.axis_name, self.input_dim)(x, self.W)
-        if self.W.shape[0] < self.input_dim:     # rows actually sharded
+        if self._sharded():
             y = collective.all_reduce(y, self.axis_name)
         return y
 

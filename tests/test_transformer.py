@@ -136,6 +136,22 @@ class TestVocabParallel:
         dp = train(mesh_mod.MeshConfig(), vocab=32, fused_head_chunk=8)
         np.testing.assert_allclose(dp, base, rtol=1e-3)
 
+    def test_generate_after_sharded_training(self):
+        # decoding consumes the tp-sharded trained state (host-gathered
+        # once): one greedy step must equal the argmax of the model's own
+        # full forward logits
+        _, m = train(mesh_mod.MeshConfig(model=2), tp=True, vocab=32,
+                     fused_head_chunk=8, steps=3, return_model=True)
+        ids, _ = lm_data(vocab=32)
+        dev = device.create_cpu_device()
+        tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+        out = m.generate(tx, max_new_tokens=1, temperature=0)
+        m.eval()
+        m.graph_mode = False
+        logits = m(tx)
+        want = np.argmax(np.asarray(logits.data)[:, -1, :], -1)
+        np.testing.assert_array_equal(out[:, -1], want)
+
     def test_save_load_restores_sharded_momentum(self, tmp_path):
         # load_states creates momentum buffers on the fresh optimizer;
         # they must re-announce their param's layout or the next compiled
