@@ -204,11 +204,21 @@ class _TpuLock:
 
 
 def _record_obs(event, data):
+    # watcher and bench processes both append here; a dedicated
+    # short-lived write lock (NOT the long-held TPU run lock) serializes
+    # the appends so a torn/interleaved line can never drop banked
+    # evidence on the floor
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "event": event}
     rec.update(data)
     try:
-        with open(OBS_PATH, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        import fcntl
+        with open(OBS_PATH + ".wlock", "a") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                with open(OBS_PATH, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
     except (OSError, TypeError):
         pass
 

@@ -21,6 +21,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -376,11 +377,18 @@ SG_EXPORT void sg_net_destroy(void* h) {
     net->closing = true;
     net->new_cv.notify_all();
     for (auto& kv : net->eps) kv.second->cv.notify_all();
-    // wait UNCONDITIONALLY until every waiter has left: the closing
-    // flag is part of each wait predicate, so the notify above wakes
-    // them all — but a consumer mid-recv with a long timeout may take a
-    // scheduling beat to observe it, and deleting the Net from under a
-    // live waiter is a use-after-free no bounded spin can rule out
+    // wait until every waiter has left: the closing flag is part of
+    // each wait predicate, so the notify above wakes them all — but a
+    // consumer mid-recv with a long timeout may take a scheduling beat
+    // to observe it, and deleting the Net from under a live waiter is a
+    // use-after-free. A waiter that never leaves (a wedged consumer
+    // thread, or a native caller sitting in a long recv timeout without
+    // the Python layer's 200ms slicing) must not turn close() into an
+    // unbounded hang either: after a generous deadline we log loudly
+    // and LEAK the Net — bounded shutdown, and the UAF stays ruled out
+    // because the memory stays valid for the stuck waiter.
+    const auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(30);
     for (;;) {
       bool busy = false;
       for (auto& kv : net->eps)
@@ -388,6 +396,16 @@ SG_EXPORT void sg_net_destroy(void* h) {
       for (auto* ep : net->graveyard)
         if (ep->waiters > 0) busy = true;
       if (!busy) break;
+      if (std::chrono::steady_clock::now() > deadline) {
+        std::fprintf(stderr,
+                     "[singa_network] sg_net_destroy: waiter still "
+                     "blocked after 30s; leaking Net %p instead of "
+                     "freeing under a live waiter\n", h);
+        std::fflush(stderr);
+        net->stop.store(true);
+        net->poke();
+        return;  // threads + fds leak with the Net; process exit reaps
+      }
       lk.unlock();
       std::this_thread::sleep_for(std::chrono::milliseconds(10));
       lk.lock();

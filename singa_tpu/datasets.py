@@ -20,8 +20,15 @@ import struct
 import numpy as np
 
 # mirror of the reference's default locations (its download scripts
-# write to /tmp) plus conventional in-repo spots
-_SEARCH_ROOTS = ["/tmp", "/root/data", "data", "."]
+# write to /tmp) plus conventional in-repo spots. Relative spots are
+# anchored at the REPO root (parent of this package), not the process
+# cwd — a launcher invoking a training script from elsewhere must find
+# the same datasets the interactive run found. cwd stays as a LAST
+# fallback for ad-hoc layouts.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SEARCH_ROOTS = ["/tmp", "/root/data",
+                 os.path.join(_REPO_ROOT, "data"), _REPO_ROOT,
+                 "data", "."]
 
 CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR10_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)

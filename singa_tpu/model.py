@@ -160,7 +160,25 @@ def _unflatten(tree, leaves, device):
 
 
 class Model(Layer):
-    """Base user model (reference python/singa/model.py Model)."""
+    """Base user model (reference python/singa/model.py Model).
+
+    Mesh layout hooks (all optional class/instance attributes):
+
+    - ``input_specs``: per-input PartitionSpec list for the compiled
+      train step (default: batch dim over the DistOpt axis).
+    - ``output_specs``: per-output-leaf specs for the train step.
+    - ``eval_output_specs``: per-output-leaf specs for the SHARDED eval
+      path. Without it, batch-leading leaves shard like the input batch
+      and every other leaf is ``pmean``'d over the reduce axes — correct
+      for mean-type outputs (losses, accuracies averaged in-model), but
+      it would divide SUM-type outputs (per-batch counts, summed
+      errors) by the world size relative to the gathered eager path.
+    - ``eval_output_reduce``: per-leaf ``"mean"``/``"sum"`` list
+      selecting how replicated (non-batch-leading) eval leaves combine
+      across shards (default ``"mean"``). Models whose eval returns
+      per-batch sums set ``"sum"`` for those leaves to keep sharded and
+      eager eval numerically identical.
+    """
 
     def __init__(self):
         super().__init__()
@@ -745,8 +763,16 @@ class Model(Layer):
             rec["tree"] = _flatten(res, leaves)
             specs = rec["leaf_specs"]
             raxes = tuple(dist.communicator.reduce_axes)
-            leaves = [x if specs[i] != P() else jax.lax.pmean(x, raxes)
-                      for i, x in enumerate(leaves)]
+            kinds = getattr(self, "eval_output_reduce", None) or []
+
+            def combine(i, x):
+                if specs[i] != P():          # batch-sharded: stitched
+                    return x
+                kind = kinds[i] if i < len(kinds) else "mean"
+                red = jax.lax.psum if kind == "sum" else jax.lax.pmean
+                return red(x, raxes)
+
+            leaves = [combine(i, x) for i, x in enumerate(leaves)]
             return leaves
 
         def body(state_arrays, *input_arrays):
@@ -774,7 +800,8 @@ class Model(Layer):
         key = (tuple((tuple(np.shape(a)), str(getattr(a, "dtype", "?")))
                      for a in input_arrays),
                repr(self._eval_input_specs(len(args))),
-               repr(getattr(self, "eval_output_specs", None)))
+               repr(getattr(self, "eval_output_specs", None)),
+               repr(getattr(self, "eval_output_reduce", None)))
         rec = self._eval_steps.get(key)
         fresh = rec is None
         try:
@@ -797,13 +824,36 @@ class Model(Layer):
             # per-shard constraints beyond input divisibility (e.g. a
             # pipeline's microbatch assert on the LOCAL batch) surface
             # when the shard_map first traces — fall back to the
-            # gather+eager path, which sees the global batch
+            # gather+eager path, which sees the global batch. Only
+            # STRUCTURAL errors pin the signature; a transient failure
+            # (device OOM, interrupted backend: RuntimeError family)
+            # falls back for THIS call and retries on the next, so one
+            # bad moment cannot silently degrade every later eval of
+            # this shape to the gather path.
             import warnings
+            structural = isinstance(
+                e, (TypeError, ValueError, AssertionError,
+                    NotImplementedError, IndexError, KeyError))
+            if not structural:
+                # RuntimeError family (XlaRuntimeError covers both a
+                # transient OOM and a permanent lowering failure): allow
+                # a bounded number of retries, then pin — an unbounded
+                # retry would pay a full retrace+compile attempt on
+                # EVERY eval of a signature that can never build
+                fails = getattr(self, "_eval_fail_counts", None)
+                if fails is None:
+                    fails = self._eval_fail_counts = {}
+                fails[key] = fails.get(key, 0) + 1
+                structural = fails[key] >= 3
+            if structural:
+                self._eval_steps[key] = NotImplemented
+            else:
+                self._eval_steps.pop(key, None)
             warnings.warn(
                 f"sharded eval unavailable for this signature "
                 f"({type(e).__name__}: {e}); falling back to gathered "
-                "eager eval", stacklevel=3)
-            self._eval_steps[key] = NotImplemented
+                f"eager eval ({'pinned' if structural else 'will retry'})",
+                stacklevel=3)
             return NotImplemented
         return _unflatten(rec["tree"], list(leaves), self.dev)
 

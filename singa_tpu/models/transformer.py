@@ -243,21 +243,24 @@ def _lm_decode_params(m):
 
     blocks = []
     for blk in m.blocks:
-        if not hasattr(blk.mlp, "up"):
-            raise NotImplementedError(
-                "generate() supports dense-FFN TransformerLMs only; "
-                "MoE decoding is not implemented")
         at = blk.attn
-        blocks.append(dict(
+        d = dict(
             ln1_s=a(blk.ln1.scale), ln1_b=a(blk.ln1.bias),
             wq=a(at.q_proj.W), bq=a(at.q_proj.b),
             wk=a(at.k_proj.W), bk=a(at.k_proj.b),
             wv=a(at.v_proj.W), bv=a(at.v_proj.b),
             wo=a(at.proj.W), bo=a(at.proj.b),
             ln2_s=a(blk.ln2.scale), ln2_b=a(blk.ln2.bias),
-            w_up=a(blk.mlp.up.W), b_up=a(blk.mlp.up.b),
-            w_dn=a(blk.mlp.down.W), b_dn=a(blk.mlp.down.b),
-        ))
+        )
+        if hasattr(blk.mlp, "up"):
+            d.update(w_up=a(blk.mlp.up.W), b_up=a(blk.mlp.up.b),
+                     w_dn=a(blk.mlp.down.W), b_dn=a(blk.mlp.down.b))
+        else:
+            # MoE FFN: all expert groups gathered to host like the rest
+            # of the decode state; "wg" flags the MoE path downstream
+            d.update(wg=a(blk.mlp.wg), w1=a(blk.mlp.w1), b1=a(blk.mlp.b1),
+                     w2=a(blk.mlp.w2), b2=a(blk.mlp.b2))
+        blocks.append(d)
     return dict(tok=a(m.tok_emb.W), pos=a(m.pos_emb.W),
                 lnf_s=a(m.ln_f.scale), lnf_b=a(m.ln_f.bias),
                 head_w=a(m.head.W), head_b=a(m.head.b),
@@ -300,7 +303,10 @@ def _generate(self, ids, max_new_tokens, temperature=1.0, top_k=None,
     per call (so freshly trained values are always used), but the
     compiled decode program is CACHED per shape signature — repeated
     calls pay no retrace. Causal models only (AR decoding is undefined
-    for bidirectional attention); dense FFN only.
+    for bidirectional attention). MoE models decode through the training
+    MoE kernel (same routing/combine math, expert axis inactive) with
+    DROP-FREE capacity; greedy decode equals the full forward exactly
+    whenever the forward itself drops no tokens.
     """
     import jax
     import jax.numpy as jnp
@@ -322,8 +328,29 @@ def _generate(self, ids, max_new_tokens, temperature=1.0, top_k=None,
     assert L <= P["pos"].shape[0], \
         f"prompt+new tokens ({L}) exceeds max_len {P['pos'].shape[0]}"
     scale = 1.0 / math.sqrt(hd)
-    act = jax.nn.gelu if self.blocks[0].mlp.activation == "gelu" \
-        else jax.nn.relu
+    mlp0 = self.blocks[0].mlp
+    act = jax.nn.gelu \
+        if getattr(mlp0, "activation", "gelu") == "gelu" else jax.nn.relu
+    if self.moe:
+        # decode reuses the training MoE kernel (same routing/combine
+        # math) with the expert axis inactive — the host-gathered params
+        # hold every expert — but with DROP-FREE capacity: cf=E makes
+        # C = k*T, so no token of the tiny per-step set is ever dropped
+        # (training's cf is tuned for joint batches; applied to T=B
+        # decode steps it would silently zero some tokens' FFN output).
+        # Exact greedy parity with a full forward therefore holds
+        # whenever the forward itself drops nothing.
+        from ..parallel.moe import _MoEFFN
+        moe_op = _MoEFFN(mlp0.n_experts, mlp0.top_k,
+                         float(mlp0.n_experts), None, ())
+
+    def mlp_apply(p, h2):
+        if "wg" in p:
+            Bq, Sq, Dq = h2.shape
+            y, _aux = moe_op.forward(h2.reshape(-1, Dq), p["wg"],
+                                     p["w1"], p["b1"], p["w2"], p["b2"])
+            return y.reshape(h2.shape)
+        return act(h2 @ p["w_up"] + p["b_up"]) @ p["w_dn"] + p["b_dn"]
 
     sig = (B, S0, max_new_tokens, float(temperature), top_k)
     cache = getattr(self, "_decode_cache", None)
@@ -346,8 +373,7 @@ def _generate(self, ids, max_new_tokens, temperature=1.0, top_k=None,
             o = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", att, v))
             x = x + (o @ p["wo"] + p["bo"])
             h2 = _ln(x, p["ln2_s"], p["ln2_b"])
-            x = x + (act(h2 @ p["w_up"] + p["b_up"]) @ p["w_dn"]
-                     + p["b_dn"])
+            x = x + mlp_apply(p, h2)
             return x, k, v
 
         def block_decode(p, x, kc, vc, pos):
@@ -363,8 +389,7 @@ def _generate(self, ids, max_new_tokens, temperature=1.0, top_k=None,
             o = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", att, vc))
             x = x + (o @ p["wo"] + p["bo"])
             h2 = _ln(x, p["ln2_s"], p["ln2_b"])
-            x = x + (act(h2 @ p["w_up"] + p["b_up"]) @ p["w_dn"]
-                     + p["b_dn"])
+            x = x + mlp_apply(p, h2)
             return x, kc, vc
 
         def sample(logits, key):

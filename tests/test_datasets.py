@@ -267,3 +267,28 @@ class TestNorthStar:
                               str(tmp_path), "--cpu", "--bs", "8",
                               "--epochs", "1"])
         assert "Training loss" in out
+
+
+class TestSearchRoots:
+    """Relative search roots are anchored at the repo root, not the
+    process cwd: a launcher starting a script from elsewhere must find
+    the same datasets the interactive run found."""
+
+    def test_repo_anchored_before_cwd(self):
+        repo_data = os.path.join(datasets._REPO_ROOT, "data")
+        assert os.path.isabs(repo_data)
+        assert repo_data in datasets._SEARCH_ROOTS
+        assert (datasets._SEARCH_ROOTS.index(repo_data)
+                < datasets._SEARCH_ROOTS.index("data"))
+
+    def test_resolution_survives_cwd_change(self, tmp_path, monkeypatch):
+        # README.md lives at the repo root (one of the roots); resolving
+        # it must work from any cwd. /tmp-style shared roots are masked
+        # so a stray foreign file cannot flake the test.
+        monkeypatch.setattr(
+            datasets, "_SEARCH_ROOTS",
+            [r for r in datasets._SEARCH_ROOTS
+             if r not in ("/tmp", "/root/data")])
+        monkeypatch.chdir(tmp_path)
+        p = datasets._resolve(None, ["README.md"], "readme", "n/a")
+        assert p == os.path.join(datasets._REPO_ROOT, "README.md")

@@ -776,6 +776,88 @@ class TestShardedEval:
         out = m(tx)                       # 63 % 4 != 0 -> eager fallback
         assert out.shape[0] == 63
 
+    def test_sum_type_eval_output_reduce(self):
+        """Replicated eval leaves default to pmean (mean-type); a model
+        whose eval returns per-batch SUMS declares eval_output_reduce so
+        sharded and eager eval agree exactly (without it the sum would
+        come back divided by the world size)."""
+
+        class SumModel(model.Model):
+            eval_output_reduce = ["mean", "sum"]
+
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(4)
+
+            def forward(self, x):
+                o = self.fc(x)
+                # (mean-type, sum-type) pair of scalar outputs
+                return (autograd.mul(autograd.reduce_mean(o),
+                                     Tensor(data=np.float32(1.0),
+                                            requires_grad=False)),
+                        autograd.reduce_sum(o))
+
+            def train_one_batch(self, x, y):
+                o = self.fc(x)
+                loss = layer.MeanSquareError()(o, y)
+                self.optimizer(loss)
+                return o, loss
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(2)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 4).astype(np.float32)
+        tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+        ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+        m = SumModel()
+        d = opt.DistOpt(opt.SGD(lr=0.1))
+        d.communicator.mesh = mesh_mod.make_mesh(
+            jax.devices("cpu"), mesh_mod.MeshConfig())
+        m.set_optimizer(d)
+        m.compile([tx], is_train=True, use_graph=True)
+        m(tx, ty)
+        m.eval()
+        mean_s, sum_s = m(tx)             # sharded eval
+        m.graph_mode = False
+        mean_e, sum_e = m(tx)             # gathered eager reference
+        np.testing.assert_allclose(np.asarray(sum_s.data).ravel(),
+                                   np.asarray(sum_e.data).ravel(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(mean_s.data).ravel(),
+                                   np.asarray(mean_e.data).ravel(),
+                                   rtol=1e-5)
+
+    def test_transient_eval_failure_retries(self, monkeypatch):
+        """A transient first-eval failure (RuntimeError family: device
+        OOM, interrupted backend) must NOT pin the signature to the
+        gather path forever — the next call retries the sharded build."""
+        import warnings as w
+        _, m = train_tp(mesh_mod.MeshConfig(model=2), steps=2)
+        x, _ = make_data()
+        tx = tensor.Tensor(data=x, device=m.dev, requires_grad=False)
+        m.eval()
+        calls = {"n": 0}
+        orig = model.Model._build_eval
+
+        def flaky(self, args):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient backend failure")
+            return orig(self, args)
+
+        monkeypatch.setattr(model.Model, "_build_eval", flaky)
+        with w.catch_warnings():
+            w.simplefilter("ignore")
+            out1 = m(tx)                  # falls back this call only
+        out2 = m(tx)                      # retried: sharded build works
+        assert calls["n"] == 2
+        assert any(r is not NotImplemented
+                   for r in m._eval_steps.values())
+        np.testing.assert_allclose(np.asarray(out1.data),
+                                   np.asarray(out2.data), rtol=2e-4,
+                                   atol=1e-5)
+
     def test_eval_then_more_training(self):
         """Interleaving sharded eval with training must not corrupt the
         training step's state threading."""

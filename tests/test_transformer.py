@@ -297,6 +297,36 @@ class TestGeneration:
             cur = np.concatenate([cur, nxt[:, None]], 1)
         np.testing.assert_array_equal(out, cur.astype(np.int64))
 
+    def test_moe_greedy_matches_naive_reforward(self):
+        # MoE decode routes through the training MoE kernel; with a
+        # capacity factor high enough that no token drops, greedy decode
+        # must EXACTLY reproduce the full-forward-per-token strategy
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(11)
+        ids, targets = lm_data(B=2, S=8)
+        tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+        ty = tensor.Tensor(data=targets, device=dev, requires_grad=False)
+        m = transformer.TransformerLM(VOCAB, d_model=32, n_heads=2,
+                                      n_layers=2, max_len=64, tp=False,
+                                      moe=4, moe_capacity_factor=8.0)
+        m.set_optimizer(opt.SGD(lr=0.3))
+        m.compile([tx], is_train=True, use_graph=True)
+        for _ in range(3):
+            m(tx, ty)
+        m.eval()
+        prompt = ids[:, :5]
+        T = 6
+        out = m.generate(prompt, T, temperature=0)
+        assert out.shape == (2, 5 + T)
+        cur = prompt.copy()
+        for _ in range(T):
+            txc = tensor.Tensor(data=cur.astype(np.float32), device=dev,
+                                requires_grad=False)
+            logits = np.asarray(m(txc).data)
+            nxt = logits[:, -1].argmax(-1).astype(np.float32)
+            cur = np.concatenate([cur, nxt[:, None]], 1)
+        np.testing.assert_array_equal(out, cur.astype(np.int64))
+
     def test_sampling_runs_and_respects_topk(self):
         m, dev, ids = self._model(steps=1)
         out = m.generate(ids[:, :4], 5, temperature=0.8, top_k=3, seed=1)
