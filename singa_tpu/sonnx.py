@@ -252,8 +252,15 @@ class SingaFrontend:
             return "Split", attrs
         if ty == "Gather":
             return "Gather", {"axis": op.axis}
-        if ty == "Embedding":
-            # our Embedding(x_ids, W) == onnx Gather(W, ids) on axis 0
+        if ty in ("Embedding", "_MaskedLookup"):
+            # our Embedding(x_ids, W) == onnx Gather(W, ids) on axis 0.
+            # _MaskedLookup (VocabParallelEmbedding's local lookup) is
+            # exported from host/eager tapes where W is full-width, so
+            # for in-range ids it IS a plain embedding. Out-of-range ids
+            # diverge at the edges: Embedding clips, _MaskedLookup
+            # returns zeros, ONNX Gather wraps negatives — exported
+            # models are exact only for ids in [0, V), the universal
+            # embedding contract.
             input_names.reverse()
             return "Gather", {"axis": 0}
         if ty == "Tile":
@@ -593,7 +600,7 @@ class SingaFrontend:
                 # onnx BatchNormalization: X, scale, B, mean, var
                 in_names = in_names[:3] + [bn_state_name(op, "running_mean"),
                                            bn_state_name(op, "running_var")]
-            if ty == "Embedding":
+            if ty in ("Embedding", "_MaskedLookup"):
                 # ONNX Gather requires int32/int64 indices; our ids tensor
                 # is float-typed on the tape, so cast it in-graph
                 cast_nm = f"{op_name}_ids_i64"
@@ -730,6 +737,7 @@ class SingaBackend:
         "Shape": autograd.shape, "And": autograd._and,
         "Or": autograd._or, "Xor": autograd._xor, "Not": autograd._not,
         "Neg": autograd.negative, "Reciprocal": autograd.reciprocal,
+        "Exp": autograd.exp,
         "Sum": autograd.sum, "NonZero": autograd.nonzero,
         "Ceil": autograd.ceil, "Floor": autograd.floor,
         "Abs": autograd.abs, "Erf": autograd.erf, "Where": autograd.where,
@@ -781,9 +789,11 @@ class SingaBackend:
             if handle is None:
                 ks = a["kernel_shape"]
                 pads = a.get("pads", [0] * 4)
+                # ONNX spec: absent strides default to 1 per spatial
+                # axis (NOT to the kernel shape)
                 handle = PoolingHandle(
                     ins[0], tuple(ks),
-                    tuple(a.get("strides", ks)),
+                    tuple(a.get("strides", [1] * len(ks))),
                     ((pads[0], pads[2]), (pads[1], pads[3])),
                     is_max=(ty == "MaxPool"))
                 node.cache["handle"] = handle

@@ -1,0 +1,3 @@
+
+Binput_2J9Åt>Ó
+€¿*EÖ?

@@ -1,0 +1,2 @@
+
+Binput_1J8…?b[@ ?

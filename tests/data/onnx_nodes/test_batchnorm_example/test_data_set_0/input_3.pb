@@ -1,0 +1,2 @@
+
+Binput_3JËo%>¦È?·_J¿

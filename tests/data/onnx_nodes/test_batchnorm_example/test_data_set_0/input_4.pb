@@ -1,0 +1,2 @@
+
+Binput_4Ji"´?˜h9?¢o@

@@ -1,0 +1,2 @@
+
+Binput_1J$X>u4.?wB2?lϽM

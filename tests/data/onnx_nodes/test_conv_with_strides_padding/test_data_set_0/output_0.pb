@@ -1,0 +1,2 @@
+
+Boutput_0J0M>)>0=b@pF!?B?.X

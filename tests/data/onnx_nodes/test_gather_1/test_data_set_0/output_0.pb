@@ -1,0 +1,2 @@
+
+Boutput_0J(g?i囿)?%>b>g>ǉ̽n

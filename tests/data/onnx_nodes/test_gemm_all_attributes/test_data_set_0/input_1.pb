@@ -1,0 +1,2 @@
+
+Binput_1JP%?,@e(֤2OPr>AU?Do屾+пȿ2?/e??酪

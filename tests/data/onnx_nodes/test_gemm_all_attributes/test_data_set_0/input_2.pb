@@ -1,0 +1,3 @@
+
+Binput_2J0ù„uøΩ	Kø¿x¿ë¬Ä>Œ¿≥
+ø∞$çæª∞5øaìﬁ?°ê~?zŸ®?3Êaø

@@ -1,0 +1,310 @@
+"""Generate the hermetic ONNX node-conformance fixtures.
+
+The official ONNX backend node suite (what the reference runs via
+test/python/test_onnx_backend.py) ships inside the `onnx` wheel, which
+this environment does not have. This script freezes an equivalent
+subset — single-node ModelProtos plus input/output TensorProtos in the
+official on-disk layout (model.onnx + test_data_set_0/{input,output}_N
+.pb) — built from the ONNX operator-spec semantics implemented in plain
+numpy, serialized with the vendored wire-compatible protos
+(singa_tpu/onnx_proto). The committed fixtures make
+tests/test_onnx_nodes.py a conformance suite that runs with zero
+optional dependencies; tests/test_onnx_backend.py still runs the real
+upstream suite whenever the onnx wheel is importable.
+
+Regenerate (deterministic, seed-pinned):
+    python tools/gen_onnx_node_fixtures.py
+"""
+
+import os
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from singa_tpu.onnx_compat import TensorProto, helper, numpy_helper  # noqa
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "data", "onnx_nodes")
+
+F = TensorProto.FLOAT
+
+
+def _vi(name, arr):
+    dt = helper.np_dtype_to_tensor_dtype(np.asarray(arr).dtype)
+    return helper.make_tensor_value_info(name, dt, list(np.shape(arr)))
+
+
+def case(name, op_type, inputs, outputs, attrs=None, opset=11):
+    """inputs/outputs: list of (name, ndarray). Returns (name, model,
+    input arrays, output arrays)."""
+    node = helper.make_node(op_type, [n for n, _ in inputs],
+                            [n for n, _ in outputs], **(attrs or {}))
+    graph = helper.make_graph(
+        [node], name,
+        [_vi(n, a) for n, a in inputs],
+        [_vi(n, a) for n, a in outputs])
+    model = helper.make_model(
+        graph, opset_imports=[helper.make_operatorsetid("", opset)])
+    return (name, model, [a for _, a in inputs], [a for _, a in outputs])
+
+
+# ---------------------------------------------------------------------------
+# numpy reference implementations of the ONNX operator spec
+# ---------------------------------------------------------------------------
+
+def ref_softmax(x, axis):
+    # opset-11 semantics: coerce to 2D at `axis`, softmax the rows
+    shape = x.shape
+    flat = x.reshape(int(np.prod(shape[:axis])) if axis > 0 else 1, -1)
+    e = np.exp(flat - flat.max(axis=1, keepdims=True))
+    return (e / e.sum(axis=1, keepdims=True)).reshape(shape)
+
+
+def ref_conv2d(x, w, strides=(1, 1), pads=(0, 0, 0, 0)):
+    N, C, H, W = x.shape
+    M, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                    (pads[1], pads[3])))
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    out = np.zeros((N, M, oh, ow), np.float32)
+    for n in range(N):
+        for m in range(M):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[n, :, i * strides[0]:i * strides[0] + kh,
+                               j * strides[1]:j * strides[1] + kw]
+                    out[n, m, i, j] = np.sum(patch * w[m])
+    return out
+
+
+def ref_pool2d(x, k, strides, is_max):
+    N, C, H, W = x.shape
+    oh = (H - k[0]) // strides[0] + 1
+    ow = (W - k[1]) // strides[1] + 1
+    out = np.zeros((N, C, oh, ow), np.float32)
+    red = np.max if is_max else np.mean
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = red(
+                x[:, :, i * strides[0]:i * strides[0] + k[0],
+                  j * strides[1]:j * strides[1] + k[1]], axis=(2, 3))
+    return out
+
+
+def ref_gemm(a, b, c=None, alpha=1.0, beta=1.0, transA=0, transB=0):
+    aa = a.T if transA else a
+    bb = b.T if transB else b
+    y = alpha * (aa @ bb)
+    if c is not None:
+        y = y + beta * c
+    return y.astype(np.float32)
+
+
+def ref_batchnorm(x, s, bias, mean, var, eps=1e-5):
+    shp = (1, -1, 1, 1)
+    return ((x - mean.reshape(shp)) / np.sqrt(var.reshape(shp) + eps)
+            * s.reshape(shp) + bias.reshape(shp)).astype(np.float32)
+
+
+def build_cases():
+    rng = np.random.RandomState(0)
+
+    def r(*shape):
+        return rng.randn(*shape).astype(np.float32)
+
+    cases = []
+
+    # -- simple activations / unary ------------------------------------
+    x = r(3, 4, 5)
+    xpos = np.abs(r(3, 4, 5)) + 0.1
+    for name, op, inp, out in [
+        ("test_relu", "Relu", x, np.maximum(x, 0)),
+        ("test_sigmoid", "Sigmoid", x, 1 / (1 + np.exp(-x))),
+        ("test_tanh", "Tanh", x, np.tanh(x)),
+        ("test_softplus", "Softplus", x, np.log1p(np.exp(x))),
+        ("test_neg", "Neg", x, -x),
+        ("test_abs", "Abs", x, np.abs(x)),
+        ("test_exp", "Exp", x, np.exp(x)),
+        ("test_log", "Log", xpos, np.log(xpos)),
+        ("test_sqrt", "Sqrt", xpos, np.sqrt(xpos)),
+        ("test_ceil", "Ceil", x, np.ceil(x)),
+        ("test_floor", "Floor", x, np.floor(x)),
+        ("test_reciprocal", "Reciprocal", xpos, 1.0 / xpos),
+        ("test_sign", "Sign", x, np.sign(x)),
+        ("test_erf", "Erf", x, np.vectorize(__import__("math").erf)(x)
+         .astype(np.float32)),
+    ]:
+        cases.append(case(name, op, [("x", inp)],
+                          [("y", out.astype(np.float32))]))
+
+    cases.append(case("test_elu", "Elu", [("x", x)],
+                      [("y", np.where(x > 0, x, 2.0 * (np.exp(x) - 1))
+                        .astype(np.float32))], {"alpha": 2.0}))
+    cases.append(case("test_leakyrelu", "LeakyRelu", [("x", x)],
+                      [("y", np.where(x > 0, x, 0.1 * x)
+                        .astype(np.float32))], {"alpha": 0.1}))
+    a_selu, g_selu = 1.6732632, 1.0507009
+    cases.append(case(
+        "test_selu_default", "Selu", [("x", x)],
+        [("y", (g_selu * np.where(x > 0, x, a_selu * (np.exp(x) - 1)))
+          .astype(np.float32))]))
+
+    # -- binary elementwise (with broadcasting rows) --------------------
+    a, b = r(3, 4, 5), r(3, 4, 5)
+    bc = r(5)                                   # numpy-style broadcast
+    bpos = np.abs(r(3, 4, 5)) + 0.5
+    for name, op, (i1, i2), out in [
+        ("test_add", "Add", (a, b), a + b),
+        ("test_add_bcast", "Add", (a, bc), a + bc),
+        ("test_sub", "Sub", (a, b), a - b),
+        ("test_mul", "Mul", (a, b), a * b),
+        ("test_div", "Div", (a, bpos), a / bpos),
+        ("test_pow", "Pow", (np.abs(a) + 0.1, b), (np.abs(a) + 0.1) ** b),
+    ]:
+        cases.append(case(name, op, [("a", i1), ("b", i2)],
+                          [("y", out.astype(np.float32))]))
+
+    # -- matmul / gemm --------------------------------------------------
+    m2a, m2b = r(4, 6), r(6, 3)
+    cases.append(case("test_matmul_2d", "MatMul",
+                      [("a", m2a), ("b", m2b)], [("y", m2a @ m2b)]))
+    m3a, m3b = r(2, 4, 6), r(2, 6, 3)
+    cases.append(case("test_matmul_3d", "MatMul",
+                      [("a", m3a), ("b", m3b)],
+                      [("y", (m3a @ m3b).astype(np.float32))]))
+    ga, gb, gc = r(3, 5), r(5, 4), r(3, 4)
+    gat, gbt = r(5, 3), r(4, 5)
+    cases.append(case("test_gemm_all_attributes", "Gemm",
+                      [("a", gat), ("b", gbt), ("c", gc)],
+                      [("y", ref_gemm(gat, gbt, gc, 0.25, 0.35, 1, 1))],
+                      {"alpha": 0.25, "beta": 0.35,
+                       "transA": 1, "transB": 1}))
+    cases.append(case("test_gemm_default", "Gemm",
+                      [("a", ga), ("b", gb), ("c", gc)],
+                      [("y", ref_gemm(ga, gb, gc))]))
+
+    # -- softmax --------------------------------------------------------
+    sm = r(3, 7)
+    cases.append(case("test_softmax_axis_1", "Softmax", [("x", sm)],
+                      [("y", ref_softmax(sm, 1))], {"axis": 1}))
+    cases.append(case("test_softmax_default_axis", "Softmax",
+                      [("x", sm)], [("y", ref_softmax(sm, 1))]))
+
+    # -- shape ops ------------------------------------------------------
+    c1, c2 = r(2, 3), r(2, 3)
+    cases.append(case("test_concat_2d_axis_0", "Concat",
+                      [("a", c1), ("b", c2)],
+                      [("y", np.concatenate([c1, c2], 0))], {"axis": 0}))
+    cases.append(case("test_concat_2d_axis_1", "Concat",
+                      [("a", c1), ("b", c2)],
+                      [("y", np.concatenate([c1, c2], 1))], {"axis": 1}))
+    fl = r(2, 3, 4)
+    cases.append(case("test_flatten_axis1", "Flatten", [("x", fl)],
+                      [("y", fl.reshape(2, 12))], {"axis": 1}))
+    tr = r(2, 3, 4)
+    cases.append(case("test_transpose_default", "Transpose", [("x", tr)],
+                      [("y", tr.transpose(2, 1, 0).copy())]))
+    rs = r(2, 3, 4)
+    tgt = np.array([4, 2, 3], np.int64)
+    cases.append(case("test_reshape_reordered_all_dims", "Reshape",
+                      [("x", rs), ("shape", tgt)],
+                      [("y", rs.reshape(4, 2, 3))]))
+    sq = r(1, 3, 4, 1)
+    cases.append(case("test_squeeze", "Squeeze", [("x", sq)],
+                      [("y", sq.reshape(3, 4))], {"axes": [0, 3]}))
+    us = r(3, 4)
+    cases.append(case("test_unsqueeze_axis_0", "Unsqueeze", [("x", us)],
+                      [("y", us.reshape(1, 3, 4))], {"axes": [0]}))
+    gt = r(5, 4)
+    gi0 = np.array([0, 1, 3], np.int64)
+    cases.append(case("test_gather_0", "Gather",
+                      [("x", gt), ("i", gi0)],
+                      [("y", np.take(gt, gi0, 0))], {"axis": 0}))
+    cases.append(case("test_gather_1", "Gather",
+                      [("x", gt), ("i", np.array([0, 2], np.int64))],
+                      [("y", np.take(gt, [0, 2], 1))], {"axis": 1}))
+
+    # -- reductions / clip ---------------------------------------------
+    rd = r(3, 2, 2)
+    cases.append(case(
+        "test_reduce_mean_default_axes_keepdims_example", "ReduceMean",
+        [("x", rd)], [("y", rd.mean(keepdims=True).astype(np.float32)
+                       .reshape(1, 1, 1))]))
+    cases.append(case(
+        "test_reduce_sum_default_axes_keepdims_example", "ReduceSum",
+        [("x", rd)], [("y", rd.sum(keepdims=True).astype(np.float32)
+                       .reshape(1, 1, 1))]))
+    cl = np.array([-2.0, -1.0, 0.0, 1.0, 2.0], np.float32)
+    cases.append(case("test_clip_example", "Clip",
+                      [("x", cl), ("min", np.float32(-1.0)),
+                       ("max", np.float32(1.0))],
+                      [("y", np.clip(cl, -1, 1))]))
+
+    # -- conv / pool / bn ----------------------------------------------
+    cx, cw = r(1, 1, 7, 5), r(1, 1, 3, 3)
+    cases.append(case(
+        "test_conv_with_strides_no_padding", "Conv",
+        [("x", cx), ("w", cw)],
+        [("y", ref_conv2d(cx, cw, (2, 2)))],
+        {"kernel_shape": [3, 3], "strides": [2, 2], "pads": [0, 0, 0, 0]}))
+    cases.append(case(
+        "test_conv_with_strides_padding", "Conv",
+        [("x", cx), ("w", cw)],
+        [("y", ref_conv2d(cx, cw, (2, 2), (1, 1, 1, 1)))],
+        {"kernel_shape": [3, 3], "strides": [2, 2], "pads": [1, 1, 1, 1]}))
+    px = r(1, 3, 8, 8)
+    cases.append(case(
+        "test_maxpool_2d_default", "MaxPool", [("x", px)],
+        [("y", ref_pool2d(px, (2, 2), (1, 1), True))],
+        {"kernel_shape": [2, 2]}))
+    cases.append(case(
+        "test_averagepool_2d_strides", "AveragePool", [("x", px)],
+        [("y", ref_pool2d(px, (3, 3), (2, 2), False))],
+        {"kernel_shape": [3, 3], "strides": [2, 2]}))
+    cases.append(case(
+        "test_globalaveragepool", "GlobalAveragePool", [("x", px)],
+        [("y", px.mean(axis=(2, 3), keepdims=True).astype(np.float32))]))
+    bx = r(2, 3, 4, 4)
+    bs, bb = np.abs(r(3)) + 0.5, r(3)
+    bm, bv = r(3), np.abs(r(3)) + 0.5
+    cases.append(case(
+        "test_batchnorm_epsilon", "BatchNormalization",
+        [("x", bx), ("s", bs), ("bias", bb), ("mean", bm), ("var", bv)],
+        [("y", ref_batchnorm(bx, bs, bb, bm, bv, 1e-2))],
+        {"epsilon": 1e-2}))
+    cases.append(case(
+        "test_batchnorm_example", "BatchNormalization",
+        [("x", bx), ("s", bs), ("bias", bb), ("mean", bm), ("var", bv)],
+        [("y", ref_batchnorm(bx, bs, bb, bm, bv))]))
+
+    return cases
+
+
+def main():
+    if os.path.isdir(OUT_DIR):
+        shutil.rmtree(OUT_DIR)
+    cases = build_cases()
+    for name, model, ins, outs in cases:
+        d = os.path.join(OUT_DIR, name)
+        ds = os.path.join(d, "test_data_set_0")
+        os.makedirs(ds)
+        with open(os.path.join(d, "model.onnx"), "wb") as f:
+            f.write(model.SerializeToString())
+        for i, arr in enumerate(ins):
+            t = numpy_helper.from_array(np.asarray(arr), f"input_{i}")
+            with open(os.path.join(ds, f"input_{i}.pb"), "wb") as f:
+                f.write(t.SerializeToString())
+        for i, arr in enumerate(outs):
+            t = numpy_helper.from_array(np.asarray(arr), f"output_{i}")
+            with open(os.path.join(ds, f"output_{i}.pb"), "wb") as f:
+                f.write(t.SerializeToString())
+    print(f"wrote {len(cases)} node cases to {OUT_DIR}")
+
+
+if __name__ == "__main__":
+    main()
