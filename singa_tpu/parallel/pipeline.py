@@ -467,8 +467,9 @@ class _StagePack:
     stage apply — different stages may have entirely different param
     pytrees."""
 
-    def __init__(self, tensors):
+    def __init__(self, tensors, row_dtype=jnp.float32):
         self.tensors = tensors
+        self.row_dtype = jnp.dtype(row_dtype)
         self.shapes = [tuple(t.shape) for t in tensors]
         self.dtypes = [jnp.asarray(t.data).dtype for t in tensors]
         self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
@@ -477,14 +478,14 @@ class _StagePack:
 
     def pack(self):
         if not self.tensors:
-            return jnp.zeros((0,), jnp.float32)
+            return jnp.zeros((0,), self.row_dtype)
         # via host: freshly-initialized params may sit on DIFFERENT
         # device sets (rng-derived ones inherit a mesh-replicated key's
         # devices, zeros-inits sit on the default device) and a device
         # concatenate across those sets is an error. One-time init cost.
         return jnp.asarray(np.concatenate([
             np.asarray(jax.device_get(t.data), np.float32).reshape(-1)
-            for t in self.tensors]))
+            for t in self.tensors])).astype(self.row_dtype)
 
     def unpack_into(self, flat):
         for t, shape, dtype, off, size in zip(
@@ -522,7 +523,7 @@ class HeteroPipeline1F1B(Layer):
     """
 
     def __init__(self, stages, loss_fn, n_micro, axis="pipe",
-                 wire_dtype="float32"):
+                 wire_dtype="float32", param_dtype="float32"):
         super().__init__()
         self._stages = list(stages)   # underscore: NOT sublayers — the
         self._loss_fn = loss_fn       # packed stack is the only state
@@ -530,8 +531,20 @@ class HeteroPipeline1F1B(Layer):
         self.axis = axis
         # "bfloat16" halves the ICI bytes of every activation AND
         # cotangent hop (the pipeline analogue of the 'half' dist
-        # option); params/loss accumulation stay float32
+        # option); loss accumulation stays float32.
+        # NOTE on the wire width: one max-over-boundaries width is a
+        # DESIGN requirement, not laziness — the wire is a single SPMD
+        # array ppermuted around the ring while different members sit at
+        # different boundaries in the same tick, so per-boundary widths
+        # cannot exist without per-member array shapes (not expressible
+        # under shard_map). wire_dtype is the lever that actually
+        # shrinks hop bytes.
         self._wire_dtype = jnp.dtype(wire_dtype)
+        # "bfloat16" also halves the packed param stack's HBM (a
+        # bf16-param model otherwise pays 2x for f32 rows). The rows ARE
+        # the master copy, so optimizer updates quantize to bf16 — the
+        # same trade as bf16 training anywhere else.
+        self._param_dtype = jnp.dtype(param_dtype)
 
     def initialize(self, x, y=None):
         B = x.shape[0]
@@ -558,8 +571,10 @@ class HeteroPipeline1F1B(Layer):
 
         jax.eval_shape(thread, jax.ShapeDtypeStruct(
             (mb,) + tuple(x.shape[1:]), jnp.asarray(x.data).dtype))
-        self._packs = [_StagePack(list(stage.get_params().values()))
-                       if isinstance(stage, Layer) else _StagePack([])
+        self._packs = [_StagePack(list(stage.get_params().values()),
+                                  self._param_dtype)
+                       if isinstance(stage, Layer)
+                       else _StagePack([], self._param_dtype)
                        for stage in self._stages]
         lmax = max([p.size for p in self._packs] + [1])
         rows = [jnp.pad(p.pack(), (0, lmax - p.size))

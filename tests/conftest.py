@@ -28,3 +28,59 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
+
+
+@pytest.fixture
+def training_mode():
+    """Shared tape-mode toggle for tests that record backward: request
+    (or alias with an autouse shim) instead of hand-rolling the
+    save/set/restore dance per module."""
+    from singa_tpu.autograd_base import CTX
+    prev = CTX.training
+    CTX.training = True
+    yield
+    CTX.training = prev
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mode():
+    """Tape mode is process-global; a test that trains and never calls
+    eval() would leak training=True into later tests and silently flip
+    BatchNorm/Dropout semantics there (seen as order-dependent ONNX
+    backend-suite failures). Every test starts in inference mode; tests
+    that train set it themselves (Model.train / the gradcheck
+    fixture)."""
+    from singa_tpu.autograd_base import CTX
+    CTX.training = False
+    yield
+    CTX.training = False
+
+
+# ---------------------------------------------------------------------------
+# Two-tier suite: the default run skips tests marked `slow` so the
+# everyday loop stays fast; `--full` (CI / pre-release) runs everything.
+#   python -m pytest tests/ -q          # fast tier (default)
+#   python -m pytest tests/ -q --full   # entire suite
+# ---------------------------------------------------------------------------
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--full", action="store_true", default=False,
+        help="run the slow tier too (long meshes, example smoke runs, "
+             "multi-process bootstraps)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running case (mesh sweeps, subprocess "
+        "smoke tests); excluded unless --full is given")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--full"):
+        return
+    skip = pytest.mark.skip(
+        reason="slow tier (run with --full)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

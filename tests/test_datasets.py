@@ -241,6 +241,7 @@ def _run_train_cnn(args, timeout=600):
     return proc.stdout
 
 
+@pytest.mark.slow
 class TestNorthStar:
     def test_resnet_cifar10(self, tmp_path):
         """`train_cnn.py resnet cifar10` — the SURVEY north-star —

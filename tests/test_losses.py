@@ -154,6 +154,7 @@ def test_fused_ce_head_layer():
                for k in m.get_params()), sorted(m.get_params())
 
 
+@pytest.mark.slow
 def test_transformer_fused_head_matches_dense():
     """TransformerLM(fused_head_chunk=...) trains on the identical loss
     math as the full-logits path: trajectories match exactly."""
@@ -181,6 +182,7 @@ def test_transformer_fused_head_matches_dense():
     np.testing.assert_allclose(fused, dense, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_transformer_fused_head_direct_call_initializes():
     """train_one_batch without compile() must lazily init the head like
     the dense path does."""

@@ -19,6 +19,7 @@ def t(arr, rg=False):
                   requires_grad=rg)
 
 
+@pytest.mark.slow
 class TestGAN:
     @pytest.mark.parametrize("kind", ["vanilla", "lsgan"])
     def test_adversarial_steps(self, kind):
@@ -101,6 +102,7 @@ class TestRBM:
                                       np.asarray(m.w.data))
 
 
+@pytest.mark.slow
 class TestCharRNN:
     def test_train_loss_decreases(self):
         vocab, steps, bs = 12, 5, 4
@@ -132,6 +134,7 @@ class TestCharRNN:
         assert all(0 <= i < vocab for i in out)
 
 
+@pytest.mark.slow
 class TestQABot:
     @pytest.mark.parametrize("kind", ["lstm", "mean", "max", "mlp"])
     def test_ranking_improves(self, kind):
@@ -174,6 +177,7 @@ class TestZooSmoke:
         assert float(loss2.data) < float(loss1.data) * 1.5  # sane step
 
 
+@pytest.mark.slow
 class TestImageNetZoo:
     """New-in-this-framework native builds of the families the reference
     ships as ONNX zoo examples (examples/onnx/{vgg16,squeezenet,mobilenet,

@@ -53,13 +53,10 @@ class MoENet(model.Model):
 
 class TestDenseMoE:
     @pytest.fixture(autouse=True)
-    def _training(self):
-        from singa_tpu.autograd_base import CTX
-        prev = CTX.training
-        CTX.training = True
-        yield
-        CTX.training = prev
+    def _training(self, training_mode):
+        yield   # shared conftest fixture
 
+    @pytest.mark.slow
     def test_top1_routes_to_best_expert(self):
         """With huge capacity, every token reaches its argmax expert and
         the output equals that expert's FFN weighted by its gate."""
@@ -104,6 +101,7 @@ class TestDenseMoE:
             np.testing.assert_allclose(np.asarray(y.data)[i], want,
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_capacity_drops_overflow_tokens(self):
         """With capacity 1 slot per expert, surplus tokens produce zero
         output rows (GShard token dropping)."""
@@ -175,6 +173,7 @@ class TestExpertParallel:
 
 
 class TestMoETransformer:
+    @pytest.mark.slow
     def test_moe_lm_trains_ep2(self):
         """TransformerLM(moe=4) over a dp4 x ep2 mesh: compiled training
         decreases loss; expert weights carry the 'expert' spec."""
@@ -206,6 +205,7 @@ class TestMoETransformer:
         finally:
             set_mesh(None)
 
+    @pytest.mark.slow
     def test_moe_with_remat_matches(self):
         """MoE blocks under activation checkpointing: the aux losses are
         threaded out of the rematerialized region, and the training

@@ -2,6 +2,7 @@
 CPU mesh. TP training must match single-device training numerically."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -1118,6 +1119,7 @@ class TestHeteroPipeline:
         np.testing.assert_allclose(fused_dist, dense_seq, rtol=1e-3)
 
 
+@pytest.mark.slow
 class TestHeteroPipelineStress:
     """Adversarial coverage for the 1F1B machinery (VERDICT r2 #9):
     RNG-consuming stages, bf16 stages, and pp composed with ep."""

@@ -105,6 +105,7 @@ class TestBf16Training:
         assert rnn.W.dtype == jnp.bfloat16
         assert y.dtype == jnp.bfloat16
 
+    @pytest.mark.slow
     def test_bf16_resnet_block_trains(self):
         """The bench's bf16 mode end-to-end on a small ResNet: conv vjp
         must keep operand dtypes consistent (no preferred_element_type
@@ -140,6 +141,11 @@ class TestBf16Training:
 class TestBroadcastSweep:
     """Binary-op broadcasting across rank/shape combos (reference
     test_operation.py's broadcast sweeps)."""
+
+    @pytest.fixture(autouse=True)
+    def _training(self, training_mode):
+        # backward needs a recorded tape (shared conftest fixture)
+        yield
 
     SHAPES = [
         ((3, 4), (4,)),

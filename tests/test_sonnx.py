@@ -267,6 +267,7 @@ class TestZooExport:
         mp = roundtrip(m, [x], rtol=rtol, atol=1e-5)
         return [n.op_type for n in mp.graph.node]
 
+    @pytest.mark.slow
     def test_squeezenet_roundtrip(self):
         from singa_tpu.models import squeezenet
         m = squeezenet.create_model()

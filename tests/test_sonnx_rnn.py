@@ -330,6 +330,7 @@ class TestOnnxRnnExport:
 
 
 class TestTransformerExport:
+    @pytest.mark.slow
     def test_transformer_lm_roundtrip(self):
         """Flash attention + LayerNorm decompose to primitive ONNX nodes;
         the reimported graph reproduces the logits."""

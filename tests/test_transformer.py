@@ -55,6 +55,7 @@ def train(mesh_config=None, tp=False, seq_axis=None, reduce_axes=None,
 
 
 class TestTransformerLM:
+    @pytest.mark.slow
     def test_eager_trains(self):
         losses = train(dist=False, use_graph=False, steps=6)
         assert losses[-1] < losses[0], losses
@@ -74,6 +75,7 @@ class TestTransformerLM:
                    reduce_axes=("data", "seq"))
         np.testing.assert_allclose(sp, dp, rtol=5e-3)
 
+    @pytest.mark.slow
     def test_sp_ulysses_matches_dp(self):
         """All-to-all sequence parallelism through the full model: one
         head re-shard per attention instead of ring hops; must match the
@@ -83,6 +85,7 @@ class TestTransformerLM:
                    reduce_axes=("data", "seq"), seq_mode="ulysses")
         np.testing.assert_allclose(ul, dp, rtol=5e-3)
 
+    @pytest.mark.slow
     def test_tp_plus_sp(self):
         dp = train(mesh_mod.MeshConfig())
         both = train(mesh_mod.MeshConfig(model=2, seq=2), tp=True,
@@ -118,7 +121,8 @@ class TestVocabParallel:
         assert tuple(m._state_specs[i_emb]) [:1] == ("model",)
         assert tuple(m._state_specs[i_head]) == (None, "model")
 
-    @pytest.mark.parametrize("chunk", [8, 12])
+    @pytest.mark.parametrize("chunk", [
+        8, pytest.param(12, marks=pytest.mark.slow)])
     def test_tp_fused_head_matches_dense_dp(self, chunk):
         # the headline composition: dp×tp mesh, vocab-sharded head, loss
         # through the cross-shard fused CE — must track the dense
@@ -152,6 +156,7 @@ class TestVocabParallel:
         want = np.argmax(np.asarray(logits.data)[:, -1, :], -1)
         np.testing.assert_array_equal(out[:, -1], want)
 
+    @pytest.mark.slow
     def test_save_load_restores_sharded_momentum(self, tmp_path):
         # load_states creates momentum buffers on the fresh optimizer;
         # they must re-announce their param's layout or the next compiled
@@ -280,6 +285,7 @@ class TestGeneration:
         m.eval()
         return m, dev, ids
 
+    @pytest.mark.slow
     def test_greedy_matches_naive_refoward(self):
         m, dev, ids = self._model()
         prompt = ids[:, :5]
@@ -297,6 +303,7 @@ class TestGeneration:
             cur = np.concatenate([cur, nxt[:, None]], 1)
         np.testing.assert_array_equal(out, cur.astype(np.int64))
 
+    @pytest.mark.slow
     def test_moe_greedy_matches_naive_reforward(self):
         # MoE decode routes through the training MoE kernel; with a
         # capacity factor high enough that no token drops, greedy decode
@@ -327,6 +334,7 @@ class TestGeneration:
             cur = np.concatenate([cur, nxt[:, None]], 1)
         np.testing.assert_array_equal(out, cur.astype(np.int64))
 
+    @pytest.mark.slow
     def test_sampling_runs_and_respects_topk(self):
         m, dev, ids = self._model(steps=1)
         out = m.generate(ids[:, :4], 5, temperature=0.8, top_k=3, seed=1)
