@@ -541,9 +541,13 @@ class Model(Layer):
                 placed.append(pa)
             input_arrays = placed
             rng = place(rng, rep)
-        if "avals" not in rec:
+        self._last_run_rec = rec       # compiled_step_info audits this
+        shapes_key = tuple(np.shape(a) for a in input_arrays)
+        if rec.get("avals_key") != shapes_key:
             # abstract signature of this step (shardings included) for
-            # compiled_step_info()'s lower-without-rerun audit
+            # compiled_step_info()'s lower-without-rerun audit; refreshed
+            # when input shapes change (jit retraces under the same rec,
+            # and the audit must describe the executable that just ran)
             def _aval(a):
                 return jax.ShapeDtypeStruct(
                     np.shape(a), np.asarray(a).dtype if not hasattr(
@@ -551,6 +555,8 @@ class Model(Layer):
                     sharding=getattr(a, "sharding", None))
             rec["avals"] = ([_aval(a) for a in state_arrays], _aval(rng),
                             [_aval(a) for a in input_arrays])
+            rec["avals_key"] = shapes_key
+            rec.pop("audit_compiled", None)
         if self.dev.verbosity >= 2 and "cost" not in rec:
             # one-time XLA cost analysis of this step signature (the
             # compiled-world per-op metric: flops / bytes, reference
@@ -935,10 +941,15 @@ class Model(Layer):
         Requires one compiled step to have run. No reference
         counterpart (closest: Graph::Debug's node dump).
         """
-        rec = None
-        for r in self._steps.values():
-            if r.get("jit") is not None and "avals" in r:
-                rec = r
+        # audit the signature that actually RAN last (a one-off
+        # odd-shaped batch must not hijack the audit away from the main
+        # training signature); fall back to any compiled rec
+        rec = getattr(self, "_last_run_rec", None)
+        if rec is None or rec.get("jit") is None or "avals" not in rec:
+            rec = None
+            for r in self._steps.values():
+                if r.get("jit") is not None and "avals" in r:
+                    rec = r
         if rec is None:
             raise RuntimeError(
                 "compiled_step_info() needs a compiled step: run one "

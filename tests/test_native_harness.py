@@ -1,0 +1,31 @@
+"""Drives the C-level assert harness (native/test_native.cc) — the tier
+the reference covers with gtest (test/singa/*.cc): record-file
+truncation/magic/prefetch edge cases and the TCP endpoint state machine
+under byte-dribbled partial frames, oversized-frame violations,
+multi-MB short-read reassembly, ACK drains, and shutdown with blocked
+waiters. `make test_native` is incremental, so repeat runs only pay the
+~2s execution."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or
+                    shutil.which("make") is None,
+                    reason="no C++ toolchain in this environment")
+def test_native_c_harness(tmp_path):
+    build = subprocess.run(["make", "-C", NATIVE, "test_native"],
+                           capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ, TEST_TMPDIR=str(tmp_path))
+    run = subprocess.run([os.path.join(NATIVE, "test_native")],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "ALL NATIVE TESTS PASSED" in run.stdout
