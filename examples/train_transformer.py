@@ -30,6 +30,14 @@ def main():
                     help="expert-parallel degree (with --moe)")
     ap.add_argument("--moe", type=int, default=0,
                     help="experts per block (0 = dense FFN)")
+    ap.add_argument("--fused-head-chunk", type=int, default=0,
+                    help="train through the chunked fused CE head: the "
+                         "(B,S,V) logits are never materialised; under "
+                         "--tp the loss reduces across vocab shards "
+                         "online (per-rank head memory V/tp)")
+    ap.add_argument("--generate", type=int, default=0,
+                    help="after training, decode N tokens greedily from "
+                         "the first batch row (KV-cache scan)")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -56,9 +64,11 @@ def main():
 
     model = transformer.TransformerLM(
         args.vocab, d_model=args.d_model, n_heads=args.heads,
-        n_layers=args.layers, max_len=args.seq,
+        n_layers=args.layers,
+        max_len=args.seq + args.generate,
         seq_axis="seq" if args.sp > 1 else None,
-        moe=args.moe or None)
+        moe=args.moe or None, tp=args.tp > 1,
+        fused_head_chunk=args.fused_head_chunk or None)
     dist = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
                        reduce_axes=("data", "expert", "seq"))
     msh = mesh_mod.make_mesh(
@@ -87,6 +97,11 @@ def main():
             print(f"step {step}: loss {float(loss.data):.4f}")
     toks = args.bs * args.seq * args.steps / (time.time() - t0)
     print(f"throughput {toks:.0f} tokens/s")
+
+    if args.generate:
+        out = model.generate(ids[:1], max_new_tokens=args.generate,
+                             temperature=0)   # first row only
+        print("generated:", out[0, -args.generate:].tolist())
 
 
 if __name__ == "__main__":

@@ -58,6 +58,19 @@ class TestExamples:
                            "--heads", "2", "--layers", "1", "--bs", "8"])
         assert "loss" in out.lower(), out[-500:]
 
+    def test_train_transformer_fused_tp_generate(self):
+        # the round's headline path end-to-end as a user would run it:
+        # vocab-sharded head + cross-shard fused CE under tp, then a
+        # greedy KV-cache decode off the sharded trained state
+        out = run_example(["examples/train_transformer.py", "--cpu",
+                           "--steps", "2", "--seq", "16", "--d-model",
+                           "32", "--heads", "2", "--layers", "1",
+                           "--bs", "8", "--tp", "2", "--vocab", "64",
+                           "--fused-head-chunk", "16",
+                           "--generate", "4"])
+        assert "loss" in out.lower(), out[-500:]
+        assert "generated:" in out, out[-500:]
+
     def test_train_gan(self):
         out = run_example(["examples/train_gan.py", "vanilla", "--cpu",
                            "--iters", "2", "--bs", "8"])
