@@ -14,6 +14,13 @@ from .rnn import CudnnRNNHandle, _RNN, rnn_op
 from .attention import (flash_attention, ring_attention, attention,
                         _FlashAttention, _RingAttention)
 
+# the `attention` FUNCTION re-export above shadows the submodule
+# attribute (`singa_tpu.ops.attention` resolves to the function); this
+# alias gives module-level consumers (kernels knobs, FORCE_PALLAS_INTERPRET)
+# a non-colliding handle
+import sys as _sys
+attention_mod = _sys.modules[__name__ + ".attention"]
+
 __all__ = [
     "ConvHandle", "_Conv2d", "conv2d",
     "BatchNormHandle", "_BatchNorm2d", "batchnorm_2d",

@@ -193,6 +193,13 @@ FORCE_PALLAS_INTERPRET = False
 
 _DECLINE_LOGGED = set()
 
+# Mosaic requires the last two dims of every block to be (8k, 128k) or
+# equal to the array's dims, so per-row statistics (m/l/lse/delta) are
+# carried lane-broadcast at this width — the same layout the canonical
+# TPU flash kernels use. Interpreter mode never enforced this; the real
+# chip does.
+_LANES = 128
+
 
 def _use_pallas(q, k, block_q, block_k):
     if not HAS_PALLAS:
@@ -266,17 +273,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 q_pos = q_pos + offset_ref[0, 0]
             mask = k_pos <= q_pos
             s = jnp.where(mask, s, _NEG_INF)
+        # m/l live lane-broadcast as (block_q, _LANES); every lane of a
+        # row holds the same scalar
         m = m_ref[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])
         if mask is not None and offset_ref is not None:
             # a FULLY-masked row has m_new == _NEG_INF (finite), making
             # exp(s - m_new) == 1 on masked entries — zero them explicitly
             # (offset grids are not pruned, so such blocks do occur)
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m - m_new)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jnp.dot(
             p, vblk, preferred_element_type=jnp.float32)
         m_ref[...] = m_new
 
@@ -287,7 +297,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(kj == last)
     def _write():
         l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[...] / l[:, :1]).astype(o_ref.dtype)
         lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
@@ -313,9 +323,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         if causal:
             q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jnp.dot(g, vblk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
         acc_ref[...] += jnp.dot(ds, kblk,
                                 preferred_element_type=jnp.float32)
 
@@ -350,9 +360,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         if causal:
             q_pos, k_pos = _causal_positions(qi, kj, block_q, block_k)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jnp.dot(g, vblk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
         dk_acc[...] += jnp.dot(ds.T, q,
                                preferred_element_type=jnp.float32)
         dv_acc[...] += jnp.dot(p.T, g,
@@ -412,20 +422,20 @@ def _pallas_flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(*operands)
-    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+    return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
 
 
 def _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale,
@@ -441,12 +451,16 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale,
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
     gr = g.reshape(B * H, Sq, D)
-    lser = lse.reshape(B * H, Sq)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(B * H, Sq)
+    # row stats enter the kernels lane-broadcast (see _LANES)
+    lser = jnp.broadcast_to(lse.reshape(B * H, Sq)[..., None],
+                            (B * H, Sq, _LANES))
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1).reshape(B * H, Sq)[..., None],
+        (B * H, Sq, _LANES))
 
     qspec = pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0))
-    rowspec = pl.BlockSpec((1, block_q), lambda b, x, y: (b, x))
+    rowspec = pl.BlockSpec((1, block_q, _LANES), lambda b, x, y: (b, x, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k, nkb=nkb),
@@ -472,8 +486,8 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale,
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
             kvspec, kvspec,
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[kvspec, kvspec],
         out_shape=[

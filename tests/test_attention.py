@@ -11,9 +11,7 @@ try:
 except ImportError:
     from jax.experimental.shard_map import shard_map
 
-import importlib
-
-ATTN = importlib.import_module("singa_tpu.ops.attention")
+from singa_tpu.ops import attention_mod as ATTN
 from singa_tpu.ops.attention import (flash_attention, ring_attention,
                                      attention)
 from singa_tpu import autograd
