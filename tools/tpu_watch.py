@@ -31,6 +31,17 @@ def log(msg):
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+def _complete_bench(o):
+    """True only for a COMPLETE honest benchmark: a salvaged partial
+    (fp32 leg only) must keep the watcher on the fast probe cadence so
+    the missing legs still get measured in the next window."""
+    return (o.get("event") == "bench"
+            and o.get("platform") not in (None, "cpu")
+            and o.get("timing") == "slope-readback"
+            and not o.get("partial") and not o.get("partial_timeout")
+            and not o.get("partial_crash"))
+
+
 def main():
     deadline = time.time() + MAX_HOURS * 3600
     banked = False
@@ -42,9 +53,7 @@ def main():
         log("opened a new round window")
     else:
         log("recent round window found; resuming it")
-        banked = any(o.get("event") == "bench"
-                     and o.get("platform") not in (None, "cpu")
-                     for o in bench._load_obs())
+        banked = any(_complete_bench(o) for o in bench._load_obs())
     log(f"watching for TPU windows (max {MAX_HOURS}h, "
         f"idle interval {IDLE_SLEEP}s)")
     while time.time() < deadline:
@@ -68,13 +77,15 @@ def main():
                 for rec in smoke:
                     bench._record_obs("smoke", rec)
                 log(f"smoke: {len(smoke)} sub-results banked")
-                res, aerr = bench._attempt("tpu", 900)
+                res, aerr = bench._attempt("tpu", 1500)
                 if res is not None:
                     bench._record_obs("bench", res)
                     thr = res.get("throughput")
-                    log(f"FULL BENCH BANKED: {thr} img/s on "
-                        f"{res.get('device_kind')}")
-                    banked = True
+                    log(f"BENCH BANKED: {thr} img/s on "
+                        f"{res.get('device_kind')} "
+                        f"(partial={bool(res.get('partial_timeout') or res.get('partial_crash') or res.get('partial'))})")
+                    banked = _complete_bench(dict(res, event="bench",
+                                                  platform=res.get("platform")))
                 else:
                     log(f"full bench attempt failed: {aerr}")
         time.sleep(BANKED_SLEEP if banked else IDLE_SLEEP)
