@@ -1,0 +1,2 @@
+
+Boutput_0J0>S#q?>?o#)jF*>%NXY?

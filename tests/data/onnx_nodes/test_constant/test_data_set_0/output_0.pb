@@ -1,0 +1,2 @@
+
+Boutput_0JøKõ>	7G¿¤NÞ?w)¹¿q•Ê¿çu?

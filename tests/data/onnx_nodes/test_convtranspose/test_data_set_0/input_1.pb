@@ -1,0 +1,2 @@
+
+Binput_1JHt?PӿT>ʿ(.X?A?>J>{@0GϿ?Cj?ȥi*q`?;鿳nξ

@@ -1,0 +1,3 @@
+
+Binput_0JÀ’Œ£>ÿ¾žu¿+™¬¿ó˜Í¾Üï¾@I?7§¾K?ø:¿âƒ¾#3²¾4IH¿Ç ?ÔGP¿LŠ¿Á¿•½‰¦¿à]¦¾,6¿%¼Æ¾wu½$ÇL¿Œ[a¾u‚§?WÓ¼ó—’?¸g±>d/F?ñBF¿‘ÙÖ=x 	>
+Õ¿á¤R¿Á¾¿¿?eïx¿ùP¬?D[ï¾\Ì\¿j]?Ì•!¿††?Nfª¾3úõ>Ûw¿lÓT?Ž×ù>

@@ -1,0 +1,3 @@
+
+Boutput_0JÀ’Œ£>4IH¿ÿ¾Ç ?žu¿ÔGP¿u‚§?eïx¿WÓ¼ùP¬?ó—’?D[ï¾+™¬¿LŠ¿ó˜Í¾Á¿•½Üï¾‰¦¿¸g±>\Ì\¿d/F?j]?ñBF¿Ì•!¿@I?à]¦¾7§¾,6¿K?%¼Æ¾‘ÙÖ=††?x 	>Nfª¾
+Õ¿3úõ>ø:¿wu½âƒ¾$ÇL¿#3²¾Œ[a¾á¤R¿Ûw¿Á¾¿lÓT?¿?Ž×ù>

@@ -1,0 +1,3 @@
+
+Binput_0J„
+?Q®½v¿

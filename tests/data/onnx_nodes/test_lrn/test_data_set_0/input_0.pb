@@ -1,0 +1,5 @@
+
+Binput_0Jf`Cd1>	?>$Y><>0?"7L=p?^h?y+@My=wc>
+Hƨ?*
+us|'齜X<C-> /Te;_?)=I>4?dタ
+Z?Ͻњe_Q?s>=

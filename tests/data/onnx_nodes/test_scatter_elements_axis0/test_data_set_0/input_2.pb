@@ -1,0 +1,2 @@
+
+Binput_2Jm5?óà@bFX¾Á—ö½:Z½¿O	ª¾

@@ -1,0 +1,2 @@
+
+Boutput_0J$Á—ö½óà@Ið¾m5?¨½®¿O	ª¾Iâõ¾:Z½¿bFX¾

@@ -1,0 +1,2 @@
+
+Binput_2Jd d>SRg#=X>t>b>7?*[[q0>uO	ξ@Y>?|$#>pb?:8پ

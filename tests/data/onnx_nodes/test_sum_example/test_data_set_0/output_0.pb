@@ -1,0 +1,2 @@
+
+Boutput_0J0)o@b>ܮ.@w?:?Y?-l@W?D

@@ -1,0 +1,2 @@
+
+Binput_0JäÍ? Ã¿È>T> >

@@ -1,0 +1,159 @@
+"""Unit tests for bench.py's window-salvage selection logic.
+
+The round's only perf evidence can ride on these few functions (the TPU
+tunnel opens rarely and drops mid-benchmark), so the partial-vs-complete
+and banked-vs-live preferences are pinned here hermetically — no
+hardware, no subprocesses.
+"""
+
+import json
+import time
+
+import bench
+
+
+def _ts(age_s=0):
+    return time.strftime("%Y-%m-%dT%H:%M:%S",
+                         time.localtime(time.time() - age_s))
+
+
+def _bench_rec(age_s=0, timing="slope-readback", **extra):
+    rec = {"event": "bench", "ts": _ts(age_s), "platform": "tpu",
+           "device_kind": "TPU v5 lite", "throughput": 1000.0,
+           "step_ms": 32.0, "timing": timing}
+    rec.update(extra)
+    return rec
+
+
+MAX_AGE = 14 * 3600
+
+
+def test_last_result_line_picks_newest_and_stamps_marker():
+    out = "\n".join([
+        "garbage not json",
+        json.dumps({"smoke": "device"}),
+        json.dumps({"throughput": 1.0, "partial": "fp32"}),
+        json.dumps({"throughput": 2.0, "partial": "bf16"}),
+    ])
+    res = bench._last_result_line(out, "partial_timeout", "killed")
+    assert res["throughput"] == 2.0
+    assert res["partial_timeout"] == "killed"
+    assert bench._last_result_line("no json here") is None
+
+
+def test_is_complete_and_n_legs():
+    full = _bench_rec(bf16_throughput=2000.0, lm_tokens_per_sec=1e5)
+    assert bench._is_complete(full) and bench._n_legs(full) == 3
+    part = _bench_rec(partial_timeout="killed after 600s")
+    assert not bench._is_complete(part) and bench._n_legs(part) == 1
+    # progress-line marker counts as partial too
+    assert not bench._is_complete(_bench_rec(partial="fp32"))
+
+
+def test_live_complete_result_passes_through():
+    live = _bench_rec(bf16_throughput=2000.0)
+    res, is_live = bench._fold_banked(live, [], MAX_AGE, [])
+    assert res is live and is_live
+
+
+def test_banked_complete_reported_when_tunnel_down():
+    banked = _bench_rec(age_s=3600)
+    res, is_live = bench._fold_banked(None, [banked], MAX_AGE, [])
+    assert not is_live
+    assert res["measured_at"] == banked["ts"]
+    assert res["throughput"] == 1000.0
+
+
+def test_complete_banked_beats_newer_partial():
+    complete = _bench_rec(age_s=7200, bf16_throughput=2000.0,
+                          lm_tokens_per_sec=1e5)
+    partial = _bench_rec(age_s=60, partial_timeout="killed after 600s")
+    res, is_live = bench._fold_banked(None, [complete, partial],
+                                      MAX_AGE, [])
+    assert res["measured_at"] == complete["ts"]
+    assert res["lm_tokens_per_sec"] == 1e5
+
+
+def test_live_partial_loses_to_banked_complete():
+    complete = _bench_rec(age_s=7200, bf16_throughput=2000.0)
+    live_partial = _bench_rec(partial_crash="child rc=1")
+    errors = []
+    res, is_live = bench._fold_banked(
+        live_partial, [complete, live_partial], MAX_AGE, errors)
+    assert not is_live
+    assert res["measured_at"] == complete["ts"]
+    assert any("live run was partial" in e for e in errors)
+
+
+def test_live_partial_kept_when_nothing_complete_banked():
+    live_partial = _bench_rec(partial_timeout="killed after 1500s")
+    res, is_live = bench._fold_banked(live_partial, [live_partial],
+                                      MAX_AGE, [])
+    assert is_live and res is live_partial
+
+
+def test_honest_timing_preferred_over_suspect():
+    suspect = _bench_rec(age_s=7200, timing="block_until_ready",
+                         bf16_throughput=9999.0)
+    honest_partial = _bench_rec(age_s=60,
+                                partial_timeout="killed after 600s")
+    res, _ = bench._fold_banked(None, [suspect, honest_partial],
+                                MAX_AGE, [])
+    assert res["timing"] == "slope-readback"
+    assert "timing_suspect" not in res
+
+
+def test_suspect_record_carried_with_marker_as_last_resort():
+    suspect = _bench_rec(age_s=7200, timing="block_until_ready")
+    res, _ = bench._fold_banked(None, [suspect], MAX_AGE, [])
+    assert "timing_suspect" in res
+
+
+def test_age_cap_excludes_stale_records():
+    stale = _bench_rec(age_s=MAX_AGE + 3600)
+    res, _ = bench._fold_banked(None, [stale], MAX_AGE, [])
+    assert res is None
+
+
+def test_tpu_phase_partial_does_not_cancel_retry(monkeypatch):
+    """Attempt 1 salvages a partial; attempt 2 (warm compile cache) must
+    still run — and its complete result wins."""
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("ok", None))
+    monkeypatch.setattr(bench, "_attempt_smoke", lambda t: [])
+    monkeypatch.setattr(bench, "_record_obs", lambda *a, **k: None)
+    partial = _bench_rec(partial_timeout="killed after 1500s")
+    full = _bench_rec(bf16_throughput=2000.0, lm_tokens_per_sec=1e5)
+    attempts = iter([(partial, None), (full, None)])
+    monkeypatch.setattr(bench, "_attempt",
+                        lambda p, t: next(attempts))
+    errors = []
+    res, _ = bench._tpu_phase(errors)
+    assert res is full
+    assert any("tpu#1" in e for e in errors)
+
+
+def test_tpu_phase_keeps_best_partial_when_no_attempt_completes(
+        monkeypatch):
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("ok", None))
+    monkeypatch.setattr(bench, "_attempt_smoke", lambda t: [])
+    monkeypatch.setattr(bench, "_record_obs", lambda *a, **k: None)
+    one_leg = _bench_rec(partial_timeout="killed after 1500s")
+    two_leg = _bench_rec(bf16_throughput=2000.0,
+                         partial_crash="child rc=1")
+    attempts = iter([(two_leg, None), (one_leg, None)])
+    monkeypatch.setattr(bench, "_attempt", lambda p, t: next(attempts))
+    res, _ = bench._tpu_phase([])
+    assert res is two_leg   # more legs wins over recency
+
+
+def test_banked_partial_with_more_legs_beats_newer_live_partial():
+    """Mirror of _tpu_phase's best-partial rule in the banked pool:
+    a 2-leg partial banked earlier must not be shadowed by a newer
+    1-leg live partial."""
+    two_leg = _bench_rec(age_s=3600, bf16_throughput=2000.0,
+                         partial_timeout="killed after 1500s")
+    one_leg_live = _bench_rec(partial_crash="child rc=1")
+    res, is_live = bench._fold_banked(
+        one_leg_live, [two_leg, one_leg_live], MAX_AGE, [])
+    assert not is_live
+    assert res["bf16_throughput"] == 2000.0

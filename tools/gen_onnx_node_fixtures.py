@@ -111,6 +111,119 @@ def ref_batchnorm(x, s, bias, mean, var, eps=1e-5):
             * s.reshape(shp) + bias.reshape(shp)).astype(np.float32)
 
 
+def ref_conv_transpose2d(x, w, strides=(1, 1)):
+    """ONNX ConvTranspose, no pads/dilation; w is (C, M, kh, kw)."""
+    N, C, H, W = x.shape
+    _, M, kh, kw = w.shape
+    oh = (H - 1) * strides[0] + kh
+    ow = (W - 1) * strides[1] + kw
+    out = np.zeros((N, M, oh, ow), np.float32)
+    for n in range(N):
+        for c in range(C):
+            for i in range(H):
+                for j in range(W):
+                    out[n, :, i * strides[0]:i * strides[0] + kh,
+                        j * strides[1]:j * strides[1] + kw] += \
+                        x[n, c, i, j] * w[c]
+    return out
+
+
+def ref_lrn(x, size, alpha, beta, bias):
+    C = x.shape[1]
+    half_lo = (size - 1) // 2
+    half_hi = size // 2
+    sq = np.zeros_like(x)
+    for c in range(C):
+        lo, hi = max(0, c - half_lo), min(C - 1, c + half_hi)
+        sq[:, c] = (x[:, lo:hi + 1] ** 2).sum(axis=1)
+    return (x / (bias + (alpha / size) * sq) ** beta).astype(np.float32)
+
+
+def ref_depth_to_space(x, bs):
+    b, c, h, w = x.shape
+    t = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    return t.transpose(0, 3, 4, 1, 5, 2).reshape(
+        b, c // (bs * bs), h * bs, w * bs).copy()
+
+
+def ref_space_to_depth(x, bs):
+    b, c, h, w = x.shape
+    t = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    return t.transpose(0, 3, 5, 1, 2, 4).reshape(
+        b, c * bs * bs, h // bs, w // bs).copy()
+
+
+def ref_scatter_elements(data, indices, updates, axis):
+    out = data.copy()
+    for idx in np.ndindex(*indices.shape):
+        tgt = list(idx)
+        tgt[axis] = indices[idx]
+        out[tuple(tgt)] = updates[idx]
+    return out
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def ref_rnn(X, W, R, B, H):
+    """ONNX RNN (tanh, forward). X (T,Bz,I); W (1,H,I); R (1,H,H);
+    B (1,2H). Returns Y (T,1,Bz,H), Y_h (1,Bz,H)."""
+    T, Bz, _ = X.shape
+    Wb, Rb = B[0, :H], B[0, H:]
+    h = np.zeros((Bz, H), np.float32)
+    Y = np.zeros((T, 1, Bz, H), np.float32)
+    for t in range(T):
+        h = np.tanh(X[t] @ W[0].T + h @ R[0].T + Wb + Rb)
+        Y[t, 0] = h
+    return Y.astype(np.float32), h[None].astype(np.float32)
+
+
+def ref_gru(X, W, R, B, H):
+    """ONNX GRU (forward, linear_before_reset=0, zrh gate order)."""
+    T, Bz, _ = X.shape
+    Wz, Wr, Wh = W[0, :H], W[0, H:2 * H], W[0, 2 * H:]
+    Rz, Rr, Rh = R[0, :H], R[0, H:2 * H], R[0, 2 * H:]
+    Wbz, Wbr, Wbh = B[0, :H], B[0, H:2 * H], B[0, 2 * H:3 * H]
+    Rbz, Rbr, Rbh = (B[0, 3 * H:4 * H], B[0, 4 * H:5 * H],
+                     B[0, 5 * H:6 * H])
+    h = np.zeros((Bz, H), np.float32)
+    Y = np.zeros((T, 1, Bz, H), np.float32)
+    for t in range(T):
+        z = _sig(X[t] @ Wz.T + h @ Rz.T + Wbz + Rbz)
+        r = _sig(X[t] @ Wr.T + h @ Rr.T + Wbr + Rbr)
+        htil = np.tanh(X[t] @ Wh.T + (r * h) @ Rh.T + Wbh + Rbh)
+        h = (1 - z) * htil + z * h
+        Y[t, 0] = h
+    return Y.astype(np.float32), h[None].astype(np.float32)
+
+
+def ref_lstm(X, W, R, B, H):
+    """ONNX LSTM (forward, iofc gate order, no peepholes)."""
+    T, Bz, _ = X.shape
+    Wi, Wo, Wf, Wc = (W[0, :H], W[0, H:2 * H], W[0, 2 * H:3 * H],
+                      W[0, 3 * H:])
+    Ri, Ro, Rf, Rc = (R[0, :H], R[0, H:2 * H], R[0, 2 * H:3 * H],
+                      R[0, 3 * H:])
+    bi = B[0, 0 * H:1 * H] + B[0, 4 * H:5 * H]
+    bo = B[0, 1 * H:2 * H] + B[0, 5 * H:6 * H]
+    bf = B[0, 2 * H:3 * H] + B[0, 6 * H:7 * H]
+    bc = B[0, 3 * H:4 * H] + B[0, 7 * H:8 * H]
+    h = np.zeros((Bz, H), np.float32)
+    c = np.zeros((Bz, H), np.float32)
+    Y = np.zeros((T, 1, Bz, H), np.float32)
+    for t in range(T):
+        i = _sig(X[t] @ Wi.T + h @ Ri.T + bi)
+        o = _sig(X[t] @ Wo.T + h @ Ro.T + bo)
+        f = _sig(X[t] @ Wf.T + h @ Rf.T + bf)
+        ct = np.tanh(X[t] @ Wc.T + h @ Rc.T + bc)
+        c = f * c + i * ct
+        h = o * np.tanh(c)
+        Y[t, 0] = h
+    return (Y.astype(np.float32), h[None].astype(np.float32),
+            c[None].astype(np.float32))
+
+
 def build_cases():
     rng = np.random.RandomState(0)
 
@@ -281,6 +394,225 @@ def build_cases():
         "test_batchnorm_example", "BatchNormalization",
         [("x", bx), ("s", bs), ("bias", bb), ("mean", bm), ("var", bv)],
         [("y", ref_batchnorm(bx, bs, bb, bm, bv))]))
+
+    # -- trig / inverse-trig / hyperbolic -------------------------------
+    # |x| < 1 for asin/acos/atanh (uniform draw — randn is unbounded)
+    xu = (rng.rand(3, 4) * 1.8 - 0.9).astype(np.float32)
+    xg1 = np.abs(r(3, 4)) + 1.1             # x > 1 for acosh
+    for name, op, inp, fn in [
+        ("test_cos", "Cos", x, np.cos), ("test_sin", "Sin", x, np.sin),
+        ("test_tan", "Tan", xu, np.tan),
+        ("test_cosh", "Cosh", x, np.cosh),
+        ("test_sinh", "Sinh", x, np.sinh),
+        ("test_acos", "Acos", xu, np.arccos),
+        ("test_asin", "Asin", xu, np.arcsin),
+        ("test_atan", "Atan", x, np.arctan),
+        ("test_acosh", "Acosh", xg1, np.arccosh),
+        ("test_asinh", "Asinh", x, np.arcsinh),
+        ("test_atanh", "Atanh", xu, np.arctanh),
+        ("test_softsign", "Softsign", x, lambda v: v / (1 + np.abs(v))),
+    ]:
+        cases.append(case(name, op, [("x", inp)],
+                          [("y", fn(inp).astype(np.float32))]))
+    cases.append(case(
+        "test_hardsigmoid", "HardSigmoid", [("x", x)],
+        [("y", np.clip(0.5 * x + 0.6, 0, 1).astype(np.float32))],
+        {"alpha": 0.5, "beta": 0.6}))
+    cases.append(case("test_identity", "Identity", [("x", x)],
+                      [("y", x)]))
+    pr_s = np.abs(r(5)).astype(np.float32)
+    cases.append(case(
+        "test_prelu_broadcast", "PRelu", [("x", x), ("slope", pr_s)],
+        [("y", np.where(x > 0, x, pr_s * x).astype(np.float32))]))
+
+    # -- logical / comparison ------------------------------------------
+    ba = rng.rand(3, 4) > 0.5
+    bb = rng.rand(3, 4) > 0.5
+    for name, op, fn in [("test_and2d", "And", np.logical_and),
+                         ("test_or2d", "Or", np.logical_or),
+                         ("test_xor2d", "Xor", np.logical_xor)]:
+        cases.append(case(name, op, [("a", ba), ("b", bb)],
+                          [("y", fn(ba, bb))]))
+    cases.append(case("test_not_2d", "Not", [("x", ba)],
+                      [("y", np.logical_not(ba))]))
+    ia = np.round(r(3, 4) * 2).astype(np.float32)
+    ib = np.round(r(3, 4) * 2).astype(np.float32)
+    cases.append(case("test_equal", "Equal", [("a", ia), ("b", ib)],
+                      [("y", ia == ib)]))
+    cases.append(case("test_greater", "Greater", [("a", a), ("b", b)],
+                      [("y", a > b)]))
+    cases.append(case("test_less", "Less", [("a", a), ("b", b)],
+                      [("y", a < b)]))
+
+    # -- variadic math --------------------------------------------------
+    v1, v2, v3 = r(3, 4), r(3, 4), r(3, 4)
+    for name, op, out in [
+        ("test_max_example", "Max", np.maximum(np.maximum(v1, v2), v3)),
+        ("test_min_example", "Min", np.minimum(np.minimum(v1, v2), v3)),
+        ("test_sum_example", "Sum", v1 + v2 + v3),
+        ("test_mean_example", "Mean", (v1 + v2 + v3) / 3.0),
+    ]:
+        cases.append(case(name, op,
+                          [("a", v1), ("b", v2), ("c", v3)],
+                          [("y", out.astype(np.float32))]))
+
+    # -- tensor introspection / selection ------------------------------
+    cases.append(case("test_shape", "Shape", [("x", r(3, 4, 5))],
+                      [("y", np.array([3, 4, 5], np.int64))]))
+    wc = r(3, 4) > 0
+    wa, wb = r(3, 4), r(3, 4)
+    cases.append(case("test_where_example", "Where",
+                      [("c", wc), ("a", wa), ("b", wb)],
+                      [("y", np.where(wc, wa, wb).astype(np.float32))]))
+    nz = np.array([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]], np.float32)
+    cases.append(case("test_nonzero_example", "NonZero", [("x", nz)],
+                      [("y", np.array(np.nonzero(nz), np.int64))]))
+    cst = r(2, 3) * 3
+    cases.append(case("test_cast_float_to_int32", "Cast", [("x", cst)],
+                      [("y", cst.astype(np.int32))],
+                      {"to": int(TensorProto.INT32)}))
+    cval = r(2, 3)
+    cases.append(case(
+        "test_constant", "Constant", [],
+        [("y", cval)],
+        {"value": numpy_helper.from_array(cval, "const_value")}))
+    cases.append(case(
+        "test_constantofshape_float_ones", "ConstantOfShape",
+        [("shape", np.array([3, 2], np.int64))],
+        [("y", np.full((3, 2), 1.0, np.float32))],
+        {"value": helper.make_tensor("value", TensorProto.FLOAT, [1],
+                                     [1.0])}))
+    oh_idx = np.array([0, 2, 1, 1], np.int64)
+    oh_out = np.full((4, 3), 0.5, np.float32)
+    oh_out[np.arange(4), oh_idx] = 2.0
+    cases.append(case(
+        "test_onehot_with_axis", "OneHot",
+        [("idx", oh_idx), ("depth", np.array([3], np.int64)),
+         ("values", np.array([0.5, 2.0], np.float32))],
+        [("y", oh_out)], {"axis": -1}))
+
+    # -- shape manipulation (attribute-as-input ops) --------------------
+    sl = r(5, 6)
+    cases.append(case(
+        "test_slice_with_steps", "Slice",
+        [("x", sl), ("starts", np.array([1, 0], np.int64)),
+         ("ends", np.array([4, 5], np.int64)),
+         ("axes", np.array([0, 1], np.int64)),
+         ("steps", np.array([2, 2], np.int64))],
+        [("y", sl[1:4:2, 0:5:2].copy())]))
+    sp = r(2, 6)
+    cases.append(case(
+        "test_split_variable_parts_1d", "Split", [("x", sp)],
+        [("y0", sp[:, :2].copy()), ("y1", sp[:, 2:].copy())],
+        {"axis": 1, "split": [2, 4]}))
+    ex = r(3, 1)
+    cases.append(case(
+        "test_expand_dim_changed", "Expand",
+        [("x", ex), ("shape", np.array([2, 3, 4], np.int64))],
+        [("y", np.broadcast_to(ex, (2, 3, 4)).astype(np.float32)
+          .copy())]))
+    tl = r(2, 3)
+    cases.append(case(
+        "test_tile", "Tile",
+        [("x", tl), ("repeats", np.array([2, 2], np.int64))],
+        [("y", np.tile(tl, (2, 2)))]))
+    pd = r(2, 3)
+    cases.append(case(
+        "test_pad_constant", "Pad",
+        [("x", pd), ("pads", np.array([0, 1, 0, 2], np.int64)),
+         ("cval", np.float32(0.5))],
+        [("y", np.pad(pd, ((0, 0), (1, 2)), constant_values=0.5))],
+        {"mode": "constant"}))
+    up = r(1, 1, 2, 2)
+    cases.append(case(
+        "test_upsample_nearest", "Upsample",
+        [("x", up), ("scales", np.array([1, 1, 2, 3], np.float32))],
+        [("y", up.repeat(2, axis=2).repeat(3, axis=3))], opset=9))
+    rz = r(1, 1, 2, 2)
+    cases.append(case(
+        "test_resize_upsample_scales_nearest", "Resize",
+        [("x", rz), ("roi", np.array([], np.float32)),
+         ("scales", np.array([1, 1, 2, 2], np.float32))],
+        [("y", rz.repeat(2, axis=2).repeat(2, axis=3))],
+        {"mode": "nearest"}))
+    d2s = r(1, 8, 2, 3)
+    cases.append(case(
+        "test_depthtospace_dcr", "DepthToSpace", [("x", d2s)],
+        [("y", ref_depth_to_space(d2s, 2))], {"blocksize": 2}))
+    s2d = r(1, 2, 4, 6)
+    cases.append(case(
+        "test_spacetodepth", "SpaceToDepth", [("x", s2d)],
+        [("y", ref_space_to_depth(s2d, 2))], {"blocksize": 2}))
+    sc_d = r(3, 3)
+    sc_i = np.array([[1, 0, 2], [0, 2, 1]], np.int64)
+    sc_u = r(2, 3)
+    cases.append(case(
+        "test_scatter_elements_axis0", "ScatterElements",
+        [("data", sc_d), ("indices", sc_i), ("updates", sc_u)],
+        [("y", ref_scatter_elements(sc_d, sc_i, sc_u, 0))], {"axis": 0}))
+
+    # -- reductions with explicit axes ----------------------------------
+    rda = r(3, 2, 4)
+    cases.append(case(
+        "test_reduce_mean_keepdims0", "ReduceMean", [("x", rda)],
+        [("y", rda.mean(axis=1).astype(np.float32))],
+        {"axes": [1], "keepdims": 0}))
+    cases.append(case(
+        "test_reduce_sum_axes02", "ReduceSum", [("x", rda)],
+        [("y", rda.sum(axis=(0, 2), keepdims=True).astype(np.float32))],
+        {"axes": [0, 2], "keepdims": 1}))
+    trp = r(2, 3, 4)
+    cases.append(case(
+        "test_transpose_perm", "Transpose", [("x", trp)],
+        [("y", trp.transpose(1, 0, 2).copy())], {"perm": [1, 0, 2]}))
+
+    # -- dropout (inference = identity) ---------------------------------
+    dr = r(3, 4)
+    cases.append(case("test_dropout_default_ratio", "Dropout",
+                      [("x", dr)], [("y", dr)], {"ratio": 0.3}))
+
+    # -- LRN / ConvTranspose -------------------------------------------
+    lx = r(1, 5, 3, 3)
+    cases.append(case(
+        "test_lrn", "LRN", [("x", lx)],
+        [("y", ref_lrn(lx, 3, 0.0002, 0.75, 2.0))],
+        {"size": 3, "alpha": 0.0002, "beta": 0.75, "bias": 2.0}))
+    ctx_, ctw = r(1, 1, 3, 3), r(1, 2, 3, 3)
+    cases.append(case(
+        "test_convtranspose", "ConvTranspose",
+        [("x", ctx_), ("w", ctw)],
+        [("y", ref_conv_transpose2d(ctx_, ctw))],
+        {"kernel_shape": [3, 3]}))
+    cases.append(case(
+        "test_convtranspose_strides", "ConvTranspose",
+        [("x", ctx_), ("w", ctw)],
+        [("y", ref_conv_transpose2d(ctx_, ctw, (2, 2)))],
+        {"kernel_shape": [3, 3], "strides": [2, 2]}))
+
+    # -- RNN family (forward, default activations, zero init states) ----
+    T, Bz, I, H = 3, 2, 4, 5
+    rx = r(T, Bz, I)
+    rw, rr = r(1, H, I) * 0.4, r(1, H, H) * 0.4
+    rb = r(1, 2 * H) * 0.4
+    ry, ryh = ref_rnn(rx, rw, rr, rb, H)
+    cases.append(case(
+        "test_simple_rnn_with_bias", "RNN",
+        [("x", rx), ("w", rw), ("r", rr), ("b", rb)],
+        [("y", ry), ("y_h", ryh)], {"hidden_size": H}))
+    gw, gr = r(1, 3 * H, I) * 0.4, r(1, 3 * H, H) * 0.4
+    gb = r(1, 6 * H) * 0.4
+    gy, gyh = ref_gru(rx, gw, gr, gb, H)
+    cases.append(case(
+        "test_gru_with_bias", "GRU",
+        [("x", rx), ("w", gw), ("r", gr), ("b", gb)],
+        [("y", gy), ("y_h", gyh)], {"hidden_size": H}))
+    lw, lr = r(1, 4 * H, I) * 0.4, r(1, 4 * H, H) * 0.4
+    lb = r(1, 8 * H) * 0.4
+    ly, lyh, lyc = ref_lstm(rx, lw, lr, lb, H)
+    cases.append(case(
+        "test_lstm_with_bias", "LSTM",
+        [("x", rx), ("w", lw), ("r", lr), ("b", lb)],
+        [("y", ly), ("y_h", lyh), ("y_c", lyc)], {"hidden_size": H}))
 
     return cases
 

@@ -38,8 +38,7 @@ def _complete_bench(o):
     return (o.get("event") == "bench"
             and o.get("platform") not in (None, "cpu")
             and o.get("timing") == "slope-readback"
-            and not o.get("partial") and not o.get("partial_timeout")
-            and not o.get("partial_crash"))
+            and bench._is_complete(o))
 
 
 def main():
