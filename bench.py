@@ -703,9 +703,9 @@ def _fold_banked(res, obs, max_age, errors):
                      and not _is_complete(pick)
                      and _n_legs(res) >= _n_legs(pick))
         if pick is not None and not keep_live:
-            if res is not None and _is_complete(pick):
+            if res is not None:
                 errors.append(
-                    "live run was partial; reporting the complete "
+                    "live run was partial; reporting the more complete "
                     "benchmark banked earlier this round instead")
             res = dict(pick)
             res["measured_at"] = res.pop("ts")
@@ -763,8 +763,17 @@ def _emit_report(res, live, smoke, obs, errors):
                          for s in {o.get("status") for o in probes}},
         }
     if not live and out["platform"] != "cpu":
-        out["note"] = ("benchmark banked earlier this round by "
-                       "tools/tpu_watch.py; tunnel was down at report time")
+        # "live" is False both when the tunnel was down AND when a live
+        # partial was superseded by a better banked record — say which,
+        # so the round artifact doesn't fabricate a tunnel outage
+        if any("live run was partial" in e for e in errors):
+            out["note"] = ("live run was partial; reporting the more "
+                           "complete benchmark banked earlier this round "
+                           "by tools/tpu_watch.py")
+        else:
+            out["note"] = ("benchmark banked earlier this round by "
+                           "tools/tpu_watch.py; tunnel was down at "
+                           "report time")
     if errors:
         out["retries"] = errors
     print(json.dumps(out))

@@ -1,0 +1,178 @@
+"""Opportunistic extra TPU measurements for a live tunnel window.
+
+Fills the BASELINE.md target rows the 3-leg benchmark doesn't cover
+(MLP step time, larger-batch bf16 MFU) and sweeps the Pallas
+flash-attention block sizes on real hardware so the 128/128 default can
+be justified (or replaced) with a measurement instead of a guess.
+
+Each result prints as its own JSON line the moment it exists AND is
+banked to tpu_observations.jsonl (event "extra"), so a mid-probe tunnel
+drop keeps everything finished so far. Serialised against the watcher
+and bench via the shared TPU lock.
+
+Run:  python tools/tpu_probe_extra.py   (exits quietly if no chip)
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402
+
+
+def emit(rec):
+    rec = dict(rec)
+    bench._record_obs("extra", rec)
+    print(json.dumps(rec), flush=True)
+
+
+def _mlp_step_time(dev):
+    """BASELINE row: MLP MNIST step time, single chip (batch 64, 784-d
+    inputs, the reference examples/mlp topology at MNIST scale)."""
+    import numpy as np
+    from singa_tpu import tensor, opt
+    from singa_tpu.models import mlp
+
+    m = mlp.create_model(perceptron_size=512)
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    x = np.random.randn(64, 784).astype(np.float32)
+    y = np.eye(10)[np.random.randint(0, 10, 64)].astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True)
+    loss = None
+    for _ in range(5):
+        _, loss = m(tx, ty)
+    bench._force(loss.data)
+
+    def step():
+        _, loss = m(tx, ty)
+        return loss
+
+    dt = bench._slope_time(step, lambda l: l.data, 20, 220)
+    return {"extra": "mlp_mnist_b64_step_us", "value": round(dt * 1e6, 1),
+            "timing": "slope-readback"}
+
+
+def _resnet50_bf16_large_batch(dev):
+    """Feed the MXU bigger tiles than the reference harness's batch 32:
+    the bf16 MFU headroom measurement."""
+    thr, ms = bench._measure(dev, batch=128, niters=20, warmup=3,
+                             image_size=224, depth=50,
+                             dtype_name="bfloat16")
+    peak = bench._peak_flops(getattr(dev.jax_device, "device_kind", ""))
+    mfu = (thr * bench.RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+           if peak else None)
+    return {"extra": "resnet50_bf16_b128", "images_per_sec": round(thr, 1),
+            "step_ms": round(ms, 2),
+            "mfu": round(mfu, 4) if mfu else None,
+            "timing": "slope-readback"}
+
+
+def _flash_block_sweep(dev):
+    """Time the Pallas flash fwd+bwd at several (block_q, block_k) on an
+    LM-representative shape; bank per-config times."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from singa_tpu.ops import attention_mod as attention
+
+    B, H, S, D = 8, 8, 1024, 64
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D) * 0.1, jnp.float32)
+               for _ in range(3))
+    scale = 1.0 / math.sqrt(D)
+    results = []
+    for bq, bk in [(128, 128), (256, 128), (128, 256), (256, 256),
+                   (512, 256), (256, 512)]:
+        if S % bq or S % bk:
+            continue
+        try:
+            # the raw kernels are timed directly (the custom_vjp wrapper
+            # pins 128/128); dependent chain + forced readback as always
+            fwd = jax.jit(lambda q, k, v, _bq=bq, _bk=bk:
+                          attention._pallas_flash_fwd(
+                              q, k, v, True, scale,
+                              block_q=_bq, block_k=_bk)[0])
+            t0 = time.time()
+            o = fwd(q, k, v)
+            lse = jax.jit(lambda q, k, v, _bq=bq, _bk=bk:
+                          attention._pallas_flash_fwd(
+                              q, k, v, True, scale,
+                              block_q=_bq, block_k=_bk)[1])(q, k, v)
+            bench._force(o)
+            g = jnp.ones_like(o)
+            bwd = jax.jit(lambda q, k, v, o, lse, g, _bq=bq, _bk=bk:
+                          attention._pallas_flash_bwd(
+                              q, k, v, o, lse, g, True, scale,
+                              block_q=_bq, block_k=_bk)[0])
+            bench._force(bwd(q, k, v, o, lse, g))
+            compile_s = time.time() - t0
+
+            cell = [q]
+
+            def step():
+                cell[0] = fwd(cell[0], k, v) * 1e-3 + q
+                return cell[0]
+
+            fwd_ms = bench._slope_time(step, lambda x: x, 5, 55) * 1e3
+
+            cellb = [q]
+
+            def stepb():
+                cellb[0] = bwd(cellb[0], k, v, o, lse, g) * 1e-3 + q
+                return cellb[0]
+
+            bwd_ms = bench._slope_time(stepb, lambda x: x, 5, 55) * 1e3
+            results.append({"block_q": bq, "block_k": bk,
+                            "fwd_ms": round(fwd_ms, 3),
+                            "bwd_ms": round(bwd_ms, 3),
+                            "ms": round(fwd_ms + bwd_ms, 3),
+                            "compile_s": round(compile_s, 1)})
+            emit({"extra": "flash_block_probe", "shape": [B, H, S, D],
+                  **results[-1]})
+        except Exception as e:  # one bad config must not end the sweep
+            emit({"extra": "flash_block_probe", "block_q": bq,
+                  "block_k": bk, "error": str(e)[:160]})
+    if results:
+        best = min(results, key=lambda r: r["ms"])
+        return {"extra": "flash_block_best", "shape": [B, H, S, D],
+                **best}
+    return None
+
+
+def main():
+    bench._enable_compile_cache()
+    with bench._TpuLock(wait_s=120) as lock:
+        if not lock.acquired:
+            print("tpu busy (watcher mid-run); try again later",
+                  file=sys.stderr)
+            return
+        import jax
+        ds = jax.devices()
+        d = next((x for x in ds if x.platform != "cpu"), ds[0])
+        if d.platform == "cpu":
+            print("no accelerator visible", file=sys.stderr)
+            return
+        emit({"extra": "device", "platform": d.platform,
+              "device_kind": getattr(d, "device_kind", "?")})
+        from singa_tpu import device as sdev
+        dev = sdev.create_tpu_device()
+        for fn in (_mlp_step_time, _flash_block_sweep,
+                   _resnet50_bf16_large_batch):
+            try:
+                rec = fn(dev)
+                if rec:
+                    emit(rec)
+            except Exception as e:
+                emit({"extra": f"{fn.__name__}_error",
+                      "error": str(e)[:200]})
+
+
+if __name__ == "__main__":
+    main()
