@@ -208,12 +208,25 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                 os.environ.get("BENCH_LM_FUSED", "1") != "0"
         except Exception as e:
             res["lm_error"] = str(e)[:200]
+        _emit_partial(res, "lm")
+        # bf16 LM: compute_dtype=bfloat16 puts the whole transformer
+        # stack (params + attention matmuls) in MXU-native precision —
+        # the LM counterpart of the CNN bf16 leg
+        if os.environ.get("BENCH_LM_BF16", "1") != "0":
+            try:
+                res["lm_bf16_tokens_per_sec"] = _measure_lm(
+                    dev, compute_dtype="bfloat16")
+            except Exception as e:
+                res["lm_bf16_error"] = str(e)[:200]
+            _emit_partial(res, "lm_bf16")
     return res
 
 
-def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3):
+def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3,
+                compute_dtype=None):
     from singa_tpu import tensor, opt
     from singa_tpu.models import transformer
+    import jax.numpy as jnp
     import numpy as np
 
     # fused CE head: the (B,S,32000) logits never materialise in the
@@ -224,7 +237,9 @@ def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3):
                                   n_layers=6, max_len=seq, tp=False,
                                   remat=False,
                                   fused_head_chunk=8192 if fused
-                                  else None)
+                                  else None,
+                                  compute_dtype=jnp.bfloat16
+                                  if compute_dtype == "bfloat16" else None)
     m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 32000, (batch, seq)).astype(np.float32)
@@ -510,7 +525,8 @@ def _is_complete(rec):
 
 def _n_legs(rec):
     return sum(1 for k in ("throughput", "bf16_throughput",
-                           "lm_tokens_per_sec") if rec.get(k) is not None)
+                           "lm_tokens_per_sec", "lm_bf16_tokens_per_sec")
+               if rec.get(k) is not None)
 
 
 def _attempt(platform, timeout):
@@ -742,7 +758,8 @@ def _emit_report(res, live, smoke, obs, errors):
     # tokens/s, timing method, partial/suspect flags), not just the
     # headline images/sec
     for k in ("mfu", "bf16_throughput", "bf16_step_ms", "bf16_mfu",
-              "bf16_error", "lm_tokens_per_sec", "lm_error",
+              "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",
+              "lm_error", "lm_bf16_error",
               "lm_fused_head", "timing", "timing_suspect",
               "partial", "partial_timeout", "partial_crash"):
         if res.get(k) is not None:

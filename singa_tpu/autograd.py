@@ -735,6 +735,21 @@ class Identity(Operator):
         return x
 
 
+class AsType(Operator):
+    """Differentiable dtype cast — the mixed-precision boundary op
+    (bf16 activations below, f32 above). Unlike :class:`Cast` (which is
+    for integer/config casts and blocks gradients), jax's vjp through
+    ``astype`` casts the cotangent back to the source dtype, which is
+    exactly the master-dtype accumulation semantics wanted here."""
+
+    def __init__(self, to):
+        super().__init__()
+        self.to = to
+
+    def forward(self, x):
+        return x.astype(self.to)
+
+
 class _LayerNorm(Operator):
     """Normalise over the trailing dim, then scale+shift (TPU extension
     used by the transformer family)."""
@@ -1134,6 +1149,10 @@ def nonzero(x):
 
 def cast(x, to):
     return Cast(to)(x)
+
+
+def astype(x, to):
+    return AsType(to)(x)
 
 
 def identity(x):

@@ -980,18 +980,29 @@ class Model(Layer):
         attr = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                 for k, v in states.items()}
         from .tensor import to_host_tree
+
+        def _portable(a):
+            # bf16 isn't a stock-numpy dtype: inside the .npz it would
+            # round-trip as an uncastable raw-void array. Store it as
+            # (lossless) f32 — attr records the true dtype, and
+            # copy_from_numpy casts back to the param's dtype on load.
+            a = np.asarray(a)
+            return a.astype(np.float32) if str(a.dtype) == "bfloat16" \
+                else a
+
         # one batched cross-process gather for every host-sharded param
-        arrays = to_host_tree({k: v.data for k, v in states.items()})
+        arrays = {k: _portable(v) for k, v in to_host_tree(
+            {k: v.data for k, v in states.items()}).items()}
         opt = getattr(self, "optimizer", None)
         if opt is not None and hasattr(opt, "get_states"):
             for k, v in opt.get_states().items():
-                arrays[f"optimizer/{k}"] = np.asarray(v)
+                arrays[f"optimizer/{k}"] = _portable(v)
                 attr[f"optimizer/{k}"] = {
                     "shape": list(np.shape(v)),
                     "dtype": str(np.asarray(v).dtype),
                     "optimizer": True}
         for k, v in aux_states.items():
-            arrays[f"aux/{k}"] = np.asarray(
+            arrays[f"aux/{k}"] = _portable(
                 v.numpy() if isinstance(v, Tensor) else v)
             attr[f"aux/{k}"] = {"shape": list(arrays[f"aux/{k}"].shape),
                                 "dtype": str(arrays[f"aux/{k}"].dtype),
@@ -1013,6 +1024,21 @@ class Model(Layer):
             with zf.open(self.TENSOR_DICT_FILENAME.strip("/")) as f:
                 data = np.load(io.BytesIO(f.read()))
                 arrays = {k: data[k] for k in data.files}
+
+        def _true_dtype(k, a):
+            # the archive stores bf16 as portable f32 (save_states
+            # _portable); attr records the real dtype — cast back here
+            # so every consumer (fresh optimizer aux included) sees the
+            # dtype that was saved, not the transport representation
+            want = attr.get(k, {}).get("dtype")
+            if want and str(a.dtype) != want:
+                try:
+                    return a.astype(np.dtype(want))
+                except TypeError:
+                    return a
+            return a
+
+        arrays = {k: _true_dtype(k, v) for k, v in arrays.items()}
         model_states = {k: v for k, v in arrays.items()
                         if not k.startswith(("optimizer/", "aux/"))}
         my_states = self.get_states()

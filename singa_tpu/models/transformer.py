@@ -137,12 +137,23 @@ class TransformerLM(model.Model):
                  max_len=1024, causal=True, tp=True, seq_axis=None,
                  remat=False, moe=None, moe_aux_weight=0.01,
                  moe_top_k=None, moe_capacity_factor=1.25,
-                 seq_mode="ring", fused_head_chunk=None):
+                 seq_mode="ring", fused_head_chunk=None,
+                 compute_dtype=None):
         """``moe``: experts per block (MoE FFN over the 'expert' mesh
         axis); the blocks' load-balance aux losses join the training loss
         scaled by ``moe_aux_weight``. ``moe_top_k`` defaults to
-        min(2, moe)."""
+        min(2, moe).
+
+        ``compute_dtype`` (e.g. ``jnp.bfloat16``): cast the summed
+        embeddings to this dtype, so every downstream layer initialises
+        its params in it and the whole transformer stack (attention
+        matmuls included) runs in the MXU's native precision — the LM
+        counterpart of feeding a bf16 input to the CNN zoo. Embedding
+        tables stay f32 (the gather is bandwidth-, not MXU-bound), norm
+        stats compute in f32 as always, and both loss paths upcast to
+        f32 before the softmax."""
         super().__init__()
+        self.compute_dtype = compute_dtype
         self.vocab_size = vocab_size
         self.d_model = d_model
         # remat: rematerialize each block in backward (jax.checkpoint) —
@@ -175,6 +186,8 @@ class TransformerLM(model.Model):
     def _hidden(self, ids):
         pos = self._pos(ids)
         x = autograd.add(self.tok_emb(ids), self.pos_emb(pos))
+        if self.compute_dtype is not None:
+            x = autograd.astype(x, self.compute_dtype)
         for blk in self.blocks:
             x = autograd.checkpoint(blk, x) if self.remat else blk(x)
         return self.ln_f(x)
@@ -203,6 +216,9 @@ class TransformerLM(model.Model):
             out = None
         else:
             logits = self.forward(ids)
+            if self.compute_dtype is not None:
+                # softmax over a 32k vocab needs f32 range
+                logits = autograd.astype(logits, np.float32)
             B, S, V = logits.shape
             flat = autograd.reshape(logits, (B * S, V))
             onehot = autograd.onehot(-1, targets, self.vocab_size)

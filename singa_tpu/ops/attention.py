@@ -212,12 +212,14 @@ def _pick_blocks(Sq, Sk):
     length doesn't divide, so short or odd-length shapes still get the
     fused kernel whenever a legal tiling exists. Override for tuning
     with SINGA_FLASH_BLOCK_Q / SINGA_FLASH_BLOCK_K."""
+    bq = next((b for b in (512, 256, 128) if Sq % b == 0), 128)
+    bk = next((b for b in (256, 128) if Sk % b == 0), 128)
     env_q = os.environ.get("SINGA_FLASH_BLOCK_Q")
     env_k = os.environ.get("SINGA_FLASH_BLOCK_K")
     if env_q or env_k:
-        return int(env_q or 128), int(env_k or 128)
-    bq = next((b for b in (512, 256, 128) if Sq % b == 0), 128)
-    bk = next((b for b in (256, 128) if Sk % b == 0), 128)
+        # a partial override keeps the adaptive pick for the other axis
+        return int(env_q) if env_q else min(bq, Sq), \
+            int(env_k) if env_k else min(bk, Sk)
     return min(bq, Sq), min(bk, Sk)
 
 

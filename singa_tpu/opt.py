@@ -237,7 +237,11 @@ class Optimizer:
             if k == "step_counter":
                 continue
             if k in self._aux:
-                self._aux[k].data = jnp.asarray(v)
+                # keep the live buffer's dtype: checkpoints store bf16
+                # aux as portable f32, and a dtype flip here would leak
+                # f32 into the compiled bf16 update step
+                self._aux[k].data = jnp.asarray(
+                    v, dtype=self._aux[k].data.dtype)
             else:
                 self._aux[k] = Tensor(data=np.asarray(v),
                                       requires_grad=False)

@@ -144,14 +144,17 @@ class MoEFFN(Layer):
     def initialize(self, x):
         D, F, E = x.shape[-1], self.d_ff, self.n_experts
         dev = x.device
+        # router stays f32 (softmax gating needs the range; x bf16 @ wg
+        # f32 promotes to f32 so routing is full-precision either way);
+        # experts follow the input dtype like every other matmul layer
         self.wg = _param((D, E), dev)
         self.wg.gaussian(0.0, math.sqrt(1.0 / D))
-        self.w1 = _param((E, D, F), dev)
+        self.w1 = _param((E, D, F), dev, dtype=x.dtype)
         self.w1.gaussian(0.0, math.sqrt(2.0 / (D + F)))
-        self.b1 = _param((E, F), dev)
-        self.w2 = _param((E, F, D), dev)
+        self.b1 = _param((E, F), dev, dtype=x.dtype)
+        self.w2 = _param((E, F, D), dev, dtype=x.dtype)
         self.w2.gaussian(0.0, math.sqrt(2.0 / (D + F)))
-        self.b2 = _param((E, D), dev)
+        self.b2 = _param((E, D), dev, dtype=x.dtype)
         if self.axis_name:
             for t in (self.w1, self.b1, self.w2, self.b2):
                 t.spec = P(self.axis_name)

@@ -41,12 +41,15 @@ class ColumnParallelLinear(Layer):
 
     def initialize(self, x):
         in_features = x.shape[-1]
-        self.W = _param((in_features, self.out_features), x.device)
+        # params follow the input dtype (bf16 activations -> bf16 W),
+        # same contract as layer.Linear
+        self.W = _param((in_features, self.out_features), x.device,
+                        dtype=x.dtype)
         std = math.sqrt(2.0 / (in_features + self.out_features))
         self.W.gaussian(0.0, std)
         self.W.spec = P(None, self.axis_name)
         if self.bias:
-            self.b = _param((self.out_features,), x.device)
+            self.b = _param((self.out_features,), x.device, dtype=x.dtype)
             self.b.spec = P(self.axis_name)
 
     def _sharded(self):
@@ -94,12 +97,14 @@ class RowParallelLinear(Layer):
         # full input width
         in_features = x.shape[-1]
         self.in_features = in_features
-        self.W = _param((in_features, self.out_features), x.device)
+        self.W = _param((in_features, self.out_features), x.device,
+                        dtype=x.dtype)
         std = math.sqrt(2.0 / (in_features + self.out_features))
         self.W.gaussian(0.0, std)
         self.W.spec = P(self.axis_name, None)
         if self.bias:
-            self.b = _param((self.out_features,), x.device)  # replicated
+            # replicated
+            self.b = _param((self.out_features,), x.device, dtype=x.dtype)
 
     def forward(self, x):
         y = autograd.matmul(x, self.W)

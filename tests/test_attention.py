@@ -477,6 +477,15 @@ class TestPickBlocks:
         monkeypatch.setenv("SINGA_FLASH_BLOCK_K", "128")
         assert _pick_blocks(1024, 1024) == (256, 128)
 
+    def test_partial_env_override_keeps_adaptive_other_axis(
+            self, monkeypatch):
+        from singa_tpu.ops.attention import _pick_blocks
+        monkeypatch.setenv("SINGA_FLASH_BLOCK_Q", "512")
+        assert _pick_blocks(1024, 1024) == (512, 256)
+        monkeypatch.delenv("SINGA_FLASH_BLOCK_Q")
+        monkeypatch.setenv("SINGA_FLASH_BLOCK_K", "128")
+        assert _pick_blocks(1024, 1024) == (512, 128)
+
     def test_dispatch_asymmetric_blocks_match(self, monkeypatch):
         """Dispatch path with bq != bk and multi-block grids both ways
         (the measured-best v5e configs are asymmetric)."""

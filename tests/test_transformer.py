@@ -363,3 +363,80 @@ class TestGeneration:
         import pytest as _pytest
         with _pytest.raises(NotImplementedError, match="causal"):
             m2.generate(ids[:, :4], 2)
+
+
+class TestBF16Compute:
+    """compute_dtype=bfloat16: the LM counterpart of the CNN zoo's
+    bf16-input training — downstream params follow, embeddings and the
+    MoE router stay f32, both loss paths upcast before the softmax."""
+
+    def _train(self, steps=8, **kw):
+        import jax.numpy as jnp
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(5)
+        ids, targets = lm_data()
+        tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+        ty = tensor.Tensor(data=targets, device=dev, requires_grad=False)
+        m = transformer.TransformerLM(VOCAB, d_model=32, n_heads=2,
+                                      n_layers=2, max_len=64, tp=False,
+                                      compute_dtype=jnp.bfloat16, **kw)
+        m.set_optimizer(opt.SGD(lr=0.3, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=True)
+        losses = [float(m(tx, ty)[1].data) for _ in range(steps)]
+        return losses, m
+
+    def test_dense_head_trains_with_bf16_params(self):
+        losses, m = self._train()
+        assert losses[-1] < losses[0]
+        assert str(m.blocks[0].attn.q_proj.W.data.dtype) == "bfloat16"
+        assert str(m.blocks[0].mlp.up.W.data.dtype) == "bfloat16"
+        # master-precision ends stay f32
+        assert str(m.tok_emb.W.data.dtype) == "float32"
+
+    def test_fused_head_trains_in_bf16(self):
+        losses, m = self._train(fused_head_chunk=16)
+        assert losses[-1] < losses[0]
+        assert str(m.head.W.data.dtype) == "bfloat16"
+
+    @pytest.mark.slow
+    def test_moe_experts_follow_router_stays_f32(self):
+        losses, m = self._train(moe=2, steps=6)
+        assert losses[-1] < losses[0]
+        assert str(m.blocks[0].mlp.w1.data.dtype) == "bfloat16"
+        assert str(m.blocks[0].mlp.wg.data.dtype) == "float32"
+
+    def test_save_load_roundtrip_preserves_bf16(self, tmp_path):
+        """bf16 params/momentum store as portable f32 inside the .npz
+        and cast back on load — same values, same dtypes, same
+        next-step loss."""
+        import jax.numpy as jnp
+        losses, m = self._train()
+        dev = device.create_cpu_device()
+        ids, targets = lm_data()
+        tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+        ty = tensor.Tensor(data=targets, device=dev, requires_grad=False)
+        p = str(tmp_path / "bf16.zip")
+        m.save_states(p)
+        m2 = transformer.TransformerLM(VOCAB, d_model=32, n_heads=2,
+                                       n_layers=2, max_len=64, tp=False,
+                                       compute_dtype=jnp.bfloat16)
+        m2.set_optimizer(opt.SGD(lr=0.3, momentum=0.9))
+        m2.compile([tx], is_train=True, use_graph=True)
+        m2.load_states(p)
+        W1 = m.blocks[0].attn.q_proj.W.data
+        W2 = m2.blocks[0].attn.q_proj.W.data
+        assert str(W2.dtype) == "bfloat16"
+        np.testing.assert_array_equal(np.asarray(W1, dtype=np.float32),
+                                      np.asarray(W2, dtype=np.float32))
+        # fresh-optimizer resume path: momentum buffers must come back
+        # in their true (attr-recorded) dtype, not the portable f32 the
+        # archive stores
+        mom_dtypes = {str(t.data.dtype)
+                      for k, t in m2.optimizer._aux.items()
+                      if k.endswith(":momentum")
+                      and "tok_emb" not in k and "pos_emb" not in k
+                      and "wg" not in k and "ln" not in k}
+        assert "bfloat16" in mom_dtypes, mom_dtypes
+        l1 = float(m(tx, ty)[1].data)
+        l2 = float(m2(tx, ty)[1].data)
+        assert abs(l1 - l2) < 5e-3, (l1, l2)

@@ -59,6 +59,42 @@ def _mlp_step_time(dev):
             "timing": "slope-readback"}
 
 
+def _lm_long_context(dev):
+    """Long-context leg: the bench's LM at 4x the sequence length with
+    rematerialised blocks and bf16 compute — exercises the flash
+    kernels' (512,256) tiling at S=4096 under real memory pressure."""
+    import jax.numpy as jnp
+    import numpy as np
+    from singa_tpu import tensor, opt
+    from singa_tpu.models import transformer
+
+    batch, seq = 2, 4096
+    m = transformer.TransformerLM(32000, d_model=512, n_heads=8,
+                                  n_layers=6, max_len=seq, tp=False,
+                                  remat=True, fused_head_chunk=8192,
+                                  compute_dtype=jnp.bfloat16)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 32000, (batch, seq)).astype(np.float32)
+    tgt = np.roll(ids, -1, 1)
+    ti = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+    tt = tensor.Tensor(data=tgt, device=dev, requires_grad=False)
+    m.compile([ti], is_train=True, use_graph=True)
+    loss = None
+    for _ in range(3):
+        _, loss = m(ti, tt)
+    bench._force(loss.data)
+
+    def step():
+        _, loss = m(ti, tt)
+        return loss
+
+    dt = bench._slope_time(step, lambda l: l.data, 3, 13)
+    return {"extra": "lm_bf16_s4096_remat_tokens_per_sec",
+            "value": round(batch * seq / dt, 1),
+            "step_ms": round(dt * 1e3, 2), "timing": "slope-readback"}
+
+
 def _resnet50_bf16_large_batch(dev):
     """Feed the MXU bigger tiles than the reference harness's batch 32:
     the bf16 MFU headroom measurement."""
@@ -93,18 +129,16 @@ def _flash_block_sweep(dev):
         if S % bq or S % bk:
             continue
         try:
-            # the raw kernels are timed directly (the custom_vjp wrapper
-            # pins 128/128); dependent chain + forced readback as always
-            fwd = jax.jit(lambda q, k, v, _bq=bq, _bk=bk:
-                          attention._pallas_flash_fwd(
-                              q, k, v, True, scale,
-                              block_q=_bq, block_k=_bk)[0])
+            # the raw kernels are timed directly (the dispatch wrapper
+            # picks its own blocks); ONE jit returns (out, lse) so each
+            # config compiles the forward kernel once
+            fwd_full = jax.jit(lambda q, k, v, _bq=bq, _bk=bk:
+                               attention._pallas_flash_fwd(
+                                   q, k, v, True, scale,
+                                   block_q=_bq, block_k=_bk))
+            fwd = lambda q, k, v: fwd_full(q, k, v)[0]  # noqa: E731
             t0 = time.time()
-            o = fwd(q, k, v)
-            lse = jax.jit(lambda q, k, v, _bq=bq, _bk=bk:
-                          attention._pallas_flash_fwd(
-                              q, k, v, True, scale,
-                              block_q=_bq, block_k=_bk)[1])(q, k, v)
+            o, lse = fwd_full(q, k, v)
             bench._force(o)
             g = jnp.ones_like(o)
             bwd = jax.jit(lambda q, k, v, o, lse, g, _bq=bq, _bk=bk:
@@ -146,6 +180,10 @@ def _flash_block_sweep(dev):
     return None
 
 
+LEGS = (_mlp_step_time, _flash_block_sweep,
+        _resnet50_bf16_large_batch, _lm_long_context)
+
+
 def main():
     bench._enable_compile_cache()
     with bench._TpuLock(wait_s=120) as lock:
@@ -163,8 +201,15 @@ def main():
               "device_kind": getattr(d, "device_kind", "?")})
         from singa_tpu import device as sdev
         dev = sdev.create_tpu_device()
-        for fn in (_mlp_step_time, _flash_block_sweep,
-                   _resnet50_bf16_large_batch):
+        # each leg is independently skippable: TPU_EXTRA_LEGS names a
+        # comma-separated subset (default all)
+        sel = os.environ.get("TPU_EXTRA_LEGS")
+        legs = {f.__name__.lstrip("_") for f in LEGS}
+        if sel:
+            legs &= {s.strip() for s in sel.split(",")}
+        for fn in LEGS:
+            if fn.__name__.lstrip("_") not in legs:
+                continue
             try:
                 rec = fn(dev)
                 if rec:
