@@ -1002,11 +1002,13 @@ class Model(Layer):
                     "dtype": str(np.asarray(v).dtype),
                     "optimizer": True}
         for k, v in aux_states.items():
-            arrays[f"aux/{k}"] = _portable(
-                v.numpy() if isinstance(v, Tensor) else v)
-            attr[f"aux/{k}"] = {"shape": list(arrays[f"aux/{k}"].shape),
-                                "dtype": str(arrays[f"aux/{k}"].dtype),
+            raw = np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+            # attr records the TRUE dtype, taken before the portable-f32
+            # conversion, so load_states can cast bf16 aux back
+            attr[f"aux/{k}"] = {"shape": list(raw.shape),
+                                "dtype": str(raw.dtype),
                                 "aux": True}
+            arrays[f"aux/{k}"] = _portable(raw)
         buf = io.BytesIO()
         np.savez(buf, **arrays)
         buf.seek(0)

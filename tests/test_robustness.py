@@ -267,3 +267,34 @@ class TestDistOptSaveResume:
         s2 = m2.optimizer.get_states()
         for k in mom_keys:
             np.testing.assert_allclose(s2[k], s1[k], rtol=1e-6)
+
+
+class TestBF16AuxStates:
+    def test_bf16_aux_roundtrips_with_true_dtype(self, tmp_path):
+        """aux_states attr records the dtype BEFORE the portable-f32
+        conversion, so bf16 aux (e.g. EMA weights) loads back as bf16
+        with identical values."""
+        import jax.numpy as jnp
+        from singa_tpu.models import mlp
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(1)
+        x = np.random.randn(4, 8).astype(np.float32)
+        y = np.eye(10)[np.random.randint(0, 10, 4)].astype(np.float32)
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = mlp.create_model(perceptron_size=8)
+        m.set_optimizer(opt.SGD(lr=0.1))
+        m.compile([tx], is_train=True, use_graph=True)
+        m(tx, ty)
+
+        ema = np.arange(6, dtype=np.float32).reshape(2, 3) \
+            .astype(jnp.bfloat16)
+        path = str(tmp_path / "aux.zip")
+        m.save_states(path, aux_states={"ema": ema})
+        aux = m.load_states(path)
+        got = aux["ema"]
+        assert str(np.asarray(got.data).dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(got.data, dtype=np.float32),
+            np.arange(6, dtype=np.float32).reshape(2, 3))

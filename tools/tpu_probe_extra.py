@@ -206,7 +206,12 @@ def main():
         sel = os.environ.get("TPU_EXTRA_LEGS")
         legs = {f.__name__.lstrip("_") for f in LEGS}
         if sel:
-            legs &= {s.strip() for s in sel.split(",")}
+            wanted = {s.strip() for s in sel.split(",")}
+            unknown = wanted - legs
+            if unknown:
+                print(f"TPU_EXTRA_LEGS: unknown legs {sorted(unknown)}; "
+                      f"valid: {sorted(legs)}", file=sys.stderr)
+            legs &= wanted
         for fn in LEGS:
             if fn.__name__.lstrip("_") not in legs:
                 continue
