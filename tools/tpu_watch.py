@@ -48,11 +48,15 @@ def main():
     # round boundary: bench.py only trusts observations after this
     # marker. A RESTART mid-round keeps the existing window (and its
     # banked evidence) instead of discarding it.
+    last_bank = 0.0
     if bench._record_round_start(MAX_HOURS):
         log("opened a new round window")
     else:
         log("recent round window found; resuming it")
-        banked = any(_complete_bench(o) for o in bench._load_obs())
+        complete = [o for o in bench._load_obs() if _complete_bench(o)]
+        banked = bool(complete)
+        if complete:
+            last_bank = time.time() - bench._obs_age_s(complete[-1])
     log(f"watching for TPU windows (max {MAX_HOURS}h, "
         f"idle interval {IDLE_SLEEP}s)")
     while time.time() < deadline:
@@ -71,7 +75,12 @@ def main():
             bench._record_obs("probe", {"status": status, "err": err,
                                         "src": "watch"})
             log(f"probe#{n}: {status}{' (' + err + ')' if err else ''}")
-            if status == "ok":
+            # probes are cheap (one 120s child) — keep the fast cadence
+            # even after a complete bench is banked, or short windows go
+            # unseen. Only the EXPENSIVE smoke+bench re-run is throttled
+            # to once per BANKED_SLEEP after a complete bank.
+            if status == "ok" and (not banked or
+                                   time.time() - last_bank >= BANKED_SLEEP):
                 smoke = bench._attempt_smoke(300)
                 for rec in smoke:
                     bench._record_obs("smoke", rec)
@@ -83,11 +92,17 @@ def main():
                     log(f"BENCH BANKED: {thr} img/s on "
                         f"{res.get('device_kind')} "
                         f"(partial={bool(res.get('partial_timeout') or res.get('partial_crash') or res.get('partial'))})")
-                    banked = _complete_bench(dict(res, event="bench",
-                                                  platform=res.get("platform")))
+                    if _complete_bench(dict(res, event="bench",
+                                            platform=res.get("platform"))):
+                        banked = True
+                        last_bank = time.time()
                 else:
                     log(f"full bench attempt failed: {aerr}")
-        time.sleep(BANKED_SLEEP if banked else IDLE_SLEEP)
+            elif status == "ok":
+                log(f"cycle#{n}: window live, bench recently banked — "
+                    f"next re-run in "
+                    f"{int(BANKED_SLEEP - (time.time() - last_bank))}s")
+        time.sleep(IDLE_SLEEP)
     log("watch window closed")
 
 
