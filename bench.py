@@ -33,6 +33,28 @@ OBS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # step (fwd + bwd wrt activations + bwd wrt weights) is ~3x forward.
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 4.09e9 * 2 * 3
 
+
+def _lm_train_flops_per_token(d, n_layers, seq, vocab, ff_mult=4,
+                              causal=True):
+    """Matmul training FLOPs per token for the bench transformer.
+
+    Per layer: qkv+o projections 4d² params, MLP 2·d·(ff_mult·d);
+    plus the d·V head (the fused CE still does the full matmul, just
+    chunked). Forward = 2 FLOPs per param per token; training ≈ 3×
+    forward (bwd wrt activations + weights). Attention scores/values
+    add 4·S·d per layer, halved when causal. The flash backward's
+    score recompute is NOT counted, so the reported MFU slightly
+    understates actual hardware utilisation."""
+    proj = 4 * d * d + 2 * d * (ff_mult * d)
+    attn_flops = 4 * seq * d * (0.5 if causal else 1.0)  # already FLOPs
+    per_token_fwd = 2 * (n_layers * proj + d * vocab) + \
+        n_layers * attn_flops
+    return 3 * per_token_fwd
+
+
+# the bench LM's shape — single source for _measure_lm and the MFU math
+LM_SHAPE = {"d_model": 512, "n_layers": 6, "seq": 1024, "vocab": 32000}
+
 # Peak dense fp32/bf16 FLOP/s per chip by TPU generation (public figures),
 # for the MFU estimate. Overridable via BENCH_PEAK_TFLOPS.
 PEAK_FLOPS_BY_KIND = [
@@ -199,8 +221,14 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     # transformer-LM leg (accelerator only — secondary metric exercising
     # the Pallas flash-attention path; the headline stays ResNet-50)
     if platform != "cpu" and os.environ.get("BENCH_LM", "1") != "0":
+        lm_flops = _lm_train_flops_per_token(
+            LM_SHAPE["d_model"], LM_SHAPE["n_layers"], LM_SHAPE["seq"],
+            LM_SHAPE["vocab"])
         try:
             res["lm_tokens_per_sec"] = _measure_lm(dev)
+            if peak:
+                res["lm_mfu"] = \
+                    res["lm_tokens_per_sec"] * lm_flops / peak
             # what the LM leg measured: fused-CE-head or full-logits
             # path — without this marker, banked numbers from different
             # modes would read as perf changes between rounds
@@ -216,14 +244,18 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             try:
                 res["lm_bf16_tokens_per_sec"] = _measure_lm(
                     dev, compute_dtype="bfloat16")
+                if peak:
+                    res["lm_bf16_mfu"] = \
+                        res["lm_bf16_tokens_per_sec"] * lm_flops / peak
             except Exception as e:
                 res["lm_bf16_error"] = str(e)[:200]
             _emit_partial(res, "lm_bf16")
     return res
 
 
-def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3,
+def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
                 compute_dtype=None):
+    seq = seq or LM_SHAPE["seq"]
     from singa_tpu import tensor, opt
     from singa_tpu.models import transformer
     import jax.numpy as jnp
@@ -233,8 +265,10 @@ def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3,
     # train step (1 GiB fp32 at these shapes) — disable via
     # BENCH_LM_FUSED=0 to measure the full-logits path
     fused = os.environ.get("BENCH_LM_FUSED", "1") != "0"
-    m = transformer.TransformerLM(32000, d_model=512, n_heads=8,
-                                  n_layers=6, max_len=seq, tp=False,
+    m = transformer.TransformerLM(LM_SHAPE["vocab"],
+                                  d_model=LM_SHAPE["d_model"], n_heads=8,
+                                  n_layers=LM_SHAPE["n_layers"],
+                                  max_len=seq, tp=False,
                                   remat=False,
                                   fused_head_chunk=8192 if fused
                                   else None,
@@ -242,7 +276,8 @@ def _measure_lm(dev, batch=8, seq=1024, niters=20, warmup=3,
                                   if compute_dtype == "bfloat16" else None)
     m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, 32000, (batch, seq)).astype(np.float32)
+    ids = rng.randint(0, LM_SHAPE["vocab"], (batch, seq)) \
+        .astype(np.float32)
     tgt = np.roll(ids, -1, 1)
     ti = tensor.Tensor(data=ids, device=dev, requires_grad=False)
     tt = tensor.Tensor(data=tgt, device=dev, requires_grad=False)
@@ -759,7 +794,7 @@ def _emit_report(res, live, smoke, obs, errors):
     # headline images/sec
     for k in ("mfu", "bf16_throughput", "bf16_step_ms", "bf16_mfu",
               "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",
-              "lm_error", "lm_bf16_error",
+              "lm_mfu", "lm_bf16_mfu", "lm_error", "lm_bf16_error",
               "lm_fused_head", "timing", "timing_suspect",
               "partial", "partial_timeout", "partial_crash"):
         if res.get(k) is not None:

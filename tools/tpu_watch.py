@@ -48,7 +48,7 @@ def main():
     # round boundary: bench.py only trusts observations after this
     # marker. A RESTART mid-round keeps the existing window (and its
     # banked evidence) instead of discarding it.
-    last_bank = 0.0
+    last_heavy = 0.0
     if bench._record_round_start(MAX_HOURS):
         log("opened a new round window")
     else:
@@ -56,7 +56,7 @@ def main():
         complete = [o for o in bench._load_obs() if _complete_bench(o)]
         banked = bool(complete)
         if complete:
-            last_bank = time.time() - bench._obs_age_s(complete[-1])
+            last_heavy = time.time() - bench._obs_age_s(complete[-1])
     log(f"watching for TPU windows (max {MAX_HOURS}h, "
         f"idle interval {IDLE_SLEEP}s)")
     while time.time() < deadline:
@@ -78,9 +78,14 @@ def main():
             # probes are cheap (one 120s child) — keep the fast cadence
             # even after a complete bench is banked, or short windows go
             # unseen. Only the EXPENSIVE smoke+bench re-run is throttled
-            # to once per BANKED_SLEEP after a complete bank.
+            # to once per BANKED_SLEEP after a complete bank — gated on
+            # when the heavy work last RAN (not last succeeded), so a
+            # failed refresh doesn't put the expensive path on every
+            # 8-minute probe.
             if status == "ok" and (not banked or
-                                   time.time() - last_bank >= BANKED_SLEEP):
+                                   time.time() - last_heavy >= BANKED_SLEEP):
+                if banked:
+                    last_heavy = time.time()
                 smoke = bench._attempt_smoke(300)
                 for rec in smoke:
                     bench._record_obs("smoke", rec)
@@ -95,13 +100,13 @@ def main():
                     if _complete_bench(dict(res, event="bench",
                                             platform=res.get("platform"))):
                         banked = True
-                        last_bank = time.time()
+                        last_heavy = time.time()
                 else:
                     log(f"full bench attempt failed: {aerr}")
             elif status == "ok":
                 log(f"cycle#{n}: window live, bench recently banked — "
                     f"next re-run in "
-                    f"{int(BANKED_SLEEP - (time.time() - last_bank))}s")
+                    f"{int(BANKED_SLEEP - (time.time() - last_heavy))}s")
         time.sleep(IDLE_SLEEP)
     log("watch window closed")
 
