@@ -1,0 +1,2 @@
+
+Binput_1J$Ÿx\¿Ôt¿SžŒ?å¾h>P?%Sò>Wý@?M`c¿fäb¾

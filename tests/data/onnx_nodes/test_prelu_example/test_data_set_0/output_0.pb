@@ -1,0 +1,2 @@
+
+Boutput_0J01ƿl?rMz5B>}䗿o??E

@@ -1,0 +1,2 @@
+
+Boutput_1J -jc?}Uø«P±øﬂB—=VG?c™?"_>÷‚(ø

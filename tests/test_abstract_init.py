@@ -138,3 +138,56 @@ class TestAbstractInit:
         m2.compile([x], is_train=True, use_graph=False)
         losses = [float(np.asarray(m2(x, y)[1].data)) for _ in range(3)]
         assert all(np.isfinite(losses)), losses
+
+
+class TestTraceOnce:
+    def test_compiled_step_never_retraces(self):
+        """The trace-once/replay contract (the reference scheduler's
+        buffered-graph semantics, test_scheduler.cc RunGraph): after the
+        first call compiles the step, later calls replay the executable
+        without re-entering Python — a silent retrace-per-call would be
+        a 100x dispatch regression on a tunneled accelerator."""
+        log = []
+        m = make_model(log)
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        rng = np.random.RandomState(0)
+        x = Tensor(data=rng.randn(4, 6).astype(np.float32),
+                   device=DEV, requires_grad=False)
+        y = Tensor(data=np.eye(3)[rng.randint(0, 3, 4)]
+                   .astype(np.float32), device=DEV, requires_grad=False)
+        m.compile([x], is_train=True, use_graph=True)
+        m(x, y)
+        n_after_first = len(log)
+        for _ in range(5):
+            m(x, y)
+        assert len(log) == n_after_first, \
+            f"forward re-entered {len(log) - n_after_first} times"
+
+    def test_new_signature_traces_once_more(self):
+        """A different input shape compiles its own executable exactly
+        once; the original signature keeps replaying its cache."""
+        log = []
+        m = make_model(log)
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        rng = np.random.RandomState(0)
+
+        def batch(n):
+            x = Tensor(data=rng.randn(n, 6).astype(np.float32),
+                       device=DEV, requires_grad=False)
+            y = Tensor(data=np.eye(3)[rng.randint(0, 3, n)]
+                       .astype(np.float32), device=DEV,
+                       requires_grad=False)
+            return x, y
+
+        x4, y4 = batch(4)
+        m.compile([x4], is_train=True, use_graph=True)
+        m(x4, y4)
+        base = len(log)
+        x2, y2 = batch(2)
+        m(x2, y2)                      # new signature: traces again
+        after_new = len(log)
+        assert after_new > base
+        for _ in range(3):             # both signatures now cached
+            m(x4, y4)
+            m(x2, y2)
+        assert len(log) == after_new, "a cached signature retraced"

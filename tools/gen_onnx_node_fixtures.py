@@ -64,22 +64,35 @@ def ref_softmax(x, axis):
     return (e / e.sum(axis=1, keepdims=True)).reshape(shape)
 
 
-def ref_conv2d(x, w, strides=(1, 1), pads=(0, 0, 0, 0)):
+def ref_conv2d_general(x, w, strides=(1, 1), pads=(0, 0, 0, 0),
+                       dilations=(1, 1), group=1):
+    """ONNX Conv reference with dilation and groups."""
     N, C, H, W = x.shape
-    M, _, kh, kw = w.shape
+    M, Cg, kh, kw = w.shape
     xp = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
                     (pads[1], pads[3])))
-    oh = (xp.shape[2] - kh) // strides[0] + 1
-    ow = (xp.shape[3] - kw) // strides[1] + 1
+    ekh = (kh - 1) * dilations[0] + 1
+    ekw = (kw - 1) * dilations[1] + 1
+    oh = (xp.shape[2] - ekh) // strides[0] + 1
+    ow = (xp.shape[3] - ekw) // strides[1] + 1
     out = np.zeros((N, M, oh, ow), np.float32)
+    mg = M // group
     for n in range(N):
         for m in range(M):
+            g = m // mg
             for i in range(oh):
                 for j in range(ow):
-                    patch = xp[n, :, i * strides[0]:i * strides[0] + kh,
-                               j * strides[1]:j * strides[1] + kw]
+                    patch = xp[n, g * Cg:(g + 1) * Cg,
+                               i * strides[0]:i * strides[0] + ekh:
+                               dilations[0],
+                               j * strides[1]:j * strides[1] + ekw:
+                               dilations[1]]
                     out[n, m, i, j] = np.sum(patch * w[m])
     return out
+
+
+def ref_conv2d(x, w, strides=(1, 1), pads=(0, 0, 0, 0)):
+    return ref_conv2d_general(x, w, strides, pads)
 
 
 def ref_pool2d(x, k, strides, is_max):
@@ -164,6 +177,19 @@ def ref_scatter_elements(data, indices, updates, axis):
 
 def _sig(x):
     return 1.0 / (1.0 + np.exp(-x))
+
+
+def ref_rnn_bidir(X, W, R, B, H):
+    """ONNX bidirectional RNN: dir 0 forward, dir 1 runs on the
+    time-reversed input and its outputs are stored back at original
+    positions. Returns Y (T,2,Bz,H), Y_h (2,Bz,H)."""
+    yf, hf = ref_rnn(X, W[0:1], R[0:1], B[0:1], H)
+    yr, hr = ref_rnn(X[::-1], W[1:2], R[1:2], B[1:2], H)
+    T, Bz = X.shape[0], X.shape[1]
+    Y = np.zeros((T, 2, Bz, H), np.float32)
+    Y[:, 0] = yf[:, 0]
+    Y[:, 1] = yr[::-1, 0]
+    return Y, np.concatenate([hf, hr], 0)
 
 
 def ref_rnn(X, W, R, B, H):
@@ -613,6 +639,83 @@ def build_cases():
         "test_lstm_with_bias", "LSTM",
         [("x", rx), ("w", lw), ("r", lr), ("b", lb)],
         [("y", ly), ("y_h", lyh), ("y_c", lyc)], {"hidden_size": H}))
+    bw, br = r(2, H, I) * 0.4, r(2, H, H) * 0.4
+    bb = r(2, 2 * H) * 0.4
+    by, byh = ref_rnn_bidir(rx, bw, br, bb, H)
+    cases.append(case(
+        "test_simple_rnn_bidirectional", "RNN",
+        [("x", rx), ("w", bw), ("r", br), ("b", bb)],
+        [("y", by), ("y_h", byh)],
+        {"hidden_size": H, "direction": "bidirectional"}))
+
+    # -- conv variants: dilation / groups -------------------------------
+    dx, dw = r(1, 1, 9, 9), r(1, 1, 3, 3)
+    cases.append(case(
+        "test_conv_dilations", "Conv", [("x", dx), ("w", dw)],
+        [("y", ref_conv2d_general(dx, dw, dilations=(2, 2)))],
+        {"kernel_shape": [3, 3], "dilations": [2, 2]}))
+    gx, gw = r(1, 4, 5, 5), r(4, 2, 3, 3)
+    cases.append(case(
+        "test_conv_groups", "Conv", [("x", gx), ("w", gw)],
+        [("y", ref_conv2d_general(gx, gw, group=2))],
+        {"kernel_shape": [3, 3], "group": 2}))
+    # pool with pads
+    ppx = r(1, 2, 5, 5)
+    padded = np.pad(ppx, ((0, 0), (0, 0), (1, 1), (1, 1)),
+                    constant_values=-np.inf)
+    mp = np.zeros((1, 2, 5, 5), np.float32)
+    for i in range(5):
+        for j in range(5):
+            mp[:, :, i, j] = padded[:, :, i:i + 3, j:j + 3].max((2, 3))
+    cases.append(case(
+        "test_maxpool_2d_pads", "MaxPool", [("x", ppx)], [("y", mp)],
+        {"kernel_shape": [3, 3], "pads": [1, 1, 1, 1]}))
+
+    # -- more edge-case variants ----------------------------------------
+    sm3 = r(2, 3, 4)
+    cases.append(case("test_softmax_axis_2", "Softmax", [("x", sm3)],
+                      [("y", ref_softmax(sm3, 2))], {"axis": 2}))
+    ga2, gb2 = r(3, 5), r(5, 4)
+    cases.append(case("test_gemm_alpha_no_c", "Gemm",
+                      [("a", ga2), ("b", gb2)],
+                      [("y", ref_gemm(ga2, gb2, None, 0.5))],
+                      {"alpha": 0.5}))
+    cl2 = r(3, 4)
+    cases.append(case("test_clip_min_only", "Clip",
+                      [("x", cl2), ("min", np.float32(0.0))],
+                      [("y", np.clip(cl2, 0.0, None))]))
+    eqb = np.round(r(3, 1) * 2).astype(np.float32)
+    eqc = np.round(r(1, 4) * 2).astype(np.float32)
+    cases.append(case("test_equal_bcast", "Equal",
+                      [("a", eqb), ("b", eqc)], [("y", eqb == eqc)]))
+    spd = r(6, 4)
+    cases.append(case(
+        "test_split_equal_parts_default", "Split", [("x", spd)],
+        [("y0", spd[:2].copy()), ("y1", spd[2:4].copy()),
+         ("y2", spd[4:].copy())], {"axis": 0}))
+    sln = r(6, 7)
+    cases.append(case(
+        "test_slice_negative", "Slice",
+        [("x", sln), ("starts", np.array([0, -4], np.int64)),
+         ("ends", np.array([6, -1], np.int64)),
+         ("axes", np.array([0, 1], np.int64))],
+        [("y", sln[0:6, -4:-1].copy())]))
+    c2f = (np.round(r(2, 3) * 5)).astype(np.int32)
+    cases.append(case("test_cast_int32_to_float", "Cast", [("x", c2f)],
+                      [("y", c2f.astype(np.float32))],
+                      {"to": int(TensorProto.FLOAT)}))
+    rneg = r(2, 3, 4)
+    cases.append(case(
+        "test_reduce_mean_negative_axes", "ReduceMean", [("x", rneg)],
+        [("y", rneg.mean(axis=-1, keepdims=True).astype(np.float32))],
+        {"axes": [-1], "keepdims": 1}))
+    prs = r(3, 4)
+    slope_full = np.abs(r(3, 4)).astype(np.float32)
+    cases.append(case(
+        "test_prelu_example", "PRelu",
+        [("x", prs), ("slope", slope_full)],
+        [("y", np.where(prs > 0, prs, slope_full * prs)
+          .astype(np.float32))]))
 
     return cases
 
