@@ -212,15 +212,21 @@ def _pick_blocks(Sq, Sk):
     length doesn't divide, so short or odd-length shapes still get the
     fused kernel whenever a legal tiling exists. Override for tuning
     with SINGA_FLASH_BLOCK_Q / SINGA_FLASH_BLOCK_K."""
-    bq = next((b for b in (512, 256, 128) if Sq % b == 0), 128)
-    bk = next((b for b in (256, 128) if Sk % b == 0), 128)
+    bq = min(next((b for b in (512, 256, 128) if Sq % b == 0), 128), Sq)
+    bk = min(next((b for b in (256, 128) if Sk % b == 0), 128), Sk)
     env_q = os.environ.get("SINGA_FLASH_BLOCK_Q")
     env_k = os.environ.get("SINGA_FLASH_BLOCK_K")
-    if env_q or env_k:
-        # a partial override keeps the adaptive pick for the other axis
-        return int(env_q) if env_q else min(bq, Sq), \
-            int(env_k) if env_k else min(bk, Sk)
-    return min(bq, Sq), min(bk, Sk)
+    # a partial override keeps the adaptive pick for the other axis
+    return (int(env_q) if env_q else bq,
+            int(env_k) if env_k else bk)
+
+
+def _pallas_blocks(q, k):
+    """Adaptive block pick + kernel-eligibility check in one step:
+    (block_q, block_k) when the Pallas kernels should run for these
+    shapes, else None (scan-path fallback)."""
+    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
+    return (bq, bk) if _use_pallas(q, k, bq, bk) else None
 
 
 def _use_pallas(q, k, block_q, block_k):
@@ -525,10 +531,10 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale,
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_k):
-    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
-    if _use_pallas(q, k, bq, bk):
+    blocks = _pallas_blocks(q, k)
+    if blocks:
         return _pallas_flash_fwd(q, k, v, causal, scale,
-                                 block_q=bq, block_k=bk)
+                                 block_q=blocks[0], block_k=blocks[1])
     return _scan_flash_fwd(q, k, v, causal, scale, block_k)
 
 
@@ -559,10 +565,10 @@ def _flash_bwd(causal, scale, block_k, res, g):
     q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    bq, bk = _pick_blocks(q.shape[2], k.shape[2])
-    if _use_pallas(q, k, bq, bk):
+    blocks = _pallas_blocks(q, k)
+    if blocks:
         return _pallas_flash_bwd(q, k, v, out, lse, g, causal, scale,
-                                 block_q=bq, block_k=bk)
+                                 block_q=blocks[0], block_k=blocks[1])
     return _scan_flash_bwd(q, k, v, out, lse, g, causal, scale, block_k)
 
 
@@ -592,10 +598,10 @@ def _ring_partials(qf, kr, vr, delta, causal, scale, block_k):
     path; the per-step position delta rides in as a traced scalar);
     backward recomputes through the differentiable scan path — same
     O(S/n) activation footprint, exact same masking semantics."""
-    bq, bk = _pick_blocks(qf.shape[2], kr.shape[2])
-    if _use_pallas(qf, kr, bq, bk):
+    blocks = _pallas_blocks(qf, kr)
+    if blocks:
         return _pallas_flash_fwd(qf, kr, vr, causal, scale,
-                                 block_q=bq, block_k=bk,
+                                 block_q=blocks[0], block_k=blocks[1],
                                  pos_delta=delta)
     return _ring_partials_scan(qf, kr, vr, delta, causal, scale, block_k)
 

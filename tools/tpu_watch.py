@@ -54,34 +54,51 @@ _EXTRA_LEG_MARKERS = {
 def _extras_missing():
     """Extra-probe legs whose success marker is not yet banked this
     round — already-banked heavy legs are never re-run on a retry."""
-    seen = {str(o.get("extra", "")) for o in bench._load_obs()
-            if o.get("event") == "extra"}
-    return [leg for leg, marker in _EXTRA_LEG_MARKERS.items()
-            if marker not in seen]
+    obs = [o for o in bench._load_obs() if o.get("event") == "extra"]
+    seen = {str(o.get("extra", "")) for o in obs}
+    missing = [leg for leg, marker in _EXTRA_LEG_MARKERS.items()
+               if marker not in seen]
+    # the sweep banks each config's record as it completes; enough of
+    # them IS the measurement even if the child died before printing
+    # the final flash_block_best summary — don't redo the whole sweep
+    if "flash_block_sweep" in missing:
+        n_cfg = sum(1 for o in obs
+                    if o.get("extra") == "flash_block_probe"
+                    and o.get("ms") is not None)
+        if n_cfg >= 3:
+            missing.remove("flash_block_sweep")
+    return missing
 
 
 def _run_extras(legs):
     """One bounded child of tools/tpu_probe_extra.py, restricted to the
     still-missing legs (it takes the TPU lock itself — call AFTER
-    releasing ours)."""
+    releasing ours). Returns the number of records the child banked —
+    0 means it provably did no work (lock busy / tunnel already gone)."""
     import subprocess
     script = os.path.join(ROOT, "tools", "tpu_probe_extra.py")
     env = dict(os.environ, TPU_EXTRA_LEGS=",".join(legs))
+    before = sum(1 for o in bench._load_obs()
+                 if o.get("event") == "extra")
     try:
         proc = subprocess.run([sys.executable, script],
                               capture_output=True, text=True,
                               timeout=1500, env=env)
-        lines = (proc.stdout or "").strip().splitlines()
-        log(f"extras({','.join(legs)}): {len(lines)} records "
-            f"(rc={proc.returncode})")
+        rc = proc.returncode
     except subprocess.TimeoutExpired:
-        log("extras: timed out after 1500s (completed legs are banked)")
+        rc = "timeout"
+    banked_new = sum(1 for o in bench._load_obs()
+                     if o.get("event") == "extra") - before
+    log(f"extras({','.join(legs)}): {banked_new} new records "
+        f"(rc={rc})")
+    return banked_new
 
 
 def main():
     deadline = time.time() + MAX_HOURS * 3600
     banked = False
-    extras_tries = 0
+    extras_tries = 0      # attempts that actually banked something
+    extras_calls = 0      # hard cap on child launches per round
     n = 0
     # round boundary: bench.py only trusts observations after this
     # marker. A RESTART mid-round keeps the existing window (and its
@@ -148,15 +165,19 @@ def main():
         # window still live after a complete bank: spend it on the
         # extra measurements, retrying ONLY the legs whose success
         # marker isn't banked yet (outside our lock — the child
-        # serializes itself). Bounded attempts so a leg that keeps
-        # dying can't eat every live cycle.
-        if banked and status == "ok" and extras_tries < 3:
+        # serializes itself). A try only counts when the child banked
+        # something — a no-work exit (lock busy, tunnel already gone)
+        # must not burn the budget; extras_calls hard-caps the loop.
+        if banked and status == "ok" and extras_tries < 3 \
+                and extras_calls < 8:
             missing = _extras_missing()
             if missing:
-                extras_tries += 1
-                log(f"window live, bench banked: extras try "
-                    f"#{extras_tries} for {missing}")
-                _run_extras(missing)
+                extras_calls += 1
+                log(f"window live, bench banked: extras run for "
+                    f"{missing} (productive tries so far: "
+                    f"{extras_tries}/3)")
+                if _run_extras(missing) > 0:
+                    extras_tries += 1
         time.sleep(IDLE_SLEEP)
     log("watch window closed")
 
