@@ -69,16 +69,24 @@ def main():
     ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
 
     m.compile([tx], is_train=True, use_graph=True)
+
+    def sync(t):
+        # completion barrier that holds on proxied backends too:
+        # block_until_ready can resolve on enqueue-ACK through a
+        # network tunnel (see docs/performance.md); fetching a scalar
+        # derived from the value cannot
+        return float(np.asarray(jnp.sum(jnp.ravel(t.data)[:1])))
+
     # always at least one untimed step: it includes trace+compile, which
     # must not land inside the timed region
     for _ in range(max(1, args.warmup)):
         out, loss = m(tx, ty)
-    loss.data.block_until_ready()
+    sync(loss)
 
     start = time.time()
     for _ in range(args.iters):
         out, loss = m(tx, ty)
-    loss.data.block_until_ready()
+    sync(loss)
     end = time.time()
 
     titer = (end - start) / args.iters

@@ -38,6 +38,11 @@ def main():
     ap.add_argument("--generate", type=int, default=0,
                     help="after training, decode N tokens greedily from "
                          "the first batch row (KV-cache scan)")
+    ap.add_argument("--bf16", action="store_true",
+                    help="compute_dtype=bfloat16: the whole transformer "
+                         "stack (params + attention matmuls) in MXU-"
+                         "native precision; embeddings / MoE router / "
+                         "loss softmax stay f32")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -62,13 +67,15 @@ def main():
     tx = tensor.Tensor(data=ids, device=dev, requires_grad=False)
     ty = tensor.Tensor(data=tgt, device=dev, requires_grad=False)
 
+    import jax.numpy as jnp
     model = transformer.TransformerLM(
         args.vocab, d_model=args.d_model, n_heads=args.heads,
         n_layers=args.layers,
         max_len=args.seq + args.generate,
         seq_axis="seq" if args.sp > 1 else None,
         moe=args.moe or None, tp=args.tp > 1,
-        fused_head_chunk=args.fused_head_chunk or None)
+        fused_head_chunk=args.fused_head_chunk or None,
+        compute_dtype=jnp.bfloat16 if args.bf16 else None)
     dist = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
                        reduce_axes=("data", "expert", "seq"))
     msh = mesh_mod.make_mesh(

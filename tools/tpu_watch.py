@@ -62,12 +62,22 @@ def _extras_missing():
     # them IS the measurement even if the child died before printing
     # the final flash_block_best summary — don't redo the whole sweep
     if "flash_block_sweep" in missing:
-        n_cfg = sum(1 for o in obs
-                    if o.get("extra") == "flash_block_probe"
-                    and o.get("ms") is not None)
-        if n_cfg >= 3:
+        cfgs = {(o.get("block_q"), o.get("block_k")) for o in obs
+                if o.get("extra") == "flash_block_probe"
+                and o.get("ms") is not None}
+        if len(cfgs) >= 3:
             missing.remove("flash_block_sweep")
     return missing
+
+
+def _n_banked_successes():
+    """Banked extra records that represent real measurements — the
+    device marker and per-leg error records don't count as work."""
+    return sum(1 for o in bench._load_obs()
+               if o.get("event") == "extra"
+               and o.get("extra") not in (None, "device")
+               and "error" not in str(o.get("extra", ""))
+               and o.get("error") is None)
 
 
 def _run_extras(legs):
@@ -78,8 +88,7 @@ def _run_extras(legs):
     import subprocess
     script = os.path.join(ROOT, "tools", "tpu_probe_extra.py")
     env = dict(os.environ, TPU_EXTRA_LEGS=",".join(legs))
-    before = sum(1 for o in bench._load_obs()
-                 if o.get("event") == "extra")
+    before = _n_banked_successes()
     try:
         proc = subprocess.run([sys.executable, script],
                               capture_output=True, text=True,
@@ -87,9 +96,8 @@ def _run_extras(legs):
         rc = proc.returncode
     except subprocess.TimeoutExpired:
         rc = "timeout"
-    banked_new = sum(1 for o in bench._load_obs()
-                     if o.get("event") == "extra") - before
-    log(f"extras({','.join(legs)}): {banked_new} new records "
+    banked_new = _n_banked_successes() - before
+    log(f"extras({','.join(legs)}): {banked_new} new measurements "
         f"(rc={rc})")
     return banked_new
 
