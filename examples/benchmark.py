@@ -70,12 +70,13 @@ def main():
 
     m.compile([tx], is_train=True, use_graph=True)
 
+    # completion barrier that holds on proxied backends too — the shared
+    # harness helper (block_until_ready can resolve on enqueue-ACK
+    # through a network tunnel; see docs/performance.md)
+    from bench import _force
+
     def sync(t):
-        # completion barrier that holds on proxied backends too:
-        # block_until_ready can resolve on enqueue-ACK through a
-        # network tunnel (see docs/performance.md); fetching a scalar
-        # derived from the value cannot
-        return float(np.asarray(jnp.sum(jnp.ravel(t.data)[:1])))
+        return _force(t.data)
 
     # always at least one untimed step: it includes trace+compile, which
     # must not land inside the timed region

@@ -11,6 +11,7 @@ guard fails the suite if a newly added public op has no case here.
 
 import math
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -221,6 +222,11 @@ CASES = [
     ("cast", lambda x: autograd.cast(x, np.int32),
      [np.array([1.7, -2.3], np.float32)],
      lambda x: x.astype(np.int32)),
+    # astype: the DIFFERENTIABLE cast (mixed-precision boundary);
+    # bf16 round-trip loses mantissa, so oracle through ml_dtypes too
+    ("astype", lambda x: autograd.astype(x, jnp.bfloat16),
+     [x235],
+     lambda x: np.asarray(jnp.asarray(x, jnp.bfloat16))),
     ("cossim", autograd.cossim, [x35, y35],
      lambda a, b: (a * b).sum(-1)
      / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1) + 1e-12)),

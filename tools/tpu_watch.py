@@ -76,7 +76,6 @@ def _n_banked_successes():
     return sum(1 for o in bench._load_obs()
                if o.get("event") == "extra"
                and o.get("extra") not in (None, "device")
-               and "error" not in str(o.get("extra", ""))
                and o.get("error") is None)
 
 
