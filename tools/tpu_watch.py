@@ -48,6 +48,7 @@ _EXTRA_LEG_MARKERS = {
     "flash_block_sweep": "flash_block_best",
     "resnet50_bf16_large_batch": "resnet50_bf16_b128",
     "lm_long_context": "lm_bf16_s4096_remat_tokens_per_sec",
+    "resnet_fusion_profile": "resnet50_bf16_fusion_profile",
 }
 
 
