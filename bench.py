@@ -105,11 +105,10 @@ def _force(x):
     platform this container reaches the chip through — it resolves when
     the proxy ACKs the enqueue, not when the TPU finishes (measured:
     30 "blocked" 4096^3 matmuls in ~1 ms, i.e. 40x the chip's peak).
-    Fetching a scalar derived from the value to the host is the only
-    completion barrier that cannot lie."""
-    import numpy as np
-    import jax.numpy as jnp
-    return float(np.asarray(jnp.sum(jnp.ravel(x)[:1])))
+    The one canonical recipe lives in the installed package so every
+    consumer (harness, examples, profiling) shares it."""
+    from singa_tpu.utils import force_completion
+    return force_completion(x)
 
 
 def _slope_time(step_fn, out_of, n_small, n_big):
@@ -136,8 +135,12 @@ def _slope_time(step_fn, out_of, n_small, n_big):
     return t2 / n_big
 
 
-def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name):
-    from singa_tpu import tensor, opt, device  # noqa: F401
+def _setup_resnet_step(dev, batch, image_size, depth, dtype_name):
+    """Build + compile THE canonical benchmark ResNet train step (SGD
+    momentum 0.9, weight_decay 1e-5, synthetic data) and return its
+    step() closure — the single source for the timing legs AND the
+    fusion-profile probe, so they decompose the same compiled program."""
+    from singa_tpu import tensor, opt
     from singa_tpu.models import resnet
     import jax.numpy as jnp
     import numpy as np
@@ -156,14 +159,19 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name):
 
     model.compile([tx], is_train=True, use_graph=True)
 
-    loss = None
-    for _ in range(warmup):
-        out, loss = model(tx, ty)
-    _force(loss.data)   # also warms the readback reduction
-
     def step():
         out, loss = model(tx, ty)
         return loss
+
+    return step
+
+
+def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name):
+    step = _setup_resnet_step(dev, batch, image_size, depth, dtype_name)
+    loss = None
+    for _ in range(warmup):
+        loss = step()
+    _force(loss.data)   # also warms the readback reduction
 
     dt = _slope_time(step, lambda l: l.data,
                      max(1, niters // 4), niters)

@@ -70,19 +70,14 @@ def main():
 
     m.compile([tx], is_train=True, use_graph=True)
 
-    # completion barrier that holds on proxied backends too — the shared
-    # harness helper (block_until_ready can resolve on enqueue-ACK
-    # through a network tunnel; see docs/performance.md). bench.py lives
-    # at the repo root, not in the installed package — fall back to the
-    # same recipe inline for pip-installed runs.
-    try:
-        from bench import _force
-    except ImportError:
-        def _force(x):
-            return float(np.asarray(jnp.sum(jnp.ravel(x)[:1])))
+    # completion barrier that holds on proxied backends too — the one
+    # canonical recipe, shipped in the package (block_until_ready can
+    # resolve on enqueue-ACK through a network tunnel; see
+    # docs/performance.md)
+    from singa_tpu.utils import force_completion
 
     def sync(t):
-        return _force(t.data)
+        return force_completion(t.data)
 
     # always at least one untimed step: it includes trace+compile, which
     # must not land inside the timed region

@@ -582,7 +582,12 @@ class Model(Layer):
 
             def run_once():
                 res = rec["jit"](state_arrays, rng, *input_arrays)
-                jax.block_until_ready(res)
+                # the trace must not stop before the device finishes:
+                # block_until_ready can resolve on a proxy's enqueue-ACK
+                # (utils.force_completion docstring), truncating the
+                # fusion table
+                from .utils import force_completion
+                force_completion(res)
                 return res
 
             (new_state, leaves, next_key), fus = \

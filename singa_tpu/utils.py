@@ -18,6 +18,26 @@ import jax.numpy as jnp
 from .tensor import Tensor
 
 
+def force_completion(x) -> float:
+    """Completion barrier that holds on proxied/tunneled backends.
+
+    ``block_until_ready`` can resolve when a network proxy ACKs the
+    ENQUEUE, not when the device finishes (measured 40x over-speed on a
+    tunneled chip — see docs/performance.md). Fetching a scalar derived
+    from an output to the host is the only barrier that cannot lie: the
+    value does not exist until the program ran. One leaf suffices — a
+    single XLA executable's outputs complete together. Accepts an array
+    or any pytree of arrays; returns the fetched scalar."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "dtype") and getattr(leaf, "size", 0):
+            return float(np.asarray(
+                jnp.sum(jnp.ravel(leaf)[:1]).astype(jnp.float32)))
+    return 0.0
+
+
 def update_progress(progress: float, info: str = "") -> None:
     """Render a textual progress bar (reference utils.update_progress:27).
 

@@ -181,46 +181,36 @@ def _flash_block_sweep(dev):
 
 
 def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
-    """Per-fusion breakdown of the ResNet bf16 train step from a real
-    jax.profiler trace — tells us (and the next round) where the
-    non-MXU time goes. Banks the top fusions by total time."""
-    import numpy as np
-    from singa_tpu import tensor, opt
-    from singa_tpu.models import resnet
-
+    """Per-fusion breakdown of THE benchmark ResNet bf16 train step
+    (bench._setup_resnet_step — same optimizer, same compiled program)
+    from a real jax.profiler trace: where the non-MXU time goes. Banks
+    the top fusions by total time. The profiled step's trace ends in a
+    forced scalar readback (model.py run_once uses
+    utils.force_completion), so the table can't be truncated by the
+    tunnel's enqueue-ACK."""
+    dev.ResetTimeProfiling()
     try:
-        m = resnet.create_model(depth=depth, num_classes=10,
-                                num_channels=3)
-        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
-        import jax.numpy as jnp
-        x = np.random.randn(batch, 3, image_size, image_size) \
-            .astype(np.float32)
-        y = np.eye(10)[np.random.randint(0, 10, batch)] \
-            .astype(np.float32)
-        tx = tensor.Tensor(data=x, device=dev,
-                           requires_grad=False).as_type(jnp.bfloat16)
-        ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
         # compile + warm up at verbosity 0: raising it earlier would
         # skip the abstract first call and run the whole model eagerly,
         # one tunnel round trip per op. The fusion trace is captured on
         # the first COMPILED step that runs at verbosity 2.
-        m.compile([tx], is_train=True, use_graph=True)
+        step = bench._setup_resnet_step(dev, batch, image_size, depth,
+                                        "bfloat16")
+        loss = None
         for _ in range(3):
-            _, loss = m(tx, ty)
+            loss = step()
         bench._force(loss.data)
         dev.SetVerbosity(2)
-        _, loss = m(tx, ty)
-        bench._force(loss.data)
+        bench._force(step().data)
         rows = sorted(((k[len("fusion/"):], cnt, tot)
                        for k, (cnt, tot) in dev.time_profiling.items()
                        if k.startswith("fusion/")),
                       key=lambda r: -r[2])
         if not rows:
-            # bank the outcome anyway: an environmental trace failure
-            # must not make the watcher re-run this heavy leg all round
-            return {"extra": "resnet50_bf16_fusion_profile",
-                    "empty": True,
-                    "note": "no fusion rows captured from the trace"}
+            # error-shaped record: the watcher retries (bounded), and
+            # the round records WHY the table is missing
+            return {"extra": "_resnet_fusion_profile_empty",
+                    "error": "no fusion rows captured from the trace"}
         total = sum(r[2] for r in rows)
         return {"extra": "resnet50_bf16_fusion_profile",
                 "batch": batch, "image_size": image_size, "depth": depth,
@@ -231,6 +221,7 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
                         for op, cnt, tot in rows[:10]]}
     finally:
         dev.SetVerbosity(0)
+        dev.ResetTimeProfiling()
 
 
 LEGS = (_mlp_step_time, _flash_block_sweep,
