@@ -180,6 +180,62 @@ def _flash_block_sweep(dev):
     return None
 
 
+def _lm_decode_throughput(dev):
+    """KV-cache autoregressive decode speed: tokens/s for greedy
+    generation on the bench LM (6L d512, 128-token prompt, 128 new
+    tokens, batch 8). The decode scan is compiled once; a second timed
+    call measures the cached path the way a serving loop would run."""
+    import numpy as np
+    import time
+    from singa_tpu import tensor, opt
+    from singa_tpu.models import transformer
+
+    B, S0 = 8, 128
+    NEW_SMALL, NEW_BIG = 16, 128
+    m = transformer.TransformerLM(32000, d_model=512, n_heads=8,
+                                  n_layers=6, max_len=S0 + NEW_BIG,
+                                  tp=False)
+    m.set_optimizer(opt.SGD(lr=0.1))
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 32000, (B, S0)).astype(np.int32)
+    # params materialise via one abstract-compiled train step
+    ids = prompt.astype(np.float32)
+    ti = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+    tt = tensor.Tensor(data=np.roll(ids, -1, 1), device=dev,
+                       requires_grad=False)
+    m.compile([ti], is_train=True, use_graph=True)
+    m(ti, tt)
+
+    # generate() host-gathers + re-uploads the weights EVERY call (a
+    # single-device inference convenience) — a per-call constant that
+    # would dominate the tunnel timing. The two-point slope over decode
+    # lengths cancels it (same methodology as bench._slope_time), so
+    # the banked number is the per-token decode cost alone. Each
+    # variant's scan compiles once before its timed call; generate
+    # returns a host numpy array, so every timing ends in a full
+    # readback.
+    def timed(new_tokens):
+        m.generate(prompt, max_new_tokens=new_tokens,
+                   temperature=0)     # compile + warm this variant
+        t0 = time.perf_counter()
+        out = m.generate(prompt, max_new_tokens=new_tokens,
+                         temperature=0)
+        assert out.shape == (B, S0 + new_tokens)
+        return time.perf_counter() - t0
+
+    t_small, t_big = timed(NEW_SMALL), timed(NEW_BIG)
+    if t_big <= t_small:   # tunnel noise swamped the short run
+        per_token = t_big / NEW_BIG   # upper bound on per-token cost
+    else:
+        per_token = (t_big - t_small) / (NEW_BIG - NEW_SMALL)
+    return {"extra": "lm_decode_tokens_per_sec",
+            "value": round(B / per_token, 1),
+            "per_token_ms": round(per_token * 1e3, 3),
+            "batch": B, "prompt": S0,
+            "new_tokens": [NEW_SMALL, NEW_BIG],
+            "timing": "slope-readback"}
+
+
 def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
     """Per-fusion breakdown of THE benchmark ResNet bf16 train step
     (bench._setup_resnet_step — same optimizer, same compiled program)
@@ -226,7 +282,7 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
 
 LEGS = (_mlp_step_time, _flash_block_sweep,
         _resnet50_bf16_large_batch, _lm_long_context,
-        _resnet_fusion_profile)
+        _resnet_fusion_profile, _lm_decode_throughput)
 
 
 def main():

@@ -49,6 +49,7 @@ _EXTRA_LEG_MARKERS = {
     "resnet50_bf16_large_batch": "resnet50_bf16_b128",
     "lm_long_context": "lm_bf16_s4096_remat_tokens_per_sec",
     "resnet_fusion_profile": "resnet50_bf16_fusion_profile",
+    "lm_decode_throughput": "lm_decode_tokens_per_sec",
 }
 
 
