@@ -197,3 +197,15 @@ def test_smoke_parser_keeps_partial_output(bench, monkeypatch):
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     lines = bench._attempt_smoke(5)
     assert [r["smoke"] for r in lines] == ["device", "matmul_bf16_4096"]
+
+
+def test_lm_train_flops_per_token_pinned():
+    """Hand-computed value for the bench LM shape (d512 L6 S1024
+    V32000, causal): proj 12d^2/layer, head dV, attn 2Sd/layer, all
+    x2 FLOPs/param and x3 for training."""
+    import bench
+    got = bench._lm_train_flops_per_token(512, 6, 1024, 32000)
+    proj = 6 * (4 * 512 * 512 + 2 * 512 * 2048)
+    head = 512 * 32000
+    attn = 6 * (4 * 1024 * 512 * 0.5)
+    assert got == 3 * (2 * (proj + head) + attn), got
