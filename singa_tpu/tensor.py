@@ -25,7 +25,8 @@ from . import device as device_mod
 
 __all__ = [
     "Tensor", "float16", "bfloat16", "float32", "float64", "int32", "int64",
-    "int8", "uint8", "from_numpy", "to_numpy", "to_host", "from_raw_tensor",
+    "int8", "uint8", "from_numpy", "to_numpy", "to_host",
+    "from_raw_tensor", "from_raw_tensors",
     "zeros_like", "ones_like", "zeros", "ones", "random", "product", "sizeof",
     "reshape", "transpose", "contiguous", "copy_data_to_from",
     "abs", "exp", "ceil", "log", "sigmoid", "sign", "sqrt", "square", "tanh",
@@ -365,6 +366,11 @@ def to_host(t: Tensor) -> Tensor:
 
 def from_raw_tensor(arr, dev=None) -> Tensor:
     return Tensor(data=arr, device=dev)
+
+
+def from_raw_tensors(arrs, dev=None) -> list:
+    """List form of :func:`from_raw_tensor` (reference tensor.py:795)."""
+    return [from_raw_tensor(a, dev) for a in arrs]
 
 
 def zeros_like(t: Tensor) -> Tensor:

@@ -172,3 +172,14 @@ class TestRandomFillers:
         t2 = Tensor(shape=(10,), device=DEV)
         t2.gaussian(0, 1)
         np.testing.assert_array_equal(t1.numpy(), t2.numpy())
+
+
+def test_from_raw_tensors_list_form():
+    """Reference tensor.from_raw_tensors (tensor.py:795): list-map of
+    from_raw_tensor."""
+    import numpy as np
+    from singa_tpu import tensor
+    arrs = [np.ones((2, 3), np.float32), np.zeros((4,), np.float32)]
+    ts = tensor.from_raw_tensors(arrs)
+    assert [t.shape for t in ts] == [(2, 3), (4,)]
+    np.testing.assert_array_equal(ts[0].numpy(), arrs[0])
