@@ -1155,6 +1155,35 @@ def astype(x, to):
     return AsType(to)(x)
 
 
+def axis_helper(y_shape, x_shape):
+    """Axes along which ``x_shape`` was broadcast to produce
+    ``y_shape`` — the sum-reduction set for a broadcast backward
+    (reference autograd.py:34)."""
+    res = []
+    j = len(x_shape) - 1
+    for i in range(len(y_shape) - 1, -1, -1):
+        if j < 0 or x_shape[j] != y_shape[i]:
+            res.append(i)
+        j -= 1
+    return tuple(res[::-1])
+
+
+def back_broadcast(y_shape, x_shape, x):
+    """Reduce a broadcast result (cotangent) back to ``x_shape``: sum
+    over the broadcast axes, then reshape (reference autograd.py:52).
+    Accepts a Tensor or array; returns the same kind, preserving the
+    Tensor's device and requires_grad metadata."""
+    if tuple(y_shape) == tuple(x_shape):
+        return x
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    arr = jnp.sum(arr, axis=axis_helper(y_shape, x_shape)) \
+        .reshape(tuple(x_shape))
+    if isinstance(x, Tensor):
+        return Tensor(data=arr, device=x.device,
+                      requires_grad=x.requires_grad)
+    return arr
+
+
 def identity(x):
     return Identity()(x)
 

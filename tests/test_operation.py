@@ -379,3 +379,28 @@ class TestDropout:
             assert 0.45 < (vals != 0).mean() < 0.75
         finally:
             autograd.training = False
+
+
+class TestBroadcastHelpers:
+    """Reference autograd.axis_helper/back_broadcast (autograd.py:34/52)."""
+
+    def test_axis_helper_matches_reference_semantics(self):
+        from singa_tpu.autograd import axis_helper
+        assert axis_helper((4, 3, 5), (3, 5)) == (0,)
+        assert axis_helper((4, 3, 5), (1, 5)) == (0, 1)
+        assert axis_helper((4, 3, 5), (5,)) == (0, 1)
+        assert axis_helper((2, 2), (2, 2)) == ()
+
+    def test_back_broadcast_sums_to_shape(self):
+        import numpy as np
+        from singa_tpu.autograd import back_broadcast
+        from singa_tpu.tensor import Tensor
+        y = np.ones((4, 3, 5), np.float32)
+        got = back_broadcast((4, 3, 5), (1, 5), y)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.full((1, 5), 12.0))
+        t = Tensor(data=y)
+        got_t = back_broadcast((4, 3, 5), (3, 5), t)
+        assert isinstance(got_t, Tensor)
+        np.testing.assert_array_equal(got_t.numpy(),
+                                      np.full((3, 5), 4.0))
