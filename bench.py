@@ -178,9 +178,42 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name):
     return batch / dt, dt * 1e3
 
 
+def _leg_guard(fn, timeout, name):
+    """Run one benchmark leg with a thread watchdog.
+
+    A half-dead tunnel can hang a readback INSIDE a C++ call, where
+    SIGALRM never gets delivered — the 04:34 window died exactly like
+    that: 25 minutes, zero output, no diagnosis. The leg runs in a
+    worker thread; if it exceeds its budget the main thread raises a
+    TimeoutError NAMING the leg, so the round records where it hung and
+    the already-banked legs survive. The caller STOPS after a timeout:
+    the abandoned thread may still occupy the exclusive-access chip, so
+    any later leg would measure interleaved work and lie."""
+    import threading
+    box = {}
+
+    def run():
+        try:
+            box["res"] = fn()
+        except BaseException as e:   # noqa: BLE001 — reported, not hidden
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True, name=name)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError(f"{name} leg hung > {timeout}s "
+                           f"(readback never returned)")
+    if "err" in box:
+        raise box["err"]
+    return box["res"]
+
+
 def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
               progress=None):
     from singa_tpu import device
+
+    leg_budget = int(os.environ.get("BENCH_LEG_TIMEOUT", "900"))
 
     def _emit_partial(res, stage):
         if progress is not None:
@@ -198,8 +231,9 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         _enable_compile_cache()
     peak = _peak_flops(getattr(dev.jax_device, "device_kind", ""))
 
-    throughput, step_ms = _measure(dev, batch, niters, warmup, image_size,
-                                   depth, "float32")
+    throughput, step_ms = _leg_guard(
+        lambda: _measure(dev, batch, niters, warmup, image_size,
+                         depth, "float32"), leg_budget, "fp32")
     res = {
         "throughput": throughput,
         "step_ms": step_ms,
@@ -217,12 +251,20 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     # counterpart of the reference's fp16 precision flag
     if os.environ.get("BENCH_BF16", "1") != "0":
         try:
-            bt, bs = _measure(dev, batch, niters, warmup, image_size,
-                              depth, "bfloat16")
+            bt, bs = _leg_guard(
+                lambda: _measure(dev, batch, niters, warmup, image_size,
+                                 depth, "bfloat16"), leg_budget, "bf16")
             res["bf16_throughput"] = bt
             res["bf16_step_ms"] = bs
             if peak:
                 res["bf16_mfu"] = bt * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+        except TimeoutError as e:
+            # the zombie leg thread may still hold the chip: stop here —
+            # a later leg timed against it would bank a lie
+            res["bf16_error"] = str(e)[:200]
+            res["leg_timeout"] = "bf16"
+            _emit_partial(res, "bf16")
+            return res
         except Exception as e:   # the fp32 number still stands
             res["bf16_error"] = str(e)[:200]
         _emit_partial(res, "bf16")
@@ -233,7 +275,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             LM_SHAPE["d_model"], LM_SHAPE["n_layers"], LM_SHAPE["seq"],
             LM_SHAPE["vocab"])
         try:
-            res["lm_tokens_per_sec"] = _measure_lm(dev)
+            res["lm_tokens_per_sec"] = _leg_guard(
+                lambda: _measure_lm(dev), leg_budget, "lm")
             if peak:
                 res["lm_mfu"] = \
                     res["lm_tokens_per_sec"] * lm_flops / peak
@@ -242,6 +285,11 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             # modes would read as perf changes between rounds
             res["lm_fused_head"] = \
                 os.environ.get("BENCH_LM_FUSED", "1") != "0"
+        except TimeoutError as e:
+            res["lm_error"] = str(e)[:200]
+            res["leg_timeout"] = "lm"
+            _emit_partial(res, "lm")
+            return res
         except Exception as e:
             res["lm_error"] = str(e)[:200]
         _emit_partial(res, "lm")
@@ -250,11 +298,15 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         # the LM counterpart of the CNN bf16 leg
         if os.environ.get("BENCH_LM_BF16", "1") != "0":
             try:
-                res["lm_bf16_tokens_per_sec"] = _measure_lm(
-                    dev, compute_dtype="bfloat16")
+                res["lm_bf16_tokens_per_sec"] = _leg_guard(
+                    lambda: _measure_lm(dev, compute_dtype="bfloat16"),
+                    leg_budget, "lm_bf16")
                 if peak:
                     res["lm_bf16_mfu"] = \
                         res["lm_bf16_tokens_per_sec"] * lm_flops / peak
+            except TimeoutError as e:
+                res["lm_bf16_error"] = str(e)[:200]
+                res["leg_timeout"] = "lm_bf16"
             except Exception as e:
                 res["lm_bf16_error"] = str(e)[:200]
             _emit_partial(res, "lm_bf16")
@@ -543,6 +595,12 @@ def child_main(platform):
     res = run_bench(batch=batch, niters=niters, warmup=warmup,
                     progress=lambda rec: print(json.dumps(rec), flush=True))
     print(json.dumps(res), flush=True)
+    # hard exit: a leg-guard's abandoned thread can still sit inside a
+    # JAX runtime call, and interpreter finalization racing it could
+    # crash AFTER the result printed — which would demote this complete
+    # run to partial_crash in the parent
+    sys.stdout.flush()
+    os._exit(0)
 
 
 def _last_result_line(out, marker_key=None, marker_val=None):
@@ -561,9 +619,9 @@ def _last_result_line(out, marker_key=None, marker_val=None):
 
 
 def _is_complete(rec):
-    """A full 3-leg benchmark, not a salvaged prefix of one."""
+    """A full benchmark, not a salvaged or leg-timeout prefix of one."""
     return not (rec.get("partial") or rec.get("partial_timeout")
-                or rec.get("partial_crash"))
+                or rec.get("partial_crash") or rec.get("leg_timeout"))
 
 
 def _n_legs(rec):
@@ -579,10 +637,15 @@ def _attempt(platform, timeout):
     the last complete leg the child printed is salvaged and returned
     with a partial marker — a 3-leg benchmark that finished fp32+bf16
     but not the LM leg still banks those numbers."""
+    env = dict(os.environ)
+    # the in-child per-leg watchdog must fire (and name the hung leg)
+    # BEFORE the parent's hard kill silences the child — derive its
+    # budget from this attempt's timeout unless the user pinned one
+    env.setdefault("BENCH_LEG_TIMEOUT", str(max(120, int(timeout * 0.55))))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform],
-            capture_output=True, text=True, timeout=timeout)
+            capture_output=True, text=True, timeout=timeout, env=env)
     except subprocess.TimeoutExpired as e:
         out = e.stdout or ""
         if isinstance(out, bytes):
@@ -804,7 +867,8 @@ def _emit_report(res, live, smoke, obs, errors):
               "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",
               "lm_mfu", "lm_bf16_mfu", "lm_error", "lm_bf16_error",
               "lm_fused_head", "timing", "timing_suspect",
-              "partial", "partial_timeout", "partial_crash"):
+              "partial", "partial_timeout", "partial_crash",
+              "leg_timeout"):
         if res.get(k) is not None:
             out[k] = round(res[k], 4) if isinstance(res[k], float) else res[k]
     if smoke:

@@ -157,3 +157,31 @@ def test_banked_partial_with_more_legs_beats_newer_live_partial():
         one_leg_live, [two_leg, one_leg_live], MAX_AGE, [])
     assert not is_live
     assert res["bf16_throughput"] == 2000.0
+
+
+def test_leg_guard_passes_through_and_times_out():
+    """Thread watchdog: returns results, propagates leg exceptions, and
+    a hung leg raises a TimeoutError NAMING the leg (the 04:34 lost
+    window produced 25 minutes of silence instead)."""
+    import time as _time
+    assert bench._leg_guard(lambda: 42, 5, "ok") == 42
+    try:
+        bench._leg_guard(lambda: 1 / 0, 5, "boom")
+        raise AssertionError("expected ZeroDivisionError")
+    except ZeroDivisionError:
+        pass
+    try:
+        bench._leg_guard(lambda: _time.sleep(30), 0.2, "fp32")
+        raise AssertionError("expected TimeoutError")
+    except TimeoutError as e:
+        assert "fp32" in str(e)
+
+
+def test_leg_timeout_record_counts_as_partial():
+    rec = _bench_rec(bf16_error="bf16 leg hung > 900s",
+                     leg_timeout="bf16")
+    assert not bench._is_complete(rec)
+    # and a complete banked record still beats it in the fold
+    complete = _bench_rec(age_s=3600, bf16_throughput=2000.0)
+    res, _ = bench._fold_banked(rec, [complete, rec], MAX_AGE, [])
+    assert res["measured_at"] == complete["ts"]
