@@ -299,7 +299,8 @@ class Conv2d(Layer):
         self.activation = activation
 
     def initialize(self, x):
-        self.in_channels = x.shape[1]
+        from .ops.layout import channel_axis
+        self.in_channels = x.shape[channel_axis(len(x.shape))]
         dev = x.device
         ks = self.kernel_size if isinstance(self.kernel_size, (tuple, list)) \
             else (self.kernel_size, self.kernel_size)
@@ -361,7 +362,8 @@ class ConvTranspose2d(Layer):
 
     def initialize(self, x):
         from .ops.conv import ConvTransposeHandle
-        self.in_channels = x.shape[1]
+        from .ops.layout import channel_axis
+        self.in_channels = x.shape[channel_axis(len(x.shape))]
         dev = x.device
         ks = self.kernel_size if isinstance(self.kernel_size, (tuple, list)) \
             else (self.kernel_size, self.kernel_size)
@@ -415,7 +417,8 @@ class SeparableConv2d(Layer):
         self.bias = bias
 
     def initialize(self, x):
-        in_channels = x.shape[1]
+        from .ops.layout import channel_axis
+        in_channels = x.shape[channel_axis(len(x.shape))]
         self.depthwise = Conv2d(in_channels, self.kernel_size,
                                 stride=self.stride, padding=self.padding,
                                 group=in_channels, bias=self.bias)
@@ -461,7 +464,8 @@ class BatchNorm2d(Layer):
         self.freeze_stats = freeze_stats
 
     def initialize(self, x):
-        self.channels = x.shape[1]
+        from .ops.layout import channel_axis
+        self.channels = x.shape[channel_axis(len(x.shape))]
         dev = x.device
         c = (self.channels,)
         self.scale = _param(c, dev, init="ones")

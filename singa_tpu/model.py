@@ -1039,9 +1039,22 @@ class Model(Layer):
             # dtype that was saved, not the transport representation
             want = attr.get(k, {}).get("dtype")
             if want and str(a.dtype) != want:
+                if want == "bfloat16":
+                    # numpy only knows bfloat16 once ml_dtypes (shipped
+                    # with jax) has registered it — import explicitly so
+                    # the cast can't silently hand consumers f32 arrays
+                    try:
+                        import ml_dtypes  # noqa: F401
+                    except ImportError:
+                        pass  # astype below fails loudly via the warning
                 try:
                     return a.astype(np.dtype(want))
                 except TypeError:
+                    import warnings
+                    warnings.warn(
+                        f"load_states: recorded dtype {want!r} for {k!r} "
+                        f"cannot be restored (keeping {a.dtype})",
+                        stacklevel=2)
                     return a
             return a
 

@@ -7,7 +7,8 @@ All convs/GEMMs lower to single MXU ops via lax; with graph (jit) mode the
 whole train step is one fused XLA computation.
 """
 
-from .. import layer, model
+from .. import autograd, layer, model
+from ..ops.layout import use_layout
 from . import TrainStepMixin
 
 
@@ -80,11 +81,17 @@ class Downsample(layer.Layer):
 
 class ResNet(model.Model, TrainStepMixin):
 
-    def __init__(self, block, layers, num_classes=10, num_channels=3):
+    def __init__(self, block, layers, num_classes=10, num_channels=3,
+                 layout="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.input_size = 224
         self.dimension = 4
+        # activation layout of the conv trunk. The public interface stays
+        # NCHW either way; "NHWC" transposes once at the stem and runs
+        # channels-last (TPU 128-lane minor dim — see ops/layout.py).
+        # Weights are OIHW in both modes, so checkpoints are identical.
+        self.layout = str(layout).upper()
         self.inplanes = 64
         self.conv1 = layer.Conv2d(64, 7, stride=2, padding=3, bias=False)
         self.bn1 = layer.BatchNorm2d()
@@ -117,6 +124,17 @@ class ResNet(model.Model, TrainStepMixin):
         return forward, blocks
 
     def forward(self, x):
+        if self.layout == "NHWC":
+            # one transpose at the stem; the trunk then runs channels-last
+            # end-to-end (handles capture NHWC at their deferred init).
+            # After global avg-pool the spatial dims are 1x1, so flatten
+            # yields the same (N, C) features as the NCHW path.
+            x = autograd.transpose(x, (0, 2, 3, 1))
+            with use_layout("NHWC"):
+                return self._trunk(x)
+        return self._trunk(x)
+
+    def _trunk(self, x):
         x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
         x = self.layer1(x)
         x = self.layer2(x)

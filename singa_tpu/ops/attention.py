@@ -202,6 +202,36 @@ _DECLINE_LOGGED = set()
 _LANES = 128
 
 
+_ENV_BLOCK_CACHE = {}
+_ENV_BLOCK_WARNED = set()
+
+
+def _env_block(name):
+    """Validated SINGA_FLASH_BLOCK_* override, or None. A value that is
+    not a positive integer is warned about ONCE and ignored (the
+    adaptive pick stands) instead of raising inside every attention
+    dispatch; validation is memoized per raw value so the hot path pays
+    one dict lookup."""
+    v = os.environ.get(name)
+    if not v:
+        return None
+    key = (name, v)
+    if key not in _ENV_BLOCK_CACHE:
+        val = None
+        try:
+            iv = int(v)
+            if iv > 0:
+                val = iv
+        except ValueError:
+            pass
+        if val is None:
+            import warnings
+            warnings.warn(f"{name}={v!r} is not a positive integer; "
+                          "ignoring the override", stacklevel=3)
+        _ENV_BLOCK_CACHE[key] = val
+    return _ENV_BLOCK_CACHE[key]
+
+
 def _pick_blocks(Sq, Sk):
     """Largest Pallas block sizes that tile the sequence lengths.
 
@@ -211,14 +241,33 @@ def _pick_blocks(Sq, Sk):
     Falls back through 256 to the 128-lane minimum when the sequence
     length doesn't divide, so short or odd-length shapes still get the
     fused kernel whenever a legal tiling exists. Override for tuning
-    with SINGA_FLASH_BLOCK_Q / SINGA_FLASH_BLOCK_K."""
+    with SINGA_FLASH_BLOCK_Q / SINGA_FLASH_BLOCK_K — an override that
+    does not divide the sequence length is warned about (once per
+    shape) and ignored, so a bad knob can never silently cost the
+    fused kernel."""
     bq = min(next((b for b in (512, 256, 128) if Sq % b == 0), 128), Sq)
     bk = min(next((b for b in (256, 128) if Sk % b == 0), 128), Sk)
-    env_q = os.environ.get("SINGA_FLASH_BLOCK_Q")
-    env_k = os.environ.get("SINGA_FLASH_BLOCK_K")
     # a partial override keeps the adaptive pick for the other axis
-    return (int(env_q) if env_q else bq,
-            int(env_k) if env_k else bk)
+    out = []
+    for name, env, adaptive, S in (("Q", _env_block("SINGA_FLASH_BLOCK_Q"),
+                                    bq, Sq),
+                                   ("K", _env_block("SINGA_FLASH_BLOCK_K"),
+                                    bk, Sk)):
+        if env is not None and S % min(env, S):
+            # a non-dividing override would silently cost the fused
+            # kernel (_use_pallas declines): warn once per shape and
+            # keep the adaptive pick instead
+            key = (name, env, S)
+            if key not in _ENV_BLOCK_WARNED:
+                _ENV_BLOCK_WARNED.add(key)
+                import warnings
+                warnings.warn(
+                    f"SINGA_FLASH_BLOCK_{name}={env} does not divide "
+                    f"sequence length {S}; using the adaptive "
+                    f"{adaptive} instead", stacklevel=3)
+            env = None
+        out.append(env if env is not None else adaptive)
+    return tuple(out)
 
 
 def _pallas_blocks(q, k):

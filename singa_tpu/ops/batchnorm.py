@@ -27,19 +27,27 @@ class BatchNormHandle:
     Supports 2D (N, C) and 4D (N, C, H, W) inputs like the reference.
     """
 
-    def __init__(self, momentum, x, eps: float = 1e-5):
+    def __init__(self, momentum, x, eps: float = 1e-5, layout=None):
+        from .layout import current_layout
         self.factor = float(momentum)
+        self.layout = (layout or current_layout()).upper()
         xs = x.shape if hasattr(x, "shape") else tuple(x)
-        self.channels = int(xs[1])
         self.is_2d = len(xs) == 2
+        self.channels = int(xs[-1]) \
+            if self.layout == "NHWC" and not self.is_2d else int(xs[1])
         self.eps = eps
         self.batchsize = int(xs[0])
 
     def _axes(self, ndim):
-        return (0,) if ndim == 2 else (0, 2, 3)
+        if ndim == 2:
+            return (0,)
+        return (0, 1, 2) if self.layout == "NHWC" else (0, 2, 3)
 
     def _bshape(self, ndim):
-        return (1, self.channels) if ndim == 2 else (1, self.channels, 1, 1)
+        if ndim == 2:
+            return (1, self.channels)
+        return (1, 1, 1, self.channels) if self.layout == "NHWC" \
+            else (1, self.channels, 1, 1)
 
 
 def _global_moments(xb, axes):

@@ -9,8 +9,11 @@ array — there is no im2col path and no algorithm search; backward comes from
 the vjp of the same primitive (cudnnConvolutionBackwardData/Filter
 equivalents are emitted by XLA).
 
-Layout: NCHW / OIHW at the API for reference parity; XLA relayouts
-internally for the MXU, so this costs nothing at runtime.
+Layout: NCHW / OIHW at the API for reference parity. A handle built
+inside :func:`..ops.layout.use_layout` ("NHWC") instead takes
+channels-last activations (weights stay OIHW, so checkpoints are
+layout-independent) — the TPU-friendly form where the channel dim sits
+in the 128-lane minor position; see ops/layout.py.
 """
 
 from __future__ import annotations
@@ -38,7 +41,8 @@ class ConvHandle:
 
     def __init__(self, x, kernel_size, stride, padding, in_channels,
                  out_channels, bias=True, group=1, pad_mode=None,
-                 dilation=1):
+                 dilation=1, layout=None):
+        from .layout import current_layout
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
         self.dilation = _pair(dilation)
@@ -53,20 +57,31 @@ class ConvHandle:
         self.bias = bool(bias)
         self.group = int(group)
         self.pad_mode = pad_mode  # "SAME"/"VALID" override, else explicit
+        self.layout = (layout or current_layout()).upper()
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.batchsize = int(xs[0]) if len(xs) > 0 else 0
         if len(xs) == 4:
-            self.height, self.width = int(xs[2]), int(xs[3])
-        self.dimension_numbers = ("NCHW", "OIHW", "NCHW")
+            if self.layout == "NHWC":
+                self.height, self.width = int(xs[1]), int(xs[2])
+            else:
+                self.height, self.width = int(xs[2]), int(xs[3])
+        # weights are OIHW in BOTH layouts (checkpoint-stable); only the
+        # activation spec changes — XLA maps either onto the MXU
+        self.dimension_numbers = (self.layout, "OIHW", self.layout)
 
     def output_shape(self, x_shape):
-        n, _, h, w = x_shape
+        if self.layout == "NHWC":
+            n, h, w, _ = x_shape
+        else:
+            n, _, h, w = x_shape
         (p0, p1), (q0, q1) = self.padding
         kh, kw = self.kernel_size
         sh, sw = self.stride
         dh, dw = self.dilation
         oh = (h + p0 + p1 - (dh * (kh - 1) + 1)) // sh + 1
         ow = (w + q0 + q1 - (dw * (kw - 1) + 1)) // sw + 1
+        if self.layout == "NHWC":
+            return (n, oh, ow, self.out_channels)
         return (n, self.out_channels, oh, ow)
 
 
@@ -95,7 +110,8 @@ class _Conv2d(Operator):
             feature_group_count=h.group,
         )
         if b is not None:
-            y = y + b.reshape(1, -1, 1, 1)
+            y = y + (b.reshape(1, 1, 1, -1) if h.layout == "NHWC"
+                     else b.reshape(1, -1, 1, 1))
         return y.astype(x.dtype)
 
 
@@ -116,7 +132,8 @@ class ConvTransposeHandle:
 
     def __init__(self, x, kernel_size, stride, padding, in_channels,
                  out_channels, bias=True, group=1, dilation=1,
-                 output_padding=0):
+                 output_padding=0, layout=None):
+        from .layout import current_layout
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
         self.dilation = _pair(dilation)
@@ -131,10 +148,14 @@ class ConvTransposeHandle:
         self.out_channels = int(out_channels)
         self.bias = bool(bias)
         self.group = int(group)
-        self.dimension_numbers = ("NCHW", "OIHW", "NCHW")
+        self.layout = (layout or current_layout()).upper()
+        self.dimension_numbers = (self.layout, "OIHW", self.layout)
 
     def output_shape(self, x_shape):
-        n, _, h, w = x_shape
+        if self.layout == "NHWC":
+            n, h, w, _ = x_shape
+        else:
+            n, _, h, w = x_shape
         (p0, p1), (q0, q1) = self.padding
         kh, kw = self.kernel_size
         sh, sw = self.stride
@@ -142,6 +163,8 @@ class ConvTransposeHandle:
         oph, opw = self.output_padding
         oh = (h - 1) * sh - p0 - p1 + dh * (kh - 1) + 1 + oph
         ow = (w - 1) * sw - q0 - q1 + dw * (kw - 1) + 1 + opw
+        if self.layout == "NHWC":
+            return (n, oh, ow, self.out_channels)
         return (n, self.out_channels, oh, ow)
 
 
@@ -182,7 +205,8 @@ class _ConvTranspose2d(Operator):
             feature_group_count=h.group,
         )
         if b is not None:
-            y = y + b.reshape(1, -1, 1, 1)
+            y = y + (b.reshape(1, 1, 1, -1) if h.layout == "NHWC"
+                     else b.reshape(1, -1, 1, 1))
         return y.astype(x.dtype)
 
 

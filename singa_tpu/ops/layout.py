@@ -1,0 +1,60 @@
+"""Activation-layout selection for the 2-D CNN stack (NCHW vs NHWC).
+
+The reference API is NCHW end-to-end (cuDNN's native layout,
+src/model/operation/convolution.h:43-90). On TPU the MXU wants the
+channel dimension in the 128-lane minor position, so NHWC activations
+avoid the relayout copies XLA otherwise inserts around every conv/BN
+fusion. This module provides the one switch the conv/pool/BN handles
+consult at construction time:
+
+- the *public* tensor API stays NCHW (reference parity);
+- a model that opts in (e.g. ``models.resnet.create_model(layout="NHWC")``)
+  transposes its input once at the stem and runs its whole conv trunk
+  channels-last, with weights still stored OIHW so checkpoints are
+  layout-independent.
+
+Which layout is faster is a hardware question, answered by the banked
+``resnet_layout_ab`` probe (tools/tpu_probe_extra.py) — bench.py picks
+the measured winner, never a guess.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_VALID = ("NCHW", "NHWC")
+
+
+def _env_default() -> str:
+    v = os.environ.get("SINGA_CONV_LAYOUT", "NCHW").upper()
+    return v if v in _VALID else "NCHW"
+
+
+_stack = [_env_default()]
+
+
+def current_layout() -> str:
+    """Layout new conv/pool/BN handles capture (handles read this once
+    at construction; op forward paths use the captured value)."""
+    return _stack[-1]
+
+
+def channel_axis(ndim: int = 4) -> int:
+    """Channel axis of an activation under the current layout."""
+    return 1 if current_layout() == "NCHW" or ndim == 2 else ndim - 1
+
+
+@contextlib.contextmanager
+def use_layout(layout: str):
+    """Scope a layout for handle construction and deferred layer init —
+    a model's forward wraps its conv trunk in this so its layers
+    initialize channels-last without any global state leaking out."""
+    layout = str(layout).upper()
+    if layout not in _VALID:
+        raise ValueError(f"layout must be one of {_VALID}, got {layout!r}")
+    _stack.append(layout)
+    try:
+        yield
+    finally:
+        _stack.pop()

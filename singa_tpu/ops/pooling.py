@@ -29,7 +29,9 @@ class PoolingHandle:
     ((ph0, ph1), (pw0, pw1)) for asymmetric padding (ONNX import).
     """
 
-    def __init__(self, x, kernel_size, stride=None, padding=0, is_max=True):
+    def __init__(self, x, kernel_size, stride=None, padding=0, is_max=True,
+                 layout=None):
+        from .layout import current_layout
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride if stride is not None else kernel_size)
         if (isinstance(padding, (tuple, list)) and len(padding) == 2
@@ -41,11 +43,17 @@ class PoolingHandle:
             self.pad_pairs = ((ph, ph), (pw, pw))
             self.padding = (ph, pw)
         self.is_max_pooling = bool(is_max)
+        self.layout = (layout or current_layout()).upper()
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.batchsize = int(xs[0])
-        self.channels = int(xs[1])
+        if self.layout == "NHWC" and len(xs) == 4:
+            self.channels = int(xs[3])
+            self.height, self.width = int(xs[1]), int(xs[2])
+        else:
+            self.channels = int(xs[1])
+            if len(xs) == 4:
+                self.height, self.width = int(xs[2]), int(xs[3])
         if len(xs) == 4:
-            self.height, self.width = int(xs[2]), int(xs[3])
             kh, kw = self.kernel_size
             sh, sw = self.stride
             (p0, p1), (q0, q1) = self.pad_pairs
@@ -62,9 +70,14 @@ class _Pooling2d(Operator):
         h = self.handle
         kh, kw = h.kernel_size
         sh, sw = h.stride
-        dims = (1, 1, kh, kw)
-        strides = (1, 1, sh, sw)
-        pads = ((0, 0), (0, 0), h.pad_pairs[0], h.pad_pairs[1])
+        if h.layout == "NHWC":
+            dims = (1, kh, kw, 1)
+            strides = (1, sh, sw, 1)
+            pads = ((0, 0), h.pad_pairs[0], h.pad_pairs[1], (0, 0))
+        else:
+            dims = (1, 1, kh, kw)
+            strides = (1, 1, sh, sw)
+            pads = ((0, 0), (0, 0), h.pad_pairs[0], h.pad_pairs[1])
         if h.is_max_pooling:
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
                 else jnp.iinfo(x.dtype).min

@@ -761,7 +761,8 @@ class SingaBackend:
                     in_channels=ins[0].shape[1],
                     out_channels=ins[1].shape[0],
                     bias=len(ins) > 2, group=a.get("group", 1),
-                    dilation=tuple(a.get("dilations", [1] * len(ks))))
+                    dilation=tuple(a.get("dilations", [1] * len(ks))),
+                    layout="NCHW")
                 node.cache["handle"] = handle
             return conv2d(handle, ins[0], ins[1],
                           ins[2] if len(ins) > 2 else None)
@@ -780,7 +781,8 @@ class SingaBackend:
                     bias=len(ins) > 2, group=group,
                     dilation=tuple(a.get("dilations", [1] * len(ks))),
                     output_padding=tuple(
-                        a.get("output_padding", [0] * len(ks))))
+                        a.get("output_padding", [0] * len(ks))),
+                    layout="NCHW")
                 node.cache["handle"] = handle
             return conv_transpose2d(handle, ins[0], ins[1],
                                     ins[2] if len(ins) > 2 else None)
@@ -795,7 +797,7 @@ class SingaBackend:
                     ins[0], tuple(ks),
                     tuple(a.get("strides", [1] * len(ks))),
                     ((pads[0], pads[2]), (pads[1], pads[3])),
-                    is_max=(ty == "MaxPool"))
+                    is_max=(ty == "MaxPool"), layout="NCHW")
                 node.cache["handle"] = handle
             return pooling_2d(handle, ins[0])
         if ty == "GlobalAveragePool":
@@ -804,7 +806,8 @@ class SingaBackend:
             handle = node.cache.get("handle")
             if handle is None:
                 handle = BatchNormHandle(a.get("momentum", 0.9), ins[0],
-                                         a.get("epsilon", 1e-5))
+                                         a.get("epsilon", 1e-5),
+                                         layout="NCHW")
                 node.cache["handle"] = handle
             x, scale, bias, mean, var = ins
             return batchnorm_2d(handle, x, scale, bias, mean, var)

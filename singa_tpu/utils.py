@@ -31,10 +31,18 @@ def force_completion(x) -> float:
     import jax
     import numpy as np
 
-    for leaf in jax.tree_util.tree_leaves(x):
+    leaves = jax.tree_util.tree_leaves(x)
+    for leaf in leaves:
         if hasattr(leaf, "dtype") and getattr(leaf, "size", 0):
             return float(np.asarray(
                 jnp.sum(jnp.ravel(leaf)[:1]).astype(jnp.float32)))
+    # no sizeable leaf to fetch (empty arrays / scalar-free pytree):
+    # fall back to block_until_ready so the caller still gets SOME
+    # synchronization instead of a silent no-op (on the axon tunnel
+    # this is enqueue-ACK semantics — weaker, but never nothing)
+    for leaf in leaves:
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
     return 0.0
 
 

@@ -185,3 +185,64 @@ def test_leg_timeout_record_counts_as_partial():
     complete = _bench_rec(age_s=3600, bf16_throughput=2000.0)
     res, _ = bench._fold_banked(rec, [complete, rec], MAX_AGE, [])
     assert res["measured_at"] == complete["ts"]
+
+
+def test_conv_layout_env_pin(monkeypatch):
+    monkeypatch.setenv("BENCH_CONV_LAYOUT", "nhwc")
+    assert bench._conv_layout() == ("NHWC", "env")
+    monkeypatch.setenv("BENCH_CONV_LAYOUT", "NCHW")
+    assert bench._conv_layout() == ("NCHW", "env")
+
+
+def test_conv_layout_auto_uses_banked_ab(monkeypatch):
+    """auto picks the measured winner of the newest banked layout A/B —
+    the probe that runs BEFORE the full bench in a TPU window — and
+    falls back to NCHW (labeled unmeasured) when none exists."""
+    monkeypatch.delenv("BENCH_CONV_LAYOUT", raising=False)
+    monkeypatch.setattr(bench, "_load_obs", lambda: [])
+    assert bench._conv_layout() == ("NCHW", "default-unmeasured")
+    obs = [
+        {"event": "extra", "extra": "resnet_layout_ab", "winner": "NCHW"},
+        {"event": "extra", "extra": "resnet_layout_ab", "winner": "NHWC"},
+    ]
+    monkeypatch.setattr(bench, "_load_obs", lambda: obs)
+    assert bench._conv_layout() == ("NHWC", "measured-ab")
+    # error-shaped records (no winner) are skipped
+    obs.append({"event": "extra", "extra": "resnet_layout_ab_error",
+                "error": "x"})
+    assert bench._conv_layout() == ("NHWC", "measured-ab")
+
+
+def test_fold_extras_latest_per_leg_and_compact_profile():
+    obs = [
+        {"event": "extra", "ts": _ts(7200),
+         "extra": "lm_decode_tokens_per_sec", "value": 100.0},
+        {"event": "extra", "ts": _ts(3600),
+         "extra": "lm_decode_tokens_per_sec", "value": 120.0},
+        {"event": "extra", "ts": _ts(3600),
+         "extra": "lm_decode_tokens_per_sec_error", "error": "boom"},
+        {"event": "extra", "ts": _ts(1800),
+         "extra": "resnet50_bf16_fusion_profile",
+         "total_measured_s": 0.5,
+         "top": [{"op": f"f{i}", "pct": 10} for i in range(10)]},
+        {"event": "smoke", "smoke": "device"},
+    ]
+    out = bench._fold_extras(obs)
+    # newest success wins; error records never fold
+    assert out["lm_decode_tokens_per_sec"]["value"] == 120.0
+    assert "error" not in out["lm_decode_tokens_per_sec"]
+    # profile folds compact: top-3 only
+    assert len(out["resnet50_bf16_fusion_profile"]["top"]) == 3
+    assert out["resnet50_bf16_fusion_profile"]["total_measured_s"] == 0.5
+
+
+def test_peak_flops_per_dtype(monkeypatch):
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS", raising=False)
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS_FP32", raising=False)
+    # no public fp32 peak: both dtypes get the chip (bf16) figure...
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v5 lite", dtype="fp32") == 197e12
+    # ...unless the caller supplies a distinct fp32 denominator
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS_FP32", "50")
+    assert bench._peak_flops("TPU v5 lite", dtype="fp32") == 50e12
+    assert bench._peak_flops("TPU v5 lite") == 197e12

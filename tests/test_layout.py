@@ -1,0 +1,183 @@
+"""NHWC (channels-last) layout mode: parity with the NCHW reference path.
+
+The reference stack is NCHW-only (cuDNN's native layout,
+src/model/operation/convolution.h:43-90). The TPU build adds an NHWC
+activation mode (ops/layout.py) because the MXU wants channels in the
+128-lane minor dim; weights stay OIHW so checkpoints are identical.
+These tests pin the invariant that makes the bench's measured layout
+A/B (tools/tpu_probe_extra.py resnet_layout_ab) a fair comparison:
+both layouts compute the SAME function.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from singa_tpu import device, opt, tensor
+from singa_tpu.ops.conv import (ConvHandle, ConvTransposeHandle, conv2d,
+                                conv_transpose2d)
+from singa_tpu.ops.pooling import PoolingHandle, pooling_2d
+from singa_tpu.ops.batchnorm import BatchNormHandle, batchnorm_2d
+from singa_tpu.ops import layout as L
+
+
+@pytest.fixture
+def dev():
+    return device.create_cpu_device()
+
+
+def _nchw_to_nhwc(x):
+    return np.transpose(x, (0, 2, 3, 1))
+
+
+def test_layout_stack_and_validation():
+    assert L.current_layout() == "NCHW"
+    with L.use_layout("nhwc"):
+        assert L.current_layout() == "NHWC"
+        assert L.channel_axis(4) == 3
+        assert L.channel_axis(2) == 1
+        with L.use_layout("NCHW"):
+            assert L.current_layout() == "NCHW"
+        assert L.current_layout() == "NHWC"
+    assert L.current_layout() == "NCHW"
+    with pytest.raises(ValueError):
+        with L.use_layout("NWHC"):
+            pass
+
+
+def test_conv2d_nhwc_matches_nchw(dev):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 9, 9).astype(np.float32)
+    W = rng.randn(4, 5, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev)
+    tW = tensor.Tensor(data=W, device=dev)
+    tb = tensor.Tensor(data=b, device=dev)
+    h = ConvHandle(x, 3, 2, 1, 5, 4)
+    ref = tensor.to_numpy(conv2d(h, tx, tW, tb))
+
+    xt = _nchw_to_nhwc(x)
+    h2 = ConvHandle(xt, 3, 2, 1, 5, 4, layout="NHWC")
+    assert h2.dimension_numbers == ("NHWC", "OIHW", "NHWC")
+    assert h2.output_shape(xt.shape) == tuple(
+        np.transpose(ref, (0, 2, 3, 1)).shape)
+    txt = tensor.Tensor(data=xt, device=dev)
+    got = tensor.to_numpy(conv2d(h2, txt, tW, tb))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_nhwc_grouped(dev):
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    W = rng.randn(6, 2, 3, 3).astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev)
+    tW = tensor.Tensor(data=W, device=dev)
+    ref = tensor.to_numpy(conv2d(ConvHandle(x, 3, 1, 1, 4, 6, group=2),
+                                 tx, tW))
+    xt = _nchw_to_nhwc(x)
+    got = tensor.to_numpy(conv2d(
+        ConvHandle(xt, 3, 1, 1, 4, 6, group=2, layout="NHWC"),
+        tensor.Tensor(data=xt, device=dev), tW))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_transpose_nhwc_matches_nchw(dev):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    W = rng.randn(3, 4, 3, 3).astype(np.float32)  # (Cin, Cout, kh, kw)
+    tx = tensor.Tensor(data=x, device=dev)
+    tW = tensor.Tensor(data=W, device=dev)
+    h = ConvTransposeHandle(x, 3, 2, 1, 3, 4, output_padding=1)
+    ref = tensor.to_numpy(conv_transpose2d(h, tx, tW))
+    xt = _nchw_to_nhwc(x)
+    h2 = ConvTransposeHandle(xt, 3, 2, 1, 3, 4, output_padding=1,
+                             layout="NHWC")
+    assert h2.output_shape(xt.shape) == tuple(
+        np.transpose(ref, (0, 2, 3, 1)).shape)
+    got = tensor.to_numpy(conv_transpose2d(
+        h2, tensor.Tensor(data=xt, device=dev), tW))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("is_max", [True, False])
+def test_pooling_nhwc_matches_nchw(dev, is_max):
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev)
+    ref = tensor.to_numpy(pooling_2d(
+        PoolingHandle(x, 3, 2, 1, is_max=is_max), tx))
+    xt = _nchw_to_nhwc(x)
+    h = PoolingHandle(xt, 3, 2, 1, is_max=is_max, layout="NHWC")
+    assert h.channels == 3 and h.height == 8
+    got = tensor.to_numpy(pooling_2d(
+        h, tensor.Tensor(data=xt, device=dev)))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_nhwc_matches_nchw(dev, training_mode):
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 3, 6, 6).astype(np.float32)
+    scale = rng.rand(3).astype(np.float32) + 0.5
+    bias = rng.randn(3).astype(np.float32)
+
+    def run(xin, layout):
+        tx = tensor.Tensor(data=xin, device=dev)
+        ts = tensor.Tensor(data=scale, device=dev)
+        tb = tensor.Tensor(data=bias, device=dev)
+        rm = tensor.Tensor(data=np.zeros(3, np.float32), device=dev,
+                           requires_grad=False)
+        rv = tensor.Tensor(data=np.ones(3, np.float32), device=dev,
+                           requires_grad=False)
+        h = BatchNormHandle(0.9, xin, layout=layout)
+        y = batchnorm_2d(h, tx, ts, tb, rm, rv)
+        return tensor.to_numpy(y), np.asarray(rm.data), np.asarray(rv.data)
+
+    ref, rm_ref, rv_ref = run(x, "NCHW")
+    got, rm_got, rv_got = run(_nchw_to_nhwc(x), "NHWC")
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                               rtol=1e-5, atol=1e-5)
+    # running-stat updates must agree too (same per-channel moments)
+    np.testing.assert_allclose(rm_got, rm_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(rv_got, rv_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_layout_train_parity(dev):
+    """End-to-end: same seed, same data — the NHWC ResNet's losses track
+    the NCHW ones step for step (same function, same init, same update)."""
+    from singa_tpu.models import resnet
+
+    def losses(lay):
+        d = device.create_cpu_device()
+        d.SetRandSeed(0)
+        m = resnet.create_model(depth=18, num_classes=10, layout=lay)
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 32, 32).astype(np.float32)
+        y = np.eye(10)[rng.randint(0, 10, 2)].astype(np.float32)
+        tx = tensor.Tensor(data=x, device=d, requires_grad=False)
+        ty = tensor.Tensor(data=y, device=d, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+        out = []
+        for _ in range(2):
+            _, loss = m(tx, ty)
+            out.append(float(loss.data))
+        return out
+
+    a, b = losses("NCHW"), losses("NHWC")
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_layout_env_default(monkeypatch):
+    monkeypatch.setattr(L, "_stack", ["NCHW"])
+    x = np.zeros((1, 2, 4, 4), np.float32)
+    assert ConvHandle(x, 3, 1, 1, 2, 2).layout == "NCHW"
+    with L.use_layout("NHWC"):
+        xt = np.zeros((1, 4, 4, 2), np.float32)
+        h = ConvHandle(xt, 3, 1, 1, 2, 2)
+        assert h.layout == "NHWC"
+        # explicit beats ambient
+        assert ConvHandle(x, 3, 1, 1, 2, 2, layout="NCHW").layout == "NCHW"

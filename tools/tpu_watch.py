@@ -42,15 +42,28 @@ def _complete_bench(o):
 
 
 # per-leg SUCCESS markers in the banked observations (error records use
-# different names on purpose, so a failed leg is retried)
+# different names on purpose, so a failed leg is retried). Ordered by
+# information value — _extras_missing() preserves this order and the
+# probe child runs legs in it.
 _EXTRA_LEG_MARKERS = {
+    # diagnostics no round has ever banked (VERDICT r4 next-round #1):
+    # the fusion profile says WHERE the 30%-MFU step spends its time;
+    # the layout A/B answers the NCHW-vs-NHWC question and steers the
+    # full benchmark that follows in the same window
+    "resnet_fusion_profile": "resnet50_bf16_fusion_profile",
+    "resnet_layout_ab": "resnet_layout_ab",
+    # flagship legs with code but no hardware numbers (VERDICT #2, #7)
+    "lm_long_context": "lm_bf16_s4096_remat_tokens_per_sec",
+    "lm_decode_throughput": "lm_decode_tokens_per_sec",
+    "hbm_footprint": "hbm_footprint",
+    # re-confirmations of round-4 measurements: last
+    "resnet50_bf16_large_batch": "resnet50_bf16_b128",
     "mlp_step_time": "mlp_mnist_b64_step_us",
     "flash_block_sweep": "flash_block_best",
-    "resnet50_bf16_large_batch": "resnet50_bf16_b128",
-    "lm_long_context": "lm_bf16_s4096_remat_tokens_per_sec",
-    "resnet_fusion_profile": "resnet50_bf16_fusion_profile",
-    "lm_decode_throughput": "lm_decode_tokens_per_sec",
 }
+
+# run BEFORE the full benchmark in a fresh window (their results steer it)
+PRIORITY_LEGS = ("resnet_fusion_profile", "resnet_layout_ab")
 
 
 def _extras_missing():
@@ -81,7 +94,7 @@ def _n_banked_successes():
                and o.get("error") is None)
 
 
-def _run_extras(legs):
+def _run_extras(legs, timeout=1500):
     """One bounded child of tools/tpu_probe_extra.py, restricted to the
     still-missing legs (it takes the TPU lock itself — call AFTER
     releasing ours). Returns the number of records the child banked —
@@ -93,7 +106,7 @@ def _run_extras(legs):
     try:
         proc = subprocess.run([sys.executable, script],
                               capture_output=True, text=True,
-                              timeout=1500, env=env)
+                              timeout=timeout, env=env)
         rc = proc.returncode
     except subprocess.TimeoutExpired:
         rc = "timeout"
@@ -139,52 +152,84 @@ def main():
             bench._record_obs("probe", {"status": status, "err": err,
                                         "src": "watch"})
             log(f"probe#{n}: {status}{' (' + err + ')' if err else ''}")
-            # probes are cheap (one 120s child) — keep the fast cadence
-            # even after a complete bench is banked, or short windows go
-            # unseen. Only the EXPENSIVE smoke+bench re-run is throttled
-            # to once per BANKED_SLEEP after a complete bank — gated on
-            # when the heavy work last RAN (not last succeeded), so a
-            # failed refresh doesn't put the expensive path on every
-            # 8-minute probe.
-            if status == "ok" and (not banked or
-                                   time.time() - last_heavy >= BANKED_SLEEP):
-                if banked:
-                    last_heavy = time.time()
-                smoke = bench._attempt_smoke(300)
-                for rec in smoke:
-                    bench._record_obs("smoke", rec)
-                log(f"smoke: {len(smoke)} sub-results banked")
-                res, aerr = bench._attempt("tpu", 1500)
-                if res is not None:
-                    bench._record_obs("bench", res)
-                    thr = res.get("throughput")
-                    log(f"BENCH BANKED: {thr} img/s on "
-                        f"{res.get('device_kind')} "
-                        f"(partial={bool(res.get('partial_timeout') or res.get('partial_crash') or res.get('partial'))})")
-                    if _complete_bench(dict(res, event="bench",
-                                            platform=res.get("platform"))):
-                        banked = True
-                        last_heavy = time.time()
+        if status != "ok":
+            time.sleep(IDLE_SLEEP)
+            continue
+        # probes are cheap (one 120s child) — keep the fast cadence
+        # even after a complete bench is banked, or short windows go
+        # unseen. Only the EXPENSIVE heavy sequence is throttled to
+        # once per BANKED_SLEEP after a complete bank — gated on when
+        # the heavy work last RAN (not last succeeded), so a failed
+        # refresh doesn't put the expensive path on every probe.
+        if not banked or time.time() - last_heavy >= BANKED_SLEEP:
+            ran_heavy = False   # heavy work actually attempted this cycle
+            # 1. cheap layered evidence first: a window that dies in
+            #    3 minutes still leaves device + matmul-peak + flash
+            #    records behind
+            with bench._TpuLock(wait_s=60) as lock:
+                if lock.acquired:
+                    ran_heavy = True
+                    smoke = bench._attempt_smoke(300)
+                    for rec in smoke:
+                        bench._record_obs("smoke", rec)
+                    log(f"smoke: {len(smoke)} sub-results banked")
                 else:
-                    log(f"full bench attempt failed: {aerr}")
-            elif status == "ok":
-                log(f"cycle#{n}: window live, bench recently banked — "
-                    f"next re-run in "
-                    f"{int(BANKED_SLEEP - (time.time() - last_heavy))}s")
-        # window still live after a complete bank: spend it on the
-        # extra measurements, retrying ONLY the legs whose success
-        # marker isn't banked yet (outside our lock — the child
-        # serializes itself). A try only counts when the child banked
-        # something — a no-work exit (lock busy, tunnel already gone)
-        # must not burn the budget; extras_calls hard-caps the loop.
-        if banked and status == "ok" and extras_tries < 3 \
-                and extras_calls < 8:
+                    log(f"cycle#{n}: smoke skipped (tpu lock busy)")
+            # 2. the never-banked diagnostics BEFORE the known bench
+            #    (VERDICT r4 #1): the fusion profile explains the MFU
+            #    gap; the layout A/B's banked winner steers the conv
+            #    layout of the full benchmark that follows
+            if extras_calls < 10:
+                pri = [leg for leg in PRIORITY_LEGS
+                       if leg in _extras_missing()]
+                if pri:
+                    extras_calls += 1
+                    log(f"window live: PRIORITY diagnostics first {pri}")
+                    # generous budget: the layout A/B's NHWC variant is
+                    # a cold compile the cache has never seen
+                    if _run_extras(pri, timeout=2100) > 0:
+                        extras_tries += 1
+            # 3. the scored 4-leg benchmark (fp32/bf16/lm/lm_bf16 —
+            #    banks lm_mfu and lm_bf16_mfu)
+            with bench._TpuLock(wait_s=60) as lock:
+                if lock.acquired:
+                    ran_heavy = True
+                    res, aerr = bench._attempt("tpu", 1500)
+                    if res is not None:
+                        bench._record_obs("bench", res)
+                        thr = res.get("throughput")
+                        log(f"BENCH BANKED: {thr} img/s on "
+                            f"{res.get('device_kind')} "
+                            f"(layout={res.get('conv_layout')}, "
+                            f"partial={bool(res.get('partial_timeout') or res.get('partial_crash') or res.get('partial'))})")
+                        if _complete_bench(dict(res, event="bench",
+                                                platform=res.get("platform"))):
+                            banked = True
+                    else:
+                        log(f"full bench attempt failed: {aerr}")
+                else:
+                    log(f"cycle#{n}: bench re-run skipped (tpu lock busy)")
+            # the refresh throttle starts only when heavy work actually
+            # RAN — a busy lock must not silence the re-attempt for a
+            # whole BANKED_SLEEP
+            if banked and ran_heavy:
+                last_heavy = time.time()
+        else:
+            log(f"cycle#{n}: window live, bench recently banked — "
+                f"next re-run in "
+                f"{int(BANKED_SLEEP - (time.time() - last_heavy))}s")
+        # 4. window still live: spend it on the remaining extra
+        # measurements, retrying ONLY the legs whose success marker
+        # isn't banked yet (outside our lock — the child serializes
+        # itself). A try only counts when the child banked something —
+        # a no-work exit (lock busy, tunnel already gone) must not burn
+        # the budget; extras_calls hard-caps the loop.
+        if extras_tries < 5 and extras_calls < 10:
             missing = _extras_missing()
             if missing:
                 extras_calls += 1
-                log(f"window live, bench banked: extras run for "
-                    f"{missing} (productive tries so far: "
-                    f"{extras_tries}/3)")
+                log(f"window live: extras run for {missing} "
+                    f"(productive tries so far: {extras_tries}/5)")
                 if _run_extras(missing) > 0:
                     extras_tries += 1
         time.sleep(IDLE_SLEEP)
