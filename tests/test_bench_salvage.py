@@ -187,11 +187,31 @@ def test_leg_timeout_record_counts_as_partial():
     assert res["measured_at"] == complete["ts"]
 
 
-def test_conv_layout_env_pin(monkeypatch):
+def test_conv_layout_env_pin(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_CONV_LAYOUT", "nhwc")
     assert bench._conv_layout() == ("NHWC", "env")
     monkeypatch.setenv("BENCH_CONV_LAYOUT", "NCHW")
     assert bench._conv_layout() == ("NCHW", "env")
+    # a typo'd pin is diagnosed, not silently demoted to auto
+    monkeypatch.setenv("BENCH_CONV_LAYOUT", "nwhc")
+    monkeypatch.setattr(bench, "_load_obs", lambda: [])
+    assert bench._conv_layout() == ("NCHW", "default-unmeasured")
+    assert "not nchw|nhwc|auto" in capsys.readouterr().err
+
+
+def test_extra_success_markers_single_source():
+    """The watcher's retry table IS bench's marker table (round-4 review:
+    two hand-maintained copies let a new leg's measurement silently miss
+    the report)."""
+    import importlib.util as iu
+    import os
+    spec = iu.spec_from_file_location(
+        "tpu_watch", os.path.join(os.path.dirname(bench.__file__),
+                                  "tools", "tpu_watch.py"))
+    mod = iu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._EXTRA_LEG_MARKERS is bench.EXTRA_SUCCESS_MARKERS
+    assert set(mod.PRIORITY_LEGS) <= set(bench.EXTRA_SUCCESS_MARKERS)
 
 
 def test_conv_layout_auto_uses_banked_ab(monkeypatch):

@@ -42,37 +42,27 @@ def _complete_bench(o):
 
 
 # per-leg SUCCESS markers in the banked observations (error records use
-# different names on purpose, so a failed leg is retried). Ordered by
-# information value — _extras_missing() preserves this order and the
-# probe child runs legs in it.
-_EXTRA_LEG_MARKERS = {
-    # diagnostics no round has ever banked (VERDICT r4 next-round #1):
-    # the fusion profile says WHERE the 30%-MFU step spends its time;
-    # the layout A/B answers the NCHW-vs-NHWC question and steers the
-    # full benchmark that follows in the same window
-    "resnet_fusion_profile": "resnet50_bf16_fusion_profile",
-    "resnet_layout_ab": "resnet_layout_ab",
-    # flagship legs with code but no hardware numbers (VERDICT #2, #7)
-    "lm_long_context": "lm_bf16_s4096_remat_tokens_per_sec",
-    "lm_decode_throughput": "lm_decode_tokens_per_sec",
-    "hbm_footprint": "hbm_footprint",
-    # re-confirmations of round-4 measurements: last
-    "resnet50_bf16_large_batch": "resnet50_bf16_b128",
-    "mlp_step_time": "mlp_mnist_b64_step_us",
-    "flash_block_sweep": "flash_block_best",
-}
+# different names on purpose, so a failed leg is retried). The single
+# source lives in bench.EXTRA_SUCCESS_MARKERS so the report's
+# extras-folding and this retry logic can never diverge; its dict order
+# is the information-value order the probe child runs legs in —
+# never-banked diagnostics first (fusion profile explains the MFU gap,
+# layout A/B steers the full benchmark), re-confirmations last.
+_EXTRA_LEG_MARKERS = bench.EXTRA_SUCCESS_MARKERS
 
 # run BEFORE the full benchmark in a fresh window (their results steer it)
 PRIORITY_LEGS = ("resnet_fusion_profile", "resnet_layout_ab")
 
 
 def _extras_missing():
-    """Extra-probe legs whose success marker is not yet banked this
-    round — already-banked heavy legs are never re-run on a retry."""
+    """Extra-probe legs with any success marker not yet banked this
+    round — already-banked heavy legs are never re-run on a retry (a
+    multi-marker leg like hbm_footprint retries until EVERY marker is
+    banked; the probe skips its already-banked children)."""
     obs = [o for o in bench._load_obs() if o.get("event") == "extra"]
     seen = {str(o.get("extra", "")) for o in obs}
-    missing = [leg for leg, marker in _EXTRA_LEG_MARKERS.items()
-               if marker not in seen]
+    missing = [leg for leg, markers in _EXTRA_LEG_MARKERS.items()
+               if any(m not in seen for m in markers)]
     # the sweep banks each config's record as it completes; enough of
     # them IS the measurement even if the child died before printing
     # the final flash_block_best summary — don't redo the whole sweep
