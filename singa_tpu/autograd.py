@@ -404,6 +404,20 @@ class ReduceMax(Operator):
         return jnp.max(x, axis=self.axes, keepdims=self.keepdims)
 
 
+class ReduceProd(Operator):
+    """Product reduction (ONNX ReduceProd — the reference reaches it only
+    through its ONNX backend; no composition of sum/log covers negative
+    or zero values, so it is a first-class op with a vjp backward)."""
+
+    def __init__(self, axes=None, keepdims=1):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return jnp.prod(x, axis=self.axes, keepdims=self.keepdims)
+
+
 class Mean(Operator):
     """Elementwise mean of N tensors (reference autograd.Mean)."""
 
@@ -1001,6 +1015,10 @@ def reduce_mean(x, axes=None, keepdims=1):
 
 def reduce_max(x, axes=None, keepdims=1):
     return ReduceMax(axes, keepdims)(x)
+
+
+def reduce_prod(x, axes=None, keepdims=1):
+    return ReduceProd(axes, keepdims)(x)
 
 
 def mean(*xs):

@@ -20,6 +20,11 @@ class TrainStepMixin:
             self.optimizer(loss)
         elif dist_option == "half":
             self.optimizer.backward_and_update_half(loss)
+        elif dist_option == "fp16":
+            # IEEE-fp16 wire format (reference synchHalf,
+            # src/io/communicator.cc:262-299) with its overflow clip
+            self.optimizer.backward_and_update_half(
+                loss, clipping=True, dtype="float16")
         elif dist_option == "partialUpdate":
             # ``rotation`` (a STATIC python int, normally
             # step % world_size) keys the Model's compiled-step cache: n

@@ -505,15 +505,27 @@ class DistOpt:
         self.opt.step()
 
     def backward_and_update_half(self, loss, threshold=2097152,
-                                 clipping=False, clip_value=2.5):
-        """Reduced-precision communication: cast to bf16 before the
-        all-reduce (reference fp16 comm, opt.py:867-920 — bf16 is the TPU
-        native half type)."""
+                                 clipping=False, clip_value=2.5,
+                                 dtype="bfloat16"):
+        """Reduced-precision communication: cast to a 16-bit type before
+        the all-reduce (reference synchHalf fp16 comm,
+        src/io/communicator.cc:262-299). ``dtype`` selects the wire
+        format: "bfloat16" (default — the TPU-native half type, same
+        exponent range as fp32 so no clipping is required) or "float16"
+        (the reference's IEEE wire format, e.g. for DCN cross-slice links
+        where the fp16 convention is fixed; pair with ``clipping`` since
+        fp16 overflows above 65504)."""
+        wire = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                jnp.bfloat16: jnp.bfloat16,
+                jnp.float16: jnp.float16}.get(dtype)
+        if wire is None:
+            raise ValueError(
+                f"dtype must be 'bfloat16' or 'float16', got {dtype!r}")
         for p, g in autograd.backward(loss):
             grad = g.data
             if clipping:
                 grad = jnp.clip(grad, -clip_value, clip_value)
-            half = grad.astype(jnp.bfloat16)
+            half = grad.astype(wire)
             g.data = self.all_reduce(
                 half, exclude=self._shard_axes(p)).astype(jnp.float32)
             self.update(p, g)

@@ -1,16 +1,30 @@
-"""Versioned parameter checkpoints.
+"""Versioned parameter checkpoints, wire-compatible with the reference.
 
 Capability parity with the reference Snapshot (src/io/snapshot.cc:33-80 and
-python/singa/snapshot.py:42-66): ``<prefix>.bin`` holds named tensors as
-key/value records through the native record-file runtime, and
-``<prefix>.desc`` is a human-readable description (name, shape, dtype) —
-the reference's TensorProto payload is replaced by a compact self-describing
-binary header, and the version tag is carried in the desc file.
+python/singa/snapshot.py:42-66). Two on-disk formats:
+
+- ``format="singa"`` — the reference's exact bytes: ``<prefix>.bin`` is a
+  BinFile ('s','g' magic-word KV records, src/io/binfile_writer.cc) whose
+  values are serialized ``TensorProto`` messages (src/proto/core.proto:70
+  — shape/data_type/stride/float_data...), and ``<prefix>.desc`` is the
+  text sidecar whose first line carries ``SINGA VERSION: 4000``
+  (snapshot.cc:46 — major*1000+minor*100+patch) followed by one
+  ``parameter name: ...`` line per tensor (snapshot.cc:97-103). A real
+  SINGA 4.0.0 checkpoint loads here, and a snapshot written here loads in
+  real SINGA (float32/double/int payloads — the dtypes the reference's
+  ``to_proto`` implements, tensor.cc:364-418).
+- ``format="native"`` — this framework's record-file runtime
+  (``SGTPREC0`` magic) with a compact self-describing array header;
+  supports every dtype (incl. bf16) and streams through the threaded
+  native reader.
+
+Reads auto-detect the format from the magic bytes.
 """
 
 from __future__ import annotations
 
 import os
+import struct
 
 import numpy as np
 
@@ -18,6 +32,152 @@ from .native import RecordReader, RecordWriter
 from .tensor import Tensor
 
 VERSION = 1
+# reference version tag written to .desc (CMakeLists.txt:41 for 4.0.0)
+SINGA_VERSION = 4000
+
+# reference core.proto DataType values (core.proto:26-34)
+_K_FLOAT32, _K_FLOAT16, _K_INT, _K_CHAR, _K_DOUBLE, _K_UCHAR = range(6)
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf, off):
+    n = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def _pack_tensorproto(arr: np.ndarray) -> bytes:
+    """Serialize the reference TensorProto wire format
+    (core.proto:70-78; payload field per dtype as tensor.cc to_proto)."""
+    out = bytearray()
+    for s in arr.shape:                       # field 1: repeated uint32
+        out += b"\x08" + _varint(int(s))
+    if arr.dtype == np.float32:
+        dt, field, payload = _K_FLOAT32, 4, arr.astype("<f4").tobytes()
+    elif arr.dtype == np.float64:
+        dt, field, payload = _K_DOUBLE, 5, arr.astype("<f8").tobytes()
+    elif arr.dtype in (np.int32, np.int64):
+        # the reference's kInt payload is int32 (core.proto:29): int64
+        # input is accepted only when every value fits — a silent
+        # wraparound on reload would corrupt step counters
+        if arr.dtype == np.int64 and (
+                arr.min(initial=0) < -2**31 or
+                arr.max(initial=0) >= 2**31):
+            raise ValueError(
+                "int64 values exceed the reference kInt (int32) range — "
+                "use format='native' for full-width integers")
+        dt, field = _K_INT, 6
+        payload = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                           for v in arr.astype(np.int64).ravel())
+    else:
+        raise ValueError(
+            f"dtype {arr.dtype} has no reference TensorProto payload "
+            f"(to_proto implements float32/double/int, tensor.cc:364) — "
+            f"use format='native' for {arr.dtype}")
+    out += b"\x10" + _varint(dt)              # field 2: data_type
+    # field 3 (stride) is omitted: FromProto recomputes a dense layout
+    out += _varint(field << 3 | 2) + _varint(len(payload)) + payload
+    return bytes(out)
+
+
+def _unpack_tensorproto(raw: bytes) -> np.ndarray:
+    shape, dtype = [], _K_FLOAT32
+    floats = bytearray()
+    doubles = bytearray()
+    ints = []
+    off = 0
+    while off < len(raw):
+        tag, off = _read_varint(raw, off)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, off = _read_varint(raw, off)
+            if field == 1:
+                shape.append(v)
+            elif field == 2:
+                dtype = v
+            elif field == 6:
+                ints.append(v)
+            # field 3 (stride) ignored: dense layout is recomputed
+        elif wire == 2:
+            ln, off = _read_varint(raw, off)
+            chunk = raw[off:off + ln]
+            off += ln
+            if field == 4:
+                floats += chunk
+            elif field == 5:
+                doubles += chunk
+            elif field == 6:
+                o2 = 0
+                while o2 < len(chunk):
+                    v, o2 = _read_varint(chunk, o2)
+                    ints.append(v)
+        elif wire == 5:                       # unpacked float
+            if field == 4:
+                floats += raw[off:off + 4]
+            off += 4
+        elif wire == 1:                       # unpacked double
+            if field == 5:
+                doubles += raw[off:off + 8]
+            off += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    if dtype == _K_DOUBLE:
+        arr = np.frombuffer(bytes(doubles), "<f8")
+    elif dtype == _K_INT:
+        arr = np.asarray([v - (1 << 64) if v >= (1 << 63) else v
+                          for v in ints], np.int64).astype(np.int32)
+    else:
+        arr = np.frombuffer(bytes(floats), "<f4")
+    return arr.reshape(shape).copy()
+
+
+def _binfile_write(f, key: str, value: bytes) -> None:
+    """One reference BinFile record (src/io/binfile_writer.cc:60-80):
+    magic 's','g',has_key,0 then size_t-framed key and value."""
+    kb = key.encode("utf-8")
+    if kb:
+        f.write(b"sg\x01\x00" + struct.pack("<Q", len(kb)) + kb
+                + struct.pack("<Q", len(value)) + value)
+    else:
+        f.write(b"sg\x00\x00" + struct.pack("<Q", len(value)) + value)
+
+
+def _binfile_read(path):
+    """Yield (key, value) from a reference BinFile."""
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off < len(data):
+        if data[off:off + 2] != b"sg":
+            raise ValueError(f"bad BinFile magic at offset {off}")
+        has_key = data[off + 2]
+        off += 4
+        key = ""
+        if has_key:
+            (klen,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            key = data[off:off + klen].decode("utf-8")
+            off += klen
+        (vlen,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        yield key, data[off:off + vlen]
+        off += vlen
 
 
 def _encode_array(arr: np.ndarray) -> bytes:
@@ -46,33 +206,91 @@ def _decode_array(raw: bytes) -> np.ndarray:
     return np.frombuffer(raw, dtype=dt, offset=off).reshape(shape).copy()
 
 
+_K_BY_DTYPE = {np.dtype(np.float32): _K_FLOAT32,
+               np.dtype(np.float64): _K_DOUBLE,
+               np.dtype(np.int32): _K_INT,
+               np.dtype(np.int64): _K_INT}
+
+
 class Snapshot:
     """Write or read a parameter checkpoint (reference
-    python/singa/snapshot.py:42; kWrite/kRead modes)."""
+    python/singa/snapshot.py:42; kWrite/kRead modes).
+
+    ``format`` applies to writes: "singa" (default — reference 4.0.0
+    wire compatibility) or "native". Reads auto-detect from the magic
+    bytes, so both kinds (and real SINGA checkpoints) load through the
+    same constructor; like the reference reader (snapshot.cc:60-64),
+    a ``<prefix>.model`` BinFile from SINGA 1.0.0 is accepted when no
+    ``.bin`` exists."""
 
     kRead = False
     kWrite = True
 
-    def __init__(self, prefix: str, mode: bool, buffer_size: int = 10):
+    def __init__(self, prefix: str, mode: bool, buffer_size: int = 10,
+                 format: str = "singa"):
         self.prefix = prefix
         self.mode = mode
+        if format not in ("singa", "native"):
+            raise ValueError(f"format must be 'singa' or 'native', "
+                             f"got {format!r}")
+        self.format = format
         if mode == self.kWrite:
-            self._writer = RecordWriter(prefix + ".bin")
+            self._names = set()
+            if format == "native":
+                self._writer = RecordWriter(prefix + ".bin")
+            else:
+                self._writer = open(prefix + ".bin", "wb")
             self._desc = open(prefix + ".desc", "w")
-            self._desc.write(f"version: {VERSION}\n")
+            if format == "singa":
+                # snapshot.cc:46 — version header line
+                self._desc.write(f"SINGA VERSION: {SINGA_VERSION}\n")
+            else:
+                self._desc.write(f"version: {VERSION}\n")
         else:
-            if not os.path.exists(prefix + ".bin"):
-                raise FileNotFoundError(prefix + ".bin")
-            self._reader = RecordReader(prefix + ".bin")
+            path = prefix + ".bin"
+            if not os.path.exists(path):
+                # SINGA 1.0.0 wrote <prefix>.model (snapshot.cc:62)
+                if os.path.exists(prefix + ".model"):
+                    path = prefix + ".model"
+                else:
+                    raise FileNotFoundError(prefix + ".bin")
+            with open(path, "rb") as f:
+                head = f.read(8)
+            self._read_path = path
+            self._read_native = head == RecordWriter.MAGIC \
+                if hasattr(RecordWriter, "MAGIC") else \
+                head == b"SGTPREC0"
+            if self._read_native:
+                self._reader = RecordReader(path)
+            else:
+                if head[:2] != b"sg":
+                    raise ValueError(
+                        f"{path}: neither a native record file nor a "
+                        f"SINGA BinFile (magic {head[:2]!r})")
+                self._reader = None
 
     def write(self, param_name: str, param_val) -> None:
         assert self.mode == self.kWrite, "snapshot opened for read"
+        # reference Snapshot::Write CHECKs key uniqueness (snapshot.cc:88)
+        if param_name in self._names:
+            raise ValueError(f"duplicate snapshot key {param_name!r}")
+        self._names.add(param_name)
         arr = np.asarray(param_val.numpy()
                          if isinstance(param_val, Tensor) else param_val)
-        self._writer.write(param_name, _encode_array(arr))
-        self._desc.write(
-            f"name: {param_name} shape: {list(arr.shape)} "
-            f"dtype: {arr.dtype.name}\n")
+        if self.format == "singa":
+            _binfile_write(self._writer, param_name,
+                           _pack_tensorproto(arr))
+            # snapshot.cc:97-103 desc line, byte for byte
+            dt = _K_BY_DTYPE.get(arr.dtype)
+            shape_str = "".join(f" {s}" for s in arr.shape)
+            self._desc.write(
+                f"parameter name: {param_name}\tdata type: {dt}"
+                f"\tdim: {arr.ndim}\tshape:{shape_str}\n")
+        else:
+            self._writer.write(param_name, _encode_array(arr))
+            self._desc.write(
+                f"name: {param_name} shape: {list(arr.shape)} "
+                f"dtype: {arr.dtype.name}\n")
 
     def read(self):
         """All params as an OrderedDict name -> Tensor (reference
@@ -80,17 +298,24 @@ class Snapshot:
         assert self.mode == self.kRead, "snapshot opened for write"
         from collections import OrderedDict
         out = OrderedDict()
-        self._reader.seek_to_first()
-        for key, val in self._reader:
-            out[key.decode("utf-8")] = Tensor(data=_decode_array(val),
-                                              requires_grad=False)
+        if self._read_native:
+            self._reader.seek_to_first()
+            for key, val in self._reader:
+                out[key.decode("utf-8")] = Tensor(
+                    data=_decode_array(val), requires_grad=False)
+        else:
+            for key, val in _binfile_read(self._read_path):
+                if key in out:   # reference CHECK(count == 0)
+                    raise ValueError(f"duplicate snapshot key {key!r}")
+                out[key] = Tensor(data=_unpack_tensorproto(val),
+                                  requires_grad=False)
         return out
 
     def done(self) -> None:
         if self.mode == self.kWrite:
             self._writer.close()
             self._desc.close()
-        else:
+        elif self._reader is not None:
             self._reader.close()
 
     def __enter__(self):

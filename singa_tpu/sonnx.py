@@ -101,6 +101,7 @@ class SingaFrontend:
         "Not": "Not", "Negative": "Neg", "Reciprocal": "Reciprocal",
         "ConstantOfShape": "ConstantOfShape", "Dropout": "Dropout",
         "ReduceSum": "ReduceSum", "ReduceMean": "ReduceMean",
+        "ReduceMax": "ReduceMax", "ReduceProd": "ReduceProd",
         "LeakyRelu": "LeakyRelu", "GlobalAveragePool": "GlobalAveragePool",
         "Squeeze": "Squeeze", "Unsqueeze": "Unsqueeze", "Slice": "Slice",
         "Ceil": "Ceil", "Floor": "Floor", "Abs": "Abs", "Split": "Split",
@@ -228,7 +229,7 @@ class SingaFrontend:
                 else:
                     input_names.append("")
             return "Clip", {}
-        if ty in ("ReduceSum", "ReduceMean"):
+        if ty in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceProd"):
             attrs = {"keepdims": int(op.keepdims)}
             if op.axes is not None:
                 attrs["axes"] = list(op.axes)
@@ -498,6 +499,34 @@ class SingaFrontend:
         n("MatMul", [probs, v_nm], out_names[0])
 
     @classmethod
+    def _export_cossim(cls, op, op_name, in_names, out_names, nodes,
+                       initializers):
+        """Decompose CosSim into primitive ONNX nodes (no CosineSimilarity
+        op exists in ONNX): sum(a*b,-1) / (|a|*|b| + eps)."""
+        a_nm, b_nm = in_names[:2]
+        eps_nm = f"{op_name}_eps"
+        initializers.append(numpy_helper.from_array(
+            np.asarray(1e-12, np.float32), eps_nm))
+
+        def n(op_ty, ins, out, **attrs):
+            nodes.append(helper.make_node(op_ty, ins, [out], name=out,
+                                          **attrs))
+            return out
+
+        ab = n("Mul", [a_nm, b_nm], f"{op_name}_ab")
+        num = n("ReduceSum", [ab], f"{op_name}_num", axes=[-1],
+                keepdims=0)
+        aa = n("Mul", [a_nm, a_nm], f"{op_name}_aa")
+        bb = n("Mul", [b_nm, b_nm], f"{op_name}_bb")
+        na = n("Sqrt", [n("ReduceSum", [aa], f"{op_name}_sa", axes=[-1],
+                          keepdims=0)], f"{op_name}_na")
+        nb = n("Sqrt", [n("ReduceSum", [bb], f"{op_name}_sb", axes=[-1],
+                          keepdims=0)], f"{op_name}_nb")
+        den = n("Add", [n("Mul", [na, nb], f"{op_name}_nanb"), eps_nm],
+                f"{op_name}_den")
+        n("Div", [num, den], out_names[0])
+
+    @classmethod
     def _export_gelu(cls, op, op_name, in_names, out_names, nodes,
                      initializers):
         """Decompose GELU (tanh approximation, matching jax.nn.gelu's
@@ -624,6 +653,10 @@ class SingaFrontend:
                 cls._export_gelu(op, op_name, in_names, out_names,
                                  nodes, initializers)
                 continue
+            if ty == "CosSim":
+                cls._export_cossim(op, op_name, in_names, out_names,
+                                   nodes, initializers)
+                continue
             onnx_ty, attrs = cls._node_attrs_and_extra(
                 op, op_name, in_names, initializers)
             nodes.append(helper.make_node(onnx_ty, in_names, out_names,
@@ -665,6 +698,11 @@ def to_onnx(model, inputs, model_name="sonnx"):
                     stores_grad=False)
         ti.name = t.name if isinstance(t, Tensor) and t.name else f"input_{i}"
         tape_inputs.append(ti)
+    # after mesh-sharded training the live params span the mesh while the
+    # tape inputs are single-device — gather them first (same path eval's
+    # eager fallback uses), or the eager tape walk below device-mismatches
+    if hasattr(model, "_unshard_state"):
+        model._unshard_state()
     # record the tape with INFERENCE semantics: BN reads (and must not
     # mutate) running stats, dropout is identity — the exported graph
     # reproduces model.eval() behaviour
@@ -770,18 +808,35 @@ class SingaBackend:
             handle = node.cache.get("handle")
             if handle is None:
                 ks = a["kernel_shape"]
-                pads = a.get("pads", [0] * 4)
+                pads = list(a.get("pads", [0] * 4))
                 group = a.get("group", 1)
+                strides = tuple(a.get("strides", [1] * len(ks)))
+                dil = tuple(a.get("dilations", [1] * len(ks)))
+                opad = list(a.get("output_padding", [0] * len(ks)))
+                if "output_shape" in a:
+                    # spec: total_padding[i] = stride[i]*(in[i]-1)
+                    #   + output_padding[i] + ((k[i]-1)*dilation[i]+1)
+                    #   - output_shape[i]. Split: SAME_UPPER puts the
+                    #   smaller half first; the default (NOTSET) puts
+                    #   the LARGER half first (begin = total - total//2)
+                    upper = a.get("auto_pad", "NOTSET") == "SAME_UPPER"
+                    pads = []
+                    for i, want in enumerate(a["output_shape"]):
+                        total = (strides[i] * (ins[0].shape[2 + i] - 1)
+                                 + opad[i] + ((ks[i] - 1) * dil[i] + 1)
+                                 - int(want))
+                        small, big = total // 2, total - total // 2
+                        pads.append(small if upper else big)   # begin
+                        pads.append(big if upper else small)   # end
+                    pads = [pads[0], pads[2], pads[1], pads[3]]
                 handle = ConvTransposeHandle(
-                    ins[0], tuple(ks),
-                    tuple(a.get("strides", [1] * len(ks))),
+                    ins[0], tuple(ks), strides,
                     ((pads[0], pads[2]), (pads[1], pads[3])),
                     in_channels=ins[0].shape[1],
                     out_channels=ins[1].shape[1] * group,
                     bias=len(ins) > 2, group=group,
-                    dilation=tuple(a.get("dilations", [1] * len(ks))),
-                    output_padding=tuple(
-                        a.get("output_padding", [0] * len(ks))),
+                    dilation=dil,
+                    output_padding=tuple(opad),
                     layout="NCHW")
                 node.cache["handle"] = handle
             return conv_transpose2d(handle, ins[0], ins[1],
@@ -831,10 +886,14 @@ class SingaBackend:
         if ty == "Transpose":
             return autograd.transpose(ins[0], a.get("perm"))
         if ty == "Squeeze":
-            return autograd.squeeze(ins[0], tuple(a["axes"])
-                                    if "axes" in a else None)
+            # opset<=12: axes attribute; opset-13: axes as a second input
+            axes = tuple(a["axes"]) if "axes" in a else \
+                (tuple(_ints(ins[1])) if len(ins) > 1 and ins[1] is not None
+                 else None)
+            return autograd.squeeze(ins[0], axes)
         if ty == "Unsqueeze":
-            return autograd.unsqueeze(ins[0], list(a["axes"]))
+            axes = list(a["axes"]) if "axes" in a else _ints(ins[1])
+            return autograd.unsqueeze(ins[0], axes)
         if ty == "Slice":
             starts = _ints(ins[1])
             ends = _ints(ins[2])
@@ -848,10 +907,43 @@ class SingaBackend:
             mx = float(np.asarray(_arr(ins[2])).reshape(-1)[0]) \
                 if len(ins) > 2 and ins[2] is not None else None
             return autograd.clip(ins[0], mn, mx)
-        if ty in ("ReduceSum", "ReduceMean"):
-            fn = autograd.reduce_sum if ty == "ReduceSum" \
-                else autograd.reduce_mean
-            return fn(ins[0], a.get("axes"), a.get("keepdims", 1))
+        if ty in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin",
+                  "ReduceProd", "ReduceL1", "ReduceL2", "ReduceLogSum",
+                  "ReduceLogSumExp"):
+            # opset-13 ReduceSum moved axes to a second input
+            axes = a.get("axes")
+            if axes is None and len(ins) > 1 and ins[1] is not None:
+                axes = _ints(ins[1])
+            keep = a.get("keepdims", 1)
+            rsum = autograd.reduce_sum
+            if ty == "ReduceSum":
+                return rsum(ins[0], axes, keep)
+            if ty == "ReduceMean":
+                return autograd.reduce_mean(ins[0], axes, keep)
+            if ty == "ReduceMax":
+                return autograd.reduce_max(ins[0], axes, keep)
+            if ty == "ReduceMin":
+                # min = -max(-x): one extra fused negation, no new op
+                return autograd.negative(
+                    autograd.reduce_max(autograd.negative(ins[0]),
+                                        axes, keep))
+            if ty == "ReduceL1":
+                return rsum(autograd.abs(ins[0]), axes, keep)
+            if ty == "ReduceL2":
+                return autograd.sqrt(rsum(autograd.mul(ins[0], ins[0]),
+                                          axes, keep))
+            if ty == "ReduceLogSum":
+                return autograd.log(rsum(ins[0], axes, keep))
+            if ty == "ReduceLogSumExp":
+                # shift by the max for stability (spec result identical)
+                m = autograd.reduce_max(ins[0], axes, 1)
+                s = autograd.log(rsum(autograd.exp(
+                    autograd.sub(ins[0], m)), axes, keep))
+                mk = m if keep else autograd.reshape(m, list(s.shape))
+                return autograd.add(s, mk)
+            # ReduceProd: log/exp trick breaks on non-positive values —
+            # do it as a real product reduction over the named axes
+            return autograd.reduce_prod(ins[0], axes, keep)
         if ty == "LeakyRelu":
             return autograd.leakyrelu(ins[0], a.get("alpha", 0.01))
         if ty == "Elu":
@@ -865,10 +957,13 @@ class SingaBackend:
         if ty == "Dropout":
             return autograd.dropout(ins[0], a.get("ratio", 0.5))
         if ty == "Split":
-            return autograd.split(ins[0], a.get("axis", 0),
-                                  list(a["split"]) if "split" in a else None,
+            # opset<=12: split attribute; opset-13: split as second input
+            parts = list(a["split"]) if "split" in a else \
+                (_ints(ins[1]) if len(ins) > 1 and ins[1] is not None
+                 else None)
+            return autograd.split(ins[0], a.get("axis", 0), parts,
                                   num_output=len(node.outputs)
-                                  if "split" not in a else None)
+                                  if parts is None else None)
         if ty == "Gather":
             return autograd.gather(ins[0], a.get("axis", 0),
                                    _arr(ins[1]).astype(np.int32))
@@ -883,26 +978,55 @@ class SingaBackend:
             return autograd.pad(ins[0], a.get("mode", "constant"), pads,
                                 const)
         if ty in ("Upsample", "Resize"):
+            from .ops.resize import resize as _resize
             if ty == "Resize":
                 # Resize(X, roi, scales[, sizes]): prefer scales; derive
-                # them from sizes when only sizes is given
+                # them from sizes when only sizes is given. The spec maps
+                # coordinates with the ORIGINAL scales (out=floor(in*s)),
+                # so both are threaded through.
                 scales_t = ins[2] if len(ins) > 2 else None
                 if scales_t is not None and scales_t.size():
-                    scales = _arr(scales_t).ravel()
+                    scales = [float(s) for s in _arr(scales_t).ravel()]
+                    out_shape = [int(np.floor(d * s))
+                                 for d, s in zip(ins[0].shape, scales)]
                 elif len(ins) > 3 and ins[3] is not None:
-                    sizes = _arr(ins[3]).ravel()
-                    scales = [s / d for s, d in zip(sizes, ins[0].shape)]
+                    out_shape = [int(v) for v in _arr(ins[3]).ravel()]
+                    scales = [o / d for o, d in zip(out_shape,
+                                                    ins[0].shape)]
                 else:
                     raise ValueError("Resize needs scales or sizes")
+                mode = a.get("mode", "nearest")
+                coord = a.get("coordinate_transformation_mode",
+                              "half_pixel")
             else:
-                scales = _arr(ins[-1]).ravel()
+                scales = [float(s) for s in _arr(ins[-1]).ravel()]
+                out_shape = [int(np.floor(d * s))
+                             for d, s in zip(ins[0].shape, scales)]
+                mode = a.get("mode", "nearest")
+                # the legacy Upsample op used asymmetric+floor sampling
+                coord = "asymmetric"
             int_scales = [int(round(float(s))) for s in scales]
-            if any(abs(i - float(s)) > 1e-6 for i, s in zip(int_scales,
-                                                            scales)):
-                raise NotImplementedError(
-                    f"{ty}: only integer nearest-neighbour scales are "
-                    f"supported, got {list(map(float, scales))}")
-            return autograd.upsample(ins[0], "nearest", int_scales)
+            if mode == "nearest" and coord == "asymmetric" and \
+                    all(abs(i - float(s)) <= 1e-6
+                        for i, s in zip(int_scales, scales)):
+                # integer nearest upsample: the one-op repeat fast path
+                return autograd.upsample(ins[0], "nearest", int_scales)
+            nearest = a.get("nearest_mode", "round_prefer_floor") \
+                if ty == "Resize" else "floor"
+            # sampling tables are static per node: compute once, cache
+            # the handle (same pattern as the Conv/Pool handles above)
+            handle = node.cache.get("resize")
+            if handle is None:
+                from .ops.resize import ResizeHandle
+                handle = ResizeHandle(
+                    ins[0].shape, out_shape,
+                    mode={"nearest": "nearest", "linear": "linear",
+                          "cubic": "cubic"}[mode],
+                    coord_mode=coord, nearest_mode=nearest,
+                    cubic_a=a.get("cubic_coeff_a", -0.75),
+                    scales=scales)
+                node.cache["resize"] = handle
+            return _resize(ins[0], handle.out_shape, handle=handle)
         if ty == "ConstantOfShape":
             v = a.get("value")
             val = float(numpy_helper.to_array(v).ravel()[0]) \
@@ -1079,7 +1203,8 @@ class SingaBackend:
                 non_weight.update(n.input[3:5])
             elif n.op_type in ("Reshape", "Expand", "Tile", "Pad", "Slice",
                                "Clip", "OneHot", "Upsample", "Resize",
-                               "Gather", "ConstantOfShape"):
+                               "Gather", "ConstantOfShape", "Split",
+                               "Squeeze", "Unsqueeze", "ReduceSum"):
                 non_weight.update(n.input[1:])
             elif n.op_type in ("RNN", "LSTM", "GRU"):
                 # sequence_lens / initial states are config, not weights

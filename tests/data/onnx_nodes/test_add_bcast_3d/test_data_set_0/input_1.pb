@@ -1,0 +1,2 @@
+
+Binput_1JtÍµ¾Óf@¿x‘ž>

@@ -1,0 +1,2 @@
+
+Binput_2J0Z?h7$qtwC?(=E>wԯXT?

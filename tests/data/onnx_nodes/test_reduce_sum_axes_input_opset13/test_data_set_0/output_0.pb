@@ -1,0 +1,2 @@
+
+Boutput_0J& i¿2s¿Ì]q¿

@@ -1,0 +1,3 @@
+
+Boutput_0J
+…Î@hJA

@@ -1,0 +1,3 @@
+
+Binput_1J0:9;V2gg>ƚ?>nӼǿ&
+?v\?$c?

@@ -1,0 +1,2 @@
+
+Binput_2J§b>Y0š?Úi8@V¿

@@ -190,6 +190,18 @@ class TestGradcheck:
             return autograd.mse_loss(xx, yt)
         gradcheck(fn, [x])
 
+    def test_resize_linear(self):
+        from singa_tpu.ops.resize import resize
+        x = a(1, 2, 3, 3)
+        gradcheck(lambda xx: resize(xx, (1, 2, 6, 5), mode="linear"),
+                  [x])
+
+    def test_resize_cubic(self):
+        from singa_tpu.ops.resize import resize
+        x = a(1, 1, 4, 4)
+        gradcheck(lambda xx: resize(xx, (1, 1, 7, 6), mode="cubic"),
+                  [x])
+
     @pytest.mark.slow
     def test_attention(self):
         from singa_tpu.ops.attention import attention

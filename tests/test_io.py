@@ -186,7 +186,7 @@ class TestSnapshot:
         for k in params:
             np.testing.assert_array_equal(loaded[k].numpy(), params[k])
         desc = open(prefix + ".desc").read()
-        assert "conv1.W" in desc and "version" in desc
+        assert "conv1.W" in desc and "SINGA VERSION: 4000" in desc
 
     def test_tensor_values(self, tmp_path):
         prefix = str(tmp_path / "ck2")
@@ -195,6 +195,137 @@ class TestSnapshot:
         snapshot.save_states(prefix, {"w": t})
         out = snapshot.load_states(prefix)
         np.testing.assert_array_equal(out["w"].numpy(), [1.0, 2.0])
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class TestSnapshotSingaFormat:
+    """Wire-format fidelity against the reference Snapshot
+    (src/io/snapshot.cc:33-103): the golden .bin/.desc bytes below are
+    constructed BY HAND from the spec — BinFile framing
+    (binfile_writer.cc:60-80, 's','g' magic + size_t-framed key/value)
+    around TensorProto payloads (core.proto:70-78) — independently of
+    snapshot.py's encoder, so a drift in either direction fails."""
+
+    @staticmethod
+    def _golden_pair():
+        import struct
+        # conv1.W: float32 (2,3), with the stride field a real SINGA
+        # to_proto emits (ignored on read)
+        w = np.array([[1.5, -2.0, 3.25], [0.5, 0.0, -1.0]], np.float32)
+        tp_w = (b"\x08\x02" + b"\x08\x03"          # shape 2, 3
+                + b"\x10\x00"                      # data_type kFloat32
+                + b"\x18\x03" + b"\x18\x01"        # stride 3, 1
+                + b"\x22" + _varint(24) + w.tobytes())
+        # step: int32 [7, -3] (negative int32 -> 10-byte varint)
+        iv = np.array([7, -3], np.int32)
+        ints = _varint(7) + _varint((1 << 64) - 3)
+        tp_i = (b"\x08\x02" + b"\x10\x02"
+                + b"\x32" + _varint(len(ints)) + ints)
+
+        def rec(key, val):
+            kb = key.encode()
+            return (b"sg\x01\x00" + struct.pack("<Q", len(kb)) + kb
+                    + struct.pack("<Q", len(val)) + val)
+
+        bin_bytes = rec("conv1.W", tp_w) + rec("step", tp_i)
+        desc = ("SINGA VERSION: 4000\n"
+                "parameter name: conv1.W\tdata type: 0\tdim: 2"
+                "\tshape: 2 3\n"
+                "parameter name: step\tdata type: 2\tdim: 1"
+                "\tshape: 2\n")
+        return w, iv, bin_bytes, desc
+
+    def test_golden_singa_checkpoint_reads(self, tmp_path):
+        w, iv, bin_bytes, desc = self._golden_pair()
+        prefix = str(tmp_path / "ref_ckpt")
+        open(prefix + ".bin", "wb").write(bin_bytes)
+        open(prefix + ".desc", "w").write(desc)
+        out = snapshot.load_states(prefix)
+        np.testing.assert_array_equal(out["conv1.W"].numpy(), w)
+        assert out["conv1.W"].numpy().dtype == np.float32
+        np.testing.assert_array_equal(out["step"].numpy(), iv)
+
+    def test_write_produces_reference_bytes(self, tmp_path):
+        """Byte-for-byte: what we write IS the golden fixture (modulo
+        the stride field, which to_proto emits but carries no
+        information for dense tensors)."""
+        w, iv, bin_bytes, desc = self._golden_pair()
+        prefix = str(tmp_path / "ours")
+        with snapshot.Snapshot(prefix, snapshot.Snapshot.kWrite) as s:
+            s.write("conv1.W", w)
+            s.write("step", iv)
+        got = open(prefix + ".bin", "rb").read()
+        # our writer omits the redundant stride field, so the expected
+        # bytes are recomputed with it absent (framing lengths change)
+        import struct
+
+        def rec(key, val):
+            kb = key.encode()
+            return (b"sg\x01\x00" + struct.pack("<Q", len(kb)) + kb
+                    + struct.pack("<Q", len(val)) + val)
+
+        tp_w = (b"\x08\x02" + b"\x08\x03" + b"\x10\x00"
+                + b"\x22" + _varint(24) + w.tobytes())
+        ints = _varint(7) + _varint((1 << 64) - 3)
+        tp_i = b"\x08\x02" + b"\x10\x02" + b"\x32" + _varint(len(ints)) \
+            + ints
+        assert got == rec("conv1.W", tp_w) + rec("step", tp_i)
+        assert open(prefix + ".desc").read() == desc
+
+    def test_native_format_autodetect(self, tmp_path):
+        prefix = str(tmp_path / "nat")
+        arr = np.random.randn(3, 2).astype(np.float32)
+        bf = np.random.randn(4).astype(np.float32)
+        with snapshot.Snapshot(prefix, snapshot.Snapshot.kWrite,
+                               format="native") as s:
+            s.write("a", arr)
+            s.write("bf", bf)
+        out = snapshot.load_states(prefix)   # auto-detects SGTPREC0
+        np.testing.assert_array_equal(out["a"].numpy(), arr)
+
+    def test_bf16_needs_native_format(self, tmp_path):
+        import ml_dtypes
+        arr = np.zeros(3, ml_dtypes.bfloat16)
+        with snapshot.Snapshot(str(tmp_path / "x"),
+                               snapshot.Snapshot.kWrite) as s:
+            with pytest.raises(ValueError, match="native"):
+                s.write("w", arr)
+
+    def test_int64_overflow_rejected(self, tmp_path):
+        """kInt is int32 on the reference wire (core.proto:29): an
+        out-of-range int64 must fail loudly, not wrap on reload."""
+        with snapshot.Snapshot(str(tmp_path / "i"),
+                               snapshot.Snapshot.kWrite) as s:
+            s.write("ok", np.array([2**31 - 1, -2**31], np.int64))
+            with pytest.raises(ValueError, match="int32"):
+                s.write("bad", np.array([2**31], np.int64))
+
+    def test_duplicate_key_raises(self, tmp_path):
+        with snapshot.Snapshot(str(tmp_path / "d"),
+                               snapshot.Snapshot.kWrite) as s:
+            s.write("w", np.zeros(2, np.float32))
+            with pytest.raises(ValueError, match="duplicate"):
+                s.write("w", np.zeros(2, np.float32))
+
+    def test_legacy_model_suffix_fallback(self, tmp_path):
+        """snapshot.cc:60-64: a 1.0.0-era <prefix>.model BinFile loads
+        when no .bin exists."""
+        w, iv, bin_bytes, _ = self._golden_pair()
+        prefix = str(tmp_path / "old")
+        open(prefix + ".model", "wb").write(bin_bytes)
+        out = snapshot.load_states(prefix)
+        np.testing.assert_array_equal(out["conv1.W"].numpy(), w)
 
 
 class TestImageTool:

@@ -161,6 +161,8 @@ CASES = [
      lambda x: x.max(axis=2)),
     ("reduce_max_all", lambda x: autograd.reduce_max(x, None, 1), [x235],
      lambda x: x.max(keepdims=True).reshape(1, 1, 1)),
+    ("reduce_prod", lambda x: autograd.reduce_prod(x, [1], 0), [x235],
+     lambda x: x.prod(axis=1)),
     # ---- shape manipulation ----
     ("reshape", lambda x: autograd.reshape(x, (5, 6)), [x235],
      lambda x: x.reshape(5, 6)),
@@ -429,6 +431,8 @@ GRAD_EXTRA = [
     ("reduce_max", lambda x: autograd.reduce_max(x, [1], 0),
      [np.cumsum(np.abs(r(3, 4, 2)) + 0.1, axis=1)
       .astype(np.float32)]),      # distinct maxima: FD-safe
+    ("reduce_prod", lambda x: autograd.reduce_prod(x, [1], 0),
+     [_away0(2, 3, 2, lo=0.4)]),  # factors away from 0: FD-stable
     ("upsample", lambda x: autograd.upsample(x, "nearest", [1, 1, 2, 2]),
      [r(1, 2, 2, 2)]),
     ("depth_to_space", lambda x: autograd.depth_to_space(x, 2),

@@ -292,6 +292,21 @@ class TestDistOptions:
         ref_losses = self._train("plain", distributed=False)
         np.testing.assert_allclose(dist_losses, ref_losses, rtol=0.05)
 
+    def test_fp16_wire_compiled_trains_and_tracks_fp32(self):
+        """The IEEE-fp16 wire option (reference synchHalf fp16 cast,
+        src/io/communicator.cc:262-299): must train through the compiled
+        mesh step and stay close to the fp32 trajectory — fp16 has MORE
+        mantissa than bf16, so the same tolerance must hold."""
+        dist_losses = self._train("fp16")
+        assert dist_losses[-1] < dist_losses[0] * 0.8, dist_losses
+        ref_losses = self._train("plain", distributed=False)
+        np.testing.assert_allclose(dist_losses, ref_losses, rtol=0.05)
+
+    def test_update_half_dtype_validation(self):
+        d = opt.DistOpt(opt.SGD(lr=0.1))
+        with pytest.raises(ValueError, match="float16"):
+            d.backward_and_update_half(None, dtype="int8")
+
     def test_plain_matches_single_device(self):
         dist_losses = self._train("plain")
         ref_losses = self._train("plain", distributed=False)

@@ -304,3 +304,102 @@ class TestZooExport:
         ops = self._eval_roundtrip(m, t(np.random.randn(1, 8, 10, 10)))
         assert "Split" in ops and "Concat" in ops and \
             "Transpose" in ops  # channel split + shuffle
+
+
+class OpNet(model.Model):
+    """Minimal model wrapping one taped op expression, so every public
+    frontend-exportable op can be round-tripped through
+    export -> parse -> SingaBackend -> run (VERDICT r4 #4: conformance
+    pressure on SingaFrontend, not just the backend)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+
+    def forward(self, *xs):
+        return self.fn(*xs)
+
+
+RNG = np.random.RandomState(11)
+
+
+def _r(*shape, lo=-1.5, hi=1.5):
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+_x34 = _r(3, 4)
+_x234 = _r(2, 3, 4)
+_pos = np.abs(_r(3, 4)) + 0.2
+_b34 = (RNG.rand(3, 4) > 0.5).astype(np.float32)
+
+# (name, op lambda, input arrays)
+OP_ROUNDTRIPS = [
+    ("reduce_max", lambda x: autograd.reduce_max(x, [1], 1), [_x234]),
+    ("reduce_prod", lambda x: autograd.reduce_prod(x, [0, 2], 0),
+     [_x234]),
+    ("reduce_sum_negaxes", lambda x: autograd.reduce_sum(x, [-1], 0),
+     [_x234]),
+    ("reduce_mean_keep", lambda x: autograd.reduce_mean(x, [1], 1),
+     [_x234]),
+    ("clip", lambda x: autograd.clip(x, -0.5, 0.8), [_x34]),
+    ("clip_min_only", lambda x: autograd.clip(x, 0.0, None), [_x34]),
+    ("pad_reflect", lambda x: autograd.pad(x, "reflect", [0, 1, 0, 1]),
+     [_x34]),
+    ("pad_edge", lambda x: autograd.pad(x, "edge", [1, 0, 1, 0]),
+     [_x34]),
+    ("pad_constant", lambda x: autograd.pad(x, "constant",
+                                            [1, 0, 0, 2], 0.5), [_x34]),
+    ("gather", lambda x: autograd.gather(x, 1, [0, 2, 2]), [_x34]),
+    ("tile", lambda x: autograd.tile(x, [2, 1]), [_x34]),
+    ("expand", lambda x: autograd.expand(x, (2, 3, 4)), [_x34]),
+    ("squeeze_unsqueeze", lambda x: autograd.unsqueeze(
+        autograd.squeeze(x, [0]), [2]), [_r(1, 3, 4)]),
+    ("transpose", lambda x: autograd.transpose(x, (2, 0, 1)), [_x234]),
+    ("slice_steps", lambda x: autograd.slice(x, [0, 1], [3, 4],
+                                             [0, 1], [1, 2]), [_x34]),
+    ("scatter_elements",
+     lambda x: autograd.scatter_elements(
+         x, t(np.array([[1, 0, 2]], np.float32)),
+         t(np.array([[1.5, 2.5, 3.5]], np.float32)), 0), [_r(3, 3)]),
+    ("depth_to_space", lambda x: autograd.depth_to_space(x, 2),
+     [_r(1, 4, 2, 3)]),
+    ("space_to_depth", lambda x: autograd.space_to_depth(x, 2),
+     [_r(1, 1, 4, 6)]),
+    ("upsample", lambda x: autograd.upsample(x, "nearest", [1, 1, 2, 3]),
+     [_r(1, 2, 2, 2)]),
+    ("softmax", lambda x: autograd.softmax(x, -1), [_x34]),
+    ("leakyrelu", lambda x: autograd.leakyrelu(x, 0.2), [_x34]),
+    ("elu", lambda x: autograd.elu(x, 1.3), [_x34]),
+    ("selu", lambda x: autograd.selu(x), [_x34]),
+    ("hardsigmoid", lambda x: autograd.hardsigmoid(x, 0.25, 0.4),
+     [_x34]),
+    ("erf", lambda x: autograd.erf(x), [_x34]),
+    ("sign_ceil_floor", lambda x: autograd.sign(
+        autograd.add(autograd.ceil(x), autograd.floor(x))), [_x34]),
+    ("reciprocal", lambda x: autograd.reciprocal(x), [_pos]),
+    ("where", lambda x, y: autograd.where(t(_b34), x, y),
+     [_x34, _r(3, 4)]),
+    ("max_min_nary", lambda a, b: autograd.min(
+        autograd.max(a, b), autograd.add(a, b)), [_x34, _r(3, 4)]),
+    ("pow", lambda a, b: autograd.pow(a, b), [_pos, _r(3, 4)]),
+    ("gemm", lambda a, b, c: autograd.gemm(a, b, c, 0.5, 2.0, 1, 1),
+     [_r(6, 4), _r(3, 6), _r(4, 3)]),
+    ("cossim", lambda a, b: autograd.cossim(a, b), [_x34, _r(3, 4)]),
+    ("split_cat", lambda x: autograd.cat(
+        list(autograd.split(x, 0, [2, 1])), 0), [_r(3, 4)]),
+    ("lrn", lambda x: autograd.lrn(x, 3, 0.1, 0.75, 1.0),
+     [_r(2, 5, 2, 2)]),
+    ("globalaveragepool", lambda x: autograd.globalaveragepool(x),
+     [_r(2, 3, 4, 4)]),
+    ("flatten", lambda x: autograd.flatten(x, 2), [_x234]),
+    ("layernorm_composed", lambda x, s, b: autograd.layernorm(x, s, b),
+     [_x34, np.abs(_r(4)) + 0.5, _r(4)]),
+]
+
+
+class TestOpRoundtrips:
+    @pytest.mark.parametrize("name,fn,ins", OP_ROUNDTRIPS,
+                             ids=[c[0] for c in OP_ROUNDTRIPS])
+    def test_op_roundtrip(self, name, fn, ins):
+        m = OpNet(fn)
+        roundtrip(m, [t(a) for a in ins], rtol=1e-4, atol=1e-5)

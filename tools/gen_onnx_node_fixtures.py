@@ -717,6 +717,348 @@ def build_cases():
         [("y", np.where(prs > 0, prs, slope_full * prs)
           .astype(np.float32))]))
 
+    # -- full Reduce* family (r5: reduce-op axes-form variants) ----------
+    rd = r(2, 3, 4)
+    rdp = np.abs(r(2, 3, 4)) + 0.2       # positive: L*/LogSum-safe
+    reduce_refs = {
+        "ReduceMax": lambda x, ax, k: x.max(axis=ax, keepdims=k),
+        "ReduceMin": lambda x, ax, k: x.min(axis=ax, keepdims=k),
+        "ReduceProd": lambda x, ax, k: x.prod(axis=ax, keepdims=k),
+        "ReduceL1": lambda x, ax, k: np.abs(x).sum(axis=ax, keepdims=k),
+        "ReduceL2": lambda x, ax, k: np.sqrt(
+            (x * x).sum(axis=ax, keepdims=k)),
+        "ReduceLogSum": lambda x, ax, k: np.log(
+            x.sum(axis=ax, keepdims=k)),
+        "ReduceLogSumExp": lambda x, ax, k: np.log(
+            np.exp(x).sum(axis=ax, keepdims=k)),
+    }
+    for op, ref in reduce_refs.items():
+        lx = rdp if "Log" in op or op == "ReduceL2" else rd
+        low = op.lower()
+        for suffix, axes, keep in [("_axes12_keepdims", (1, 2), 1),
+                                   ("_axes1_nokeep", (1,), 0),
+                                   ("_default_axes", None, 1),
+                                   ("_negative_axes", (-1,), 1)]:
+            attrs = {"keepdims": keep}
+            if axes is not None:
+                attrs["axes"] = list(axes)
+            cases.append(case(
+                f"test_{low}{suffix}", op, [("x", lx)],
+                [("y", ref(lx, axes, bool(keep)).astype(np.float32))],
+                attrs))
+    # opset-13 ReduceSum: axes arrive as an input tensor
+    cases.append(case(
+        "test_reduce_sum_axes_input_opset13", "ReduceSum",
+        [("x", rd), ("axes", np.array([0, 2], np.int64))],
+        [("y", rd.sum(axis=(0, 2), keepdims=True).astype(np.float32))],
+        {"keepdims": 1}, opset=13))
+
+    # -- opset-13 attribute-as-input forms -------------------------------
+    sq13 = r(1, 3, 1, 4)
+    cases.append(case(
+        "test_squeeze_axes_input_opset13", "Squeeze",
+        [("x", sq13), ("axes", np.array([0, 2], np.int64))],
+        [("y", sq13.reshape(3, 4).copy())], opset=13))
+    cases.append(case(
+        "test_unsqueeze_axes_input_opset13", "Unsqueeze",
+        [("x", sq13.reshape(3, 4).copy()),
+         ("axes", np.array([0, 3], np.int64))],
+        [("y", sq13.reshape(1, 3, 4, 1).copy())], opset=13))
+    sp13 = r(6, 4)
+    cases.append(case(
+        "test_split_sizes_input_opset13", "Split",
+        [("x", sp13), ("split", np.array([4, 2], np.int64))],
+        [("y0", sp13[:4].copy()), ("y1", sp13[4:].copy())],
+        {"axis": 0}, opset=13))
+    cases.append(case(
+        "test_split_axis1_num_outputs", "Split", [("x", sp13)],
+        [("y0", sp13[:, :2].copy()), ("y1", sp13[:, 2:].copy())],
+        {"axis": 1}))
+    cl13 = r(3, 4)
+    cases.append(case(
+        "test_clip_min_max_opset13", "Clip",
+        [("x", cl13), ("min", np.float32(-0.4)),
+         ("max", np.float32(0.5))],
+        [("y", np.clip(cl13, -0.4, 0.5))], opset=13))
+
+    # -- Pad modes --------------------------------------------------------
+    pdx = r(2, 3)
+    cases.append(case(
+        "test_pad_reflect", "Pad",
+        [("x", pdx), ("pads", np.array([0, 1, 0, 1], np.int64))],
+        [("y", np.pad(pdx, ((0, 0), (1, 1)), mode="reflect"))],
+        {"mode": "reflect"}))
+    cases.append(case(
+        "test_pad_edge", "Pad",
+        [("x", pdx), ("pads", np.array([1, 0, 1, 0], np.int64))],
+        [("y", np.pad(pdx, ((1, 1), (0, 0)), mode="edge"))],
+        {"mode": "edge"}))
+    cases.append(case(
+        "test_pad_constant_value", "Pad",
+        [("x", pdx), ("pads", np.array([0, 2, 1, 0], np.int64)),
+         ("value", np.float32(1.5))],
+        [("y", np.pad(pdx, ((0, 1), (2, 0)), constant_values=1.5))]))
+
+    # -- Resize modes (r5: linear / cubic / non-integer nearest) ---------
+    def resize_ref(x, out_hw, mode, coord, nearest="round_prefer_floor",
+                   a_cubic=-0.75, scales=None):
+        from numpy import floor, ceil, clip
+
+        def coords(o, i, s):
+            j = np.arange(o, dtype=np.float64)
+            if coord == "align_corners":
+                return j * (i - 1) / max(o - 1, 1)
+            if coord == "asymmetric":
+                return j / s
+            return (j + 0.5) / s - 0.5
+
+        def axis_tables(o, i, s):
+            xx = coords(o, i, s)
+            if mode == "nearest":
+                if nearest == "floor":
+                    idx = floor(xx)
+                else:
+                    idx = ceil(xx - 0.5)
+                return [(clip(idx, 0, i - 1).astype(int), 1.0)]
+            if mode == "linear":
+                lo = floor(xx)
+                whi = xx - lo
+                return [(clip(lo, 0, i - 1).astype(int), 1 - whi),
+                        (clip(lo + 1, 0, i - 1).astype(int), whi)]
+            base = floor(xx).astype(int)
+            frac = xx - base
+
+            def ck(t):
+                t = np.abs(t)
+                return np.where(
+                    t <= 1,
+                    (a_cubic + 2) * t**3 - (a_cubic + 3) * t**2 + 1,
+                    np.where(t < 2, a_cubic * t**3 - 5 * a_cubic * t**2
+                             + 8 * a_cubic * t - 4 * a_cubic, 0.0))
+            return [(clip(base + k, 0, i - 1).astype(int), ck(k - frac))
+                    for k in (-1, 0, 1, 2)]
+
+        N, C, H, W = x.shape
+        oh, ow = out_hw
+        sh = scales[2] if scales else oh / H
+        sw = scales[3] if scales else ow / W
+        out = np.zeros((N, C, oh, W))
+        for idx, w in axis_tables(oh, H, sh):
+            out += x[:, :, idx, :] * np.asarray(w).reshape(1, 1, -1, 1)
+        out2 = np.zeros((N, C, oh, ow))
+        for idx, w in axis_tables(ow, W, sw):
+            out2 += out[:, :, :, idx] * np.asarray(w).reshape(1, 1, 1, -1)
+        return out2.astype(np.float32)
+
+    rz = r(1, 1, 4, 4)
+    scl = np.array([1, 1, 2, 2], np.float32)
+    roi = np.zeros(0, np.float32)
+    cases.append(case(
+        "test_resize_upsample_scales_linear", "Resize",
+        [("x", rz), ("roi", roi), ("scales", scl)],
+        [("y", resize_ref(rz, (8, 8), "linear", "half_pixel",
+                          scales=[1, 1, 2, 2]))],
+        {"mode": "linear"}))
+    cases.append(case(
+        "test_resize_upsample_scales_linear_align_corners", "Resize",
+        [("x", rz), ("roi", roi), ("scales", scl)],
+        [("y", resize_ref(rz, (8, 8), "linear", "align_corners",
+                          scales=[1, 1, 2, 2]))],
+        {"mode": "linear",
+         "coordinate_transformation_mode": "align_corners"}))
+    dscl = np.array([1, 1, 0.6, 0.6], np.float32)
+    cases.append(case(
+        "test_resize_downsample_scales_linear", "Resize",
+        [("x", rz), ("roi", roi), ("scales", dscl)],
+        [("y", resize_ref(rz, (2, 2), "linear", "half_pixel",
+                          scales=[1, 1, 0.6, 0.6]))],
+        {"mode": "linear"}))
+    cases.append(case(
+        "test_resize_upsample_scales_cubic", "Resize",
+        [("x", rz), ("roi", roi), ("scales", scl)],
+        [("y", resize_ref(rz, (8, 8), "cubic", "half_pixel",
+                          scales=[1, 1, 2, 2]))],
+        {"mode": "cubic"}))
+    cases.append(case(
+        "test_resize_downsample_scales_cubic", "Resize",
+        [("x", rz), ("roi", roi),
+         ("scales", np.array([1, 1, 0.8, 0.8], np.float32))],
+        [("y", resize_ref(rz, (3, 3), "cubic", "half_pixel",
+                          scales=[1, 1, 0.8, 0.8]))],
+        {"mode": "cubic"}))
+    cases.append(case(
+        "test_resize_upsample_sizes_nearest", "Resize",
+        [("x", rz), ("roi", roi), ("scales", np.zeros(0, np.float32)),
+         ("sizes", np.array([1, 1, 7, 9], np.int64))],
+        [("y", resize_ref(rz, (7, 9), "nearest", "half_pixel",
+                          scales=[1, 1, 7 / 4, 9 / 4]))]))
+    cases.append(case(
+        "test_resize_downsample_sizes_nearest", "Resize",
+        [("x", rz), ("roi", roi), ("scales", np.zeros(0, np.float32)),
+         ("sizes", np.array([1, 1, 2, 3], np.int64))],
+        [("y", resize_ref(rz, (2, 3), "nearest", "half_pixel",
+                          scales=[1, 1, 2 / 4, 3 / 4]))]))
+    cases.append(case(
+        "test_resize_nearest_asymmetric_floor", "Resize",
+        [("x", rz), ("roi", roi),
+         ("scales", np.array([1, 1, 1.5, 1.5], np.float32))],
+        [("y", resize_ref(rz, (6, 6), "nearest", "asymmetric", "floor",
+                          scales=[1, 1, 1.5, 1.5]))],
+        {"coordinate_transformation_mode": "asymmetric",
+         "nearest_mode": "floor"}))
+
+    # -- ConvTranspose output_padding / output_shape / pads --------------
+    ctx2 = r(1, 1, 3, 3)
+    ctw2 = r(1, 2, 3, 3)
+    base = ref_conv_transpose2d(ctx2, ctw2, strides=(3, 2))
+    # output_padding adds zeros at the bottom/right
+    opadded = np.zeros((1, 2, base.shape[2] + 1, base.shape[3] + 1),
+                       np.float32)
+    opadded[:, :, :base.shape[2], :base.shape[3]] = base
+    cases.append(case(
+        "test_convtranspose_output_padding", "ConvTranspose",
+        [("x", ctx2), ("w", ctw2)], [("y", opadded)],
+        {"kernel_shape": [3, 3], "strides": [3, 2],
+         "output_padding": [1, 1]}))
+    # pads crop the full output symmetrically
+    full = ref_conv_transpose2d(ctx2, ctw2, strides=(2, 2))
+    cases.append(case(
+        "test_convtranspose_pads", "ConvTranspose",
+        [("x", ctx2), ("w", ctw2)],
+        [("y", full[:, :, 1:-1, 1:-1].copy())],
+        {"kernel_shape": [3, 3], "strides": [2, 2],
+         "pads": [1, 1, 1, 1]}))
+    # output_shape: spec derives the pads. Default auto_pad (NOTSET)
+    # puts the LARGER pad half at the BEGIN (crop from the start);
+    # SAME_UPPER reverses it — both splits pinned.
+    want_h, want_w = full.shape[2] - 1, full.shape[3] - 1
+    cases.append(case(
+        "test_convtranspose_output_shape", "ConvTranspose",
+        [("x", ctx2), ("w", ctw2)],
+        [("y", full[:, :, 1:, 1:].copy())],
+        {"kernel_shape": [3, 3], "strides": [2, 2],
+         "output_shape": [want_h, want_w]}))
+    cases.append(case(
+        "test_convtranspose_output_shape_same_upper", "ConvTranspose",
+        [("x", ctx2), ("w", ctw2)],
+        [("y", full[:, :, :want_h, :want_w].copy())],
+        {"kernel_shape": [3, 3], "strides": [2, 2],
+         "output_shape": [want_h, want_w], "auto_pad": "SAME_UPPER"}))
+
+    # -- misc spec variants ----------------------------------------------
+    g2 = r(3, 4, 5)
+    gi2 = np.array([[0, 2], [1, 3]], np.int64)
+    cases.append(case(
+        "test_gather_2d_indices", "Gather",
+        [("x", g2), ("indices", gi2)],
+        [("y", np.take(g2, gi2, axis=1))], {"axis": 1}))
+    fl0 = r(2, 3, 4)
+    cases.append(case(
+        "test_flatten_axis0", "Flatten", [("x", fl0)],
+        [("y", fl0.reshape(1, -1).copy())], {"axis": 0}))
+    cases.append(case(
+        "test_flatten_negative_axis", "Flatten", [("x", fl0)],
+        [("y", fl0.reshape(6, 4).copy())], {"axis": -1}))
+    cases.append(case(
+        "test_concat_3d_negative_axis", "Concat",
+        [("a", fl0[:, :, :2].copy()), ("b", fl0[:, :, 2:].copy())],
+        [("y", fl0.copy())], {"axis": -1}))
+    tp4 = r(2, 3, 4, 5)
+    cases.append(case(
+        "test_transpose_4d", "Transpose", [("x", tp4)],
+        [("y", tp4.transpose(0, 3, 1, 2).copy())],
+        {"perm": [0, 3, 1, 2]}))
+    gm3 = (r(3, 5), r(5, 4), r(1, 4))
+    cases.append(case(
+        "test_gemm_beta_broadcast_c", "Gemm",
+        [("a", gm3[0]), ("b", gm3[1]), ("c", gm3[2])],
+        [("y", ref_gemm(gm3[0], gm3[1], gm3[2], 1.0, 0.7))],
+        {"beta": 0.7}))
+    gmt = (r(5, 3), r(5, 4), r(3, 4))
+    cases.append(case(
+        "test_gemm_transA", "Gemm",
+        [("a", gmt[0]), ("b", gmt[1]), ("c", gmt[2])],
+        [("y", ref_gemm(gmt[0], gmt[1], gmt[2], transA=1))],
+        {"transA": 1}))
+    # averagepool with SAME-style explicit pads (count_include_pad=1,
+    # the mode our backend implements — attribute set explicitly so the
+    # fixture is unambiguous about which spec mode is claimed)
+    apx = r(1, 2, 4, 4)
+    app = np.pad(apx, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    apo = np.zeros((1, 2, 4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            apo[:, :, i, j] = app[:, :, i:i + 3, j:j + 3].mean((2, 3))
+    cases.append(case(
+        "test_averagepool_2d_pads_count_include_pad", "AveragePool",
+        [("x", apx)], [("y", apo)],
+        {"kernel_shape": [3, 3], "pads": [1, 1, 1, 1],
+         "count_include_pad": 1}))
+    # strided slice over 3 axes
+    sl3 = r(4, 5, 6)
+    cases.append(case(
+        "test_slice_3axes_steps", "Slice",
+        [("x", sl3), ("starts", np.array([1, 0, 5], np.int64)),
+         ("ends", np.array([4, 5, 0], np.int64)),
+         ("axes", np.array([0, 1, 2], np.int64)),
+         ("steps", np.array([2, 2, -2], np.int64))],
+        [("y", sl3[1:4:2, 0:5:2, 5:0:-2].copy())]))
+    # scatter along axis 1
+    scx = np.zeros((3, 5), np.float32)
+    sci = np.array([[1, 3]], np.int64)
+    scu = np.array([[1.5, 2.5]], np.float32)
+    sco = scx.copy()
+    sco[0, 1], sco[0, 3] = 1.5, 2.5
+    cases.append(case(
+        "test_scatter_elements_axis1", "ScatterElements",
+        [("x", scx), ("indices", sci), ("updates", scu)],
+        [("y", sco)], {"axis": 1}))
+    # where with broadcasting
+    wc = (np.arange(12).reshape(3, 4) % 2 == 0)
+    wa, wb = r(3, 4), r(1, 4)
+    cases.append(case(
+        "test_where_broadcast", "Where",
+        [("c", wc), ("a", wa), ("b", wb)],
+        [("y", np.where(wc, wa, np.broadcast_to(wb, (3, 4)))
+          .astype(np.float32))]))
+    # hard dtype edges
+    cases.append(case(
+        "test_cast_float_to_int64", "Cast",
+        [("x", np.array([1.9, -1.9, 0.4], np.float32))],
+        [("y", np.array([1.9, -1.9, 0.4], np.float32)
+          .astype(np.int64))],
+        {"to": int(TensorProto.INT64)}))
+    # global average pool on non-square input
+    gap = r(2, 3, 5, 7)
+    cases.append(case(
+        "test_globalaveragepool_nonsquare", "GlobalAveragePool",
+        [("x", gap)], [("y", gap.mean((2, 3), keepdims=True)
+                        .astype(np.float32))]))
+    # elementwise binaries with full broadcasting
+    bca, bcb = r(2, 1, 4), r(3, 1)
+    for op, fn in [("Add", np.add), ("Sub", np.subtract),
+                   ("Mul", np.multiply)]:
+        cases.append(case(
+            f"test_{op.lower()}_bcast_3d", op,
+            [("a", bca), ("b", bcb)],
+            [("y", fn(bca, bcb).astype(np.float32))]))
+    bcd = np.abs(r(3, 1)) + 0.4
+    cases.append(case(
+        "test_div_bcast_3d", "Div", [("a", bca), ("b", bcd)],
+        [("y", (bca / bcd).astype(np.float32))]))
+    # LRN non-default attributes
+    lr2 = r(2, 6, 3, 3)
+    half = 5 // 2
+    sq = np.zeros_like(lr2)
+    for c in range(6):
+        lo, hi = max(0, c - half), min(6, c + half + 1)
+        sq[:, c] = (lr2[:, lo:hi] ** 2).sum(axis=1)
+    cases.append(case(
+        "test_lrn_custom_attrs", "LRN", [("x", lr2)],
+        [("y", (lr2 / (2.0 + (1e-3 / 5) * sq) ** 0.5)
+          .astype(np.float32))],
+        {"size": 5, "alpha": 1e-3, "beta": 0.5, "bias": 2.0}))
+
     return cases
 
 
