@@ -101,6 +101,7 @@ EXTRA_SUCCESS_MARKERS = {
     "lm_long_context": ("lm_bf16_s4096_remat_tokens_per_sec",),
     "lm_decode_throughput": ("lm_decode_tokens_per_sec",),
     "hbm_footprint": ("hbm_resnet50_b32_bf16", "hbm_lm_b8_s1024_bf16"),
+    "resnet_stem_ab": ("resnet_stem_ab",),
     "resnet50_bf16_large_batch": ("resnet50_bf16_b128",),
     "mlp_step_time": ("mlp_mnist_b64_step_us",),
     "flash_block_sweep": ("flash_block_best",),
@@ -192,7 +193,7 @@ def _slope_time(step_fn, out_of, n_small, n_big):
 
 
 def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
-                       layout="NCHW"):
+                       layout="NCHW", stem=None):
     """Build + compile THE canonical benchmark ResNet train step (SGD
     momentum 0.9, weight_decay 1e-5, synthetic data) and return its
     step() closure — the single source for the timing legs AND the
@@ -202,8 +203,9 @@ def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
     import jax.numpy as jnp
     import numpy as np
 
+    stem = stem or os.environ.get("BENCH_RESNET_STEM", "conv7")
     model = resnet.create_model(depth=depth, num_classes=10, num_channels=3,
-                                layout=layout)
+                                layout=layout, stem=stem)
     model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
 
     x = np.random.randn(batch, 3, image_size, image_size).astype(np.float32)
@@ -225,9 +227,9 @@ def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
 
 
 def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
-             layout="NCHW"):
+             layout="NCHW", stem=None):
     step = _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
-                              layout=layout)
+                              layout=layout, stem=stem)
     loss = None
     for _ in range(warmup):
         loss = step()

@@ -277,7 +277,7 @@ class Conv2d(Layer):
 
     def __init__(self, nb_kernels, kernel_size, *args, stride=1, padding=0,
                  dilation=1, group=1, bias=True, pad_mode="NOTSET",
-                 activation="NOTSET"):
+                 activation="NOTSET", space_to_depth=False):
         super().__init__()
         # legacy form Conv2d(in_ch, nb_kernels, k[, stride[, padding]])
         # (reference layer.py:552-560); in_channels is inferred at init
@@ -297,6 +297,7 @@ class Conv2d(Layer):
         self.bias = bias
         self.pad_mode = pad_mode
         self.activation = activation
+        self.space_to_depth = space_to_depth
 
     def initialize(self, x):
         from .ops.layout import channel_axis
@@ -327,7 +328,8 @@ class Conv2d(Layer):
         self.handle = ConvHandle(x, ks, self.stride, pad,
                                  self.in_channels, self.nb_kernels,
                                  self.bias, self.group, pad_mode,
-                                 dilation=self.dilation)
+                                 dilation=self.dilation,
+                                 space_to_depth=self.space_to_depth)
 
     def forward(self, x):
         from .ops.conv import conv2d

@@ -82,7 +82,7 @@ class Downsample(layer.Layer):
 class ResNet(model.Model, TrainStepMixin):
 
     def __init__(self, block, layers, num_classes=10, num_channels=3,
-                 layout="NCHW"):
+                 layout="NCHW", stem="conv7"):
         super().__init__()
         self.num_classes = num_classes
         self.input_size = 224
@@ -92,8 +92,15 @@ class ResNet(model.Model, TrainStepMixin):
         # channels-last (TPU 128-lane minor dim — see ops/layout.py).
         # Weights are OIHW in both modes, so checkpoints are identical.
         self.layout = str(layout).upper()
+        # stem="space_to_depth": the exact MXU-friendly reformulation of
+        # the 7x7/s2 stem conv (ops/conv.py _space_to_depth_conv) —
+        # same weights, same math, 12 input channels instead of 3
+        if stem not in ("conv7", "space_to_depth"):
+            raise ValueError(f"stem must be 'conv7' or 'space_to_depth', "
+                             f"got {stem!r}")
         self.inplanes = 64
-        self.conv1 = layer.Conv2d(64, 7, stride=2, padding=3, bias=False)
+        self.conv1 = layer.Conv2d(64, 7, stride=2, padding=3, bias=False,
+                                  space_to_depth=(stem == "space_to_depth"))
         self.bn1 = layer.BatchNorm2d()
         self.relu = layer.ReLU()
         self.maxpool = layer.MaxPool2d(kernel_size=3, stride=2, padding=1)

@@ -41,7 +41,7 @@ class ConvHandle:
 
     def __init__(self, x, kernel_size, stride, padding, in_channels,
                  out_channels, bias=True, group=1, pad_mode=None,
-                 dilation=1, layout=None):
+                 dilation=1, layout=None, space_to_depth=False):
         from .layout import current_layout
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
@@ -68,6 +68,21 @@ class ConvHandle:
         # weights are OIHW in BOTH layouts (checkpoint-stable); only the
         # activation spec changes — XLA maps either onto the MXU
         self.dimension_numbers = (self.layout, "OIHW", self.layout)
+        self.space_to_depth = bool(space_to_depth)
+        if self.space_to_depth:
+            kh, kw = self.kernel_size
+            (p0, p1), (q0, q1) = self.padding
+            if (self.stride != (2, 2) or kh != kw or p0 != p1
+                    or q0 != q1 or p0 != q0 or 2 * p0 != kh - 1
+                    or self.group != 1 or self.dilation != (1, 1)
+                    or self.pad_mode
+                    or (self.height and self.height % 2)
+                    or (self.width and self.width % 2)):
+                raise ValueError(
+                    "space_to_depth stem requires stride 2, square odd "
+                    "kernel with pad = (K-1)/2, group 1, no dilation, "
+                    "and even spatial dims (the 7x7/s2 ResNet stem "
+                    "shape)")
 
     def output_shape(self, x_shape):
         if self.layout == "NHWC":
@@ -85,6 +100,58 @@ class ConvHandle:
         return (n, self.out_channels, oh, ow)
 
 
+def _s2d_geometry(K, P):
+    """Tap decomposition of a stride-2 conv axis: kernel position p maps
+    to block offset t and parity a via p - P = 2t + a. Returns
+    (t_min, t_max) — the transformed kernel spans t_max - t_min + 1."""
+    qs = [p - P for p in range(K)]
+    ts = [(q - (q % 2)) // 2 for q in qs]
+    return min(ts), max(ts)
+
+
+def _space_to_depth_conv(x, W, handle):
+    """The MLPerf-style stem transform: a KxK stride-2 conv with tiny
+    C_in (3 for images — wasting 3/128 of the MXU's lane dim) is
+    EXACTLY a (K+1)/2-rounded conv at stride 1 on the space-to-depth'd
+    input with 4x the channels. Weights stay stored as (O, C, K, K) —
+    checkpoints unchanged — and are re-indexed into the transformed
+    kernel inside the trace (a compile-time constant gather)."""
+    import numpy as np
+    h = handle
+    K, _ = h.kernel_size
+    (P, _), _ = h.padding
+    t_min, t_max = _s2d_geometry(K, P)
+    Kp = t_max - t_min + 1
+    O, C = h.out_channels, h.in_channels
+    # weight re-index: W4[o, c*4 + ah*2 + aw, th-t_min, tw-t_min]
+    #   = W[o, c, p_h, p_w]  with p = (2t + a) + P. The index tables are
+    # numpy constants, so the whole remap is ONE gather + ONE scatter in
+    # the trace (not K*K*C dynamic-update-slices).
+    c_i, ph_i, pw_i = np.meshgrid(np.arange(C), np.arange(K),
+                                  np.arange(K), indexing="ij")
+    c_i, ph_i, pw_i = c_i.ravel(), ph_i.ravel(), pw_i.ravel()
+    qh, qw = ph_i - P, pw_i - P
+    ah, aw = qh % 2, qw % 2
+    th, tw = (qh - ah) // 2, (qw - aw) // 2
+    W4 = jnp.zeros((O, C * 4, Kp, Kp), W.dtype).at[
+        :, c_i * 4 + ah * 2 + aw, th - t_min, tw - t_min].set(
+        W[:, c_i, ph_i, pw_i])
+    pad = ((-t_min, t_max), (-t_min, t_max))
+    if h.layout == "NHWC":
+        N, H, Wd, _ = x.shape
+        xb = x.reshape(N, H // 2, 2, Wd // 2, 2, C) \
+            .transpose(0, 1, 3, 5, 2, 4).reshape(N, H // 2, Wd // 2,
+                                                 C * 4)
+    else:
+        N, _, H, Wd = x.shape
+        xb = x.reshape(N, C, H // 2, 2, Wd // 2, 2) \
+            .transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, H // 2,
+                                                 Wd // 2)
+    return lax.conv_general_dilated(
+        xb, W4, window_strides=(1, 1), padding=pad,
+        dimension_numbers=h.dimension_numbers)
+
+
 class _Conv2d(Operator):
     """Forward via one MXU conv; backward via vjp (reference
     GpuConvForward/Backwardx/W/b convolution.h:131-141)."""
@@ -96,6 +163,12 @@ class _Conv2d(Operator):
 
     def forward(self, x, W, b=None):
         h = self.handle
+        if getattr(h, "space_to_depth", False):
+            y = _space_to_depth_conv(x, W, h)
+            if b is not None:
+                y = y + (b.reshape(1, 1, 1, -1) if h.layout == "NHWC"
+                         else b.reshape(1, -1, 1, 1))
+            return y.astype(x.dtype)
         padding = h.pad_mode if h.pad_mode else h.padding
         if self.odd_padding is not None:
             t, bo, l, r = self.odd_padding

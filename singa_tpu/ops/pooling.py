@@ -30,8 +30,13 @@ class PoolingHandle:
     """
 
     def __init__(self, x, kernel_size, stride=None, padding=0, is_max=True,
-                 layout=None):
+                 layout=None, count_include_pad=True):
         from .layout import current_layout
+        # True matches the reference's cuDNN include-padding average mode
+        # (CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING); the ONNX
+        # AveragePool DEFAULT is exclude (count_include_pad=0), which the
+        # backend requests explicitly
+        self.count_include_pad = bool(count_include_pad)
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride if stride is not None else kernel_size)
         if (isinstance(padding, (tuple, list)) and len(padding) == 2
@@ -82,11 +87,16 @@ class _Pooling2d(Operator):
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
                 else jnp.iinfo(x.dtype).min
             return lax.reduce_window(x, init, lax.max, dims, strides, pads)
-        # average pool: divide by true window size (count_include_pad=True
-        # matches the reference cuDNN mode
-        # CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING)
         s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
-        return s / float(kh * kw)
+        if h.count_include_pad:
+            # divide by full window size (reference cuDNN include mode)
+            return s / float(kh * kw)
+        # ONNX default: divide by the VALID element count per window —
+        # a reduce_window over ones gives it; XLA folds this to a
+        # constant table at compile time
+        ones = jnp.ones(x.shape, x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return s / cnt
 
 
 class GlobalAveragePool(Operator):

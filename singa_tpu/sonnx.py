@@ -180,7 +180,10 @@ class SingaFrontend:
                      "pads": [p0, q0, p1, q1]}
             if h.is_max_pooling:
                 return "MaxPool", attrs
-            attrs["count_include_pad"] = 1
+            # mirror the handle's divisor mode, not a hardcoded 1 —
+            # exclude-pad pools must survive the round-trip
+            attrs["count_include_pad"] = int(
+                getattr(h, "count_include_pad", True))
             return "AveragePool", attrs
         if ty in ("_BatchNorm2d", "_BatchNorm2dInference"):
             h = op.handle
@@ -852,7 +855,11 @@ class SingaBackend:
                     ins[0], tuple(ks),
                     tuple(a.get("strides", [1] * len(ks))),
                     ((pads[0], pads[2]), (pads[1], pads[3])),
-                    is_max=(ty == "MaxPool"), layout="NCHW")
+                    is_max=(ty == "MaxPool"), layout="NCHW",
+                    # ONNX AveragePool defaults to EXCLUDING padding
+                    # from the divisor (count_include_pad=0)
+                    count_include_pad=bool(
+                        a.get("count_include_pad", 0)))
                 node.cache["handle"] = handle
             return pooling_2d(handle, ins[0])
         if ty == "GlobalAveragePool":

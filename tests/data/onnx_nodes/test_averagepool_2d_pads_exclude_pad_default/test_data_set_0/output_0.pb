@@ -1,0 +1,2 @@
+
+Boutput_0JG}	:E{h.K>ཫaLP	RO	>fi'gk=IX>l?LУ|>EV>?Ϩ+oqtx

@@ -9,6 +9,9 @@ A/B (tools/tpu_probe_extra.py resnet_layout_ab) a fair comparison:
 both layouts compute the SAME function.
 """
 
+import os
+import sys
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -169,6 +172,83 @@ def test_resnet_layout_train_parity(dev):
 
     a, b = losses("NCHW"), losses("NHWC")
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestSpaceToDepthStem:
+    """The exact stride-2 stem reformulation (ops/conv.py
+    _space_to_depth_conv): same weights, same math, C*4 channels at
+    stride 1 — so the MXU's lane dim isn't 97% padding on C_in=3."""
+
+    def test_exact_vs_plain_conv_7x7(self, dev):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 16, 16).astype(np.float32)
+        W = rng.randn(8, 3, 7, 7).astype(np.float32)
+        tx = tensor.Tensor(data=x, device=dev)
+        tW = tensor.Tensor(data=W, device=dev)
+        ref = tensor.to_numpy(conv2d(ConvHandle(x, 7, 2, 3, 3, 8),
+                                     tx, tW))
+        got = tensor.to_numpy(conv2d(
+            ConvHandle(x, 7, 2, 3, 3, 8, space_to_depth=True), tx, tW))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_exact_nhwc(self, dev):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 12, 12).astype(np.float32)
+        W = rng.randn(4, 3, 7, 7).astype(np.float32)
+        ref = tensor.to_numpy(conv2d(
+            ConvHandle(x, 7, 2, 3, 3, 4),
+            tensor.Tensor(data=x, device=dev),
+            tensor.Tensor(data=W, device=dev)))
+        xt = _nchw_to_nhwc(x)
+        got = tensor.to_numpy(conv2d(
+            ConvHandle(xt, 7, 2, 3, 3, 4, space_to_depth=True,
+                       layout="NHWC"),
+            tensor.Tensor(data=xt, device=dev),
+            tensor.Tensor(data=W, device=dev)))
+        np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gradients_match_plain(self, dev, training_mode):
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_gradcheck import gradcheck
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        W = rng.randn(3, 2, 3, 3).astype(np.float32)
+        h = ConvHandle(x, 3, 2, 1, 2, 3, space_to_depth=True)
+        gradcheck(lambda xx, ww: conv2d(h, xx, ww), [x, W])
+
+    def test_invalid_geometry_rejected(self):
+        x = np.zeros((1, 3, 16, 16), np.float32)
+        with pytest.raises(ValueError, match="space_to_depth"):
+            ConvHandle(x, 7, 1, 3, 3, 8, space_to_depth=True)  # stride 1
+        with pytest.raises(ValueError, match="space_to_depth"):
+            ConvHandle(x, 4, 2, 1, 3, 8, space_to_depth=True)  # even K
+        with pytest.raises(ValueError, match="space_to_depth"):
+            ConvHandle(np.zeros((1, 3, 15, 16), np.float32),
+                       7, 2, 3, 3, 8, space_to_depth=True)     # odd H
+
+    def test_resnet_stem_train_parity(self, dev):
+        """Same seed, same data: the s2d-stem ResNet's losses track the
+        plain-stem run (same function, same init, same update), and the
+        checkpoint stays layout/stem-independent."""
+        from singa_tpu.models import resnet
+
+        def losses(stem):
+            d = device.create_cpu_device()
+            d.SetRandSeed(0)
+            m = resnet.create_model(depth=18, num_classes=10, stem=stem)
+            m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+            rng = np.random.RandomState(0)
+            x = rng.randn(2, 3, 32, 32).astype(np.float32)
+            y = np.eye(10)[rng.randint(0, 10, 2)].astype(np.float32)
+            tx = tensor.Tensor(data=x, device=d, requires_grad=False)
+            ty = tensor.Tensor(data=y, device=d, requires_grad=False)
+            m.compile([tx], is_train=True, use_graph=True)
+            return [float(m(tx, ty)[1].data) for _ in range(2)]
+
+        np.testing.assert_allclose(losses("conv7"),
+                                   losses("space_to_depth"),
+                                   rtol=1e-4, atol=1e-5)
 
 
 def test_layout_env_default(monkeypatch):

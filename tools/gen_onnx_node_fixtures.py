@@ -994,6 +994,16 @@ def build_cases():
         [("x", apx)], [("y", apo)],
         {"kernel_shape": [3, 3], "pads": [1, 1, 1, 1],
          "count_include_pad": 1}))
+    # the ONNX DEFAULT divides by the valid-element count per window
+    apo_ex = np.zeros((1, 2, 4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            win = apx[:, :, max(0, i - 1):i + 2, max(0, j - 1):j + 2]
+            apo_ex[:, :, i, j] = win.mean((2, 3))
+    cases.append(case(
+        "test_averagepool_2d_pads_exclude_pad_default", "AveragePool",
+        [("x", apx)], [("y", apo_ex)],
+        {"kernel_shape": [3, 3], "pads": [1, 1, 1, 1]}))
     # strided slice over 3 axes
     sl3 = r(4, 5, 6)
     cases.append(case(

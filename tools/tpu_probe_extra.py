@@ -145,6 +145,39 @@ def _resnet_layout_ab(dev):
     return out
 
 
+def _resnet_stem_ab(dev):
+    """Second MFU lever behind the layout question: the space-to-depth
+    stem (exact 7x7/s2 reformulation, ops/conv.py) A/B'd against the
+    plain stem in the SAME window, both using the measured layout
+    winner. Measurement-only this round — bench keeps the plain stem
+    until a banked win justifies flipping the default."""
+    peak = bench._peak_flops(getattr(dev.jax_device, "device_kind", ""))
+    layout, layout_src = bench._conv_layout()
+    out = {"extra": "resnet_stem_ab", "batch": 32, "dtype": "bfloat16",
+           "conv_layout": layout, "conv_layout_src": layout_src,
+           "timing": "slope-readback"}
+    ms = {}
+    for stem in ("conv7", "space_to_depth"):
+        thr, step_ms = bench._measure(dev, batch=32, niters=20, warmup=3,
+                                      image_size=224, depth=50,
+                                      dtype_name="bfloat16",
+                                      layout=layout, stem=stem)
+        ms[stem] = step_ms
+        rec = {"stem": stem, "images_per_sec": round(thr, 1),
+               "step_ms": round(step_ms, 2)}
+        if peak:
+            rec["mfu"] = round(
+                thr * bench.RESNET50_TRAIN_FLOPS_PER_IMAGE / peak, 4)
+        out.update({f"{stem}_{k}": v for k, v in rec.items()
+                    if k != "stem"})
+        emit({"extra": "resnet_stem_probe", "conv_layout": layout, **rec,
+              "timing": "slope-readback"})
+    out["winner"] = "space_to_depth" \
+        if ms["space_to_depth"] < 0.98 * ms["conv7"] else "conv7"
+    out["s2d_speedup"] = round(ms["conv7"] / ms["space_to_depth"], 3)
+    return out
+
+
 def _hbm_footprint(dev):
     """Peak HBM per training step (VERDICT r5 #7 — the TPU counterpart
     of the reference's MemPoolConf pool stats, core.proto:52). Each
@@ -417,6 +450,7 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
 # run FIRST in a window; re-confirmations of known numbers run last
 LEGS = (_resnet_fusion_profile, _resnet_layout_ab,
         _lm_long_context, _lm_decode_throughput, _hbm_footprint,
+        _resnet_stem_ab,
         _resnet50_bf16_large_batch, _mlp_step_time, _flash_block_sweep)
 
 
