@@ -132,6 +132,26 @@ def _conv_layout():
     return "NCHW", "default-unmeasured"
 
 
+def _resnet_stem():
+    """Stem for the ResNet legs, mirroring _conv_layout: BENCH_RESNET_STEM
+    pins it; otherwise the banked resnet_stem_ab winner from THIS round
+    (the variant is exact — tests pin parity — so using the measured
+    faster form is a labeled optimization, not a model change); default
+    conv7 when unmeasured."""
+    env = os.environ.get("BENCH_RESNET_STEM", "auto").lower()
+    if env in ("conv7", "space_to_depth"):
+        return env, "env"
+    if env != "auto":
+        print(f"bench: BENCH_RESNET_STEM={env!r} is not "
+              f"conv7|space_to_depth|auto; using auto", file=sys.stderr)
+    for o in reversed(_load_obs()):
+        if (o.get("event") == "extra"
+                and o.get("extra") == "resnet_stem_ab"
+                and o.get("winner") in ("conv7", "space_to_depth")):
+            return o["winner"], "measured-ab"
+    return "conv7", "default-unmeasured"
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache for the benchmark children.
 
@@ -203,7 +223,7 @@ def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
     import jax.numpy as jnp
     import numpy as np
 
-    stem = stem or os.environ.get("BENCH_RESNET_STEM", "conv7")
+    stem = stem or _resnet_stem()[0]
     model = resnet.create_model(depth=depth, num_classes=10, num_channels=3,
                                 layout=layout, stem=stem)
     model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
@@ -295,10 +315,11 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     peak = _peak_flops(kind)
     peak32 = _peak_flops(kind, dtype="fp32")
     layout, layout_src = _conv_layout()
+    stem, stem_src = _resnet_stem()
 
     throughput, step_ms = _leg_guard(
         lambda: _measure(dev, batch, niters, warmup, image_size,
-                         depth, "float32", layout=layout),
+                         depth, "float32", layout=layout, stem=stem),
         leg_budget, "fp32")
     res = {
         "throughput": throughput,
@@ -316,6 +337,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             else "bf16_peak"),
         "conv_layout": layout,
         "conv_layout_src": layout_src,
+        "resnet_stem": stem,
+        "resnet_stem_src": stem_src,
         "platform": platform,
         "device_kind": kind or "unknown",
         # distinguishes honest slope-readback records from the earlier
@@ -330,7 +353,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         try:
             bt, bs = _leg_guard(
                 lambda: _measure(dev, batch, niters, warmup, image_size,
-                                 depth, "bfloat16", layout=layout),
+                                 depth, "bfloat16", layout=layout,
+                                 stem=stem),
                 leg_budget, "bf16")
             res["bf16_throughput"] = bt
             res["bf16_step_ms"] = bs
@@ -979,6 +1003,7 @@ def _emit_report(res, live, smoke, obs, errors):
     # tokens/s, timing method, partial/suspect flags), not just the
     # headline images/sec
     for k in ("mfu", "mfu_denominator", "conv_layout", "conv_layout_src",
+              "resnet_stem", "resnet_stem_src",
               "bf16_throughput", "bf16_step_ms", "bf16_mfu",
               "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",
               "lm_mfu", "lm_bf16_mfu", "lm_error", "lm_bf16_error",

@@ -266,3 +266,17 @@ def test_peak_flops_per_dtype(monkeypatch):
     monkeypatch.setenv("BENCH_PEAK_TFLOPS_FP32", "50")
     assert bench._peak_flops("TPU v5 lite", dtype="fp32") == 50e12
     assert bench._peak_flops("TPU v5 lite") == 197e12
+
+
+def test_resnet_stem_env_and_banked(monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_RESNET_STEM", "space_to_depth")
+    assert bench._resnet_stem() == ("space_to_depth", "env")
+    monkeypatch.setenv("BENCH_RESNET_STEM", "s2d")   # typo: warn, auto
+    monkeypatch.setattr(bench, "_load_obs", lambda: [])
+    assert bench._resnet_stem() == ("conv7", "default-unmeasured")
+    assert "conv7|space_to_depth|auto" in capsys.readouterr().err
+    monkeypatch.delenv("BENCH_RESNET_STEM")
+    monkeypatch.setattr(bench, "_load_obs", lambda: [
+        {"event": "extra", "extra": "resnet_stem_ab",
+         "winner": "space_to_depth"}])
+    assert bench._resnet_stem() == ("space_to_depth", "measured-ab")
