@@ -33,6 +33,10 @@ def main():
     ap.add_argument("--dist", action="store_true")
     ap.add_argument("--verbosity", "-v", type=int, default=0)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--layout", default="NCHW",
+                    choices=["NCHW", "NHWC"])
+    ap.add_argument("--stem", default="conv7",
+                    choices=["conv7", "space_to_depth"])
     args = ap.parse_args()
 
     if args.cpu:
@@ -51,7 +55,8 @@ def main():
 
     world = 1
     m = resnet.create_model(depth=args.depth, num_classes=1000,
-                            num_channels=3)
+                            num_channels=3, layout=args.layout,
+                            stem=args.stem)
     sgd = opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5)
     if args.dist:
         d = opt.DistOpt(sgd)

@@ -53,6 +53,14 @@ def build_parser():
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--no-augment", action="store_true")
     ap.add_argument("--verbosity", "-v", type=int, default=0)
+    ap.add_argument("--layout", default="NCHW",
+                    choices=["NCHW", "NHWC"],
+                    help="conv-trunk activation layout (resnet only; "
+                         "NHWC is the TPU lane-friendly form)")
+    ap.add_argument("--stem", default="conv7",
+                    choices=["conv7", "space_to_depth"],
+                    help="resnet stem: plain 7x7/s2 conv or its exact "
+                         "space-to-depth reformulation")
     ap.add_argument("--npz", default=None,
                     help="npz with arrays x,y (overrides the data arg)")
     return ap
@@ -114,8 +122,11 @@ def main():
                                      num_classes=num_classes)
         augment = False
     else:
+        kw = {}
+        if args.model == "resnet":
+            kw = {"layout": args.layout, "stem": args.stem}
         model = factory.create_model(num_channels=chans,
-                                     num_classes=num_classes)
+                                     num_classes=num_classes, **kw)
     sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
     model.set_optimizer(opt.DistOpt(sgd) if args.dist else sgd)
 
