@@ -125,6 +125,31 @@ class TestBatchNorm:
             np.asarray(bn.running_var.data)[None, :, None, None] + bn.eps)
         np.testing.assert_allclose(np.asarray(y.data), expect, atol=1e-3)
 
+    def test_bf16_moments_accumulate_in_f32(self):
+        """A bf16 sum over N*H*W elements loses most of its mantissa;
+        _global_moments upcasts before reducing, so bf16 BN's running
+        stats must land within bf16 INPUT precision of the f32 run
+        (not bf16 ACCUMULATION error, which is ~100x worse here)."""
+        import jax.numpy as jnp
+        autograd.training = True
+        try:
+            rs = np.random.RandomState(3)
+            x = (rs.randn(64, 2, 16, 16) * 2 + 3).astype(np.float32)
+            bn32 = layer.BatchNorm2d(momentum=0.0)
+            bn32(t(x, rg=True))
+            bn16 = layer.BatchNorm2d(momentum=0.0)
+            bn16(Tensor(data=jnp.asarray(x, jnp.bfloat16),
+                        requires_grad=True, stores_grad=True))
+            np.testing.assert_allclose(
+                np.asarray(bn16.running_mean.data, np.float32),
+                np.asarray(bn32.running_mean.data), rtol=2e-2, atol=2e-2)
+            np.testing.assert_allclose(
+                np.asarray(bn16.running_var.data, np.float32),
+                np.asarray(bn32.running_var.data), rtol=2e-2)
+            assert bn16.running_mean.data.dtype == jnp.float32
+        finally:
+            autograd.training = False
+
     def test_states_include_running(self):
         bn = layer.BatchNorm2d()
         bn(t(np.random.randn(2, 3, 4, 4).astype(np.float32)))
