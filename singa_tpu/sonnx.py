@@ -155,6 +155,15 @@ class SingaFrontend:
 
         if ty == "_Conv2d":
             h = op.handle
+            if getattr(h, "layout", "NCHW") != "NCHW":
+                # ONNX Conv is NCHW-only; exporting NHWC activations as
+                # a Conv node would be silently wrong. Checkpoints are
+                # layout-independent, so the fix is a rebuild.
+                raise NotImplementedError(
+                    "ONNX export of an NHWC-mode conv is not supported "
+                    "— rebuild the model with layout='NCHW' (weights/"
+                    "checkpoints are identical across layouts) and "
+                    "export that")
             (p0, p1), (q0, q1) = h.padding
             attrs = {"kernel_shape": list(h.kernel_size),
                      "strides": list(h.stride),

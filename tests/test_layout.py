@@ -261,3 +261,37 @@ def test_layout_env_default(monkeypatch):
         assert h.layout == "NHWC"
         # explicit beats ambient
         assert ConvHandle(x, 3, 1, 1, 2, 2, layout="NCHW").layout == "NCHW"
+
+
+def test_onnx_export_nhwc_raises_clearly(dev):
+    """ONNX Conv is NCHW-only: exporting an NHWC-mode model must fail
+    loudly, not emit silently wrong nodes."""
+    from singa_tpu import sonnx
+    from singa_tpu.models import resnet
+    m = resnet.create_model(depth=18, num_classes=4, layout="NHWC")
+    x = tensor.Tensor(data=np.random.randn(1, 3, 32, 32)
+                      .astype(np.float32), device=dev)
+    m.compile([x], is_train=True, use_graph=False)
+    m.eval()
+    with pytest.raises(NotImplementedError, match="NCHW"):
+        sonnx.to_onnx(m, [x], "nhwc")
+
+
+def test_onnx_export_s2d_stem_roundtrips(dev):
+    """The space-to-depth stem is the SAME function as the 7x7/s2 conv,
+    so it exports as a plain ONNX Conv and the reimport matches."""
+    from singa_tpu import sonnx
+    from singa_tpu.models import resnet
+    d = device.create_cpu_device()
+    d.SetRandSeed(2)
+    m = resnet.create_model(depth=18, num_classes=4,
+                            stem="space_to_depth")
+    x = tensor.Tensor(data=np.random.RandomState(0)
+                      .randn(1, 3, 32, 32).astype(np.float32), device=d)
+    m.compile([x], is_train=True, use_graph=False)
+    m.eval()
+    want = tensor.to_numpy(m(x))
+    om = sonnx.to_onnx(m, [x], "s2d")
+    rep = sonnx.prepare(om, device="CPU")
+    got = np.asarray(rep.run([x])[0].data)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
