@@ -101,6 +101,7 @@ EXTRA_SUCCESS_MARKERS = {
     "lm_long_context": ("lm_bf16_s4096_remat_tokens_per_sec",),
     "lm_decode_throughput": ("lm_decode_tokens_per_sec",),
     "hbm_footprint": ("hbm_resnet50_b32_bf16", "hbm_lm_b8_s1024_bf16"),
+    "lm_fusion_profile": ("lm_bf16_fusion_profile",),
     "resnet_stem_ab": ("resnet_stem_ab",),
     "resnet50_bf16_large_batch": ("resnet50_bf16_b128",),
     "mlp_step_time": ("mlp_mnist_b64_step_us",),
@@ -965,12 +966,13 @@ def _fold_extras(obs):
                 and o.get("error") is None:
             latest[o["extra"]] = {k: v for k, v in o.items()
                                   if k not in ("event", "extra")}
-    # the fusion profile is large: fold a compact summary (total + top-3)
+    # fusion profiles are large: fold a compact summary (total + top-3)
     for o in obs:
         if o.get("event") == "extra" \
-                and o.get("extra") == "resnet50_bf16_fusion_profile" \
+                and o.get("extra") in ("resnet50_bf16_fusion_profile",
+                                       "lm_bf16_fusion_profile") \
                 and o.get("error") is None:
-            latest["resnet50_bf16_fusion_profile"] = {
+            latest[o["extra"]] = {
                 "ts": o.get("ts"),
                 "total_measured_s": o.get("total_measured_s"),
                 "top": (o.get("top") or [])[:3],

@@ -145,6 +145,40 @@ def _resnet_layout_ab(dev):
     return out
 
 
+def _lm_fusion_profile(dev):
+    """Per-fusion breakdown of THE benchmark bf16 LM train step
+    (bench._setup_lm_step — flash attention + fused CE head), same
+    methodology as the ResNet profile: the LM's ~20%-MFU estimate has
+    never been decomposed on hardware."""
+    dev.ResetTimeProfiling()
+    try:
+        step = bench._setup_lm_step(dev, compute_dtype="bfloat16")
+        loss = None
+        for _ in range(3):
+            loss = step()
+        bench._force(loss.data)
+        dev.SetVerbosity(2)
+        bench._force(step().data)
+        rows = sorted(((k[len("fusion/"):], cnt, tot)
+                       for k, (cnt, tot) in dev.time_profiling.items()
+                       if k.startswith("fusion/")),
+                      key=lambda r: -r[2])
+        if not rows:
+            return {"extra": "_lm_fusion_profile_empty",
+                    "error": "no fusion rows captured from the trace"}
+        total = sum(r[2] for r in rows)
+        return {"extra": "lm_bf16_fusion_profile",
+                "shape": dict(bench.LM_SHAPE),
+                "total_measured_s": round(total, 4),
+                "top": [{"op": op[:80], "count": cnt,
+                         "total_ms": round(tot * 1e3, 2),
+                         "pct": round(100 * tot / total, 1)}
+                        for op, cnt, tot in rows[:10]]}
+    finally:
+        dev.SetVerbosity(0)
+        dev.ResetTimeProfiling()
+
+
 def _resnet_stem_ab(dev):
     """Second MFU lever behind the layout question: the space-to-depth
     stem (exact 7x7/s2 reformulation, ops/conv.py) A/B'd against the
@@ -450,7 +484,7 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
 # run FIRST in a window; re-confirmations of known numbers run last
 LEGS = (_resnet_fusion_profile, _resnet_layout_ab,
         _lm_long_context, _lm_decode_throughput, _hbm_footprint,
-        _resnet_stem_ab,
+        _lm_fusion_profile, _resnet_stem_ab,
         _resnet50_bf16_large_batch, _mlp_step_time, _flash_block_sweep)
 
 
