@@ -1,0 +1,136 @@
+"""Hermetic tests for the opportunistic TPU probe legs
+(tools/tpu_probe_extra.py): the record structure, winner rules, child
+parsing, and retry markers are exercised with monkeypatched
+measurements, so a leg bug can't burn a real (rare) tunnel window.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+import bench
+
+_SPEC = importlib.util.spec_from_file_location(
+    "tpu_probe_extra",
+    os.path.join(os.path.dirname(bench.__file__), "tools",
+                 "tpu_probe_extra.py"))
+probe = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(probe)
+
+
+@pytest.fixture
+def banked(monkeypatch):
+    """Collect emitted records instead of writing the obs file."""
+    out = []
+    monkeypatch.setattr(bench, "_record_obs",
+                        lambda ev, rec: out.append((ev, dict(rec))))
+    return out
+
+
+def test_leg_names_match_marker_table():
+    legs = {f.__name__.lstrip("_") for f in probe.LEGS}
+    assert legs == set(bench.EXTRA_SUCCESS_MARKERS), (
+        legs ^ set(bench.EXTRA_SUCCESS_MARKERS))
+
+
+def test_layout_ab_record_and_margin(monkeypatch, banked):
+    times = {"NCHW": 13.0, "NHWC": 12.9}   # within 2%: default stands
+
+    def fake_measure(dev, batch, niters, warmup, image_size, depth,
+                     dtype_name, layout="NCHW", stem=None):
+        return 32.0 / (times[layout] / 1e3), times[layout]
+
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(bench, "_peak_flops", lambda *a, **k: 197e12)
+    rec = probe._resnet_layout_ab(types.SimpleNamespace(jax_device=None))
+    assert rec["winner"] == "NCHW"
+    assert rec["nchw_step_ms"] == 13.0 and rec["nhwc_step_ms"] == 12.9
+    assert rec["nhwc_mfu"] > rec["nchw_mfu"] > 0
+    # per-variant probe records banked as they complete
+    assert [r for _, r in banked if r.get("extra") ==
+            "resnet_layout_probe"]
+
+    times["NHWC"] = 10.0                   # clear win
+    banked.clear()
+    rec = probe._resnet_layout_ab(types.SimpleNamespace(jax_device=None))
+    assert rec["winner"] == "NHWC"
+    assert rec["nhwc_speedup"] == round(13.0 / 10.0, 3)
+
+
+def test_stem_ab_record_and_margin(monkeypatch, banked):
+    times = {"conv7": 13.0, "space_to_depth": 11.0}
+
+    def fake_measure(dev, batch, niters, warmup, image_size, depth,
+                     dtype_name, layout="NCHW", stem=None):
+        return 32.0 / (times[stem] / 1e3), times[stem]
+
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(bench, "_peak_flops", lambda *a, **k: 197e12)
+    monkeypatch.setattr(bench, "_conv_layout",
+                        lambda: ("NHWC", "measured-ab"))
+    rec = probe._resnet_stem_ab(types.SimpleNamespace(jax_device=None))
+    assert rec["winner"] == "space_to_depth"
+    assert rec["conv_layout"] == "NHWC"
+    assert rec["s2d_speedup"] == round(13.0 / 11.0, 3)
+
+
+def _fake_proc(lines, rc=0):
+    return types.SimpleNamespace(stdout="\n".join(lines), stderr="",
+                                 returncode=rc)
+
+
+def test_hbm_footprint_success_and_error_markers(monkeypatch, banked):
+    outs = {
+        "resnet": _fake_proc([json.dumps(
+            {"hbm": "resnet", "model": "resnet50",
+             "peak_bytes_in_use": 7 << 30, "peak_gib": 7.0})]),
+        "lm": _fake_proc([json.dumps(
+            {"hbm": "lm", "error": "no accelerator"})]),
+    }
+    monkeypatch.setattr(bench, "_load_obs", lambda: [])
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda argv, **kw: outs[argv[-1]])
+    rec = probe._hbm_footprint(None)
+    names = [r.get("extra") for _, r in banked]
+    # resnet banked under its SUCCESS marker; the lm child's error line
+    # must bank under the ERROR name so the watcher retries the leg
+    assert "hbm_resnet50_b32_bf16" in names
+    assert "hbm_lm_b8_s1024_bf16_error" in names
+    assert "hbm_lm_b8_s1024_bf16" not in names
+    assert rec["children"] == 1
+
+
+def test_hbm_footprint_skips_banked_children(monkeypatch, banked):
+    calls = []
+    monkeypatch.setattr(bench, "_load_obs", lambda: [
+        {"event": "extra", "extra": "hbm_resnet50_b32_bf16",
+         "peak_gib": 7.0}])
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda argv, **kw: calls.append(argv[-1]) or _fake_proc(
+            [json.dumps({"hbm": "lm", "peak_bytes_in_use": 2 << 30})]))
+    rec = probe._hbm_footprint(None)
+    assert calls == ["lm"]          # only the missing child re-runs
+    assert rec["children"] == 2     # banked + fresh
+
+
+def test_extras_missing_honors_multi_marker_legs(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch", os.path.join(os.path.dirname(bench.__file__),
+                                  "tools", "tpu_watch.py"))
+    watch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+    obs = [{"event": "extra", "extra": "hbm_resnet50_b32_bf16"}]
+    monkeypatch.setattr(bench, "_load_obs", lambda: obs)
+    missing = watch._extras_missing()
+    assert "hbm_footprint" in missing     # lm marker still absent
+    obs.append({"event": "extra", "extra": "hbm_lm_b8_s1024_bf16"})
+    assert "hbm_footprint" not in watch._extras_missing()
+    # priority legs come FIRST in the missing order
+    assert missing[:2] == ["resnet_fusion_profile", "resnet_layout_ab"]
