@@ -669,6 +669,28 @@ class SingaFrontend:
                 cls._export_cossim(op, op_name, in_names, out_names,
                                    nodes, initializers)
                 continue
+            if ty == "SoftMax":
+                refs = getattr(op, "_export_refs", None)
+                nd = len(refs[0].shape) if refs else 2
+                ax = op.axis + nd if op.axis < 0 else op.axis
+                if nd > 2 and ax < nd - 1:
+                    # our softmax is per-axis; opset-11 Softmax coerces
+                    # to 2D at `axis`, so an INNER axis must be exported
+                    # as transpose -> last-axis softmax -> transpose
+                    # (semantics-preserving at any opset)
+                    perm = [i for i in range(nd) if i != ax] + [ax]
+                    inv = [perm.index(i) for i in range(nd)]
+                    tnm = f"{op_name}_t"
+                    nodes.append(helper.make_node(
+                        "Transpose", [in_names[0]], [tnm], name=tnm,
+                        perm=perm))
+                    snm = f"{op_name}_sm"
+                    nodes.append(helper.make_node(
+                        "Softmax", [tnm], [snm], name=snm, axis=nd - 1))
+                    nodes.append(helper.make_node(
+                        "Transpose", [snm], out_names, name=op_name,
+                        perm=inv))
+                    continue
             onnx_ty, attrs = cls._node_attrs_and_extra(
                 op, op_name, in_names, initializers)
             nodes.append(helper.make_node(onnx_ty, in_names, out_names,
@@ -738,13 +760,17 @@ def to_onnx(model, inputs, model_name="sonnx"):
 class OnnxNode:
     """Light view of a NodeProto (reference sonnx.OnnxNode)."""
 
-    def __init__(self, node):
+    def __init__(self, node, opset=None):
         self.node = node
         self.name = _sanitize(node.name) or _sanitize("_".join(node.output))
         self.op_type = node.op_type
         self.inputs = list(node.input)
         self.outputs = list(node.output)
         self.attrs = attribute_dict(node)
+        # default-domain opset of the containing model: ops whose
+        # SEMANTICS changed across opsets (Softmax's coerce-to-2D vs
+        # per-axis) dispatch on it
+        self.opset = opset
         self.cache = {}  # shape-specialised handles, filled on first run
 
 
@@ -888,7 +914,25 @@ class SingaBackend:
                                  a.get("alpha", 1.0), a.get("beta", 1.0),
                                  a.get("transA", 0), a.get("transB", 0))
         if ty == "Softmax":
-            return autograd.softmax(ins[0], a.get("axis", 1))
+            opset = node.opset or cls._opset_version
+            if opset >= 13:
+                # opset-13 redefined Softmax as single-axis, default -1
+                return autograd.softmax(ins[0], a.get("axis", -1))
+            # opset<=12: coerce to 2D at `axis`, softmax the rows —
+            # identical to per-axis only when `axis` is the last dim
+            axis = a.get("axis", 1)
+            x = ins[0]
+            nd = len(x.shape)
+            if axis < 0:
+                axis += nd
+            if axis >= nd - 1:
+                return autograd.softmax(x, -1)
+            shape = list(x.shape)
+            lead = 1
+            for s in shape[:axis]:
+                lead *= s
+            flat = autograd.reshape(x, (lead, -1))
+            return autograd.reshape(autograd.softmax(flat, -1), shape)
         if ty == "Concat":
             return autograd.cat(list(ins), a.get("axis", 0))
         if ty == "Flatten":
@@ -1196,11 +1240,14 @@ class SingaBackend:
     def prepare(cls, model, device="CPU", init_inputs=None, **kwargs):
         """Parse an ONNX ModelProto into a runnable :class:`SingaRep`
         (reference SingaBackend.prepare sonnx.py:1911)."""
+        opset = None
         for imp in model.opset_import:
-            if imp.domain == "" and imp.version > cls._opset_version:
-                warnings.warn(
-                    f"opset {imp.version} is newer than supported "
-                    f"({cls._opset_version})")
+            if imp.domain == "":
+                opset = imp.version
+                if imp.version > cls._opset_version:
+                    warnings.warn(
+                        f"opset {imp.version} is newer than supported "
+                        f"({cls._opset_version})")
         if model.ir_version > cls._ir_version:
             warnings.warn(
                 f"ir_version {model.ir_version} is newer than supported "
@@ -1238,7 +1285,7 @@ class SingaBackend:
 
         inputs = [vi for vi in graph.input if vi.name not in params]
         outputs = list(graph.output)
-        nodes = [OnnxNode(n) for n in graph.node]
+        nodes = [OnnxNode(n, opset=opset) for n in graph.node]
         return SingaRep(params, inputs, outputs, nodes, dev)
 
 

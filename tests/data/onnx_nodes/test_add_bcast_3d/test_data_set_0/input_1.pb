@@ -1,2 +1,2 @@
 
-Binput_1JtÍµ¾Óf@¿x‘ž>
+Binput_1JR'¾ ¾jž¾

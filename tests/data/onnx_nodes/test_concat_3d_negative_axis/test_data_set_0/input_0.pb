@@ -1,2 +1,2 @@
 
-Binput_0J0?-B~c8[}_3?W2?_-?0ˈJxV
+Binput_0J0asɾ,]geQɿ|S?$lK>V[_qP

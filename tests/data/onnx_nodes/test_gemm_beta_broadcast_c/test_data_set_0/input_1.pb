@@ -1,2 +1,2 @@
 
-Binput_1JP$>:ۣ>;@OhE3d?˗?/x꽮Հ>Qwɾ瘮==<ÿ:>>nΈ;
+Binput_1JP]S=V?23DgKSpTf?Gʿy>?= >_w?FW>b/+5i>??UI

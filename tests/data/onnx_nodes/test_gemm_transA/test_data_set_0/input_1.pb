@@ -1,3 +1,2 @@
 
-Binput_1JP„b/¿+£5¿°i>?—?þUI¿¬––¿yñ£¿±Ô?Ð&‹½ü@=8?	á>¿Ïœ>IÈ?ëOw¿¨=
-@ûjÍ½4¿Géš>ÃÑ‹?
+Binput_1JPqú¾twC?ö¿(=E>wÔ¯¾ÄâX¿¾T›¿?ø™¿: Ñ¿7ke?ísw¾§u">éÎ–?Í,œ¿#	q¿j‚>p{é¿>&¿ËÅl¿*·¨>

@@ -1,4 +1,5 @@
 
-Binput_0J?OAɾi>dd`?Voe>k?{^꾙C>=24?_-?PXf>gNs@+@.>ߒ?9YzuhH2=de<5v$*Ұm8þݟU!?yBB&>z?,=޿XhM	 >az2Y=V[?j>޼>Oľ?G?9*x<!??տZ>\.>R؎?dk$eϿ?/$Q>^/˿
+Binput_0J.>ߒ?9YzuhH2=de<5v$*Ұm8þݟU!?yBB&>z?,=޿XhM	 >az2Y=V[?j>޼>Oľ?G?9*x<!??տZ>\.>R؎?dk$eϿ?/$Q>^/˿
 =aHX?<>0b
-W?glh?i.3@(̦\,cc?"-	@X?7}K?_Y?pLmc?8#3>pa0N>$V>-Y1?)<>,Dᾖ෿g?ǰ֟k"$
+W?glh?i.3@(̦\,cc?"-	@X?7}K?_Y?pLmc?8#3>pa0N>$V>-Y1?)<>,Dᾖ෿g?ǰ֟k"$:9;V2gg>ƚ?>nӼǿ&
+?v\?$c?b>Y0?i8@V[C]?q>=J>m',t$F

@@ -1,2 +1,3 @@
 
-Boutput_0JH$*52=,=޿&>yOj>=V7}K	@,cc?$0N>pa"$ǰ෿
+Boutput_0JHOj>=Vdk>Z>>0X?
+="$ǰ෿$c??ǿ$Fm'=

@@ -1,2 +1,2 @@
 
-Binput_2J§b>Y0š?Úi8@V¿
+Binput_2Jg(<ã•æ?J}r?Ñúï¼

@@ -403,3 +403,14 @@ class TestOpRoundtrips:
     def test_op_roundtrip(self, name, fn, ins):
         m = OpNet(fn)
         roundtrip(m, [t(a) for a in ins], rtol=1e-4, atol=1e-5)
+
+
+def test_inner_axis_softmax_roundtrip():
+    """Our softmax is per-axis; opset-11 Softmax coerces to 2D — an
+    inner-axis export must decompose (transpose/softmax/transpose) so
+    the reimport matches the original semantics."""
+    m = OpNet(lambda x: autograd.softmax(x, 1))
+    x = t(np.random.RandomState(5).randn(2, 3, 4))
+    om = roundtrip(m, [x], rtol=1e-4, atol=1e-5)
+    types = [n.op_type for n in om.graph.node]
+    assert types.count("Transpose") >= 2 and "Softmax" in types

@@ -945,6 +945,26 @@ def build_cases():
         {"kernel_shape": [3, 3], "strides": [2, 2],
          "output_shape": [want_h, want_w], "auto_pad": "SAME_UPPER"}))
 
+    # -- Softmax: the opset-semantics fork -------------------------------
+    # opset<=12 coerces to 2D at `axis` (ref_softmax); opset-13 is
+    # single-axis. These fixtures use 3D x with an INNER axis — the one
+    # shape class where the two disagree — so the backend's opset
+    # dispatch is actually exercised.
+    smf = r(2, 3, 4)
+    cases.append(case(
+        "test_softmax_axis1_3d_coerce_opset11", "Softmax", [("x", smf)],
+        [("y", ref_softmax(smf, 1))], {"axis": 1}))
+    e13 = np.exp(smf - smf.max(1, keepdims=True))
+    cases.append(case(
+        "test_softmax_axis1_3d_peraxis_opset13", "Softmax", [("x", smf)],
+        [("y", (e13 / e13.sum(1, keepdims=True)).astype(np.float32))],
+        {"axis": 1}, opset=13))
+    ed = np.exp(smf - smf.max(-1, keepdims=True))
+    cases.append(case(
+        "test_softmax_default_axis_opset13", "Softmax", [("x", smf)],
+        [("y", (ed / ed.sum(-1, keepdims=True)).astype(np.float32))],
+        opset=13))
+
     # -- misc spec variants ----------------------------------------------
     g2 = r(3, 4, 5)
     gi2 = np.array([[0, 2], [1, 3]], np.int64)
