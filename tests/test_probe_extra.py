@@ -134,3 +134,80 @@ def test_extras_missing_honors_multi_marker_legs(monkeypatch):
     assert "hbm_footprint" not in watch._extras_missing()
     # priority legs come FIRST in the missing order
     assert missing[:2] == ["resnet_fusion_profile", "resnet_layout_ab"]
+
+
+class _FakeLock:
+    acquired = True
+
+    def __init__(self, wait_s):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_watcher_window_sequence(monkeypatch, tmp_path):
+    """One simulated live-window cycle of tools/tpu_watch.py main():
+    the order must be probe -> smoke -> PRIORITY diagnostics (fusion
+    profile + layout A/B, which steer the bench) -> full bench ->
+    remaining extras. A regression here quietly wastes the round's one
+    rare tunnel window."""
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch_sim", os.path.join(os.path.dirname(bench.__file__),
+                                      "tools", "tpu_watch.py"))
+    watch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+
+    events = []
+    monkeypatch.setattr(watch, "STOP_FILE",
+                        str(tmp_path / "stop"))
+    monkeypatch.setattr(watch, "MAX_HOURS", 0.01)
+    monkeypatch.setattr(watch, "IDLE_SLEEP", 0)
+    monkeypatch.setattr(watch.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_record_round_start", lambda h: True)
+    monkeypatch.setattr(bench, "_record_obs", lambda *a: None)
+    monkeypatch.setattr(bench, "_TpuLock", _FakeLock)
+    monkeypatch.setattr(bench, "_probe_tpu",
+                        lambda t: events.append("probe") or ("ok", None))
+    monkeypatch.setattr(bench, "_attempt_smoke",
+                        lambda t: events.append("smoke") or [])
+    complete = {"throughput": 1000.0, "platform": "tpu",
+                "device_kind": "TPU v5 lite", "conv_layout": "NHWC",
+                "timing": "slope-readback"}
+    monkeypatch.setattr(
+        bench, "_attempt",
+        lambda p, t: events.append("bench") or (dict(complete), None))
+
+    banked_markers = set()
+
+    def fake_load_obs():
+        return [{"event": "extra", "extra": m} for m in banked_markers]
+
+    monkeypatch.setattr(bench, "_load_obs", fake_load_obs)
+
+    def fake_run_extras(legs, timeout=1500):
+        events.append(("extras", tuple(legs)))
+        for leg in legs:
+            banked_markers.update(bench.EXTRA_SUCCESS_MARKERS[leg])
+        if len([e for e in events if isinstance(e, tuple)]) >= 2:
+            open(watch.STOP_FILE, "w").close()   # end after 2 extras runs
+        return len(legs)
+
+    monkeypatch.setattr(watch, "_run_extras", fake_run_extras)
+    watch.main()
+
+    probe_i = events.index("probe")
+    smoke_i = events.index("smoke")
+    bench_i = events.index("bench")
+    extras = [(i, e) for i, e in enumerate(events)
+              if isinstance(e, tuple)]
+    assert probe_i < smoke_i < extras[0][0] < bench_i < extras[1][0]
+    # first extras run = ONLY the priority diagnostics
+    assert extras[0][1][1] == tuple(watch.PRIORITY_LEGS)
+    # second extras run = the remaining legs, never the banked ones
+    legs2 = extras[1][1][1]
+    assert not (set(legs2) & set(watch.PRIORITY_LEGS))
+    assert "lm_long_context" in legs2
