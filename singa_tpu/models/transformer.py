@@ -247,40 +247,64 @@ __all__ = ["TransformerLM", "TransformerBlock", "MultiHeadAttention",
            "create_model"]
 
 
+def _lm_decode_tensors(m):
+    """Ordered (name, Tensor) leaves the decode functions need."""
+    out = []
+    for i, blk in enumerate(m.blocks):
+        at = blk.attn
+        leaves = [("ln1_s", blk.ln1.scale), ("ln1_b", blk.ln1.bias),
+                  ("wq", at.q_proj.W), ("bq", at.q_proj.b),
+                  ("wk", at.k_proj.W), ("bk", at.k_proj.b),
+                  ("wv", at.v_proj.W), ("bv", at.v_proj.b),
+                  ("wo", at.proj.W), ("bo", at.proj.b),
+                  ("ln2_s", blk.ln2.scale), ("ln2_b", blk.ln2.bias)]
+        if hasattr(blk.mlp, "up"):
+            leaves += [("w_up", blk.mlp.up.W), ("b_up", blk.mlp.up.b),
+                       ("w_dn", blk.mlp.down.W), ("b_dn", blk.mlp.down.b)]
+        else:
+            # MoE FFN: all expert groups gathered to host like the rest
+            # of the decode state; "wg" flags the MoE path downstream
+            leaves += [("wg", blk.mlp.wg), ("w1", blk.mlp.w1),
+                       ("b1", blk.mlp.b1), ("w2", blk.mlp.w2),
+                       ("b2", blk.mlp.b2)]
+        out.append(leaves)
+    return out
+
+
 def _lm_decode_params(m):
     """Pull the trained weights into one host-gathered pytree of jnp
     arrays for the pure decode functions (mesh-sharded state is gathered
-    once here — generation is a single-device inference convenience)."""
+    once here — generation is a single-device inference convenience).
+
+    The gathered tree is CACHED against the identity of the live param
+    arrays (jax arrays are immutable, so a train step rebinds every
+    leaf): a serving loop pays the host round-trip once, not per call.
+    The cache holds references to the arrays it was built from, so after
+    a train step one stale weight copy lives until the next generate()
+    call refreshes it — an inference-convenience tradeoff, documented
+    here."""
     import jax
     import jax.numpy as jnp
+
+    per_block = _lm_decode_tensors(m)
+    live = [t.data for leaves in per_block for _, t in leaves] \
+        + [m.tok_emb.W.data, m.pos_emb.W.data, m.ln_f.scale.data,
+           m.ln_f.bias.data, m.head.W.data, m.head.b.data]
+    pin = getattr(m, "_decode_params_pin", None)
+    if pin is not None and len(pin[0]) == len(live) and \
+            all(a is b for a, b in zip(pin[0], live)):
+        return pin[1]
 
     def a(t):
         return jnp.asarray(np.asarray(jax.device_get(t.data)))
 
-    blocks = []
-    for blk in m.blocks:
-        at = blk.attn
-        d = dict(
-            ln1_s=a(blk.ln1.scale), ln1_b=a(blk.ln1.bias),
-            wq=a(at.q_proj.W), bq=a(at.q_proj.b),
-            wk=a(at.k_proj.W), bk=a(at.k_proj.b),
-            wv=a(at.v_proj.W), bv=a(at.v_proj.b),
-            wo=a(at.proj.W), bo=a(at.proj.b),
-            ln2_s=a(blk.ln2.scale), ln2_b=a(blk.ln2.bias),
-        )
-        if hasattr(blk.mlp, "up"):
-            d.update(w_up=a(blk.mlp.up.W), b_up=a(blk.mlp.up.b),
-                     w_dn=a(blk.mlp.down.W), b_dn=a(blk.mlp.down.b))
-        else:
-            # MoE FFN: all expert groups gathered to host like the rest
-            # of the decode state; "wg" flags the MoE path downstream
-            d.update(wg=a(blk.mlp.wg), w1=a(blk.mlp.w1), b1=a(blk.mlp.b1),
-                     w2=a(blk.mlp.w2), b2=a(blk.mlp.b2))
-        blocks.append(d)
-    return dict(tok=a(m.tok_emb.W), pos=a(m.pos_emb.W),
-                lnf_s=a(m.ln_f.scale), lnf_b=a(m.ln_f.bias),
-                head_w=a(m.head.W), head_b=a(m.head.b),
-                blocks=blocks)
+    blocks = [{name: a(t) for name, t in leaves} for leaves in per_block]
+    P = dict(tok=a(m.tok_emb.W), pos=a(m.pos_emb.W),
+             lnf_s=a(m.ln_f.scale), lnf_b=a(m.ln_f.bias),
+             head_w=a(m.head.W), head_b=a(m.head.b),
+             blocks=blocks)
+    m._decode_params_pin = (live, P)
+    return P
 
 
 def _ln(x, s, b, eps=1e-5):

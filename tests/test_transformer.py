@@ -140,6 +140,35 @@ class TestVocabParallel:
         dp = train(mesh_mod.MeshConfig(), vocab=32, fused_head_chunk=8)
         np.testing.assert_allclose(dp, base, rtol=1e-3)
 
+    def test_decode_weight_cache_reuses_and_invalidates(self):
+        """The host-gather of decode weights is cached against live
+        param identity: repeated generate() calls reuse it; a train
+        step (which rebinds every param array) must invalidate it so
+        decoding NEVER uses stale weights."""
+        from singa_tpu.models.transformer import _lm_decode_params
+        _, m = train(steps=2, return_model=True)
+        P1 = _lm_decode_params(m)
+        assert _lm_decode_params(m) is P1          # identity: cached
+        ids, tgt = lm_data()
+        dev = device.create_cpu_device()
+        tx = tensor.Tensor(data=ids.astype(np.float32), device=dev,
+                           requires_grad=False)
+        ty = tensor.Tensor(data=tgt.astype(np.float32), device=dev,
+                           requires_grad=False)
+        m(tx, ty)                                  # one more train step
+        P2 = _lm_decode_params(m)
+        assert P2 is not P1                        # regathered
+        assert not np.allclose(np.asarray(P2["head_w"]),
+                               np.asarray(P1["head_w"]))
+        # and a greedy step after the refresh matches the live forward
+        out = m.generate(ids[:, :6], max_new_tokens=1, temperature=0)
+        m.eval()
+        m.graph_mode = False
+        want = np.argmax(np.asarray(
+            m(tensor.Tensor(data=ids[:, :6].astype(np.float32),
+                            device=dev)).data)[:, -1, :], -1)
+        np.testing.assert_array_equal(out[:, -1], want)
+
     def test_generate_after_sharded_training(self):
         # decoding consumes the tp-sharded trained state (host-gathered
         # once): one greedy step must equal the argmax of the model's own
