@@ -58,9 +58,26 @@ def parse_trace_dir(logdir):
                     continue
             elif not _is_xla_op_event(name):
                 continue
+            name = _enrich(name, e.get("args"))
             cnt, tot = out.get(name, (0, 0.0))
             out[name] = (cnt + 1, tot + float(e["dur"]) * 1e-6)
     return out
+
+
+def _enrich(name, args):
+    """Fold trace metadata into an uninformative fusion symbol: device
+    lanes name events "fusion.NN", but their args often carry the HLO
+    long name / source op — without it a banked profile row can't be
+    attributed to a model component. Purely additive: events without
+    metadata keep their bare name (CPU CI traces are unchanged)."""
+    if not isinstance(args, dict):
+        return name
+    meta = args.get("long_name") or args.get("tf_op") \
+        or args.get("hlo_op") or args.get("hlo_category")
+    meta = str(meta) if meta else ""
+    if meta and meta != name:
+        return f"{name}|{meta[:160]}"
+    return name
 
 
 def measure_step_fusions(run_step, logdir=None):

@@ -186,3 +186,17 @@ class TestMeasuredFusionProfiling:
         out = prof.parse_trace_dir(str(tmp_path))
         # host lane ignored once a device lane exists
         assert out == {"fusion.12": (1, 80.0 * 1e-6)}
+
+
+def test_enrich_folds_metadata_into_fusion_symbols():
+    from singa_tpu.profiling import _enrich
+    # device-lane fusion symbols gain their HLO long name
+    assert _enrich("fusion.42", {"long_name": "convolution.7"}) == \
+        "fusion.42|convolution.7"
+    # no metadata / self-referential metadata: bare name unchanged
+    assert _enrich("fusion.42", None) == "fusion.42"
+    assert _enrich("fusion.42", {}) == "fusion.42"
+    assert _enrich("add.1", {"long_name": "add.1"}) == "add.1"
+    # oversized metadata is truncated, not dropped
+    out = _enrich("fusion.1", {"tf_op": "x" * 500})
+    assert len(out) < 200 and out.startswith("fusion.1|xxx")

@@ -398,14 +398,14 @@ def _lm_decode_throughput(dev):
     m.compile([ti], is_train=True, use_graph=True)
     m(ti, tt)
 
-    # generate() host-gathers + re-uploads the weights EVERY call (a
-    # single-device inference convenience) — a per-call constant that
-    # would dominate the tunnel timing. The two-point slope over decode
-    # lengths cancels it (same methodology as bench._slope_time), so
-    # the banked number is the per-token decode cost alone. Each
-    # variant's scan compiles once before its timed call; generate
-    # returns a host numpy array, so every timing ends in a full
-    # readback.
+    # generate()'s weight gather is cached across calls (identity-keyed
+    # on the live params), but a residual per-call constant remains
+    # (prompt upload, readback, dispatch). The two-point slope over
+    # decode lengths cancels any such constant (same methodology as
+    # bench._slope_time), so the banked number is the per-token decode
+    # cost alone. Each variant's scan compiles once before its timed
+    # call; generate returns a host numpy array, so every timing ends
+    # in a full readback.
     def timed(new_tokens):
         m.generate(prompt, max_new_tokens=new_tokens,
                    temperature=0)     # compile + warm this variant
