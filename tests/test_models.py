@@ -229,3 +229,35 @@ class TestImageNetZoo:
         m.compile([x], is_train=False, use_graph=False)
         out = m.forward(x)
         assert float(np.abs(np.asarray(out.data)).max()) < 100.0
+
+
+class TestBf16CnnTraining:
+    """The bench's bf16 CNN path (input cast -> params follow the input
+    dtype): the bf16 ResNet trajectory must track the fp32 one — this is
+    the numerics contract behind the bf16_throughput leg, now including
+    the f32-accumulated BN moments."""
+
+    @staticmethod
+    def _losses(cast_bf16, steps=3):
+        import jax.numpy as jnp
+        from singa_tpu.models import resnet
+        d = device.create_cpu_device()
+        d.SetRandSeed(0)
+        m = resnet.create_model(depth=18, num_classes=10)
+        m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 32, 32).astype(np.float32)
+        y = np.eye(10)[rng.randint(0, 10, 2)].astype(np.float32)
+        tx = Tensor(data=x, device=d, requires_grad=False)
+        if cast_bf16:
+            tx = tx.as_type(jnp.bfloat16)
+        ty = Tensor(data=y, device=d, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+        return [float(np.asarray(m(tx, ty)[1].data))
+                for _ in range(steps)]
+
+    def test_bf16_tracks_f32(self):
+        l32 = self._losses(False)
+        l16 = self._losses(True)
+        assert l16[-1] < l16[0], l16          # actually trains
+        np.testing.assert_allclose(l16, l32, rtol=5e-2)
