@@ -109,6 +109,20 @@ EXTRA_SUCCESS_MARKERS = {
 }
 
 
+def _git_rev():
+    """Short commit hash stamped into measurement records, so a banked
+    number is attributable to the code that produced it (None outside a
+    work tree)."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def _conv_layout():
     """Activation layout for the ResNet legs: measured, not guessed.
 
@@ -345,6 +359,7 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         # distinguishes honest slope-readback records from the earlier
         # block_until_ready ones the axon tunnel inflated
         "timing": "slope-readback",
+        "git": _git_rev(),
     }
     _emit_partial(res, "fp32")
     # bf16 variant: params follow the input dtype, so the whole train step
@@ -1005,7 +1020,7 @@ def _emit_report(res, live, smoke, obs, errors):
     # tokens/s, timing method, partial/suspect flags), not just the
     # headline images/sec
     for k in ("mfu", "mfu_denominator", "conv_layout", "conv_layout_src",
-              "resnet_stem", "resnet_stem_src",
+              "resnet_stem", "resnet_stem_src", "git",
               "bf16_throughput", "bf16_step_ms", "bf16_mfu",
               "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",
               "lm_mfu", "lm_bf16_mfu", "lm_error", "lm_bf16_error",
