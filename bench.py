@@ -123,48 +123,43 @@ def _git_rev():
         return None
 
 
-def _conv_layout():
-    """Activation layout for the ResNet legs: measured, not guessed.
-
-    BENCH_CONV_LAYOUT=nchw|nhwc pins it; the default "auto" uses the
-    winner of the banked ``resnet_layout_ab`` hardware A/B from THIS
-    round (tools/tpu_probe_extra.py runs it before the full bench in a
-    TPU window), falling back to NCHW when no A/B has been banked.
-    Returns (layout, source)."""
-    mode = os.environ.get("BENCH_CONV_LAYOUT", "auto").lower()
-    if mode in ("nchw", "nhwc"):
-        return mode.upper(), "env"
+def _measured_choice(env_var, choices, ab_marker, default,
+                     canon=str):
+    """One mechanism for "measured, not guessed" config: an env pin
+    (validated — a typo'd pin warns instead of silently demoting), else
+    the newest banked A/B winner from THIS round, else the default,
+    each labeled with its source. Returns (value, source)."""
+    mode = os.environ.get(env_var, "auto").lower()
+    if mode in choices:
+        return canon(mode), "env"
     if mode != "auto":
-        # a typo'd pin must not silently demote to auto (same contract
-        # as the SINGA_FLASH_BLOCK_* knob validation)
-        print(f"bench: BENCH_CONV_LAYOUT={mode!r} is not "
-              f"nchw|nhwc|auto; using auto", file=sys.stderr)
+        print(f"bench: {env_var}={mode!r} is not "
+              f"{'|'.join(choices)}|auto; using auto", file=sys.stderr)
+    wanted = {canon(c) for c in choices}
     for o in reversed(_load_obs()):
-        if (o.get("event") == "extra"
-                and o.get("extra") == "resnet_layout_ab"
-                and o.get("winner") in ("NCHW", "NHWC")):
+        if (o.get("event") == "extra" and o.get("extra") == ab_marker
+                and o.get("winner") in wanted):
             return o["winner"], "measured-ab"
-    return "NCHW", "default-unmeasured"
+    return default, "default-unmeasured"
+
+
+def _conv_layout():
+    """Activation layout for the ResNet legs: BENCH_CONV_LAYOUT pin, or
+    the banked ``resnet_layout_ab`` hardware A/B winner (the probe runs
+    before the full bench in a TPU window), else NCHW."""
+    return _measured_choice("BENCH_CONV_LAYOUT", ("nchw", "nhwc"),
+                            "resnet_layout_ab", "NCHW",
+                            canon=str.upper)
 
 
 def _resnet_stem():
-    """Stem for the ResNet legs, mirroring _conv_layout: BENCH_RESNET_STEM
-    pins it; otherwise the banked resnet_stem_ab winner from THIS round
-    (the variant is exact — tests pin parity — so using the measured
-    faster form is a labeled optimization, not a model change); default
-    conv7 when unmeasured."""
-    env = os.environ.get("BENCH_RESNET_STEM", "auto").lower()
-    if env in ("conv7", "space_to_depth"):
-        return env, "env"
-    if env != "auto":
-        print(f"bench: BENCH_RESNET_STEM={env!r} is not "
-              f"conv7|space_to_depth|auto; using auto", file=sys.stderr)
-    for o in reversed(_load_obs()):
-        if (o.get("event") == "extra"
-                and o.get("extra") == "resnet_stem_ab"
-                and o.get("winner") in ("conv7", "space_to_depth")):
-            return o["winner"], "measured-ab"
-    return "conv7", "default-unmeasured"
+    """Stem for the ResNet legs, same mechanism: BENCH_RESNET_STEM pin,
+    or the banked ``resnet_stem_ab`` winner (the variant is exact —
+    tests pin parity — so the measured faster form is a labeled
+    optimization, not a model change), else conv7."""
+    return _measured_choice("BENCH_RESNET_STEM",
+                            ("conv7", "space_to_depth"),
+                            "resnet_stem_ab", "conv7")
 
 
 def _enable_compile_cache():

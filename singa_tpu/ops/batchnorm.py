@@ -28,9 +28,9 @@ class BatchNormHandle:
     """
 
     def __init__(self, momentum, x, eps: float = 1e-5, layout=None):
-        from .layout import current_layout
+        from .layout import resolve as _resolve_layout
         self.factor = float(momentum)
-        self.layout = (layout or current_layout()).upper()
+        self.layout = _resolve_layout(layout)
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.is_2d = len(xs) == 2
         self.channels = int(xs[-1]) \

@@ -18,6 +18,8 @@ in the 128-lane minor position; see ops/layout.py.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -42,7 +44,7 @@ class ConvHandle:
     def __init__(self, x, kernel_size, stride, padding, in_channels,
                  out_channels, bias=True, group=1, pad_mode=None,
                  dilation=1, layout=None, space_to_depth=False):
-        from .layout import current_layout
+        from .layout import resolve as _resolve_layout
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
         self.dilation = _pair(dilation)
@@ -57,7 +59,7 @@ class ConvHandle:
         self.bias = bool(bias)
         self.group = int(group)
         self.pad_mode = pad_mode  # "SAME"/"VALID" override, else explicit
-        self.layout = (layout or current_layout()).upper()
+        self.layout = _resolve_layout(layout)
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.batchsize = int(xs[0]) if len(xs) > 0 else 0
         if len(xs) == 4:
@@ -100,6 +102,14 @@ class ConvHandle:
         return (n, self.out_channels, oh, ow)
 
 
+def _add_bias(y, b, layout):
+    """Per-channel bias broadcast for either activation layout."""
+    if b is None:
+        return y
+    return y + (b.reshape(1, 1, 1, -1) if layout == "NHWC"
+                else b.reshape(1, -1, 1, 1))
+
+
 def _s2d_geometry(K, P):
     """Tap decomposition of a stride-2 conv axis: kernel position p maps
     to block offset t and parity a via p - P = 2t + a. Returns
@@ -115,8 +125,8 @@ def _space_to_depth_conv(x, W, handle):
     EXACTLY a (K+1)/2-rounded conv at stride 1 on the space-to-depth'd
     input with 4x the channels. Weights stay stored as (O, C, K, K) —
     checkpoints unchanged — and are re-indexed into the transformed
-    kernel inside the trace (a compile-time constant gather)."""
-    import numpy as np
+    kernel inside the trace — one gather + one scatter over constant
+    numpy index tables per step (tiny: O*C*4*Kp*Kp elements)."""
     h = handle
     K, _ = h.kernel_size
     (P, _), _ = h.padding
@@ -164,10 +174,7 @@ class _Conv2d(Operator):
     def forward(self, x, W, b=None):
         h = self.handle
         if getattr(h, "space_to_depth", False):
-            y = _space_to_depth_conv(x, W, h)
-            if b is not None:
-                y = y + (b.reshape(1, 1, 1, -1) if h.layout == "NHWC"
-                         else b.reshape(1, -1, 1, 1))
+            y = _add_bias(_space_to_depth_conv(x, W, h), b, h.layout)
             return y.astype(x.dtype)
         padding = h.pad_mode if h.pad_mode else h.padding
         if self.odd_padding is not None:
@@ -182,10 +189,7 @@ class _Conv2d(Operator):
             dimension_numbers=h.dimension_numbers,
             feature_group_count=h.group,
         )
-        if b is not None:
-            y = y + (b.reshape(1, 1, 1, -1) if h.layout == "NHWC"
-                     else b.reshape(1, -1, 1, 1))
-        return y.astype(x.dtype)
+        return _add_bias(y, b, h.layout).astype(x.dtype)
 
 
 def conv2d(handle: ConvHandle, x, W, b=None, odd_padding=None):
@@ -206,7 +210,7 @@ class ConvTransposeHandle:
     def __init__(self, x, kernel_size, stride, padding, in_channels,
                  out_channels, bias=True, group=1, dilation=1,
                  output_padding=0, layout=None):
-        from .layout import current_layout
+        from .layout import resolve as _resolve_layout
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
         self.dilation = _pair(dilation)
@@ -221,7 +225,7 @@ class ConvTransposeHandle:
         self.out_channels = int(out_channels)
         self.bias = bool(bias)
         self.group = int(group)
-        self.layout = (layout or current_layout()).upper()
+        self.layout = _resolve_layout(layout)
         self.dimension_numbers = (self.layout, "OIHW", self.layout)
 
     def output_shape(self, x_shape):
@@ -277,10 +281,7 @@ class _ConvTranspose2d(Operator):
             dimension_numbers=h.dimension_numbers,
             feature_group_count=h.group,
         )
-        if b is not None:
-            y = y + (b.reshape(1, 1, 1, -1) if h.layout == "NHWC"
-                     else b.reshape(1, -1, 1, 1))
-        return y.astype(x.dtype)
+        return _add_bias(y, b, h.layout).astype(x.dtype)
 
 
 def conv_transpose2d(handle: ConvTransposeHandle, x, W, b=None):

@@ -45,6 +45,16 @@ def channel_axis(ndim: int = 4) -> int:
     return 1 if current_layout() == "NCHW" or ndim == 2 else ndim - 1
 
 
+def resolve(layout) -> str:
+    """Normalise a handle's layout argument: explicit value (validated)
+    or the ambient default. The one place every handle resolves through,
+    so a typo'd layout= fails loudly instead of silently meaning NCHW."""
+    v = (str(layout).upper() if layout else current_layout())
+    if v not in _VALID:
+        raise ValueError(f"layout must be one of {_VALID}, got {layout!r}")
+    return v
+
+
 @contextlib.contextmanager
 def use_layout(layout: str):
     """Scope a layout for handle construction and deferred layer init —

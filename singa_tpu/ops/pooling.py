@@ -31,7 +31,7 @@ class PoolingHandle:
 
     def __init__(self, x, kernel_size, stride=None, padding=0, is_max=True,
                  layout=None, count_include_pad=True):
-        from .layout import current_layout
+        from .layout import resolve as _resolve_layout
         # True matches the reference's cuDNN include-padding average mode
         # (CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING); the ONNX
         # AveragePool DEFAULT is exclude (count_include_pad=0), which the
@@ -48,7 +48,7 @@ class PoolingHandle:
             self.pad_pairs = ((ph, ph), (pw, pw))
             self.padding = (ph, pw)
         self.is_max_pooling = bool(is_max)
-        self.layout = (layout or current_layout()).upper()
+        self.layout = _resolve_layout(layout)
         xs = x.shape if hasattr(x, "shape") else tuple(x)
         self.batchsize = int(xs[0])
         if self.layout == "NHWC" and len(xs) == 4:

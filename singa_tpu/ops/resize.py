@@ -137,7 +137,7 @@ class _Resize(Operator):
         return x.astype(dtype)
 
 
-def resize(x, out_shape, mode="nearest", coord_mode="half_pixel",
+def resize(x, out_shape=None, mode="nearest", coord_mode="half_pixel",
            nearest_mode="round_prefer_floor", cubic_a=-0.75, scales=None,
            handle=None):
     """Functional wrapper: resample ``x`` to ``out_shape`` with ONNX
@@ -145,8 +145,11 @@ def resize(x, out_shape, mode="nearest", coord_mode="half_pixel",
     used in the coordinate transform when the caller got out_shape from
     a scales input (ONNX computes out = floor(in * scale) but maps
     coordinates with the ORIGINAL scale, not the ratio). Pass a
-    prebuilt ``handle`` to reuse its tables across calls."""
+    prebuilt ``handle`` to reuse its tables across calls instead of
+    the shape/mode arguments."""
     if handle is None:
+        if out_shape is None:
+            raise ValueError("resize needs out_shape or a handle")
         handle = ResizeHandle(x.shape, out_shape, mode, coord_mode,
                               nearest_mode, cubic_a, scales)
     return _Resize(handle)(x)

@@ -1086,7 +1086,7 @@ class SingaBackend:
                     cubic_a=a.get("cubic_coeff_a", -0.75),
                     scales=scales)
                 node.cache["resize"] = handle
-            return _resize(ins[0], handle.out_shape, handle=handle)
+            return _resize(ins[0], handle=handle)
         if ty == "ConstantOfShape":
             v = a.get("value")
             val = float(numpy_helper.to_array(v).ravel()[0]) \
