@@ -45,6 +45,15 @@ class TestExamples:
                            "--dist", "--dist-option", "half"])
         assert "loss" in out.lower(), out[-500:]
 
+    def test_train_resnet_perf_modes(self):
+        """The round-5 perf modes through the user CLI: channels-last
+        trunk + space-to-depth stem on the resnet family."""
+        out = run_example(["examples/train_cnn.py", "resnet", "--cpu",
+                           "--epochs", "1", "--iters", "2", "--bs", "2",
+                           "--layout", "NHWC",
+                           "--stem", "space_to_depth"], timeout=900)
+        assert "loss" in out.lower(), out[-500:]
+
     def test_train_charrnn(self):
         out = run_example(["examples/train_charrnn.py", "--cpu",
                            "--epochs", "1", "--seq", "8", "--hidden", "16",
