@@ -253,19 +253,24 @@ def _pick_blocks(Sq, Sk):
                                     bq, Sq),
                                    ("K", _env_block("SINGA_FLASH_BLOCK_K"),
                                     bk, Sk)):
-        if env is not None and S % min(env, S):
-            # a non-dividing override would silently cost the fused
-            # kernel (_use_pallas declines): warn once per shape and
-            # keep the adaptive pick instead
-            key = (name, env, S)
-            if key not in _ENV_BLOCK_WARNED:
-                _ENV_BLOCK_WARNED.add(key)
-                import warnings
-                warnings.warn(
-                    f"SINGA_FLASH_BLOCK_{name}={env} does not divide "
-                    f"sequence length {S}; using the adaptive "
-                    f"{adaptive} instead", stacklevel=3)
-            env = None
+        if env is not None:
+            # clamp to the sequence length FIRST: an oversized override
+            # would otherwise reach the kernel unclamped and launch a
+            # zero-size grid (output never written)
+            env = min(env, S)
+            if S % env:
+                # a non-dividing override would silently cost the fused
+                # kernel (_use_pallas declines): warn once per shape and
+                # keep the adaptive pick instead
+                key = (name, env, S)
+                if key not in _ENV_BLOCK_WARNED:
+                    _ENV_BLOCK_WARNED.add(key)
+                    import warnings
+                    warnings.warn(
+                        f"SINGA_FLASH_BLOCK_{name}={env} does not divide "
+                        f"sequence length {S}; using the adaptive "
+                        f"{adaptive} instead", stacklevel=3)
+                env = None
         out.append(env if env is not None else adaptive)
     return tuple(out)
 

@@ -97,10 +97,14 @@ class ResizeHandle:
         self.out_shape = tuple(int(s) for s in out_shape)
         self.tables = []   # (axis, idx, weights-or-None)
         for ax, (si, so) in enumerate(zip(in_shape, self.out_shape)):
-            if si == so:
-                continue
             scale = (scales[ax] if scales is not None
                      else so / float(si))
+            # an axis is a passthrough only when the SCALE is 1 — with
+            # an explicit non-unit scale whose floor(in*s) == in, the
+            # spec still maps coordinates through s (e.g. s=1.4 on 2
+            # elements resamples, it does not copy)
+            if si == so and abs(float(scale) - 1.0) < 1e-9:
+                continue
             x = _src_coords(so, si, scale, coord_mode)
             if mode == "nearest":
                 idx, w = _nearest_table(x, si, nearest_mode)

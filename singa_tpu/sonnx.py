@@ -970,10 +970,14 @@ class SingaBackend:
         if ty in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin",
                   "ReduceProd", "ReduceL1", "ReduceL2", "ReduceLogSum",
                   "ReduceLogSumExp"):
-            # opset-13 ReduceSum moved axes to a second input
+            # opset-13 ReduceSum moved axes to a second input. An EMPTY
+            # axes tensor means reduce over ALL axes (the spec default)
+            # unless noop_with_empty_axes=1 asks for identity.
             axes = a.get("axes")
             if axes is None and len(ins) > 1 and ins[1] is not None:
-                axes = _ints(ins[1])
+                axes = _ints(ins[1]) or None
+                if axes is None and a.get("noop_with_empty_axes", 0):
+                    return autograd.identity(ins[0])
             keep = a.get("keepdims", 1)
             rsum = autograd.reduce_sum
             if ty == "ReduceSum":

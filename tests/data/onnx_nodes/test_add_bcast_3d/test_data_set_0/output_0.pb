@@ -1,2 +1,2 @@
 
-Boutput_0J`{u?4j@bjv?}w?u@^Ȩ?IP?7?%l?z߿0^?̜̽ž޿`?a8?.3{
+Boutput_0J`lLf[?ʾw/ЋG2Jd?	>[H>嬾C<XP<Dۨwu߁>>=F?

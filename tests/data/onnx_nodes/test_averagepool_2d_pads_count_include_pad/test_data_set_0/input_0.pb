@@ -1,3 +1,2 @@
 
-Binput_0J!Ѿ
-b?kgQؾـ@?[E C?OAɾi>dd`?Voe>k?{^꾙C>=24?_-?PXf>gNs@+@
+Binput_0Jـ@?[E C?OAɾi>dd`?Voe>k?{^꾙C>=24?_-?PXf>gNs@+@.>ߒ?9Y

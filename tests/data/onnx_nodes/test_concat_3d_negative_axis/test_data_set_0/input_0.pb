@@ -1,2 +1,2 @@
 
-Binput_0J0a½„¿³sŽ¿ÈÉ¾,¬¾]g¹¿eQÉ¿ù|S?$’l½ÄK>›V¿[_ª¾qçP¾
+Binput_0J0ÈÉ¾,¬¾]g¹¿eQÉ¿ù|S?$’l½ÄK>›V¿[_ª¾qçP¾,Oå>âxA¿

@@ -1,2 +1,2 @@
 
-Binput_1J¢B?Ï?5$Ç?
+Binput_1JÑ? W¹?’Þ>

@@ -1,2 +1,2 @@
 
-Boutput_0J`–]ð?uTu@ß@”¾ôŸ@ë‘ú?¾¾@Œš¾y6	@’ü8?£Î¼?u1ä½*™J?Vˆ)ÀŸ€Ü?Í1Ù=²V½¾Øº0ÀÝå?Ojâ=u`Å¾ y‚¿'³)?p''=M·¾
+Boutput_0J`gÉ¿sú‚?p="ï`¾ê/Œ¿˜U6?[™3=¾¤óiÀ%@·Ü>R¤¿ª&¾'Î¾qßœ¾[ÚI>˜ç½xÝ½ÉaZ¾Ó>©×À¾êw¸¾B96¿ÿxê>

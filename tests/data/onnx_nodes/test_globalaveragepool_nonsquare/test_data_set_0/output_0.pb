@@ -1,2 +1,2 @@
 
-Boutput_0JHò½Ü£)¾ó2m>i1 ¾Ô,`¼ÜC€<
+Boutput_0J®½mª¾Kl¯>’s¾BP=!Æ>

@@ -1,3 +1,2 @@
 
-Boutput_0J`÷<¾†ı¿¾T
-è<’N¾àï3¾?§·¾Ööİ<fE¾W¿±¾"k5¿`C[=«Â¾>¬„>“,¾Şø)¼4,=ÀÒ}>I%¾\—"¼½=¼ú>£¾Ûœ ¼}Œ=
+Boutput_0J`„„>™8¾¶M5¼=ê?(“˜¿5I–½æƒ>˜P)¿8Ü>cêØ<p½½Mé<—+ß<_t\=/Õ½EcA>uı8>.½¶>¾"k¾6‹½¿€…½Ûà¾±©=

@@ -1,2 +1,2 @@
 
-Binput_0J æ?Uß@¸‚1¾·™?8ýÊ¿D„?L‚=1´b¾
+Binput_0J 8ýÊ¿D„?L‚=1´b¾R'¾ ¾jž¾ípK>

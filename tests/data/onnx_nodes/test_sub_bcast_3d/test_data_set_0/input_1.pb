@@ -1,2 +1,2 @@
 
-Binput_1JR'¾ ¾jž¾
+Binput_1J£y2¾ñ“¿ˆÕ>

@@ -1,2 +1,2 @@
 
-Binput_2Jg(<ã•æ?J}r?Ñúï¼
+Binput_2Jj­•¾˜.F¾Š¯–?s…?

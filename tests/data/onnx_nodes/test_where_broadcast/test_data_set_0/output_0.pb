@@ -1,2 +1,2 @@
 
-Boutput_0J0+{џ?г•ж?©Oя>СъпјЃмґѕг•ж?ЁX?СъпјcВпїг•ж?…ґ§?Съпј
+Boutput_0J0Ѓмґѕ.FѕЁX?s…?cВпї.Fѕ…ґ§?s…?g(<.FѕJ}r?s…?

@@ -500,6 +500,16 @@ class TestPickBlocks:
         monkeypatch.setenv("SINGA_FLASH_BLOCK_Q", "-64")
         assert _pick_blocks(1024, 1024) == (512, 256)
 
+    def test_oversized_env_value_clamps_to_sequence(self, monkeypatch):
+        """env block > S must clamp, not reach the kernel raw (an
+        unclamped oversize launches a zero-size Pallas grid whose
+        output is never written)."""
+        from singa_tpu.ops.attention import _pick_blocks
+        monkeypatch.setenv("SINGA_FLASH_BLOCK_Q", "2048")
+        assert _pick_blocks(1024, 1024) == (1024, 256)
+        monkeypatch.setenv("SINGA_FLASH_BLOCK_K", "4096")
+        assert _pick_blocks(1024, 1024) == (1024, 1024)
+
     def test_nondividing_env_value_falls_back_to_adaptive(
             self, monkeypatch):
         import warnings

@@ -752,6 +752,19 @@ def build_cases():
         [("x", rd), ("axes", np.array([0, 2], np.int64))],
         [("y", rd.sum(axis=(0, 2), keepdims=True).astype(np.float32))],
         {"keepdims": 1}, opset=13))
+    # empty axes input = reduce over ALL axes (spec default)...
+    cases.append(case(
+        "test_reduce_sum_empty_axes_input_opset13", "ReduceSum",
+        [("x", rd), ("axes", np.zeros(0, np.int64))],
+        [("y", rd.sum(keepdims=True).reshape(1, 1, 1)
+          .astype(np.float32))],
+        {"keepdims": 1}, opset=13))
+    # ...unless noop_with_empty_axes=1 asks for identity
+    cases.append(case(
+        "test_reduce_sum_empty_axes_noop_opset13", "ReduceSum",
+        [("x", rd), ("axes", np.zeros(0, np.int64))],
+        [("y", rd.copy())],
+        {"keepdims": 1, "noop_with_empty_axes": 1}, opset=13))
 
     # -- opset-13 attribute-as-input forms -------------------------------
     sq13 = r(1, 3, 1, 4)
@@ -904,6 +917,17 @@ def build_cases():
          ("scales", np.array([1, 1, 1.5, 1.5], np.float32))],
         [("y", resize_ref(rz, (6, 6), "nearest", "asymmetric", "floor",
                           scales=[1, 1, 1.5, 1.5]))],
+        {"coordinate_transformation_mode": "asymmetric",
+         "nearest_mode": "floor"}))
+    # scale 1.4 on 2 elements: floor(2*1.4)=2 == in, but the spec still
+    # maps coordinates through the scale — NOT a passthrough
+    rz2 = r(1, 1, 2, 2)
+    cases.append(case(
+        "test_resize_nearest_scale_floors_to_same_size", "Resize",
+        [("x", rz2), ("roi", roi),
+         ("scales", np.array([1, 1, 1.4, 1.4], np.float32))],
+        [("y", resize_ref(rz2, (2, 2), "nearest", "asymmetric", "floor",
+                          scales=[1, 1, 1.4, 1.4]))],
         {"coordinate_transformation_mode": "asymmetric",
          "nearest_mode": "floor"}))
 
