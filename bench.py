@@ -109,18 +109,26 @@ EXTRA_SUCCESS_MARKERS = {
 }
 
 
+_GIT_REV_CACHE = []
+
+
 def _git_rev():
     """Short commit hash stamped into measurement records, so a banked
     number is attributable to the code that produced it (None outside a
-    work tree)."""
+    work tree). Cached: constant for the process lifetime, and
+    _record_obs calls this while holding the obs write lock."""
+    if _GIT_REV_CACHE:
+        return _GIT_REV_CACHE[0]
     try:
         out = subprocess.run(
             ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
              "rev-parse", "--short", "HEAD"],
             capture_output=True, text=True, timeout=10)
-        return out.stdout.strip() or None
+        rev = out.stdout.strip() or None
     except (OSError, subprocess.SubprocessError):
-        return None
+        rev = None
+    _GIT_REV_CACHE.append(rev)
+    return rev
 
 
 def _measured_choice(env_var, choices, ab_marker, default,
@@ -128,7 +136,14 @@ def _measured_choice(env_var, choices, ab_marker, default,
     """One mechanism for "measured, not guessed" config: an env pin
     (validated — a typo'd pin warns instead of silently demoting), else
     the newest banked A/B winner from THIS round, else the default,
-    each labeled with its source. Returns (value, source)."""
+    each labeled with its source. Returns (value, source).
+
+    A banked winner is trusted only while it plausibly describes the
+    CURRENT code: within the same max-age window ``_fold_banked`` uses
+    for the bench legs (BENCH_BANKED_MAX_AGE_H), or carrying a git
+    stamp matching the current revision. Without the gate, a stale
+    ``resnet_layout_ab``/``resnet_stem_ab`` record measured on older
+    layout/stem code would keep steering bench config indefinitely."""
     mode = os.environ.get(env_var, "auto").lower()
     if mode in choices:
         return canon(mode), "env"
@@ -136,9 +151,18 @@ def _measured_choice(env_var, choices, ab_marker, default,
         print(f"bench: {env_var}={mode!r} is not "
               f"{'|'.join(choices)}|auto; using auto", file=sys.stderr)
     wanted = {canon(c) for c in choices}
+    max_age = float(os.environ.get("BENCH_BANKED_MAX_AGE_H", "14")) * 3600
+    rev = _git_rev()
     for o in reversed(_load_obs()):
         if (o.get("event") == "extra" and o.get("extra") == ab_marker
                 and o.get("winner") in wanted):
+            if _obs_age_s(o) >= max_age and \
+                    not (rev and o.get("git") == rev):
+                print(f"bench: ignoring stale {ab_marker} winner "
+                      f"{o['winner']!r} (older than the banked max-age "
+                      f"window and not stamped with the current rev)",
+                      file=sys.stderr)
+                continue
             return o["winner"], "measured-ab"
     return default, "default-unmeasured"
 
@@ -528,6 +552,10 @@ def _record_obs(event, data):
     # evidence on the floor
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "event": event}
     rec.update(data)
+    # every banked record carries the commit that produced it, so the
+    # staleness gate in _measured_choice can keep trusting an old A/B
+    # winner measured on exactly this code
+    rec.setdefault("git", _git_rev())
     try:
         import fcntl
         with open(OBS_PATH + ".wlock", "a") as lk:

@@ -7,6 +7,13 @@ manager: every run resumes from the newest checkpoint, so a crashed or
 preempted job continues exactly where it stopped (optimizer momentum
 included — the trajectory is identical to an uninterrupted run).
 
+This is the MINIMAL form — the raw CheckpointManager loop. The full
+production driver (preemption signal handling with a supervisor
+exit-code contract, NaN/divergence guards, transient-failure retry,
+corrupt-checkpoint fallback) lives in ``singa_tpu/resilience``; see
+``examples/train_cnn.py --resilient`` and the README's Fault tolerance
+section.
+
 Try it:
     python examples/train_elastic.py --cpu --steps 40 --crash-at 17
     python examples/train_elastic.py --cpu --steps 40

@@ -32,7 +32,7 @@ from .model import Model    # noqa: F401
 
 _LAZY = ("sonnx", "io", "data", "datasets", "image_tool", "net",
          "snapshot", "native", "channel", "caffe", "network",
-         "checkpoint", "profiling")
+         "checkpoint", "profiling", "resilience")
 
 
 def __getattr__(name):

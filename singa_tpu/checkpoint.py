@@ -134,7 +134,11 @@ class AsyncModelCheckpointer:
         module docstring for the template/topology rules)."""
         path = os.path.abspath(str(path))
         live = _state_tensor_dict(model)
-        meta = dict(self._ckptr.metadata(path).item_metadata.tree)
+        # orbax API drift: metadata() returns a plain dict tree on
+        # newer versions, a CheckpointMetadata wrapper on older ones
+        raw = self._ckptr.metadata(path)
+        tree = getattr(getattr(raw, "item_metadata", None), "tree", None)
+        meta = dict(tree if tree is not None else raw)
         restored = self._ckptr.restore(
             path, args=self._ocp.args.StandardRestore(
                 _build_restore_template(live, meta)))
@@ -162,15 +166,53 @@ class CheckpointManager:
     def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
         import orbax.checkpoint as ocp
         self._ocp = ocp
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(str(directory)),
+        self._dir = os.path.abspath(str(directory))
+        self._max_to_keep = max_to_keep
+        self._save_interval_steps = save_interval_steps
+        self._mgr = self._make_mgr()
+        self._sweep_uncommitted()
+
+    def _make_mgr(self):
+        ocp = self._ocp
+        return ocp.CheckpointManager(
+            self._dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps,
+                max_to_keep=self._max_to_keep,
+                save_interval_steps=self._save_interval_steps,
                 enable_async_checkpointing=True),
             # a FRESH manager (resume path) must know the handler type
             # before any save, or item metadata cannot be read
             item_handlers=ocp.StandardCheckpointHandler())
+
+    def _sweep_uncommitted(self):
+        """Remove step directories a dead writer left without a commit
+        marker. A process killed mid-async-save (the normal way a
+        preempted job dies) leaves the step's directory on disk but
+        absent from ``all_steps()``; the restarted job resumes from an
+        earlier step, re-trains, and its ``save`` of that step number
+        would then refuse — 'destination already exists' — stranding
+        the run. Single-writer-per-directory is assumed (as it is for
+        rotation)."""
+        import shutil
+        committed = {str(s) for s in self._mgr.all_steps()}
+        try:
+            entries = os.listdir(self._dir)
+        except OSError:
+            return
+        for name in entries:
+            # only orbax's own artifacts: an exact step-number dir with
+            # no commit marker, or an orbax tmp dir. Anything else in
+            # here (a user's "3.backup", notes, …) is not ours to delete
+            wreck = (name.isdigit() and name not in committed) or \
+                ".orbax-checkpoint-tmp" in name
+            if wreck:
+                path = os.path.join(self._dir, name)
+                if os.path.isdir(path):
+                    warnings.warn(
+                        f"removing uncommitted checkpoint wreckage "
+                        f"{path} (a previous writer died mid-save)",
+                        stacklevel=3)
+                    shutil.rmtree(path, ignore_errors=True)
 
     def save(self, step, model, force=False):
         arrays = {k: t.data for k, t in _state_tensor_dict(model).items()}
@@ -181,12 +223,10 @@ class CheckpointManager:
     def latest_step(self):
         return self._mgr.latest_step()
 
-    def restore_latest(self, model):
-        """Restore the newest checkpoint into ``model`` and return the
-        NEXT step to run (0 when no checkpoint exists)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return 0
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def _restore_step(self, step, model):
         live = _state_tensor_dict(model)
         meta = self._mgr.item_metadata(step)
         tree = dict(getattr(meta, "tree", None) or meta)
@@ -194,7 +234,66 @@ class CheckpointManager:
             step, args=self._ocp.args.StandardRestore(
                 _build_restore_template(live, tree)))
         _apply_restored(model, live, restored)
-        return step + 1
+
+    def restore_latest(self, model):
+        """Restore the newest RESTORABLE checkpoint into ``model`` and
+        return the NEXT step to run (0 when no checkpoint exists).
+
+        A preempted or crashed writer can leave the newest step
+        truncated or corrupt on disk even when its commit marker made
+        it down; raising there would strand a job that has perfectly
+        good earlier checkpoints. So restorability is verified by
+        attempting the restore, scanning BACKWARD: a step that fails to
+        load is warned about — loudly — and the scan falls back to the
+        previous one. (A failed attempt may have partially landed
+        arrays in the live tensors; the succeeding attempt overwrites
+        every entry, so the model never trains on a half-restored mix.)
+        """
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        for i, step in enumerate(steps):
+            try:
+                self._restore_step(step, model)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                warnings.warn(
+                    f"checkpoint step {step} is not restorable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"previous step", stacklevel=2)
+                continue
+            if i:
+                warnings.warn(
+                    f"resumed from step {step} after skipping {i} "
+                    f"corrupt/incomplete newer checkpoint(s) — up to "
+                    f"{steps[0] - step} step(s) of work were lost",
+                    stacklevel=2)
+                # delete the skipped wreckage and rebuild the manager:
+                # while a corrupt step remains the directory's newest,
+                # orbax's should_save refuses every interval save of the
+                # re-run window (step <= latest), so a second crash
+                # there would lose the same stretch of work again
+                import shutil
+                for bad_step in steps[:i]:
+                    shutil.rmtree(os.path.join(self._dir, str(bad_step)),
+                                  ignore_errors=True)
+                self._mgr.close()
+                self._mgr = self._make_mgr()
+            return step + 1
+        if steps:
+            warnings.warn(
+                f"none of the {len(steps)} checkpoints under this "
+                "directory are restorable; starting from scratch",
+                stacklevel=2)
+            # same stranding as the partial-fallback case: while the
+            # corrupt steps remain committed, orbax refuses every save
+            # of the from-scratch re-run (step <= latest) — clear them
+            import shutil
+            for bad_step in steps:
+                shutil.rmtree(os.path.join(self._dir, str(bad_step)),
+                              ignore_errors=True)
+            self._mgr.close()
+            self._mgr = self._make_mgr()
+        return 0
 
     def wait(self):
         self._mgr.wait_until_finished()

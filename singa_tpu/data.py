@@ -115,6 +115,92 @@ class ImageBatchIter:
                         continue
 
 
+def backoff_delay(attempt, base, cap, jitter, rng):
+    """The one retry-delay formula every resilient component shares:
+    ``min(cap, base * 2**attempt)`` stretched by up to ``jitter`` drawn
+    from the caller's (seeded, hence deterministic) RNG."""
+    return min(cap, base * (2.0 ** attempt)) * (1.0 + jitter * rng.random())
+
+
+class RetryingIterator:
+    """Retry transient data-source failures with exponential backoff +
+    jitter — the input-pipeline arm of the resilient training runtime
+    (singa_tpu/resilience): a flaky network filesystem or a dying
+    worker costs a delayed batch, not the job.
+
+    ``source`` is an iterable OR a zero-arg factory returning a fresh
+    iterator; with a factory, a failure REBUILDS the source (the right
+    move when the underlying worker/socket is dead) and iteration
+    continues from the rebuilt stream. ``StopIteration`` passes through
+    untouched — exhaustion is not a failure — EXCEPT when it
+    immediately follows a retried error on a non-factory source: a
+    generator that raised is permanently closed, so its retry yields
+    StopIteration, and passing that through would silently truncate the
+    stream; the original error is re-raised instead.
+
+        for batch in RetryingIterator(lambda: ImageBatchIter(...)):
+            ...
+    """
+
+    def __init__(self, source, max_retries=3, backoff_base=0.1,
+                 backoff_cap=5.0, jitter=0.25, seed=0, sleep=None):
+        import random
+        import time
+        self._source = source
+        self._factory = source if callable(source) else None
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.jitter = float(jitter)
+        self.retries = 0            # total retried failures (observability)
+        self._rng = random.Random(seed)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._it = None
+
+    def _iterator(self):
+        if self._it is None:
+            src = self._factory() if self._factory is not None \
+                else self._source
+            self._it = iter(src)
+        return self._it
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        attempt = 0
+        failed = None
+        while True:
+            try:
+                item = next(self._iterator())
+            except StopIteration:
+                if failed is not None:
+                    # a failed generator is closed, not exhausted:
+                    # surface the failure, don't truncate the stream
+                    # (resilience.runtime._next_batch applies the same
+                    # rule around its epoch-wrap; keep them in sync)
+                    raise failed from None
+                raise
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if attempt >= self.max_retries:
+                    raise
+                self._sleep(backoff_delay(attempt, self.backoff_base,
+                                          self.backoff_cap, self.jitter,
+                                          self._rng))
+                self.retries += 1
+                attempt += 1
+                if self._factory is not None:
+                    self._it = None     # rebuild a (likely dead) source
+                else:
+                    failed = e
+            else:
+                return item
+
+    next = __next__
+
+
 class NumpyBatchIter:
     """Batches over in-memory arrays with epoch shuffle — the synthetic /
     pre-loaded data path used by examples (reference examples load cifar

@@ -204,6 +204,10 @@ class Model(Layer):
 
     def set_optimizer(self, optimizer):
         self.optimizer = optimizer
+        if hasattr(optimizer, "bind_model"):
+            # guards (resilience.GuardedOptimizer) shadow model state the
+            # optimizer never sees (BN running stats) — hand them the model
+            optimizer.bind_model(self)
 
     # -- modes -------------------------------------------------------------
     def train(self, mode=True):
@@ -253,6 +257,10 @@ class Model(Layer):
         from .opt import DistOpt
         if isinstance(opt, DistOpt):
             self._dist = opt
+        elif isinstance(getattr(opt, "inner", None), DistOpt):
+            # a wrapper (e.g. resilience.GuardedOptimizer) around a
+            # DistOpt: the mesh/collective plumbing keys off the DistOpt
+            self._dist = opt.inner
         self._compiled = True
         self.train(is_train)
 
@@ -357,6 +365,11 @@ class Model(Layer):
         (optimizer scalars are born on the host default device)."""
         if self._state_list is not None:
             return
+        opt = getattr(self, "optimizer", None)
+        if hasattr(opt, "materialize_shadows"):
+            # create the guard's shadow tensors from the CURRENT concrete
+            # values, so they join the threaded state collected below
+            opt.materialize_shadows()
         state_list = self._state_tensors()
         for t in state_list:
             if not isinstance(t.data, jax.core.Tracer):

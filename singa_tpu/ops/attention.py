@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd_base import Operator
+from ..parallel.communicator import axis_size as _axis_size
 
 _NEG_INF = -1e30
 
@@ -692,7 +693,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, S_local, D = q.shape
     q_off = idx * S_local
@@ -812,7 +813,7 @@ def attention(q, k, v, causal=False, scale=None, seq_axis=None,
                          "(expected 'ring' or 'ulysses')")
     if seq_axis is not None and active_axis(seq_axis):
         if seq_mode in ("ulysses", "alltoall", "all_to_all"):
-            n = lax.axis_size(seq_axis)
+            n = _axis_size(seq_axis)
             H = q.shape[1]
             if H % n == 0:
                 return _UlyssesAttention(seq_axis, causal, scale)(q, k, v)

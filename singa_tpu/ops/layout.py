@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+from contextvars import ContextVar
 
 _VALID = ("NCHW", "NHWC")
 
@@ -31,13 +32,19 @@ def _env_default() -> str:
     return v if v in _VALID else "NCHW"
 
 
-_stack = [_env_default()]
+# Per-context (thread/task) scope stack: a ContextVar instead of a
+# process-global list, so an NHWC scope entered while one model builds
+# (e.g. training) can never leak into handle construction on another
+# thread (e.g. a concurrent serving model) — each thread/asyncio task
+# sees only its own scopes, falling back to the env default.
+_stack: ContextVar[tuple] = ContextVar("singa_tpu_conv_layout",
+                                       default=(_env_default(),))
 
 
 def current_layout() -> str:
     """Layout new conv/pool/BN handles capture (handles read this once
     at construction; op forward paths use the captured value)."""
-    return _stack[-1]
+    return _stack.get()[-1]
 
 
 def channel_axis(ndim: int = 4) -> int:
@@ -63,8 +70,8 @@ def use_layout(layout: str):
     layout = str(layout).upper()
     if layout not in _VALID:
         raise ValueError(f"layout must be one of {_VALID}, got {layout!r}")
-    _stack.append(layout)
+    token = _stack.set(_stack.get() + (layout,))
     try:
         yield
     finally:
-        _stack.pop()
+        _stack.reset(token)

@@ -122,6 +122,15 @@ class Optimizer:
         self.step_counter = Tensor(shape=(), dtype=jnp.float32,
                                    requires_grad=False)
         self.step_counter.name = "step_counter"
+        # dynamic-loss-scale state lives WITH the optimizer (not the
+        # guard that drives it) so every checkpoint route — zip
+        # save_states, Snapshot, the async sharded manager — carries it
+        # and a resumed run continues with the backed-off scale instead
+        # of re-diverging at the stale one. 1.0 = scaling inactive.
+        self.loss_scale = Tensor(shape=(), dtype=jnp.float32,
+                                 requires_grad=False)
+        self.loss_scale.data = jnp.ones((), jnp.float32)
+        self.loss_scale.name = "loss_scale"
         self._aux = {}  # name -> Tensor, created lazily per param
         self.regularizer = None       # global default
         self.constraint = None        # global default
@@ -195,13 +204,15 @@ class Optimizer:
 
     def state_tensors(self):
         """All mutable optimizer state, for jit state-threading."""
-        return [self.step_counter] + list(self._aux.values())
+        return [self.step_counter, self.loss_scale] + \
+            list(self._aux.values())
 
     def state_tensor_dict(self):
         """name -> LIVE state Tensor — no gather, no host copy; the
         sharded-checkpointing counterpart of get_states (which pulls
         everything to host for the zip route)."""
-        d = {"step_counter": self.step_counter}
+        d = {"step_counter": self.step_counter,
+             "loss_scale": self.loss_scale}
         d.update(self._aux)
         return d
 
@@ -213,6 +224,9 @@ class Optimizer:
         if name == "step_counter":
             self.step_counter.data = jnp.asarray(array)
             return
+        if name == "loss_scale":
+            self.loss_scale.data = jnp.asarray(array)
+            return
         t = self._aux.get(name)
         if t is None:
             t = Tensor(data=array, requires_grad=False)
@@ -223,7 +237,8 @@ class Optimizer:
 
     def get_states(self):
         from .tensor import to_host_tree
-        states = {"step_counter": np.asarray(self.step_counter.data)}
+        states = {"step_counter": np.asarray(self.step_counter.data),
+                  "loss_scale": np.asarray(self.loss_scale.data)}
         # batched gather: host-sharded aux (e.g. expert momentum) pays
         # one cross-process collective for the whole dict
         states.update(to_host_tree({k: v.data
@@ -233,8 +248,11 @@ class Optimizer:
     def set_states(self, states):
         if "step_counter" in states:
             self.step_counter.data = jnp.asarray(states["step_counter"])
+        if "loss_scale" in states:
+            self.loss_scale.data = jnp.asarray(
+                states["loss_scale"], dtype=jnp.float32)
         for k, v in states.items():
-            if k == "step_counter":
+            if k in ("step_counter", "loss_scale"):
                 continue
             if k in self._aux:
                 # keep the live buffer's dtype: checkpoints store bf16
@@ -401,6 +419,10 @@ class DistOpt:
     @property
     def step_counter(self):
         return self.opt.step_counter
+
+    @property
+    def loss_scale(self):
+        return self.opt.loss_scale
 
     def state_tensors(self):
         return self.opt.state_tensors() + list(self._residuals.values())

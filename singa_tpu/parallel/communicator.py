@@ -42,6 +42,16 @@ def active_axis(axis_name: str) -> bool:
     return axis_name in _ACTIVE_AXES
 
 
+def axis_size(axis_name: str) -> int:
+    """Size of a bound mesh axis. ``jax.lax.axis_size`` only exists on
+    newer jax; on older versions ``psum(1, axis)`` is the idiom — and
+    it constant-folds to a python int at trace time, so callers can use
+    the result in static control flow either way."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 # Mesh axes the BATCH dimension is sharded over inside the current
 # shard_map'd step. Cross-replica statistics (sync-BN) must reduce over
 # exactly these — not a hardcoded ("data",), which silently computes
@@ -154,7 +164,7 @@ class Communicator:
         axes = self._active_reduce_axes(exclude)
         size = 1
         for a in axes:
-            size *= lax.axis_size(a)
+            size *= axis_size(a)
         return size
 
     # -- collectives (identity outside a mesh context) ---------------------
@@ -178,7 +188,7 @@ class Communicator:
 
     def broadcast(self, arr, root=0):
         if active_axis(self.axis_name):
-            n = lax.axis_size(self.axis_name)
+            n = axis_size(self.axis_name)
             mask = (lax.axis_index(self.axis_name) == root)
             return lax.psum(jnp.where(mask, arr, jnp.zeros_like(arr)),
                             self.axis_name)

@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 from ..autograd_base import Operator
 from ..layer import Layer, _param
 from ..tensor import Tensor
-from .communicator import active_axis
+from .communicator import active_axis, axis_size
 
 
 class _MoEFFN(Operator):
@@ -86,7 +86,7 @@ class _MoEFFN(Operator):
         # dispatch -> expert-major buffer, exchange over the expert axis
         ein = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
         if active_axis(self.axis_name):
-            ep = lax.axis_size(self.axis_name)
+            ep = axis_size(self.axis_name)
             if E % ep != 0:
                 raise ValueError(
                     f"n_experts={E} must divide by the '{self.axis_name}' "

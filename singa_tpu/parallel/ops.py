@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd_base import Operator
-from .communicator import active_axis
+from .communicator import active_axis, axis_size as _axis_size
 
 
 class AllReduce(Operator):
@@ -123,7 +123,7 @@ class PMean(Operator):
 
     def backward(self, dy):
         if active_axis(self.axis_name):
-            return dy / lax.axis_size(self.axis_name)
+            return dy / _axis_size(self.axis_name)
         return dy
 
 

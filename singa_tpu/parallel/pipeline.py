@@ -39,6 +39,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..autograd_base import Operator
+from .communicator import axis_size as _axis_size
 from ..layer import Layer
 from ..tensor import Tensor
 
@@ -61,7 +62,7 @@ def _pipe_descale_fwd(x, axis_name):
 
 
 def _pipe_descale_bwd(axis_name, _res, g):
-    return (g / lax.axis_size(axis_name),)
+    return (g / _axis_size(axis_name),)
 
 
 _pipe_descale.defvjp(_pipe_descale_fwd, _pipe_descale_bwd)
@@ -84,7 +85,7 @@ def _pipeline_fwd_core(dispatch, stage_params, x_microbatches, wire_shape,
     on stage 0, to the injected microbatch ``mb``). Returns the last
     stage's wire outputs (n_micro, *wire_shape), broadcast to all
     stages."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     sid = lax.axis_index(axis_name)
     n_micro = x_microbatches.shape[0]
     steps = n_micro + n - 1
@@ -193,7 +194,7 @@ def _pipeline_1f1b_core(dispatch, loss_fn, stage_params, x_microbatches,
     re-read from ``x_microbatches`` at backward time, so heterogeneous
     input shapes never touch the ring.
     """
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     sid = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     R = 2 * (S - 1) + 1                       # max in-flight per stage

@@ -1,0 +1,32 @@
+"""Resilient training runtime: survive what a real TPU job actually hits.
+
+The reference stack has no failure story — NCCL/MPI errors simply exit
+the process (include/singa/io/communicator.h:40-67). A pod-scale job
+loses preemptible capacity, sees transient data/device hiccups, and
+occasionally diverges numerically; losing a warm process is especially
+expensive on TPU where the XLA compile alone can take minutes. This
+package adds the three layers that keep work alive:
+
+- :mod:`runtime` — :class:`ResilientTrainer`: a checkpoint-restart
+  training driver with SIGTERM/SIGINT preemption handling (sync-save
+  then exit with :data:`EXIT_PREEMPTED` for the restart supervisor),
+  exponential-backoff retry of transient step/data failures, an
+  optional per-step watchdog timeout, and automatic rollback to the
+  last good checkpoint on sustained divergence.
+- :mod:`guards` — :class:`GuardedOptimizer`: per-step NaN/Inf detection
+  on loss and global grad-norm, computed on-device inside the compiled
+  step (one scalar readback per step on the host side), with in-graph
+  skip-step masking and dynamic loss-scale backoff. A bad step can
+  never land in the parameters.
+- :mod:`faults` — :class:`FaultPlan`: deterministic fault injection
+  (poisoned batches, raising steps/iterators, hangs, SIGTERM delivery,
+  crash-mid-async-save) plus on-disk checkpoint corruption helpers,
+  driving the chaos tests in ``tests/test_resilience.py``.
+"""
+
+from .runtime import (EXIT_PREEMPTED, ResilientTrainer,  # noqa: F401
+                      StepTimeoutError)
+from .guards import GuardedOptimizer                      # noqa: F401
+from .faults import (FaultInjected, FaultPlan,            # noqa: F401
+                     SimulatedCrash, corrupt_checkpoint,
+                     truncate_checkpoint)

@@ -1,0 +1,201 @@
+"""Deterministic fault injection for the resilient training runtime.
+
+A :class:`FaultPlan` is a schedule of failures keyed by global step
+number, consumed by :class:`~singa_tpu.resilience.runtime.ResilientTrainer`
+at well-defined hook points. Every fault fires a configured number of
+times and then disarms, so chaos tests are exactly reproducible — no
+randomness, no sleeps beyond the milliseconds a hang fault asks for.
+
+Faults::
+
+    plan = (FaultPlan()
+            .poison_batch(step=3)          # NaN inputs -> NaN loss/grads
+            .fail_step(step=5, times=2)    # transient step exception
+            .fail_data(step=7)             # data iterator raises
+            .hang_step(step=9, seconds=.05)  # watchdog fodder
+            .preempt_at(step=11)           # real SIGTERM to this process
+            .crash_after_save(step=13))    # die mid-async-save
+
+On-disk chaos (for restore-hardening tests) lives beside the plan:
+:func:`truncate_checkpoint` / :func:`corrupt_checkpoint` damage a
+committed checkpoint step directory in place.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class FaultInjected(RuntimeError):
+    """A transient failure raised by a FaultPlan (retryable)."""
+
+
+class SimulatedCrash(RuntimeError):
+    """A hard crash injected mid-async-save (NOT retryable: the chaos
+    test catches it and restarts a fresh trainer, like a supervisor)."""
+
+
+class FaultPlan:
+    """Deterministic, step-keyed failure schedule (see module doc).
+
+    All ``.fault(...)`` registrations return ``self`` so plans chain.
+    ``fired`` logs ``(step, kind)`` tuples for test assertions.
+    """
+
+    def __init__(self):
+        self._faults = []   # dicts: kind, step, times, extras
+        self.fired = []
+
+    def _arm(self, kind, step, times=1, **extra):
+        rec = {"kind": kind, "step": int(step), "times": int(times)}
+        rec.update(extra)
+        self._faults.append(rec)
+        return self
+
+    def _take(self, kind, step):
+        for rec in self._faults:
+            if rec["kind"] == kind and rec["step"] == int(step) \
+                    and rec["times"] > 0:
+                rec["times"] -= 1
+                self.fired.append((int(step), kind))
+                return rec
+        return None
+
+    # -- registration ------------------------------------------------------
+    def poison_batch(self, step, times=1):
+        """Replace every floating tensor in step N's batch with NaNs."""
+        return self._arm("poison", step, times)
+
+    def fail_step(self, step, times=1, message="injected step failure"):
+        """Raise FaultInjected from the training step body."""
+        return self._arm("step", step, times, message=message)
+
+    def fail_data(self, step, times=1, message="injected data failure"):
+        """Raise FaultInjected from the data-fetch path."""
+        return self._arm("data", step, times, message=message)
+
+    def hang_step(self, step, seconds=0.05, times=1):
+        """Stall the step body (drives the watchdog timeout)."""
+        return self._arm("hang", step, times, seconds=float(seconds))
+
+    def preempt_at(self, step, sig=signal.SIGTERM):
+        """Deliver a real preemption signal to this process just before
+        step N runs (the trainer's handler turns it into a synchronous
+        checkpoint + EXIT_PREEMPTED at the step boundary)."""
+        return self._arm("preempt", step, 1, sig=int(sig))
+
+    def crash_after_save(self, step):
+        """Raise SimulatedCrash right after step N's async checkpoint
+        save is DISPATCHED but before it is awaited — the process dies
+        mid-write, exercising restart over a possibly-incomplete latest
+        checkpoint."""
+        return self._arm("crash_save", step, 1)
+
+    # -- trainer hook points ----------------------------------------------
+    def on_step(self, step, attempt=0):
+        """Called inside the (retried, watchdog-timed) step body before
+        the model runs."""
+        rec = self._take("preempt", step)
+        if rec is not None:
+            os.kill(os.getpid(), rec["sig"])
+        rec = self._take("hang", step)
+        if rec is not None:
+            time.sleep(rec["seconds"])
+        rec = self._take("step", step)
+        if rec is not None:
+            raise FaultInjected(f"step {step}: {rec['message']}")
+
+    def on_batch(self, step, batch):
+        """Possibly poison the fetched batch; returns the batch to use."""
+        if self._take("poison", step) is None:
+            return batch
+        poisoned = []
+        for item in batch:
+            arr = item.data if isinstance(item, Tensor) else item
+            if jnp.issubdtype(jnp.asarray(arr).dtype, jnp.floating):
+                nan = jnp.full(jnp.shape(arr), jnp.nan,
+                               jnp.asarray(arr).dtype)
+                item = Tensor(data=nan, device=getattr(
+                    item, "device", None), requires_grad=False) \
+                    if isinstance(item, Tensor) else np.asarray(nan)
+            poisoned.append(item)
+        return tuple(poisoned)
+
+    def on_data(self, step):
+        """Called before each data fetch attempt."""
+        rec = self._take("data", step)
+        if rec is not None:
+            raise FaultInjected(f"step {step}: {rec['message']}")
+
+    def on_saved(self, step):
+        """Called after a checkpoint save was dispatched for step N."""
+        if self._take("crash_save", step) is not None:
+            raise SimulatedCrash(f"crashed mid-async-save of step {step}")
+
+
+class _NullPlan(FaultPlan):
+    """Hook no-ops for the common no-faults case."""
+
+    def on_step(self, step, attempt=0):
+        pass
+
+    def on_batch(self, step, batch):
+        return batch
+
+    def on_data(self, step):
+        pass
+
+    def on_saved(self, step):
+        pass
+
+
+NULL_PLAN = _NullPlan()
+
+
+# -- on-disk checkpoint chaos ----------------------------------------------
+
+def _step_dir(directory, step):
+    root = os.path.join(str(directory), str(int(step)))
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no checkpoint step dir at {root}")
+    return root
+
+
+def truncate_checkpoint(directory, step):
+    """Truncate every file under checkpoint ``step`` to half its size —
+    the classic torn write a preemption leaves behind. Returns the
+    number of files damaged."""
+    root = _step_dir(directory, step)
+    count = 0
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            path = os.path.join(dirpath, fn)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+            count += 1
+    return count
+
+
+def corrupt_checkpoint(directory, step, byte=0xFF):
+    """Overwrite the head of every file under checkpoint ``step`` with
+    garbage (bit-rot / partial overwrite). Returns files damaged."""
+    root = _step_dir(directory, step)
+    count = 0
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            path = os.path.join(dirpath, fn)
+            size = os.path.getsize(path)
+            if size == 0:
+                continue
+            with open(path, "r+b") as f:
+                f.write(bytes([byte]) * min(1024, size))
+            count += 1
+    return count

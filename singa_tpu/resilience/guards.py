@@ -1,0 +1,330 @@
+"""NaN/divergence step guards with dynamic loss scaling.
+
+:class:`GuardedOptimizer` wraps any optimizer (plain ``Optimizer`` or
+``DistOpt``) and replaces its plain training driver with a guarded one.
+Everything happens ON DEVICE, inside the compiled step the Model layer
+traces — no per-gradient host readbacks:
+
+- the loss is seeded into backward pre-multiplied by the optimizer's
+  ``loss_scale`` (a power of two: bit-exact for in-range f32 grads, and
+  the classic underflow shield for fp16/bf16), gradients are unscaled
+  before use;
+- one global grad-norm accumulates across parameters; a step is *bad*
+  when the loss or that norm is non-finite (or exceeds the configured
+  divergence ceilings);
+- the whole state update — params, momentum/moments, step counter — is
+  computed and then masked with ``where(ok, new, old)``, so a bad step
+  is a no-op on every state tensor: an injected NaN can never land in
+  the parameters;
+- forward-mutated model state the optimizer never sees (BatchNorm
+  running statistics — rebound from the batch BEFORE the guard runs)
+  is covered by *shadow* tensors holding each stat's value as of the
+  last good step: on a bad step the stat is restored from its shadow,
+  so poisoned batch statistics cannot leak into eval/checkpoints
+  either. Shadows are threaded state (checkpointed under
+  ``guard-shadow/``); the Model layer materialises them before the
+  step compiles (``bind_model``/``materialize_shadows``);
+- on a bad step the loss scale backs off; after ``growth_interval``
+  consecutive good steps it grows back (dynamic loss scaling).
+
+The guard's own counters (bad/good streak, total skipped, last grad
+norm) are scalar state tensors: they thread through the compiled step
+like optimizer aux, persist through every checkpoint route under the
+``guard/`` prefix, and cost the host exactly ONE scalar readback per
+step to inspect (``bad_streak_value`` — what ``ResilientTrainer`` polls
+to decide rollback).
+
+Under a ``DistOpt`` the badness verdict is derived from the all-reduced
+gradients (plus an all-reduced loss-badness flag), so every mesh shard
+agrees on skip-vs-apply and replicated state cannot fork.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from ..opt import DistOpt
+from ..tensor import Tensor
+
+
+def _scalar(value=0.0, name=None):
+    t = Tensor(shape=(), dtype=jnp.float32, requires_grad=False)
+    t.data = jnp.asarray(float(value), jnp.float32)
+    t.name = name
+    return t
+
+
+class GuardedOptimizer:
+    """Skip-bad-steps wrapper around an optimizer (see module docstring).
+
+    Only the plain driving path (``optimizer(loss)`` /
+    ``backward_and_update``) is guarded; the specialised DistOpt drivers
+    (``backward_and_update_half``, sparse/partial variants) pass through
+    unguarded via attribute delegation.
+
+    ``dynamic_loss_scale=False`` pins the scale (skip-step and streak
+    accounting still run — the pure-guard mode for f32 training).
+    """
+
+    def __init__(self, optimizer, *, dynamic_loss_scale=True,
+                 init_scale=1.0, growth_factor=2.0, backoff_factor=0.5,
+                 growth_interval=2000, min_scale=2.0 ** -14,
+                 max_scale=2.0 ** 24, max_loss=None, max_grad_norm=None):
+        self.inner = optimizer
+        self.dynamic_loss_scale = bool(dynamic_loss_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.max_loss = max_loss
+        self.max_grad_norm = max_grad_norm
+        self.opt.loss_scale.data = jnp.asarray(float(init_scale),
+                                               jnp.float32)
+        self.bad_streak = _scalar(name="guard/bad_streak")
+        self.good_streak = _scalar(name="guard/good_streak")
+        self.skipped_total = _scalar(name="guard/skipped_total")
+        self.last_grad_norm = _scalar(name="guard/last_grad_norm")
+        self._model = None
+        self._shadows = {}      # model-state name -> shadow Tensor
+
+    # -- forward-mutated state shadows ------------------------------------
+    def bind_model(self, model):
+        """Called by Model.set_optimizer: lets the guard see model state
+        the optimizer never touches (BN running stats)."""
+        self._model = model
+
+    def _shadowable_states(self):
+        if self._model is None:
+            return
+        opt_ids = {id(t) for t in self.inner.state_tensors()}
+        for name, t in self._model.get_states().items():
+            # trainable params (requires_grad) are masked via their
+            # gradient pairs; everything else is forward-mutated state
+            if not t.requires_grad and id(t) not in opt_ids:
+                yield name, t
+
+    def materialize_shadows(self):
+        """Create shadow tensors from the CURRENT (pre-step, concrete)
+        values — Model._ensure_state calls this right before the step
+        compiles, so shadows are threaded through it like any state."""
+        import jax
+        for name, t in self._shadowable_states():
+            if name not in self._shadows and \
+                    not isinstance(t.data, jax.core.Tracer):
+                # a DISTINCT buffer: the live tensor and its shadow are
+                # both donated step state, and XLA rejects donating the
+                # same buffer twice
+                sh = Tensor(data=jnp.array(t.data, copy=True),
+                            device=t.device, requires_grad=False)
+                sh.spec = t.spec
+                sh.name = f"guard-shadow/{name}"
+                self._shadows[name] = sh
+
+    # -- plumbing ----------------------------------------------------------
+    @property
+    def opt(self):
+        """The innermost base optimizer (unwraps a DistOpt)."""
+        inner = self.inner
+        return inner.opt if isinstance(inner, DistOpt) else inner
+
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def _own_state(self):
+        return {"guard/bad_streak": self.bad_streak,
+                "guard/good_streak": self.good_streak,
+                "guard/skipped_total": self.skipped_total,
+                "guard/last_grad_norm": self.last_grad_norm}
+
+    _SHADOW = "guard-shadow/"
+
+    def state_tensors(self):
+        return self.inner.state_tensors() + \
+            list(self._own_state().values()) + list(self._shadows.values())
+
+    def state_tensor_dict(self):
+        d = self.inner.state_tensor_dict()
+        d.update(self._own_state())
+        d.update({self._SHADOW + k: v for k, v in self._shadows.items()})
+        return d
+
+    def _set_shadow(self, name, array, spec=None):
+        sh = self._shadows.get(name)
+        if sh is None:
+            sh = Tensor(data=array, requires_grad=False)
+            sh.spec = spec
+            sh.name = self._SHADOW + name
+            self._shadows[name] = sh
+        else:
+            sh.data = jnp.asarray(array)
+
+    def restore_state_tensor(self, name, array, spec=None):
+        own = self._own_state()
+        if name in own:
+            own[name].data = jnp.asarray(array)
+        elif name.startswith(self._SHADOW):
+            self._set_shadow(name[len(self._SHADOW):], array, spec)
+        else:
+            self.inner.restore_state_tensor(name, array, spec)
+
+    def get_states(self):
+        states = self.inner.get_states()
+        states.update({k: np.asarray(t.data)
+                       for k, t in self._own_state().items()})
+        states.update({self._SHADOW + k: np.asarray(t.data)
+                       for k, t in self._shadows.items()})
+        return states
+
+    def set_states(self, states):
+        own = self._own_state()
+        rest = {}
+        for k, v in states.items():
+            if k in own:
+                own[k].data = jnp.asarray(v, dtype=jnp.float32)
+            elif k.startswith(self._SHADOW):
+                self._set_shadow(k[len(self._SHADOW):], np.asarray(v))
+            else:
+                rest[k] = v
+        self.inner.set_states(rest)
+
+    def announce_aux_specs(self, params_by_name):
+        self.inner.announce_aux_specs(params_by_name)
+
+    def step(self):
+        self.inner.step()
+
+    # -- host-side readbacks ----------------------------------------------
+    def bad_streak_value(self) -> int:
+        """Consecutive bad (skipped) steps — the ONE scalar the driver
+        reads back per step to decide rollback."""
+        return int(float(np.asarray(self.bad_streak.data)))
+
+    def stats(self) -> dict:
+        return {
+            "loss_scale": float(np.asarray(self.opt.loss_scale.data)),
+            "bad_streak": int(float(np.asarray(self.bad_streak.data))),
+            "good_streak": int(float(np.asarray(self.good_streak.data))),
+            "skipped_total": int(float(np.asarray(
+                self.skipped_total.data))),
+            "grad_norm": float(np.asarray(self.last_grad_norm.data)),
+        }
+
+    def reset_streaks(self, extra_backoff=False):
+        """Zero the streak counters (after the driver rolled state back
+        to a checkpoint); optionally back the restored loss scale off
+        once more so the retried stretch does not re-diverge at the
+        scale that just failed."""
+        self.bad_streak.data = jnp.zeros((), jnp.float32)
+        self.good_streak.data = jnp.zeros((), jnp.float32)
+        if extra_backoff and self.dynamic_loss_scale:
+            ls = self.opt.loss_scale
+            ls.data = jnp.maximum(
+                ls.data.astype(jnp.float32) * self.backoff_factor,
+                self.min_scale)
+
+    # -- the guarded driver ------------------------------------------------
+    def __call__(self, loss):
+        self.backward_and_update(loss)
+
+    def backward_and_update(self, loss):
+        dist = self.inner if isinstance(self.inner, DistOpt) else None
+        base = self.opt
+        scale = base.loss_scale.data.astype(jnp.float32)
+        loss_arr = loss.data
+
+        # seed backward with the scale so every gradient comes out
+        # pre-multiplied (underflow shield); unscale before use
+        dy = jnp.full(jnp.shape(loss_arr), scale).astype(loss_arr.dtype)
+        inv = 1.0 / scale
+        norm_sq = jnp.zeros((), jnp.float32)
+        pairs = []
+        for p, g in autograd.backward(loss, dy=dy):
+            arr = g.data
+            excl = dist._shard_axes(p) if dist is not None else ()
+            if dist is not None:
+                # collectives issue per-grad as backward yields, so XLA
+                # still overlaps them with remaining backward compute
+                arr = dist.all_reduce(arr, exclude=excl)
+                arr = arr / dist.communicator.effective_world_size()
+            arr = arr.astype(jnp.float32) * inv
+            contrib = jnp.sum(arr * arr)
+            if excl:
+                # a shard-excluded param (expert/tensor-parallel) holds a
+                # DISTINCT grad slice per shard: sum its norm contribution
+                # over those axes, or shards would compute different
+                # verdicts from the same step and fork replicated state
+                from ..parallel.communicator import active_axis
+                axes = tuple(a for a in excl if active_axis(a))
+                if axes:
+                    import jax
+                    contrib = jax.lax.psum(contrib, axes)
+            norm_sq = norm_sq + contrib
+            g.data = arr.astype(p.dtype)
+            pairs.append((p, g))
+
+        # badness verdict — on device, replicated-consistent: a NaN loss
+        # on ONE shard must skip the step on ALL shards, so the loss
+        # flag rides an all-reduce (grad badness already does, through
+        # the summed gradients feeding norm_sq)
+        loss_bad = 1.0 - jnp.all(jnp.isfinite(
+            loss_arr.astype(jnp.float32))).astype(jnp.float32)
+        if self.max_loss is not None:
+            loss_bad = jnp.maximum(loss_bad, jnp.any(
+                loss_arr.astype(jnp.float32) > self.max_loss
+            ).astype(jnp.float32))
+        if dist is not None:
+            loss_bad = dist.all_reduce(loss_bad)
+        norm_ok = jnp.isfinite(norm_sq)
+        if self.max_grad_norm is not None:
+            norm_ok = jnp.logical_and(
+                norm_ok, norm_sq <= float(self.max_grad_norm) ** 2)
+        ok = jnp.logical_and(loss_bad == 0.0, norm_ok)
+
+        # run the full update, then mask EVERY touched state tensor so a
+        # bad step is a perfect no-op (fresh aux born this step masks
+        # back to its zero init)
+        before = {id(t): (t, t.data) for t in self.inner.state_tensors()}
+        for p, _g in pairs:
+            before.setdefault(id(p), (p, p.data))
+        for p, g in pairs:
+            base.apply(p.name or f"param/{id(p)}", p, g)
+        base.step()
+        for t, old in before.values():
+            if t.data is not old:
+                t.data = jnp.where(ok, t.data, old)
+        for t in self.inner.state_tensors():
+            if id(t) not in before:
+                t.data = jnp.where(ok, t.data, jnp.zeros_like(t.data))
+
+        # forward-mutated model state (BN running stats) was rebound
+        # from the batch BEFORE this guard ran, so its pre-step value is
+        # gone from the live tensor — restore from the shadow (its value
+        # as of the last good step), then refresh the shadow
+        for name, t in self._shadowable_states():
+            sh = self._shadows.get(name)
+            if sh is None:
+                continue    # not materialized yet (abstract rehearsal)
+            t.data = jnp.where(ok, t.data, sh.data.astype(t.dtype))
+            sh.data = t.data.astype(sh.dtype)
+
+        # guard bookkeeping (outside the mask: streaks must advance on
+        # bad steps — that is their whole point)
+        okf = ok.astype(jnp.float32)
+        bad = self.bad_streak.data
+        good = self.good_streak.data
+        self.bad_streak.data = jnp.where(ok, 0.0, bad + 1.0)
+        self.good_streak.data = jnp.where(ok, good + 1.0, 0.0)
+        self.skipped_total.data = self.skipped_total.data + (1.0 - okf)
+        self.last_grad_norm.data = jnp.sqrt(norm_sq)
+        if self.dynamic_loss_scale:
+            grown = jnp.where(
+                jnp.mod(good + 1.0, float(self.growth_interval)) == 0.0,
+                scale * self.growth_factor, scale)
+            new_scale = jnp.where(ok, grown, scale * self.backoff_factor)
+            base.loss_scale.data = jnp.clip(new_scale, self.min_scale,
+                                            self.max_scale)

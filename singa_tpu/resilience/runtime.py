@@ -1,0 +1,386 @@
+"""The fault-tolerant training driver (checkpoint-restart loop).
+
+:class:`ResilientTrainer` owns the loop a production TPU job needs
+around ``model(tx, ty)``:
+
+- **Preemption**: SIGTERM/SIGINT set a flag; at the next step boundary
+  the trainer checkpoints synchronously and exits with
+  :data:`EXIT_PREEMPTED` (75, BSD's EX_TEMPFAIL: "transient — retry").
+  The restart supervisor contract is: exit code 75 means *restart me*;
+  the restarted trainer resumes from the preemption checkpoint with
+  bit-identical state (params, optimizer aux, loss-scale, guard
+  counters all ride the checkpoint).
+- **Transient failures**: step exceptions and data-iterator exceptions
+  retry with exponential backoff + deterministic jitter; an optional
+  watchdog runs each step on a worker thread. A step that overruns the
+  timeout gets one grace period: finishing late is used as-is, a step
+  that raised late is retried, and a step STILL running after the grace
+  raises a fatal :class:`StepTimeoutError` — a hung backend cannot be
+  retried in-process (the zombie thread could land its update mid-retry),
+  so the supervisor restart from checkpoint is the recovery.
+- **Divergence**: when the model's optimizer is a
+  :class:`~singa_tpu.resilience.guards.GuardedOptimizer`, the trainer
+  polls its bad-streak counter (one scalar readback) and, after
+  ``rollback_after`` consecutive bad steps, rolls state back to the
+  last good checkpoint and continues (bounded by ``max_rollbacks``).
+- **Restart**: every ``run`` begins with
+  ``CheckpointManager.restore_latest``, which itself scans backward
+  past corrupt/incomplete checkpoints (singa_tpu/checkpoint.py).
+
+Usage::
+
+    trainer = ResilientTrainer(model, "ckpts", save_interval_steps=50)
+    summary = trainer.run(batches, num_steps=10_000)
+
+where ``batches`` is any (re-)iterable yielding the positional args of
+one training step (tuples of Tensors). Exhausted re-iterables
+re-iterate (epoch wrap); endless generators work as-is; a FINITE
+one-shot generator that runs dry mid-training raises a clear error
+(it cannot be rewound).
+"""
+
+from __future__ import annotations
+
+import random
+import signal
+import threading
+import time
+import warnings
+
+from ..checkpoint import CheckpointManager
+from .faults import NULL_PLAN
+from .guards import GuardedOptimizer
+
+# BSD EX_TEMPFAIL: the documented "preempted — checkpointed cleanly,
+# restart me" exit code for the restart supervisor. Distinct from 0
+# (done), 1 (crash), and 42-style user codes.
+EXIT_PREEMPTED = 75
+
+
+class StepTimeoutError(RuntimeError):
+    """A training step exceeded the watchdog timeout.
+
+    Carries the worker thread plus its result/exception slots so the
+    driver can decide safely: a LATE completion within the grace join
+    is used as-is; a still-running worker makes the timeout fatal —
+    retrying while a zombie step can still land its (state-mutating)
+    update would race on the shared tensors."""
+
+    def __init__(self, message, worker=None, result=None, raised=None):
+        super().__init__(message)
+        self.worker = worker
+        self.result = result if result is not None else {}
+        self.raised = raised if raised is not None else []
+
+
+class _Preempted(Exception):
+    """Internal control flow: a preemption checkpoint has committed."""
+
+
+class ResilientTrainer:
+    """Checkpoint-restart training loop (see module docstring).
+
+    Parameters beyond the obvious:
+
+    - ``step_retries`` / ``data_retries``: transient-failure retry
+      budgets per step / per batch fetch.
+    - ``backoff_base`` / ``backoff_cap`` / ``jitter``: retry delay is
+      ``min(cap, base * 2**attempt) * (1 + jitter*u)`` with ``u`` drawn
+      from a seeded RNG — exponential backoff, deterministic jitter.
+    - ``step_timeout``: seconds before a step is declared overdue; one
+      grace period follows (late success used, late failure retried,
+      still-hung fatal). None disables the watchdog thread.
+    - ``rollback_after``: consecutive guard-flagged bad steps before
+      state rolls back to the last checkpoint (None disables; requires
+      a GuardedOptimizer to ever trigger).
+    - ``exit_on_preempt``: raise ``SystemExit(EXIT_PREEMPTED)`` after
+      the preemption checkpoint (the supervisor contract); False makes
+      ``run`` return its summary with ``preempted=True`` instead (for
+      embedding in a larger host process).
+    - ``faults``: a FaultPlan for chaos testing.
+    """
+
+    def __init__(self, model, ckpt_dir, *, max_to_keep=3,
+                 save_interval_steps=1, step_retries=3, data_retries=3,
+                 backoff_base=0.1, backoff_cap=5.0, jitter=0.25,
+                 step_timeout=None, rollback_after=3, max_rollbacks=3,
+                 exit_on_preempt=True, install_signal_handlers=True,
+                 faults=None, seed=0, verbose=True):
+        self.model = model
+        self.mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps)
+        self.step_retries = int(step_retries)
+        self.data_retries = int(data_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.jitter = float(jitter)
+        self.step_timeout = step_timeout
+        self.rollback_after = rollback_after
+        self.max_rollbacks = int(max_rollbacks)
+        self.exit_on_preempt = bool(exit_on_preempt)
+        self.install_signal_handlers = bool(install_signal_handlers)
+        self.faults = faults if faults is not None else NULL_PLAN
+        self.verbose = bool(verbose)
+        self._rng = random.Random(seed)
+        self._sleep = time.sleep          # injectable in tests
+        self._preempt_signal = None
+        self._data = None
+        self._it = None
+
+    # -- logging -----------------------------------------------------------
+    def _log(self, msg):
+        if self.verbose:
+            print(f"[resilient] {msg}", flush=True)
+
+    # -- signal handling ---------------------------------------------------
+    def _handler(self, signum, frame):
+        # only record: all real work (sync checkpoint, exit) happens at
+        # the next step boundary, never inside the handler
+        self._preempt_signal = signum
+
+    def _install_handlers(self):
+        if not self.install_signal_handlers:
+            return None
+        try:
+            prev = {s: signal.signal(s, self._handler)
+                    for s in (signal.SIGTERM, signal.SIGINT)}
+        except ValueError:
+            # signal.signal only works on the main thread; degrade to
+            # no preemption handling rather than refusing to train
+            warnings.warn(
+                "ResilientTrainer: not on the main thread, preemption "
+                "signal handlers NOT installed", stacklevel=3)
+            return None
+        return prev
+
+    @staticmethod
+    def _restore_handlers(prev):
+        if prev:
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+    def _check_preempt(self, completed_step, start):
+        """At a step boundary: if a preemption signal arrived, commit a
+        synchronous checkpoint of the completed step and stop."""
+        if self._preempt_signal is None:
+            return
+        signame = signal.Signals(self._preempt_signal).name
+        if completed_step >= start:
+            if self.mgr.latest_step() != completed_step:
+                self.mgr.save(completed_step, self.model, force=True)
+            self.mgr.wait()     # synchronous: the bytes must be down
+            self._log(f"{signame}: checkpointed step {completed_step}, "
+                      f"exiting {EXIT_PREEMPTED} for the supervisor")
+        else:
+            self._log(f"{signame} before any step completed; "
+                      f"exiting {EXIT_PREEMPTED} without a checkpoint")
+        raise _Preempted()
+
+    # -- retry plumbing ----------------------------------------------------
+    def _backoff(self, attempt, what, summary, kind):
+        from ..data import backoff_delay
+        delay = backoff_delay(attempt, self.backoff_base,
+                              self.backoff_cap, self.jitter, self._rng)
+        summary[kind] += 1
+        self._log(f"{what}: transient failure, retrying "
+                  f"in {delay * 1e3:.0f} ms "
+                  f"(attempt {attempt + 1})")
+        self._sleep(delay)
+
+    def _next_batch(self, step, summary):
+        attempt = 0
+        failed = None
+        while True:
+            try:
+                self.faults.on_data(step)
+                if self._it is None:
+                    self._it = iter(self._data)
+                try:
+                    batch = next(self._it)
+                except StopIteration:
+                    self._it = iter(self._data)   # epoch wrap
+                    batch = next(self._it)
+                if not isinstance(batch, (tuple, list)):
+                    batch = (batch,)
+                self._yielded_any = True
+                return self.faults.on_batch(step, tuple(batch))
+            except StopIteration:
+                if failed is not None:
+                    # a generator that raised is CLOSED, not exhausted:
+                    # this StopIteration is the corpse of the retried
+                    # failure — surface the real error (same rule as
+                    # data.RetryingIterator.__next__; keep them in sync)
+                    raise failed from None
+                if getattr(self, "_yielded_any", False):
+                    raise RuntimeError(
+                        "data source is exhausted and not re-iterable "
+                        "(a one-shot generator?); pass a re-iterable "
+                        "like NumpyBatchIter, or an endless generator"
+                    ) from None
+                raise RuntimeError(
+                    "data source yielded no batches") from None
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if attempt >= self.data_retries:
+                    raise
+                failed = e
+                self._backoff(attempt, f"data fetch (step {step})",
+                              summary, "data_retries")
+                attempt += 1
+
+    def _call_step(self, step, batch, attempt):
+        """One step attempt: fault hooks + the model call, optionally
+        under the watchdog thread."""
+        def body():
+            self.faults.on_step(step, attempt)
+            return self.model(*batch)
+
+        if self.step_timeout is None:
+            return body()
+        result, raised = {}, []
+        # carry the caller's contextvars into the worker: a use_layout()
+        # scope (ops/layout.py ContextVar) entered around run() must be
+        # visible to lazy conv/BN handle init inside the step
+        import contextvars
+        ctx = contextvars.copy_context()
+
+        def work():
+            try:
+                result["out"] = ctx.run(body)
+            except BaseException as e:     # noqa: BLE001 — re-raised below
+                raised.append(e)
+
+        worker = threading.Thread(target=work, daemon=True,
+                                  name=f"resilient-step-{step}")
+        worker.start()
+        worker.join(self.step_timeout)
+        if worker.is_alive():
+            raise StepTimeoutError(
+                f"step {step} exceeded the {self.step_timeout}s "
+                "watchdog timeout", worker=worker, result=result,
+                raised=raised)
+        if raised:
+            raise raised[0]
+        return result.get("out")
+
+    def _run_step(self, step, batch, summary):
+        attempt = 0
+        while True:
+            try:
+                return self._call_step(step, batch, attempt)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except StepTimeoutError as e:
+                # grace-join the overdue worker one more timeout period:
+                # a SLOW step that completes in the grace is simply used
+                # (its update already landed); a step still running after
+                # that is fatal — we cannot retry while a zombie thread
+                # may yet land its state mutation concurrently
+                summary["step_timeouts"] += 1
+                e.worker.join(self.step_timeout)
+                if e.worker.is_alive():
+                    raise StepTimeoutError(
+                        f"step {step} still running after "
+                        f"{2 * self.step_timeout}s; a hung backend "
+                        "cannot be retried in-process — exit and let "
+                        "the supervisor restart from the checkpoint"
+                    ) from None
+                if not e.raised:
+                    self._log(f"step {step} finished late "
+                              "(within the watchdog grace); using it")
+                    return e.result.get("out")
+                if attempt >= self.step_retries:
+                    raise e.raised[0]
+                self._backoff(attempt, f"train step {step}",
+                              summary, "step_retries")
+                attempt += 1
+            except Exception:
+                if attempt >= self.step_retries:
+                    raise
+                self._backoff(attempt, f"train step {step}",
+                              summary, "step_retries")
+                attempt += 1
+
+    # -- divergence rollback ----------------------------------------------
+    def _guard(self):
+        opt = getattr(self.model, "optimizer", None)
+        return opt if isinstance(opt, GuardedOptimizer) else None
+
+    def _maybe_rollback(self, step, bad_streak, summary):
+        """Returns the step to continue from (rolled back), or None."""
+        guard = self._guard()
+        if guard is None or self.rollback_after is None:
+            return None
+        if bad_streak < self.rollback_after:
+            return None
+        if summary["rollbacks"] >= self.max_rollbacks:
+            raise RuntimeError(
+                f"training diverged: {self.rollback_after} consecutive "
+                f"bad steps after {summary['rollbacks']} rollbacks")
+        self.mgr.wait()          # never restore under an in-flight save
+        resume = self.mgr.restore_latest(self.model)
+        guard.reset_streaks(extra_backoff=True)
+        summary["rollbacks"] += 1
+        warnings.warn(
+            f"{self.rollback_after} consecutive bad steps at step "
+            f"{step}; rolled back to checkpoint, resuming at step "
+            f"{resume} (rollback {summary['rollbacks']}/"
+            f"{self.max_rollbacks})", stacklevel=2)
+        return resume
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, data, num_steps, step_callback=None):
+        """Train until global step ``num_steps``, surviving what the
+        FaultPlan / real world throws. Returns a summary dict; raises
+        ``SystemExit(EXIT_PREEMPTED)`` on preemption (see class doc)."""
+        self._data = data
+        self._it = None
+        self._yielded_any = False
+        self._preempt_signal = None     # a reused trainer starts clean
+        summary = {"start": None, "steps_run": 0, "rollbacks": 0,
+                   "step_retries": 0, "data_retries": 0,
+                   "step_timeouts": 0, "skipped_steps": 0,
+                   "preempted": False}
+        prev_handlers = self._install_handlers()
+        try:
+            start = self.mgr.restore_latest(self.model)
+            summary["start"] = start
+            if start:
+                self._log(f"resumed from checkpoint; continuing at "
+                          f"step {start}")
+            step = start
+            self._check_preempt(step - 1, start)
+            guard = self._guard()
+            while step < num_steps:
+                batch = self._next_batch(step, summary)
+                out = self._run_step(step, batch, summary)
+                summary["steps_run"] += 1
+                # ONE scalar readback per step; a guard-flagged bad step
+                # is never checkpointed, so the newest checkpoint always
+                # predates the bad streak and rollback actually rewinds
+                bad = guard.bad_streak_value() if guard is not None else 0
+                if bad == 0:
+                    self.mgr.save(step, self.model)
+                    self.faults.on_saved(step)
+                if step_callback is not None:
+                    step_callback(step, out)
+                self._check_preempt(step, start)
+                resumed = self._maybe_rollback(step, bad, summary)
+                step = resumed if resumed is not None else step + 1
+            self.mgr.wait()
+            guard = self._guard()
+            if guard is not None:
+                summary["skipped_steps"] = guard.stats()["skipped_total"]
+            return summary
+        except _Preempted:
+            summary["preempted"] = True
+            if self.exit_on_preempt:
+                raise SystemExit(EXIT_PREEMPTED) from None
+            return summary
+        finally:
+            self._restore_handlers(prev_handlers)
+
+    def close(self):
+        self.mgr.close()

@@ -142,8 +142,20 @@ def _unpack_tensorproto(raw: bytes) -> np.ndarray:
     elif dtype == _K_INT:
         arr = np.asarray([v - (1 << 64) if v >= (1 << 63) else v
                           for v in ints], np.int64).astype(np.int32)
-    else:
+    elif dtype == _K_FLOAT32:
         arr = np.frombuffer(bytes(floats), "<f4")
+    else:
+        # mirror the clear error _pack_tensorproto gives on write: a
+        # kFloat16/kChar/kUChar payload (field 7 bytes_data) is never
+        # parsed above, so decoding would hand back an empty/garbled
+        # buffer and fail later at reshape with a confusing message
+        names = {_K_FLOAT16: "kFloat16", _K_CHAR: "kChar",
+                 _K_UCHAR: "kUChar"}
+        raise ValueError(
+            f"TensorProto data_type {names.get(dtype, dtype)} is not "
+            "supported by this reader (only kFloat32/kDouble/kInt "
+            "payloads, matching the reference to_proto, "
+            "tensor.cc:364-418)")
     return arr.reshape(shape).copy()
 
 
@@ -181,8 +193,14 @@ def _binfile_read(path):
 
 
 def _encode_array(arr: np.ndarray) -> bytes:
-    """dtype-str-len u8 | dtype str | ndim u8 | dims u32* | raw bytes"""
-    dt = arr.dtype.str.encode("ascii")
+    """dtype-str-len u8 | dtype str | ndim u8 | dims u32* | raw bytes
+
+    Extended dtypes (bfloat16, fp8 — registered by ml_dtypes) have a
+    void ``dtype.str`` ('<V2'), which would round-trip as raw bytes with
+    the real type lost; their registered NAME parses back through
+    ``np.dtype(...)``, so it is stored instead."""
+    dt = (arr.dtype.name if "V" in arr.dtype.str
+          else arr.dtype.str).encode("ascii")
     out = bytearray()
     out += len(dt).to_bytes(1, "little")
     out += dt
@@ -195,7 +213,15 @@ def _encode_array(arr: np.ndarray) -> bytes:
 
 def _decode_array(raw: bytes) -> np.ndarray:
     n = raw[0]
-    dt = np.dtype(raw[1:1 + n].decode("ascii"))
+    tok = raw[1:1 + n].decode("ascii")
+    if tok and tok[0] not in "<>|=":
+        # name-encoded extended dtype: numpy only knows it once
+        # ml_dtypes (shipped with jax) has registered it
+        try:
+            import ml_dtypes  # noqa: F401
+        except ImportError:
+            pass
+    dt = np.dtype(tok)
     off = 1 + n
     ndim = raw[off]
     off += 1
@@ -212,40 +238,51 @@ _K_BY_DTYPE = {np.dtype(np.float32): _K_FLOAT32,
                np.dtype(np.int64): _K_INT}
 
 
+def _singa_serializable(arr: np.ndarray) -> bool:
+    """Whether the reference TensorProto wire format can carry ``arr``
+    losslessly (the dtypes _pack_tensorproto accepts, incl. the int64
+    in-int32-range rule)."""
+    if arr.dtype in (np.float32, np.float64, np.int32):
+        return True
+    if arr.dtype == np.int64:
+        return bool(arr.min(initial=0) >= -2**31
+                    and arr.max(initial=0) < 2**31)
+    return False
+
+
 class Snapshot:
     """Write or read a parameter checkpoint (reference
     python/singa/snapshot.py:42; kWrite/kRead modes).
 
-    ``format`` applies to writes: "singa" (default — reference 4.0.0
-    wire compatibility) or "native". Reads auto-detect from the magic
-    bytes, so both kinds (and real SINGA checkpoints) load through the
-    same constructor; like the reference reader (snapshot.cc:60-64),
-    a ``<prefix>.model`` BinFile from SINGA 1.0.0 is accepted when no
-    ``.bin`` exists."""
+    ``format`` applies to writes: "auto" (default), "singa" (reference
+    4.0.0 wire compatibility) or "native". With "auto" the records are
+    buffered in memory and the files land on ``done()``/context exit:
+    the reference wire format is used when every tensor fits it, else
+    the whole snapshot auto-falls-back to the native record format —
+    with a warning — so bfloat16 / out-of-int32-range int64 state that
+    saved fine before the singa format existed keeps saving fine (the
+    explicit ``format="singa"`` contract still raises on such dtypes).
+    Reads auto-detect from the magic bytes, so both kinds (and real
+    SINGA checkpoints) load through the same constructor; like the
+    reference reader (snapshot.cc:60-64), a ``<prefix>.model`` BinFile
+    from SINGA 1.0.0 is accepted when no ``.bin`` exists."""
 
     kRead = False
     kWrite = True
 
     def __init__(self, prefix: str, mode: bool, buffer_size: int = 10,
-                 format: str = "singa"):
+                 format: str = "auto"):
         self.prefix = prefix
         self.mode = mode
-        if format not in ("singa", "native"):
-            raise ValueError(f"format must be 'singa' or 'native', "
-                             f"got {format!r}")
+        if format not in ("auto", "singa", "native"):
+            raise ValueError(f"format must be 'auto', 'singa' or "
+                             f"'native', got {format!r}")
         self.format = format
         if mode == self.kWrite:
             self._names = set()
-            if format == "native":
-                self._writer = RecordWriter(prefix + ".bin")
-            else:
-                self._writer = open(prefix + ".bin", "wb")
-            self._desc = open(prefix + ".desc", "w")
-            if format == "singa":
-                # snapshot.cc:46 — version header line
-                self._desc.write(f"SINGA VERSION: {SINGA_VERSION}\n")
-            else:
-                self._desc.write(f"version: {VERSION}\n")
+            self._pending = [] if format == "auto" else None
+            if format != "auto":
+                self._open_write(format)
         else:
             path = prefix + ".bin"
             if not os.path.exists(path):
@@ -269,15 +306,21 @@ class Snapshot:
                         f"SINGA BinFile (magic {head[:2]!r})")
                 self._reader = None
 
-    def write(self, param_name: str, param_val) -> None:
-        assert self.mode == self.kWrite, "snapshot opened for read"
-        # reference Snapshot::Write CHECKs key uniqueness (snapshot.cc:88)
-        if param_name in self._names:
-            raise ValueError(f"duplicate snapshot key {param_name!r}")
-        self._names.add(param_name)
-        arr = np.asarray(param_val.numpy()
-                         if isinstance(param_val, Tensor) else param_val)
-        if self.format == "singa":
+    def _open_write(self, format: str) -> None:
+        if format == "native":
+            self._writer = RecordWriter(self.prefix + ".bin")
+        else:
+            self._writer = open(self.prefix + ".bin", "wb")
+        self._desc = open(self.prefix + ".desc", "w")
+        if format == "singa":
+            # snapshot.cc:46 — version header line
+            self._desc.write(f"SINGA VERSION: {SINGA_VERSION}\n")
+        else:
+            self._desc.write(f"version: {VERSION}\n")
+
+    def _write_record(self, format: str, param_name: str,
+                      arr: np.ndarray) -> None:
+        if format == "singa":
             _binfile_write(self._writer, param_name,
                            _pack_tensorproto(arr))
             # snapshot.cc:97-103 desc line, byte for byte
@@ -291,6 +334,19 @@ class Snapshot:
             self._desc.write(
                 f"name: {param_name} shape: {list(arr.shape)} "
                 f"dtype: {arr.dtype.name}\n")
+
+    def write(self, param_name: str, param_val) -> None:
+        assert self.mode == self.kWrite, "snapshot opened for read"
+        # reference Snapshot::Write CHECKs key uniqueness (snapshot.cc:88)
+        if param_name in self._names:
+            raise ValueError(f"duplicate snapshot key {param_name!r}")
+        self._names.add(param_name)
+        arr = np.asarray(param_val.numpy()
+                         if isinstance(param_val, Tensor) else param_val)
+        if self._pending is not None:       # auto: decide format on done()
+            self._pending.append((param_name, arr))
+        else:
+            self._write_record(self.format, param_name, arr)
 
     def read(self):
         """All params as an OrderedDict name -> Tensor (reference
@@ -313,6 +369,25 @@ class Snapshot:
 
     def done(self) -> None:
         if self.mode == self.kWrite:
+            if self._pending is not None:
+                pending, self._pending = self._pending, None
+                bad = [(n, a.dtype) for n, a in pending
+                       if not _singa_serializable(a)]
+                fmt = "native" if bad else "singa"
+                if bad:
+                    import warnings
+                    warnings.warn(
+                        f"snapshot {self.prefix!r}: {bad[0][0]!r} "
+                        f"(dtype {bad[0][1]}) has no reference "
+                        "TensorProto payload; writing the whole snapshot "
+                        "in the native record format instead (pass "
+                        "format='singa' to force the reference wire "
+                        "format, which raises on such dtypes)",
+                        stacklevel=2)
+                self._open_write(fmt)
+                self.format = fmt
+                for name, arr in pending:
+                    self._write_record(fmt, name, arr)
             self._writer.close()
             self._desc.close()
         elif self._reader is not None:
@@ -325,9 +400,36 @@ class Snapshot:
         self.done()
 
 
-def save_states(prefix: str, states: dict) -> None:
-    """Convenience: dict of name->Tensor/ndarray to a snapshot."""
-    with Snapshot(prefix, Snapshot.kWrite) as s:
+def save_states(prefix: str, states: dict, format: str = "auto") -> None:
+    """Convenience: dict of name->Tensor/ndarray to a snapshot.
+    ``format`` passes through to :class:`Snapshot` ("auto" default:
+    reference wire format when every dtype fits, native otherwise).
+
+    With the whole dict in hand, "auto" is resolved HERE by inspecting
+    dtypes up front, so the records stream straight to disk instead of
+    riding Snapshot's record-at-a-time buffering (which would hold a
+    host copy of the entire checkpoint until done())."""
+    if format == "auto":
+        format = "singa"
+        for k, v in states.items():
+            dt = np.dtype(getattr(v, "dtype", None) or np.asarray(v).dtype)
+            if dt == np.int64:
+                # range decides: only the values say whether the
+                # reference kInt (int32) payload can carry them
+                arr = np.asarray(v.numpy()
+                                 if isinstance(v, Tensor) else v)
+                if _singa_serializable(arr):
+                    continue
+            elif dt in (np.float32, np.float64, np.int32):
+                continue
+            import warnings
+            warnings.warn(
+                f"save_states {prefix!r}: {k!r} (dtype {dt}) has no "
+                "reference TensorProto payload; writing the snapshot "
+                "in the native record format instead", stacklevel=2)
+            format = "native"
+            break
+    with Snapshot(prefix, Snapshot.kWrite, format=format) as s:
         for k, v in states.items():
             s.write(k, v)
 

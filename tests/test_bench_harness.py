@@ -168,6 +168,51 @@ def test_stale_banked_observation_age_capped(bench, capsys, monkeypatch):
     assert out["value"] == 1.1
 
 
+def test_measured_choice_age_gates_stale_winner(bench, monkeypatch):
+    """ADVICE r5 #4 regression: a banked A/B winner older than the
+    banked max-age window (and not stamped with the current commit)
+    must not steer bench config — _measured_choice falls back to the
+    default instead of adopting a winner measured on older code."""
+    import time as _time
+    monkeypatch.delenv("BENCH_CONV_LAYOUT", raising=False)
+    monkeypatch.setattr(bench, "_git_rev", lambda: "cafe123")
+
+    def write(ts, git=None):
+        rec = {"ts": ts, "event": "extra", "extra": "resnet_layout_ab",
+               "winner": "NHWC"}
+        if git:
+            rec["git"] = git
+        with open(bench.OBS_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # stale (20h > 14h window), stamped with an OLDER commit: ignored
+    old = _time.strftime("%Y-%m-%dT%H:%M:%S",
+                         _time.localtime(_time.time() - 20 * 3600))
+    write(old, git="0ldrev0")
+    assert bench._conv_layout() == ("NCHW", "default-unmeasured")
+
+    # same age but stamped with the CURRENT commit: still trusted
+    os.remove(bench.OBS_PATH)
+    write(old, git="cafe123")
+    assert bench._conv_layout() == ("NHWC", "measured-ab")
+
+    # fresh record (no git stamp needed): trusted
+    os.remove(bench.OBS_PATH)
+    fresh = _time.strftime("%Y-%m-%dT%H:%M:%S")
+    write(fresh)
+    assert bench._conv_layout() == ("NHWC", "measured-ab")
+
+
+def test_record_obs_stamps_git_rev(bench, monkeypatch):
+    """Every banked record carries the producing commit, so the
+    staleness gate's same-commit escape can actually fire."""
+    monkeypatch.setattr(bench, "_git_rev", lambda: "cafe123")
+    bench._record_obs("extra", {"extra": "resnet_layout_ab",
+                                "winner": "NHWC"})
+    recs = bench._raw_obs()
+    assert recs and recs[-1]["git"] == "cafe123"
+
+
 def test_round_start_marker_resumes_recent_window(bench):
     assert bench._record_round_start(11.5) is True
     # a restart minutes later must NOT open a new window (it would

@@ -222,8 +222,10 @@ def test_conv_layout_auto_uses_banked_ab(monkeypatch):
     monkeypatch.setattr(bench, "_load_obs", lambda: [])
     assert bench._conv_layout() == ("NCHW", "default-unmeasured")
     obs = [
-        {"event": "extra", "extra": "resnet_layout_ab", "winner": "NCHW"},
-        {"event": "extra", "extra": "resnet_layout_ab", "winner": "NHWC"},
+        {"event": "extra", "ts": _ts(7200),
+         "extra": "resnet_layout_ab", "winner": "NCHW"},
+        {"event": "extra", "ts": _ts(3600),
+         "extra": "resnet_layout_ab", "winner": "NHWC"},
     ]
     monkeypatch.setattr(bench, "_load_obs", lambda: obs)
     assert bench._conv_layout() == ("NHWC", "measured-ab")
@@ -277,6 +279,6 @@ def test_resnet_stem_env_and_banked(monkeypatch, capsys):
     assert "conv7|space_to_depth|auto" in capsys.readouterr().err
     monkeypatch.delenv("BENCH_RESNET_STEM")
     monkeypatch.setattr(bench, "_load_obs", lambda: [
-        {"event": "extra", "extra": "resnet_stem_ab",
+        {"event": "extra", "ts": _ts(60), "extra": "resnet_stem_ab",
          "winner": "space_to_depth"}])
     assert bench._resnet_stem() == ("space_to_depth", "measured-ab")

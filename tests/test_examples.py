@@ -45,6 +45,17 @@ class TestExamples:
                            "--dist", "--dist-option", "half"])
         assert "loss" in out.lower(), out[-500:]
 
+    def test_train_cnn_resilient(self, tmp_path):
+        """The fault-tolerant driver through the user CLI: trains,
+        checkpoints, and a relaunch resumes instead of restarting."""
+        args = ["examples/train_cnn.py", "mlp", "--cpu", "--epochs", "1",
+                "--iters", "2", "--bs", "8", "--resilient",
+                "--save-every", "1", "--ckpt-dir", str(tmp_path / "ck")]
+        out = run_example(args)
+        assert "resilient run summary" in out, out[-500:]
+        out = run_example(args[:4] + ["2"] + args[5:])   # 2 epochs now
+        assert "resumed from checkpoint" in out, out[-500:]
+
     def test_train_resnet_perf_modes(self):
         """The round-5 perf modes through the user CLI: channels-last
         trunk + space-to-depth stem on the resnet family."""

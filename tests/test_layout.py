@@ -33,6 +33,37 @@ def _nchw_to_nhwc(x):
     return np.transpose(x, (0, 2, 3, 1))
 
 
+def test_layout_scope_is_per_thread():
+    """ADVICE r5 #1 regression: an NHWC scope on one thread must not
+    leak into handle construction on another (training alongside
+    serving) — the scope stack is a ContextVar, not a process global."""
+    import threading
+
+    seen = {}
+    entered = threading.Event()
+    release = threading.Event()
+
+    def other_thread():
+        seen["before"] = L.current_layout()
+        with L.use_layout("NHWC" if seen["before"] == "NCHW" else "NCHW"):
+            pass
+        entered.wait(5)
+        # main thread is INSIDE use_layout("NHWC") right now
+        seen["during"] = L.current_layout()
+        release.set()
+
+    th = threading.Thread(target=other_thread)
+    th.start()
+    with L.use_layout("NHWC"):
+        entered.set()
+        release.wait(5)
+        assert L.current_layout() == "NHWC"
+    th.join(5)
+    assert seen["before"] == "NCHW"
+    assert seen["during"] == "NCHW"     # no cross-thread leak
+    assert L.current_layout() == "NCHW"
+
+
 def test_layout_stack_and_validation():
     assert L.current_layout() == "NCHW"
     with L.use_layout("nhwc"):
@@ -252,7 +283,9 @@ class TestSpaceToDepthStem:
 
 
 def test_layout_env_default(monkeypatch):
-    monkeypatch.setattr(L, "_stack", ["NCHW"])
+    from contextvars import ContextVar
+    monkeypatch.setattr(
+        L, "_stack", ContextVar("test_layout", default=("NCHW",)))
     x = np.zeros((1, 2, 4, 4), np.float32)
     assert ConvHandle(x, 3, 1, 1, 2, 2).layout == "NCHW"
     with L.use_layout("NHWC"):

@@ -203,9 +203,10 @@ class TestPipeline:
         def run(x_mb, Wstack):
             return pipeline.pipeline_spmd(stage, Wstack, x_mb, "pipe")
 
+        from singa_tpu.model import _shard_map_compat_kwargs
         mapped = shard_map(run, mesh=msh,
                            in_specs=(P(), P("pipe")),
-                           out_specs=P())
+                           out_specs=P(), **_shard_map_compat_kwargs())
         x_mb = pipeline.microbatch(x, n_micro)
         out = mapped(x_mb, np.stack(Ws))
 
@@ -232,8 +233,9 @@ class TestPipeline:
             out = pipeline.pipeline_spmd(stage, Wstack, x_mb, "pipe")
             return jnp.sum(out ** 2)
 
+        from singa_tpu.model import _shard_map_compat_kwargs
         mapped = shard_map(loss, mesh=msh, in_specs=(P("pipe"), P()),
-                           out_specs=P())
+                           out_specs=P(), **_shard_map_compat_kwargs())
         x_mb = pipeline.microbatch(x, n_micro)
         g = jax.grad(lambda W: jax.jit(mapped)(W, x_mb))(Ws)
 

@@ -1,0 +1,719 @@
+"""Chaos suite for the resilient training runtime
+(singa_tpu/resilience): preemption checkpoint-restart, NaN/divergence
+guards, transient-failure retry, watchdog timeouts, and restore
+hardening against corrupt checkpoints. All CPU, all deterministic
+(FaultPlan schedules), no sleeps beyond milliseconds."""
+
+import os
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, layer, model, opt
+from singa_tpu.checkpoint import CheckpointManager
+from singa_tpu.resilience import (EXIT_PREEMPTED, FaultInjected, FaultPlan,
+                                  GuardedOptimizer, ResilientTrainer,
+                                  SimulatedCrash, corrupt_checkpoint,
+                                  truncate_checkpoint)
+from singa_tpu.tensor import Tensor
+
+
+class MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def fresh_model(seed=7, guard=True, **guard_kw):
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(seed)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+    tx = Tensor(data=x, device=dev, requires_grad=False)
+    ty = Tensor(data=y, device=dev, requires_grad=False)
+    m = MLP()
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    m.set_optimizer(GuardedOptimizer(sgd, **guard_kw) if guard else sgd)
+    m.compile([tx], is_train=True, use_graph=True)
+    return m, tx, ty
+
+
+def full_state(m):
+    """Every model param/state + optimizer state array, host-side."""
+    out = {k: np.asarray(v.data).copy() for k, v in m.get_states().items()}
+    out.update({f"opt/{k}": np.asarray(v).copy()
+                for k, v in m.optimizer.get_states().items()})
+    return out
+
+
+def make_trainer(m, ckpt_dir, **kw):
+    kw.setdefault("verbose", False)
+    kw.setdefault("backoff_base", 0.001)
+    kw.setdefault("backoff_cap", 0.002)
+    return ResilientTrainer(m, ckpt_dir, **kw)
+
+
+class TestPreemption:
+    def test_sigterm_checkpoints_and_exits_with_contract_code(
+            self, tmp_path):
+        """A preemption signal mid-run commits a synchronous checkpoint
+        of the completed step and exits with the documented supervisor
+        code; a restarted trainer resumes at the right step with
+        BIT-IDENTICAL state."""
+        ck = str(tmp_path / "run")
+        m, tx, ty = fresh_model()
+        plan = FaultPlan().preempt_at(step=4, sig=signal.SIGTERM)
+        tr = make_trainer(m, ck, save_interval_steps=2, faults=plan)
+        with pytest.raises(SystemExit) as e:
+            tr.run([(tx, ty)], num_steps=10)
+        assert e.value.code == EXIT_PREEMPTED == 75
+        assert (4, "preempt") in plan.fired
+        snap = full_state(m)
+
+        # restart: fresh process (different init on purpose)
+        m2, tx2, ty2 = fresh_model(seed=99)
+        tr2 = make_trainer(m2, ck)
+        summary = tr2.run([(tx2, ty2)], num_steps=5)
+        assert summary["start"] == 5        # preempted after step 4
+        assert summary["steps_run"] == 0
+        snap2 = full_state(m2)
+        assert set(snap) == set(snap2)
+        for k in snap:
+            np.testing.assert_array_equal(snap[k], snap2[k], err_msg=k)
+
+        # and the restarted trainer actually continues training
+        summary = tr2.run([(tx2, ty2)], num_steps=8)
+        assert summary["steps_run"] == 3
+
+    def test_sigint_handled_too(self, tmp_path):
+        m, tx, ty = fresh_model()
+        plan = FaultPlan().preempt_at(step=1, sig=signal.SIGINT)
+        tr = make_trainer(m, str(tmp_path / "run"),
+                          save_interval_steps=1, faults=plan)
+        with pytest.raises(SystemExit) as e:
+            tr.run([(tx, ty)], num_steps=5)
+        assert e.value.code == EXIT_PREEMPTED
+
+    def test_handlers_restored_after_run(self, tmp_path):
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        m, tx, ty = fresh_model()
+        tr = make_trainer(m, str(tmp_path / "run"))
+        tr.run([(tx, ty)], num_steps=2)
+        assert signal.getsignal(signal.SIGTERM) is prev_term
+        assert signal.getsignal(signal.SIGINT) is prev_int
+
+
+class TestNanGuard:
+    def test_nan_step_skipped_and_scale_backs_off(self, tmp_path):
+        """An injected-NaN step must be a perfect no-op on every state
+        tensor (params AND momentum AND step counter) and must halve
+        the loss scale."""
+        m, tx, ty = fresh_model(init_scale=1024.0)
+        plan = FaultPlan().poison_batch(step=3)
+        tr = make_trainer(m, str(tmp_path / "run"),
+                          save_interval_steps=100, faults=plan,
+                          rollback_after=None)
+        snaps = {}
+
+        def cb(step, out):
+            snaps[step] = full_state(m)
+
+        summary = tr.run([(tx, ty)], num_steps=6, step_callback=cb)
+        assert summary["steps_run"] == 6
+        stats = m.optimizer.stats()
+        assert stats["skipped_total"] == 1
+        assert stats["loss_scale"] == 512.0     # one backoff from 1024
+        assert stats["bad_streak"] == 0         # recovered
+        # the poisoned step changed NOTHING (bar the guard's own
+        # bookkeeping — the scale backoff and streaks EXIST to move)
+        bookkeeping = ("opt/loss_scale", "opt/guard/bad_streak",
+                       "opt/guard/good_streak", "opt/guard/skipped_total",
+                       "opt/guard/last_grad_norm")
+        for k in snaps[2]:
+            if k in bookkeeping:
+                continue
+            np.testing.assert_array_equal(snaps[3][k], snaps[2][k],
+                                          err_msg=k)
+        # ...and training continued afterwards
+        assert any(not np.array_equal(snaps[4][k], snaps[3][k])
+                   for k in snaps[3])
+        # no NaN ever landed anywhere
+        for k, v in full_state(m).items():
+            assert np.all(np.isfinite(v)), k
+
+    def test_bn_running_stats_not_poisoned(self, tmp_path):
+        """Forward rebinds BN running stats from the batch BEFORE the
+        guard runs — the shadow tensors must restore them on a bad
+        step, or a single NaN batch poisons eval forever."""
+        class BNNet(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.c1 = layer.Conv2d(4, 3, padding=1)
+                self.bn = layer.BatchNorm2d()
+                self.relu = layer.ReLU()
+                self.fc = layer.Linear(4)
+                self.loss_fn = layer.SoftMaxCrossEntropy()
+
+            def forward(self, x):
+                from singa_tpu import autograd
+                return self.fc(autograd.flatten(
+                    self.relu(self.bn(self.c1(x)))))
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = self.loss_fn(out, y)
+                self.optimizer(loss)
+                return out, loss
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 3, 6, 6).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = BNNet()
+        m.set_optimizer(GuardedOptimizer(opt.SGD(lr=0.05, momentum=0.9)))
+        m.compile([tx], is_train=True, use_graph=True)
+
+        plan = FaultPlan().poison_batch(step=3)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan,
+                          rollback_after=None)
+        tr.run([(tx, ty)], num_steps=6)
+        assert m.optimizer.stats()["skipped_total"] == 1
+        states = m.get_states()
+        stats_keys = [k for k in states if "running" in k]
+        assert stats_keys, "expected BN running stats"
+        for k in stats_keys:
+            assert np.all(np.isfinite(np.asarray(states[k].data))), k
+        # eval-mode forward (uses running stats) stays finite
+        m.eval()
+        out = m(tx)
+        assert np.all(np.isfinite(np.asarray(out.data)))
+
+    def test_guard_works_through_compiled_step(self, tmp_path):
+        """The skip masking runs INSIDE the jit-compiled step: poison a
+        late step (well past compile) and params stay finite."""
+        m, tx, ty = fresh_model(init_scale=256.0)
+        plan = FaultPlan().poison_batch(step=5)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan,
+                          rollback_after=None)
+        tr.run([(tx, ty)], num_steps=7)
+        assert m.optimizer.stats()["skipped_total"] == 1
+        for k, v in full_state(m).items():
+            assert np.all(np.isfinite(v)), k
+
+    def test_loss_scale_state_rides_checkpoints(self, tmp_path):
+        """loss_scale + guard counters live with the optimizer and
+        round-trip through the checkpoint manager into a fresh
+        process."""
+        ck = str(tmp_path / "run")
+        m, tx, ty = fresh_model(init_scale=64.0)
+        plan = FaultPlan().poison_batch(step=2)
+        tr = make_trainer(m, ck, save_interval_steps=1, faults=plan,
+                          rollback_after=None)
+        tr.run([(tx, ty)], num_steps=4)
+        assert m.optimizer.stats()["loss_scale"] == 32.0
+
+        m2, tx2, ty2 = fresh_model(seed=99, init_scale=64.0)
+        tr2 = make_trainer(m2, ck)
+        tr2.run([(tx2, ty2)], num_steps=4)      # restore only
+        st = m2.optimizer.stats()
+        assert st["loss_scale"] == 32.0
+        assert st["skipped_total"] == 1
+
+
+class TestGuardedDistOpt:
+    def test_guard_over_distopt_skips_consistently(self, tmp_path):
+        """GuardedOptimizer wrapping a DistOpt: the badness verdict is
+        derived from all-reduced gradients, so every mesh shard skips
+        (or applies) the same step and replicated state cannot fork."""
+        import jax
+        from singa_tpu.parallel import mesh as mesh_mod
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(7)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 64)]
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = MLP()
+        d = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9))
+        d.communicator.mesh = mesh_mod.make_mesh(
+            jax.devices("cpu"), mesh_mod.MeshConfig())
+        m.set_optimizer(GuardedOptimizer(d, init_scale=256.0))
+        m.compile([tx], is_train=True, use_graph=True)
+        assert m._dist is d      # wrapper unwrapped for mesh plumbing
+
+        plan = FaultPlan().poison_batch(step=4)
+        tr = make_trainer(m, str(tmp_path / "run"),
+                          save_interval_steps=3, faults=plan,
+                          rollback_after=None)
+        summary = tr.run([(tx, ty)], num_steps=7)
+        assert summary["steps_run"] == 7
+        stats = m.optimizer.stats()
+        assert stats["skipped_total"] == 1
+        assert stats["loss_scale"] == 128.0
+        for k, v in full_state(m).items():
+            assert np.all(np.isfinite(v)), k
+
+
+    def test_guard_over_tensor_parallel_shards(self, tmp_path):
+        """Shard-excluded (tensor-parallel) params: each shard's grad
+        slice is distinct, so the grad-norm verdict psums their norm
+        contributions over the shard axes — every shard must reach the
+        same skip-vs-apply decision."""
+        import jax
+        from singa_tpu.parallel import mesh as mesh_mod
+        from singa_tpu.parallel import tensor_parallel as tp
+        from singa_tpu.parallel.communicator import set_mesh
+
+        class TPModel(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.mlp = tp.TPMLP(16, 4)
+                self.loss_fn = layer.SoftMaxCrossEntropy()
+
+            def forward(self, x):
+                return self.mlp(x)
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = self.loss_fn(out, y)
+                self.optimizer(loss)
+                return out, loss
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = TPModel()
+        d = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9))
+        msh = mesh_mod.make_mesh(jax.devices("cpu"),
+                                 mesh_mod.MeshConfig(model=2))
+        d.communicator.mesh = msh
+        set_mesh(msh)
+        try:
+            m.set_optimizer(GuardedOptimizer(d, init_scale=64.0))
+            m.compile([tx], is_train=True, use_graph=True)
+            plan = FaultPlan().poison_batch(step=3)
+            tr = make_trainer(m, str(tmp_path / "run"),
+                              save_interval_steps=2, faults=plan,
+                              rollback_after=None)
+            tr.run([(tx, ty)], num_steps=6)
+            stats = m.optimizer.stats()
+            assert stats["skipped_total"] == 1
+            assert stats["loss_scale"] == 32.0
+            for k, v in full_state(m).items():
+                assert np.all(np.isfinite(v)), k
+        finally:
+            set_mesh(None)
+
+
+class TestRollback:
+    def test_k_consecutive_bad_steps_roll_back(self, tmp_path):
+        """After K consecutive bad steps the trainer restores the last
+        good checkpoint and keeps going (with the guard streaks
+        reset)."""
+        m, tx, ty = fresh_model(init_scale=128.0)
+        plan = (FaultPlan().poison_batch(step=3).poison_batch(step=4)
+                .poison_batch(step=5))
+        tr = make_trainer(m, str(tmp_path / "run"),
+                          save_interval_steps=1, faults=plan,
+                          rollback_after=3)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            summary = tr.run([(tx, ty)], num_steps=8)
+        assert summary["rollbacks"] == 1
+        assert any("rolled back" in str(x.message) for x in w)
+        assert m.optimizer.bad_streak_value() == 0
+        for k, v in full_state(m).items():
+            assert np.all(np.isfinite(v)), k
+
+    def test_bad_steps_are_not_checkpointed(self, tmp_path):
+        """Checkpoints written during a bad streak would make rollback
+        restore the streak's own state — flagged-bad steps must not
+        save, so the newest checkpoint predates the streak."""
+        m, tx, ty = fresh_model(init_scale=64.0)
+        plan = FaultPlan().poison_batch(step=2).poison_batch(step=3)
+        tr = make_trainer(m, str(tmp_path / "run"),
+                          save_interval_steps=1, faults=plan,
+                          rollback_after=None)
+        saved = []
+        real_save = tr.mgr.save
+
+        def spy(step, model, **kw):
+            saved.append(step)
+            return real_save(step, model, **kw)
+
+        tr.mgr.save = spy
+        tr.run([(tx, ty)], num_steps=6)
+        assert 2 not in saved and 3 not in saved
+        assert {0, 1, 4, 5} <= set(saved)
+
+    def test_unbounded_divergence_raises(self, tmp_path):
+        """A model that NEVER produces a good step must not loop
+        forever: after max_rollbacks the trainer raises."""
+        m, tx, ty = fresh_model(init_scale=16.0)
+        plan = FaultPlan().poison_batch(step=0, times=1000)
+        for s in range(1, 40):
+            plan.poison_batch(step=s, times=1000)
+        tr = make_trainer(m, str(tmp_path / "run"),
+                          save_interval_steps=1, faults=plan,
+                          rollback_after=2, max_rollbacks=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(RuntimeError, match="diverged"):
+                tr.run([(tx, ty)], num_steps=40)
+
+
+class TestRestoreHardening:
+    def _train_and_snapshot(self, ck, steps=5):
+        m, tx, ty = fresh_model()
+        mgr = CheckpointManager(ck, max_to_keep=10, save_interval_steps=1)
+        snaps = {}
+        for s in range(steps):
+            m(tx, ty)
+            mgr.save(s, m)
+            mgr.wait()
+            snaps[s] = full_state(m)
+        mgr.close()
+        return snaps
+
+    @pytest.mark.parametrize("damage", [truncate_checkpoint,
+                                        corrupt_checkpoint])
+    def test_damaged_latest_falls_back_to_previous(self, tmp_path,
+                                                   damage):
+        ck = str(tmp_path / "run")
+        snaps = self._train_and_snapshot(ck)
+        assert damage(ck, 4) > 0
+        m2, tx2, ty2 = fresh_model(seed=99)
+        mgr = CheckpointManager(ck)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            start = mgr.restore_latest(m2)
+        mgr.close()
+        assert start == 4                     # resumed from step 3
+        msgs = [str(x.message) for x in w]
+        assert any("not restorable" in s for s in msgs)
+        assert any("skipping 1" in s for s in msgs)
+        got = full_state(m2)
+        for k in snaps[3]:
+            np.testing.assert_array_equal(got[k], snaps[3][k],
+                                          err_msg=k)
+
+    def test_fallback_deletes_wreckage_so_saves_resume(self, tmp_path):
+        """After falling back past a corrupt newest step, that step's
+        directory must be deleted and the manager rebuilt — otherwise
+        orbax still counts it as latest and silently refuses every
+        interval save of the re-run window."""
+        ck = str(tmp_path / "run")
+        self._train_and_snapshot(ck)
+        truncate_checkpoint(ck, 4)
+        m2, tx2, ty2 = fresh_model(seed=99)
+        mgr = CheckpointManager(ck, max_to_keep=10,
+                                save_interval_steps=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            start = mgr.restore_latest(m2)
+        assert start == 4
+        assert mgr.latest_step() == 3          # wreckage forgotten
+        assert not os.path.isdir(os.path.join(ck, "4"))
+        m2(tx2, ty2)
+        assert mgr.save(4, m2)                 # re-run step 4 persists
+        mgr.wait()
+        assert mgr.latest_step() == 4
+        mgr.close()
+
+    def test_all_checkpoints_damaged_starts_from_scratch(self, tmp_path):
+        ck = str(tmp_path / "run")
+        self._train_and_snapshot(ck, steps=3)
+        for s in range(3):
+            truncate_checkpoint(ck, s)
+        m2, _tx, _ty = fresh_model(seed=99)
+        before = full_state(m2)
+        mgr = CheckpointManager(ck)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            start = mgr.restore_latest(m2)
+        mgr.close()
+        assert start == 0
+        assert any("starting from scratch" in str(x.message) for x in w)
+        got = full_state(m2)
+        for k in before:        # nothing half-restored
+            np.testing.assert_array_equal(got[k], before[k], err_msg=k)
+        # the corrupt steps are cleared, so the from-scratch re-run's
+        # saves are not silently refused as step <= latest
+        mgr2 = CheckpointManager(ck, max_to_keep=10,
+                                 save_interval_steps=1)
+        try:
+            assert mgr2.latest_step() is None
+            assert mgr2.save(0, m2)
+            mgr2.wait()
+            assert mgr2.latest_step() == 0
+        finally:
+            mgr2.close()
+
+    def test_sweep_spares_user_files_in_checkpoint_dir(self, tmp_path):
+        """The wreckage sweep removes only orbax's own artifacts: a
+        user's '3.backup' or notes dir must survive manager init."""
+        ck = str(tmp_path / "run")
+        self._train_and_snapshot(ck, steps=2)
+        backup = os.path.join(ck, "1.backup")
+        notes = os.path.join(ck, "notes")
+        os.makedirs(backup)
+        os.makedirs(notes)
+        mgr = CheckpointManager(ck)
+        mgr.close()
+        assert os.path.isdir(backup)
+        assert os.path.isdir(notes)
+
+    def test_crash_mid_async_save_restartable(self, tmp_path):
+        """Dying between save dispatch and commit must leave the
+        directory restartable: the next trainer resumes from SOME
+        earlier committed step and completes."""
+        ck = str(tmp_path / "run")
+        m, tx, ty = fresh_model()
+        plan = FaultPlan().crash_after_save(step=3)
+        tr = make_trainer(m, ck, save_interval_steps=1, faults=plan)
+        with pytest.raises(SimulatedCrash):
+            tr.run([(tx, ty)], num_steps=8)
+
+        m2, tx2, ty2 = fresh_model(seed=99)
+        tr2 = make_trainer(m2, ck)
+        summary = tr2.run([(tx2, ty2)], num_steps=8)
+        assert 0 <= summary["start"] <= 4
+        assert summary["start"] + summary["steps_run"] == 8
+        for k, v in full_state(m2).items():
+            assert np.all(np.isfinite(v)), k
+
+
+class TestRetries:
+    def test_transient_step_failure_retried_with_backoff(self, tmp_path):
+        m, tx, ty = fresh_model()
+        plan = FaultPlan().fail_step(step=2, times=2)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan)
+        delays = []
+        tr._sleep = delays.append
+        summary = tr.run([(tx, ty)], num_steps=4)
+        assert summary["steps_run"] == 4
+        assert summary["step_retries"] == 2
+        assert len(delays) == 2 and delays[1] > delays[0]  # exponential
+
+    def test_step_failure_budget_exhausted_reraises(self, tmp_path):
+        m, tx, ty = fresh_model()
+        plan = FaultPlan().fail_step(step=1, times=10)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan,
+                          step_retries=2)
+        tr._sleep = lambda s: None
+        with pytest.raises(FaultInjected):
+            tr.run([(tx, ty)], num_steps=4)
+
+    def test_data_iterator_failure_retried(self, tmp_path):
+        m, tx, ty = fresh_model()
+        plan = FaultPlan().fail_data(step=2, times=2)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan)
+        delays = []
+        tr._sleep = delays.append
+        summary = tr.run([(tx, ty)], num_steps=4)
+        assert summary["steps_run"] == 4
+        assert summary["data_retries"] == 2
+        assert len(delays) == 2
+
+    def test_watchdog_uses_late_step_within_grace(self, tmp_path):
+        """A SLOW step (finishes inside the one-grace-period join) is
+        used as-is — its update already landed, so retrying it would
+        double-apply."""
+        m, tx, ty = fresh_model()
+        # warm the compile first so the hang attempt is the only slow op
+        warm = make_trainer(m, str(tmp_path / "warm"))
+        warm.run([(tx, ty)], num_steps=1)
+        plan = FaultPlan().hang_step(step=2, seconds=0.25)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan,
+                          step_timeout=0.2)
+        tr._sleep = lambda s: None
+        summary = tr.run([(tx, ty)], num_steps=4)
+        assert summary["steps_run"] == 4
+        assert summary["step_timeouts"] == 1
+        assert summary["step_retries"] == 0     # late result used, no rerun
+
+    def test_watchdog_truly_hung_step_is_fatal(self, tmp_path):
+        """A step still running after the grace period must NOT be
+        retried in-process (the zombie thread could land its update
+        concurrently with the retry) — it raises for the supervisor."""
+        m, tx, ty = fresh_model()
+        warm = make_trainer(m, str(tmp_path / "warm"))
+        warm.run([(tx, ty)], num_steps=1)
+        plan = FaultPlan().hang_step(step=2, seconds=2.0)
+        tr = make_trainer(m, str(tmp_path / "run"), faults=plan,
+                          step_timeout=0.05)
+        tr._sleep = lambda s: None
+        from singa_tpu.resilience import StepTimeoutError
+        with pytest.raises(StepTimeoutError, match="supervisor"):
+            tr.run([(tx, ty)], num_steps=4)
+
+    def test_retrying_iterator_rebuilds_factory_source(self):
+        from singa_tpu.data import RetryingIterator
+        calls = {"n": 0}
+
+        def factory():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                def boom():
+                    yield 1
+                    raise OSError("worker died")
+                return boom()
+            return iter([2, 3])
+
+        it = RetryingIterator(factory, backoff_base=0.0001,
+                              sleep=lambda s: None)
+        assert list(it) == [1, 2, 3]
+        assert it.retries == 1
+        assert calls["n"] == 2
+
+    def test_retrying_iterator_exhausts_budget(self):
+        from singa_tpu.data import RetryingIterator
+
+        def always_bad():
+            raise OSError("dead")
+            yield  # pragma: no cover
+
+        it = RetryingIterator(always_bad, max_retries=2,
+                              backoff_base=0.0001, sleep=lambda s: None)
+        with pytest.raises(OSError):
+            next(it)
+        assert it.retries == 2
+
+    def test_retrying_iterator_passes_stopiteration(self):
+        from singa_tpu.data import RetryingIterator
+        assert list(RetryingIterator(iter([1, 2]))) == [1, 2]
+
+    def test_retrying_iterator_no_silent_truncation_on_generator(self):
+        """A non-factory generator that raises is CLOSED: the retry's
+        StopIteration must surface the original error, not end the
+        stream early as if it were exhausted."""
+        from singa_tpu.data import RetryingIterator
+
+        def gen():
+            yield 1
+            raise OSError("disk hiccup")
+
+        it = RetryingIterator(gen(), backoff_base=0.0001,
+                              sleep=lambda s: None)
+        assert next(it) == 1
+        with pytest.raises(OSError, match="disk hiccup"):
+            next(it)
+
+
+class TestEpochWrap:
+    def test_finite_iterable_wraps_epochs(self, tmp_path):
+        m, tx, ty = fresh_model()
+        tr = make_trainer(m, str(tmp_path / "run"))
+        summary = tr.run([(tx, ty), (tx, ty)], num_steps=5)
+        assert summary["steps_run"] == 5
+
+    def test_one_shot_generator_running_dry_names_the_cause(
+            self, tmp_path):
+        """A finite generator cannot be rewound: running dry must raise
+        an error naming the one-shot-generator problem, not the false
+        'yielded no batches'."""
+        m, tx, ty = fresh_model()
+        tr = make_trainer(m, str(tmp_path / "run"))
+        gen = ((tx, ty) for _ in range(2))
+        with pytest.raises(RuntimeError, match="one-shot generator"):
+            tr.run(gen, num_steps=5)
+
+    def test_generator_transient_error_surfaces_not_masked(
+            self, tmp_path):
+        """A generator source that raises is CLOSED; the retry's
+        StopIteration must re-raise the ORIGINAL error, not blame a
+        one-shot generator (and not silently burn the retry budget)."""
+        m, tx, ty = fresh_model()
+        tr = make_trainer(m, str(tmp_path / "run"))
+        tr._sleep = lambda s: None
+
+        def flaky():
+            yield (tx, ty)
+            yield (tx, ty)
+            raise OSError("augmentation read failed")
+
+        with pytest.raises(OSError, match="augmentation read failed"):
+            tr.run(flaky(), num_steps=5)
+
+
+@pytest.mark.slow
+class TestPreemptionSubprocess:
+    def test_real_process_exit_code(self, tmp_path):
+        """The full supervisor contract in a real process: SIGTERM ->
+        the process exits EXIT_PREEMPTED; a second invocation resumes
+        and completes with exit 0."""
+        import subprocess
+        import sys
+        script = str(tmp_path / "job.py")
+        with open(script, "w") as f:
+            f.write(f"""
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import numpy as np
+from singa_tpu import device, layer, model, opt
+from singa_tpu.tensor import Tensor
+from singa_tpu.resilience import FaultPlan, GuardedOptimizer, ResilientTrainer
+
+class MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16); self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+    def train_one_batch(self, x, y):
+        out = self.forward(x); loss = self.loss_fn(out, y)
+        self.optimizer(loss); return out, loss
+
+dev = device.create_cpu_device(); dev.SetRandSeed(7)
+rng = np.random.RandomState(0)
+tx = Tensor(data=rng.randn(8, 8).astype(np.float32), device=dev,
+            requires_grad=False)
+ty = Tensor(data=np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)],
+            device=dev, requires_grad=False)
+m = MLP(); m.set_optimizer(GuardedOptimizer(opt.SGD(lr=0.1)))
+m.compile([tx], is_train=True, use_graph=True)
+plan = FaultPlan()
+if sys.argv[1] == "preempt":
+    plan.preempt_at(step=2)
+tr = ResilientTrainer(m, {str(tmp_path / "ck")!r}, save_interval_steps=1,
+                      faults=plan, verbose=False)
+summary = tr.run([(tx, ty)], num_steps=5)
+print("START", summary["start"])
+""")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p1 = subprocess.run([sys.executable, script, "preempt"],
+                            capture_output=True, text=True, timeout=300,
+                            env=env)
+        assert p1.returncode == EXIT_PREEMPTED, p1.stderr[-2000:]
+        p2 = subprocess.run([sys.executable, script, "resume"],
+                            capture_output=True, text=True, timeout=300,
+                            env=env)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        assert "START 3" in p2.stdout
