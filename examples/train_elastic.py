@@ -1,57 +1,51 @@
-"""Fault-tolerant (checkpoint-restart) training loop.
+"""Elastic multi-host training: cluster health + two-phase checkpoints +
+world-size-elastic resume.
 
-The reference's failure handling is fail-fast only: NCCL/MPI errors
-print and exit (include/singa/io/communicator.h:40-67), with no resume.
-This example exceeds that cheaply with the rotated async checkpoint
-manager: every run resumes from the newest checkpoint, so a crashed or
-preempted job continues exactly where it stopped (optimizer momentum
-included — the trajectory is identical to an uninterrupted run).
+The reference's failure handling is print-and-exit
+(include/singa/io/communicator.h:40-67). This example runs the full
+elastic contract instead:
 
-This is the MINIMAL form — the raw CheckpointManager loop. The full
-production driver (preemption signal handling with a supervisor
-exit-code contract, NaN/divergence guards, transient-failure retry,
-corrupt-checkpoint fallback) lives in ``singa_tpu/resilience``; see
-``examples/train_cnn.py --resilient`` and the README's Fault tolerance
-section.
+- every rank joins a control-plane cluster (heartbeats, failing-fast
+  barriers — ``singa_tpu/resilience/cluster.py``);
+- checkpoints are TWO-PHASE: each rank writes its shard, ACKs, and only
+  after every ACK does the coordinator publish the commit marker — a
+  rank that dies mid-save can never leave a checkpoint that only looks
+  committed;
+- a lost rank exits the survivors with code 75 (the supervisor
+  contract); relaunching with a SMALLER ``--world`` resumes from the
+  last *committed* step, optimizer momentum included, with the batch
+  accounting rescaled from the manifest (per-replica batch kept).
 
-Try it:
+Try it (single host — world of one, same code path)::
+
     python examples/train_elastic.py --cpu --steps 40 --crash-at 17
-    python examples/train_elastic.py --cpu --steps 40
-    # resumes at 16: the newest committed checkpoint is step 15
-    # (--save-every 5), and resume = latest saved step + 1
+    python examples/train_elastic.py --cpu --steps 40      # resumes
 
-Usage: python examples/train_elastic.py [--dir ckpts] [--steps 100]
-           [--save-every 5] [--keep 3] [--bs 32] [--lr 0.1]
-           [--crash-at -1] [--cpu]
+Two hosts, then lose one and restart smaller::
+
+    python examples/train_elastic.py --cpu --world 2 --steps 40 \
+        --die-at 11 --die-rank 1            # rank 1 hard-dies at step 11
+    # survivors exit 75; restart at the surviving size:
+    python examples/train_elastic.py --cpu --world 1 --steps 40
+
+``tools/chaos_smoke.py`` drives these scenarios end-to-end under a
+wall-clock budget.
 """
 
 import argparse
+import json
+import os
+import subprocess
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--dir", default="ckpts")
-    ap.add_argument("--steps", type=int, default=100)
-    ap.add_argument("--save-every", type=int, default=5)
-    ap.add_argument("--keep", type=int, default=3)
-    ap.add_argument("--bs", type=int, default=32)
-    ap.add_argument("--lr", type=float, default=0.1)
-    ap.add_argument("--crash-at", type=int, default=-1,
-                    help="simulate a failure after this step")
-    ap.add_argument("--cpu", action="store_true")
-    args = ap.parse_args()
-
-    if args.cpu:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-
-    from singa_tpu import device, layer, model, opt, tensor
-    from singa_tpu.checkpoint import CheckpointManager
+def build_model(lr):
+    from singa_tpu import layer, model, opt
 
     class MLP(model.Model):
         def __init__(self):
@@ -70,40 +64,178 @@ def main():
             self.optimizer(loss)
             return out, loss
 
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+    return m
+
+
+def dump_state(model, path):
+    """Host-copy every model + optimizer state to one npz — the
+    bit-identity probe the chaos suite compares across restarts."""
+    states = {f"model/{k}": np.asarray(getattr(v, "data", v))
+              for k, v in model.get_states().items()}
+    for k, v in model.optimizer.get_states().items():
+        states[f"optimizer/{k}"] = np.asarray(getattr(v, "data", v))
+    np.savez(path, **states)
+
+
+def run_rank(args):
+    from singa_tpu import device, tensor
+    from singa_tpu.checkpoint import latest_manifest
+    from singa_tpu.data import NumpyBatchIter
+    from singa_tpu.parallel import communicator, mesh as mesh_mod
+    from singa_tpu.resilience import ClusterConfig, FaultPlan, make_cluster
+    from singa_tpu.resilience.runtime import ResilientTrainer
+
+    # -- elastic accounting: manifest first, shapes second ---------------
+    manifest = latest_manifest(args.dir)
+    per_bs, global_bs = args.bs, args.bs * args.world
+    if manifest is not None:
+        per, gb = communicator.rescale_batch(manifest, args.world)
+        if per is not None:
+            per_bs, global_bs = per, gb
+        if int(manifest.get("world", args.world)) != args.world:
+            print(f"rank {args.rank}: elastic restart — checkpoint world "
+                  f"{manifest.get('world')} -> {args.world}, global "
+                  f"batch {manifest.get('global_batch')} -> {global_bs}",
+                  flush=True)
+
+    # the data axis absorbs any device-count change; axis NAMES stay
+    # fixed so checkpointed shardings re-land on the new degrees. The
+    # CLUSTER world change is reported above — elastic_mesh's
+    # saved_world compares per-process DEVICE degrees, a different
+    # quantity (1 per process here), so it is not passed.
+    mesh = mesh_mod.elastic_mesh()
+    communicator.set_mesh(mesh)
+
+    faults = FaultPlan()
+    if args.die_at >= 0 and args.rank == args.die_rank:
+        faults.kill_rank(args.die_at)
+    if args.kill_before_ack >= 0 and args.rank == args.die_rank:
+        faults.kill_before_ack(args.kill_before_ack)
+
+    cluster = make_cluster(
+        args.rank, args.world, args.coordinator,
+        ClusterConfig(heartbeat_interval=args.hb_interval,
+                      straggler_after=3 * args.hb_interval,
+                      dead_after=args.dead_after),
+        faults=faults)
+
     dev = device.create_cpu_device() if args.cpu \
         else device.create_tpu_device()
     dev.SetRandSeed(0)
     rng = np.random.RandomState(0)
-    x = rng.randn(args.bs, 32).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, args.bs)]
-    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
-    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    n = max(global_bs * 4, 64)
+    x = rng.randn(n, 32).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    tx = tensor.Tensor(data=x[:global_bs], device=dev,
+                       requires_grad=False)
 
-    m = MLP()
-    m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+    m = build_model(args.lr)
     m.compile([tx], is_train=True, use_graph=True)
 
-    mgr = CheckpointManager(args.dir, max_to_keep=args.keep,
-                            save_interval_steps=args.save_every)
+    trainer = ResilientTrainer(
+        m, args.dir, max_to_keep=args.keep,
+        save_interval_steps=args.save_every, cluster=cluster,
+        faults=faults, commit_timeout=args.commit_timeout,
+        start_barrier_timeout=args.start_timeout,
+        manifest_extra={"per_replica_batch": per_bs,
+                        "global_batch": global_bs})
+
+    if args.dump_restored:
+        # bit-identity probe: what does the last COMMITTED checkpoint
+        # restore to? (run() restores again itself — deterministic)
+        start = trainer.mgr.restore_latest(m)
+        dump_state(m, args.dump_restored)
+        print(f"rank {args.rank}: dumped restored state of step "
+              f"{start - 1} to {args.dump_restored}", flush=True)
+
+    def on_step(step, out):
+        if args.dump_on_save and trainer.mgr.latest_step() == step:
+            dump_state(m, os.path.join(args.dump_on_save,
+                                       f"state_step{step}.npz"))
+        if step == args.crash_at:
+            trainer.mgr.wait()
+            print(f"simulated crash at step {step}", flush=True)
+            sys.exit(42)
+
+    batches = NumpyBatchIter(x, y, batch_size=global_bs, seed=0)
     try:
-        start = mgr.restore_latest(m)
-        if start:
-            print(f"resumed from checkpoint; continuing at step {start}",
-                  flush=True)
-        for step in range(start, args.steps):
-            out, loss = m(tx, ty)
-            mgr.save(step, m)
-            if step % 10 == 0 or step == args.steps - 1:
-                print(f"step {step}: loss {float(loss.data):.4f}",
-                      flush=True)
-            if step == args.crash_at:
-                mgr.wait()
-                print(f"simulated crash at step {step}", flush=True)
-                sys.exit(42)
-        mgr.wait()
-        print("training complete", flush=True)
+        summary = trainer.run(batches, num_steps=args.steps,
+                              step_callback=on_step)
     finally:
-        mgr.close()
+        cluster.close()
+    print(f"rank {args.rank}: summary "
+          f"{json.dumps({k: v for k, v in summary.items() if k != 'cluster'})}",
+          flush=True)
+    print("training complete", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="ckpts")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--save-every", type=int, default=5)
+    ap.add_argument("--keep", type=int, default=3)
+    ap.add_argument("--bs", type=int, default=32,
+                    help="PER-REPLICA batch size (the elastic invariant)")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--world", type=int, default=1)
+    ap.add_argument("--rank", type=int, default=None,
+                    help="this process's rank; omit to spawn all ranks")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank 0's cluster listener")
+    ap.add_argument("--hb-interval", type=float, default=0.25)
+    ap.add_argument("--dead-after", type=float, default=2.5)
+    ap.add_argument("--commit-timeout", type=float, default=30.0)
+    ap.add_argument("--start-timeout", type=float, default=30.0)
+    ap.add_argument("--crash-at", type=int, default=-1,
+                    help="soft crash (exit 42) after this step commits")
+    ap.add_argument("--die-at", type=int, default=-1,
+                    help="hard-kill --die-rank just before this step")
+    ap.add_argument("--die-rank", type=int, default=1)
+    ap.add_argument("--kill-before-ack", type=int, default=-1,
+                    help="hard-kill --die-rank after this step's shard "
+                         "is written but before its commit ACK")
+    ap.add_argument("--dump-on-save", default="",
+                    help="dir for per-committed-step state npz dumps")
+    ap.add_argument("--dump-restored", default="",
+                    help="npz path for the state right after restore")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.world > 1 and args.coordinator is None:
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        args.coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+
+    if args.rank is not None or args.world <= 1:
+        args.rank = args.rank or 0
+        run_rank(args)
+        return
+
+    # launcher mode: one subprocess per rank; exit code is rank 0's
+    # (the supervisor contract — 75 means "restart me, maybe smaller")
+    procs = []
+    for r in range(args.world):
+        cmd = [sys.executable, os.path.abspath(__file__), "--rank",
+               str(r)]
+        for k, v in vars(args).items():
+            if k == "rank" or isinstance(v, bool) or v is None:
+                continue
+            cmd += [f"--{k.replace('_', '-')}", str(v)]
+        if args.cpu:
+            cmd.append("--cpu")
+        procs.append(subprocess.Popen(cmd))
+    rcs = [p.wait() for p in procs]
+    print(f"launcher: rank exit codes {rcs}", flush=True)
+    sys.exit(rcs[0])
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ different mesh).
 
 from __future__ import annotations
 
+import json
 import os
 import warnings
 
@@ -163,14 +164,20 @@ class CheckpointManager:
         mgr.wait(); mgr.close()
     """
 
-    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1,
+                 sweep=True):
+        """``sweep=False`` skips the uncommitted-wreckage sweep at init —
+        for READ-ONLY managers opened on a directory another rank owns
+        (the elastic cross-rank restore path must never delete a live
+        writer's in-flight step)."""
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self._dir = os.path.abspath(str(directory))
         self._max_to_keep = max_to_keep
         self._save_interval_steps = save_interval_steps
         self._mgr = self._make_mgr()
-        self._sweep_uncommitted()
+        if sweep:
+            self._sweep_uncommitted()
 
     def _make_mgr(self):
         ocp = self._ocp
@@ -300,3 +307,296 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+def latest_manifest(directory):
+    """Peek the newest commit marker's manifest under a
+    :class:`DistributedCheckpointManager` root WITHOUT constructing a
+    manager — restarted launchers read this before building anything
+    (the manifest's saved world size + batch extras decide the new
+    run's batch shapes, which must exist before the model compiles).
+    Returns None when no committed checkpoint exists."""
+    cdir = os.path.join(os.path.abspath(str(directory)), "commits")
+    try:
+        names = os.listdir(cdir)
+    except OSError:
+        return None
+    steps = sorted(int(n[:-5]) for n in names
+                   if n.endswith(".json") and n[:-5].isdigit())
+    for s in reversed(steps):
+        try:
+            with open(os.path.join(cdir, f"{s}.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+class DistributedCheckpointManager(CheckpointManager):
+    """Two-phase-commit checkpoints for a multi-host run.
+
+    A host that dies mid-save must never leave a checkpoint that only
+    *looks* committed. Layout under ``directory``::
+
+        rank0/<step>/...      each rank's shard, via its own rotated
+        rank1/<step>/...      orbax manager (single writer per dir)
+        commits/<step>.json   the CLUSTER commit marker + manifest
+
+    Phase 1: every rank writes its shard and waits the bytes down, then
+    ACKs the step to the coordinator (``cluster.ack_save``). Phase 2:
+    only after ALL ranks acked does the coordinator atomically publish
+    ``commits/<step>.json`` (the registered commit hook) and broadcast
+    the decision. A rank killed between shard-write and ACK leaves a
+    step with no marker: ``restore_latest`` treats such step dirs as
+    uncommitted wreckage — swept, never restored — reusing the
+    backward-scan machinery of the base class.
+
+    The marker doubles as the **elastic manifest**: it records the world
+    size (and the caller's batch-accounting extras), so a run restarted
+    at a *different* world size M deterministically re-shards: each new
+    rank reads shard ``rank % N`` of the old world N (full-shape arrays
+    re-land onto the CURRENT mesh via the live-sharding restore
+    template), and the batch accounting rescales from the manifest
+    (``parallel.communicator.rescale_batch``).
+
+    This per-rank-directory scheme matches the control-plane-coordinated
+    deployment (each process holds its full replica / addressable
+    shards). Under ``jax.distributed`` with globally-addressed arrays,
+    orbax's save is itself collective and all ranks share one directory
+    — the two-phase marker protocol above still applies unchanged.
+    """
+
+    def __init__(self, directory, cluster, max_to_keep=3,
+                 save_interval_steps=1, commit_timeout=60.0,
+                 manifest_extra=None):
+        self.cluster = cluster
+        self._root = os.path.abspath(str(directory))
+        self._commit_dir = os.path.join(self._root, "commits")
+        os.makedirs(self._commit_dir, exist_ok=True)
+        self._commit_timeout = float(commit_timeout)
+        self.manifest_extra = dict(manifest_extra or {})
+        self.restored_manifest = None
+        if cluster.rank == 0:
+            cluster.set_commit_hook(self._publish_commit)
+        super().__init__(os.path.join(self._root, f"rank{cluster.rank}"),
+                         max_to_keep=max_to_keep,
+                         save_interval_steps=save_interval_steps)
+
+    # -- commit markers ----------------------------------------------------
+    def _marker(self, step):
+        return os.path.join(self._commit_dir, f"{int(step)}.json")
+
+    def committed_steps(self):
+        """Steps with a published cluster commit marker."""
+        try:
+            names = os.listdir(self._commit_dir)
+        except OSError:
+            return []
+        return sorted(int(n[:-5]) for n in names
+                      if n.endswith(".json") and n[:-5].isdigit())
+
+    def read_manifest(self, step):
+        with open(self._marker(step)) as f:
+            return json.load(f)
+
+    def _publish_commit(self, step):
+        """Coordinator-only (runs as the cluster's commit hook, after
+        every rank's ACK): atomic tmp-write + rename, so a marker either
+        fully exists or not at all — no torn marker can ever pass for a
+        commit."""
+        manifest = {"step": int(step), "world": int(self.cluster.world)}
+        manifest.update(self.manifest_extra)
+        tmp = os.path.join(self._commit_dir, f".tmp-{int(step)}.json")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._marker(step))
+        # markers follow the shard rotation window: a marker whose
+        # shards max_to_keep already rotated away is dead weight. Only
+        # markers AT OR BELOW the step just published are candidates —
+        # a stale higher-numbered marker (left by a resume that fell
+        # back) must not make this fresh marker count as the oldest and
+        # get pruned the moment it lands; stale-newer markers are
+        # cleared by invalidate_markers_from once the cluster agrees on
+        # a resume point
+        committed = self.committed_steps()
+        kept = [s for s in committed if s <= int(step)]
+        kept = set(kept[-self._max_to_keep:])
+        for old in committed:
+            if old <= int(step) and old not in kept:
+                try:
+                    os.remove(self._marker(old))
+                except OSError:
+                    pass
+
+    def invalidate_markers_from(self, step):
+        """Remove commit markers at/after ``step`` — coordinator-only,
+        and ONLY once the cluster has AGREED to resume at ``step`` (the
+        trainer's resume barrier): agreement proves no rank restored
+        past it, so those markers vouch for a timeline about to be
+        re-run, where a rank killed pre-ACK would otherwise find a
+        stale marker vouching for its never-acked shard. This is the
+        cluster-consistent counterpart of what a lone rank must never
+        do (its local restore failures say nothing about its peers'
+        shards). Returns the number of markers removed."""
+        if self.cluster.rank != 0:
+            return 0
+        removed = 0
+        for s in self.committed_steps():
+            if s >= int(step):
+                try:
+                    os.remove(self._marker(s))
+                    removed += 1
+                except OSError:
+                    pass
+        if removed:
+            warnings.warn(
+                f"invalidated {removed} stale commit marker(s) at/after "
+                f"the agreed resume step {step} (their timeline is "
+                "about to be re-run)", stacklevel=2)
+        return removed
+
+    # -- two-phase save ----------------------------------------------------
+    def save(self, step, model, force=False, commit_timeout=None):
+        """Write this rank's shard, ACK, and wait for the cluster commit.
+        Returns True only when the step COMMITTED (marker published).
+        The underlying write is awaited before the ACK — an ACK is a
+        durability promise, not an intention. ``commit_timeout``
+        overrides the manager default for THIS save (the preemption
+        path uses a short one: a forced off-schedule save can only
+        quorum when every rank was preempted at the same boundary, and
+        a doomed wait must not eat the kill grace)."""
+        saved = super().save(step, model, force=force)
+        if not saved:
+            return False
+        self.wait()                       # bytes down BEFORE the ack
+        self.cluster.ack_save(step)       # fault hook: kill_before_ack
+        timeout = self._commit_timeout if commit_timeout is None \
+            else float(commit_timeout)
+        ok = self.cluster.wait_commit(step, timeout=timeout)
+        if not ok:
+            warnings.warn(
+                f"checkpoint step {step}: commit did not complete within "
+                f"{timeout:.0f}s (a rank died before its ACK"
+                "?); the step stays uncommitted and restore will refuse "
+                "it", stacklevel=2)
+        return ok
+
+    # -- elastic restore ---------------------------------------------------
+    def _source_ranks(self, manifest):
+        """Deterministic shard-source order for this rank: our own (or
+        wrapped, when the world grew) shard first, then every other
+        rank of the SAVED world. In this per-rank-directory deployment
+        each rank's shard is a full replica, so a rank whose own shard
+        is corrupt restores a peer's copy of the SAME step instead of
+        silently diverging to an older one."""
+        saved_world = max(1, int(manifest.get("world",
+                                              self.cluster.world)))
+        primary = self.cluster.rank % saved_world
+        return [primary] + [r for r in range(saved_world)
+                            if r != primary]
+
+    def _restore_foreign(self, src_rank, step, model):
+        """Restore from another rank's shard directory (read-only: no
+        wreckage sweep — that dir may belong to a live writer)."""
+        src = CheckpointManager(
+            os.path.join(self._root, f"rank{src_rank}"),
+            max_to_keep=self._max_to_keep,
+            save_interval_steps=self._save_interval_steps, sweep=False)
+        try:
+            src._restore_step(step, model)
+        finally:
+            src.close()
+
+    def restore_latest(self, model):
+        """Restore the newest CLUSTER-COMMITTED checkpoint and return
+        the next step to run (0 when none exists). Local step dirs
+        without a commit marker are wreckage from a writer that died in
+        the commit hole — swept, exactly like the base class sweeps
+        orbax-uncommitted dirs. On success ``self.restored_manifest``
+        carries the marker's manifest (saved world size + batch extras)
+        for the elastic-resume accounting."""
+        import shutil
+        self.restored_manifest = None
+        committed = self.committed_steps()
+        committed_set = set(committed)
+        local = set(self._mgr.all_steps())
+        wreck = sorted(s for s in local if s not in committed_set)
+        if wreck:
+            warnings.warn(
+                f"sweeping {len(wreck)} locally-saved but cluster-"
+                f"uncommitted checkpoint step(s) {wreck} (a rank died "
+                "between shard-write and ACK)", stacklevel=2)
+            for s in wreck:
+                shutil.rmtree(os.path.join(self._dir, str(s)),
+                              ignore_errors=True)
+            self._mgr.close()
+            self._mgr = self._make_mgr()
+            local -= set(wreck)
+        for i, step in enumerate(reversed(committed)):
+            restored = False
+            try:
+                manifest = self.read_manifest(step)
+            except (OSError, ValueError):
+                continue                       # torn marker: not ours
+            for src in self._source_ranks(manifest):
+                try:
+                    if src == self.cluster.rank and step in local:
+                        self._restore_step(step, model)
+                    else:
+                        self._restore_foreign(src, step, model)
+                    restored = True
+                    break
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    warnings.warn(
+                        f"committed checkpoint step {step}: rank "
+                        f"{src}'s shard is not restorable on rank "
+                        f"{self.cluster.rank} ({type(e).__name__}: {e})"
+                        "; trying the next source", stacklevel=2)
+            if not restored:
+                warnings.warn(
+                    f"committed checkpoint step {step} is not "
+                    f"restorable from any rank's shard; falling back "
+                    "to the previous step", stacklevel=2)
+                continue
+            if i:
+                # clear OUR newer (locally corrupt) shards so orbax's
+                # should_save does not refuse the re-run window; the
+                # markers stay — other ranks' shards may be intact
+                newer = [s for s in local if s > step]
+                for s in newer:
+                    shutil.rmtree(os.path.join(self._dir, str(s)),
+                                  ignore_errors=True)
+                if newer:
+                    self._mgr.close()
+                    self._mgr = self._make_mgr()
+            self.restored_manifest = manifest
+            if int(manifest.get("world", self.cluster.world)) != \
+                    self.cluster.world:
+                warnings.warn(
+                    f"elastic resume: checkpoint step {step} was saved "
+                    f"at world size {manifest.get('world')}, restoring "
+                    f"into world size {self.cluster.world} (state "
+                    "re-sharded onto the current mesh)", stacklevel=2)
+            return step + 1
+        if committed:
+            warnings.warn(
+                f"none of the {len(committed)} committed checkpoints "
+                "are restorable on this rank; starting from scratch",
+                stacklevel=2)
+            for s in local:
+                shutil.rmtree(os.path.join(self._dir, str(s)),
+                              ignore_errors=True)
+            # the shared commit markers are deliberately LEFT in place:
+            # this branch only proves the steps unreadable on THIS rank
+            # (possibly a transient IO error), and deleting markers
+            # would destroy checkpoints peers can still restore. Ranks
+            # that disagree about the resume step fail loudly at the
+            # trainer's resume barrier; markers whose shards rotate
+            # away are pruned by _publish_commit.
+            self._mgr.close()
+            self._mgr = self._make_mgr()
+        return 0

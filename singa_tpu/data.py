@@ -138,6 +138,11 @@ class RetryingIterator:
     StopIteration, and passing that through would silently truncate the
     stream; the original error is re-raised instead.
 
+    A factory-backed RetryingIterator is also RE-ITERABLE: calling
+    ``iter()`` on an exhausted one rebuilds a fresh epoch from the
+    factory, so it drops straight into ResilientTrainer's epoch-wrap
+    (and the trainer's run summary surfaces ``counters()``).
+
         for batch in RetryingIterator(lambda: ImageBatchIter(...)):
             ...
     """
@@ -152,10 +157,15 @@ class RetryingIterator:
         self.backoff_base = float(backoff_base)
         self.backoff_cap = float(backoff_cap)
         self.jitter = float(jitter)
-        self.retries = 0            # total retried failures (observability)
+        # observability: surfaced in the ResilientTrainer run summary
+        # (data-pipeline flakiness must be visible, not silent)
+        self.attempts = 0           # total fetch attempts, incl. retries
+        self.retries = 0            # failed attempts that were retried
+        self.rebuilds = 0           # factory-source rebuilds after failure
         self._rng = random.Random(seed)
         self._sleep = sleep if sleep is not None else time.sleep
         self._it = None
+        self._exhausted = False
 
     def _iterator(self):
         if self._it is None:
@@ -165,13 +175,27 @@ class RetryingIterator:
         return self._it
 
     def __iter__(self):
+        # epoch wrap for factory sources: a fresh iterator per epoch
+        # (a plain-iterable source keeps passthrough exhaustion)
+        if self._factory is not None and self._exhausted:
+            self._it = None
+            self._exhausted = False
         return self
+
+    def counters(self) -> dict:
+        """Flakiness counters: ``attempts`` (every fetch attempt,
+        retries included), ``retries`` (attempts that failed and were
+        retried), ``rebuilds`` (factory-source rebuilds). The
+        ResilientTrainer run summary embeds this dict."""
+        return {"attempts": self.attempts, "retries": self.retries,
+                "rebuilds": self.rebuilds}
 
     def __next__(self):
         attempt = 0
         failed = None
         while True:
             try:
+                self.attempts += 1
                 item = next(self._iterator())
             except StopIteration:
                 if failed is not None:
@@ -180,6 +204,7 @@ class RetryingIterator:
                     # (resilience.runtime._next_batch applies the same
                     # rule around its epoch-wrap; keep them in sync)
                     raise failed from None
+                self._exhausted = True
                 raise
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -193,6 +218,7 @@ class RetryingIterator:
                 attempt += 1
                 if self._factory is not None:
                     self._it = None     # rebuild a (likely dead) source
+                    self.rebuilds += 1
                 else:
                     failed = e
             else:

@@ -165,33 +165,53 @@ class EndPoint:
         The native wait runs in SHORT slices with the net guard released
         between them, so ``NetworkThread.close()`` is never blocked for a
         caller-chosen recv timeout, and one endpoint's long recv does not
-        serialize the whole Net against close."""
+        serialize the whole Net against close.
+
+        A ``NetworkThread.close()`` racing an ALREADY-pending recv —
+        including one still waiting on the per-endpoint lock behind a
+        concurrent receiver — makes that recv return ``None`` (the
+        clean "nothing arrived" shape its caller must handle anyway);
+        only a recv STARTED after close — a programming error — raises
+        ``ConnectionError``."""
         import time as _time
         deadline = _time.monotonic() + max(0.0, timeout)
+        with self._net._cond:
+            was_open = self._net._h is not None
         with self._recv_lock:
             while True:
                 remaining = deadline - _time.monotonic()
                 slice_ms = int(min(max(remaining, 0.0), 0.2) * 1000)
-                with self._net._guard() as h:
-                    ms = ctypes.c_uint64()
-                    ps = ctypes.c_uint64()
-                    rc = _load().sg_ep_recv_wait(
-                        h, self._h, slice_ms,
-                        ctypes.byref(ms), ctypes.byref(ps))
-                    if rc < 0:
-                        raise ConnectionError("endpoint closed")
-                    if rc > 0:
-                        meta = ctypes.create_string_buffer(
-                            max(1, ms.value))
-                        payload = ctypes.create_string_buffer(
-                            max(1, ps.value))
-                        rc2 = _load().sg_ep_recv_copy(
-                            h, self._h, meta, ms.value, payload, ps.value)
-                        if rc2 < 0:
-                            # closed between the wait and the copy
+                try:
+                    with self._net._guard() as h:
+                        ms = ctypes.c_uint64()
+                        ps = ctypes.c_uint64()
+                        rc = _load().sg_ep_recv_wait(
+                            h, self._h, slice_ms,
+                            ctypes.byref(ms), ctypes.byref(ps))
+                        if rc < 0:
                             raise ConnectionError("endpoint closed")
-                        return Message(meta.raw[:ms.value],
-                                       payload.raw[:ps.value])
+                        if rc > 0:
+                            meta = ctypes.create_string_buffer(
+                                max(1, ms.value))
+                            payload = ctypes.create_string_buffer(
+                                max(1, ps.value))
+                            rc2 = _load().sg_ep_recv_copy(
+                                h, self._h, meta, ms.value, payload,
+                                ps.value)
+                            if rc2 < 0:
+                                # closed between the wait and the copy
+                                raise ConnectionError("endpoint closed")
+                            return Message(meta.raw[:ms.value],
+                                           payload.raw[:ps.value])
+                except ConnectionError:
+                    # our own Net closed under a pending recv -> clean
+                    # None; a peer-dead endpoint (Net still up) or a
+                    # recv started after close still raises
+                    with self._net._cond:
+                        closed_now = self._net._h is None
+                    if was_open and closed_now:
+                        return None
+                    raise
                 if remaining <= 0:
                     return None
 

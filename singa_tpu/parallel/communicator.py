@@ -126,6 +126,31 @@ def init_process(nccl_id: NcclIdHolder | None = None, rank: int = 0,
             num_processes=world, process_id=rank)
 
 
+def rescale_batch(manifest, new_world):
+    """Data-parallel batch accounting across an elastic restart.
+
+    A checkpoint's manifest (``DistributedCheckpointManager`` commit
+    marker) records the world size it was saved at plus, when the
+    caller provided them, ``per_replica_batch`` / ``global_batch``. On
+    resume at a different world size the invariant kept is the
+    PER-REPLICA batch — each surviving host keeps its compiled step and
+    its memory footprint — so the global batch scales with the world:
+    ``global = per_replica * new_world``. Returns ``(per_replica,
+    new_global)`` (``(None, None)`` when the manifest carries no batch
+    info). Callers that instead want fixed global batch semantics can
+    derive ``per_replica = global // new_world`` themselves; that
+    changes the compiled step shape, which is why it is not the default.
+    """
+    saved_world = max(1, int(manifest.get("world", 1)))
+    per = manifest.get("per_replica_batch")
+    if per is None:
+        gb = manifest.get("global_batch")
+        if gb is None:
+            return None, None
+        per = max(1, int(gb) // saved_world)
+    return int(per), int(per) * int(new_world)
+
+
 class Communicator:
     """All-reduce (and friends) over the mesh 'data' axis.
 
@@ -188,7 +213,6 @@ class Communicator:
 
     def broadcast(self, arr, root=0):
         if active_axis(self.axis_name):
-            n = axis_size(self.axis_name)
             mask = (lax.axis_index(self.axis_name) == root)
             return lax.psum(jnp.where(mask, arr, jnp.zeros_like(arr)),
                             self.axis_name)

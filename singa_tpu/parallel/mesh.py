@@ -54,3 +54,32 @@ def make_mesh(devices=None, config: MeshConfig | None = None) -> Mesh:
     shape = tuple(deg[a] for a in config.axis_order)
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, config.axis_order)
+
+
+def elastic_mesh(devices=None, config: MeshConfig | None = None,
+                 saved_world: int | None = None) -> Mesh:
+    """Mesh for a (possibly world-size-changed) restart.
+
+    Built over whatever devices THIS incarnation of the job has: the
+    ``data`` axis defaults to -1 ("everything left"), so a run restarted
+    with fewer or more hosts gets a mesh whose dp degree simply absorbs
+    the change while every axis NAME stays fixed — shardings and
+    collectives written against names re-land unchanged, and the restore
+    template re-shards checkpointed state onto the new degrees.
+
+    ``saved_world`` (from a checkpoint manifest) makes the transition
+    loud: a mismatch with the current world is warned, not an error —
+    elastic resume is exactly the case where they differ.
+    """
+    import warnings
+    if devices is None:
+        devices = jax.devices()
+    cfg = config or MeshConfig()
+    fixed = cfg.model * cfg.pipe * cfg.seq * cfg.expert
+    world = len(devices) // max(1, fixed)
+    if saved_world is not None and int(saved_world) != world:
+        warnings.warn(
+            f"elastic mesh: data-parallel degree is now {world} "
+            f"(checkpoint was saved at {saved_world}); state will be "
+            "re-sharded onto the new mesh on restore", stacklevel=2)
+    return make_mesh(devices, cfg)

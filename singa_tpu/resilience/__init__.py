@@ -20,8 +20,18 @@ package adds the three layers that keep work alive:
   never land in the parameters.
 - :mod:`faults` — :class:`FaultPlan`: deterministic fault injection
   (poisoned batches, raising steps/iterators, hangs, SIGTERM delivery,
-  crash-mid-async-save) plus on-disk checkpoint corruption helpers,
-  driving the chaos tests in ``tests/test_resilience.py``.
+  crash-mid-async-save, killed/dropped/straggling cluster peers, death
+  in the two-phase-commit hole) plus on-disk checkpoint corruption
+  helpers, driving the chaos tests in ``tests/test_resilience.py`` and
+  ``tests/test_multiprocess.py``.
+- :mod:`cluster` — coordinator/worker cluster health over the
+  :mod:`singa_tpu.network` control plane: heartbeats with dead-peer and
+  straggler detection, barriers that *name the missing ranks* instead
+  of hanging, and the ACK/commit protocol behind the two-phase
+  :class:`~singa_tpu.checkpoint.DistributedCheckpointManager`.
+  Membership loss is recoverable: exit 75, restart at the surviving
+  world size, resume from the last committed checkpoint (world-size-
+  elastic re-sharding included).
 """
 
 from .runtime import (EXIT_PREEMPTED, ResilientTrainer,  # noqa: F401
@@ -30,3 +40,6 @@ from .guards import GuardedOptimizer                      # noqa: F401
 from .faults import (FaultInjected, FaultPlan,            # noqa: F401
                      SimulatedCrash, corrupt_checkpoint,
                      truncate_checkpoint)
+from .cluster import (BarrierTimeout, ClusterConfig,      # noqa: F401
+                      ClusterError, MembershipError, SoloCluster,
+                      make_cluster)

@@ -1,0 +1,696 @@
+"""Cluster health: heartbeats, failing-fast barriers, two-phase commit.
+
+The reference's multi-process story is fail-fast NCCL error prints
+(include/singa/io/communicator.h:40-67): a dead or straggling host
+silently hangs every collective, and a host that dies mid-save leaves a
+checkpoint that only *looks* committed. This module is the control-plane
+layer a pod-scale job needs on top of :mod:`singa_tpu.network`
+(``NetworkThread``/``EndPoint`` — tensor traffic stays on XLA
+collectives over ICI/DCN, never these sockets):
+
+- **Membership**: every worker heartbeats the coordinator (rank 0); the
+  coordinator tracks last-seen ages, flags *stragglers* (heartbeat gap
+  over ``straggler_after``) and declares a rank *dead* after
+  ``dead_after`` of silence. The digest rides back on every heartbeat
+  ack, so workers learn of lost peers (and of a dead coordinator, by
+  the ack going silent) without extra traffic. :meth:`ClusterBase.check`
+  raises :class:`MembershipError` — a *recoverable* loss: the
+  supervisor contract is exit :data:`~singa_tpu.resilience.runtime.
+  EXIT_PREEMPTED` (75) and a restart at the smaller world size.
+- **Barriers**: :meth:`ClusterBase.barrier` never hangs — at the
+  timeout (or as soon as a participant is declared dead) it raises
+  :class:`BarrierTimeout` *naming the missing ranks*.
+- **Two-phase commit** (for distributed checkpoints,
+  ``singa_tpu/checkpoint.py``): every rank writes its shard then
+  :meth:`ClusterBase.ack_save`; the coordinator publishes the commit
+  marker (the registered ``commit_hook``) only once ALL ranks acked,
+  then broadcasts the decision; :meth:`ClusterBase.wait_commit` returns
+  whether the step committed. A rank that dies between shard-write and
+  ACK therefore leaves a step with NO marker — wreckage that
+  ``restore_latest`` refuses.
+
+Usage (one process per rank)::
+
+    cluster = make_cluster(rank, world, "host0:19123")
+    cluster.barrier("start", timeout=30)     # rendezvous, names absentees
+    ...
+    cluster.ack_save(step); cluster.wait_commit(step, timeout=30)
+    cluster.check()                          # raises on membership loss
+    cluster.close()
+
+``world == 1`` returns a :class:`SoloCluster` that needs no sockets, so
+elastic restarts down to a single host run the identical code path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from dataclasses import dataclass
+
+from .. import network as net
+from .faults import NULL_PLAN, DropPeerSignal as _DropPeerSignal
+
+
+class ClusterError(RuntimeError):
+    """Base class for cluster-health failures."""
+
+
+class MembershipError(ClusterError):
+    """A peer (or the coordinator) was lost — RECOVERABLE by a restart
+    at the smaller world size: the supervisor contract is exit code 75
+    (``resilience.EXIT_PREEMPTED``)."""
+
+    def __init__(self, dead, world):
+        self.dead = sorted(int(r) for r in dead)
+        self.world = int(world)
+        super().__init__(
+            f"cluster membership lost: rank(s) {self.dead} of world "
+            f"{self.world} are dead; restart at world "
+            f"{self.world - len(self.dead)} to continue")
+
+
+class BarrierTimeout(ClusterError):
+    """A barrier did not complete — names who is missing instead of
+    hanging the collective."""
+
+    def __init__(self, name, missing, timeout):
+        self.name = name
+        self.missing = sorted(int(r) for r in missing)
+        super().__init__(
+            f"barrier {name!r} timed out after {timeout:.1f}s waiting "
+            f"for rank(s) {self.missing}")
+
+
+@dataclass
+class ClusterConfig:
+    """Timing knobs. Defaults suit tests/local chaos runs; production
+    pods want heartbeat_interval ~1s and dead_after ~30s."""
+
+    heartbeat_interval: float = 0.25   # worker -> coordinator beat period
+    straggler_after: float = 0.75      # silence before a rank is "slow"
+    dead_after: float = 2.5            # silence before a rank is dead
+    connect_timeout: float = 15.0      # worker's coordinator-dial budget
+    recv_slice: float = 0.25           # receiver-loop poll granularity
+
+
+def _addr(coordinator: str):
+    host, port = coordinator.rsplit(":", 1)
+    return host, int(port)
+
+
+def _msg(kind: str, **payload) -> net.Message:
+    return net.Message(kind.encode(), json.dumps(payload).encode())
+
+
+def _payload(msg: net.Message) -> dict:
+    return json.loads(msg.payload.decode() or "{}")
+
+
+# decided commit steps kept in memory per rank — coordinator and worker
+# MUST share this window: a worker pruning earlier than the coordinator
+# could drop the Event for a step whose decision is still coming
+COMMIT_WINDOW = 16
+
+
+class ClusterBase:
+    """API shared by coordinator, worker, and the solo degenerate."""
+
+    rank: int = 0
+    world: int = 1
+
+    # -- health ------------------------------------------------------------
+    def health(self) -> dict:
+        raise NotImplementedError
+
+    def check(self):
+        """Raise :class:`MembershipError` when membership was lost."""
+        dead = self.health().get("dead", [])
+        if dead:
+            raise MembershipError(dead, self.world)
+
+    # -- barrier -----------------------------------------------------------
+    def barrier(self, name: str, timeout: float = 30.0):
+        raise NotImplementedError
+
+    # -- two-phase commit ---------------------------------------------------
+    def set_commit_hook(self, hook):
+        """Coordinator-side: ``hook(step) -> None`` runs exactly once per
+        step, after every rank acked and before the commit broadcast —
+        the checkpoint layer's marker write."""
+        self._commit_hook = hook
+
+    def ack_save(self, step: int):
+        raise NotImplementedError
+
+    def wait_commit(self, step: int, timeout: float = 30.0) -> bool:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SoloCluster(ClusterBase):
+    """World of one: every protocol completes instantly, no sockets —
+    the elastic end state (a job restarted down to a single host) runs
+    the same code path as the pod it shrank from."""
+
+    def __init__(self, rank: int = 0, faults=None):
+        self.rank = int(rank)
+        self.world = 1
+        self.faults = faults if faults is not None else NULL_PLAN
+        self._commit_hook = None
+
+    def health(self):
+        return {"rank": self.rank, "world": 1, "alive": [self.rank],
+                "dead": [], "stragglers": [], "heartbeat_age": {}}
+
+    def barrier(self, name, timeout=30.0):
+        return
+
+    def ack_save(self, step):
+        self.faults.on_ack(int(step))
+        if self._commit_hook is not None:
+            self._commit_hook(int(step))
+
+    def wait_commit(self, step, timeout=30.0):
+        return True
+
+
+class Coordinator(ClusterBase):
+    """Rank 0: owns the listener, the membership table, barrier and
+    commit bookkeeping. Also a full participant (its own arrivals and
+    ACKs count like any rank's)."""
+
+    def __init__(self, world: int, coordinator: str,
+                 config: ClusterConfig | None = None, faults=None):
+        self.rank = 0
+        self.world = int(world)
+        self.cfg = config or ClusterConfig()
+        self.faults = faults if faults is not None else NULL_PLAN
+        host, port = _addr(coordinator)
+        self._net = net.NetworkThread(port=port)
+        self._lock = threading.Lock()
+        self._running = True
+        self._commit_hook = None
+        self._peers: dict[int, net.EndPoint] = {}
+        self._last_hb: dict[int, float] = {}
+        self._hb_count: dict[int, int] = {}
+        self._dead: set[int] = set()
+        self._stragglers: set[int] = set()
+        # barrier name -> {"arrived": set, "event": Event,
+        #                  "missing": list|None}
+        self._barriers: dict[str, dict] = {}
+        # failed-barrier memory (bounded): a rank arriving AFTER the
+        # failure gets told immediately instead of burning its own
+        # timeout against a ghost slot that can never complete
+        self._failed_barriers: dict[str, list] = {}
+        self._acks: dict[int, set] = {}
+        self._commit_done: dict[int, threading.Event] = {}
+        self._commit_ok: dict[int, bool] = {}
+        self._commit_claimed: set[int] = set()   # publish/abort decided
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="cluster-accept")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._monitor_loop, daemon=True,
+                             name="cluster-monitor")
+        t.start()
+        self._threads.append(t)
+
+    # -- wiring ------------------------------------------------------------
+    def _accept_loop(self):
+        while self._running:
+            try:
+                ep = self._net.accept(timeout=self.cfg.recv_slice)
+            except ConnectionError:
+                return                     # net closed
+            if ep is None:
+                continue
+            # the hello handshake runs on the PEER's thread: one stalled
+            # dialer (or a stray connection to the advertised port) must
+            # not serialize every other rank's join behind its timeout.
+            # Daemon + untracked: a long-lived coordinator accepting
+            # dial-and-die churn must not accumulate dead Thread objects
+            threading.Thread(target=self._join_then_serve, args=(ep,),
+                             daemon=True, name="cluster-join").start()
+
+    def _join_then_serve(self, ep):
+        try:
+            hello = ep.recv(timeout=5.0)
+        except ConnectionError:
+            ep.close()       # dialer died mid-handshake: free the slot
+            return
+        if hello is None or hello.meta != b"hello":
+            ep.close()
+            return
+        rank = int(_payload(hello)["rank"])
+        with self._lock:
+            self._peers[rank] = ep
+            self._last_hb[rank] = time.monotonic()
+            self._dead.discard(rank)
+        self._peer_loop(rank, ep)
+
+    def _peer_loop(self, rank, ep):
+        while self._running:
+            try:
+                msg = ep.recv(timeout=self.cfg.recv_slice)
+            except ConnectionError:
+                return          # monitor will declare it dead by silence
+            if msg is None:
+                continue
+            kind = msg.meta.decode()
+            data = _payload(msg)
+            if kind == "hb":
+                with self._lock:
+                    self._last_hb[rank] = time.monotonic()
+                    self._hb_count[rank] = self._hb_count.get(rank, 0) + 1
+                try:
+                    ep.send(_msg("hb-ack", **self._digest()))
+                except ConnectionError:
+                    return
+            elif kind == "barrier":
+                self._barrier_arrive(data["name"], rank)
+            elif kind == "ack":
+                self._ack_arrive(int(data["step"]), rank)
+
+    def _monitor_loop(self):
+        while self._running:
+            time.sleep(self.cfg.heartbeat_interval / 2)
+            now = time.monotonic()
+            newly_dead = []
+            with self._lock:
+                for rank, seen in self._last_hb.items():
+                    age = now - seen
+                    if age > self.cfg.dead_after and rank not in self._dead:
+                        self._dead.add(rank)
+                        newly_dead.append(rank)
+                    if age > self.cfg.straggler_after:
+                        self._stragglers.add(rank)
+                    else:
+                        self._stragglers.discard(rank)
+            for rank in newly_dead:
+                warnings.warn(
+                    f"cluster: rank {rank} declared dead "
+                    f"(no heartbeat for {self.cfg.dead_after:.1f}s)",
+                    stacklevel=2)
+                # a barrier waiting on a dead rank can never complete:
+                # fail it NOW, naming the corpse, instead of hanging out
+                # the caller's full timeout
+                self._fail_barriers_missing(rank)
+
+    def _digest(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            expected = set(range(1, self.world))
+            connected = set(self._last_hb)
+            ages = {str(r): round(now - t, 3)
+                    for r, t in self._last_hb.items()}
+            return {
+                "world": self.world,
+                "alive": sorted({0} | (connected - self._dead)),
+                "dead": sorted(self._dead),
+                "never_joined": sorted(expected - connected),
+                "stragglers": sorted(self._stragglers - self._dead),
+                "heartbeat_age": ages,
+                "heartbeats": {str(r): c
+                               for r, c in self._hb_count.items()},
+            }
+
+    # -- health ------------------------------------------------------------
+    def health(self):
+        d = self._digest()
+        d["rank"] = 0
+        return d
+
+    # -- barrier -----------------------------------------------------------
+    def _barrier_slot(self, name):
+        with self._lock:
+            slot = self._barriers.get(name)
+            if slot is None:
+                slot = {"arrived": set(), "event": threading.Event(),
+                        "missing": None}
+                self._barriers[name] = slot
+            return slot
+
+    def _fail_barrier(self, name, slot, missing):
+        """Record + announce a barrier failure: remember it (bounded)
+        so late arrivals are told immediately, drop the live slot, wake
+        local waiters, tell the arrived workers."""
+        with self._lock:
+            slot["missing"] = missing
+            self._failed_barriers[name] = missing
+            while len(self._failed_barriers) > 32:
+                self._failed_barriers.pop(
+                    next(iter(self._failed_barriers)))
+            self._barriers.pop(name, None)
+        slot["event"].set()
+        self._broadcast("barrier-fail", ranks=slot["arrived"],
+                        name=name, missing=missing)
+
+    def _barrier_arrive(self, name, rank):
+        with self._lock:
+            failed = self._failed_barriers.get(name)
+            ep = self._peers.get(rank)
+        if failed is not None:
+            # straggler arriving at an already-failed barrier: answer
+            # NOW with the true missing set, instead of leaving it to
+            # time out again and falsely blame the coordinator
+            if rank != 0 and ep is not None:
+                try:
+                    ep.send(_msg("barrier-fail", name=name,
+                                 missing=failed))
+                except ConnectionError:
+                    pass
+            return
+        slot = self._barrier_slot(name)
+        with self._lock:
+            slot["arrived"].add(rank)
+            complete = len(slot["arrived"]) == self.world
+            # a participant that is ALREADY dead will never arrive:
+            # fail now, naming the corpse — live ranks merely being
+            # slow still get the full timeout
+            dead_missing = sorted(self._dead - slot["arrived"])
+        if complete:
+            slot["event"].set()
+            self._broadcast("barrier-ok", ranks=slot["arrived"],
+                            name=name)
+        elif dead_missing:
+            self._fail_barrier(name, slot, dead_missing)
+
+    def _fail_barriers_missing(self, dead_rank):
+        with self._lock:
+            pending = [(n, s) for n, s in self._barriers.items()
+                       if not s["event"].is_set()
+                       and dead_rank not in s["arrived"]]
+            missing = {n: sorted(self._dead - s["arrived"])
+                       for n, s in pending}
+        for name, slot in pending:
+            self._fail_barrier(name, slot, missing[name])
+
+    def _broadcast(self, kind, ranks=None, **payload):
+        with self._lock:
+            eps = [(r, ep) for r, ep in self._peers.items()
+                   if (ranks is None or r in ranks) and r not in self._dead]
+        for _r, ep in eps:
+            try:
+                ep.send(_msg(kind, **payload))
+            except ConnectionError:
+                pass
+
+    def barrier(self, name, timeout=30.0):
+        with self._lock:
+            failed = self._failed_barriers.get(name)
+        if failed is not None:
+            raise BarrierTimeout(name, failed, 0.0)
+        slot = self._barrier_slot(name)
+        self._barrier_arrive(name, 0)
+        if not slot["event"].wait(timeout):
+            with self._lock:
+                missing = sorted(
+                    set(range(self.world)) - slot["arrived"])
+            self._fail_barrier(name, slot, missing)
+        with self._lock:
+            self._barriers.pop(name, None)
+            missing = slot["missing"]
+        if missing:
+            raise BarrierTimeout(name, missing, timeout)
+
+    # -- two-phase commit ---------------------------------------------------
+    def _commit_slot(self, step):
+        with self._lock:
+            ev = self._commit_done.get(step)
+            if ev is None:
+                ev = threading.Event()
+                self._commit_done[step] = ev
+                self._acks.setdefault(step, set())
+            return ev
+
+    def _ack_arrive(self, step, rank):
+        ev = self._commit_slot(step)
+        with self._lock:
+            self._acks[step].add(rank)
+            complete = len(self._acks[step]) == self.world
+            # claim the publish under the lock: a quorum completing
+            # AFTER wait_commit's timeout aborted the step must not
+            # publish a marker every save() caller was told to distrust
+            claim = complete and step not in self._commit_claimed
+            if claim:
+                self._commit_claimed.add(step)
+        if claim:
+            # publish the marker (the checkpoint layer's atomic write)
+            # BEFORE telling anyone the step committed
+            ok = True
+            if self._commit_hook is not None:
+                try:
+                    self._commit_hook(step)
+                except Exception as e:      # marker write failed: abort
+                    warnings.warn(f"commit hook for step {step} failed "
+                                  f"({type(e).__name__}: {e}); step "
+                                  "stays uncommitted", stacklevel=2)
+                    ok = False
+            with self._lock:
+                self._commit_ok[step] = ok
+                # bound the per-step bookkeeping: decided steps beyond
+                # the window can never be waited on again
+                decided = sorted(self._commit_ok)
+                for old in decided[:-COMMIT_WINDOW]:
+                    self._commit_ok.pop(old, None)
+                    self._acks.pop(old, None)
+                    self._commit_done.pop(old, None)
+                    self._commit_claimed.discard(old)
+            ev.set()
+            self._broadcast("commit", step=step, ok=ok)
+
+    def ack_save(self, step):
+        self.faults.on_ack(int(step))
+        self._ack_arrive(int(step), 0)
+
+    def wait_commit(self, step, timeout=30.0):
+        step = int(step)
+        ev = self._commit_slot(step)
+        if not ev.wait(timeout):
+            with self._lock:
+                aborted = step not in self._commit_claimed
+                if aborted:
+                    # no publish in flight: ABORT, so a straggler's late
+                    # ACK cannot commit a step save() already reported
+                    # uncommitted
+                    self._commit_claimed.add(step)
+                    self._commit_ok[step] = False
+            if aborted:
+                ev.set()
+                self._broadcast("commit", step=step, ok=False)
+            else:
+                ev.wait(5.0)     # publish decision in flight; let it land
+        with self._lock:
+            return bool(self._commit_ok.get(step))
+
+    # -- teardown ----------------------------------------------------------
+    def close(self):
+        self._running = False
+        self._net.close()
+
+
+class Worker(ClusterBase):
+    """Rank > 0: dials the coordinator, heartbeats on a background
+    thread, and learns cluster state from the heartbeat-ack digest."""
+
+    def __init__(self, rank: int, world: int, coordinator: str,
+                 config: ClusterConfig | None = None, faults=None):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.cfg = config or ClusterConfig()
+        self.faults = faults if faults is not None else NULL_PLAN
+        self._net = net.NetworkThread(port=-1)
+        self._lock = threading.Lock()
+        self._running = True
+        self._commit_hook = None
+        self._digest: dict = {}
+        self._last_ack = time.monotonic()
+        self._coordinator_dead = False
+        self._dropped = False          # fault-injected silent death
+        self._barriers: dict[str, dict] = {}
+        self._commit_done: dict[int, threading.Event] = {}
+        self._commit_ok: dict[int, bool] = {}
+        host, port = _addr(coordinator)
+        self._ep = self._dial(host, port)
+        self._ep.send(_msg("hello", rank=self.rank))
+        self._threads = []
+        for target, name in ((self._rx_loop, "rx"), (self._hb_loop, "hb")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"cluster-{name}-{rank}")
+            t.start()
+            self._threads.append(t)
+
+    def _dial(self, host, port):
+        deadline = time.monotonic() + self.cfg.connect_timeout
+        while True:
+            try:
+                return self._net.connect(host, port)
+            except ConnectionError:
+                if time.monotonic() >= deadline:
+                    raise ClusterError(
+                        f"rank {self.rank}: coordinator {host}:{port} "
+                        f"unreachable for {self.cfg.connect_timeout:.0f}s"
+                    ) from None
+                time.sleep(0.1)
+
+    # -- background loops --------------------------------------------------
+    def _hb_loop(self):
+        seq = 0
+        while self._running:
+            seq += 1
+            try:
+                self.faults.on_heartbeat(seq)
+            except _DropPeerSignal:
+                # simulate a silent network death: stop beating, leave
+                # the socket up (the coordinator must detect by SILENCE)
+                with self._lock:
+                    self._dropped = True
+                return
+            if not self._running:
+                return
+            try:
+                self._ep.send(_msg("hb", rank=self.rank, seq=seq))
+            except ConnectionError:
+                if self._running:
+                    self._mark_coordinator_dead()
+                return
+            time.sleep(self.cfg.heartbeat_interval)
+            if time.monotonic() - self._last_ack > self.cfg.dead_after:
+                self._mark_coordinator_dead()
+                return
+
+    def _rx_loop(self):
+        while self._running:
+            try:
+                msg = self._ep.recv(timeout=self.cfg.recv_slice)
+            except ConnectionError:
+                if self._running:    # our own close() is not a death
+                    self._mark_coordinator_dead()
+                return
+            if msg is None:
+                continue
+            kind = msg.meta.decode()
+            data = _payload(msg)
+            if kind == "hb-ack":
+                with self._lock:
+                    self._digest = data
+                    self._last_ack = time.monotonic()
+            elif kind in ("barrier-ok", "barrier-fail"):
+                with self._lock:
+                    slot = self._barriers.get(data["name"])
+                if slot is not None:
+                    slot["missing"] = data.get("missing") \
+                        if kind == "barrier-fail" else None
+                    slot["event"].set()
+            elif kind == "commit":
+                step = int(data["step"])
+                with self._lock:
+                    ev = self._commit_done.setdefault(
+                        step, threading.Event())
+                    self._commit_ok[step] = bool(data.get("ok"))
+                    # same bounded window the coordinator keeps: a
+                    # weeks-long run must not leak an Event per step
+                    for old in sorted(self._commit_ok)[:-COMMIT_WINDOW]:
+                        self._commit_ok.pop(old, None)
+                        self._commit_done.pop(old, None)
+                ev.set()
+
+    def _mark_coordinator_dead(self):
+        with self._lock:
+            if self._dropped:       # fault-injected: we left, not them
+                return
+            self._coordinator_dead = True
+
+    # -- health ------------------------------------------------------------
+    def health(self):
+        with self._lock:
+            d = dict(self._digest) if self._digest else {
+                "world": self.world, "alive": [], "dead": [],
+                "stragglers": [], "heartbeat_age": {}}
+            d["rank"] = self.rank
+            d["coordinator_ack_age"] = round(
+                time.monotonic() - self._last_ack, 3)
+            if self._coordinator_dead:
+                dead = set(d.get("dead", []))
+                dead.add(0)
+                d["dead"] = sorted(dead)
+        return d
+
+    # -- barrier -----------------------------------------------------------
+    def barrier(self, name, timeout=30.0):
+        slot = {"event": threading.Event(), "missing": None}
+        with self._lock:
+            self._barriers[name] = slot
+        try:
+            self._ep.send(_msg("barrier", name=name, rank=self.rank))
+        except ConnectionError:
+            raise BarrierTimeout(name, [0], 0.0) from None
+        # small slack over the caller's budget: the coordinator times
+        # the barrier too and its fail message names the true missing
+        # set — only a DEAD coordinator leaves us to our local timeout
+        if not slot["event"].wait(timeout + 2 * self.cfg.recv_slice):
+            with self._lock:
+                self._barriers.pop(name, None)
+            raise BarrierTimeout(name, [0], timeout)
+        with self._lock:
+            self._barriers.pop(name, None)
+        if slot["missing"]:
+            raise BarrierTimeout(name, slot["missing"], timeout)
+
+    # -- two-phase commit ---------------------------------------------------
+    def ack_save(self, step):
+        self.faults.on_ack(int(step))
+        with self._lock:
+            self._commit_done.setdefault(int(step), threading.Event())
+        try:
+            self._ep.send(_msg("ack", step=int(step), rank=self.rank))
+        except ConnectionError:
+            self._mark_coordinator_dead()
+
+    def wait_commit(self, step, timeout=30.0):
+        with self._lock:
+            ev = self._commit_done.setdefault(int(step),
+                                              threading.Event())
+        if not ev.wait(timeout):
+            return False
+        with self._lock:
+            return bool(self._commit_ok.get(int(step)))
+
+    # -- teardown ----------------------------------------------------------
+    def close(self):
+        self._running = False
+        self._net.close()
+
+
+def make_cluster(rank: int, world: int, coordinator: str | None = None,
+                 config: ClusterConfig | None = None,
+                 faults=None) -> ClusterBase:
+    """Build this process's cluster member: :class:`SoloCluster` for a
+    world of one, :class:`Coordinator` for rank 0, :class:`Worker`
+    otherwise. ``coordinator`` is ``"host:port"`` (the same address the
+    jax.distributed coordinator convention uses)."""
+    if world <= 1:
+        return SoloCluster(rank, faults)
+    if coordinator is None:
+        raise ValueError("multi-rank cluster needs coordinator='host:port'")
+    if int(rank) == 0:
+        return Coordinator(world, coordinator, config, faults)
+    return Worker(rank, world, coordinator, config, faults)
+
+
+__all__ = ["ClusterConfig", "ClusterError", "MembershipError",
+           "BarrierTimeout", "ClusterBase", "SoloCluster", "Coordinator",
+           "Worker", "make_cluster"]

@@ -42,6 +42,14 @@ class SimulatedCrash(RuntimeError):
     test catches it and restarts a fresh trainer, like a supervisor)."""
 
 
+class DropPeerSignal(BaseException):
+    """Raised from FaultPlan.on_heartbeat to simulate a silently-dropped
+    peer: the cluster Worker stops heartbeating but keeps its socket up,
+    so the coordinator must detect the loss by SILENCE (the realistic
+    network-partition shape). BaseException so no blanket Exception
+    handler accidentally swallows the injected death."""
+
+
 class FaultPlan:
     """Deterministic, step-keyed failure schedule (see module doc).
 
@@ -98,10 +106,39 @@ class FaultPlan:
         checkpoint."""
         return self._arm("crash_save", step, 1)
 
+    # -- cluster faults ----------------------------------------------------
+    def kill_rank(self, step):
+        """Hard-kill THIS process (``os._exit(1)``) just before step N
+        runs — no atexit, no checkpoint, no goodbye: the way a preempted
+        or OOM-killed pod member actually vanishes. Peers must detect
+        the loss by heartbeat silence."""
+        return self._arm("kill", step, 1)
+
+    def drop_peer(self, beat):
+        """From heartbeat number ``beat`` on, this rank goes silent
+        (stops heartbeating, socket left up — a network partition, not
+        a process death). Drives the coordinator's dead-peer detection
+        without killing the test process."""
+        return self._arm("drop_peer", beat, 1)
+
+    def delay_heartbeat(self, beat, seconds=0.5, times=1):
+        """Stall heartbeat number ``beat`` by ``seconds`` before it is
+        sent — straggler fodder for the health monitor."""
+        return self._arm("hb_delay", beat, times, seconds=float(seconds))
+
+    def kill_before_ack(self, step):
+        """Hard-kill this process AFTER step N's checkpoint shard is
+        fully written but BEFORE the ACK reaches the coordinator — the
+        two-phase-commit hole: the step must never gain a commit marker
+        and ``restore_latest`` must refuse it."""
+        return self._arm("kill_ack", step, 1)
+
     # -- trainer hook points ----------------------------------------------
     def on_step(self, step, attempt=0):
         """Called inside the (retried, watchdog-timed) step body before
         the model runs."""
+        if self._take("kill", step) is not None:
+            os._exit(1)          # no cleanup: a real pod death
         rec = self._take("preempt", step)
         if rec is not None:
             os.kill(os.getpid(), rec["sig"])
@@ -139,6 +176,20 @@ class FaultPlan:
         if self._take("crash_save", step) is not None:
             raise SimulatedCrash(f"crashed mid-async-save of step {step}")
 
+    def on_heartbeat(self, seq):
+        """Called by the cluster Worker before sending heartbeat ``seq``."""
+        rec = self._take("hb_delay", seq)
+        if rec is not None:
+            time.sleep(rec["seconds"])
+        if self._take("drop_peer", seq) is not None:
+            raise DropPeerSignal(f"dropped at heartbeat {seq}")
+
+    def on_ack(self, step):
+        """Called after step N's checkpoint shard is durably written,
+        just before the two-phase-commit ACK is sent."""
+        if self._take("kill_ack", step) is not None:
+            os._exit(1)          # died in the commit hole
+
 
 class _NullPlan(FaultPlan):
     """Hook no-ops for the common no-faults case."""
@@ -153,6 +204,12 @@ class _NullPlan(FaultPlan):
         pass
 
     def on_saved(self, step):
+        pass
+
+    def on_heartbeat(self, seq):
+        pass
+
+    def on_ack(self, step):
         pass
 
 

@@ -47,7 +47,8 @@ import threading
 import time
 import warnings
 
-from ..checkpoint import CheckpointManager
+from ..checkpoint import CheckpointManager, DistributedCheckpointManager
+from .cluster import BarrierTimeout, MembershipError
 from .faults import NULL_PLAN
 from .guards import GuardedOptimizer
 
@@ -98,6 +99,18 @@ class ResilientTrainer:
       ``run`` return its summary with ``preempted=True`` instead (for
       embedding in a larger host process).
     - ``faults``: a FaultPlan for chaos testing.
+    - ``cluster``: a :mod:`~singa_tpu.resilience.cluster` member. When
+      given, checkpoints go through the two-phase
+      :class:`~singa_tpu.checkpoint.DistributedCheckpointManager`
+      (commit marker only after every rank's ACK), cluster health is
+      checked at every step boundary, and a lost peer (or a failed
+      start rendezvous) exits :data:`EXIT_PREEMPTED` — membership loss
+      is RECOVERABLE: the supervisor restarts at the smaller world size
+      and ``run`` resumes from the last *committed* checkpoint,
+      re-sharded onto the new mesh.
+    - ``manifest_extra``: dict recorded in every commit marker (e.g.
+      ``per_replica_batch`` — the elastic batch accounting reads it on
+      resume, see ``parallel.communicator.rescale_batch``).
     """
 
     def __init__(self, model, ckpt_dir, *, max_to_keep=3,
@@ -105,11 +118,23 @@ class ResilientTrainer:
                  backoff_base=0.1, backoff_cap=5.0, jitter=0.25,
                  step_timeout=None, rollback_after=3, max_rollbacks=3,
                  exit_on_preempt=True, install_signal_handlers=True,
-                 faults=None, seed=0, verbose=True):
+                 faults=None, seed=0, verbose=True, cluster=None,
+                 commit_timeout=60.0, start_barrier_timeout=60.0,
+                 preempt_commit_timeout=10.0, manifest_extra=None):
         self.model = model
-        self.mgr = CheckpointManager(
-            ckpt_dir, max_to_keep=max_to_keep,
-            save_interval_steps=save_interval_steps)
+        self.cluster = cluster
+        self.start_barrier_timeout = float(start_barrier_timeout)
+        self.preempt_commit_timeout = float(preempt_commit_timeout)
+        if cluster is not None:
+            self.mgr = DistributedCheckpointManager(
+                ckpt_dir, cluster, max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                commit_timeout=commit_timeout,
+                manifest_extra=manifest_extra)
+        else:
+            self.mgr = CheckpointManager(
+                ckpt_dir, max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps)
         self.step_retries = int(step_retries)
         self.data_retries = int(data_retries)
         self.backoff_base = float(backoff_base)
@@ -121,6 +146,12 @@ class ResilientTrainer:
         self.exit_on_preempt = bool(exit_on_preempt)
         self.install_signal_handlers = bool(install_signal_handlers)
         self.faults = faults if faults is not None else NULL_PLAN
+        if cluster is not None and \
+                getattr(cluster, "faults", NULL_PLAN) is NULL_PLAN:
+            # one plan drives every hook point: a caller that armed
+            # kill_before_ack on the trainer's plan gets it fired from
+            # the cluster's ack path too
+            cluster.faults = self.faults
         self.verbose = bool(verbose)
         self._rng = random.Random(seed)
         self._sleep = time.sleep          # injectable in tests
@@ -168,7 +199,24 @@ class ResilientTrainer:
         signame = signal.Signals(self._preempt_signal).name
         if completed_step >= start:
             if self.mgr.latest_step() != completed_step:
-                self.mgr.save(completed_step, self.model, force=True)
+                if isinstance(self.mgr, DistributedCheckpointManager):
+                    # a forced off-schedule save only reaches quorum
+                    # when EVERY rank was preempted at this boundary
+                    # (whole-pod maintenance — the common TPU case); a
+                    # per-node preemption cannot commit, so wait only
+                    # briefly and leave resume to the last committed
+                    # step rather than eating the kill grace
+                    ok = self.mgr.save(
+                        completed_step, self.model, force=True,
+                        commit_timeout=self.preempt_commit_timeout)
+                    if not ok:
+                        self._log(
+                            f"{signame}: preemption checkpoint of step "
+                            f"{completed_step} did not commit; resume "
+                            "will use the last committed step")
+                else:
+                    self.mgr.save(completed_step, self.model,
+                                  force=True)
             self.mgr.wait()     # synchronous: the bytes must be down
             self._log(f"{signame}: checkpointed step {completed_step}, "
                       f"exiting {EXIT_PREEMPTED} for the supervisor")
@@ -303,6 +351,30 @@ class ResilientTrainer:
                               summary, "step_retries")
                 attempt += 1
 
+    # -- cluster health ----------------------------------------------------
+    def _check_cluster(self):
+        """At a step boundary: raise MembershipError if a peer (or the
+        coordinator) was lost — the run() handler turns it into the
+        exit-75 supervisor contract."""
+        if self.cluster is not None:
+            self.cluster.check()
+
+    def _finalize_summary(self, summary):
+        """Observability that must survive EVERY exit path (success,
+        preemption, membership loss): guard stats, data-pipeline
+        flakiness counters, final cluster health."""
+        guard = self._guard()
+        if guard is not None:
+            summary["skipped_steps"] = guard.stats()["skipped_total"]
+        from ..data import RetryingIterator
+        if isinstance(self._data, RetryingIterator):
+            summary["data_source"] = self._data.counters()
+        if self.cluster is not None:
+            try:
+                summary["cluster"] = self.cluster.health()
+            except Exception:       # a torn-down cluster is not an error
+                pass
+
     # -- divergence rollback ----------------------------------------------
     def _guard(self):
         opt = getattr(self.model, "optimizer", None)
@@ -319,8 +391,31 @@ class ResilientTrainer:
             raise RuntimeError(
                 f"training diverged: {self.rollback_after} consecutive "
                 f"bad steps after {summary['rollbacks']} rollbacks")
+        if self.cluster is not None and self.cluster.world > 1:
+            # rollback must be LOCKSTEP: a rank rewinding alone would
+            # ack different step numbers forever and no checkpoint
+            # could ever commit again. The guard streak is shard-
+            # consistent under DistOpt, so all ranks normally arrive
+            # here together; a rank whose divergence is LOCAL (a
+            # hardware fault) strands its peers at this barrier →
+            # BarrierTimeout → exit 75 → the supervisor restart is the
+            # consistent recovery.
+            self.cluster.barrier(
+                f"rollback-{step}-{summary['rollbacks']}",
+                timeout=self.start_barrier_timeout)
         self.mgr.wait()          # never restore under an in-flight save
         resume = self.mgr.restore_latest(self.model)
+        if self.cluster is not None and self.cluster.world > 1:
+            # same agreement rule as the startup resume barrier: the
+            # name carries the resumed step, so a rank whose shards
+            # made it fall back FURTHER than its peers strands them
+            # here and everyone exits 75 instead of training at
+            # inconsistent parameter versions
+            self.cluster.barrier(
+                f"rollback-resume-{resume}-{summary['rollbacks']}",
+                timeout=self.start_barrier_timeout)
+        if isinstance(self.mgr, DistributedCheckpointManager):
+            self.mgr.invalidate_markers_from(resume)
         guard.reset_streaks(extra_backoff=True)
         summary["rollbacks"] += 1
         warnings.warn(
@@ -342,16 +437,54 @@ class ResilientTrainer:
         summary = {"start": None, "steps_run": 0, "rollbacks": 0,
                    "step_retries": 0, "data_retries": 0,
                    "step_timeouts": 0, "skipped_steps": 0,
-                   "preempted": False}
+                   "preempted": False, "membership_lost": False,
+                   "dead_ranks": [], "elastic": None}
         prev_handlers = self._install_handlers()
         try:
+            if self.cluster is not None and self.cluster.world > 1:
+                # rendezvous BEFORE restore: a rank that never shows up
+                # is named now, not discovered as a hung collective later
+                self.cluster.barrier("run-start",
+                                     timeout=self.start_barrier_timeout)
             start = self.mgr.restore_latest(self.model)
             summary["start"] = start
+            if self.cluster is not None and self.cluster.world > 1:
+                # resume-step agreement: the barrier NAME carries the
+                # resumed step, so a rank that fell back to an older
+                # checkpoint (all same-step shard sources corrupt)
+                # strands its peers here and everyone exits 75 LOUDLY
+                # instead of training at inconsistent parameter
+                # versions where no checkpoint could ever commit again
+                self.cluster.barrier(f"resume-{start}",
+                                     timeout=self.start_barrier_timeout)
+            if isinstance(self.mgr, DistributedCheckpointManager):
+                # agreement reached (barrier above, or a world of one):
+                # markers at/after the resume point vouch for a
+                # timeline about to be re-run — cleared now so a later
+                # pre-ACK death cannot hide behind a stale marker
+                self.mgr.invalidate_markers_from(start)
             if start:
                 self._log(f"resumed from checkpoint; continuing at "
                           f"step {start}")
+            manifest = getattr(self.mgr, "restored_manifest", None)
+            if manifest is not None and self.cluster is not None:
+                saved_world = int(manifest.get("world",
+                                               self.cluster.world))
+                if saved_world != self.cluster.world:
+                    from ..parallel.communicator import rescale_batch
+                    per, gb = rescale_batch(manifest, self.cluster.world)
+                    summary["elastic"] = {
+                        "saved_world": saved_world,
+                        "world": self.cluster.world,
+                        "per_replica_batch": per, "global_batch": gb}
+                    self._log(
+                        f"elastic resume: world {saved_world} -> "
+                        f"{self.cluster.world}" +
+                        (f", global batch -> {gb} (per-replica {per} "
+                         "kept)" if per is not None else ""))
             step = start
             self._check_preempt(step - 1, start)
+            self._check_cluster()
             guard = self._guard()
             while step < num_steps:
                 batch = self._next_batch(step, summary)
@@ -367,15 +500,31 @@ class ResilientTrainer:
                 if step_callback is not None:
                     step_callback(step, out)
                 self._check_preempt(step, start)
+                self._check_cluster()
                 resumed = self._maybe_rollback(step, bad, summary)
                 step = resumed if resumed is not None else step + 1
             self.mgr.wait()
-            guard = self._guard()
-            if guard is not None:
-                summary["skipped_steps"] = guard.stats()["skipped_total"]
+            self._finalize_summary(summary)
             return summary
         except _Preempted:
             summary["preempted"] = True
+            self._finalize_summary(summary)
+            if self.exit_on_preempt:
+                raise SystemExit(EXIT_PREEMPTED) from None
+            return summary
+        except (MembershipError, BarrierTimeout) as e:
+            # RECOVERABLE: the job is still viable at a smaller world.
+            # Same supervisor contract as preemption — exit 75, restart
+            # (now with fewer ranks), resume from the last COMMITTED
+            # checkpoint re-sharded onto the new mesh. No checkpoint is
+            # attempted here: a commit could never complete without the
+            # dead rank's ACK, and the last committed step is consistent.
+            summary["membership_lost"] = True
+            summary["dead_ranks"] = list(getattr(e, "dead", [])) or \
+                list(getattr(e, "missing", []))
+            self._finalize_summary(summary)
+            self._log(f"{e}; exiting {EXIT_PREEMPTED} for the "
+                      "supervisor (restart at the surviving world size)")
             if self.exit_on_preempt:
                 raise SystemExit(EXIT_PREEMPTED) from None
             return summary

@@ -70,10 +70,8 @@ def pytest_addoption(parser):
              "multi-process bootstraps)")
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: long-running case (mesh sweeps, subprocess "
-        "smoke tests); excluded unless --full is given")
+# (the `slow` and `chaos` markers are registered in pyproject.toml's
+# [tool.pytest.ini_options] — one source of truth)
 
 
 def pytest_collection_modifyitems(config, items):

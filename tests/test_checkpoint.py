@@ -225,6 +225,28 @@ class TestCheckpointManager:
         finally:
             mgr2.close()
 
+    def test_read_only_manager_skips_sweep(self, tmp_path):
+        """sweep=False must leave another writer's uncommitted step dirs
+        alone (the elastic cross-rank restore path opens dirs it does
+        not own)."""
+        import os
+        from singa_tpu.checkpoint import CheckpointManager
+        d = tmp_path / "other"
+        wreck = d / "7.orbax-checkpoint-tmp-123"   # mid-save wreckage
+        os.makedirs(wreck)
+        (wreck / "x.bin").write_bytes(b"partial")
+        mgr = CheckpointManager(d, sweep=False)
+        try:
+            assert (wreck / "x.bin").exists()
+        finally:
+            mgr.close()
+        with pytest.warns(UserWarning, match="wreckage"):
+            mgr2 = CheckpointManager(d)  # the OWNER still sweeps
+        try:
+            assert not wreck.exists()
+        finally:
+            mgr2.close()
+
     def test_max_to_keep_rotates(self, tmp_path):
         import os
         from singa_tpu.checkpoint import CheckpointManager
@@ -248,3 +270,327 @@ class TestCheckpointManager:
             assert kept == [3, 4], kept
         finally:
             mgr.close()
+
+
+class _Hub:
+    """Shared state for in-process FakeClusters: the ack/commit ledger a
+    real Coordinator keeps, without sockets (the socket protocol itself
+    is covered by tests/test_cluster.py)."""
+
+    def __init__(self, world):
+        import threading
+        self.world = world
+        self.lock = threading.Lock()
+        self.acks = {}
+        self.committed = set()
+        self.hook = None
+
+
+class FakeCluster:
+    """Duck-typed cluster member over a _Hub. wait_commit POLLS (saves
+    from different ranks run on threads, like real processes)."""
+
+    def __init__(self, rank, hub):
+        from singa_tpu.resilience.faults import NULL_PLAN
+        self.rank = rank
+        self.world = hub.world
+        self.hub = hub
+        self.faults = NULL_PLAN
+
+    def set_commit_hook(self, hook):
+        self.hub.hook = hook
+
+    def ack_save(self, step):
+        with self.hub.lock:
+            self.hub.acks.setdefault(step, set()).add(self.rank)
+            complete = len(self.hub.acks[step]) == self.world
+        if complete and self.hub.hook is not None:
+            self.hub.hook(step)
+            with self.hub.lock:
+                self.hub.committed.add(step)
+
+    def wait_commit(self, step, timeout=30.0):
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.hub.lock:
+                if step in self.hub.committed:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def check(self):
+        pass
+
+    def health(self):
+        return {"rank": self.rank, "world": self.world, "dead": []}
+
+    def close(self):
+        pass
+
+
+def _compiled_mlp(dev, seed=7, momentum=0.9):
+    dev.SetRandSeed(seed)
+    x, y = make_xy()
+    tx = Tensor(data=x, device=dev, requires_grad=False)
+    ty = Tensor(data=y, device=dev, requires_grad=False)
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=momentum))
+    m.compile([tx], is_train=True, use_graph=True)
+    return m, tx, ty
+
+
+class TestDistributedCheckpointManager:
+    def test_solo_two_phase_markers_and_resume(self, tmp_path):
+        from singa_tpu.checkpoint import (DistributedCheckpointManager,
+                                          latest_manifest)
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(
+            tmp_path / "d", SoloCluster(0),
+            manifest_extra={"per_replica_batch": 16, "global_batch": 16})
+        try:
+            assert mgr.restore_latest(m) == 0
+            for s in range(3):
+                m(tx, ty)
+                assert mgr.save(s, m) is True     # committed
+            assert mgr.committed_steps() == [0, 1, 2]
+            man = mgr.read_manifest(2)
+            assert man["world"] == 1 and man["per_replica_batch"] == 16
+            assert latest_manifest(tmp_path / "d") == man
+        finally:
+            mgr.close()
+        # fresh "process": resume lands on the newest committed step
+        m2, tx, ty = _compiled_mlp(dev, seed=99)
+        mgr2 = DistributedCheckpointManager(tmp_path / "d",
+                                            SoloCluster(0))
+        try:
+            assert mgr2.restore_latest(m2) == 3
+            assert mgr2.restored_manifest["world"] == 1
+        finally:
+            mgr2.close()
+
+    def test_unmarked_step_is_wreckage(self, tmp_path):
+        """A step dir whose commit marker is MISSING (writer died
+        between shard-write and ACK) is swept and never restored."""
+        import os
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(tmp_path / "d", SoloCluster(0))
+        try:
+            for s in range(3):
+                m(tx, ty)
+                mgr.save(s, m)
+        finally:
+            mgr.close()
+        # simulate death-in-the-commit-hole: shard exists, marker gone
+        os.remove(tmp_path / "d" / "commits" / "2.json")
+        assert (tmp_path / "d" / "rank0" / "2").is_dir()
+
+        m2, tx, ty = _compiled_mlp(dev, seed=99)
+        mgr2 = DistributedCheckpointManager(tmp_path / "d",
+                                            SoloCluster(0))
+        try:
+            with pytest.warns(UserWarning, match="uncommitted"):
+                assert mgr2.restore_latest(m2) == 2   # step 1 + 1
+            assert not (tmp_path / "d" / "rank0" / "2").exists()
+            # and the re-run can save step 2 again (no orbax refusal)
+            m2(tx, ty)
+            assert mgr2.save(2, m2) is True
+        finally:
+            mgr2.close()
+
+    def test_two_rank_commit_and_world_shrink_resume(self, tmp_path):
+        """Two in-process 'ranks' save through the two-phase protocol;
+        a world-1 restart restores the last committed step (momentum
+        included) and reports the elastic manifest."""
+        import threading
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        hub = _Hub(2)
+        ms, mgrs = [], []
+        for r in range(2):
+            m, tx, ty = _compiled_mlp(dev)      # same seed: replicas
+            ms.append((m, tx, ty))
+            mgrs.append(DistributedCheckpointManager(
+                tmp_path / "d", FakeCluster(r, hub),
+                manifest_extra={"per_replica_batch": 8,
+                                "global_batch": 16}))
+        try:
+            for s in range(2):
+                oks = [None, None]
+                for m, tx, ty in ms:
+                    m(tx, ty)
+
+                def save(r, s=s):
+                    oks[r] = mgrs[r].save(s, ms[r][0], force=True)
+
+                ts = [threading.Thread(target=save, args=(r,))
+                      for r in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(60)
+                assert oks == [True, True]
+            assert mgrs[0].committed_steps() == [0, 1]
+            expected = {k: np.asarray(t.data) for k, t in
+                        ms[0][0].optimizer.state_tensor_dict().items()}
+        finally:
+            for g in mgrs:
+                g.close()
+
+        # elastic: restart at world 1 — resume from the committed step
+        m2, tx, ty = _compiled_mlp(dev, seed=99)
+        mgr2 = DistributedCheckpointManager(tmp_path / "d",
+                                            SoloCluster(0))
+        try:
+            with pytest.warns(UserWarning, match="elastic resume"):
+                assert mgr2.restore_latest(m2) == 2
+            assert mgr2.restored_manifest == {
+                "step": 1, "world": 2, "per_replica_batch": 8,
+                "global_batch": 16}
+            got = {k: np.asarray(t.data) for k, t in
+                   m2.optimizer.state_tensor_dict().items()}
+            assert set(got) == set(expected)
+            for k in expected:          # bit-identical, momentum incl.
+                np.testing.assert_array_equal(got[k], expected[k],
+                                              err_msg=k)
+        finally:
+            mgr2.close()
+
+    def test_markers_follow_rotation_window(self, tmp_path):
+        """Commit markers are pruned with the shard rotation: a marker
+        whose shards max_to_keep already deleted is dead weight, and a
+        stale one could vouch for a future unacked shard of the same
+        step number."""
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(tmp_path / "d", SoloCluster(0),
+                                           max_to_keep=2)
+        try:
+            for s in range(5):
+                m(tx, ty)
+                assert mgr.save(s, m, force=True) is True
+            assert mgr.committed_steps() == [3, 4]
+        finally:
+            mgr.close()
+
+    def test_agreed_resume_invalidates_stale_markers(self, tmp_path):
+        """After the cluster agrees on a resume point, markers at/after
+        it are cleared (their timeline is about to be re-run — a later
+        pre-ACK death must not hide behind a stale marker); a mere
+        local restore failure never touches them."""
+        import shutil
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(tmp_path / "d", SoloCluster(0))
+        try:
+            for s in range(3):
+                m(tx, ty)
+                mgr.save(s, m)
+        finally:
+            mgr.close()
+        shutil.rmtree(tmp_path / "d" / "rank0")    # shards wiped
+        m2, tx, ty = _compiled_mlp(dev, seed=99)
+        mgr2 = DistributedCheckpointManager(tmp_path / "d",
+                                            SoloCluster(0))
+        try:
+            with pytest.warns(UserWarning, match="starting from scratch"):
+                assert mgr2.restore_latest(m2) == 0
+            # restore itself left the shared markers alone...
+            assert mgr2.committed_steps() == [0, 1, 2]
+            # ...the post-agreement invalidation clears them
+            with pytest.warns(UserWarning, match="invalidated"):
+                assert mgr2.invalidate_markers_from(0) == 3
+            assert mgr2.committed_steps() == []
+            # and the re-run commits its own step 0 cleanly
+            m2(tx, ty)
+            assert mgr2.save(0, m2, force=True) is True
+            assert mgr2.committed_steps() == [0]
+        finally:
+            mgr2.close()
+
+    def test_publish_prune_spares_fresh_and_stale_newer_markers(
+            self, tmp_path):
+        """Rotation pruning at publish time only considers markers at
+        or below the published step: a stale higher-numbered marker
+        must not displace the marker just published."""
+        import json as _json
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(tmp_path / "d", SoloCluster(0),
+                                           max_to_keep=2)
+        try:
+            for s in (7, 9):        # stale leftovers of a wiped run
+                with open(tmp_path / "d" / "commits" / f"{s}.json",
+                          "w") as f:
+                    _json.dump({"step": s, "world": 1}, f)
+            m(tx, ty)
+            assert mgr.save(0, m, force=True) is True
+            assert 0 in mgr.committed_steps()      # fresh one survived
+        finally:
+            mgr.close()
+
+    def test_world_grow_wraps_onto_saved_shards(self, tmp_path):
+        """A rank BEYOND the saved world restores the wrapped shard
+        (rank % saved_world) — growing back after a shrink works."""
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(tmp_path / "d", SoloCluster(0))
+        try:
+            m(tx, ty)
+            assert mgr.save(0, m) is True
+            expected = float(m(tx, ty)[1].data)
+        finally:
+            mgr.close()
+        # new rank 1 of world 2: no rank1/ shards exist — wraps to rank0
+        hub = _Hub(2)
+        m2, tx, ty = _compiled_mlp(dev, seed=99)
+        mgr2 = DistributedCheckpointManager(tmp_path / "d",
+                                            FakeCluster(1, hub))
+        try:
+            assert mgr2.restore_latest(m2) == 1
+            replay = float(m2(tx, ty)[1].data)
+            np.testing.assert_allclose(replay, expected, rtol=1e-5)
+        finally:
+            mgr2.close()
+
+    def test_commit_timeout_returns_false_and_restore_refuses(
+            self, tmp_path):
+        """A rank whose ACK never completes the quorum: save() reports
+        uncommitted, no marker is published, and a later restore falls
+        back to the previous committed step."""
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        dev = device.create_cpu_device()
+        hub = _Hub(2)                    # rank 1 never acks
+        m, tx, ty = _compiled_mlp(dev)
+        mgr = DistributedCheckpointManager(
+            tmp_path / "d", FakeCluster(0, hub), commit_timeout=0.3)
+        try:
+            m(tx, ty)
+            with pytest.warns(UserWarning, match="uncommitted"):
+                assert mgr.save(0, m, force=True) is False
+            assert mgr.committed_steps() == []
+        finally:
+            mgr.close()
+        m2, tx, ty = _compiled_mlp(dev, seed=99)
+        mgr2 = DistributedCheckpointManager(tmp_path / "d",
+                                            SoloCluster(0))
+        try:
+            with pytest.warns(UserWarning, match="uncommitted"):
+                assert mgr2.restore_latest(m2) == 0   # nothing committed
+        finally:
+            mgr2.close()

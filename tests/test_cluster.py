@@ -1,0 +1,319 @@
+"""Cluster-health layer (singa_tpu/resilience/cluster.py): heartbeats,
+dead-peer/straggler detection, failing-fast barriers, and the two-phase
+commit protocol — tested IN-PROCESS over loopback sockets (one thread
+per rank), fast enough for tier-1. The real-subprocess chaos scenarios
+live in tests/test_multiprocess.py (slow tier)."""
+
+import threading
+import time
+
+import pytest
+
+from singa_tpu import network as net
+from singa_tpu.resilience.cluster import (BarrierTimeout, ClusterConfig,
+                                          MembershipError, SoloCluster,
+                                          make_cluster)
+from singa_tpu.resilience.faults import FaultPlan
+
+pytestmark = pytest.mark.skipif(
+    not net.available(), reason="native network layer unavailable")
+
+FAST = ClusterConfig(heartbeat_interval=0.1, straggler_after=0.3,
+                     dead_after=1.0, connect_timeout=10.0)
+
+
+def _free_coordinator():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def _spawn_cluster(world, faults_by_rank=None):
+    """Coordinator in this thread, workers brought up concurrently (a
+    worker's constructor blocks until its dial lands)."""
+    addr = _free_coordinator()
+    members = [None] * world
+    members[0] = make_cluster(0, world, addr, FAST,
+                              (faults_by_rank or {}).get(0))
+
+    def bring_up(r):
+        members[r] = make_cluster(r, world, addr, FAST,
+                                  (faults_by_rank or {}).get(r))
+
+    ts = [threading.Thread(target=bring_up, args=(r,))
+          for r in range(1, world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(15)
+    assert all(m is not None for m in members)
+    return members
+
+
+def _close_all(members):
+    for m in members:
+        try:
+            m.close()
+        except Exception:
+            pass
+
+
+class TestSoloCluster:
+    def test_everything_is_instant(self):
+        c = make_cluster(0, 1)
+        assert isinstance(c, SoloCluster)
+        c.barrier("x", timeout=0.0)
+        committed = []
+        c.set_commit_hook(committed.append)
+        c.ack_save(5)
+        assert c.wait_commit(5, timeout=0.0) is True
+        assert committed == [5]
+        c.check()                      # never raises
+        assert c.health()["dead"] == []
+
+    def test_multi_rank_without_coordinator_refused(self):
+        with pytest.raises(ValueError, match="coordinator"):
+            make_cluster(0, 2)
+
+
+class TestMembership:
+    def test_heartbeats_all_alive(self):
+        members = _spawn_cluster(3)
+        try:
+            time.sleep(4 * FAST.heartbeat_interval)
+            h = members[0].health()
+            assert h["alive"] == [0, 1, 2]
+            assert h["dead"] == [] and h["never_joined"] == []
+            for m in members:
+                m.check()               # no one raises
+            # workers see the digest too
+            hw = members[1].health()
+            assert hw["dead"] == []
+            assert hw["world"] == 3
+        finally:
+            _close_all(members)
+
+    def test_dropped_peer_detected_and_named(self):
+        """A rank that silently stops heartbeating (socket left up — a
+        network partition, injected via FaultPlan.drop_peer) is declared
+        dead; check() raises the recoverable MembershipError naming it,
+        on the coordinator AND on the surviving worker."""
+        plan = FaultPlan().drop_peer(2)
+        members = _spawn_cluster(3, {2: plan})
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if members[0].health()["dead"]:
+                    break
+                time.sleep(0.1)
+            with pytest.raises(MembershipError) as e0:
+                members[0].check()
+            assert e0.value.dead == [2]
+            assert "restart at world 2" in str(e0.value)
+            # the surviving worker learns from the heartbeat-ack digest
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if members[1].health()["dead"]:
+                    break
+                time.sleep(0.1)
+            with pytest.raises(MembershipError) as e1:
+                members[1].check()
+            assert 2 in e1.value.dead
+        finally:
+            _close_all(members)
+
+    def test_straggler_flagged_not_dead(self):
+        """A delayed heartbeat (shorter than dead_after) flags the rank
+        as a straggler without killing its membership."""
+        plan = FaultPlan().delay_heartbeat(3, seconds=0.5)
+        members = _spawn_cluster(2, {1: plan})
+        try:
+            saw_straggler = False
+            deadline = time.monotonic() + 6
+            while time.monotonic() < deadline:
+                h = members[0].health()
+                if 1 in h["stragglers"]:
+                    saw_straggler = True
+                    break
+                time.sleep(0.05)
+            assert saw_straggler
+            time.sleep(3 * FAST.heartbeat_interval)
+            h = members[0].health()
+            assert h["dead"] == []          # recovered, not dead
+            members[0].check()
+        finally:
+            _close_all(members)
+
+    def test_dead_coordinator_seen_by_worker(self):
+        members = _spawn_cluster(2)
+        try:
+            members[0].close()
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                if 0 in members[1].health().get("dead", []):
+                    break
+                time.sleep(0.1)
+            with pytest.raises(MembershipError) as e:
+                members[1].check()
+            assert 0 in e.value.dead
+        finally:
+            _close_all(members)
+
+
+class TestBarrier:
+    def test_barrier_completes_everywhere(self):
+        members = _spawn_cluster(3)
+        errs = []
+
+        def arrive(m):
+            try:
+                m.barrier("b", timeout=10.0)
+            except Exception as e:      # pragma: no cover - assertion aid
+                errs.append((m.rank, repr(e)))
+
+        try:
+            ts = [threading.Thread(target=arrive, args=(m,))
+                  for m in members]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(15)
+            assert errs == []
+        finally:
+            _close_all(members)
+
+    def test_barrier_timeout_names_missing_ranks(self):
+        """Rank 2 never arrives: every participant gets BarrierTimeout
+        NAMING rank 2 — nobody hangs."""
+        members = _spawn_cluster(3)
+        out = {}
+
+        def arrive(m):
+            try:
+                m.barrier("partial", timeout=1.0)
+                out[m.rank] = "completed"
+            except BarrierTimeout as e:
+                out[m.rank] = e.missing
+
+        try:
+            ts = [threading.Thread(target=arrive, args=(m,))
+                  for m in members[:2]]          # rank 2 stays away
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(15)
+            assert out[0] == [2]
+            assert out[1] == [2]
+        finally:
+            _close_all(members)
+
+    def test_barrier_fails_fast_on_dead_rank(self):
+        """A pending barrier does not wait out its full timeout once a
+        participant is DECLARED dead — it fails as soon as the monitor
+        flags the corpse, naming it."""
+        plan = FaultPlan().drop_peer(1)          # rank 1 dies ~first beat
+        members = _spawn_cluster(3, {1: plan})
+        try:
+            # wait until the monitor has declared rank 1 dead
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if 1 in members[0].health()["dead"]:
+                    break
+                time.sleep(0.05)
+            out = {}
+
+            def arrive(m):
+                t0 = time.monotonic()
+                try:
+                    m.barrier("post-death", timeout=30.0)
+                    out[m.rank] = ("completed", 0)
+                except BarrierTimeout as e:
+                    out[m.rank] = (e.missing, time.monotonic() - t0)
+
+            ts = [threading.Thread(target=arrive, args=(m,))
+                  for m in (members[0], members[2])]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(20)
+            missing0, took0 = out[0]
+            assert 1 in missing0
+            assert took0 < 10.0, "barrier waited out its timeout"
+        finally:
+            _close_all(members)
+
+
+class TestTwoPhaseCommit:
+    def test_commit_requires_every_ack(self):
+        """The marker hook fires exactly once, only after ALL ranks
+        acked; wait_commit is True on every rank."""
+        members = _spawn_cluster(3)
+        committed = []
+        members[0].set_commit_hook(committed.append)
+        try:
+            members[1].ack_save(7)
+            members[2].ack_save(7)
+            time.sleep(0.3)
+            assert committed == []       # coordinator hasn't acked yet
+            assert members[1].wait_commit(7, timeout=0.2) is False
+            members[0].ack_save(7)
+            assert members[0].wait_commit(7, timeout=5.0) is True
+            assert members[1].wait_commit(7, timeout=5.0) is True
+            assert members[2].wait_commit(7, timeout=5.0) is True
+            assert committed == [7]
+        finally:
+            _close_all(members)
+
+    def test_missing_ack_never_commits(self):
+        """A rank that dies between shard-write and ACK (here: simply
+        never acks) leaves the step uncommitted for everyone."""
+        members = _spawn_cluster(3)
+        committed = []
+        members[0].set_commit_hook(committed.append)
+        try:
+            members[0].ack_save(4)
+            members[1].ack_save(4)      # rank 2 died in the commit hole
+            assert members[0].wait_commit(4, timeout=1.0) is False
+            assert members[1].wait_commit(4, timeout=0.5) is False
+            assert committed == []
+        finally:
+            _close_all(members)
+
+    def test_late_ack_after_timeout_cannot_commit(self):
+        """Once the coordinator's wait_commit timed out (save() reported
+        the step uncommitted), a straggler's LATE ack must not publish
+        the marker after the fact."""
+        members = _spawn_cluster(2)
+        committed = []
+        members[0].set_commit_hook(committed.append)
+        try:
+            members[0].ack_save(9)
+            assert members[0].wait_commit(9, timeout=0.3) is False
+            members[1].ack_save(9)          # the straggler lands late
+            time.sleep(0.5)
+            assert committed == []          # abort held
+            assert members[0].wait_commit(9, timeout=0.2) is False
+            assert members[1].wait_commit(9, timeout=1.0) is False
+        finally:
+            _close_all(members)
+
+    def test_failed_commit_hook_aborts(self):
+        """A marker write that raises must yield commit=False everywhere
+        — a half-published commit is exactly what two-phase prevents."""
+        members = _spawn_cluster(2)
+
+        def bad_hook(step):
+            raise OSError("disk full")
+
+        members[0].set_commit_hook(bad_hook)
+        try:
+            members[1].ack_save(3)
+            with pytest.warns(UserWarning, match="commit hook"):
+                members[0].ack_save(3)
+                assert members[0].wait_commit(3, timeout=5.0) is False
+            assert members[1].wait_commit(3, timeout=5.0) is False
+        finally:
+            _close_all(members)

@@ -2,13 +2,21 @@
 (reference examples/cnn/train_multiprocess.py + train_mpi.py need real
 GPUs, NCCL, and mpirun; here two OS processes with 2 CPU devices each run
 the identical code path — coordination service, global 4-device mesh,
-cross-process psum over gloo — hermetically)."""
+cross-process psum over gloo — hermetically).
+
+The ``chaos``-marked classes are the REAL-SUBPROCESS cluster-health
+scenarios (heartbeat loss, barrier timeouts naming absentees, death in
+the two-phase-commit hole, world-size-elastic resume): each rank is an
+actual OS process over the control-plane sockets, and deaths are real
+``os._exit`` kills. ``tools/chaos_smoke.py`` runs them end-to-end under
+a wall-clock budget outside pytest."""
 
 import os
 import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow   # real multi-process bootstraps: --full tier
@@ -84,3 +92,135 @@ def test_cross_host_sharded_checkpoint():
         w1 = next(v for k, v in a.items()
                   if k.endswith("ffn.w1") and not k.startswith("optimizer"))
         assert w1.shape == (4, 16, 32)
+
+
+# ---------------------------------------------------------------------------
+# Cluster chaos: real processes, real kills, real sockets. The scenario
+# harness (rank command line, budgeted run-with-kill, commit-dir parse)
+# lives in tools/chaos_smoke.py — ONE source of truth for the pytest
+# tier and the standalone smoke, so tuning values cannot drift apart.
+# ---------------------------------------------------------------------------
+
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "chaos_smoke", os.path.join(REPO, "tools", "chaos_smoke.py"))
+chaos_smoke = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(chaos_smoke)
+
+EXIT_PREEMPTED = chaos_smoke.EXIT_PREEMPTED
+_elastic_cmd = chaos_smoke._cmd
+_committed = chaos_smoke._committed
+
+
+def _run_ranks(cmds, timeout=240):
+    return chaos_smoke._run(cmds, chaos_smoke.Budget(timeout))
+
+
+@pytest.mark.chaos
+class TestClusterChaos:
+    def test_heartbeat_loss_detected_and_survivor_exits_75(
+            self, tmp_path):
+        """Rank 1 hard-dies (os._exit, no goodbye) mid-training: the
+        coordinator detects the loss by heartbeat SILENCE, names the
+        dead rank, and exits with the recoverable supervisor code 75."""
+        port = _free_port()
+        d = tmp_path / "ck"
+        rcs, outs = _run_ranks([
+            _elastic_cmd(0, 2, port, d),
+            _elastic_cmd(1, 2, port, d,
+                         ["--die-at", "9", "--die-rank", "1"])])
+        assert rcs[1] == 1, outs[1][-2000:]          # the hard kill
+        assert rcs[0] == EXIT_PREEMPTED, outs[0][-2000:]
+        assert "rank 1 declared dead" in outs[0]
+        assert "membership lost" in outs[0] or \
+            "rank(s) [1]" in outs[0], outs[0][-2000:]
+
+    def test_barrier_timeout_names_missing_rank(self, tmp_path):
+        """Rank 0 alone at a world-2 rendezvous: the start barrier must
+        fail NAMING rank 1 (never a hang), and exit 75 (recoverable —
+        restart smaller)."""
+        port = _free_port()
+        cmd = _elastic_cmd(0, 2, port, tmp_path / "ck",
+                           ["--start-timeout", "3"])
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=180)
+        out = p.stdout + p.stderr
+        assert p.returncode == EXIT_PREEMPTED, out[-2000:]
+        assert "rank(s) [1]" in out, out[-2000:]
+
+    def test_kill_before_ack_leaves_no_committed_checkpoint(
+            self, tmp_path):
+        """Rank 1 dies AFTER its step-6 shard is durably written but
+        BEFORE its ACK: the step must never gain a commit marker, and
+        the world-1 restart must resume from the PREVIOUS committed
+        step — the shard-without-marker is swept as wreckage."""
+        port = _free_port()
+        d = tmp_path / "ck"
+        rcs, outs = _run_ranks([
+            _elastic_cmd(0, 2, port, d),
+            _elastic_cmd(1, 2, port, d,
+                         ["--kill-before-ack", "6", "--die-rank", "1"])])
+        assert rcs[1] == 1, outs[1][-2000:]
+        assert rcs[0] == EXIT_PREEMPTED, outs[0][-2000:]
+        committed = _committed(d)
+        assert 6 not in committed, committed      # the commit hole held
+        # under load an earlier commit wait may have timed out too (the
+        # abort semantics); the invariant is that NOTHING at/after the
+        # kill step committed and resume lands right after the newest
+        # committed step
+        last = max(committed, default=-1)
+        assert committed and last <= 4, committed
+        # rank 1's shard of step 6 is on disk — written, never acked
+        assert os.path.isdir(d / "rank1" / "6")
+
+        # world-1 restart: refuses the unmarked step
+        p = subprocess.run(
+            _elastic_cmd(0, 1, port, d, ["--steps", "10"]),
+            capture_output=True, text=True, timeout=240)
+        out = p.stdout + p.stderr
+        assert p.returncode == 0, out[-2000:]
+        assert f"continuing at step {last + 1}" in out, out[-2000:]
+        assert "training complete" in out
+
+    def test_elastic_resume_bit_identical_optimizer_state(
+            self, tmp_path):
+        """The acceptance scenario end-to-end: a 2-process run loses
+        rank 1 mid-training; the survivor exits 75; a world-1 restart
+        resumes from the last COMMITTED checkpoint with bit-identical
+        optimizer state (momentum included) and rescaled batch
+        accounting."""
+        port = _free_port()
+        d = tmp_path / "ck"
+        dumps = tmp_path / "dumps"
+        os.makedirs(dumps)
+        rcs, outs = _run_ranks([
+            _elastic_cmd(0, 2, port, d, ["--dump-on-save", str(dumps)]),
+            _elastic_cmd(1, 2, port, d,
+                         ["--die-at", "11", "--die-rank", "1"])])
+        assert rcs == [EXIT_PREEMPTED, 1], outs[0][-2000:]
+        committed = _committed(d)
+        # the newest committed step is normally 10, but under load the
+        # survivor's last commit wait can time out (abort semantics) —
+        # the invariant is resume == newest committed + 1, bit-identical
+        last = max(committed, default=-1)
+        assert committed and last >= 4, committed
+
+        restored = tmp_path / "restored.npz"
+        p = subprocess.run(
+            _elastic_cmd(0, 1, port, d,
+                         ["--dump-restored", str(restored)]),
+            capture_output=True, text=True, timeout=240)
+        out = p.stdout + p.stderr
+        assert p.returncode == 0, out[-2000:]
+        assert f"continuing at step {last + 1}" in out, out[-2000:]
+        assert "elastic restart — checkpoint world 2 -> 1" in out
+        assert "global batch 8 -> 4" in out       # per-replica 4 kept
+
+        a = np.load(restored)
+        b = np.load(dumps / f"state_step{last}.npz")
+        assert set(a.files) == set(b.files)
+        momentum = [k for k in a.files if k.endswith(":momentum")]
+        assert momentum, a.files                  # SGD momentum rode along
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)

@@ -185,7 +185,8 @@ class TestNetwork:
 
     def test_close_races_blocked_recv(self):
         """close() while another thread is blocked in recv(): the blocked
-        call unwinds (error or None) and nothing crashes."""
+        call returns None cleanly (the shutdown-race contract), never an
+        exception, and nothing crashes."""
         import threading
         srv = net.NetworkThread(port=0)
         cli = net.NetworkThread(port=-1)
@@ -205,5 +206,89 @@ class TestNetwork:
         cli.close()              # must wake + drain it, then free
         t.join(timeout=10.0)
         assert not t.is_alive(), "blocked recv never unwound"
-        assert results and results[0] in ("conn-error", None)
+        # a recv that was PENDING when close() ran unwinds as a clean
+        # None — an exception here would make every cluster-health
+        # receiver loop need a try/except just to shut down
+        assert results == [None], results
         srv.close()
+
+    def test_close_races_many_blocked_recvs(self):
+        """Several threads blocked in recv() on different endpoints of
+        one Net: close() unwinds all of them to None, promptly (no
+        waiting out the 30s caller timeouts)."""
+        import threading
+        import time
+        srv = net.NetworkThread(port=0)
+        cli = net.NetworkThread(port=-1)
+        eps = [cli.connect("127.0.0.1", srv.port) for _ in range(3)]
+        results = []
+        lock = threading.Lock()
+
+        def blocked(e):
+            try:
+                r = e.recv(timeout=30.0)
+            except ConnectionError:
+                r = "conn-error"
+            with lock:
+                results.append(r)
+
+        ts = [threading.Thread(target=blocked, args=(e,)) for e in eps]
+        for t in ts:
+            t.start()
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        cli.close()
+        for t in ts:
+            t.join(timeout=10.0)
+        assert all(not t.is_alive() for t in ts)
+        assert time.monotonic() - t0 < 5.0, "close waited out recv timeouts"
+        assert results == [None, None, None], results
+        srv.close()
+
+    def test_recv_started_after_close_still_raises(self):
+        """The None-on-race contract must not soften the programming
+        error: recv() on an endpoint whose Net is ALREADY closed
+        raises."""
+        srv = net.NetworkThread(port=0)
+        cli = net.NetworkThread(port=-1)
+        ep = cli.connect("127.0.0.1", srv.port)
+        cli.close()
+        with pytest.raises(ConnectionError):
+            ep.recv(timeout=0.2)
+        srv.close()
+
+    def test_accept_after_close_raises_clearly(self):
+        """accept() on a closed Net raises a clear ConnectionError
+        immediately — it must never hang out its timeout."""
+        import time
+        srv = net.NetworkThread(port=0)
+        srv.close()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="closed"):
+            srv.accept(timeout=30.0)
+        assert time.monotonic() - t0 < 1.0, "accept-after-close hung"
+
+    def test_close_races_blocked_accept(self):
+        """close() while another thread is blocked in accept(): the
+        accept unwinds promptly (None or ConnectionError, not a hang)
+        and close() itself is not blocked for the accept timeout."""
+        import threading
+        import time
+        srv = net.NetworkThread(port=0)
+        results = []
+
+        def blocked():
+            try:
+                results.append(srv.accept(timeout=30.0))
+            except ConnectionError:
+                results.append("conn-error")
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        srv.close()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "blocked accept never unwound"
+        assert time.monotonic() - t0 < 5.0, "close blocked on accept"
+        assert results and results[0] in ("conn-error", None)

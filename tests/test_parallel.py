@@ -186,6 +186,91 @@ class TestCollectiveOps:
             collective.all_to_all(t, "expert").numpy(), 1.0)
 
 
+class TestCommunicatorSingleChipDegradation:
+    """Every Communicator collective must degrade to the IDENTITY
+    outside any mesh context (a world of one), so single-chip scripts
+    run the multi-chip code path unchanged — broadcast and ppermute
+    included (they historically lacked these regression tests)."""
+
+    def _comm(self):
+        from singa_tpu.parallel.communicator import Communicator
+        return Communicator(axis_name="data")
+
+    def test_broadcast_is_identity(self):
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = self._comm().broadcast(arr, root=0)
+        np.testing.assert_array_equal(np.asarray(out), arr)
+        # a non-zero root must not matter in a world of one
+        out = self._comm().broadcast(arr, root=3)
+        np.testing.assert_array_equal(np.asarray(out), arr)
+
+    def test_ppermute_is_identity(self):
+        arr = np.arange(4, dtype=np.float32)
+        out = self._comm().ppermute(arr, perm=[(0, 1), (1, 0)])
+        np.testing.assert_array_equal(np.asarray(out), arr)
+
+    def test_all_reduce_gather_scatter_identity(self):
+        c = self._comm()
+        arr = np.ones((4, 2), np.float32)
+        for op in (lambda a: c.all_reduce(a),
+                   lambda a: c.all_gather(a),
+                   lambda a: c.reduce_scatter(a)):
+            np.testing.assert_array_equal(np.asarray(op(arr)), arr)
+
+    def test_rank_and_world_degrade(self):
+        c = self._comm()
+        assert c.rank() == 0
+        assert c.effective_world_size() == 1
+
+    def test_broadcast_inside_mesh_still_selects_root(self):
+        """The degradation must not have broken the real collective:
+        inside a shard_map context broadcast really broadcasts."""
+        from singa_tpu.parallel.communicator import Communicator
+        devs = jax.devices("cpu")[:4]
+        msh = Mesh(np.array(devs), ("data",))
+        c = Communicator(axis_name="data")
+
+        def f(x):
+            with collective_context("data"):
+                return c.broadcast(x, root=2)
+
+        mapped = shard_map(f, mesh=msh, in_specs=(P("data"),),
+                           out_specs=P("data"))
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        out = np.asarray(mapped(x))
+        for shard in out:
+            np.testing.assert_array_equal(shard, x[2])
+
+
+class TestElasticHelpers:
+    def test_rescale_batch_keeps_per_replica(self):
+        from singa_tpu.parallel.communicator import rescale_batch
+        man = {"world": 4, "per_replica_batch": 8, "global_batch": 32}
+        assert rescale_batch(man, 2) == (8, 16)
+        assert rescale_batch(man, 8) == (8, 64)
+
+    def test_rescale_batch_derives_per_replica(self):
+        from singa_tpu.parallel.communicator import rescale_batch
+        assert rescale_batch({"world": 4, "global_batch": 32}, 1) == \
+            (8, 8)
+        assert rescale_batch({"world": 2}, 1) == (None, None)
+
+    def test_elastic_mesh_warns_on_world_change(self):
+        import warnings as _w
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            msh = mesh_mod.elastic_mesh(
+                devices=jax.devices("cpu")[:2], saved_world=4)
+        assert msh.shape["data"] == 2
+        assert any("elastic mesh" in str(r.message) for r in rec)
+        # matching world: silent
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            mesh_mod.elastic_mesh(devices=jax.devices("cpu")[:2],
+                                  saved_world=2)
+        assert not [r for r in rec if "elastic" in str(r.message)]
+
+
 class TestPipeline:
     def test_forward_matches_sequential(self):
         n_stage, n_micro = 4, 8

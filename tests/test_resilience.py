@@ -624,6 +624,52 @@ class TestRetries:
         with pytest.raises(OSError, match="disk hiccup"):
             next(it)
 
+    def test_retrying_iterator_counters(self):
+        """attempts/retries/rebuilds are exposed — flakiness must be
+        observable, not silent."""
+        from singa_tpu.data import RetryingIterator
+        calls = {"n": 0}
+
+        def factory():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                def boom():
+                    raise OSError("worker died")
+                    yield  # pragma: no cover
+                return boom()
+            return iter([1, 2])
+
+        it = RetryingIterator(factory, backoff_base=0.0001,
+                              sleep=lambda s: None)
+        assert list(it) == [1, 2]
+        assert it.counters() == {"attempts": 5, "retries": 2,
+                                 "rebuilds": 2}
+
+    def test_counters_surface_in_trainer_summary(self, tmp_path):
+        """The run summary embeds the RetryingIterator counters so
+        data-pipeline flakiness shows up where operators look."""
+        from singa_tpu.data import RetryingIterator
+        m, tx, ty = fresh_model()
+        calls = {"n": 0}
+
+        def factory():
+            calls["n"] += 1
+            if calls["n"] == 2:          # second epoch opens flaky
+                def boom():
+                    raise OSError("nfs flake")
+                    yield  # pragma: no cover
+                return boom()
+            return iter([(tx, ty), (tx, ty)])
+
+        src = RetryingIterator(factory, backoff_base=0.0001,
+                               sleep=lambda s: None)
+        tr = make_trainer(m, str(tmp_path / "run"))
+        summary = tr.run(src, num_steps=5)
+        assert summary["steps_run"] == 5
+        assert summary["data_source"]["retries"] == 1
+        assert summary["data_source"]["rebuilds"] == 1
+        assert summary["data_source"]["attempts"] >= 6
+
 
 class TestEpochWrap:
     def test_finite_iterable_wraps_epochs(self, tmp_path):

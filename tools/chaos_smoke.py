@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""End-to-end cluster-chaos smoke under a hard wall-clock budget.
+
+Runs the real-subprocess elastic scenarios (the same ones
+``tests/test_multiprocess.py -m chaos`` asserts, without the pytest
+harness) against ``examples/train_elastic.py``:
+
+1. **dead-rank-elastic** — a 2-process run loses rank 1 to a hard kill;
+   the survivor exits 75; a world-1 restart resumes from the last
+   COMMITTED checkpoint with bit-identical optimizer state and rescaled
+   batch accounting.
+2. **commit-hole** — rank 1 dies after its shard is written but before
+   its ACK; the step never gains a commit marker and the restart
+   resumes from the previous committed step.
+3. **barrier-missing** — a rank never shows up at the start rendezvous;
+   the survivor names it and exits 75 instead of hanging.
+
+Every subprocess gets the REMAINING budget as its timeout, so the whole
+smoke is bounded by ``--budget`` seconds end to end (default 300) —
+exceeding it is itself a failure: a chaos path that hangs is exactly
+the bug this suite exists to catch.
+
+Usage::
+
+    python tools/chaos_smoke.py [--budget 300] [--keep-dirs]
+"""
+
+import argparse
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ELASTIC = os.path.join(REPO, "examples", "train_elastic.py")
+EXIT_PREEMPTED = 75
+
+
+class Budget:
+    def __init__(self, seconds):
+        self.deadline = time.monotonic() + seconds
+
+    def remaining(self):
+        left = self.deadline - time.monotonic()
+        if left <= 0:
+            raise TimeoutError("chaos smoke exceeded its wall-clock "
+                               "budget")
+        return left
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _cmd(rank, world, port, ckpt_dir, extra=(), steps=30):
+    return [sys.executable, ELASTIC, "--cpu", "--rank", str(rank),
+            "--world", str(world), "--coordinator", f"127.0.0.1:{port}",
+            "--dir", str(ckpt_dir), "--steps", str(steps),
+            "--save-every", "2", "--bs", "4", "--hb-interval", "0.2",
+            "--dead-after", "1.5", "--commit-timeout", "5",
+            "--start-timeout", "15"] + list(extra)
+
+
+def _run(cmds, budget):
+    procs = [subprocess.Popen(c, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for c in cmds]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=budget.remaining())[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return [p.returncode for p in procs], outs
+
+
+def _committed(ckpt_dir):
+    cdir = os.path.join(str(ckpt_dir), "commits")
+    if not os.path.isdir(cdir):
+        return []
+    # digits-only: a coordinator killed between tmp-write and rename
+    # leaves .tmp-<step>.json, which must not crash the harness
+    return sorted(int(f[:-5]) for f in os.listdir(cdir)
+                  if f.endswith(".json") and f[:-5].isdigit())
+
+
+def _check(ok, what, detail=""):
+    if not ok:
+        raise AssertionError(f"{what}\n{detail[-2000:]}")
+    print(f"  ok: {what}")
+
+
+def scenario_dead_rank_elastic(root, budget):
+    d = os.path.join(root, "ck")
+    dumps = os.path.join(root, "dumps")
+    os.makedirs(dumps)
+    port = _free_port()
+    rcs, outs = _run([
+        _cmd(0, 2, port, d, ["--dump-on-save", dumps]),
+        _cmd(1, 2, port, d, ["--die-at", "11", "--die-rank", "1"])],
+        budget)
+    _check(rcs == [EXIT_PREEMPTED, 1],
+           f"survivor exits {EXIT_PREEMPTED}, victim hard-killed "
+           f"(got {rcs})", outs[0])
+    committed = _committed(d)
+    # under load the survivor's commit wait for the last pre-death step
+    # can time out (the ABORT semantics working as designed), so the
+    # newest committed step is 10 or an earlier even step — the real
+    # invariant is resume == newest committed + 1, bit-identical
+    last = max(committed, default=-1)
+    _check(bool(committed) and last >= 4,
+           f"training committed real progress (markers: {committed})")
+    restored = os.path.join(root, "restored.npz")
+    rcs2, outs2 = _run([_cmd(0, 1, port, d,
+                             ["--dump-restored", restored])], budget)
+    _check(rcs2 == [0], f"world-1 restart completes (got {rcs2})",
+           outs2[0])
+    _check(f"continuing at step {last + 1}" in outs2[0],
+           f"resumed at step {last + 1} from committed step {last}",
+           outs2[0])
+    _check("global batch 8 -> 4" in outs2[0],
+           "batch accounting rescaled (per-replica kept)", outs2[0])
+    a = np.load(restored)
+    b = np.load(os.path.join(dumps, f"state_step{last}.npz"))
+    assert set(a.files) == set(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+    _check(any(k.endswith(":momentum") for k in a.files),
+           "bit-identical restore incl. optimizer momentum "
+           f"({len(a.files)} state entries)")
+
+
+def scenario_commit_hole(root, budget):
+    d = os.path.join(root, "ck")
+    port = _free_port()
+    rcs, outs = _run([
+        _cmd(0, 2, port, d),
+        _cmd(1, 2, port, d, ["--kill-before-ack", "6",
+                             "--die-rank", "1"])], budget)
+    _check(rcs == [EXIT_PREEMPTED, 1],
+           f"survivor exits {EXIT_PREEMPTED} after the commit-hole "
+           f"death (got {rcs})", outs[0])
+    committed = _committed(d)
+    last = max(committed, default=-1)
+    _check(6 not in committed and committed and last <= 4,
+           f"step 6 never committed (markers: {committed})")
+    _check(os.path.isdir(os.path.join(d, "rank1", "6")),
+           "the victim's shard IS on disk — written, never acked")
+    rcs2, outs2 = _run([_cmd(0, 1, port, d, ["--steps", "10"])], budget)
+    _check(rcs2 == [0] and f"continuing at step {last + 1}" in outs2[0],
+           "restart refuses the unmarked step, resumes after step "
+           f"{last}", outs2[0])
+
+
+def scenario_barrier_missing(root, budget):
+    d = os.path.join(root, "ck")
+    port = _free_port()
+    rcs, outs = _run([_cmd(0, 2, port, d, ["--start-timeout", "3"])],
+                     budget)
+    _check(rcs == [EXIT_PREEMPTED],
+           f"lone rank exits {EXIT_PREEMPTED} (got {rcs})", outs[0])
+    _check("rank(s) [1]" in outs[0],
+           "the missing rank is NAMED, not hung on", outs[0])
+
+
+SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
+             ("commit-hole", scenario_commit_hole),
+             ("barrier-missing", scenario_barrier_missing)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=300.0,
+                    help="hard wall-clock budget in seconds for the "
+                         "WHOLE smoke")
+    ap.add_argument("--keep-dirs", action="store_true")
+    ap.add_argument("--only", default=None,
+                    help="run a single scenario by name")
+    args = ap.parse_args()
+
+    budget = Budget(args.budget)
+    root = tempfile.mkdtemp(prefix="chaos_smoke_")
+    t0 = time.monotonic()
+    failed = []
+    try:
+        for name, fn in SCENARIOS:
+            if args.only and name != args.only:
+                continue
+            print(f"[chaos] {name} "
+                  f"({budget.remaining():.0f}s budget left)")
+            sdir = os.path.join(root, name)
+            os.makedirs(sdir)
+            try:
+                fn(sdir, budget)
+            except TimeoutError:
+                raise
+            except (AssertionError, Exception) as e:  # noqa: BLE001
+                failed.append(name)
+                print(f"  FAIL: {type(e).__name__}: {e}")
+    except TimeoutError as e:
+        print(f"[chaos] BUDGET EXCEEDED: {e}")
+        failed.append("budget")
+    finally:
+        if not args.keep_dirs:
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            print(f"[chaos] dirs kept under {root}")
+    took = time.monotonic() - t0
+    if failed:
+        print(f"[chaos] FAILED {failed} in {took:.0f}s")
+        sys.exit(1)
+    print(f"[chaos] all scenarios passed in {took:.0f}s "
+          f"(budget {args.budget:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()
