@@ -113,6 +113,10 @@ def run_rank(args):
         faults.kill_rank(args.die_at)
     if args.kill_before_ack >= 0 and args.rank == args.die_rank:
         faults.kill_before_ack(args.kill_before_ack)
+    if args.diverge_at >= 0 and args.rank == args.diverge_rank:
+        # silent SDC on this rank: state forks with no exception — only
+        # the cross-replica fingerprint can see it
+        faults.diverge_at(args.diverge_at, times=args.diverge_times)
 
     cluster = make_cluster(
         args.rank, args.world, args.coordinator,
@@ -139,6 +143,8 @@ def run_rank(args):
         save_interval_steps=args.save_every, cluster=cluster,
         faults=faults, commit_timeout=args.commit_timeout,
         start_barrier_timeout=args.start_timeout,
+        fingerprint_every=args.fingerprint_every,
+        max_divergence_rollbacks=args.max_divergence_rollbacks,
         manifest_extra={"per_replica_batch": per_bs,
                         "global_batch": global_bs})
 
@@ -198,6 +204,18 @@ def main():
     ap.add_argument("--kill-before-ack", type=int, default=-1,
                     help="hard-kill --die-rank after this step's shard "
                          "is written but before its commit ACK")
+    ap.add_argument("--fingerprint-every", type=int, default=0,
+                    help="cross-replica state fingerprint cadence "
+                         "(0 = off, the zero-overhead default)")
+    ap.add_argument("--max-divergence-rollbacks", type=int, default=2,
+                    help="quarantine-rollbacks before exit 76")
+    ap.add_argument("--diverge-at", type=int, default=-1,
+                    help="silently perturb --diverge-rank's params at "
+                         "this step's fingerprint check (SDC injection)")
+    ap.add_argument("--diverge-rank", type=int, default=1)
+    ap.add_argument("--diverge-times", type=int, default=1,
+                    help="how many times the divergence re-fires "
+                         "(>max-divergence-rollbacks forces exit 76)")
     ap.add_argument("--dump-on-save", default="",
                     help="dir for per-committed-step state npz dumps")
     ap.add_argument("--dump-restored", default="",

@@ -33,6 +33,10 @@ import warnings
 import numpy as np
 import jax
 
+from .integrity import (IntegrityError, digest_tree, manifest_digest,
+                        read_digest_sidecar, verify_tree,
+                        write_digest_sidecar)
+
 
 def _state_tensor_dict(model):
     """name -> LIVE Tensor for every model state + optimizer aux (no
@@ -165,16 +169,26 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, max_to_keep=3, save_interval_steps=1,
-                 sweep=True):
+                 sweep=True, digests=True):
         """``sweep=False`` skips the uncommitted-wreckage sweep at init —
         for READ-ONLY managers opened on a directory another rank owns
         (the elastic cross-rank restore path must never delete a live
-        writer's in-flight step)."""
+        writer's in-flight step). ``digests=False`` disables the
+        per-tensor content-digest sidecars (``<dir>/digests/<step>.json``)
+        written with every save and re-verified before any restore
+        hands state back — only for callers that measure the host-side
+        CRC cost and prefer orbax's own parse errors as the sole
+        corruption detector."""
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self._dir = os.path.abspath(str(directory))
         self._max_to_keep = max_to_keep
         self._save_interval_steps = save_interval_steps
+        self._digests_on = bool(digests)
+        self._digest_dir = os.path.join(self._dir, "digests")
+        # digest tree of the newest save (the distributed manager acks
+        # its manifest digest to the cluster); None when digests are off
+        self.last_saved_digests = None
         self._mgr = self._make_mgr()
         if sweep:
             self._sweep_uncommitted()
@@ -221,11 +235,153 @@ class CheckpointManager:
                         stacklevel=3)
                     shutil.rmtree(path, ignore_errors=True)
 
+    def _reopen(self):
+        """Rebuild the orbax manager after step dirs were deleted out
+        from under it (its should_save else refuses the re-run window),
+        and prune digest sidecars down to the surviving steps."""
+        self._mgr.close()
+        self._mgr = self._make_mgr()
+        self._prune_digests()
+
+    # -- content digests ---------------------------------------------------
+    def _digest_path(self, step):
+        return os.path.join(self._digest_dir, f"{int(step)}.json")
+
+    def _write_digests(self, step, tree):
+        os.makedirs(self._digest_dir, exist_ok=True)
+        write_digest_sidecar(self._digest_path(step), tree,
+                             step=int(step))
+        self._prune_digests()
+
+    def read_digests(self, step):
+        """The step's digest sidecar dict ({"algo","records","manifest"})
+        or None when absent (a pre-integrity save) / unreadable."""
+        return read_digest_sidecar(self._digest_path(step))
+
+    def _prune_digests(self, keep=None):
+        """Sidecars follow the step rotation: one whose step orbax (or a
+        wreckage sweep) already deleted is dead weight."""
+        keep = {int(s) for s in (self._mgr.all_steps()
+                                 if keep is None else keep)}
+        try:
+            names = os.listdir(self._digest_dir)
+        except OSError:
+            return
+        for n in names:
+            if n.endswith(".json") and n[:-5].isdigit() \
+                    and int(n[:-5]) not in keep:
+                try:
+                    os.remove(os.path.join(self._digest_dir, n))
+                except OSError:
+                    pass
+
+    def _verify_restored(self, step, restored, expect_manifest=None):
+        """Verify restored arrays against the step's digest sidecar
+        BEFORE they land in any live tensor — and, when the caller
+        holds a cluster-committed manifest digest, against THAT too: a
+        shard whose sidecar agrees with its own bytes but not with the
+        commit marker is a stale/foreign shard wearing the right step
+        number, and must be rejected before it touches training state.
+        Raises :class:`~singa_tpu.integrity.IntegrityError` on any
+        mismatch; returns the sidecar dict (None when the step predates
+        the integrity layer — accepted, loudly)."""
+        if not self._digests_on:
+            return None
+        expected = self.read_digests(step)
+        if expected is None:
+            if expect_manifest:
+                # the commit marker carries the cluster-agreed digest,
+                # so the shard can be verified DIRECTLY against it even
+                # without its sidecar (lost, or this rank's sidecar
+                # write failed at save time): recompute the manifest
+                # digest from the restored bytes. A healthy shard
+                # passes — no crash loop for a rank whose bookkeeping
+                # failed — while a stale/corrupt shard still fails to
+                # the next source, never reaching live tensors.
+                tree = digest_tree(restored)
+                got = manifest_digest(tree)
+                if got != expect_manifest:
+                    raise IntegrityError(
+                        f"checkpoint step {step}: no digest sidecar, "
+                        f"and the restored content ({got}) does not "
+                        f"match the cluster-committed "
+                        f"{expect_manifest} — stale or corrupt shard")
+                warnings.warn(
+                    f"checkpoint step {step}: digest sidecar missing; "
+                    "shard re-verified directly against the cluster-"
+                    "committed manifest digest", stacklevel=3)
+                return {"algo": "crc32", "records": tree,
+                        "manifest": got}
+            warnings.warn(
+                f"checkpoint step {step} has no digest sidecar (saved "
+                "before the integrity layer?); restoring UNVERIFIED",
+                stacklevel=3)
+            return None
+        bad = verify_tree(restored, expected["records"])
+        if bad:
+            raise IntegrityError(
+                f"checkpoint step {step}: content digest mismatch for "
+                f"{len(bad)} entr{'y' if len(bad) == 1 else 'ies'} "
+                f"(first: {bad[:3]}) — the shard is corrupt on disk")
+        got = expected.get("manifest")
+        if expect_manifest and got and expect_manifest != got:
+            raise IntegrityError(
+                f"checkpoint step {step}: shard manifest digest {got} "
+                f"does not match the cluster-committed "
+                f"{expect_manifest}")
+        return expected
+
     def save(self, step, model, force=False):
+        # one outstanding digest worker, like orbax's one outstanding
+        # write — and joined BEFORE the next orbax save so the worker's
+        # all_steps()-based sidecar pruning never overlaps a write
+        self._join_digest_thread()
         arrays = {k: t.data for k, t in _state_tensor_dict(model).items()}
-        return self._mgr.save(int(step),
-                              args=self._ocp.args.StandardSave(arrays),
-                              force=force)
+        saved = self._mgr.save(int(step),
+                               args=self._ocp.args.StandardSave(arrays),
+                               force=force)
+        if saved and self._digests_on:
+            # digest the SAME immutable arrays handed to orbax (jax
+            # arrays cannot change under the async write), so the
+            # sidecar vouches for exactly the bytes being persisted —
+            # but OFF the step path: the device→host transfer + CRC
+            # runs on a worker thread overlapping training, exactly
+            # like orbax's own async write, and wait() joins it. A
+            # process that dies before the join leaves a step without
+            # a sidecar, which restore treats as 'unverified' (warned)
+            # — never as verified-and-wrong.
+            import threading
+            # cleared BEFORE the worker runs: a worker that fails must
+            # leave None (ack'd as "no digest"), never the PREVIOUS
+            # step's tree masquerading as this one's
+            self.last_saved_digests = None
+
+            def digest_work(arrays=arrays, step=int(step)):
+                try:
+                    tree = digest_tree(arrays)
+                    self._write_digests(step, tree)
+                    # published only once the sidecar is ON DISK: a
+                    # digest ACKed into a commit marker must always
+                    # have the sidecar restore will check against
+                    self.last_saved_digests = tree
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    warnings.warn(
+                        f"digest sidecar for step {step} failed "
+                        f"({type(e).__name__}: {e}); the step will "
+                        "restore unverified", stacklevel=2)
+
+            self._digest_thread = threading.Thread(
+                target=digest_work, daemon=True, name="ckpt-digest")
+            self._digest_thread.start()
+        return saved
+
+    def _join_digest_thread(self):
+        t = getattr(self, "_digest_thread", None)
+        if t is not None:
+            t.join()
+            self._digest_thread = None
 
     def latest_step(self):
         return self._mgr.latest_step()
@@ -233,14 +389,24 @@ class CheckpointManager:
     def all_steps(self):
         return sorted(self._mgr.all_steps())
 
-    def _restore_step(self, step, model):
+    def _restore_step(self, step, model, expect_manifest=None):
+        """Restore + VERIFY one step into ``model``. Digest verification
+        (including the cluster-committed manifest digest, when the
+        caller passes one) runs on the restored arrays BEFORE any of
+        them lands in a live tensor, so corrupt or stale bytes never
+        reach training state — the raised IntegrityError drives the
+        caller's fallback chain (peer shards, then older steps) exactly
+        like an unreadable file does. Returns the digest sidecar (or
+        None, pre-integrity)."""
         live = _state_tensor_dict(model)
         meta = self._mgr.item_metadata(step)
         tree = dict(getattr(meta, "tree", None) or meta)
         restored = self._mgr.restore(
             step, args=self._ocp.args.StandardRestore(
                 _build_restore_template(live, tree)))
+        sidecar = self._verify_restored(step, restored, expect_manifest)
         _apply_restored(model, live, restored)
+        return sidecar
 
     def restore_latest(self, model):
         """Restore the newest RESTORABLE checkpoint into ``model`` and
@@ -256,6 +422,7 @@ class CheckpointManager:
         arrays in the live tensors; the succeeding attempt overwrites
         every entry, so the model never trains on a half-restored mix.)
         """
+        self._join_digest_thread()
         steps = sorted(self._mgr.all_steps(), reverse=True)
         for i, step in enumerate(steps):
             try:
@@ -283,8 +450,7 @@ class CheckpointManager:
                 for bad_step in steps[:i]:
                     shutil.rmtree(os.path.join(self._dir, str(bad_step)),
                                   ignore_errors=True)
-                self._mgr.close()
-                self._mgr = self._make_mgr()
+                self._reopen()
             return step + 1
         if steps:
             warnings.warn(
@@ -298,14 +464,153 @@ class CheckpointManager:
             for bad_step in steps:
                 shutil.rmtree(os.path.join(self._dir, str(bad_step)),
                               ignore_errors=True)
-            self._mgr.close()
-            self._mgr = self._make_mgr()
+            self._reopen()
         return 0
 
+    def scrub(self, delete=False):
+        """Re-verify every at-rest checkpoint against its digest
+        sidecar (no model needed: the restore template comes from the
+        checkpoint's own metadata). Returns ``{step: status}`` with
+        status one of ``"ok"``, ``"corrupt"``, ``"unreadable"``,
+        ``"unverified"`` (no sidecar — a pre-integrity save), or
+        ``"in-flight"`` (a live writer's not-yet-committed save —
+        skipped, never demoted).
+
+        With ``delete=True``, corrupt/unreadable steps are DEMOTED
+        (step dir + sidecar removed) so the rotation window only ever
+        counts — and therefore only ever deletes — *verified* steps:
+        without the demotion, a corrupt newest step would let
+        ``max_to_keep`` rotate away the last restorable one. Run it
+        periodically (cron / a background thread between steps) or via
+        ``tools/scrub_checkpoints.py``."""
+        # a step whose async orbax write is still in flight appears in
+        # all_steps() but cannot restore yet — wait() (digest join +
+        # wait_until_finished) so a healthy in-flight step is never
+        # reported, or demoted, as corrupt
+        self.wait()
+        report = {}
+        for step in self.all_steps():
+            if not os.path.isdir(os.path.join(self._dir, str(step))):
+                # a LIVE WRITER's in-flight async save: listed in
+                # all_steps() but its final-named dir only appears at
+                # commit (until then only an orbax tmp dir exists). A
+                # read-only scrubber (the CLI, the background daemon)
+                # must neither flag it as corrupt nor — with delete —
+                # demote it out from under the writer; our own wait()
+                # above only covers the in-process pipeline.
+                report[step] = "in-flight"
+                continue
+            expected = self.read_digests(step) if self._digests_on \
+                else None
+            if expected is None:
+                report[step] = "unverified"
+                continue
+            try:
+                meta = self._mgr.item_metadata(step)
+                tree = dict(getattr(meta, "tree", None) or meta)
+                template = {k: jax.ShapeDtypeStruct(tuple(m.shape),
+                                                    m.dtype)
+                            for k, m in tree.items()}
+                restored = self._mgr.restore(
+                    step,
+                    args=self._ocp.args.StandardRestore(template))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                warnings.warn(
+                    f"scrub: checkpoint step {step} is unreadable "
+                    f"({type(e).__name__}: {e})", stacklevel=2)
+                report[step] = "unreadable"
+                continue
+            bad = verify_tree(restored, expected["records"])
+            if bad:
+                warnings.warn(
+                    f"scrub: checkpoint step {step} FAILED digest "
+                    f"verification ({len(bad)} entries, first "
+                    f"{bad[:3]})", stacklevel=2)
+                report[step] = "corrupt"
+            else:
+                report[step] = "ok"
+        if delete:
+            import shutil
+            demoted = [s for s, st in report.items()
+                       if st in ("corrupt", "unreadable")]
+            for s in demoted:
+                shutil.rmtree(os.path.join(self._dir, str(s)),
+                              ignore_errors=True)
+            if demoted:
+                warnings.warn(
+                    f"scrub: demoted corrupt checkpoint step(s) "
+                    f"{demoted} so rotation keeps only verified steps",
+                    stacklevel=2)
+                self._reopen()
+        return report
+
+    def start_scrubber(self, interval=3600.0):
+        """Background at-rest verification: a daemon thread re-runs
+        :meth:`scrub` every ``interval`` seconds through its OWN
+        read-only manager (``sweep=False`` — the live writer's orbax
+        bookkeeping is never touched), warns on anything corrupt, and
+        publishes the newest result as ``self.scrub_report``.
+        Report-only by design: demotion while a writer is live is an
+        explicit decision (``scrub(delete=True)`` between runs, or the
+        ``tools/scrub_checkpoints.py`` CLI). Returns the thread;
+        ``stop_scrubber()`` (also called by ``close``) ends it."""
+        import threading
+        if getattr(self, "_scrub_stop", None) is not None:
+            if self._scrubber.is_alive():
+                raise RuntimeError("scrubber already running")
+            # a prior stop_scrubber's timed join expired while a long
+            # pass finished in the background; the thread is dead now —
+            # disarm the stale guard and start fresh
+            self._scrub_stop = None
+        self._scrub_stop = threading.Event()
+        self.scrub_report = {}
+
+        def loop(stop=self._scrub_stop):
+            # the Event is captured: stop_scrubber may null the
+            # attribute after an expired join while a long scrub pass
+            # is still mid-flight
+            while not stop.wait(float(interval)):
+                try:
+                    ro = CheckpointManager(
+                        self._dir, max_to_keep=self._max_to_keep,
+                        sweep=False, digests=self._digests_on)
+                    try:
+                        self.scrub_report = ro.scrub()
+                    finally:
+                        ro.close()
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:   # keep scrubbing next round
+                    warnings.warn(
+                        f"background scrub pass failed "
+                        f"({type(e).__name__}: {e})", stacklevel=2)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="ckpt-scrubber")
+        t.start()
+        self._scrubber = t
+        return t
+
+    def stop_scrubber(self):
+        stop = getattr(self, "_scrub_stop", None)
+        if stop is not None:
+            stop.set()
+            self._scrubber.join(timeout=5.0)
+            if not self._scrubber.is_alive():
+                # a scrub pass longer than the join grace finishes in
+                # the background and exits at its next wait(); until
+                # then the already-running guard stays armed
+                self._scrub_stop = None
+
     def wait(self):
+        self._join_digest_thread()
         self._mgr.wait_until_finished()
 
     def close(self):
+        self.stop_scrubber()
+        self._join_digest_thread()
         self._mgr.close()
 
 
@@ -368,7 +673,7 @@ class DistributedCheckpointManager(CheckpointManager):
 
     def __init__(self, directory, cluster, max_to_keep=3,
                  save_interval_steps=1, commit_timeout=60.0,
-                 manifest_extra=None):
+                 manifest_extra=None, digests=True):
         self.cluster = cluster
         self._root = os.path.abspath(str(directory))
         self._commit_dir = os.path.join(self._root, "commits")
@@ -376,11 +681,15 @@ class DistributedCheckpointManager(CheckpointManager):
         self._commit_timeout = float(commit_timeout)
         self.manifest_extra = dict(manifest_extra or {})
         self.restored_manifest = None
+        # step -> this rank's manifest digest, pending its commit marker
+        # (rank 0's publish hook reads it; bounded by the save window)
+        self._pending_digest = {}
         if cluster.rank == 0:
             cluster.set_commit_hook(self._publish_commit)
         super().__init__(os.path.join(self._root, f"rank{cluster.rank}"),
                          max_to_keep=max_to_keep,
-                         save_interval_steps=save_interval_steps)
+                         save_interval_steps=save_interval_steps,
+                         digests=digests)
 
     # -- commit markers ----------------------------------------------------
     def _marker(self, step):
@@ -405,6 +714,13 @@ class DistributedCheckpointManager(CheckpointManager):
         fully exists or not at all — no torn marker can ever pass for a
         commit."""
         manifest = {"step": int(step), "world": int(self.cluster.world)}
+        digest = self._pending_digest.pop(int(step), None)
+        if digest is not None:
+            # the manifest-level content digest: every rank ACKed this
+            # exact digest (the cluster refuses to commit disagreeing
+            # ones), so any rank's restore can cross-check its shard —
+            # even a peer's — against the cluster-agreed content
+            manifest["digest"] = digest
         manifest.update(self.manifest_extra)
         tmp = os.path.join(self._commit_dir, f".tmp-{int(step)}.json")
         with open(tmp, "w") as f:
@@ -470,8 +786,18 @@ class DistributedCheckpointManager(CheckpointManager):
         saved = super().save(step, model, force=force)
         if not saved:
             return False
-        self.wait()                       # bytes down BEFORE the ack
-        self.cluster.ack_save(step)       # fault hook: kill_before_ack
+        self.wait()     # bytes down AND digests computed BEFORE the ack
+        digest = manifest_digest(self.last_saved_digests) \
+            if self.last_saved_digests else None
+        if digest is not None:
+            self._pending_digest[int(step)] = digest
+            # bound the bookkeeping to the rotation window
+            for old in sorted(self._pending_digest)[:-self._max_to_keep]:
+                self._pending_digest.pop(old, None)
+        # the ACK carries this rank's manifest digest: the coordinator
+        # commits only when EVERY rank acked the same content, so a
+        # silently-diverged replica can never be vouched for by a marker
+        self.cluster.ack_save(step, digest=digest)  # fault: kill_before_ack
         timeout = self._commit_timeout if commit_timeout is None \
             else float(commit_timeout)
         ok = self.cluster.wait_commit(step, timeout=timeout)
@@ -497,15 +823,18 @@ class DistributedCheckpointManager(CheckpointManager):
         return [primary] + [r for r in range(saved_world)
                             if r != primary]
 
-    def _restore_foreign(self, src_rank, step, model):
+    def _restore_foreign(self, src_rank, step, model,
+                         expect_manifest=None):
         """Restore from another rank's shard directory (read-only: no
-        wreckage sweep — that dir may belong to a live writer)."""
+        wreckage sweep — that dir may belong to a live writer). The
+        peer's digest sidecar is verified exactly like our own."""
         src = CheckpointManager(
             os.path.join(self._root, f"rank{src_rank}"),
             max_to_keep=self._max_to_keep,
-            save_interval_steps=self._save_interval_steps, sweep=False)
+            save_interval_steps=self._save_interval_steps, sweep=False,
+            digests=self._digests_on)
         try:
-            src._restore_step(step, model)
+            return src._restore_step(step, model, expect_manifest)
         finally:
             src.close()
 
@@ -518,6 +847,7 @@ class DistributedCheckpointManager(CheckpointManager):
         carries the marker's manifest (saved world size + batch extras)
         for the elastic-resume accounting."""
         import shutil
+        self._join_digest_thread()
         self.restored_manifest = None
         committed = self.committed_steps()
         committed_set = set(committed)
@@ -531,8 +861,7 @@ class DistributedCheckpointManager(CheckpointManager):
             for s in wreck:
                 shutil.rmtree(os.path.join(self._dir, str(s)),
                               ignore_errors=True)
-            self._mgr.close()
-            self._mgr = self._make_mgr()
+            self._reopen()
             local -= set(wreck)
         for i, step in enumerate(reversed(committed)):
             restored = False
@@ -540,12 +869,18 @@ class DistributedCheckpointManager(CheckpointManager):
                 manifest = self.read_manifest(step)
             except (OSError, ValueError):
                 continue                       # torn marker: not ours
+            # the commit marker carries the CLUSTER-AGREED manifest
+            # digest: _verify_restored checks each candidate shard
+            # against it BEFORE any array lands in a live tensor, so a
+            # stale/foreign shard wearing the right step number is
+            # rejected without ever touching training state
+            want = manifest.get("digest")
             for src in self._source_ranks(manifest):
                 try:
                     if src == self.cluster.rank and step in local:
-                        self._restore_step(step, model)
+                        self._restore_step(step, model, want)
                     else:
-                        self._restore_foreign(src, step, model)
+                        self._restore_foreign(src, step, model, want)
                     restored = True
                     break
                 except (KeyboardInterrupt, SystemExit):
@@ -571,8 +906,7 @@ class DistributedCheckpointManager(CheckpointManager):
                     shutil.rmtree(os.path.join(self._dir, str(s)),
                                   ignore_errors=True)
                 if newer:
-                    self._mgr.close()
-                    self._mgr = self._make_mgr()
+                    self._reopen()
             self.restored_manifest = manifest
             if int(manifest.get("world", self.cluster.world)) != \
                     self.cluster.world:
@@ -597,6 +931,5 @@ class DistributedCheckpointManager(CheckpointManager):
             # that disagree about the resume step fail loudly at the
             # trainer's resume barrier; markers whose shards rotate
             # away are pruned by _publish_commit.
-            self._mgr.close()
-            self._mgr = self._make_mgr()
+            self._reopen()
         return 0

@@ -16,6 +16,8 @@ import os
 import numpy as np
 
 from . import native
+from .integrity import (IntegrityError, read_digest_sidecar,
+                        record_digest, write_digest_sidecar)
 from .tensor import Tensor
 
 
@@ -24,19 +26,79 @@ from .tensor import Tensor
 # ---------------------------------------------------------------------------
 
 class BinFileWriter:
-    """KV record-file writer (reference src/io/binfile_writer.cc)."""
+    """KV record-file writer (reference src/io/binfile_writer.cc).
 
-    def __init__(self, path=None, mode="create"):
+    ``digest=True`` accumulates a per-record content digest and writes
+    a ``<path>.digest`` sidecar on Close — ``verify_record_file`` (or
+    ``BinFileReader(..., verify=True)``) re-checks every record against
+    it, so bit-rot in an at-rest dataset/checkpoint record file is
+    caught at read time instead of training on garbage."""
+
+    def __init__(self, path=None, mode="create", digest=False):
         self._w = None
+        self._digest = bool(digest)
+        self._records = {}
+        self._count = 0
+        self._path = None
         if path is not None:
             self.Open(path, mode)
 
     def Open(self, path, mode="create"):
+        if mode == "append" and self._digest:
+            # continue the EXISTING sidecar's numbering, or the rewrite
+            # on Close would describe only the appended tail and a
+            # healthy file would fail verification. Checked BEFORE the
+            # writer opens so a refusal never leaks an open handle.
+            prior = read_digest_sidecar(path + ".digest")
+            if prior is None:
+                raise ValueError(
+                    f"append with digest=True needs {path}.digest from "
+                    "the original writer (was it written with "
+                    "digest=True?)")
+            self._records = dict(prior["records"])
+            self._count = int(prior.get("count", len(self._records)))
+        elif mode != "append":
+            self._records, self._count = {}, 0
         self._w = native.RecordWriter(path, append=(mode == "append"))
+        self._path = path
+        if mode == "append" and not self._digest and \
+                os.path.exists(path + ".digest"):
+            # appending UNVERIFIED records invalidates the old sidecar
+            # — left behind, it would flag the healthy grown file as
+            # corrupt ("sidecar out of sync"). The file is knowingly
+            # unverified from here on; say so.
+            import warnings
+            warnings.warn(
+                f"appending to {path} without digest=True: removing "
+                "its digest sidecar (the file is no longer "
+                "verifiable)", stacklevel=3)
+            try:
+                os.remove(path + ".digest")
+            except OSError:
+                pass
+        if mode != "append":
+            # a rewrite invalidates any previous writer's sidecar; left
+            # behind it would make verification flag the healthy new
+            # records as corrupt (Close rewrites it when digest=True).
+            # Removed only AFTER the writer opened: a failed open must
+            # not strip a still-valid file of its verifiability.
+            try:
+                os.remove(path + ".digest")
+            except OSError:
+                pass
         return True
 
     def Write(self, key, value):
         self._w.write(key, value)
+        if self._digest:
+            value = value.encode() if isinstance(value, str) else value
+            kb = key.encode() if isinstance(key, str) else bytes(key)
+            # index-qualified (record files may repeat keys), and named
+            # by the DECODED key — exactly how verify_record_file will
+            # look the record up when it reads the file back
+            name = f"{self._count}:{kb.decode('utf-8', 'replace')}"
+            self._records[name] = record_digest(kb, value)
+        self._count += 1
         return True
 
     def Flush(self):
@@ -46,6 +108,9 @@ class BinFileWriter:
         if self._w:
             self._w.close()
             self._w = None
+            if self._digest and self._path:
+                write_digest_sidecar(self._path + ".digest",
+                                     self._records, count=self._count)
 
     write = Write
     flush = Flush
@@ -58,17 +123,62 @@ class BinFileWriter:
         self.Close()
 
 
+def verify_record_file(path):
+    """Re-verify every record of ``path`` against its ``<path>.digest``
+    sidecar. Returns the number of records verified; raises
+    :class:`~singa_tpu.integrity.IntegrityError` on the first mismatch
+    (or on a record count that disagrees — truncation), and
+    ``FileNotFoundError`` when no sidecar exists to verify against."""
+    sidecar = read_digest_sidecar(path + ".digest")
+    if sidecar is None:
+        raise FileNotFoundError(f"{path}.digest: no digest sidecar")
+    records = sidecar["records"]
+    reader = native.RecordReader(path)
+    n = 0
+    try:
+        while True:
+            rec = reader.read()
+            if rec is None:
+                break
+            key, value = rec
+            name = f"{n}:{key.decode('utf-8', 'replace')}"
+            want = records.get(name)
+            if want is None:
+                raise IntegrityError(
+                    f"{path}: record #{n} ({key!r}) has no digest "
+                    "entry — sidecar out of sync with the file")
+            if record_digest(key, value) != want:
+                raise IntegrityError(
+                    f"{path}: record #{n} ({key!r}) failed its content "
+                    "digest — corrupt record file")
+            n += 1
+    finally:
+        reader.close()
+    count = sidecar.get("count")
+    if count is not None and n != int(count):
+        raise IntegrityError(
+            f"{path}: {n} records on disk but the sidecar digested "
+            f"{count} — truncated or appended-to record file")
+    return n
+
+
 class BinFileReader:
     """KV record-file reader w/ optional background prefetch thread
-    (reference src/io/binfile_reader.cc)."""
+    (reference src/io/binfile_reader.cc). ``verify=True`` re-checks the
+    whole file against its ``<path>.digest`` sidecar (written by
+    ``BinFileWriter(digest=True)``) before the first record is handed
+    out."""
 
-    def __init__(self, path=None, prefetch=64):
+    def __init__(self, path=None, prefetch=64, verify=False):
         self._r = None
         self._prefetch = prefetch
+        self._verify = bool(verify)
         if path is not None:
             self.Open(path)
 
     def Open(self, path, capacity=None):
+        if self._verify:
+            verify_record_file(path)
         self._r = native.RecordReader(path, prefetch=self._prefetch)
         return True
 

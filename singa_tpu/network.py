@@ -28,6 +28,9 @@ import os
 import subprocess
 import threading
 
+from .integrity import (IntegrityError, MAX_MESSAGE_BYTES, open_frame,
+                        seal_frame)
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _NATIVE_DIR = os.path.join(os.path.dirname(_HERE), "native")
 _PACKAGED_LIB = os.path.join(_HERE, "native", "libsinga_network.so")
@@ -158,9 +161,17 @@ class EndPoint:
         msg.id = mid
         return mid
 
-    def recv(self, timeout: float = 5.0) -> Message | None:
+    def recv(self, timeout: float = 5.0,
+             max_bytes: int | None = None) -> Message | None:
         """Next message, or None on timeout. Raises when the connection
-        died and nothing is queued.
+        died and nothing is queued. ``max_bytes`` (optional) rejects a
+        frame whose meta or payload exceeds it — the frame is consumed
+        and a typed :class:`~singa_tpu.integrity.IntegrityError` raised
+        before the Python-side buffers are built. Unset by default: the
+        general message layer supports anything up to the native
+        runtime's own 1 GiB frame cap; ``recv_sealed`` (the
+        control-plane path) applies :data:`~singa_tpu.integrity.
+        MAX_MESSAGE_BYTES`.
 
         The native wait runs in SHORT slices with the net guard released
         between them, so ``NetworkThread.close()`` is never blocked for a
@@ -190,6 +201,23 @@ class EndPoint:
                             ctypes.byref(ms), ctypes.byref(ps))
                         if rc < 0:
                             raise ConnectionError("endpoint closed")
+                        if rc > 0 and max_bytes is not None and \
+                                (ms.value > max_bytes or
+                                 ps.value > max_bytes):
+                            # a frame far beyond what this caller's
+                            # protocol ever sends: don't build the
+                            # Python-side buffers for it. The frame is
+                            # CONSUMED (zero-capacity copy pops it; the
+                            # native layer truncates, never overflows)
+                            # so the endpoint stays usable, then the
+                            # typed error surfaces.
+                            _load().sg_ep_recv_copy(h, self._h, None, 0,
+                                                    None, 0)
+                            raise IntegrityError(
+                                f"oversized frame (meta {ms.value}B / "
+                                f"payload {ps.value}B > "
+                                f"{max_bytes}B cap): corrupt "
+                                "length header? (frame dropped)")
                         if rc > 0:
                             meta = ctypes.create_string_buffer(
                                 max(1, ms.value))
@@ -214,6 +242,33 @@ class EndPoint:
                     raise
                 if remaining <= 0:
                     return None
+
+    def send_sealed(self, msg: Message) -> int:
+        """``send`` with the integrity frame header (magic + protocol
+        version + CRCs over meta and payload + length fields) sealed
+        onto the payload — the counterpart ``recv_sealed`` verifies it.
+        The frame format is :func:`singa_tpu.integrity.seal_frame` —
+        the SAME frames the cluster layer builds (it seals via
+        ``integrity.seal_frame`` directly, because its fault-injection
+        and drop-and-count hooks sit between sealing and the socket);
+        these helpers are the convenience pair for other EndPoint
+        users."""
+        return self.send(Message(msg.meta,
+                                 seal_frame(msg.meta, msg.payload)))
+
+    def recv_sealed(self, timeout: float = 5.0) -> Message | None:
+        """``recv`` + verify-and-strip of the integrity frame header.
+        Returns None on timeout like ``recv``; a frame that fails any
+        check (magic, version, truncation, length, CRC — or the
+        control-plane ``MAX_MESSAGE_BYTES`` cap, enforced before the
+        Python buffers are built) raises
+        :class:`~singa_tpu.integrity.IntegrityError` — the corrupt
+        frame is consumed, so the connection stays usable and the
+        caller decides whether to drop-and-count or tear down."""
+        msg = self.recv(timeout, max_bytes=MAX_MESSAGE_BYTES)
+        if msg is None:
+            return None
+        return Message(msg.meta, open_frame(msg.meta, msg.payload))
 
     def drain(self, timeout: float = 5.0) -> bool:
         """Wait until every sent message has been acknowledged."""

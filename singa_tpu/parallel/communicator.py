@@ -151,6 +151,46 @@ def rescale_batch(manifest, new_world):
     return int(per), int(per) * int(new_world)
 
 
+def replica_fingerprint(arrays, axis_name="data"):
+    """In-graph cross-replica parameter fingerprint (integrity layer).
+
+    Each replica reduces every array to two cheap scalars — sum and
+    sum-of-squares in f32 — stacks them into one small vector, and
+    all-gathers that vector over ``axis_name``. On healthy hardware the
+    gathered rows are IDENTICAL (data-parallel params are replicated
+    and every replica ran the same program); a row that differs is
+    silent data corruption or a non-deterministic kernel on that
+    replica. Returns ``(gathered, agree)``: ``gathered`` has shape
+    ``(axis_size, 2 * len(arrays))`` and ``agree`` is a scalar bool
+    (all rows BITWISE-equal the first — the vectors are compared as
+    int32 bit patterns, so identical computations agree even through a
+    NaN, and SDC does not need a large epsilon to be seen). Outside a
+    mesh context (or on an inactive axis) there is nothing to compare
+    with: the local vector comes back with ``agree=True``.
+
+    Cost: one tiny all-gather of ``2 * n_params`` f32 scalars riding
+    the step's existing collectives — cheap enough to run on a cadence.
+    Limitation of the lossy reduction: two replicas whose sums both
+    saturate (e.g. to the same inf) from DIFFERENT values compare
+    equal; the host-side counterpart for cross-PROCESS agreement —
+    :func:`singa_tpu.integrity.state_fingerprint` over the cluster
+    control plane — digests every byte and has no such blind spot."""
+    parts = []
+    for a in arrays:
+        x = jnp.asarray(getattr(a, "data", a)).astype(jnp.float32)
+        parts.append(jnp.sum(x))
+        parts.append(jnp.sum(x * x))
+    vec = jnp.stack(parts) if parts else jnp.zeros((0,), jnp.float32)
+    if active_axis(axis_name):
+        gathered = lax.all_gather(vec, axis_name)
+        # bitwise comparison: float == would call bit-identical NaN
+        # rows "divergent" (NaN != NaN) on perfectly healthy replicas
+        bits = lax.bitcast_convert_type(gathered, jnp.int32)
+        agree = jnp.all(bits == bits[0:1])
+        return gathered, agree
+    return vec[None], jnp.asarray(True)
+
+
 class Communicator:
     """All-reduce (and friends) over the mesh 'data' axis.
 

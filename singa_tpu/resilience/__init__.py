@@ -32,14 +32,25 @@ package adds the three layers that keep work alive:
   Membership loss is recoverable: exit 75, restart at the surviving
   world size, resume from the last committed checkpoint (world-size-
   elastic re-sharding included).
+
+On top of the fail-stop story above sits the END-TO-END INTEGRITY
+layer (:mod:`singa_tpu.integrity`): checkpoint shards carry content
+digests verified on restore (and re-verified at rest by
+``CheckpointManager.scrub`` / ``tools/scrub_checkpoints.py``), every
+control-plane frame rides a CRC behind a versioned hello, and a
+periodic cross-replica fingerprint quarantines silently-diverged
+state and rolls back to the last verified, cluster-agreed checkpoint
+— exiting :data:`EXIT_DIVERGED` (76, distinct from 75: cordon the
+suspect host, don't just relaunch) when divergence repeats.
 """
 
-from .runtime import (EXIT_PREEMPTED, ResilientTrainer,  # noqa: F401
+from .runtime import (EXIT_DIVERGED, EXIT_PREEMPTED,      # noqa: F401
+                      DivergenceError, ResilientTrainer,
                       StepTimeoutError)
 from .guards import GuardedOptimizer                      # noqa: F401
 from .faults import (FaultInjected, FaultPlan,            # noqa: F401
-                     SimulatedCrash, corrupt_checkpoint,
-                     truncate_checkpoint)
+                     SimulatedCrash, bitflip_checkpoint,
+                     corrupt_checkpoint, truncate_checkpoint)
 from .cluster import (BarrierTimeout, ClusterConfig,      # noqa: F401
                       ClusterError, MembershipError, SoloCluster,
                       make_cluster)

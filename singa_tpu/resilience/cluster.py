@@ -52,7 +52,15 @@ import warnings
 from dataclasses import dataclass
 
 from .. import network as net
+from ..integrity import (MAX_MESSAGE_BYTES, IntegrityError, open_frame,
+                         seal_frame)
 from .faults import NULL_PLAN, DropPeerSignal as _DropPeerSignal
+
+# control-plane protocol version, negotiated in the hello handshake: a
+# peer speaking a different framing/message dialect is REJECTED at join
+# (named, loudly) instead of being mis-parsed for the whole run. Bump on
+# any incompatible change to the message set or frame format.
+PROTO_VERSION = 1
 
 
 class ClusterError(RuntimeError):
@@ -103,11 +111,19 @@ def _addr(coordinator: str):
 
 
 def _msg(kind: str, **payload) -> net.Message:
-    return net.Message(kind.encode(), json.dumps(payload).encode())
+    """A SEALED control-plane message: the JSON payload rides behind the
+    integrity frame header (magic + protocol version + CRCs), so a
+    corrupted frame is detected before any parsing."""
+    meta = kind.encode()
+    raw = json.dumps(payload).encode()
+    return net.Message(meta, seal_frame(meta, raw))
 
 
 def _payload(msg: net.Message) -> dict:
-    return json.loads(msg.payload.decode() or "{}")
+    """Verify + parse a sealed message; raises
+    :class:`~singa_tpu.integrity.IntegrityError` on a corrupt frame
+    (receive loops drop-and-count those — see ``_open``)."""
+    return json.loads(open_frame(msg.meta, msg.payload).decode() or "{}")
 
 
 # decided commit steps kept in memory per rank — coordinator and worker
@@ -116,11 +132,66 @@ def _payload(msg: net.Message) -> dict:
 COMMIT_WINDOW = 16
 
 
+def _prune_window(decided, *others):
+    """Bound per-step/per-round bookkeeping to the newest COMMIT_WINDOW
+    decided keys — older entries can never be waited on again. One
+    helper for the commit AND fingerprint slots on both coordinator and
+    worker, so the four windows can never drift apart. ``others`` may
+    be dicts or sets keyed like ``decided``."""
+    for old in sorted(decided)[:-COMMIT_WINDOW]:
+        decided.pop(old, None)
+        for m in others:
+            if isinstance(m, set):
+                m.discard(old)
+            else:
+                m.pop(old, None)
+
+
 class ClusterBase:
     """API shared by coordinator, worker, and the solo degenerate."""
 
     rank: int = 0
     world: int = 1
+    _wire_seq = 0          # sent-frame counter (fault-injection keying)
+    _wire_errors = 0       # corrupt frames dropped by this member
+    _WIRE_WARN_LIMIT = 5   # warn the first few, count the rest silently
+
+    # -- wire integrity ----------------------------------------------------
+    def _send(self, ep, kind, **payload):
+        """Seal and send one control-plane message. The fault hook runs
+        on the SEALED bytes, so an injected bit-flip is exactly what a
+        corrupted TCP frame looks like to the receiver's CRC."""
+        msg = _msg(kind, **payload)
+        self._wire_seq += 1
+        msg.payload = self.faults.on_wire_send(self._wire_seq,
+                                               msg.payload)
+        ep.send(msg)
+
+    def _open(self, msg):
+        """Unseal + parse an inbound message; a frame failing any
+        integrity check is dropped and counted (returns None) — the
+        periodic/timeout nature of every protocol (heartbeats re-send,
+        barriers and commits time out loudly) covers the loss, and
+        garbage NEVER reaches protocol parsing."""
+        try:
+            return _payload(msg)
+        except (IntegrityError, ValueError, UnicodeDecodeError) as e:
+            self._note_wire_error(e)
+            return None
+
+    def _note_wire_error(self, exc):
+        self._wire_errors += 1
+        if self._wire_errors <= self._WIRE_WARN_LIMIT:
+            warnings.warn(
+                f"cluster rank {self.rank}: dropped corrupt "
+                f"control-plane frame #{self._wire_errors} "
+                f"({exc}); protocol timeouts/retries absorb the loss",
+                stacklevel=2)
+
+    @property
+    def wire_errors(self) -> int:
+        """Corrupt control-plane frames this member has dropped."""
+        return self._wire_errors
 
     # -- health ------------------------------------------------------------
     def health(self) -> dict:
@@ -143,10 +214,32 @@ class ClusterBase:
         the checkpoint layer's marker write."""
         self._commit_hook = hook
 
-    def ack_save(self, step: int):
+    def ack_save(self, step: int, digest=None):
+        """ACK a durably-written shard. ``digest`` (optional) is the
+        shard's manifest content digest: the coordinator compares the
+        digests of ALL ranks before publishing — replicas that disagree
+        mean divergence, and the step stays uncommitted rather than
+        vouching for forked state."""
         raise NotImplementedError
 
     def wait_commit(self, step: int, timeout: float = 30.0) -> bool:
+        raise NotImplementedError
+
+    # -- cross-replica fingerprint agreement --------------------------------
+    def fingerprint_agree(self, seq: int, fp: str,
+                          timeout: float = 30.0):
+        """Exchange this rank's state fingerprint and wait for the
+        cluster verdict. ``seq`` is a monotonically increasing check id
+        identical across ranks — NOT the step number: a step re-run
+        after a quarantine rollback must open a FRESH agreement round,
+        never reuse the stale verdict of its first run. Returns
+        ``(ok, divergent_ranks)`` — ``ok`` False when the fingerprints
+        disagree (``divergent_ranks`` names the minority; attribution
+        is majority-vote, so a 1-vs-1 tie names one side arbitrarily).
+        A verdict that does not arrive within ``timeout`` returns
+        ``(True, [])`` with a warning: a control-plane hiccup must not
+        roll back healthy training, and a dead coordinator is caught by
+        the membership check."""
         raise NotImplementedError
 
     def close(self):
@@ -172,18 +265,24 @@ class SoloCluster(ClusterBase):
 
     def health(self):
         return {"rank": self.rank, "world": 1, "alive": [self.rank],
-                "dead": [], "stragglers": [], "heartbeat_age": {}}
+                "dead": [], "stragglers": [], "heartbeat_age": {},
+                "wire_errors": 0}
 
     def barrier(self, name, timeout=30.0):
         return
 
-    def ack_save(self, step):
+    def ack_save(self, step, digest=None):
         self.faults.on_ack(int(step))
         if self._commit_hook is not None:
             self._commit_hook(int(step))
 
     def wait_commit(self, step, timeout=30.0):
         return True
+
+    def fingerprint_agree(self, seq, fp, timeout=30.0):
+        # a world of one has no peer to disagree with; cross-DEVICE
+        # divergence is covered by integrity.replica_buffer_mismatches
+        return True, []
 
 
 class Coordinator(ClusterBase):
@@ -215,9 +314,15 @@ class Coordinator(ClusterBase):
         # timeout against a ghost slot that can never complete
         self._failed_barriers: dict[str, list] = {}
         self._acks: dict[int, set] = {}
+        self._ack_digests: dict[int, dict] = {}  # step -> {rank: digest}
         self._commit_done: dict[int, threading.Event] = {}
         self._commit_ok: dict[int, bool] = {}
         self._commit_claimed: set[int] = set()   # publish/abort decided
+        # cross-replica fingerprint agreement (same bounded window)
+        self._fp: dict[int, dict] = {}           # seq -> {rank: fp}
+        self._fp_done: dict[int, threading.Event] = {}
+        self._fp_result: dict[int, tuple] = {}   # seq -> (ok, divergent)
+        self._fp_claimed: set[int] = set()       # verdict decided
         self._threads = []
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="cluster-accept")
@@ -246,43 +351,90 @@ class Coordinator(ClusterBase):
                              daemon=True, name="cluster-join").start()
 
     def _join_then_serve(self, ep):
+        """The versioned hello handshake: verify the sealed hello and
+        its protocol version, answer ``hello-ack`` (or ``hello-reject``
+        naming both versions), THEN register the peer. A peer speaking
+        an incompatible dialect is turned away at the door instead of
+        being mis-parsed for the whole run."""
         try:
-            hello = ep.recv(timeout=5.0)
-        except ConnectionError:
+            hello = ep.recv(timeout=5.0, max_bytes=MAX_MESSAGE_BYTES)
+        except (ConnectionError, IntegrityError):
             ep.close()       # dialer died mid-handshake: free the slot
             return
         if hello is None or hello.meta != b"hello":
             ep.close()
             return
-        rank = int(_payload(hello)["rank"])
+        try:
+            data = _payload(hello)
+        except (IntegrityError, ValueError, UnicodeDecodeError) as e:
+            # unsealed (pre-integrity peer) or corrupted hello
+            self._note_wire_error(e)
+            self._reject(ep, f"unreadable hello ({e})")
+            return
+        proto = int(data.get("proto", 0))
+        if proto != PROTO_VERSION:
+            warnings.warn(
+                f"cluster: rejecting join from rank "
+                f"{data.get('rank', '?')}: protocol version {proto} "
+                f"(this coordinator speaks {PROTO_VERSION})",
+                stacklevel=2)
+            self._reject(ep, f"protocol version {proto} unsupported")
+            return
+        rank = int(data["rank"])
+        try:
+            self._send(ep, "hello-ack", proto=PROTO_VERSION,
+                       world=self.world)
+        except ConnectionError:
+            ep.close()
+            return
         with self._lock:
             self._peers[rank] = ep
             self._last_hb[rank] = time.monotonic()
             self._dead.discard(rank)
         self._peer_loop(rank, ep)
 
+    def _reject(self, ep, reason):
+        try:
+            self._send(ep, "hello-reject", proto=PROTO_VERSION,
+                       reason=reason)
+            ep.drain(timeout=1.0)    # let the verdict reach the dialer
+        except ConnectionError:
+            pass
+        ep.close()
+
     def _peer_loop(self, rank, ep):
         while self._running:
             try:
-                msg = ep.recv(timeout=self.cfg.recv_slice)
+                msg = ep.recv(timeout=self.cfg.recv_slice,
+                              max_bytes=MAX_MESSAGE_BYTES)
             except ConnectionError:
                 return          # monitor will declare it dead by silence
+            except IntegrityError as e:
+                # oversized-frame guard: the frame was consumed by the
+                # network layer — drop, count, keep serving the peer
+                self._note_wire_error(e)
+                continue
             if msg is None:
                 continue
+            data = self._open(msg)
+            if data is None:
+                continue        # corrupt frame: dropped and counted
             kind = msg.meta.decode()
-            data = _payload(msg)
             if kind == "hb":
                 with self._lock:
                     self._last_hb[rank] = time.monotonic()
                     self._hb_count[rank] = self._hb_count.get(rank, 0) + 1
                 try:
-                    ep.send(_msg("hb-ack", **self._digest()))
+                    self._send(ep, "hb-ack", **self._digest())
                 except ConnectionError:
                     return
             elif kind == "barrier":
                 self._barrier_arrive(data["name"], rank)
             elif kind == "ack":
-                self._ack_arrive(int(data["step"]), rank)
+                self._ack_arrive(int(data["step"]), rank,
+                                 data.get("digest"))
+            elif kind == "fp":
+                self._fp_arrive(int(data["seq"]), rank, data.get("fp"))
 
     def _monitor_loop(self):
         while self._running:
@@ -325,6 +477,7 @@ class Coordinator(ClusterBase):
                 "heartbeat_age": ages,
                 "heartbeats": {str(r): c
                                for r, c in self._hb_count.items()},
+                "wire_errors": self._wire_errors,
             }
 
     # -- health ------------------------------------------------------------
@@ -368,8 +521,8 @@ class Coordinator(ClusterBase):
             # time out again and falsely blame the coordinator
             if rank != 0 and ep is not None:
                 try:
-                    ep.send(_msg("barrier-fail", name=name,
-                                 missing=failed))
+                    self._send(ep, "barrier-fail", name=name,
+                               missing=failed)
                 except ConnectionError:
                     pass
             return
@@ -404,7 +557,7 @@ class Coordinator(ClusterBase):
                    if (ranks is None or r in ranks) and r not in self._dead]
         for _r, ep in eps:
             try:
-                ep.send(_msg(kind, **payload))
+                self._send(ep, kind, **payload)
             except ConnectionError:
                 pass
 
@@ -436,10 +589,12 @@ class Coordinator(ClusterBase):
                 self._acks.setdefault(step, set())
             return ev
 
-    def _ack_arrive(self, step, rank):
+    def _ack_arrive(self, step, rank, digest=None):
         ev = self._commit_slot(step)
         with self._lock:
             self._acks[step].add(rank)
+            if digest is not None:
+                self._ack_digests.setdefault(step, {})[rank] = digest
             complete = len(self._acks[step]) == self.world
             # claim the publish under the lock: a quorum completing
             # AFTER wait_commit's timeout aborted the step must not
@@ -447,11 +602,26 @@ class Coordinator(ClusterBase):
             claim = complete and step not in self._commit_claimed
             if claim:
                 self._commit_claimed.add(step)
+            digests = dict(self._ack_digests.get(step, {}))
         if claim:
+            # full replicas must be bit-identical: ACK digests that
+            # disagree mean a replica diverged, and a commit marker must
+            # never vouch for forked state — the step stays uncommitted
+            # (every checkpoint that DOES commit is therefore
+            # cross-replica-agreed, which is what makes "roll back to
+            # the last committed step" a divergence recovery)
+            ok = len({d for d in digests.values()}) <= 1
+            if not ok:
+                groups: dict = {}
+                for r, d in digests.items():
+                    groups.setdefault(d, []).append(r)
+                warnings.warn(
+                    f"checkpoint step {step}: shard content digests "
+                    f"disagree across ranks ({groups}) — replicas have "
+                    "diverged; the step stays uncommitted", stacklevel=2)
             # publish the marker (the checkpoint layer's atomic write)
             # BEFORE telling anyone the step committed
-            ok = True
-            if self._commit_hook is not None:
+            if ok and self._commit_hook is not None:
                 try:
                     self._commit_hook(step)
                 except Exception as e:      # marker write failed: abort
@@ -461,20 +631,15 @@ class Coordinator(ClusterBase):
                     ok = False
             with self._lock:
                 self._commit_ok[step] = ok
-                # bound the per-step bookkeeping: decided steps beyond
-                # the window can never be waited on again
-                decided = sorted(self._commit_ok)
-                for old in decided[:-COMMIT_WINDOW]:
-                    self._commit_ok.pop(old, None)
-                    self._acks.pop(old, None)
-                    self._commit_done.pop(old, None)
-                    self._commit_claimed.discard(old)
+                _prune_window(self._commit_ok, self._acks,
+                              self._ack_digests, self._commit_done,
+                              self._commit_claimed)
             ev.set()
             self._broadcast("commit", step=step, ok=ok)
 
-    def ack_save(self, step):
+    def ack_save(self, step, digest=None):
         self.faults.on_ack(int(step))
-        self._ack_arrive(int(step), 0)
+        self._ack_arrive(int(step), 0, digest)
 
     def wait_commit(self, step, timeout=30.0):
         step = int(step)
@@ -495,6 +660,82 @@ class Coordinator(ClusterBase):
                 ev.wait(5.0)     # publish decision in flight; let it land
         with self._lock:
             return bool(self._commit_ok.get(step))
+
+    # -- cross-replica fingerprint agreement --------------------------------
+    def _fp_slot(self, seq):
+        with self._lock:
+            ev = self._fp_done.get(seq)
+            if ev is None:
+                ev = threading.Event()
+                self._fp_done[seq] = ev
+                self._fp.setdefault(seq, {})
+            return ev
+
+    def _fp_arrive(self, seq, rank, fp):
+        ev = self._fp_slot(seq)
+        with self._lock:
+            self._fp[seq][rank] = fp
+            complete = len(self._fp[seq]) == self.world
+            # claim the verdict under the lock: a straggler's fp
+            # landing AFTER fingerprint_agree's timeout already
+            # recorded "agreed" must not broadcast a contradicting
+            # late verdict (workers quarantining while rank 0 trains
+            # on would strand the lockstep barriers) — same rule as
+            # _commit_claimed on the commit path
+            if not complete or seq in self._fp_claimed:
+                return
+            self._fp_claimed.add(seq)
+            values = list(self._fp[seq].values())
+            # deterministic tie-break (count, then the fp string): a
+            # 1-vs-1 tie cannot attribute blame either way, but the
+            # verdict must not depend on set-iteration hash order
+            majority = max(sorted(set(values)), key=values.count)
+            divergent = sorted(r for r, v in self._fp[seq].items()
+                               if v != majority)
+            ok = not divergent
+            self._fp_result[seq] = (ok, divergent)
+            _prune_window(self._fp_result, self._fp, self._fp_done,
+                          self._fp_claimed)
+        if not ok:
+            warnings.warn(
+                "cross-replica fingerprint DISAGREEMENT (check round "
+                f"{seq}): rank(s) {divergent} hold a minority state "
+                "(silent divergence — quarantine and roll back)",
+                stacklevel=2)
+        ev.set()
+        self._broadcast("fp-result", seq=seq, ok=ok,
+                        divergent=divergent)
+
+    def fingerprint_agree(self, seq, fp, timeout=30.0):
+        seq = int(seq)
+        ev = self._fp_slot(seq)
+        self._fp_arrive(seq, 0, fp)
+        if not ev.wait(timeout):
+            warnings.warn(
+                f"fingerprint agreement round {seq} timed out after "
+                f"{timeout:.0f}s (a rank stalled?); treating as agreed —"
+                " membership checks cover a dead peer", stacklevel=2)
+            with self._lock:
+                # claim + record the non-verdict: the round is DECIDED
+                # as "agreed" — a straggler's late fp can no longer
+                # complete it into a contradicting broadcast, and the
+                # window pruning reaches the slot (a lost 'fp' frame
+                # must not leak its Event forever) — same rules as
+                # wait_commit's timeout abort. If the round completed
+                # and BROADCAST in the race window between our wait
+                # expiring and this lock, that verdict was already
+                # sent to every worker: return IT (not the literal
+                # "agreed"), or rank 0 would train on while its
+                # workers quarantine and the lockstep barriers strand
+                if seq not in self._fp_claimed:
+                    self._fp_claimed.add(seq)
+                    self._fp_result[seq] = (True, [])
+                result = self._fp_result.get(seq, (True, []))
+                _prune_window(self._fp_result, self._fp, self._fp_done,
+                              self._fp_claimed)
+            return result
+        with self._lock:
+            return self._fp_result.get(seq, (True, []))
 
     # -- teardown ----------------------------------------------------------
     def close(self):
@@ -523,15 +764,67 @@ class Worker(ClusterBase):
         self._barriers: dict[str, dict] = {}
         self._commit_done: dict[int, threading.Event] = {}
         self._commit_ok: dict[int, bool] = {}
+        self._fp_done: dict[int, threading.Event] = {}
+        self._fp_result: dict[int, tuple] = {}
         host, port = _addr(coordinator)
         self._ep = self._dial(host, port)
-        self._ep.send(_msg("hello", rank=self.rank))
+        try:
+            self._hello(host, port)
+        except BaseException:
+            self._net.close()
+            raise
         self._threads = []
         for target, name in ((self._rx_loop, "rx"), (self._hb_loop, "hb")):
             t = threading.Thread(target=target, daemon=True,
                                  name=f"cluster-{name}-{rank}")
             t.start()
             self._threads.append(t)
+
+    def _hello(self, host, port):
+        """Versioned hello: announce our rank + protocol version and
+        wait for the coordinator's verdict — ``hello-ack`` joins,
+        ``hello-reject`` (or silence from a pre-integrity coordinator
+        that cannot read the sealed hello) fails LOUDLY here, at join,
+        instead of as mis-parsed messages mid-run."""
+        self._send(self._ep, "hello", rank=self.rank,
+                   proto=PROTO_VERSION)
+        deadline = time.monotonic() + self.cfg.connect_timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ClusterError(
+                    f"rank {self.rank}: no hello-ack from coordinator "
+                    f"{host}:{port} within "
+                    f"{self.cfg.connect_timeout:.0f}s (version-"
+                    "mismatched or unreachable control plane?)")
+            try:
+                msg = self._ep.recv(timeout=min(1.0, remaining),
+                                    max_bytes=MAX_MESSAGE_BYTES)
+            except (ConnectionError, IntegrityError) as e:
+                raise ClusterError(
+                    f"rank {self.rank}: hello handshake with "
+                    f"{host}:{port} failed ({e})") from None
+            if msg is None:
+                continue
+            try:
+                data = _payload(msg)
+            except (IntegrityError, ValueError, UnicodeDecodeError) as e:
+                raise ClusterError(
+                    f"rank {self.rank}: corrupt hello reply from "
+                    f"{host}:{port} ({e})") from None
+            kind = msg.meta.decode()
+            if kind == "hello-reject":
+                raise ClusterError(
+                    f"rank {self.rank}: coordinator rejected the join: "
+                    f"{data.get('reason', 'no reason given')} "
+                    f"(coordinator protocol {data.get('proto')}, ours "
+                    f"{PROTO_VERSION})")
+            if kind == "hello-ack":
+                return
+            # anything else this early is a protocol violation
+            raise ClusterError(
+                f"rank {self.rank}: unexpected {kind!r} during the "
+                "hello handshake")
 
     def _dial(self, host, port):
         deadline = time.monotonic() + self.cfg.connect_timeout
@@ -562,7 +855,7 @@ class Worker(ClusterBase):
             if not self._running:
                 return
             try:
-                self._ep.send(_msg("hb", rank=self.rank, seq=seq))
+                self._send(self._ep, "hb", rank=self.rank, seq=seq)
             except ConnectionError:
                 if self._running:
                     self._mark_coordinator_dead()
@@ -575,15 +868,21 @@ class Worker(ClusterBase):
     def _rx_loop(self):
         while self._running:
             try:
-                msg = self._ep.recv(timeout=self.cfg.recv_slice)
+                msg = self._ep.recv(timeout=self.cfg.recv_slice,
+                                    max_bytes=MAX_MESSAGE_BYTES)
             except ConnectionError:
                 if self._running:    # our own close() is not a death
                     self._mark_coordinator_dead()
                 return
+            except IntegrityError as e:
+                self._note_wire_error(e)     # oversized-frame guard
+                continue
             if msg is None:
                 continue
+            data = self._open(msg)
+            if data is None:
+                continue        # corrupt frame: dropped and counted
             kind = msg.meta.decode()
-            data = _payload(msg)
             if kind == "hb-ack":
                 with self._lock:
                     self._digest = data
@@ -603,9 +902,17 @@ class Worker(ClusterBase):
                     self._commit_ok[step] = bool(data.get("ok"))
                     # same bounded window the coordinator keeps: a
                     # weeks-long run must not leak an Event per step
-                    for old in sorted(self._commit_ok)[:-COMMIT_WINDOW]:
-                        self._commit_ok.pop(old, None)
-                        self._commit_done.pop(old, None)
+                    _prune_window(self._commit_ok, self._commit_done)
+                ev.set()
+            elif kind == "fp-result":
+                seq = int(data["seq"])
+                with self._lock:
+                    ev = self._fp_done.setdefault(seq,
+                                                  threading.Event())
+                    self._fp_result[seq] = (
+                        bool(data.get("ok")),
+                        [int(r) for r in data.get("divergent", [])])
+                    _prune_window(self._fp_result, self._fp_done)
                 ev.set()
 
     def _mark_coordinator_dead(self):
@@ -623,6 +930,9 @@ class Worker(ClusterBase):
             d["rank"] = self.rank
             d["coordinator_ack_age"] = round(
                 time.monotonic() - self._last_ack, 3)
+            # the digest's wire_errors is the COORDINATOR's count; ours
+            # rides separately so a one-sided corrupt link is visible
+            d["local_wire_errors"] = self._wire_errors
             if self._coordinator_dead:
                 dead = set(d.get("dead", []))
                 dead.add(0)
@@ -635,7 +945,7 @@ class Worker(ClusterBase):
         with self._lock:
             self._barriers[name] = slot
         try:
-            self._ep.send(_msg("barrier", name=name, rank=self.rank))
+            self._send(self._ep, "barrier", name=name, rank=self.rank)
         except ConnectionError:
             raise BarrierTimeout(name, [0], 0.0) from None
         # small slack over the caller's budget: the coordinator times
@@ -651,12 +961,13 @@ class Worker(ClusterBase):
             raise BarrierTimeout(name, slot["missing"], timeout)
 
     # -- two-phase commit ---------------------------------------------------
-    def ack_save(self, step):
+    def ack_save(self, step, digest=None):
         self.faults.on_ack(int(step))
         with self._lock:
             self._commit_done.setdefault(int(step), threading.Event())
         try:
-            self._ep.send(_msg("ack", step=int(step), rank=self.rank))
+            self._send(self._ep, "ack", step=int(step), rank=self.rank,
+                       digest=digest)
         except ConnectionError:
             self._mark_coordinator_dead()
 
@@ -668,6 +979,33 @@ class Worker(ClusterBase):
             return False
         with self._lock:
             return bool(self._commit_ok.get(int(step)))
+
+    # -- cross-replica fingerprint agreement --------------------------------
+    def fingerprint_agree(self, seq, fp, timeout=30.0):
+        seq = int(seq)
+        with self._lock:
+            ev = self._fp_done.setdefault(seq, threading.Event())
+        try:
+            self._send(self._ep, "fp", seq=seq, fp=fp,
+                       rank=self.rank)
+        except ConnectionError:
+            self._mark_coordinator_dead()
+            return True, []      # membership check reports the death
+        if not ev.wait(timeout):
+            with self._lock:
+                # the verdict may have landed in the race window while
+                # the wait expired — honor it if so
+                late = self._fp_result.get(seq)
+            if late is not None:
+                return late
+            warnings.warn(
+                f"fingerprint agreement round {seq} timed out after "
+                f"{timeout:.0f}s (coordinator stalled?); treating as "
+                "agreed — membership checks cover a dead coordinator",
+                stacklevel=2)
+            return True, []
+        with self._lock:
+            return self._fp_result.get(seq, (True, []))
 
     # -- teardown ----------------------------------------------------------
     def close(self):
@@ -691,6 +1029,6 @@ def make_cluster(rank: int, world: int, coordinator: str | None = None,
     return Worker(rank, world, coordinator, config, faults)
 
 
-__all__ = ["ClusterConfig", "ClusterError", "MembershipError",
-           "BarrierTimeout", "ClusterBase", "SoloCluster", "Coordinator",
-           "Worker", "make_cluster"]
+__all__ = ["PROTO_VERSION", "ClusterConfig", "ClusterError",
+           "MembershipError", "BarrierTimeout", "ClusterBase",
+           "SoloCluster", "Coordinator", "Worker", "make_cluster"]

@@ -133,6 +133,25 @@ class FaultPlan:
         and ``restore_latest`` must refuse it."""
         return self._arm("kill_ack", step, 1)
 
+    # -- integrity faults --------------------------------------------------
+    def corrupt_wire(self, seq, times=1):
+        """Flip one bit in each of the next ``times`` control-plane
+        frames this member sends, starting from send number ``seq``
+        (counting from 1, after sealing) — exactly what a corrupted TCP
+        frame looks like to the receiver's CRC. The receiver must
+        drop-and-count them, never parse them. (Unlike step-keyed
+        faults, send numbers never repeat, so ``times`` spans
+        CONSECUTIVE frames.)"""
+        return self._arm("wire", seq, times)
+
+    def diverge_at(self, step, eps=1e-3, times=1):
+        """Silently perturb this rank's first floating parameter by
+        ``eps`` right before the step-N fingerprint check — the SDC /
+        non-deterministic-kernel shape of failure: state forks with no
+        exception anywhere. Only the cross-replica fingerprint (or the
+        commit-time ACK digest agreement) can catch it."""
+        return self._arm("diverge", step, times, eps=float(eps))
+
     # -- trainer hook points ----------------------------------------------
     def on_step(self, step, attempt=0):
         """Called inside the (retried, watchdog-timed) step body before
@@ -190,6 +209,38 @@ class FaultPlan:
         if self._take("kill_ack", step) is not None:
             os._exit(1)          # died in the commit hole
 
+    def on_wire_send(self, seq, payload):
+        """Called with every SEALED outbound control-plane frame;
+        returns the bytes to actually send (possibly bit-flipped)."""
+        took = None
+        for rec in self._faults:
+            # send numbers never repeat, so a wire fault covers the
+            # CONSECUTIVE frames starting at its seq (see corrupt_wire)
+            if rec["kind"] == "wire" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "wire"))
+                took = rec
+                break
+        if took is None or not payload:
+            return payload
+        return payload[:-1] + bytes([payload[-1] ^ 0x01])
+
+    def on_fingerprint(self, step, model):
+        """Called right before the step-N cross-replica fingerprint is
+        computed; a ``diverge_at`` fault mutates the first floating
+        parameter in place (no exception — silent divergence)."""
+        rec = self._take("diverge", step)
+        if rec is None:
+            return
+        for t in model.get_states().values():
+            arr = getattr(t, "data", None)
+            if arr is not None and jnp.issubdtype(
+                    jnp.asarray(arr).dtype, jnp.floating):
+                t.data = jnp.asarray(arr) + jnp.asarray(
+                    rec["eps"], jnp.asarray(arr).dtype)
+                return
+
 
 class _NullPlan(FaultPlan):
     """Hook no-ops for the common no-faults case."""
@@ -210,6 +261,12 @@ class _NullPlan(FaultPlan):
         pass
 
     def on_ack(self, step):
+        pass
+
+    def on_wire_send(self, seq, payload):
+        return payload
+
+    def on_fingerprint(self, step, model):
         pass
 
 
@@ -256,3 +313,47 @@ def corrupt_checkpoint(directory, step, byte=0xFF):
                 f.write(bytes([byte]) * min(1024, size))
             count += 1
     return count
+
+
+def bitflip_checkpoint(directory, step, nbits=1):
+    """Flip ``nbits`` single bits mid-file in every DATA chunk store
+    under checkpoint ``step`` — the realistic SDC shape: metadata and
+    manifests are untouched (damaging those makes orbax's own parser
+    raise, which is the easy case), so the checkpoint still loads
+    cleanly and only the tensor BYTES are wrong. Nothing but a content
+    digest can catch it. Orbax keeps redundant chunk stores (plain +
+    ocdbt), so every copy is damaged; flips land in the back half of
+    each file, away from any leading format header. Returns the list
+    of damaged file paths."""
+    root = _step_dir(directory, step)
+
+    def _scan(skip_meta):
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                # metadata/manifest files fail PARSING when damaged —
+                # orbax catches that itself. The SDC shape under test
+                # is a flip in the raw tensor bytes (the d/ chunk
+                # stores), which only a content digest can see.
+                if skip_meta and (fn.startswith("_")
+                                  or "manifest" in fn
+                                  or fn.endswith(".json")):
+                    continue
+                path = os.path.join(dirpath, fn)
+                if os.path.getsize(path) > 0:
+                    out.append(path)
+        return out
+
+    targets = _scan(skip_meta=True) or _scan(skip_meta=False)
+    if not targets:
+        raise FileNotFoundError(f"no file to bit-flip under {root}")
+    for path in targets:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            for i in range(int(nbits)):
+                off = size // 2 + (i * 97) % max(1, size - size // 2)
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([(b[0] if b else 0) ^ 0x01]))
+    return targets

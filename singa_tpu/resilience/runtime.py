@@ -48,6 +48,7 @@ import time
 import warnings
 
 from ..checkpoint import CheckpointManager, DistributedCheckpointManager
+from ..integrity import replica_buffer_mismatches, state_fingerprint
 from .cluster import BarrierTimeout, MembershipError
 from .faults import NULL_PLAN
 from .guards import GuardedOptimizer
@@ -56,6 +57,33 @@ from .guards import GuardedOptimizer
 # restart me" exit code for the restart supervisor. Distinct from 0
 # (done), 1 (crash), and 42-style user codes.
 EXIT_PREEMPTED = 75
+
+# Repeated cross-replica divergence (silent data corruption or a
+# non-deterministic kernel) after quarantine-and-rollback already
+# retried: DISTINCT from 75 because "relaunch the same command" is the
+# wrong medicine — the fleet should cordon/replace the suspect host
+# before restarting (resume still works: every committed checkpoint is
+# cross-replica-agreed). 76 is BSD EX_PROTOCOL — "remote said the
+# impossible" is close enough in spirit to a replica whose bytes
+# disagree with its peers'.
+EXIT_DIVERGED = 76
+
+
+class DivergenceError(RuntimeError):
+    """Replicas diverged again after quarantine-and-rollback — the
+    supervisor contract is exit :data:`EXIT_DIVERGED` (76): investigate
+    or cordon the divergent host, THEN relaunch (resume lands on the
+    last cross-replica-agreed checkpoint)."""
+
+    def __init__(self, step, divergent, rollbacks):
+        self.step = int(step)
+        self.divergent = list(divergent)
+        super().__init__(
+            f"cross-replica divergence at step {step} persisted after "
+            f"{rollbacks} quarantine-rollback(s)"
+            + (f" (divergent: {self.divergent})" if divergent else "")
+            + f"; exiting {EXIT_DIVERGED} — cordon the suspect host "
+            "before restarting")
 
 
 class StepTimeoutError(RuntimeError):
@@ -111,6 +139,20 @@ class ResilientTrainer:
     - ``manifest_extra``: dict recorded in every commit marker (e.g.
       ``per_replica_batch`` — the elastic batch accounting reads it on
       resume, see ``parallel.communicator.rescale_batch``).
+    - ``fingerprint_every``: every N steps, fingerprint the full model
+      + optimizer state and check that replicas agree — bit-exactly:
+      per-device buffer comparison locally
+      (:func:`~singa_tpu.integrity.replica_buffer_mismatches`) and a
+      digest exchange over the cluster for multi-rank runs
+      (:meth:`~singa_tpu.resilience.cluster.ClusterBase.
+      fingerprint_agree`). A disagreement means silent divergence (SDC,
+      non-deterministic kernel): the step is QUARANTINED — never
+      checkpointed — and state rolls back to the last *verified,
+      cluster-agreed* checkpoint. 0 (the default) disables the check
+      entirely: zero added work on the step path.
+    - ``max_divergence_rollbacks``: quarantine-rollbacks allowed before
+      the run exits :data:`EXIT_DIVERGED` (76) — repeated divergence
+      means bad hardware, and "restart the same pod" is not a fix.
     """
 
     def __init__(self, model, ckpt_dir, *, max_to_keep=3,
@@ -120,7 +162,8 @@ class ResilientTrainer:
                  exit_on_preempt=True, install_signal_handlers=True,
                  faults=None, seed=0, verbose=True, cluster=None,
                  commit_timeout=60.0, start_barrier_timeout=60.0,
-                 preempt_commit_timeout=10.0, manifest_extra=None):
+                 preempt_commit_timeout=10.0, manifest_extra=None,
+                 fingerprint_every=0, max_divergence_rollbacks=2):
         self.model = model
         self.cluster = cluster
         self.start_barrier_timeout = float(start_barrier_timeout)
@@ -143,6 +186,8 @@ class ResilientTrainer:
         self.step_timeout = step_timeout
         self.rollback_after = rollback_after
         self.max_rollbacks = int(max_rollbacks)
+        self.fingerprint_every = int(fingerprint_every)
+        self.max_divergence_rollbacks = int(max_divergence_rollbacks)
         self.exit_on_preempt = bool(exit_on_preempt)
         self.install_signal_handlers = bool(install_signal_handlers)
         self.faults = faults if faults is not None else NULL_PLAN
@@ -380,6 +425,33 @@ class ResilientTrainer:
         opt = getattr(self.model, "optimizer", None)
         return opt if isinstance(opt, GuardedOptimizer) else None
 
+    def _lockstep_restore(self, prefix, step, n):
+        """The ONE rollback body both recovery paths (guard-streak
+        rollback, fingerprint quarantine) share, so their ordering can
+        never drift apart. Rollback must be LOCKSTEP: a rank rewinding
+        alone would ack different step numbers forever and no
+        checkpoint could ever commit again — a rank whose trigger is
+        LOCAL (a hardware fault) strands its peers at the first
+        barrier → BarrierTimeout → exit 75 → the supervisor restart is
+        the consistent recovery. The resume barrier's name carries the
+        resumed step (same agreement rule as the startup resume
+        barrier): a rank whose shards fell back FURTHER than its peers
+        strands them there instead of training at inconsistent
+        parameter versions. Returns the step to resume from."""
+        if self.cluster is not None and self.cluster.world > 1:
+            self.cluster.barrier(f"{prefix}-{step}-{n}",
+                                 timeout=self.start_barrier_timeout)
+        self.mgr.wait()          # never restore under an in-flight save
+        resume = self.mgr.restore_latest(self.model)
+        if self.cluster is not None and self.cluster.world > 1:
+            self.cluster.barrier(f"{prefix}-resume-{resume}-{n}",
+                                 timeout=self.start_barrier_timeout)
+        if isinstance(self.mgr, DistributedCheckpointManager):
+            # agreement reached: markers at/after the resume point
+            # vouch for a timeline about to be re-run
+            self.mgr.invalidate_markers_from(resume)
+        return resume
+
     def _maybe_rollback(self, step, bad_streak, summary):
         """Returns the step to continue from (rolled back), or None."""
         guard = self._guard()
@@ -391,31 +463,8 @@ class ResilientTrainer:
             raise RuntimeError(
                 f"training diverged: {self.rollback_after} consecutive "
                 f"bad steps after {summary['rollbacks']} rollbacks")
-        if self.cluster is not None and self.cluster.world > 1:
-            # rollback must be LOCKSTEP: a rank rewinding alone would
-            # ack different step numbers forever and no checkpoint
-            # could ever commit again. The guard streak is shard-
-            # consistent under DistOpt, so all ranks normally arrive
-            # here together; a rank whose divergence is LOCAL (a
-            # hardware fault) strands its peers at this barrier →
-            # BarrierTimeout → exit 75 → the supervisor restart is the
-            # consistent recovery.
-            self.cluster.barrier(
-                f"rollback-{step}-{summary['rollbacks']}",
-                timeout=self.start_barrier_timeout)
-        self.mgr.wait()          # never restore under an in-flight save
-        resume = self.mgr.restore_latest(self.model)
-        if self.cluster is not None and self.cluster.world > 1:
-            # same agreement rule as the startup resume barrier: the
-            # name carries the resumed step, so a rank whose shards
-            # made it fall back FURTHER than its peers strands them
-            # here and everyone exits 75 instead of training at
-            # inconsistent parameter versions
-            self.cluster.barrier(
-                f"rollback-resume-{resume}-{summary['rollbacks']}",
-                timeout=self.start_barrier_timeout)
-        if isinstance(self.mgr, DistributedCheckpointManager):
-            self.mgr.invalidate_markers_from(resume)
+        resume = self._lockstep_restore("rollback", step,
+                                        summary["rollbacks"])
         guard.reset_streaks(extra_backoff=True)
         summary["rollbacks"] += 1
         warnings.warn(
@@ -423,6 +472,75 @@ class ResilientTrainer:
             f"{step}; rolled back to checkpoint, resuming at step "
             f"{resume} (rollback {summary['rollbacks']}/"
             f"{self.max_rollbacks})", stacklevel=2)
+        return resume
+
+    # -- cross-replica fingerprint: quarantine and rollback ----------------
+    def _state_arrays(self):
+        from ..checkpoint import _state_tensor_dict
+        return {k: t.data
+                for k, t in _state_tensor_dict(self.model).items()}
+
+    def _fingerprint_check(self, step, summary):
+        """Bit-exact cross-replica agreement on the FULL training state.
+        Returns True when every replica agrees; False (with the
+        divergents named) quarantines the step."""
+        # chaos hook: diverge_at silently perturbs this rank's state —
+        # the exact SDC shape the detector exists for
+        self.faults.on_fingerprint(step, self.model)
+        arrays = self._state_arrays()
+        summary["fingerprints"] += 1
+        # the agreement round is keyed by the CHECK count, not the step
+        # number: in lockstep every rank counts the same rounds, and a
+        # step re-run after a rollback opens a fresh round instead of
+        # reusing its first run's stale verdict
+        seq = summary["fingerprints"]
+        divergent = []
+        # local front: replicated per-device buffers must be identical
+        local = replica_buffer_mismatches(arrays)
+        if local:
+            divergent += [f"{n}@{d}" for n, ds in local.items()
+                          for d in ds]
+        # cluster front: every rank's state digest must be identical
+        if self.cluster is not None and self.cluster.world > 1:
+            fp = state_fingerprint(arrays)
+            ok, ranks = self.cluster.fingerprint_agree(
+                seq, fp, timeout=self.start_barrier_timeout)
+            if not ok:
+                divergent += [f"rank{r}" for r in ranks] or ["unknown"]
+        if divergent:
+            warnings.warn(
+                f"step {step}: cross-replica fingerprint mismatch "
+                f"({divergent}) — quarantining the step and rolling "
+                "back to the last verified checkpoint", stacklevel=2)
+            summary["divergent"] = sorted(set(summary["divergent"])
+                                          | set(divergent))
+            return False
+        return True
+
+    def _quarantine_rollback(self, step, summary):
+        """A diverged step is never checkpointed; roll every rank back
+        (LOCKSTEP, like ``_maybe_rollback``) to the last verified —
+        and, under a cluster, cross-replica-AGREED — checkpoint.
+        Returns the step to resume from; raises
+        :class:`DivergenceError` when the budget is spent."""
+        summary["quarantined_steps"] += 1
+        if summary["divergence_rollbacks"] >= \
+                self.max_divergence_rollbacks:
+            raise DivergenceError(step, summary["divergent"],
+                                  summary["divergence_rollbacks"])
+        # every rank saw the same fp-result broadcast, so all arrive at
+        # the lockstep barriers together
+        resume = self._lockstep_restore("quarantine", step,
+                                        summary["divergence_rollbacks"])
+        guard = self._guard()
+        if guard is not None:
+            guard.reset_streaks()
+        summary["divergence_rollbacks"] += 1
+        warnings.warn(
+            f"quarantined diverged step {step}; rolled back to the "
+            f"last verified checkpoint, resuming at step {resume} "
+            f"(divergence rollback {summary['divergence_rollbacks']}/"
+            f"{self.max_divergence_rollbacks})", stacklevel=2)
         return resume
 
     # -- the loop ----------------------------------------------------------
@@ -438,7 +556,10 @@ class ResilientTrainer:
                    "step_retries": 0, "data_retries": 0,
                    "step_timeouts": 0, "skipped_steps": 0,
                    "preempted": False, "membership_lost": False,
-                   "dead_ranks": [], "elastic": None}
+                   "dead_ranks": [], "elastic": None,
+                   "fingerprints": 0, "quarantined_steps": 0,
+                   "divergence_rollbacks": 0, "divergent": [],
+                   "diverged": False}
         prev_handlers = self._install_handlers()
         try:
             if self.cluster is not None and self.cluster.world > 1:
@@ -490,6 +611,16 @@ class ResilientTrainer:
                 batch = self._next_batch(step, summary)
                 out = self._run_step(step, batch, summary)
                 summary["steps_run"] += 1
+                # cross-replica fingerprint on its cadence, BEFORE the
+                # save: a diverged step is quarantined — it must never
+                # be checkpointed, and the rollback target is the last
+                # verified (and cluster-agreed) step. Off by default:
+                # fingerprint_every=0 adds zero work here.
+                if self.fingerprint_every and \
+                        (step + 1) % self.fingerprint_every == 0 and \
+                        not self._fingerprint_check(step, summary):
+                    step = self._quarantine_rollback(step, summary)
+                    continue
                 # ONE scalar readback per step; a guard-flagged bad step
                 # is never checkpointed, so the newest checkpoint always
                 # predates the bad streak and rollback actually rewinds
@@ -511,6 +642,18 @@ class ResilientTrainer:
             self._finalize_summary(summary)
             if self.exit_on_preempt:
                 raise SystemExit(EXIT_PREEMPTED) from None
+            return summary
+        except DivergenceError as e:
+            # NOT recoverable by a plain restart: replicas forked twice
+            # despite rolling back to agreed state — suspect hardware.
+            # Exit DISTINCT from 75 so the supervisor cordons/replaces
+            # the divergent host first; resume still lands on the last
+            # cross-replica-agreed checkpoint.
+            summary["diverged"] = True
+            self._finalize_summary(summary)
+            self._log(f"{e}")
+            if self.exit_on_preempt:
+                raise SystemExit(EXIT_DIVERGED) from None
             return summary
         except (MembershipError, BarrierTimeout) as e:
             # RECOVERABLE: the job is still viable at a smaller world.

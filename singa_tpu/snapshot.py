@@ -28,6 +28,8 @@ import struct
 
 import numpy as np
 
+from .integrity import (IntegrityError, read_digest_sidecar,
+                        tensor_digest, write_digest_sidecar)
 from .native import RecordReader, RecordWriter
 from .tensor import Tensor
 
@@ -280,6 +282,7 @@ class Snapshot:
         self.format = format
         if mode == self.kWrite:
             self._names = set()
+            self._digests = {}      # param name -> content digest
             self._pending = [] if format == "auto" else None
             if format != "auto":
                 self._open_write(format)
@@ -320,6 +323,15 @@ class Snapshot:
 
     def _write_record(self, format: str, param_name: str,
                       arr: np.ndarray) -> None:
+        # the digest covers the DECODED array (dtype+shape+bytes), not
+        # the wire encoding, so both formats verify through one rule —
+        # and a record that decodes to the wrong values fails even if
+        # its framing is intact. The reference kInt payload reloads as
+        # int32 (core.proto:29), so in-range int64 input is digested in
+        # its canonical round-trip form.
+        canon = arr.astype(np.int32) \
+            if format == "singa" and arr.dtype == np.int64 else arr
+        self._digests[param_name] = tensor_digest(canon)
         if format == "singa":
             _binfile_write(self._writer, param_name,
                            _pack_tensorproto(arr))
@@ -348,23 +360,44 @@ class Snapshot:
         else:
             self._write_record(self.format, param_name, arr)
 
-    def read(self):
+    def read(self, verify=True):
         """All params as an OrderedDict name -> Tensor (reference
-        Snapshot.Read)."""
+        Snapshot.Read). With ``verify`` (default) every decoded array
+        is checked against the ``<prefix>.digest`` sidecar when one
+        exists — a flipped bit in the .bin raises
+        :class:`~singa_tpu.integrity.IntegrityError` naming the record
+        instead of silently handing back corrupt parameters. Snapshots
+        without a sidecar (real SINGA files, pre-integrity saves) load
+        unverified, as before."""
         assert self.mode == self.kRead, "snapshot opened for write"
         from collections import OrderedDict
-        out = OrderedDict()
+        arrays = OrderedDict()
         if self._read_native:
             self._reader.seek_to_first()
             for key, val in self._reader:
-                out[key.decode("utf-8")] = Tensor(
-                    data=_decode_array(val), requires_grad=False)
+                arrays[key.decode("utf-8")] = _decode_array(val)
         else:
             for key, val in _binfile_read(self._read_path):
-                if key in out:   # reference CHECK(count == 0)
+                if key in arrays:   # reference CHECK(count == 0)
                     raise ValueError(f"duplicate snapshot key {key!r}")
-                out[key] = Tensor(data=_unpack_tensorproto(val),
-                                  requires_grad=False)
+                arrays[key] = _unpack_tensorproto(val)
+        if verify:
+            sidecar = read_digest_sidecar(self.prefix + ".digest")
+            if sidecar is not None:
+                for name, want in sidecar["records"].items():
+                    if name not in arrays:
+                        raise IntegrityError(
+                            f"snapshot {self.prefix!r}: digested record "
+                            f"{name!r} is missing from the file")
+                    got = tensor_digest(arrays[name])
+                    if got != want:
+                        raise IntegrityError(
+                            f"snapshot {self.prefix!r}: record {name!r} "
+                            f"failed its content digest ({got} != "
+                            f"recorded {want}) — corrupt .bin")
+        out = OrderedDict()
+        for key, arr in arrays.items():
+            out[key] = Tensor(data=arr, requires_grad=False)
         return out
 
     def done(self) -> None:
@@ -390,6 +423,12 @@ class Snapshot:
                     self._write_record(fmt, name, arr)
             self._writer.close()
             self._desc.close()
+            # the digest sidecar lands LAST (atomic tmp+rename): its
+            # presence vouches for a complete .bin, so a write torn
+            # before this point simply loads unverified-or-failing,
+            # never verified-and-wrong
+            write_digest_sidecar(self.prefix + ".digest", self._digests,
+                                 format=self.format)
         elif self._reader is not None:
             self._reader.close()
 

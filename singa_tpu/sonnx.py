@@ -1037,7 +1037,9 @@ class SingaBackend:
             return autograd.expand(ins[0], _ints(ins[1]))
         if ty == "Pad":
             pads = _ints(ins[1])
-            const = float(_arr(ins[2])) \
+            # reshape(-1)[0]: the constant may arrive as a 0-d OR 1-elem
+            # array; float() of an ndim>0 array is a numpy deprecation
+            const = float(_arr(ins[2]).reshape(-1)[0]) \
                 if len(ins) > 2 and ins[2] is not None else 0.0
             return autograd.pad(ins[0], a.get("mode", "constant"), pads,
                                 const)

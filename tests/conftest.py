@@ -74,8 +74,30 @@ def pytest_addoption(parser):
 # [tool.pytest.ini_options] — one source of truth)
 
 
+def _selects_slow_tier(markexpr):
+    """True when -m POSITIVELY selects a slow-tier marker (``slow``,
+    ``chaos``, …) — i.e. the marker appears and is not negated."""
+    import re
+    return any(
+        re.search(rf"\b{m}\b", markexpr)
+        and not re.search(rf"\bnot\s+{m}\b", markexpr)
+        for m in ("slow", "chaos"))
+
+
 def pytest_collection_modifyitems(config, items):
     if config.getoption("--full"):
+        return
+    markexpr = config.getoption("-m") or ""
+    if _selects_slow_tier(markexpr):
+        # `pytest -m slow` without --full used to report a green
+        # "63 skipped" NO-OP — the worst kind of pass. Selecting the
+        # slow tier by marker IS the opt-in, so imply --full instead
+        # of silently skipping everything that was asked for.
+        tr = config.pluginmanager.getplugin("terminalreporter")
+        if tr is not None:
+            tr.write_line(
+                f"[conftest] -m {markexpr!r} selects the slow tier: "
+                "implying --full so the selection actually runs")
         return
     skip = pytest.mark.skip(
         reason="slow tier (run with --full)")

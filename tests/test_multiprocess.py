@@ -224,3 +224,21 @@ class TestClusterChaos:
         assert momentum, a.files                  # SGD momentum rode along
         for k in a.files:
             np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+    def test_bitflip_detected_and_recovery_bit_identical(self, tmp_path):
+        """Integrity front, disk: tensor bytes flip in the newest
+        committed checkpoint (metadata intact — pure SDC); the restart
+        detects it at restore, falls back to the previous VERIFIED step
+        bit-identically, and the scrub CLI flags the damage. The full
+        scenario (shared with the standalone smoke) asserts each link."""
+        chaos_smoke.scenario_bitflip_restore(
+            str(tmp_path), chaos_smoke.Budget(240))
+
+    def test_divergence_quarantine_rollback_and_exit_76(self, tmp_path):
+        """Integrity front, replicas: one rank's parameters silently
+        fork; the cross-replica fingerprint catches it, every rank
+        quarantines + rolls back to the last cluster-agreed checkpoint,
+        and repeated divergence exits EXIT_DIVERGED (76) — the
+        'cordon the host' supervisor code, distinct from 75."""
+        chaos_smoke.scenario_divergence_quarantine(
+            str(tmp_path), chaos_smoke.Budget(240))

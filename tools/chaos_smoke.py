@@ -14,6 +14,15 @@ harness) against ``examples/train_elastic.py``:
    resumes from the previous committed step.
 3. **barrier-missing** — a rank never shows up at the start rendezvous;
    the survivor names it and exits 75 instead of hanging.
+4. **bitflip-restore** — bits flip in the newest committed checkpoint's
+   tensor data (metadata intact — pure SDC); the restart detects it at
+   restore and falls back to the previous VERIFIED step bit-identically,
+   and the scrub CLI flags the damaged step.
+5. **divergence-quarantine** — one rank's parameters silently fork
+   (injected SDC); the cross-replica fingerprint catches it, every rank
+   quarantines the step and rolls back to the last cluster-agreed
+   checkpoint, and when the divergence repeats the run exits 76
+   (``EXIT_DIVERGED`` — cordon the host, don't just relaunch).
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
 smoke is bounded by ``--budget`` seconds end to end (default 300) —
@@ -38,7 +47,9 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ELASTIC = os.path.join(REPO, "examples", "train_elastic.py")
+SCRUB = os.path.join(REPO, "tools", "scrub_checkpoints.py")
 EXIT_PREEMPTED = 75
+EXIT_DIVERGED = 76
 
 
 class Budget:
@@ -174,9 +185,95 @@ def scenario_barrier_missing(root, budget):
            "the missing rank is NAMED, not hung on", outs[0])
 
 
+def scenario_bitflip_restore(root, budget):
+    """Pure-SDC disk corruption: tensor bytes flip in the newest
+    committed step, the restart refuses it (digest/chunk-CRC failure),
+    falls back to the previous verified step BIT-IDENTICALLY, and the
+    scrub CLI flags the damage."""
+    d = os.path.join(root, "ck")
+    dumps = os.path.join(root, "dumps")
+    os.makedirs(dumps)
+    port = _free_port()
+    rcs, outs = _run([_cmd(0, 1, port, d,
+                           ["--dump-on-save", dumps], steps=12)], budget)
+    _check(rcs == [0], f"clean world-1 run completes (got {rcs})",
+           outs[0])
+    committed = _committed(d)
+    last = max(committed)
+    _check(last >= 4, f"real progress committed (markers: {committed})")
+
+    sys.path.insert(0, REPO)
+    from singa_tpu.resilience.faults import bitflip_checkpoint
+    flipped = bitflip_checkpoint(os.path.join(d, "rank0"), last)
+    _check(bool(flipped), f"bits flipped in step {last}'s tensor data "
+           f"({len(flipped)} chunk files)")
+
+    scrub = subprocess.run(
+        [sys.executable, SCRUB, d], capture_output=True, text=True,
+        timeout=budget.remaining())
+    _check(scrub.returncode == 1 and f"rank0/{last}" in scrub.stdout,
+           f"scrub CLI flags step {last} and exits nonzero",
+           scrub.stdout + scrub.stderr)
+
+    prev = max(s for s in committed if s != last)
+    restored = os.path.join(root, "restored.npz")
+    rcs2, outs2 = _run([_cmd(0, 1, port, d,
+                             ["--dump-restored", restored],
+                             steps=12)], budget)
+    _check(rcs2 == [0], f"restart completes (got {rcs2})", outs2[0])
+    _check(f"dumped restored state of step {prev}" in outs2[0],
+           f"corrupt step {last} refused; restore fell back to "
+           f"verified step {prev}", outs2[0])
+    a = np.load(restored)
+    b = np.load(os.path.join(dumps, f"state_step{prev}.npz"))
+    assert set(a.files) == set(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+    _check(True, "recovery is bit-identical to the verified step "
+           f"({len(a.files)} state entries)")
+
+
+def scenario_divergence_quarantine(root, budget):
+    """Injected single-replica SDC: the cross-replica fingerprint
+    detects it, every rank quarantines + rolls back to the last
+    cluster-agreed checkpoint, and repeated divergence exits 76."""
+    d = os.path.join(root, "ck")
+    port = _free_port()
+    rcs, outs = _run([
+        _cmd(0, 2, port, d, ["--fingerprint-every", "3",
+                             "--max-divergence-rollbacks", "1"],
+             steps=20),
+        _cmd(1, 2, port, d, ["--fingerprint-every", "3",
+                             "--max-divergence-rollbacks", "1",
+                             "--diverge-at", "5", "--diverge-rank", "1",
+                             "--diverge-times", "5"],
+             steps=20)], budget)
+    # the rank that loses the race to the verdict may instead see the
+    # other's death as membership loss (75) — but at least the
+    # coordinator always learns the verdict and exits 76
+    _check(rcs[0] == EXIT_DIVERGED and
+           rcs[1] in (EXIT_DIVERGED, EXIT_PREEMPTED),
+           f"divergence exits {EXIT_DIVERGED} (got {rcs})",
+           outs[0] + outs[1])
+    _check("quarantined diverged step" in outs[0] + outs[1],
+           "the diverged step was quarantined and rolled back",
+           outs[0])
+    _check("fingerprint" in outs[0] + outs[1],
+           "the fingerprint detector is what fired", outs[0])
+    committed = _committed(d)
+    # save-every is 2, divergence at step 5: nothing at or after the
+    # divergence point may commit (a vacuous `5 not in` would pass even
+    # with quarantine broken, since odd steps never save)
+    _check(bool(committed) and max(committed) < 5,
+           f"nothing at/after the divergence committed "
+           f"(markers: {committed})")
+
+
 SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("commit-hole", scenario_commit_hole),
-             ("barrier-missing", scenario_barrier_missing)]
+             ("barrier-missing", scenario_barrier_missing),
+             ("bitflip-restore", scenario_bitflip_restore),
+             ("divergence-quarantine", scenario_divergence_quarantine)]
 
 
 def main():

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Scrub at-rest checkpoints: re-verify every step against its content
+digests, report, and optionally demote the corrupt ones.
+
+Detects the layout automatically:
+
+- a :class:`~singa_tpu.checkpoint.DistributedCheckpointManager` root
+  (``commits/`` + ``rank<N>/`` shard dirs): every rank's shards are
+  scrubbed, and a committed step none of whose shards verify is flagged
+  — that checkpoint is unrecoverable and the fleet should know *before*
+  it tries to restore from it;
+- a plain :class:`~singa_tpu.checkpoint.CheckpointManager` directory:
+  its steps are scrubbed directly.
+
+``--delete`` demotes corrupt/unreadable steps (shard dir + digest
+sidecar removed) so the rotation window only ever counts verified
+steps — without demotion a corrupt newest step would let
+``max_to_keep`` rotate away the last restorable one. Commit markers
+are NEVER deleted here: a marker whose local shard is corrupt may
+still be restorable from a peer's shard.
+
+Exit code: 0 when every verified step is clean, 1 when anything is
+corrupt/unreadable (cron-able: page on nonzero).
+
+Usage::
+
+    python tools/scrub_checkpoints.py CKPT_DIR [--delete] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# scrubbing is host-side IO + CRC work; never grab an accelerator for it
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _scrub_dir(path, delete):
+    from singa_tpu.checkpoint import CheckpointManager
+    # read-only open: never sweep another writer's in-flight step
+    mgr = CheckpointManager(path, sweep=False)
+    try:
+        return mgr.scrub(delete=delete)
+    finally:
+        mgr.close()
+
+
+def scrub_root(root, delete=False):
+    """Scrub ``root`` (plain or distributed layout). Returns
+    ``{relative_dir: {step: status}}``."""
+    root = os.path.abspath(root)
+    rank_dirs = sorted(
+        d for d in (os.listdir(root) if os.path.isdir(root) else [])
+        if d.startswith("rank") and d[4:].isdigit()
+        and os.path.isdir(os.path.join(root, d)))
+    if os.path.isdir(os.path.join(root, "commits")) and rank_dirs:
+        return {d: _scrub_dir(os.path.join(root, d), delete)
+                for d in rank_dirs}
+    return {".": _scrub_dir(root, delete)}
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="re-verify at-rest checkpoints against their "
+                    "content digests")
+    ap.add_argument("directory", help="checkpoint root (plain "
+                    "CheckpointManager dir or a distributed root with "
+                    "commits/ + rank<N>/)")
+    ap.add_argument("--delete", action="store_true",
+                    help="demote corrupt/unreadable steps (keeps the "
+                         "rotation window verified-only)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args()
+
+    report = scrub_root(args.directory, delete=args.delete)
+
+    bad = 0
+    # a distributed step is LOST only when no rank's shard verifies
+    steps: dict = {}
+    for d, res in report.items():
+        for step, status in res.items():
+            steps.setdefault(step, []).append(status)
+            if status in ("corrupt", "unreadable"):
+                bad += 1
+    lost = sorted(s for s, sts in steps.items()
+                  if sts and all(x in ("corrupt", "unreadable")
+                                 for x in sts))
+
+    if args.json:
+        print(json.dumps({"report": report, "corrupt_shards": bad,
+                          "lost_steps": lost, "deleted": args.delete}))
+    else:
+        for d, res in sorted(report.items()):
+            for step, status in sorted(res.items()):
+                print(f"[scrub] {d}/{step}: {status}")
+        if lost:
+            print(f"[scrub] LOST step(s) {lost}: no rank's shard "
+                  "verifies — restore will fall back past them")
+        print(f"[scrub] {bad} corrupt/unreadable shard(s)"
+              + (" (demoted)" if args.delete and bad else ""))
+    sys.exit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()
