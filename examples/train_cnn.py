@@ -13,11 +13,19 @@ for the accepted locations/formats. ``synthetic`` needs no files.
 
 Usage: python examples/train_cnn.py [cnn|alexnet|resnet|xceptionnet|mlp]
            [cifar10|cifar100|mnist|synthetic] [--data-dir DIR]
-           [--bs 64] [--epochs 10] [--lr 0.05] [-p float32|bfloat16]
+           [--bs 64] [--epochs 10] [--lr 0.05]
+           [-p float32|bfloat16|bf16_mixed] [--layout auto|NCHW|NHWC]
            [--dist] [--dist-option plain|half|partialUpdate|
             sparseTopK|sparseThreshold] [--spars 0.05] [--cpu]
            [--verbosity 0] [--npz path.npz]
            [--resilient] [--ckpt-dir ckpts_cnn] [--save-every 50]
+
+``-p bf16_mixed`` trains under the mixed-precision compile policy
+(``Model.compile(policy="bf16_mixed")``): fp32 master weights (what
+checkpoints store) with bf16 conv/matmul compute and dynamic loss
+scaling — the TPU production setting. ``--layout auto`` (resnet) uses
+the banked ``resnet_layout_ab`` hardware A/B winner so the example runs
+the measured-fastest conv layout, falling back to NCHW when unmeasured.
 
 ``--resilient`` runs the fault-tolerant driver instead of the bare
 epoch loop: NaN/divergence guards (singa_tpu/resilience/guards.py)
@@ -34,6 +42,20 @@ import time
 import numpy as np
 
 sys.path.insert(0, ".")
+
+
+def _measured_layout():
+    """Conv-trunk layout for --layout auto: the banked
+    ``resnet_layout_ab`` hardware A/B winner via bench._conv_layout
+    (env pin > fresh banked measurement > NCHW default), so the example
+    — not just the benchmark — runs the measured-fastest form. Falls
+    back to NCHW when bench.py or its observations are unreachable
+    (e.g. the example is run outside the repo root)."""
+    try:
+        import bench
+        return bench._conv_layout()
+    except Exception as e:  # noqa: BLE001 — the example must still run
+        return "NCHW", f"unmeasured-fallback ({type(e).__name__})"
 
 
 def build_parser():
@@ -54,17 +76,25 @@ def build_parser():
                          "lets CI run a real epoch quickly")
     ap.add_argument("--lr", "-l", type=float, default=0.05)
     ap.add_argument("-p", "--precision", default="float32",
-                    choices=["float32", "bfloat16"])
+                    choices=["float32", "bfloat16", "bf16_mixed"],
+                    help="bf16_mixed compiles the model under the "
+                         "mixed-precision policy (fp32 masters + loss "
+                         "scaling, bf16 compute); bfloat16 is the "
+                         "legacy pure-bf16 input cast")
     ap.add_argument("--dist", action="store_true")
     ap.add_argument("--dist-option", default="plain")
     ap.add_argument("--spars", type=float, default=0.05)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--no-augment", action="store_true")
     ap.add_argument("--verbosity", "-v", type=int, default=0)
-    ap.add_argument("--layout", default="NCHW",
-                    choices=["NCHW", "NHWC"],
+    ap.add_argument("--layout", default="auto",
+                    choices=["auto", "NCHW", "NHWC"],
                     help="conv-trunk activation layout (resnet only; "
-                         "NHWC is the TPU lane-friendly form)")
+                         "NHWC is the TPU lane-friendly form, applied "
+                         "via ops.layout.use_layout inside the model). "
+                         "'auto' runs the banked resnet_layout_ab "
+                         "hardware A/B winner (bench._conv_layout) and "
+                         "falls back to NCHW when unmeasured")
     ap.add_argument("--stem", default="conv7",
                     choices=["conv7", "space_to_depth"],
                     help="resnet stem: plain 7x7/s2 conv or its exact "
@@ -139,17 +169,31 @@ def main():
     else:
         kw = {}
         if args.model == "resnet":
-            kw = {"layout": args.layout, "stem": args.stem}
+            layout = args.layout
+            if layout == "auto":
+                layout, layout_src = _measured_layout()
+                print(f"conv layout: {layout} ({layout_src})", flush=True)
+            kw = {"layout": layout, "stem": args.stem}
         model = factory.create_model(num_channels=chans,
                                      num_classes=num_classes, **kw)
     sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
     opt_obj = opt.DistOpt(sgd) if args.dist else sgd
     if args.resilient:
         from singa_tpu.resilience import GuardedOptimizer
-        # bf16 benefits from a real loss scale; f32 runs pure-guard
-        opt_obj = GuardedOptimizer(
-            opt_obj,
-            init_scale=2.0 ** 15 if args.precision == "bfloat16" else 1.0)
+        # (Without --resilient, compile(policy="bf16_mixed") wraps a
+        # GuardedOptimizer itself — this explicit wrap keeps the
+        # trainer's rollback hooks on the same object.)
+        if args.precision == "bf16_mixed":
+            # same configuration compile() would pick for the policy
+            from singa_tpu.mixed_precision import Policy
+            opt_obj = GuardedOptimizer.for_policy(opt_obj,
+                                                  Policy("bf16_mixed"))
+        else:
+            # legacy pure-bf16 keeps its underflow shield; f32 runs
+            # pure-guard
+            opt_obj = GuardedOptimizer(
+                opt_obj, init_scale=2.0 ** 15
+                if args.precision == "bfloat16" else 1.0)
     model.set_optimizer(opt_obj)
 
     # Under --dist every process feeds the FULL global batch and the
@@ -172,12 +216,17 @@ def main():
             x = np.ascontiguousarray(x, np.float32)
         t = tensor.Tensor(data=x, device=dev, requires_grad=False)
         if args.precision == "bfloat16":
+            # legacy pure-bf16: params follow the input dtype. Under
+            # bf16_mixed the input stays f32 — the policy casts at the
+            # op boundary inside the compiled step.
             import jax.numpy as jnp
             t = t.as_type(jnp.bfloat16)
         return t
 
     tx = stage(train_x[:args.bs])
-    model.compile([tx], is_train=True, use_graph=True)
+    model.compile([tx], is_train=True, use_graph=True,
+                  policy="bf16_mixed" if args.precision == "bf16_mixed"
+                  else None)
 
     eye = np.eye(num_classes, dtype=np.float32)
     acc = metric.Accuracy()

@@ -26,9 +26,11 @@ from . import ops           # noqa: F401
 from . import parallel      # noqa: F401
 from . import metric        # noqa: F401
 from . import utils         # noqa: F401
+from . import mixed_precision  # noqa: F401
 
 from .tensor import Tensor  # noqa: F401
 from .model import Model    # noqa: F401
+from .mixed_precision import Policy  # noqa: F401
 
 _LAZY = ("sonnx", "io", "data", "datasets", "image_tool", "net",
          "snapshot", "native", "channel", "caffe", "network",

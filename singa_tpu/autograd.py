@@ -31,6 +31,10 @@ from .tensor import Tensor
 from .autograd_base import (CTX, Operator, Dummy, backward, gradients,
                             infer_dependency, is_training, set_training,
                             _raw)
+# ops cast compute operands / upcast fragile reductions through the ONE
+# precision-contract module (f32-accumulate discipline lives there)
+from .mixed_precision import cast_compute as _cast_compute
+from .mixed_precision import accum_f32 as _f32a
 
 
 class _AutogradModule(types.ModuleType):
@@ -99,6 +103,10 @@ class AddBias(Operator):
         self.axis = axis
 
     def forward(self, x, b):
+        # policy discipline: a bias is not numerically fragile — under a
+        # 16-bit policy it joins the activation's precision instead of
+        # silently upcasting the whole activation back to its own
+        x, b = _cast_compute(x, b)
         if self.axis == 0:
             return x + b.reshape((1,) + b.shape)
         return x + b.reshape(b.shape + (1,) * (x.ndim - 1 - self.axis))
@@ -106,6 +114,10 @@ class AddBias(Operator):
 
 class Matmul(Operator):
     def forward(self, a, b):
+        # under an active precision policy both operands enter the MXU
+        # in the compute dtype (fp32 masters are cast at the use site;
+        # the vjp casts the weight gradient back up automatically)
+        a, b = _cast_compute(a, b)
         return jnp.matmul(a, b)
 
 
@@ -118,6 +130,7 @@ class Gemm(Operator):
         self.transA, self.transB = transA, transB
 
     def forward(self, A, B, C=None):
+        A, B, C = _cast_compute(A, B, C)
         a = A.T if self.transA else A
         b = B.T if self.transB else B
         y = self.alpha * (a @ b)
@@ -275,7 +288,10 @@ class SoftMax(Operator):
         self.axis = axis
 
     def forward(self, x):
-        return jax.nn.softmax(x, axis=self.axis)
+        # logsumexp accumulation stays f32 for 16-bit inputs (an 8-bit
+        # mantissa sum over a wide axis loses the tail); the activation
+        # keeps its precision class
+        return jax.nn.softmax(_f32a(x), axis=self.axis).astype(x.dtype)
 
 
 class GELU(Operator):
@@ -322,6 +338,8 @@ class CrossEntropy(Operator):
         t = jax.lax.stop_gradient(t)
         eps = 1e-10
         batch = x.shape[0]
+        # loss reduction in f32 regardless of the net's compute dtype
+        x, t = _f32a(x), _f32a(t)
         return -jnp.sum(t * jnp.log(x + eps)) / batch
 
 
@@ -333,6 +351,9 @@ class SoftMaxCrossEntropy(Operator):
 
     def forward(self, x, t):
         t = jax.lax.stop_gradient(t)
+        # logsumexp + mean in f32: the fragile-op contract of 16-bit
+        # policies (and of the plain bf16 input path)
+        x = _f32a(x)
         logp = jax.nn.log_softmax(x, axis=-1)
         if t.shape == x.shape:
             ce = -jnp.sum(t * logp, axis=-1)
@@ -349,13 +370,14 @@ class MeanSquareError(Operator):
     def forward(self, x, t):
         t = jax.lax.stop_gradient(t)
         batch = x.shape[0]
-        return jnp.sum(jnp.square(x - t)) / (2.0 * batch)
+        return jnp.sum(jnp.square(_f32a(x) - _f32a(t))) / (2.0 * batch)
 
 
 class BinaryCrossEntropy(Operator):
     def forward(self, x, t):
         t = jax.lax.stop_gradient(t)
         eps = 1e-10
+        x, t = _f32a(x), _f32a(t)
         per = -(t * jnp.log(x + eps) + (1 - t) * jnp.log(1 - x + eps))
         return jnp.mean(jnp.sum(per.reshape(per.shape[0], -1), axis=-1))
 
@@ -693,7 +715,11 @@ class Embedding(Operator):
     """Lookup rows of W by integer ids (reference autograd.Embedding:5648)."""
 
     def forward(self, x, W):
-        return jnp.take(W, jax.lax.stop_gradient(x).astype(jnp.int32), axis=0)
+        y = jnp.take(W, jax.lax.stop_gradient(x).astype(jnp.int32), axis=0)
+        # policy cast on the GATHERED rows, not the table: casting W
+        # itself would materialise a full 16-bit copy of the (possibly
+        # vocab-sized) table; ids are index-valued and never cast
+        return _cast_compute(y)
 
 
 class CosSim(Operator):

@@ -32,6 +32,7 @@ import warnings
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from .integrity import (IntegrityError, digest_tree, manifest_digest,
                         read_digest_sidecar, verify_tree,
@@ -54,6 +55,23 @@ def _state_tensor_dict(model):
 def _aux_param_base(name):
     """'<param>:<kind>' (optionally 'residual/<param>') -> param name."""
     return name.split("/", 1)[-1].rsplit(":", 1)[0]
+
+
+def _adapt_float(arr, target_dt):
+    """Adapt a restored array to a live/template dtype, float-to-float
+    only: a checkpoint written under a different precision mode (pure
+    bf16 params vs fp32 masters) lands in the LIVE dtype so the
+    compiled step's avals — and state donation — survive the migration.
+    bf16→f32 is lossless; the reverse is the destination policy's own
+    quantisation. Same-dtype (and any non-float) input passes through
+    untouched, bit-identical."""
+    arr_dt = getattr(arr, "dtype", None)
+    if (target_dt is not None and arr_dt is not None
+            and target_dt != arr_dt
+            and jnp.issubdtype(target_dt, jnp.floating)
+            and jnp.issubdtype(arr_dt, jnp.floating)):
+        return jnp.asarray(arr, dtype=target_dt)
+    return arr
 
 
 def _build_restore_template(live, meta_tree):
@@ -98,11 +116,16 @@ def _apply_restored(model, live, restored):
                     f"{tuple(np.shape(lt.data))}; skipped (did the "
                     "architecture change since the save?)", stacklevel=3)
                 continue
-            lt.data = arr
+            lt.data = _adapt_float(arr, getattr(lt.data, "dtype", None))
         elif k.startswith("optimizer/") and opt is not None \
                 and hasattr(opt, "restore_state_tensor"):
             nm = k[len("optimizer/"):]
             pt = live.get("model/" + _aux_param_base(nm))
+            # lazily-built aux has no live tensor to adapt to yet — the
+            # owning param's dtype is its template (momentum must match
+            # its master, or the first step promotes and retraces)
+            arr = _adapt_float(
+                arr, getattr(getattr(pt, "data", None), "dtype", None))
             opt.restore_state_tensor(nm, arr, getattr(pt, "spec", None))
         else:
             warnings.warn(f"checkpoint entry {k!r} has no live "

@@ -165,6 +165,11 @@ class Layer:
 
 
 def _param(shape, device, init="zeros", dtype=jnp.float32):
+    # deferred inits pass the INPUT's dtype here; under an active
+    # precision policy the master must not follow a 16-bit activation —
+    # ops cast params down at their use sites instead (mixed_precision)
+    from .mixed_precision import param_dtype as _policy_param_dtype
+    dtype = _policy_param_dtype(dtype)
     t = Tensor(shape=shape, device=device, dtype=dtype,
                requires_grad=True, stores_grad=True)
     if init == "ones":

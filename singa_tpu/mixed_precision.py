@@ -1,0 +1,254 @@
+"""Precision policy: bf16 compute against fp32 master parameters.
+
+On TPU the MXU's native matmul precision is bf16 — feeding it bf16
+operands roughly doubles dense throughput and halves the HBM traffic of
+activations and gradient communication. What must NOT be bf16 is the
+canonical training state: parameters drift by updates ~1e-4 of their
+magnitude, below bf16's 8 mantissa bits, so masters stay fp32 and only
+the *compute* is cast down.
+
+A :class:`Policy` names the three dtypes of that contract:
+
+- ``param_dtype`` — what parameters are created and updated in (the
+  masters; what every checkpoint route saves);
+- ``compute_dtype`` — what matmul/conv/attention operands are cast to
+  inside the traced step;
+- ``output_dtype`` — what floating output leaves of the compiled step
+  are cast back to at the step boundary.
+
+The policy is threaded through ``Model.compile(policy=...)``: the model
+enters :func:`policy_scope` inside its jitted train/eval builders, so
+every cast is part of ONE fused XLA program (params are cast at their
+use sites; XLA dedups the converts and the backward casts gradients back
+up through the same boundary — the optimizer always sees fp32 gradients
+against fp32 masters). Numerically fragile ops opt out by construction:
+BatchNorm/LayerNorm statistics, softmax/logsumexp accumulations and loss
+reductions run in fp32 regardless of policy (see ops/batchnorm.py,
+autograd losses, ops/losses.py), and :func:`fp32_accumulate` is the
+escape hatch for user code that needs a full-precision region inside a
+policy scope.
+
+No reference counterpart (the reference's closest knob is fp16 wire
+format in Communicator::fusedSynchHalf); the design follows the standard
+mixed-precision recipe the TPU literature attributes most of the bf16
+cost advantage to.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+
+import jax.numpy as jnp
+
+__all__ = ["Policy", "resolve", "active_policy", "policy_scope",
+           "fp32_accumulate", "cast_compute", "compute_dtype",
+           "param_dtype", "accum_f32"]
+
+# canonical named policies; aliases normalise below
+_NAMED = {
+    "float32": ("float32", "float32", "float32"),
+    "bf16_mixed": ("float32", "bfloat16", "float32"),
+    "float16_mixed": ("float32", "float16", "float32"),
+    "bfloat16": ("bfloat16", "bfloat16", "bfloat16"),
+}
+_ALIASES = {"fp32": "float32", "f32": "float32",
+            "bf16": "bfloat16", "mixed_bf16": "bf16_mixed",
+            "fp16_mixed": "float16_mixed", "f16_mixed": "float16_mixed"}
+
+_LOW_BITS = (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+
+
+def _dt(x):
+    return None if x is None else jnp.dtype(x)
+
+
+class Policy:
+    """One precision contract for a compiled model (see module doc).
+
+    ``Policy("bf16_mixed")`` is the TPU production setting: fp32
+    masters, bf16 compute, fp32 outputs. Explicit dtype kwargs override
+    the named preset; ``loss_scaling`` overrides whether
+    ``Model.compile`` pairs the policy with a dynamic-loss-scaling
+    :class:`~singa_tpu.resilience.GuardedOptimizer` by default (on for
+    every 16-bit compute dtype, off for float32).
+    """
+
+    def __init__(self, name="bf16_mixed", *, param_dtype=None,
+                 compute_dtype=None, output_dtype=None, loss_scaling=None):
+        key = _ALIASES.get(str(name).lower(), str(name).lower())
+        if key not in _NAMED:
+            raise ValueError(
+                f"unknown precision policy {name!r}; expected one of "
+                f"{sorted(_NAMED)} (or aliases {sorted(_ALIASES)})")
+        self.name = key
+        p, c, o = _NAMED[key]
+        self.param_dtype = _dt(param_dtype if param_dtype is not None
+                               else p)
+        self.compute_dtype = _dt(compute_dtype if compute_dtype is not None
+                                 else c)
+        self.output_dtype = _dt(output_dtype if output_dtype is not None
+                                else o)
+        self._loss_scaling = loss_scaling
+
+    # -- derived contract --------------------------------------------------
+    @property
+    def is_mixed(self):
+        """True when compute happens below the masters' precision."""
+        return self.compute_dtype != self.param_dtype
+
+    @property
+    def comm_dtype(self):
+        """Wire dtype for gradient collectives under this policy (None =
+        reduce in the gradients' own dtype). A 16-bit compute dtype
+        makes the comm 16-bit too: the psum'd values were just computed
+        at that precision, so the wire loses nothing extra while the
+        all-reduce moves half the bytes."""
+        return self.compute_dtype if self.compute_dtype in _LOW_BITS \
+            else None
+
+    @property
+    def wants_loss_scaling(self):
+        if self._loss_scaling is not None:
+            return bool(self._loss_scaling)
+        return self.compute_dtype in _LOW_BITS
+
+    @property
+    def default_loss_scale(self):
+        """Initial dynamic-loss-scale: fp16's narrow exponent needs the
+        classic 2^15 underflow shield; bf16 shares fp32's exponent range
+        so scaling starts neutral and only moves if the guard's dynamic
+        backoff/growth finds a reason."""
+        return 2.0 ** 15 if self.compute_dtype == jnp.dtype(jnp.float16) \
+            else 1.0
+
+    def describe(self):
+        return {"name": self.name,
+                "param_dtype": str(self.param_dtype),
+                "compute_dtype": str(self.compute_dtype),
+                "output_dtype": str(self.output_dtype)}
+
+    def __repr__(self):
+        return (f"Policy({self.name!r}: params={self.param_dtype}, "
+                f"compute={self.compute_dtype}, out={self.output_dtype})")
+
+    def __eq__(self, other):
+        # loss scaling is part of the contract: a recompile that only
+        # flips the opt-out must still register as a policy change
+        return isinstance(other, Policy) and \
+            self.describe() == other.describe() and \
+            self.wants_loss_scaling == other.wants_loss_scaling
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.describe().items()))
+                    + (self.wants_loss_scaling,))
+
+    # -- casts -------------------------------------------------------------
+    def cast_output(self, x):
+        """Step-boundary cast of one output leaf (floats only: integer
+        outputs — predictions, counts — are never touched)."""
+        if self.output_dtype is None or not hasattr(x, "dtype"):
+            return x
+        if jnp.issubdtype(x.dtype, jnp.floating) and \
+                x.dtype != self.output_dtype:
+            return x.astype(self.output_dtype)
+        return x
+
+
+def resolve(policy):
+    """str | Policy | None -> Policy | None."""
+    if policy is None or isinstance(policy, Policy):
+        return policy
+    return Policy(policy)
+
+
+# Per-context scope stack (same pattern as ops/layout.py): a ContextVar
+# so a policy entered while one model's step traces can never leak into
+# another thread's trace; ``None`` entries are fp32_accumulate escapes.
+_stack: ContextVar[tuple] = ContextVar("singa_tpu_precision_policy",
+                                       default=())
+
+
+def active_policy():
+    """The innermost active Policy, or None (no policy / inside an
+    :func:`fp32_accumulate` escape)."""
+    s = _stack.get()
+    return s[-1] if s else None
+
+
+@contextlib.contextmanager
+def policy_scope(policy):
+    """Activate a policy for ops traced within (model step builders
+    enter this inside their jit bodies, so the casts land in the one
+    fused program). ``None`` is a no-op scope."""
+    if policy is None:
+        yield
+        return
+    token = _stack.set(_stack.get() + (resolve(policy),))
+    try:
+        yield
+    finally:
+        _stack.reset(token)
+
+
+@contextlib.contextmanager
+def fp32_accumulate():
+    """Escape hatch: suspend compute-dtype casting for ops built inside
+    — the fp32-accumulate region for numerically fragile user code
+    (custom reductions, cumulative sums, metric math) under a 16-bit
+    policy. Params created inside still honor the *outer* policy's
+    param story only if created via an explicit dtype; compute casts are
+    simply off."""
+    token = _stack.set(_stack.get() + (None,))
+    try:
+        yield
+    finally:
+        _stack.reset(token)
+
+
+def compute_dtype():
+    """Active compute dtype, or None when no policy applies."""
+    p = active_policy()
+    return p.compute_dtype if p is not None else None
+
+
+def cast_compute(*arrays):
+    """Cast floating operands to the active policy's compute dtype (the
+    per-op discipline matmul/conv/attention/bias ops apply to their
+    inputs). Integers, bools and ``None`` pass through; with no active
+    policy this is the identity. Returns a single value for a single
+    argument."""
+    p = active_policy()
+    if p is None or p.compute_dtype is None:
+        return arrays[0] if len(arrays) == 1 else arrays
+    ct = p.compute_dtype
+    out = []
+    for a in arrays:
+        if a is not None and hasattr(a, "dtype") and \
+                jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != ct:
+            a = a.astype(ct)
+        out.append(a)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def accum_f32(x):
+    """Accumulate in f32: upcast a 16-bit float before a numerically
+    fragile reduction (softmax/logsumexp, loss means, norm statistics).
+    The cast fuses into the reduction under XLA, so the fp32 discipline
+    is free; f32 inputs pass through untouched. The op-level sibling of
+    the :func:`fp32_accumulate` scope."""
+    return x.astype(jnp.float32) if x.dtype in _LOW_BITS else x
+
+
+def param_dtype(dtype=None):
+    """Dtype a NEW trainable parameter should be created in: the active
+    policy's master dtype for floating params (deferred layer inits pass
+    the input's dtype here — under a policy the masters must not follow
+    a 16-bit activation), the requested dtype otherwise."""
+    p = active_policy()
+    if p is None or p.param_dtype is None:
+        return dtype
+    if dtype is not None and not jnp.issubdtype(jnp.dtype(dtype),
+                                                jnp.floating):
+        return dtype
+    return p.param_dtype

@@ -87,11 +87,16 @@ def _resolve_leaf_specs(leaves, full_batch, input_specs, axis, user_out):
     builders: a user-supplied spec list wins; otherwise batch-leading
     leaves shard like the input batch dim (which may span several mesh
     axes, e.g. ('data','expert') for MoE — P('data') alone would
-    mis-stitch those outputs) and everything else replicates."""
+    mis-stitch those outputs) and everything else replicates.
+
+    Leaves are already arrays (or array-shaped zeros from the abstract
+    rehearsal); only their host metadata is read — no jnp.asarray, no
+    device round-trip on the compile path."""
     if user_out is not None:
         return list(user_out)
-    shard_mask = [jnp.asarray(x).ndim >= 1 and
-                  jnp.asarray(x).shape[0] == full_batch for x in leaves]
+    shapes = [x.shape if hasattr(x, "shape") else np.shape(x)
+              for x in leaves]
+    shard_mask = [len(s) >= 1 and s[0] == full_batch for s in shapes]
     batch_ax = _batch_dim_axes(input_specs, axis)
     return [P(batch_ax) if m else P() for m in shard_mask]
 
@@ -191,6 +196,7 @@ class Model(Layer):
         self._steps = {}           # static-arg signature -> compiled step
         self._state_list = None
         self._dist = None
+        self._policy = None        # mixed_precision.Policy (compile arg)
         self._step_count = 0
         self._eval_steps = {}      # input signature -> compiled eval step
         self.step_times = []
@@ -202,7 +208,64 @@ class Model(Layer):
     def train_one_batch(self, *args, **kwargs):
         raise NotImplementedError
 
+    def _migrate_masters(self, new_policy):
+        """Recompiling across a param-dtype change (pure-bf16 ->
+        bf16_mixed, or back to an explicit 16-bit master policy): cast
+        already-materialised trainable params — and the optimizer aux
+        that mirrors them (momentum/moments/residuals) — to the new
+        master dtype, so the live state matches what the new policy
+        reports and checkpoints. Non-trainable state (BN running stats,
+        guard counters/shadows) keeps its own dtype; 16->32 is
+        lossless, 32->16 is the destination policy's own quantisation."""
+        pd = new_policy.param_dtype if new_policy is not None else None
+        if pd is None:
+            return
+
+        def _adapt(t):
+            if not isinstance(t.data, jax.core.Tracer) and \
+                    jnp.issubdtype(t.dtype, jnp.floating) and \
+                    t.dtype != pd:
+                t.data = t.data.astype(pd)
+
+        for t in self.get_states().values():
+            if t.requires_grad:
+                _adapt(t)
+        opt0 = getattr(self, "optimizer", None)
+        if opt0 is not None and hasattr(opt0, "state_tensor_dict"):
+            for k, t in opt0.state_tensor_dict().items():
+                # per-param aux is named '<param>:<kind>' (residuals
+                # 'residual/<param>'); scalars and guard shadows are not
+                if ":" in k.rsplit("/", 1)[-1] or \
+                        k.startswith("residual/"):
+                    _adapt(t)
+
+    def _policy_companion(self, optimizer):
+        """Pair a 16-bit precision policy with dynamic loss scaling: the
+        promised-automatic GuardedOptimizer wrap, applied wherever the
+        optimizer meets the policy — compile(policy=...) over an
+        existing optimizer OR set_optimizer called after compile. An
+        optimizer already guarded (has dynamic_loss_scale) keeps its own
+        configuration."""
+        pol = getattr(self, "_policy", None)
+        wants = pol is not None and pol.wants_loss_scaling
+        mark = vars(optimizer).get("_policy_companion_wrap") \
+            if optimizer is not None else None
+        if mark is not None and (not wants or mark != pol):
+            # undo OUR wrap (never a user's) when the policy stops
+            # wanting scaling (loss_scaling=False recompile) or changed
+            # contract (bf16_mixed -> float16_mixed must re-derive its
+            # init scale, not inherit the old policy's); the same
+            # policy keeps the wrap AND its adapted scale state
+            optimizer = optimizer.inner
+        if (wants and optimizer is not None
+                and not hasattr(optimizer, "dynamic_loss_scale")):
+            from .resilience import GuardedOptimizer
+            optimizer = GuardedOptimizer.for_policy(optimizer, pol)
+            optimizer._policy_companion_wrap = pol
+        return optimizer
+
     def set_optimizer(self, optimizer):
+        optimizer = self._policy_companion(optimizer)
         self.optimizer = optimizer
         if hasattr(optimizer, "bind_model"):
             # guards (resilience.GuardedOptimizer) shadow model state the
@@ -225,10 +288,43 @@ class Model(Layer):
 
     # -- compile -----------------------------------------------------------
     def compile(self, inputs, is_train=True, use_graph=False,
-                sequential=False):
+                sequential=False, policy=None):
         """Shape-infer via a dry forward run (reference model.py:156-184),
-        decide graph (jit) mode, and detect a distributed optimizer."""
+        decide graph (jit) mode, and detect a distributed optimizer.
+
+        ``policy``: a :class:`singa_tpu.mixed_precision.Policy` (or its
+        name, e.g. ``"bf16_mixed"``) activating mixed-precision compile:
+        parameters are created/updated as fp32 masters, matmul/conv/
+        attention cast their operands to the compute dtype INSIDE the
+        jitted step (one fused XLA program; donation of the fp32 state
+        is unchanged), fragile ops (norm stats, softmax/loss reductions)
+        stay fp32, and floating output leaves are cast back to the
+        policy's output dtype at the step boundary. A 16-bit policy is
+        paired with dynamic loss scaling by default: a plain optimizer
+        is wrapped in ``resilience.GuardedOptimizer`` here (pass
+        ``Policy(name, loss_scaling=False)`` or pre-wrap yourself to
+        opt out)."""
         assert len(inputs) > 0
+        from . import mixed_precision as mp
+        new_policy = mp.resolve(policy)
+        if new_policy != getattr(self, "_policy", None):
+            # a RE-compile under a different policy must not replay
+            # executables traced under the old one (they'd silently run
+            # the old precision while every surface reports the new),
+            # and params the old policy already materialised — the dry
+            # run below creates them on the FIRST compile — move to the
+            # new master dtype. Both are no-ops on a fresh model.
+            self._invalidate_compiled()
+            self._step_ready = False
+            self._migrate_masters(new_policy)
+        self._policy = new_policy
+        opt0 = getattr(self, "optimizer", None)
+        if opt0 is not None:
+            # loss scaling is the default companion of a 16-bit policy:
+            # re-route the existing optimizer through set_optimizer so
+            # the _policy_companion wrap applies (set_optimizer called
+            # AFTER compile hits the same wrap there)
+            self.set_optimizer(opt0)
         self.dev = inputs[0].device
         self.graph_mode = use_graph
         self.sequential = sequential
@@ -236,9 +332,10 @@ class Model(Layer):
         CTX.training = False
         try:
             # abstract dry run: layer.initialize still executes (params
-            # materialise concretely) but the inter-layer compute traces
-            # with zero device work — on a network-tunneled accelerator
-            # an eager dry run costs one round trip PER OP
+            # materialise concretely — under a policy, as its master
+            # dtype) but the inter-layer compute traces with zero device
+            # work — on a network-tunneled accelerator an eager dry run
+            # costs one round trip PER OP
             self._abstract_call(inputs, lambda: self.forward(*inputs))
         except Exception as e:
             import warnings
@@ -246,7 +343,8 @@ class Model(Layer):
                 f"abstract dry run failed ({type(e).__name__}: {e}); "
                 "falling back to an eager forward — host-side effects in "
                 "forward may have run twice", stacklevel=2)
-            self.forward(*inputs)
+            with self._policy_scope():
+                self.forward(*inputs)
         finally:
             CTX.training = prev
         # name params/states now so optimizer aux keys are stable between
@@ -263,6 +361,17 @@ class Model(Layer):
             self._dist = opt.inner
         self._compiled = True
         self.train(is_train)
+
+    def _policy_scope(self):
+        """The model's precision-policy scope: entered inside every
+        traced body (train step, eval step, abstract rehearsal) AND the
+        eager fallbacks, so op-level compute casts and param creation
+        see one consistent policy wherever the model's code runs —
+        including a watchdog worker thread (the scope is entered inside
+        the body, so no ContextVar propagation is needed). Nullcontext
+        when the model was compiled without a policy."""
+        from . import mixed_precision as mp
+        return mp.policy_scope(getattr(self, "_policy", None))
 
     # -- abstract (zero-compute) materialisation ---------------------------
     def _abstract_call(self, inputs, body):
@@ -297,9 +406,10 @@ class Model(Layer):
             return leaves
 
         try:
-            out_avals = jax.eval_shape(
-                absfn, [jax.ShapeDtypeStruct(np.shape(d), d.dtype)
-                        for d in datas])
+            with self._policy_scope():
+                out_avals = jax.eval_shape(
+                    absfn, [jax.ShapeDtypeStruct(np.shape(d), d.dtype)
+                            for d in datas])
         finally:
             for t, d in zip(inputs, datas):
                 t.data = d
@@ -389,6 +499,11 @@ class Model(Layer):
         n_inputs = sum(1 for s in layout if s is _TENSOR)
 
         def fn(state_arrays, rng_key, *input_arrays):
+            # host-side trace counter: this python body runs ONCE per
+            # jit trace (steady-state training must keep it at 1 — the
+            # retrace-guard CI test pins that; cost-analysis/audit
+            # re-lowers legitimately add to it)
+            rec["n_traces"] = rec.get("n_traces", 0) + 1
             # advance the RNG stream inside the trace: one half drives this
             # step's random ops, the other is handed back as the next
             # step's key — no host-side eager split per step (it cost more
@@ -407,9 +522,15 @@ class Model(Layer):
             ins = [Tensor(data=next(it), device=self.dev,
                           requires_grad=False) if s is _TENSOR else s
                    for s in layout]
-            res = self.train_one_batch(*ins)
+            with self._policy_scope():
+                res = self.train_one_batch(*ins)
             leaves = []
             rec["out_tree"]["tree"] = _flatten(res, leaves)
+            pol = getattr(self, "_policy", None)
+            if pol is not None:
+                # step-boundary output cast: compute may run 16-bit but
+                # what the host sees is the policy's output dtype
+                leaves = [pol.cast_output(x) for x in leaves]
             if dist is not None:
                 # output leaves that end up replicated (loss scalars,
                 # metrics, param snapshots) are averaged across batch-like
@@ -470,11 +591,36 @@ class Model(Layer):
             rec["jit"] = jax.jit(fn, donate_argnums=(0,))
         return rec
 
+    def _cast_output_tree(self, res):
+        """Policy output contract for EAGER results (the compiled paths
+        cast their flattened leaves instead): floating leaves — Tensor
+        OR raw array, matching what _flatten treats as a leaf — go to
+        output_dtype."""
+        pol = getattr(self, "_policy", None)
+        if pol is None:
+            return res
+
+        def _cast(t):
+            if isinstance(t, Tensor):
+                if jnp.issubdtype(t.dtype, jnp.floating) and \
+                        t.dtype != pol.output_dtype:
+                    t = Tensor(data=pol.cast_output(t.data),
+                               device=t.device, requires_grad=False)
+                return t
+            return pol.cast_output(t)
+
+        return jax.tree_util.tree_map(
+            _cast, res, is_leaf=lambda x: isinstance(x, Tensor))
+
     def _run_step(self, *args):
         """Train-mode step dispatch (reference
         ModelMeta.buffer_operation wrapper, model.py:56-91)."""
         if not self.graph_mode:
-            return self.train_one_batch(*args)
+            # the non-graph path honors the same policy contract as the
+            # compiled one (compute casts + output dtype), just eagerly
+            with self._policy_scope():
+                res = self.train_one_batch(*args)
+            return self._cast_output_tree(res)
         if not self._step_ready:
             # first call materialises params + optimizer aux states.
             # Preferred: abstractly (zero device compute — the reference's
@@ -499,10 +645,11 @@ class Model(Layer):
                         "eager first step — note any host-side effects in "
                         "train_one_batch may have run twice", stacklevel=3)
             if not self._step_ready:
-                res = self.train_one_batch(*args)
+                with self._policy_scope():
+                    res = self.train_one_batch(*args)
                 self._step_ready = True
                 self._eager_out = res
-                return res
+                return self._cast_output_tree(res)
         input_arrays, layout = self._split_step_args(args)
         try:
             hash(layout)
@@ -679,7 +826,10 @@ class Model(Layer):
             ins = [Tensor(data=next(it), device=self.dev,
                           requires_grad=False) if s is _TENSOR else s
                    for s in layout]
-            res = self.train_one_batch(*ins)
+            # same policy scope as the real step, so the dumped jaxpr
+            # shows the convert ops the compiled program actually runs
+            with self._policy_scope():
+                res = self.train_one_batch(*ins)
             leaves = []
             _flatten(res, leaves)
             return [t.data for t in self._state_list], leaves
@@ -786,7 +936,8 @@ class Model(Layer):
                 ins = [Tensor(data=a, device=self.dev,
                               requires_grad=False)
                        for a in input_arrays]
-                res = self.forward(*ins)
+                with self._policy_scope():
+                    res = self.forward(*ins)
             finally:
                 CTX.training = prev
                 # eval leaves state untouched: restore the concrete
@@ -795,6 +946,9 @@ class Model(Layer):
                     t.data = a
             leaves = []
             rec["tree"] = _flatten(res, leaves)
+            pol = getattr(self, "_policy", None)
+            if pol is not None:
+                leaves = [pol.cast_output(x) for x in leaves]
             specs = rec["leaf_specs"]
             raxes = tuple(dist.communicator.reduce_axes)
             kinds = getattr(self, "eval_output_reduce", None) or []
@@ -931,7 +1085,12 @@ class Model(Layer):
         prev = CTX.training
         CTX.training = False
         try:
-            return self.forward(*args, **kwargs)
+            with self._policy_scope():
+                res = self.forward(*args, **kwargs)
+            # the eager path honors the same output contract as the
+            # compiled one (a bf16-computed eval still hands back
+            # output_dtype leaves)
+            return self._cast_output_tree(res)
         finally:
             CTX.training = prev
 
@@ -989,7 +1148,10 @@ class Model(Layer):
             for a in state_avals)
         donated = getattr(ma, "alias_size_in_bytes", None)
         return {"memory_analysis": ma, "donated_bytes": donated,
-                "state_bytes": state_bytes, "hlo": hlo}
+                "state_bytes": state_bytes, "hlo": hlo,
+                "n_traces": rec.get("n_traces"),
+                "policy": self._policy.describe()
+                if getattr(self, "_policy", None) is not None else None}
 
     def save_states(self, fpath, aux_states={}):  # noqa: B006 (parity)
         """Zip of params+states .npz and an attribute JSON, including
@@ -997,6 +1159,11 @@ class Model(Layer):
         states = {k: v for k, v in self.get_states().items()}
         attr = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                 for k, v in states.items()}
+        if getattr(self, "_policy", None) is not None:
+            # self-describing checkpoints: params in the archive are the
+            # POLICY'S MASTERS (fp32 under bf16_mixed) — record the
+            # policy so a reader can tell masters from a pure-16-bit run
+            attr["meta/precision_policy"] = self._policy.describe()
         from .tensor import to_host_tree
 
         def _portable(a):

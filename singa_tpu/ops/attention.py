@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd_base import Operator
+from ..mixed_precision import cast_compute as _cast_compute
 from ..parallel.communicator import axis_size as _axis_size
 
 _NEG_INF = -1e30
@@ -742,6 +743,9 @@ class _FlashAttention(Operator):
         self.scale = scale
 
     def forward(self, q, k, v):
+        # policy discipline: attention matmuls run in the compute dtype;
+        # the kernel's own online-softmax statistics are f32 regardless
+        q, k, v = _cast_compute(q, k, v)
         return flash_attention(q, k, v, self.causal, self.scale)
 
 
@@ -781,6 +785,7 @@ class _UlyssesAttention(Operator):
         self.scale = scale
 
     def forward(self, q, k, v):
+        q, k, v = _cast_compute(q, k, v)
         return ulysses_attention(q, k, v, self.axis_name, self.causal,
                                  self.scale)
 
@@ -795,6 +800,7 @@ class _RingAttention(Operator):
         self.scale = scale
 
     def forward(self, q, k, v):
+        q, k, v = _cast_compute(q, k, v)
         return ring_attention(q, k, v, self.axis_name, self.causal,
                               self.scale)
 

@@ -135,8 +135,17 @@ def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,
         xb = x.data if isinstance(x, Tensor) else x
         batch_mean, batch_var = _global_moments(xb, axes)
         m = h.factor
-        running_mean.data = m * running_mean.data + (1 - m) * batch_mean
-        running_var.data = m * running_var.data + (1 - m) * batch_var
+        # running stats keep their own (f32) dtype under EVERY precision
+        # mode — _global_moments already accumulates f32, and the astype
+        # pins the threaded state's dtype so a precision policy (or a
+        # stat tensor restored from an older checkpoint) can never flip
+        # it mid-training and break step donation
+        running_mean.data = (m * running_mean.data.astype(jnp.float32)
+                             + (1 - m) * batch_mean
+                             ).astype(running_mean.data.dtype)
+        running_var.data = (m * running_var.data.astype(jnp.float32)
+                            + (1 - m) * batch_var
+                            ).astype(running_var.data.dtype)
         op, args = _BatchNorm2d(handle), (x, scale, bias)
     else:
         op, args = _BatchNorm2dInference(handle), \

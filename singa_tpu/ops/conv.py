@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd_base import Operator
+from ..mixed_precision import cast_compute as _cast_compute
 
 
 def _pair(v):
@@ -172,6 +173,11 @@ class _Conv2d(Operator):
         self.odd_padding = odd_padding  # extra (t,b,l,r) pad, reference util
 
     def forward(self, x, W, b=None):
+        # an active precision policy runs the conv in its compute dtype
+        # (x is cast here too — the stem conv is where an f32 input
+        # becomes a 16-bit activation); the trailing astype(x.dtype)
+        # then keeps the whole trunk in that precision class
+        x, W, b = _cast_compute(x, W, b)
         h = self.handle
         if getattr(h, "space_to_depth", False):
             y = _add_bias(_space_to_depth_conv(x, W, h), b, h.layout)
@@ -256,6 +262,7 @@ class _ConvTranspose2d(Operator):
         self.handle = handle
 
     def forward(self, x, W, b=None):
+        x, W, b = _cast_compute(x, W, b)
         h = self.handle
         kh, kw = h.kernel_size
         dh, dw = h.dilation

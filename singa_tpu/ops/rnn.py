@@ -155,6 +155,10 @@ class _RNN(Operator):
         self.use_mask = use_mask
 
     def forward(self, x, hx, cx, W, seq_lengths=None):
+        # policy discipline: the scanned gate matmuls run in the compute
+        # dtype (W is the packed master; lengths are index-valued)
+        from ..mixed_precision import cast_compute as _cast_compute
+        x, hx, cx, W = _cast_compute(x, hx, cx, W)
         h = self.handle
         lengths = seq_lengths
         D, L, H = h.num_directions, h.num_layers, h.hidden_size

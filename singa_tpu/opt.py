@@ -504,6 +504,19 @@ class DistOpt:
     def all_reduce(self, arr, exclude=()):
         return self.communicator.all_reduce(arr, exclude=exclude)
 
+    def all_reduce_wire(self, arr, exclude=(), wire=None):
+        """All-reduce with the policy's (or an explicit) 16-bit wire
+        cast, returning f32 when a cast happened — the ONE place the
+        comm-dtype discipline lives, shared by the plain and guarded
+        drivers. ``wire=None`` resolves the active policy; no policy
+        (or the grad already on the wire dtype) reduces as-is."""
+        if wire is None:
+            wire = self._policy_wire()
+        if wire is not None and arr.dtype != wire:
+            return self.all_reduce(arr.astype(wire),
+                                   exclude=exclude).astype(jnp.float32)
+        return self.all_reduce(arr, exclude=exclude)
+
     def update(self, p: Tensor, g: Tensor):
         """Average an already-summed gradient and apply
         (reference opt.py:738-746: grad /= world_size).
@@ -516,30 +529,68 @@ class DistOpt:
         g.data = g.data / self.communicator.effective_world_size()
         self.opt.apply(p.name or f"param/{id(p)}", p, g)
 
+    @staticmethod
+    def _policy_wire():
+        """Wire dtype for gradient collectives under the ACTIVE precision
+        policy (None = reduce in the gradients' own dtype). The compiled
+        step enters the model's policy scope, so a bf16_mixed model's
+        psums automatically move 16-bit bytes — the policy-driven form of
+        the explicit ``backward_and_update_half`` driver."""
+        from .mixed_precision import active_policy
+        pol = active_policy()
+        return pol.comm_dtype if pol is not None else None
+
     # -- training drivers ---------------------------------------------------
     def backward_and_update(self, loss, threshold=2097152):
         """All-reduce each gradient as soon as backward produces it
         (reference opt.py:826-865). ``threshold`` is accepted for parity;
-        XLA handles small-tensor fusion so no manual fused buffer exists."""
+        XLA handles small-tensor fusion so no manual fused buffer exists.
+        Under an active 16-bit precision policy the reduce moves the
+        policy's comm dtype on the wire; the update math that follows is
+        back in the masters' precision."""
+        wire = self._policy_wire()
         for p, g in autograd.backward(loss):
-            g.data = self.all_reduce(g.data, exclude=self._shard_axes(p))
+            g.data = self.all_reduce_wire(g.data,
+                                          exclude=self._shard_axes(p),
+                                          wire=wire)
             self.update(p, g)
         self.opt.step()
 
+    @classmethod
+    def _half_wire_defaults(cls, dtype, clipping):
+        """Resolve backward_and_update_half's (dtype, clipping)
+        defaults: an explicit dtype keeps the caller's choices; a None
+        dtype takes the active policy's comm dtype (else bfloat16), and
+        a POLICY-selected fp16 wire forces clipping on — fp16 overflows
+        above 65504 and this driver runs unguarded."""
+        if dtype is not None:
+            return dtype, clipping
+        wire_pol = cls._policy_wire()
+        if wire_pol == jnp.dtype(jnp.float16):
+            return "float16", True
+        return wire_pol or "bfloat16", clipping
+
     def backward_and_update_half(self, loss, threshold=2097152,
                                  clipping=False, clip_value=2.5,
-                                 dtype="bfloat16"):
+                                 dtype=None):
         """Reduced-precision communication: cast to a 16-bit type before
         the all-reduce (reference synchHalf fp16 comm,
         src/io/communicator.cc:262-299). ``dtype`` selects the wire
-        format: "bfloat16" (default — the TPU-native half type, same
-        exponent range as fp32 so no clipping is required) or "float16"
-        (the reference's IEEE wire format, e.g. for DCN cross-slice links
+        format: "bfloat16" (the TPU-native half type, same exponent
+        range as fp32 so no clipping is required) or "float16" (the
+        reference's IEEE wire format, e.g. for DCN cross-slice links
         where the fp16 convention is fixed; pair with ``clipping`` since
-        fp16 overflows above 65504)."""
+        fp16 overflows above 65504). Default (None): the active
+        precision policy's comm dtype, else bfloat16 — and when the
+        POLICY selects the fp16 wire, clipping turns on with it (this
+        driver runs unguarded, so an unclipped policy-default fp16 wire
+        would let one large gradient sum land inf in the params)."""
+        dtype, clipping = self._half_wire_defaults(dtype, clipping)
         wire = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
                 jnp.bfloat16: jnp.bfloat16,
-                jnp.float16: jnp.float16}.get(dtype)
+                jnp.float16: jnp.float16,
+                jnp.dtype(jnp.bfloat16): jnp.bfloat16,
+                jnp.dtype(jnp.float16): jnp.float16}.get(dtype)
         if wire is None:
             raise ValueError(
                 f"dtype must be 'bfloat16' or 'float16', got {dtype!r}")

@@ -90,6 +90,20 @@ class GuardedOptimizer:
         self._model = None
         self._shadows = {}      # model-state name -> shadow Tensor
 
+    @classmethod
+    def for_policy(cls, optimizer, policy):
+        """The default companion of a 16-bit precision policy
+        (``Model.compile(policy=...)`` wraps a plain optimizer through
+        here): dynamic loss scaling ON, started at the policy's default
+        scale — 2^15 for float16 compute (the classic underflow shield),
+        neutral 1.0 for bfloat16 (same exponent range as f32; the
+        dynamic backoff/growth machinery stays armed against the
+        occasional overflow/NaN step). An optimizer the user already
+        wrapped keeps its own configuration and never passes through
+        here."""
+        return cls(optimizer, dynamic_loss_scale=True,
+                   init_scale=policy.default_loss_scale)
+
     # -- forward-mutated state shadows ------------------------------------
     def bind_model(self, model):
         """Called by Model.set_optimizer: lets the guard see model state
@@ -243,13 +257,16 @@ class GuardedOptimizer:
         inv = 1.0 / scale
         norm_sq = jnp.zeros((), jnp.float32)
         pairs = []
+        wire = DistOpt._policy_wire() if dist is not None else None
         for p, g in autograd.backward(loss, dy=dy):
             arr = g.data
             excl = dist._shard_axes(p) if dist is not None else ()
             if dist is not None:
                 # collectives issue per-grad as backward yields, so XLA
-                # still overlaps them with remaining backward compute
-                arr = dist.all_reduce(arr, exclude=excl)
+                # still overlaps them with remaining backward compute;
+                # under a 16-bit policy the wire carries the policy's
+                # comm dtype (the unscale below is f32 either way)
+                arr = dist.all_reduce_wire(arr, exclude=excl, wire=wire)
                 arr = arr / dist.communicator.effective_world_size()
             arr = arr.astype(jnp.float32) * inv
             contrib = jnp.sum(arr * arr)

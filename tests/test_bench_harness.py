@@ -244,6 +244,96 @@ def test_smoke_parser_keeps_partial_output(bench, monkeypatch):
     assert [r["smoke"] for r in lines] == ["device", "matmul_bf16_4096"]
 
 
+def _bank_probes(bench, statuses, src="watch"):
+    with open(bench.OBS_PATH, "a") as f:
+        for s in statuses:
+            f.write(json.dumps({"ts": "2026-01-01T00:00:00",
+                                "event": "probe", "status": s,
+                                "src": src}) + "\n")
+
+
+def test_probe_cooldown_trips_after_consecutive_timeouts(bench,
+                                                         monkeypatch):
+    """BENCH_r05 regression: 73 consecutive probe timeouts burned
+    ~11.5h of round budget at full probe cost. After
+    BENCH_PROBE_FASTFAIL consecutive timeouts the cooldown engages."""
+    monkeypatch.delenv("BENCH_FORCE_PROBE", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_FASTFAIL", raising=False)
+    assert bench._probe_cooldown() == 0          # no observations
+    _bank_probes(bench, ["timeout"] * 5)
+    assert bench._probe_cooldown() == 0          # below the default 6
+    _bank_probes(bench, ["timeout"])
+    assert bench._probe_cooldown() == 6
+    # ANY non-timeout outcome breaks the streak (the backend answered)
+    _bank_probes(bench, ["error"])
+    assert bench._probe_cooldown() == 0
+    _bank_probes(bench, ["timeout"] * 6)
+    assert bench._probe_cooldown() == 6
+    # non-probe records (cooldown markers, smokes) do not reset it
+    bench._record_obs("probe_cooldown", {"consecutive_timeouts": 6})
+    bench._record_obs("smoke", {"smoke": "device"})
+    assert bench._probe_cooldown() == 6
+    # env overrides: force re-probe / disable the fast-fail entirely
+    monkeypatch.setenv("BENCH_FORCE_PROBE", "1")
+    assert bench._probe_cooldown() == 0
+    monkeypatch.delenv("BENCH_FORCE_PROBE")
+    monkeypatch.setenv("BENCH_PROBE_FASTFAIL", "0")
+    assert bench._probe_cooldown() == 0
+    monkeypatch.setenv("BENCH_PROBE_FASTFAIL", "3")
+    assert bench._probe_cooldown() == 6
+
+
+def test_probe_cooldown_falls_straight_to_cpu(bench, capsys, monkeypatch):
+    """With the cooldown tripped, main() never launches a probe or a
+    TPU attempt — it banks a probe_cooldown record and reports the CPU
+    fallback (or a banked benchmark if one exists)."""
+    _bank_probes(bench, ["timeout"] * 8)
+    calls = []
+
+    def probe(t):
+        calls.append(("probe", t))
+        return ("timeout", "should not run")
+
+    def attempt(plat, t):
+        calls.append((plat, t))
+        return (dict(CPU_RES), None) if plat == "cpu" else (None, "down")
+
+    monkeypatch.setattr(bench, "_probe_tpu", probe)
+    monkeypatch.setattr(bench, "_attempt", attempt)
+    out = _run_main(bench, capsys)
+    assert out["platform"] == "cpu"
+    assert all(c[0] == "cpu" for c in calls), calls   # no probe, no tpu
+    obs = bench._load_obs()
+    assert any(o.get("event") == "probe_cooldown" for o in obs)
+    assert any("consecutive probe timeouts" in e
+               for e in out.get("retries", []))
+
+
+def test_probe_cooldown_prefers_banked_bench_over_cpu(bench, capsys,
+                                                      monkeypatch):
+    """A cooldown round with a benchmark banked earlier still reports
+    the hardware number, not the CPU liveness fallback."""
+    rec = {"ts": time_now(), "event": "bench",
+           "timing": "slope-readback"}
+    rec.update(TPU_RES)
+    with open(bench.OBS_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    _bank_probes(bench, ["timeout"] * 8)
+    monkeypatch.setattr(bench, "_probe_tpu",
+                        lambda t: (_ for _ in ()).throw(
+                            AssertionError("probe ran during cooldown")))
+    monkeypatch.setattr(bench, "_attempt",
+                        lambda plat, t: (dict(CPU_RES), None))
+    out = _run_main(bench, capsys)
+    assert out["platform"] == "tpu"
+    assert out["value"] == TPU_RES["throughput"]
+
+
+def time_now():
+    import time as _time
+    return _time.strftime("%Y-%m-%dT%H:%M:%S")
+
+
 def test_lm_train_flops_per_token_pinned():
     """Hand-computed value for the bench LM shape (d512 L6 S1024
     V32000, causal): proj 12d^2/layer, head dV, attn 2Sd/layer, all

@@ -65,6 +65,17 @@ class TestExamples:
                            "--stem", "space_to_depth"], timeout=900)
         assert "loss" in out.lower(), out[-500:]
 
+    def test_train_resnet_bf16_mixed_policy(self):
+        """The mixed-precision compile policy end-to-end through the
+        user CLI (acceptance: Model.compile(policy="bf16_mixed") trains
+        the resnet example): fp32 masters + loss scaling, bf16 compute,
+        --layout auto resolving the banked/default conv layout."""
+        out = run_example(["examples/train_cnn.py", "resnet", "--cpu",
+                           "--epochs", "1", "--iters", "2", "--bs", "2",
+                           "-p", "bf16_mixed"], timeout=900)
+        assert "loss" in out.lower(), out[-500:]
+        assert "conv layout:" in out.lower(), out[-500:]
+
     def test_train_charrnn(self):
         out = run_example(["examples/train_charrnn.py", "--cpu",
                            "--epochs", "1", "--seq", "8", "--hidden", "16",

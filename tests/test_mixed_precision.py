@@ -1,0 +1,561 @@
+"""Mixed-precision compile policy: bf16 compute + fp32 masters.
+
+Pins the ISSUE-4 contract end to end on the CPU tier:
+- Policy resolution/naming and the scope/cast helpers;
+- `Model.compile(policy="bf16_mixed")` keeps fp32 masters, runs compute
+  in bf16 (visible in the compiled HLO), outputs f32 leaves, and pairs
+  the policy with a dynamic-loss-scaling GuardedOptimizer by default;
+- a bf16-mixed MLP converges to parity with fp32 within tolerance;
+- BatchNorm running stats stay fp32 under the policy;
+- save_states/load_states round-trips the masters bit-exactly across a
+  policy change (policy-compiled -> plain-f32 model and back).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from singa_tpu import tensor, device, opt, layer, model
+from singa_tpu import mixed_precision as mp
+
+
+# ---------------------------------------------------------------------------
+# policy object + helpers
+# ---------------------------------------------------------------------------
+
+def test_policy_named_presets():
+    p = mp.Policy("bf16_mixed")
+    assert p.param_dtype == jnp.dtype(jnp.float32)
+    assert p.compute_dtype == jnp.dtype(jnp.bfloat16)
+    assert p.output_dtype == jnp.dtype(jnp.float32)
+    assert p.is_mixed and p.wants_loss_scaling
+    assert p.comm_dtype == jnp.dtype(jnp.bfloat16)
+    assert p.default_loss_scale == 1.0          # bf16: f32 exponent range
+
+    f16 = mp.Policy("float16_mixed")
+    assert f16.default_loss_scale == 2.0 ** 15  # fp16 underflow shield
+
+    f32 = mp.Policy("float32")
+    assert not f32.is_mixed and not f32.wants_loss_scaling
+    assert f32.comm_dtype is None
+
+    pure = mp.Policy("bf16")                    # alias of bfloat16
+    assert pure.param_dtype == jnp.dtype(jnp.bfloat16)
+    assert not pure.is_mixed                    # compute == param
+    assert pure.wants_loss_scaling              # 16-bit compute
+
+    assert mp.resolve(None) is None
+    assert mp.resolve(p) is p
+    assert mp.resolve("bf16_mixed") == p
+
+    with pytest.raises(ValueError):
+        mp.Policy("float8")
+
+
+def test_policy_scope_and_cast_compute():
+    x32 = jnp.ones((4,), jnp.float32)
+    ids = jnp.arange(4, dtype=jnp.int32)
+    assert mp.active_policy() is None
+    assert mp.cast_compute(x32).dtype == jnp.float32    # no policy: identity
+    with mp.policy_scope("bf16_mixed"):
+        assert mp.active_policy().name == "bf16_mixed"
+        a, i, n = mp.cast_compute(x32, ids, None)
+        assert a.dtype == jnp.bfloat16
+        assert i.dtype == jnp.int32                     # ints never cast
+        assert n is None
+        # escape hatch: fp32-accumulate region suspends the cast
+        with mp.fp32_accumulate():
+            assert mp.active_policy() is None
+            assert mp.cast_compute(x32).dtype == jnp.float32
+        assert mp.cast_compute(x32).dtype == jnp.bfloat16
+        # params are created as masters, not in the activation's dtype
+        assert mp.param_dtype(jnp.bfloat16) == jnp.dtype(jnp.float32)
+        assert mp.param_dtype(jnp.int32) == jnp.int32
+    assert mp.active_policy() is None
+
+
+# ---------------------------------------------------------------------------
+# model fixtures
+# ---------------------------------------------------------------------------
+
+class MLP(model.Model):
+    def __init__(self, hidden=16, classes=4):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+class ConvBN(model.Model):
+    def __init__(self, classes=4):
+        super().__init__()
+        self.conv = layer.Conv2d(8, 3, padding=1)
+        self.bn = layer.BatchNorm2d()
+        self.relu = layer.ReLU()
+        self.flat = layer.Flatten()
+        self.fc = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc(self.flat(self.relu(self.bn(self.conv(x)))))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def _data(n=128, din=8, classes=4, seed=1):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype(np.float32)
+    w = rng.randn(din, classes).astype(np.float32)
+    y = np.argmax(x @ w + 0.05 * rng.randn(n, classes), axis=1)
+    return x, np.eye(classes, dtype=np.float32)[y]
+
+
+def _train_mlp(policy, steps=40, seed=42, lr=0.3, guard=False,
+               use_graph=True):
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(seed)
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m = MLP()
+    sgd = opt.SGD(lr=lr, momentum=0.9)
+    if guard:
+        from singa_tpu.resilience import GuardedOptimizer
+        sgd = GuardedOptimizer(sgd)
+    m.set_optimizer(sgd)
+    m.compile([tx], is_train=True, use_graph=use_graph, policy=policy)
+    losses = []
+    for _ in range(steps):
+        _, loss = m(tx, ty)
+        losses.append(float(loss.data))
+    return m, losses
+
+
+# ---------------------------------------------------------------------------
+# compiled-model contract
+# ---------------------------------------------------------------------------
+
+def test_bf16_mixed_masters_stay_f32_and_compute_is_bf16():
+    m, losses = _train_mlp("bf16_mixed", steps=3)
+    # masters: every trainable param and optimizer aux is f32
+    for name, t in m.get_states().items():
+        assert t.dtype == jnp.float32, (name, t.dtype)
+    base = m.optimizer.opt
+    for name, arr in base.get_states().items():
+        assert np.asarray(arr).dtype == np.float32, name
+    # compute: the ONE fused program contains bf16 ops
+    info = m.compiled_step_info()
+    assert "bf16" in info["hlo"]
+    assert info["policy"]["compute_dtype"] == "bfloat16"
+    # outputs: cast back to the policy's output dtype at the boundary
+    out, loss = m(*[tensor.Tensor(data=d, requires_grad=False,
+                                  device=m.dev) for d in _data()])
+    assert out.dtype == jnp.float32
+    assert loss.dtype == jnp.float32
+
+
+def test_policy_step_keeps_state_donation():
+    """The casts live INSIDE the one fused program: fp32 master state
+    still aliases input->output (a policy that broke donation would
+    double the weight HBM footprint — the exact thing it exists to
+    halve)."""
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    info = m.compiled_step_info()
+    if info["donated_bytes"] is None:
+        pytest.skip("backend memory_analysis lacks alias bytes")
+    assert info["donated_bytes"] >= 0.95 * info["state_bytes"], info
+
+
+def test_bf16_mixed_pairs_loss_scaling_by_default():
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    from singa_tpu.resilience import GuardedOptimizer
+    assert isinstance(m.optimizer, GuardedOptimizer)
+    assert m.optimizer.dynamic_loss_scale
+    # a pre-wrapped guard keeps its own configuration (no double wrap)
+    m2, _ = _train_mlp("bf16_mixed", steps=2, guard=True)
+    assert isinstance(m2.optimizer, GuardedOptimizer)
+    assert not isinstance(m2.optimizer.inner, GuardedOptimizer)
+    # float32 policy / no policy: no implicit wrap
+    m3, _ = _train_mlp("float32", steps=2)
+    assert not isinstance(m3.optimizer, GuardedOptimizer)
+
+
+def test_set_optimizer_after_compile_still_gets_loss_scaling():
+    """The promised-automatic companion must not depend on call order:
+    compile(policy=...) first, set_optimizer after — the wrap happens in
+    set_optimizer against the stored policy."""
+    from singa_tpu.resilience import GuardedOptimizer
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(3)
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    m = MLP()
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    m.set_optimizer(opt.SGD(lr=0.3, momentum=0.9))
+    assert isinstance(m.optimizer, GuardedOptimizer)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    losses = [float(m(tx, ty)[1].data) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_policy_applies_on_the_non_graph_path_too():
+    """use_graph=False must honor the same policy contract as the
+    compiled path: bf16 compute (visible as quantised params after a
+    step), f32 outputs, and graph/eager loss parity."""
+    m_e, le = _train_mlp("bf16_mixed", steps=10, use_graph=False)
+    m_g, lg = _train_mlp("bf16_mixed", steps=10, use_graph=True)
+    assert le[-1] < le[0] * 0.5
+    assert abs(le[-1] - lg[-1]) < 0.05, (le[-1], lg[-1])
+    out, loss = m_e(*[tensor.Tensor(data=d, requires_grad=False,
+                                    device=m_e.dev) for d in _data()])
+    assert out.dtype == jnp.float32 and loss.dtype == jnp.float32
+    for name, t in m_e.get_states().items():
+        assert t.dtype == jnp.float32, (name, t.dtype)
+    # the eager steps really computed through bf16: the fp32 masters
+    # moved by bf16-quantised gradients, so the two trajectories match
+    # closely but the eager one is NOT the pure-f32 trajectory
+    _, l32 = _train_mlp(None, steps=10, use_graph=False)
+    assert le != l32, "non-graph policy path silently ran pure fp32"
+
+
+def test_graph_debug_shows_policy_converts():
+    """graph_debug must describe the program that actually runs: under
+    a policy the dumped op table contains the compute-dtype converts."""
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    x, y = _data()
+    txt = m.graph_debug(
+        tensor.Tensor(data=x, device=m.dev, requires_grad=False),
+        tensor.Tensor(data=y, device=m.dev, requires_grad=False),
+        print_out=False)
+    assert "bfloat16" in txt and "convert_element_type" in txt, txt[:400]
+
+
+def test_recompile_with_new_policy_invalidates_cached_steps():
+    """Re-compiling under a different policy must not replay
+    executables traced under the old one: the cached step is dropped,
+    the next call re-traces with the new precision."""
+    m, _ = _train_mlp(None, steps=2)
+    assert "bf16" not in m.compiled_step_info()["hlo"]
+    dev = m.dev
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    assert not m._steps and not m._step_ready
+    _, loss = m(tx, ty)
+    _, loss = m(tx, ty)
+    assert loss.dtype == jnp.float32
+    assert "bf16" in m.compiled_step_info()["hlo"], \
+        "recompile kept the old-precision executable"
+    # recompiling with the SAME policy keeps the cache (no retrace tax)
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    assert m._steps and m._step_ready
+
+
+def test_recompile_across_param_dtype_migrates_masters():
+    """pure-bf16 -> bf16_mixed on a live model: materialised params AND
+    their optimizer aux upcast to the new fp32 masters, so the state
+    matches what the new policy reports and checkpoints."""
+    m, _ = _train_mlp("bfloat16", steps=3)
+    assert all(t.dtype == jnp.bfloat16
+               for t in m.get_states().values())
+    dev = m.dev
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    for name, t in m.get_states().items():
+        assert t.dtype == jnp.float32, (name, t.dtype)
+    for k, t in m.optimizer.state_tensor_dict().items():
+        if ":" in k:
+            assert t.dtype == jnp.float32, (k, t.dtype)
+    losses = [float(m(tx, ty)[1].data) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    assert "bf16" in m.compiled_step_info()["hlo"]
+
+
+def test_recompile_before_any_step_still_migrates_masters():
+    """compile materialises params in its dry run; a second compile
+    under a different policy BEFORE any training step must migrate them
+    too (the gate is the policy change, not prior steps)."""
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(4)
+    x, y = _data()
+    txb = tensor.Tensor(data=x, device=dev,
+                        requires_grad=False).as_type(jnp.bfloat16)
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.3, momentum=0.9))
+    m.compile([txb], is_train=True, use_graph=True, policy="bfloat16")
+    assert all(t.dtype == jnp.bfloat16 for t in m.get_states().values())
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    for name, t in m.get_states().items():
+        assert t.dtype == jnp.float32, (name, t.dtype)
+    _, loss = m(tx, tensor.Tensor(data=y, device=dev,
+                                  requires_grad=False))
+    assert np.isfinite(float(loss.data))
+
+
+def test_policy_change_rederives_companion_scale():
+    """bf16_mixed -> float16_mixed recompile must re-derive the
+    companion's init scale for the NEW policy (2^15 fp16 underflow
+    shield), not inherit the bf16 policy's neutral 1.0; a same-policy
+    recompile keeps the wrap AND its adapted scale state."""
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    assert float(np.asarray(m.optimizer.opt.loss_scale.data)) == 1.0
+    # adapt the scale mid-run, then recompile with the SAME policy:
+    # state survives
+    m.optimizer.opt.loss_scale.data = jnp.asarray(4.0, jnp.float32)
+    dev = m.dev
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    assert float(np.asarray(m.optimizer.opt.loss_scale.data)) == 4.0
+    # different 16-bit policy: fresh wrap at ITS default scale
+    m.compile([tx], is_train=True, use_graph=True,
+              policy="float16_mixed")
+    assert float(np.asarray(m.optimizer.opt.loss_scale.data)) == 2.0 ** 15
+
+
+def test_loss_scaling_opt_out_unwraps_companion_on_recompile():
+    """Policy equality includes the loss-scaling flag, and a recompile
+    with the documented opt-out removes the companion wrap the policy
+    itself added (a USER's GuardedOptimizer is never unwrapped)."""
+    from singa_tpu.resilience import GuardedOptimizer
+    assert mp.Policy("bf16_mixed") != mp.Policy("bf16_mixed",
+                                                loss_scaling=False)
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    assert isinstance(m.optimizer, GuardedOptimizer)
+    dev = m.dev
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True,
+              policy=mp.Policy("bf16_mixed", loss_scaling=False))
+    assert not isinstance(m.optimizer, GuardedOptimizer)
+    _, loss = m(tx, ty)
+    assert np.isfinite(float(loss.data))
+    # a user-wrapped guard survives the opt-out policy untouched
+    m2, _ = _train_mlp(mp.Policy("bf16_mixed", loss_scaling=False),
+                       steps=2, guard=True)
+    assert isinstance(m2.optimizer, GuardedOptimizer)
+
+
+def test_half_driver_policy_fp16_wire_turns_on_clipping():
+    """backward_and_update_half's policy-resolved fp16 wire must come
+    with the overflow clip (the driver runs unguarded); the bf16 wire
+    stays clip-free, and explicit dtype args keep caller behavior."""
+    from singa_tpu.opt import DistOpt
+    res = DistOpt._half_wire_defaults
+    with mp.policy_scope("float16_mixed"):
+        assert res(None, False) == ("float16", True)
+    with mp.policy_scope("bf16_mixed"):
+        assert res(None, False) == (jnp.dtype(jnp.bfloat16), False)
+    assert res(None, False) == ("bfloat16", False)       # no policy
+    # explicit caller choices always win, even under a policy
+    with mp.policy_scope("float16_mixed"):
+        assert res("bfloat16", False) == ("bfloat16", False)
+        assert res("float16", False) == ("float16", False)
+
+
+def test_bf16_mixed_mlp_converges_to_fp32_parity():
+    _, l32 = _train_mlp(None, steps=40)
+    _, lbf = _train_mlp("bf16_mixed", steps=40)
+    assert l32[-1] < l32[0] * 0.2
+    assert lbf[-1] < lbf[0] * 0.2
+    # parity within tolerance: bf16 compute quantises each step, so
+    # trajectories drift — but the optimisation quality must match
+    assert abs(lbf[-1] - l32[-1]) < 0.1, (l32[-1], lbf[-1])
+
+
+def test_bn_running_stats_stay_f32_under_policy():
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(7)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 6, 6).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m = ConvBN()
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    for _ in range(4):
+        _, loss = m(tx, ty)
+    assert np.isfinite(float(loss.data))
+    assert m.bn.running_mean.dtype == jnp.float32
+    assert m.bn.running_var.dtype == jnp.float32
+    # and they actually tracked batch statistics (not frozen at init)
+    assert not np.allclose(np.asarray(m.bn.running_var.data), 1.0)
+    # params (incl. BN scale/bias) are f32 masters
+    for name, t in m.get_states().items():
+        assert t.dtype == jnp.float32, (name, t.dtype)
+
+
+# ---------------------------------------------------------------------------
+# persistence: masters are what's saved
+# ---------------------------------------------------------------------------
+
+def test_save_states_roundtrips_masters_across_policy_change(tmp_path):
+    m, _ = _train_mlp("bf16_mixed", steps=5)
+    path = str(tmp_path / "policy.zip")
+    m.save_states(path)
+    before = {k: np.asarray(v.data) for k, v in m.get_states().items()}
+    assert all(a.dtype == np.float32 for a in before.values())
+
+    # restore into a PLAIN f32 model (policy change: bf16_mixed -> none)
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(99)
+    x, y = _data()
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    m2 = MLP()
+    m2.set_optimizer(opt.SGD(lr=0.3, momentum=0.9))
+    m2.compile([tx], is_train=True, use_graph=True)
+    m2(tx, tensor.Tensor(data=y, device=dev, requires_grad=False))
+    m2.load_states(path)
+    after = {k: np.asarray(v.data) for k, v in m2.get_states().items()}
+    for k, a in before.items():
+        assert a.dtype == after[k].dtype == np.float32
+        np.testing.assert_array_equal(a, after[k], err_msg=k)
+
+    # and back into a policy-compiled model: still bit-exact
+    m3 = MLP()
+    m3.set_optimizer(opt.SGD(lr=0.3, momentum=0.9))
+    m3.compile([tensor.Tensor(data=x, device=dev, requires_grad=False)],
+               is_train=True, use_graph=True, policy="bf16_mixed")
+    m3.load_states(path)
+    for k, t in m3.get_states().items():
+        np.testing.assert_array_equal(before[k], np.asarray(t.data),
+                                      err_msg=k)
+    # training continues after the restore (compiled steps were
+    # invalidated and rebuild against the restored tensors)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    tx3 = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    _, loss = m3(tx3, ty)
+    assert np.isfinite(float(loss.data))
+
+
+def test_snapshot_route_carries_f32_masters(tmp_path):
+    """The Snapshot (reference wire format) route also saves MASTERS:
+    a policy-compiled model's params write as plain f32 TensorProtos —
+    no bf16 special-casing needed — and read back bit-exactly."""
+    from singa_tpu import snapshot
+    m, _ = _train_mlp("bf16_mixed", steps=3)
+    states = {k: np.asarray(v.data) for k, v in m.get_states().items()}
+    prefix = str(tmp_path / "snap")
+    with snapshot.Snapshot(prefix, snapshot.Snapshot.kWrite) as s:
+        for k, v in states.items():
+            s.write(k, v)
+    with snapshot.Snapshot(prefix, snapshot.Snapshot.kRead) as s:
+        back = dict(s.read())
+    for k, v in states.items():
+        got = np.asarray(back[k] if not hasattr(back[k], "data")
+                         else back[k].data)
+        assert got.dtype == np.float32, k
+        np.testing.assert_array_equal(v, got.reshape(v.shape), err_msg=k)
+
+
+def test_save_states_records_policy_metadata(tmp_path):
+    import json
+    import zipfile
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    path = str(tmp_path / "meta.zip")
+    m.save_states(path)
+    with zipfile.ZipFile(path) as zf:
+        attr = json.loads(zf.read("states_attr.json"))
+    assert attr["meta/precision_policy"]["name"] == "bf16_mixed"
+    assert attr["meta/precision_policy"]["param_dtype"] == "float32"
+
+
+# ---------------------------------------------------------------------------
+# distributed: policy-driven comm + shard-consistent guard
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs a device mesh")
+def test_dist_policy_comm_is_bf16_on_the_wire():
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(5)
+    x, y = _data(n=64)
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m = MLP()
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9)))
+    m.compile([tx], is_train=True, use_graph=True, policy="bf16_mixed")
+    losses = [float(m(tx, ty)[1].data) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    # the gradient all-reduces carry bf16 in the lowered program (the
+    # CPU backend may upcast them post-optimisation; TPU keeps them)
+    rec = m._last_run_rec
+    state_avals, rng_aval, in_avals = rec["avals"]
+    txt = rec["jit"].lower(state_avals, rng_aval, *in_avals).as_text()
+    assert "all_reduce" in txt
+    assert "bf16" in txt
+    blocks = txt.split('"stablehlo.all_reduce"')[1:]
+    assert any("bf16" in b.split("---")[0][:400] for b in blocks), \
+        "no bf16 gradient all-reduce found in the lowered step"
+
+
+def test_policy_wire_resolution():
+    from singa_tpu.opt import DistOpt
+    assert DistOpt._policy_wire() is None
+    with mp.policy_scope("bf16_mixed"):
+        assert DistOpt._policy_wire() == jnp.dtype(jnp.bfloat16)
+    with mp.policy_scope("float32"):
+        assert DistOpt._policy_wire() is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint restore across precision modes
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_restore_adapts_dtype_to_live_masters():
+    """A checkpoint written under a different precision mode (pure-bf16
+    params) lands in a policy-compiled model's fp32 masters AS fp32 —
+    the live dtype (and so the compiled step's avals + donation)
+    survives the migration; same-dtype restores stay bit-identical."""
+    from singa_tpu.checkpoint import (_apply_restored, _aux_param_base,
+                                      _state_tensor_dict)
+    m, _ = _train_mlp("bf16_mixed", steps=2)
+    live = _state_tensor_dict(m)
+    name, lt = next(iter(live.items()))
+    f32_val = np.asarray(lt.data)
+    bf16_val = jnp.asarray(f32_val).astype(jnp.bfloat16)
+    _apply_restored(m, live, {name: bf16_val})
+    assert lt.dtype == jnp.float32, "live master dtype flipped on restore"
+    np.testing.assert_array_equal(
+        np.asarray(lt.data), np.asarray(bf16_val.astype(jnp.float32)))
+    # same-dtype restore: bit-identical passthrough
+    _apply_restored(m, live, {name: f32_val})
+    np.testing.assert_array_equal(np.asarray(lt.data), f32_val)
+
+    # LIVE optimizer aux (momentum) adapts through the same branch
+    aux_key = next(k for k in live if ":momentum" in k)
+    at = live[aux_key]
+    aux_bf16 = jnp.asarray(np.asarray(at.data)).astype(jnp.bfloat16)
+    _apply_restored(m, live, {aux_key: aux_bf16})
+    assert at.dtype == jnp.float32, "live momentum dtype flipped"
+
+    # FRESH (lazily-built) aux lands in the owning param's dtype, not
+    # the checkpoint's foreign one — the fresh-process resume path
+    base = m.optimizer.opt
+    pname = _aux_param_base(aux_key[len("optimizer/"):])
+    del base._aux[f"{pname}:momentum"]
+    live2 = {k: v for k, v in live.items() if k != aux_key}
+    _apply_restored(m, live2, {aux_key: aux_bf16})
+    fresh = base._aux[f"{pname}:momentum"]
+    assert fresh.dtype == jnp.float32, \
+        "fresh aux born in the checkpoint's foreign dtype"

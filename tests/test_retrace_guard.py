@@ -1,0 +1,112 @@
+"""Retrace guard: a fixed-shape training loop must compile exactly once.
+
+Accidental per-step retraces are the silent step-time killer — the loop
+still produces correct numbers, just 100x slower, so nothing functional
+ever fails. The Model step builder keeps a host-side trace counter
+(``rec["n_traces"]``: the traced python body runs once per jit trace),
+which this suite pins:
+
+- N same-shape steps -> ONE trace, one compiled-step record;
+- a new input shape retraces the SAME record (jit shape specialisation),
+  visible as exactly one more trace;
+- a new static-arg signature compiles its own record (the documented
+  static-arg cache), leaving the original at one trace.
+"""
+
+import numpy as np
+
+from singa_tpu import tensor, device, opt, layer, model
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=8, classes=3):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y, tag="a"):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def _setup(bs=16, din=6, classes=3, seed=0):
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(11)
+    rng = np.random.RandomState(seed)
+
+    def batch(n):
+        x = rng.randn(n, din).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, n)]
+        return (tensor.Tensor(data=x, device=dev, requires_grad=False),
+                tensor.Tensor(data=y, device=dev, requires_grad=False))
+
+    m = MLP(classes=classes)
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    tx, _ = batch(bs)
+    m.compile([tx], is_train=True, use_graph=True)
+    return m, batch
+
+
+def _only_rec(m):
+    recs = list(m._steps.values())
+    assert len(recs) == 1, f"expected one compiled-step record: {m._steps}"
+    return recs[0]
+
+
+def test_fixed_shape_loop_traces_exactly_once():
+    m, batch = _setup()
+    tx, ty = batch(16)
+    for _ in range(6):
+        m(tx, ty)                      # identical arrays every step
+    for _ in range(3):
+        m(*batch(16))                  # fresh same-shape arrays
+    rec = _only_rec(m)
+    assert rec["n_traces"] == 1, \
+        f"fixed-shape loop retraced {rec['n_traces']} times"
+
+
+def test_new_shape_retraces_once_then_caches():
+    m, batch = _setup()
+    for _ in range(3):
+        m(*batch(16))
+    rec = _only_rec(m)
+    assert rec["n_traces"] == 1
+    for _ in range(3):
+        m(*batch(8))                   # new batch size: ONE retrace
+    assert rec["n_traces"] == 2, rec["n_traces"]
+    for _ in range(2):
+        m(*batch(16))                  # original shape: still cached
+    assert rec["n_traces"] in (2, 3)   # jax may evict across shapes
+    assert len(m._steps) == 1
+
+
+def test_static_arg_gets_its_own_record_not_a_retrace():
+    m, batch = _setup()
+    tx, ty = batch(16)
+    for _ in range(2):
+        m(tx, ty, "a")
+    for _ in range(2):
+        m(tx, ty, "b")                 # distinct static arg
+    assert len(m._steps) == 2
+    for rec in m._steps.values():
+        assert rec["n_traces"] == 1, \
+            {k: r["n_traces"] for k, r in m._steps.items()}
+
+
+def test_compiled_step_info_reports_trace_count():
+    m, batch = _setup()
+    for _ in range(4):
+        m(*batch(16))
+    info = m.compiled_step_info()
+    # the audit itself may legitimately re-lower (counted honestly);
+    # the training loop must have contributed exactly one
+    assert info["n_traces"] >= 1
+    rec = _only_rec(m)
+    assert rec["n_traces"] <= 2        # loop trace + at most the audit

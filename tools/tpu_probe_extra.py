@@ -99,14 +99,15 @@ def _resnet50_bf16_large_batch(dev):
     """Feed the MXU bigger tiles than the reference harness's batch 32:
     the bf16 MFU headroom measurement."""
     layout, layout_src = bench._conv_layout()
+    leg_dtype, bf16_mode = bench._bf16_leg_dtype()
     thr, ms = bench._measure(dev, batch=128, niters=20, warmup=3,
                              image_size=224, depth=50,
-                             dtype_name="bfloat16", layout=layout)
+                             dtype_name=leg_dtype, layout=layout)
     peak = bench._peak_flops(getattr(dev.jax_device, "device_kind", ""))
     mfu = (thr * bench.RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
            if peak else None)
     return {"extra": "resnet50_bf16_b128", "images_per_sec": round(thr, 1),
-            "step_ms": round(ms, 2),
+            "step_ms": round(ms, 2), "bf16_mode": bf16_mode,
             "mfu": round(mfu, 4) if mfu else None,
             "conv_layout": layout, "conv_layout_src": layout_src,
             "timing": "slope-readback"}
@@ -121,13 +122,14 @@ def _resnet_layout_ab(dev):
     window automatically runs the faster layout. NHWC must beat NCHW by
     >2% to win — inside that margin the established default stands."""
     peak = bench._peak_flops(getattr(dev.jax_device, "device_kind", ""))
-    out = {"extra": "resnet_layout_ab", "batch": 32, "dtype": "bfloat16",
-           "timing": "slope-readback"}
+    leg_dtype, bf16_mode = bench._bf16_leg_dtype()
+    out = {"extra": "resnet_layout_ab", "batch": 32, "dtype": leg_dtype,
+           "bf16_mode": bf16_mode, "timing": "slope-readback"}
     ms = {}
     for lay in ("NCHW", "NHWC"):
         thr, step_ms = bench._measure(dev, batch=32, niters=20, warmup=3,
                                       image_size=224, depth=50,
-                                      dtype_name="bfloat16", layout=lay)
+                                      dtype_name=leg_dtype, layout=lay)
         ms[lay] = step_ms
         rec = {"layout": lay, "images_per_sec": round(thr, 1),
                "step_ms": round(step_ms, 2)}
@@ -187,14 +189,16 @@ def _resnet_stem_ab(dev):
     until a banked win justifies flipping the default."""
     peak = bench._peak_flops(getattr(dev.jax_device, "device_kind", ""))
     layout, layout_src = bench._conv_layout()
-    out = {"extra": "resnet_stem_ab", "batch": 32, "dtype": "bfloat16",
+    leg_dtype, bf16_mode = bench._bf16_leg_dtype()
+    out = {"extra": "resnet_stem_ab", "batch": 32, "dtype": leg_dtype,
+           "bf16_mode": bf16_mode,
            "conv_layout": layout, "conv_layout_src": layout_src,
            "timing": "slope-readback"}
     ms = {}
     for stem in ("conv7", "space_to_depth"):
         thr, step_ms = bench._measure(dev, batch=32, niters=20, warmup=3,
                                       image_size=224, depth=50,
-                                      dtype_name="bfloat16",
+                                      dtype_name=leg_dtype,
                                       layout=layout, stem=stem)
         ms[stem] = step_ms
         rec = {"stem": stem, "images_per_sec": round(thr, 1),
@@ -275,10 +279,12 @@ def _hbm_child(which):
         return
     if which == "resnet":
         layout, _ = bench._conv_layout()
-        step = bench._setup_resnet_step(dev, 32, 224, 50, "bfloat16",
+        leg_dtype, bf16_mode = bench._bf16_leg_dtype()
+        step = bench._setup_resnet_step(dev, 32, 224, 50, leg_dtype,
                                         layout=layout)
         shape = {"model": "resnet50", "batch": 32, "image_size": 224,
-                 "dtype": "bfloat16", "conv_layout": layout}
+                 "dtype": leg_dtype, "bf16_mode": bf16_mode,
+                 "conv_layout": layout}
     else:
         step = bench._setup_lm_step(dev, batch=8,
                                     compute_dtype="bfloat16")
@@ -447,9 +453,12 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
         # skip the abstract first call and run the whole model eagerly,
         # one tunnel round trip per op. The fusion trace is captured on
         # the first COMPILED step that runs at verbosity 2.
+        # the SAME program the bench bf16 timing leg compiles (policy
+        # by default): the profile must decompose what was timed
         layout, _ = bench._conv_layout()
+        leg_dtype, bf16_mode = bench._bf16_leg_dtype()
         step = bench._setup_resnet_step(dev, batch, image_size, depth,
-                                        "bfloat16", layout=layout)
+                                        leg_dtype, layout=layout)
         loss = None
         for _ in range(3):
             loss = step()
@@ -467,7 +476,7 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
                     "error": "no fusion rows captured from the trace"}
         total = sum(r[2] for r in rows)
         return {"extra": "resnet50_bf16_fusion_profile",
-                "conv_layout": layout,
+                "conv_layout": layout, "bf16_mode": bf16_mode,
                 "batch": batch, "image_size": image_size, "depth": depth,
                 "total_measured_s": round(total, 4),
                 "top": [{"op": op[:80], "count": cnt,

@@ -138,12 +138,28 @@ def main():
                 log(f"cycle#{n}: bench.py holds the tpu lock; skipping")
                 time.sleep(IDLE_SLEEP)
                 continue
-            status, err = bench._probe_tpu(120)
+            # dead-tunnel fast-fail (BENCH_r05: 73 consecutive probe
+            # timeouts burned the round at full probe cost): once the
+            # streak trips the cooldown, probe SHORT and SLOW — still
+            # probing, so a tunnel that revives breaks the streak and
+            # restores full cadence, but a dead one costs 30s per
+            # half-hour instead of 120s per 8 minutes. Every 4th
+            # cooldown cycle keeps the FULL budget: a revived backend
+            # whose cold start exceeds 30s must still be recoverable
+            # without human intervention (BENCH_FORCE_PROBE).
+            cooldown = bench._probe_cooldown()
+            full_probe = not cooldown or n % 4 == 0
+            if cooldown:
+                log(f"cycle#{n}: probe cooldown active "
+                    f"({cooldown} consecutive timeouts) — "
+                    f"{'full' if full_probe else 'short'} probe, "
+                    "slow cadence")
+            status, err = bench._probe_tpu(120 if full_probe else 30)
             bench._record_obs("probe", {"status": status, "err": err,
                                         "src": "watch"})
             log(f"probe#{n}: {status}{' (' + err + ')' if err else ''}")
         if status != "ok":
-            time.sleep(IDLE_SLEEP)
+            time.sleep(IDLE_SLEEP * (4 if cooldown else 1))
             continue
         # probes are cheap (one 120s child) — keep the fast cadence
         # even after a complete bench is banked, or short windows go
