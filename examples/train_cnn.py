@@ -239,27 +239,49 @@ def main():
     n_val = len(val_x) // args.bs or 1
 
     if args.resilient:
+        from singa_tpu.data import NumpyBatchIter
         from singa_tpu.resilience import ResilientTrainer
 
-        def batches():
-            brng = np.random.RandomState(1)
-            while True:
-                order = brng.permutation(len(train_x))
-                for b in range(n_train):
-                    sel = order[b * args.bs:(b + 1) * args.bs]
-                    bx = train_x[sel]
+        class StagedBatches:
+            """Checkpointable CNN input pipeline: sample selection via
+            the stateless-shuffle NumpyBatchIter (its ``{epoch,
+            position}`` state rides every --resilient checkpoint, so a
+            preempted/rolled-back run resumes the EXACT sample stream),
+            augmentation seeded by that state (the resumed stream
+            reproduces the exact augmented batches too), device staging
+            last."""
+
+            def __init__(self, inner):
+                self.inner = inner
+
+            def state_dict(self):
+                return self.inner.state_dict()
+
+            def load_state_dict(self, state):
+                self.inner.load_state_dict(state)
+
+            def __iter__(self):
+                for bx, by in self.inner:
                     if augment:
-                        bx = datasets.augment_crop_flip(bx, rng=brng)
+                        st = self.inner.state_dict()
+                        arng = np.random.RandomState(
+                            (st["epoch"] * 1_000_003 + st["position"])
+                            % (2 ** 31))
+                        bx = datasets.augment_crop_flip(bx, rng=arng)
                     yield (stage(bx),
-                           tensor.Tensor(data=eye[train_y[sel]],
-                                         device=dev,
+                           tensor.Tensor(data=eye[by], device=dev,
                                          requires_grad=False))
 
+        # --max-batches caps the EPOCH by slicing the sample set, so
+        # the deterministic permutation stays over a fixed population
+        pipeline = StagedBatches(NumpyBatchIter(
+            train_x[:n_train * args.bs], train_y[:n_train * args.bs],
+            args.bs, seed=1))
         model.train()
         trainer = ResilientTrainer(model, args.ckpt_dir,
                                    save_interval_steps=args.save_every,
                                    verbose=(rank == 0))
-        summary = trainer.run(batches(),
+        summary = trainer.run(pipeline,
                               num_steps=args.epochs * n_train)
         if rank == 0:
             print(f"resilient run summary: {summary}", flush=True)

@@ -156,15 +156,29 @@ def run_rank(args):
         print(f"rank {args.rank}: dumped restored state of step "
               f"{start - 1} to {args.dump_restored}", flush=True)
 
+    if args.dump_sample_ids:
+        os.makedirs(args.dump_sample_ids, exist_ok=True)
+
     def on_step(step, out):
         if args.dump_on_save and trainer.mgr.latest_step() == step:
             dump_state(m, os.path.join(args.dump_on_save,
                                        f"state_step{step}.npz"))
+        if args.dump_sample_ids and batches.last_batch_ids is not None:
+            # one file per step, overwritten on a re-run: the dir holds
+            # the FINAL timeline's per-step sample ids — what the
+            # data-resume chaos scenario asserts bit-identical to a
+            # fault-free run's
+            np.save(os.path.join(args.dump_sample_ids,
+                                 f"ids_step{step}.npy"),
+                    batches.last_batch_ids)
         if step == args.crash_at:
             trainer.mgr.wait()
             print(f"simulated crash at step {step}", flush=True)
             sys.exit(42)
 
+    # checkpointable stream: state ({epoch, position}) rides every
+    # checkpoint, so kills/rollbacks/elastic restarts rewind it in
+    # lockstep with the tensors (exactly-once sample consumption)
     batches = NumpyBatchIter(x, y, batch_size=global_bs, seed=0)
     try:
         summary = trainer.run(batches, num_steps=args.steps,
@@ -220,6 +234,9 @@ def main():
                     help="dir for per-committed-step state npz dumps")
     ap.add_argument("--dump-restored", default="",
                     help="npz path for the state right after restore")
+    ap.add_argument("--dump-sample-ids", default="",
+                    help="dir for per-step consumed-sample-id npy dumps "
+                         "(the exactly-once probe)")
     args = ap.parse_args()
 
     if args.cpu:

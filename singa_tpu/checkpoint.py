@@ -34,9 +34,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .integrity import (IntegrityError, digest_tree, manifest_digest,
-                        read_digest_sidecar, verify_tree,
-                        write_digest_sidecar)
+from .integrity import (IntegrityError, data_state_digest, digest_tree,
+                        manifest_digest, read_digest_sidecar,
+                        verify_tree, write_digest_sidecar)
 
 
 def _state_tensor_dict(model):
@@ -209,9 +209,19 @@ class CheckpointManager:
         self._save_interval_steps = save_interval_steps
         self._digests_on = bool(digests)
         self._digest_dir = os.path.join(self._dir, "digests")
+        self._data_dir = os.path.join(self._dir, "data_state")
         # digest tree of the newest save (the distributed manager acks
         # its manifest digest to the cluster); None when digests are off
         self.last_saved_digests = None
+        # digest of the newest save's data-iterator state (rides the
+        # two-phase ACK beside the tensor digest); None when the save
+        # carried no data state
+        self.last_saved_data_digest = None
+        # data-iterator state of the newest successful restore_latest
+        # (the trainer rewinds its iterator to it); None when the step
+        # predates data-state capture
+        self.restored_data_state = None
+        self._restored_data_state = None
         self._mgr = self._make_mgr()
         if sweep:
             self._sweep_uncommitted()
@@ -282,21 +292,70 @@ class CheckpointManager:
         return read_digest_sidecar(self._digest_path(step))
 
     def _prune_digests(self, keep=None):
-        """Sidecars follow the step rotation: one whose step orbax (or a
-        wreckage sweep) already deleted is dead weight."""
+        """Sidecars (tensor digests AND data states) follow the step
+        rotation: one whose step orbax (or a wreckage sweep) already
+        deleted is dead weight."""
         keep = {int(s) for s in (self._mgr.all_steps()
                                  if keep is None else keep)}
+        for d in (self._digest_dir, self._data_dir):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for n in names:
+                if n.endswith(".json") and n[:-5].isdigit() \
+                        and int(n[:-5]) not in keep:
+                    try:
+                        os.remove(os.path.join(d, n))
+                    except OSError:
+                        pass
+
+    # -- data-iterator state -----------------------------------------------
+    def _data_state_path(self, step):
+        return os.path.join(self._data_dir, f"{int(step)}.json")
+
+    def _write_data_state(self, step, state):
+        """Persist the data pipeline's ``state_dict()`` beside the step
+        — synchronous (the state is a few counters) and atomic, with
+        its own content digest: the sample-stream offset a resume
+        rewinds to is vouched for exactly like the tensors."""
+        os.makedirs(self._data_dir, exist_ok=True)
+        digest = data_state_digest(state)
+        doc = {"step": int(step), "state": state, "digest": digest}
+        path = self._data_state_path(step)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return digest
+
+    def read_data_state(self, step):
+        """The step's verified data-iterator state, or None when the
+        step carries none (a pre-data-state save, or a run without a
+        checkpointable iterator). Raises
+        :class:`~singa_tpu.integrity.IntegrityError` when the sidecar
+        exists but its content does not match its digest — a corrupt
+        data offset must drive the same fallback chain as corrupt
+        tensor bytes, never silently restart the stream."""
         try:
-            names = os.listdir(self._digest_dir)
+            with open(self._data_state_path(step)) as f:
+                doc = json.load(f)
         except OSError:
-            return
-        for n in names:
-            if n.endswith(".json") and n[:-5].isdigit() \
-                    and int(n[:-5]) not in keep:
-                try:
-                    os.remove(os.path.join(self._digest_dir, n))
-                except OSError:
-                    pass
+            return None
+        except ValueError as e:
+            raise IntegrityError(
+                f"checkpoint step {step}: data-state sidecar is "
+                f"unparseable ({e})")
+        state = doc.get("state")
+        want = doc.get("digest")
+        if state is None or want is None or \
+                data_state_digest(state) != want:
+            raise IntegrityError(
+                f"checkpoint step {step}: data-state sidecar failed "
+                f"its digest check — the resume offset is corrupt")
+        return state
 
     def _verify_restored(self, step, restored, expect_manifest=None):
         """Verify restored arrays against the step's digest sidecar
@@ -354,7 +413,7 @@ class CheckpointManager:
                 f"{expect_manifest}")
         return expected
 
-    def save(self, step, model, force=False):
+    def save(self, step, model, force=False, data_state=None):
         # one outstanding digest worker, like orbax's one outstanding
         # write — and joined BEFORE the next orbax save so the worker's
         # all_steps()-based sidecar pruning never overlaps a write
@@ -363,6 +422,13 @@ class CheckpointManager:
         saved = self._mgr.save(int(step),
                                args=self._ocp.args.StandardSave(arrays),
                                force=force)
+        if saved:
+            # the data-iterator state rides every save (tiny JSON,
+            # synchronous + atomic): on ANY restore of this step the
+            # sample stream rewinds in lockstep with the tensors
+            self.last_saved_data_digest = \
+                self._write_data_state(step, data_state) \
+                if data_state is not None else None
         if saved and self._digests_on:
             # digest the SAME immutable arrays handed to orbax (jax
             # arrays cannot change under the async write), so the
@@ -428,6 +494,11 @@ class CheckpointManager:
             step, args=self._ocp.args.StandardRestore(
                 _build_restore_template(live, tree)))
         sidecar = self._verify_restored(step, restored, expect_manifest)
+        # the data state is read AND verified before any restored array
+        # lands in a live tensor: a corrupt resume offset falls back
+        # exactly like corrupt tensor bytes, keeping data and model
+        # state consistent at whatever step the chain settles on
+        self._restored_data_state = self.read_data_state(step)
         _apply_restored(model, live, restored)
         return sidecar
 
@@ -446,10 +517,12 @@ class CheckpointManager:
         every entry, so the model never trains on a half-restored mix.)
         """
         self._join_digest_thread()
+        self.restored_data_state = None
         steps = sorted(self._mgr.all_steps(), reverse=True)
         for i, step in enumerate(steps):
             try:
                 self._restore_step(step, model)
+                self.restored_data_state = self._restored_data_state
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
@@ -522,6 +595,17 @@ class CheckpointManager:
                 # demote it out from under the writer; our own wait()
                 # above only covers the in-process pipeline.
                 report[step] = "in-flight"
+                continue
+            try:
+                # the data-state sidecar is part of the step: a corrupt
+                # resume offset makes the checkpoint as unrestorable as
+                # corrupt tensor bytes (restore would fall back past it)
+                self.read_data_state(step)
+            except IntegrityError as e:
+                warnings.warn(
+                    f"scrub: checkpoint step {step} data-state sidecar "
+                    f"FAILED verification ({e})", stacklevel=2)
+                report[step] = "corrupt"
                 continue
             expected = self.read_digests(step) if self._digests_on \
                 else None
@@ -744,6 +828,15 @@ class DistributedCheckpointManager(CheckpointManager):
             # ones), so any rank's restore can cross-check its shard —
             # even a peer's — against the cluster-agreed content
             manifest["digest"] = digest
+        data = {str(r): d for r, d in
+                self.cluster.ack_data_digests(int(step)).items()
+                if d is not None}
+        if data:
+            # each rank's data-iterator state digest rode its ACK: the
+            # marker vouches for the sample-stream offset exactly like
+            # it vouches for the tensors, and any restore cross-checks
+            # whichever rank's data sidecar it lands on
+            manifest["data_digests"] = data
         manifest.update(self.manifest_extra)
         tmp = os.path.join(self._commit_dir, f".tmp-{int(step)}.json")
         with open(tmp, "w") as f:
@@ -797,7 +890,8 @@ class DistributedCheckpointManager(CheckpointManager):
         return removed
 
     # -- two-phase save ----------------------------------------------------
-    def save(self, step, model, force=False, commit_timeout=None):
+    def save(self, step, model, force=False, commit_timeout=None,
+             data_state=None):
         """Write this rank's shard, ACK, and wait for the cluster commit.
         Returns True only when the step COMMITTED (marker published).
         The underlying write is awaited before the ACK — an ACK is a
@@ -806,7 +900,8 @@ class DistributedCheckpointManager(CheckpointManager):
         path uses a short one: a forced off-schedule save can only
         quorum when every rank was preempted at the same boundary, and
         a doomed wait must not eat the kill grace)."""
-        saved = super().save(step, model, force=force)
+        saved = super().save(step, model, force=force,
+                             data_state=data_state)
         if not saved:
             return False
         self.wait()     # bytes down AND digests computed BEFORE the ack
@@ -817,10 +912,14 @@ class DistributedCheckpointManager(CheckpointManager):
             # bound the bookkeeping to the rotation window
             for old in sorted(self._pending_digest)[:-self._max_to_keep]:
                 self._pending_digest.pop(old, None)
-        # the ACK carries this rank's manifest digest: the coordinator
+        # the ACK carries this rank's manifest digest — the coordinator
         # commits only when EVERY rank acked the same content, so a
-        # silently-diverged replica can never be vouched for by a marker
-        self.cluster.ack_save(step, digest=digest)  # fault: kill_before_ack
+        # silently-diverged replica can never be vouched for by a
+        # marker — and its data-state digest, recorded in the marker so
+        # the committed checkpoint vouches for the sample-stream offset
+        self.cluster.ack_save(  # fault: kill_before_ack
+            step, digest=digest,
+            data_digest=self.last_saved_data_digest)
         timeout = self._commit_timeout if commit_timeout is None \
             else float(commit_timeout)
         ok = self.cluster.wait_commit(step, timeout=timeout)
@@ -857,9 +956,36 @@ class DistributedCheckpointManager(CheckpointManager):
             save_interval_steps=self._save_interval_steps, sweep=False,
             digests=self._digests_on)
         try:
-            return src._restore_step(step, model, expect_manifest)
+            out = src._restore_step(step, model, expect_manifest)
+            # the data state is GLOBAL-stream state (rank-agnostic by
+            # construction — see data.NumpyBatchIter), so the peer's
+            # offset resumes this rank's derived shard exactly
+            self._restored_data_state = src._restored_data_state
+            return out
         finally:
             src.close()
+
+    def _check_restored_data(self, step, src_rank, manifest):
+        """Cross-check the just-restored data state against the digest
+        rank ``src_rank`` ACKed into the commit marker. Raises
+        :class:`~singa_tpu.integrity.IntegrityError` (driving the
+        caller's next-source fallback) when the marker vouches for a
+        data state this shard cannot produce."""
+        want = (manifest.get("data_digests") or {}).get(str(src_rank))
+        if not want:
+            return        # pre-data-state marker, or a stateless run
+        state = self._restored_data_state
+        if state is None:
+            raise IntegrityError(
+                f"checkpoint step {step}: rank {src_rank} ACKed a "
+                f"data state into the commit marker but its sidecar "
+                "is missing — the resume offset cannot be trusted")
+        got = data_state_digest(state)
+        if got != want:
+            raise IntegrityError(
+                f"checkpoint step {step}: data-state digest {got} "
+                f"does not match the cluster-committed {want} for "
+                f"rank {src_rank} — stale or corrupt resume offset")
 
     def restore_latest(self, model):
         """Restore the newest CLUSTER-COMMITTED checkpoint and return
@@ -872,6 +998,7 @@ class DistributedCheckpointManager(CheckpointManager):
         import shutil
         self._join_digest_thread()
         self.restored_manifest = None
+        self.restored_data_state = None
         committed = self.committed_steps()
         committed_set = set(committed)
         local = set(self._mgr.all_steps())
@@ -904,6 +1031,7 @@ class DistributedCheckpointManager(CheckpointManager):
                         self._restore_step(step, model, want)
                     else:
                         self._restore_foreign(src, step, model, want)
+                    self._check_restored_data(step, src, manifest)
                     restored = True
                     break
                 except (KeyboardInterrupt, SystemExit):
@@ -931,6 +1059,7 @@ class DistributedCheckpointManager(CheckpointManager):
                 if newer:
                     self._reopen()
             self.restored_manifest = manifest
+            self.restored_data_state = self._restored_data_state
             if int(manifest.get("world", self.cluster.world)) != \
                     self.cluster.world:
                 warnings.warn(

@@ -1,21 +1,99 @@
-"""Data loading pipeline with background prefetch.
+"""Data loading pipeline: checkpointable, deterministic, fault-isolating.
 
 Capability parity with the reference pipeline (python/singa/data.py:60-124):
 :class:`ImageBatchIter` streams (image, label) batches from an image-list
 file through a worker process and a bounded queue, overlapping JPEG decode +
 augmentation with device compute. On TPU this hides host-side input cost
 behind the XLA step, the same role the reference's prefetch plays for CUDA.
+
+On top of that parity, every iterator here implements the **state
+protocol** the resilience stack (``singa_tpu/resilience``) rides on::
+
+    state = it.state_dict()        # tiny JSON-able dict
+    it2.load_state_dict(state)     # resume the EXACT sample stream
+
+The protocol's contract is *exactly-once*: shuffles are **stateless**
+(an epoch's sample order is a pure function of ``(seed, epoch)`` via
+:func:`epoch_permutation` — never stored), so state is just counters
+``{epoch, position, ...}`` and a restored iterator reproduces the exact
+order from any offset. A preempted-and-resumed run therefore consumes a
+sample sequence bit-identical to a fault-free one — the reproducibility
+bar pod-scale TPU fine-tuning holds itself to. ``state_dict()`` always
+reflects batches the CONSUMER has taken (never batches merely sitting in
+a prefetch queue), so a prefetched-but-unstepped batch is replayed after
+a restore, not dropped.
 """
 
 from __future__ import annotations
 
+import json
 import os
-import random
+import tempfile
+import time
+import warnings
 from multiprocessing import Process, Queue
 from queue import Empty, Full, Queue as _TQueue
 from threading import Thread
 
 import numpy as np
+
+
+def epoch_permutation(seed, epoch, n):
+    """The stateless shuffle every checkpointable iterator shares: the
+    sample order of epoch ``epoch`` is a pure function of
+    ``(seed, epoch)`` — derived on demand, never stored — so iterator
+    state stays ``{epoch, position}`` and any rank (or a restarted
+    process, or a re-sharded elastic world) reproduces the exact same
+    global order."""
+    ss = np.random.SeedSequence([int(seed) & 0xFFFFFFFF, int(epoch)])
+    return np.random.Generator(np.random.PCG64(ss)).permutation(int(n))
+
+
+def can_load_state(obj):
+    """True when ``obj`` can actually LOAD a saved data state. Plain
+    ``callable(obj.load_state_dict)`` lies for delegating wrappers — a
+    :class:`DevicePrefetcher` around a plain generator has the method
+    but nothing to apply it to — so wrappers expose their own
+    ``can_load_state()`` answering for the inner source, and the
+    resilience runtime probes through this helper before committing to
+    a rewind (falling back to its loud not-checkpointable warning
+    instead of crashing mid-restore)."""
+    probe = getattr(obj, "can_load_state", None)
+    if callable(probe):
+        return bool(probe())
+    return callable(getattr(obj, "load_state_dict", None))
+
+
+def raise_retried_failure(failed):
+    """The ONE closed-generator-after-retry rule
+    (:class:`RetryingIterator` and ``resilience.runtime._next_batch``
+    both fetch through it): a ``StopIteration`` that immediately
+    follows a retried error on a non-rebuildable source is the corpse
+    of the closed generator, not exhaustion — re-raise the original
+    failure instead of silently truncating the stream. A no-op when no
+    retried failure is pending."""
+    if failed is not None:
+        raise failed from None
+
+
+class DataWorkerKilled(BaseException):
+    """Fault injection only (``FaultPlan.kill_data_worker``): kills the
+    prefetch worker abruptly — no error record, no goodbye — so the
+    consumer's died-worker attribution path is what gets exercised.
+    BaseException so the worker's skip/error handlers cannot absorb it."""
+
+
+class DataSampleError(RuntimeError):
+    """A data pipeline failure attributed to a NAMED sample: carries
+    ``sample`` (the ``{epoch, index, path, error}`` record of the
+    offending sample, when known) and ``quarantined`` (every skipped
+    sample so far) so a dead worker or an exhausted skip budget
+    surfaces *which* bytes are bad, not just that something died."""
+
+    def __init__(self, message, sample=None, quarantined=None):
+        super().__init__(message)
+        self.sample = sample
+        self.quarantined = list(quarantined or [])
 
 
 class ImageBatchIter:
@@ -24,46 +102,183 @@ class ImageBatchIter:
     ``img_list_file``: each line is ``<relative path><delimiter><label>``.
     ``image_transform``: path -> list of augmented numpy images (multiple
     augmentations multiply the effective batch, like the reference).
+
+    Deterministic + checkpointable: shuffling uses the stateless
+    :func:`epoch_permutation` keyed by ``(seed, epoch)``, and
+    ``state_dict()/load_state_dict()`` resume the exact stream from the
+    last CONSUMED batch (batches still sitting in the prefetch queue at
+    a crash are re-decoded by the restarted worker — replayed, never
+    dropped).
+
+    Fault isolation: a sample whose decode/transform raises is skipped,
+    counted, and recorded in ``self.quarantined`` with full attribution
+    (epoch, list index, path, error) instead of killing the worker —
+    bounded by ``skip_budget`` total skips, beyond which the iterator
+    raises :class:`DataSampleError` loudly (the default budget of 0
+    keeps fail-fast semantics, now with the sample named). A worker
+    that dies outright surfaces the sample it was decoding.
     """
 
     def __init__(self, img_list_file, batch_size, image_transform,
                  shuffle=True, delimiter=" ", image_folder=None,
-                 capacity=10, use_process=False):
+                 capacity=10, use_process=False, seed=0,
+                 skip_budget=0, faults=None):
         """``use_process=False`` (default) prefetches on a daemon thread —
         fork()ing a multi-threaded XLA process is deadlock-prone, and PIL /
         numpy release the GIL for the heavy work. ``use_process=True``
         matches the reference's separate-process behaviour."""
         self.img_list_file = img_list_file
         self.use_process = use_process
+        self.capacity = capacity
         self.queue = Queue(capacity) if use_process else _TQueue(capacity)
         self.batch_size = batch_size
         self.image_transform = image_transform
         self.shuffle = shuffle
         self.delimiter = delimiter
         self.image_folder = image_folder or ""
+        self.seed = int(seed)
+        self.skip_budget = int(skip_budget)
+        self.faults = faults
         self.stop = False
         self.p = None
+        # CONSUMED state (advances only when __next__ hands a batch out)
+        self._epoch = 0
+        self._position = 0
+        self.skip_count = 0
+        self.quarantined = []
+        self.last_batch_ids = None
+        # worker-side attribution: the sample being decoded right now.
+        # Thread mode shares memory; process mode writes it through a
+        # black-box-recorder file (_attr_path) the parent reads on
+        # death — a segfaulting decoder can't say goodbye, but the
+        # record it wrote just before survives it.
+        self._current_sample = None
+        self._attr_path = None
+        self._gen_id = 0
         with open(img_list_file, "r") as fd:
             self.num_samples = sum(1 for line in fd if line.strip())
 
+    # -- state protocol ----------------------------------------------------
+    def state_dict(self):
+        """JSON-able consumed-stream state. ``seed`` and ``num_samples``
+        ride along for verification only — the shuffle itself is
+        stateless (:func:`epoch_permutation`)."""
+        return {"kind": "ImageBatchIter", "epoch": int(self._epoch),
+                "position": int(self._position), "seed": self.seed,
+                "num_samples": int(self.num_samples),
+                "skip_count": int(self.skip_count),
+                "quarantined": [dict(q) for q in self.quarantined]}
+
+    def load_state_dict(self, state):
+        """Rewind/fast-forward to ``state`` (a running worker is ended
+        and restarts from the loaded offset on the next fetch)."""
+        if self.p is not None:
+            self.end()
+        _check_state_source(self, state)
+        self._epoch = int(state.get("epoch", 0))
+        self._position = int(state.get("position", 0))
+        self.skip_count = int(state.get("skip_count", 0))
+        self.quarantined = [dict(q)
+                           for q in state.get("quarantined", [])]
+        self.last_batch_ids = None
+
+    # -- lifecycle ---------------------------------------------------------
     def start(self):
+        self.stop = False
+        # fresh queue + generation per worker: a batch a dying worker
+        # managed to put during the end() drain race can never leak
+        # into a restarted iterator (and a stale-generation record that
+        # somehow survives is discarded by __next__)
+        self._gen_id += 1
+        self.queue = Queue(self.capacity) if self.use_process \
+            else _TQueue(self.capacity)
+        start_state = (self._epoch, self._position, self.skip_count)
         if self.use_process:
-            self.p = Process(target=self.run)
+            self._remove_attr_file()
+            self._attr_path = os.path.join(
+                tempfile.gettempdir(),
+                f"singa-data-attr-{os.getpid()}-{id(self)}-"
+                f"{self._gen_id}.json")
+            self.p = Process(target=self.run,
+                             args=(self._gen_id, start_state,
+                                   self._attr_path))
         else:
-            self.p = Thread(target=self.run)
+            self.p = Thread(target=self.run,
+                            args=(self._gen_id, start_state))
         self.p.daemon = True
         self.p.start()
+
+    def _remove_attr_file(self):
+        if self._attr_path is not None:
+            try:
+                os.remove(self._attr_path)
+            except OSError:
+                pass
+            self._attr_path = None
+
+    def _worker_death_error(self):
+        sample = self._current_sample
+        if sample is None and self._attr_path is not None:
+            # process mode: the child's memory is gone, but its
+            # black-box record of the sample it was decoding survives
+            try:
+                with open(self._attr_path) as f:
+                    sample = json.load(f)
+            except (OSError, ValueError):
+                pass
+        if sample is not None:
+            return DataSampleError(
+                f"ImageBatchIter worker died while decoding sample "
+                f"{sample.get('path')!r} (epoch {sample.get('epoch')}, "
+                f"list index {sample.get('index')})", sample=sample,
+                quarantined=self.quarantined)
+        return DataSampleError(
+            "ImageBatchIter worker died (bad image path or malformed "
+            "list line?)", quarantined=self.quarantined)
 
     def __next__(self):
         assert self.p is not None, "call start() before next()"
         while True:
             try:
-                return self.queue.get(timeout=1.0)
+                item = self.queue.get(timeout=1.0)
             except Empty:
                 if not self.p.is_alive():
-                    raise RuntimeError(
-                        "ImageBatchIter worker died (bad image path or "
-                        "malformed list line?)") from None
+                    raise self._worker_death_error() from None
+                continue
+            if not isinstance(item, dict) or \
+                    item.get("gen") != self._gen_id:
+                continue                    # stale worker generation
+            kind = item.get("kind")
+            if kind == "error":
+                # the worker attributed its own death (skip budget
+                # exhausted, unreadable list, ...): adopt its
+                # bookkeeping and raise with the sample named
+                self.skip_count = int(item.get("skip_count",
+                                               self.skip_count))
+                for q in item.get("quarantined", []):
+                    self.quarantined.append(dict(q))
+                raise DataSampleError(item.get("message", "data worker "
+                                                          "failure"),
+                                      sample=item.get("sample"),
+                                      quarantined=self.quarantined)
+            if kind != "batch":
+                continue                    # clean-stop sentinel
+            # consumed-at-hand-out accounting: state reflects THIS
+            # batch only once the caller actually has it
+            self._epoch = int(item["epoch"])
+            self._position = int(item["position"])
+            self.skip_count = int(item["skip_count"])
+            if item["skipped"]:
+                self.quarantined.extend(item["skipped"])
+                first = item["skipped"][0]
+                warnings.warn(
+                    f"ImageBatchIter: skipped {len(item['skipped'])} "
+                    f"corrupt sample(s) (first: {first.get('path')!r}, "
+                    f"{first.get('error')}); {self.skip_count}/"
+                    f"{self.skip_budget} of the skip budget used",
+                    stacklevel=2)
+            self.last_batch_ids = np.asarray(item["ids"], np.int64)
+            return item["batch"]
 
     next = __next__
 
@@ -73,46 +288,140 @@ class ImageBatchIter:
         return self
 
     def end(self):
-        if self.p is not None:
-            if self.use_process:
-                self.p.terminate()
-            else:
-                self.stop = True
-                # unblock a queue.put-blocked worker
+        if self.p is None:
+            return
+        self.stop = True
+        if self.use_process:
+            self.p.terminate()
+            self.p.join(timeout=5.0)    # reap: no zombie child left
+        else:
+            # drain WHILE joining: a worker blocked mid-put frees up,
+            # sees the stop flag, enqueues its end sentinel and exits —
+            # the join (not the drain) is what guarantees no worker
+            # survives into a restarted iterator
+            deadline = time.monotonic() + 5.0
+            while self.p.is_alive() and time.monotonic() < deadline:
                 try:
-                    while True:
-                        self.queue.get_nowait()
+                    self.queue.get_nowait()
                 except Empty:
                     pass
-            self.p = None
+                self.p.join(timeout=0.05)
+            if self.p.is_alive():
+                warnings.warn(
+                    "ImageBatchIter worker did not exit within the "
+                    "end() grace (a transform hung?); its queue is "
+                    "abandoned", stacklevel=2)
+        self.p = None
+        self._remove_attr_file()
 
-    def run(self):
-        with open(self.img_list_file, "r") as fd:
-            samples = [line.strip().split(self.delimiter, 1)
-                       for line in fd if line.strip()]
+    # -- worker ------------------------------------------------------------
+    def _put(self, item):
+        """Stop-aware bounded put; returns False when stopped first."""
         while not self.stop:
-            if self.shuffle:
-                random.shuffle(samples)
-            pos = 0
-            while pos < len(samples):
-                images, labels = [], []
-                while len(images) < self.batch_size and pos < len(samples):
-                    path, label = samples[pos]
-                    pos += 1
+            try:
+                self.queue.put(item, timeout=0.1)
+                return True
+            except Full:
+                continue
+        return False
+
+    def run(self, gen=0, start_state=None, attr_path=None):
+        epoch, pos, skip_count = start_state or (0, 0, 0)
+        try:
+            with open(self.img_list_file, "r") as fd:
+                samples = [line.strip().split(self.delimiter, 1)
+                           for line in fd if line.strip()]
+        except OSError as e:
+            self._put({"kind": "error", "gen": gen,
+                       "skip_count": skip_count, "quarantined": [],
+                       "message": f"cannot read image list "
+                                  f"{self.img_list_file!r}: {e}"})
+            return
+        n = len(samples)
+        pending_skips = []   # skip records awaiting a batch to ride on
+        while not self.stop:
+            order = epoch_permutation(self.seed, epoch, n) \
+                if self.shuffle else np.arange(n)
+            while pos < n and not self.stop:
+                images, labels, ids = [], [], []
+                skips = pending_skips
+                pending_skips = []
+                while len(images) < self.batch_size and pos < n:
+                    i = int(order[pos])
+                    path, label = samples[i]
                     full = os.path.join(self.image_folder, path)
-                    augmented = self.image_transform(full)
-                    for img in augmented:
-                        images.append(np.asarray(img, np.float32))
-                        labels.append(int(float(label)))
-                if not images:
-                    continue
-                batch = (np.stack(images), np.asarray(labels, np.int32))
-                while not self.stop:
+                    self._current_sample = {"epoch": epoch, "index": i,
+                                            "path": full}
+                    if attr_path is not None:
+                        # black-box recorder (process mode): written
+                        # BEFORE the decode so an abrupt death leaves
+                        # the sample's name behind (best effort — an
+                        # unwritable tmpdir degrades to the generic
+                        # death message, never kills the worker)
+                        try:
+                            with open(attr_path, "w") as f:
+                                json.dump(self._current_sample, f)
+                        except OSError:
+                            attr_path = None
+                    pos += 1
                     try:
-                        self.queue.put(batch, timeout=0.1)
-                        break
-                    except Full:
-                        continue
+                        if self.faults is not None:
+                            self.faults.on_sample(pos - 1, full)
+                        augmented = self.image_transform(full)
+                        for img in augmented:
+                            images.append(np.asarray(img, np.float32))
+                            labels.append(int(float(label)))
+                            ids.append(i)
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except DataWorkerKilled:
+                        return      # abrupt death: no record, no goodbye
+                    except Exception as e:
+                        skip_count += 1
+                        rec = {"epoch": int(epoch), "index": i,
+                               "path": full,
+                               "error": f"{type(e).__name__}: {e}"}
+                        skips.append(rec)
+                        if skip_count > self.skip_budget:
+                            self._put({
+                                "kind": "error", "gen": gen,
+                                "sample": rec, "quarantined": skips,
+                                "skip_count": skip_count,
+                                "message":
+                                    f"data skip budget exhausted: "
+                                    f"{skip_count} corrupt sample(s) "
+                                    f"with a budget of "
+                                    f"{self.skip_budget} (last: "
+                                    f"{full!r}, {rec['error']}) — "
+                                    "the dataset needs attention, not "
+                                    "more skipping"})
+                            return
+                if not images:
+                    # the whole tail of the epoch was corrupt: its skip
+                    # records ride on the next REAL batch (the records
+                    # carry their own epoch/index attribution, so
+                    # arriving late loses nothing)
+                    pending_skips = skips
+                    break
+                batch = (np.stack(images), np.asarray(labels, np.int32))
+                if not self._put({"kind": "batch", "gen": gen,
+                                  "epoch": int(epoch),
+                                  "position": int(pos),
+                                  "skipped": skips,
+                                  "skip_count": int(skip_count),
+                                  "ids": ids, "batch": batch}):
+                    break
+            if self.stop:
+                break
+            epoch += 1
+            pos = 0
+        # clean-stop sentinel (best effort: the queue may be full and
+        # the consumer gone; generation tags make a missed sentinel
+        # harmless)
+        try:
+            self.queue.put_nowait({"kind": "end", "gen": gen})
+        except Full:
+            pass
 
 
 def backoff_delay(attempt, base, cap, jitter, rng):
@@ -120,6 +429,27 @@ def backoff_delay(attempt, base, cap, jitter, rng):
     ``min(cap, base * 2**attempt)`` stretched by up to ``jitter`` drawn
     from the caller's (seeded, hence deterministic) RNG."""
     return min(cap, base * (2.0 ** attempt)) * (1.0 + jitter * rng.random())
+
+
+def _check_state_source(it, state):
+    """Shared load_state_dict sanity: a state saved against a different
+    dataset size cannot resume the same stream; a different seed CAN —
+    by adopting the saved one (the permutation is derived from the
+    state's seed, which is the whole point of carrying it)."""
+    n = state.get("num_samples")
+    if n is not None and int(n) != int(it.num_samples):
+        warnings.warn(
+            f"data state was saved over {n} samples but this iterator "
+            f"holds {it.num_samples}; the resumed stream will NOT "
+            "match the saved one (did the dataset change?)",
+            stacklevel=3)
+    seed = state.get("seed")
+    if seed is not None and int(seed) != int(it.seed):
+        warnings.warn(
+            f"data state carries seed {seed} but this iterator was "
+            f"built with seed {it.seed}; adopting the SAVED seed so "
+            "the resumed stream matches the checkpoint", stacklevel=3)
+        it.seed = int(seed)
 
 
 class RetryingIterator:
@@ -130,13 +460,17 @@ class RetryingIterator:
 
     ``source`` is an iterable OR a zero-arg factory returning a fresh
     iterator; with a factory, a failure REBUILDS the source (the right
-    move when the underlying worker/socket is dead) and iteration
-    continues from the rebuilt stream. ``StopIteration`` passes through
-    untouched — exhaustion is not a failure — EXCEPT when it
-    immediately follows a retried error on a non-factory source: a
-    generator that raised is permanently closed, so its retry yields
-    StopIteration, and passing that through would silently truncate the
-    stream; the original error is re-raised instead.
+    move when the underlying worker/socket is dead). A rebuilt source
+    that supports the state protocol is FAST-FORWARDED to the state of
+    the last delivered batch, so the rebuilt stream continues exactly
+    where the dead one left off — no replayed, no skipped samples.
+    ``StopIteration`` passes through untouched — exhaustion is not a
+    failure — EXCEPT when it immediately follows a retried error on a
+    non-factory source: a generator that raised is permanently closed,
+    so its retry yields StopIteration, and passing that through would
+    silently truncate the stream; :func:`raise_retried_failure` (the
+    rule's one home, shared with ``resilience.runtime._next_batch``)
+    re-raises the original error instead.
 
     A factory-backed RetryingIterator is also RE-ITERABLE: calling
     ``iter()`` on an exhausted one rebuilds a fresh epoch from the
@@ -165,12 +499,21 @@ class RetryingIterator:
         self._rng = random.Random(seed)
         self._sleep = sleep if sleep is not None else time.sleep
         self._it = None
+        self._src_obj = None
+        self._last_state = None     # state as of the last DELIVERED batch
+        self._pending_state = None  # explicit load, applied on (re)build
         self._exhausted = False
 
     def _iterator(self):
         if self._it is None:
             src = self._factory() if self._factory is not None \
                 else self._source
+            self._src_obj = src
+            state = self._pending_state if self._pending_state is not None \
+                else self._last_state
+            if state is not None and hasattr(src, "load_state_dict"):
+                src.load_state_dict(state)
+            self._pending_state = None
             self._it = iter(src)
         return self._it
 
@@ -190,6 +533,33 @@ class RetryingIterator:
         return {"attempts": self.attempts, "retries": self.retries,
                 "rebuilds": self.rebuilds}
 
+    # -- state protocol ----------------------------------------------------
+    def state_dict(self):
+        """Delegates to the underlying source: the state of the last
+        DELIVERED batch (a batch lost to an in-flight failure was never
+        delivered, so resume regenerates it — replay, not drop).
+        Returns None when the source predates the protocol."""
+        if self._last_state is not None:
+            return dict(self._last_state)
+        src = self._src_obj if self._src_obj is not None \
+            else (None if self._factory is not None else self._source)
+        sd = getattr(src, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def can_load_state(self):
+        """Delegating wrapper: a factory source is trusted (the state
+        is applied to whatever it builds); a plain source answers for
+        itself (see :func:`can_load_state`)."""
+        if self._factory is not None:
+            return True
+        return can_load_state(self._source)
+
+    def load_state_dict(self, state):
+        self._pending_state = dict(state)
+        self._last_state = dict(state)
+        self._exhausted = False
+        self._it = None        # applied when the source is (re)built
+
     def __next__(self):
         attempt = 0
         failed = None
@@ -198,12 +568,10 @@ class RetryingIterator:
                 self.attempts += 1
                 item = next(self._iterator())
             except StopIteration:
-                if failed is not None:
-                    # a failed generator is closed, not exhausted:
-                    # surface the failure, don't truncate the stream
-                    # (resilience.runtime._next_batch applies the same
-                    # rule around its epoch-wrap; keep them in sync)
-                    raise failed from None
+                # a failed generator is closed, not exhausted: surface
+                # the failure instead of truncating the stream (the one
+                # shared rule — see raise_retried_failure)
+                raise_retried_failure(failed)
                 self._exhausted = True
                 raise
             except (KeyboardInterrupt, SystemExit):
@@ -222,39 +590,137 @@ class RetryingIterator:
                 else:
                     failed = e
             else:
+                sd = getattr(self._src_obj, "state_dict", None)
+                if callable(sd):
+                    self._last_state = sd()
                 return item
 
     next = __next__
 
 
 class NumpyBatchIter:
-    """Batches over in-memory arrays with epoch shuffle — the synthetic /
-    pre-loaded data path used by examples (reference examples load cifar
-    into numpy then slice batches in the train loop)."""
+    """Batches over in-memory arrays with a stateless epoch shuffle —
+    the synthetic / pre-loaded data path used by examples (reference
+    examples load cifar into numpy then slice batches in the train
+    loop).
+
+    ``batch_size`` is the PER-RANK batch; with ``world > 1`` the
+    deterministic global stream (epoch ``e`` is
+    ``epoch_permutation(seed, e, n)``) is consumed ``batch_size *
+    world`` samples per step, rank ``r`` reading the ``r``-th slice of
+    each global batch. State (``{epoch, position}``) counts GLOBAL
+    samples and is therefore rank-agnostic: any rank's saved state
+    resumes any other rank — or a *different* world size — at the same
+    point of the same stream, which is what makes elastic resume
+    exactly-once (the consumed set is always a prefix of the global
+    permutation).
+
+    ``pad_last=True`` (implies ``drop_last=False``) pads the ragged
+    last batch up to ``batch_size`` and yields ``(x, y, mask)`` with a
+    float32 validity mask for EVERY batch — constant shapes and arity,
+    so a fixed-shape compiled step never retraces on the tail.
+
+    ``last_batch_ids`` holds the dataset indices of the most recently
+    yielded batch (this rank's slice) — the sample-attribution probe
+    the exactly-once chaos scenario asserts on.
+    """
 
     def __init__(self, x, y, batch_size, shuffle=True, drop_last=True,
-                 seed=0):
+                 seed=0, pad_last=False, rank=0, world=1):
         self.x = np.asarray(x)
         self.y = np.asarray(y)
-        self.batch_size = batch_size
+        self.batch_size = int(batch_size)
         self.shuffle = shuffle
-        self.drop_last = drop_last
-        self._rng = np.random.RandomState(seed)
+        self.pad_last = bool(pad_last)
+        self.drop_last = False if pad_last else drop_last
+        self.seed = int(seed)
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        if not 0 <= self.rank < self.world:
+            raise ValueError(f"rank {rank} outside world {world}")
+        if self.world > 1 and not self.drop_last and not self.pad_last:
+            # a ragged last GLOBAL batch would hand high ranks a short
+            # (possibly empty) slice — divergent per-rank shapes desync
+            # every collective; padding is the constant-shape answer
+            raise ValueError(
+                "NumpyBatchIter with world > 1 and drop_last=False "
+                "requires pad_last=True (the ragged last global batch "
+                "would yield rank-divergent, possibly empty, slices)")
+        self._epoch = 0
+        self._position = 0      # GLOBAL samples consumed this epoch
+        self.last_batch_ids = None
+
+    @property
+    def num_samples(self):
+        return len(self.x)
+
+    @property
+    def global_batch(self):
+        return self.batch_size * self.world
 
     @property
     def num_batches(self):
-        n = len(self.x) // self.batch_size
-        if not self.drop_last and len(self.x) % self.batch_size:
+        n = len(self.x) // self.global_batch
+        if not self.drop_last and len(self.x) % self.global_batch:
             n += 1
         return n
 
+    def _epoch_samples(self):
+        """Global samples one epoch consumes."""
+        n = len(self.x)
+        if self.drop_last:
+            return (n // self.global_batch) * self.global_batch
+        return n
+
+    # -- state protocol ----------------------------------------------------
+    def state_dict(self):
+        return {"kind": "NumpyBatchIter", "epoch": int(self._epoch),
+                "position": int(self._position), "seed": self.seed,
+                "num_samples": int(len(self.x))}
+
+    def load_state_dict(self, state):
+        _check_state_source(self, state)
+        self._epoch = int(state.get("epoch", 0))
+        self._position = int(state.get("position", 0))
+        self.last_batch_ids = None
+
     def __iter__(self):
-        idx = np.arange(len(self.x))
-        if self.shuffle:
-            self._rng.shuffle(idx)
-        for b in range(self.num_batches):
-            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
-            yield self.x[sel], self.y[sel]
+        n = len(self.x)
+        end = self._epoch_samples()
+        if end <= 0:
+            return
+        if self._position >= end:
+            # the previous epoch was fully consumed (possibly noticed
+            # only now, at re-iteration): wrap
+            self._epoch += 1
+            self._position = 0
+        epoch = self._epoch
+        idx = epoch_permutation(self.seed, epoch, n) if self.shuffle \
+            else np.arange(n)
+        while self._position < end and self._epoch == epoch:
+            pos = self._position
+            take = min(self.global_batch, end - pos)
+            lo = pos + self.rank * self.batch_size
+            hi = min(pos + (self.rank + 1) * self.batch_size, pos + take)
+            sel = idx[lo:hi] if lo < pos + take else idx[:0]
+            # consumed-at-yield accounting: the GLOBAL position advances
+            # before the batch is handed out, so state captured after
+            # the caller's step counts this batch exactly once
+            self._position = pos + take
+            self.last_batch_ids = np.asarray(sel, np.int64)
+            bx, by = self.x[sel], self.y[sel]
+            if self.pad_last:
+                mask = np.zeros(self.batch_size, np.float32)
+                mask[:len(sel)] = 1.0
+                if len(sel) < self.batch_size:
+                    pad = self.batch_size - len(sel)
+                    bx = np.concatenate(
+                        [bx, np.zeros((pad,) + bx.shape[1:], bx.dtype)])
+                    by = np.concatenate(
+                        [by, np.zeros((pad,) + by.shape[1:], by.dtype)])
+                yield bx, by, mask
+            else:
+                yield bx, by
 
 
 class DevicePrefetcher:
@@ -269,6 +735,12 @@ class DevicePrefetcher:
     prefetches into host memory only; there is no device staging in the
     reference because CUDA streams hide it).
 
+    State protocol: ``state_dict()`` snapshots the inner iterator's
+    state *as of the last batch this prefetcher YIELDED* — never the
+    batches merely staged in flight — so a resume replays the staged-
+    but-unconsumed window instead of dropping it, and a consumed batch
+    is never yielded twice.
+
     Usage::
 
         for tx, ty in DevicePrefetcher(batches, dev):
@@ -281,6 +753,28 @@ class DevicePrefetcher:
         self.iterator = iterator       # re-iterated per epoch in __iter__
         self.device = device
         self.depth = max(1, int(depth))
+        self._consumed_state = None
+
+    # -- state protocol ----------------------------------------------------
+    def state_dict(self):
+        if self._consumed_state is not None:
+            return dict(self._consumed_state)
+        sd = getattr(self.iterator, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def can_load_state(self):
+        """Delegating wrapper: loadable iff the INNER iterator is (see
+        :func:`can_load_state`)."""
+        return can_load_state(self.iterator)
+
+    def load_state_dict(self, state):
+        ld = getattr(self.iterator, "load_state_dict", None)
+        if ld is None:
+            raise TypeError(
+                "DevicePrefetcher's inner iterator does not implement "
+                "the state protocol (no load_state_dict)")
+        ld(state)
+        self._consumed_state = dict(state)
 
     def _stage(self, batch):
         if not isinstance(batch, (tuple, list)):
@@ -307,10 +801,19 @@ class DevicePrefetcher:
                     "already exhausted; pass a re-iterable (e.g. "
                     "NumpyBatchIter) for multi-epoch use")
             self._consumed_oneshot = True
-        pending = deque()
+        sd = getattr(self.iterator, "state_dict", None)
+        pending = deque()   # (staged batch, inner state AFTER that batch)
+
+        def emit():
+            staged, st = pending.popleft()
+            if st is not None:
+                self._consumed_state = st
+            return staged
+
         for batch in src:
-            pending.append(self._stage(batch))
+            pending.append((self._stage(batch),
+                            sd() if callable(sd) else None))
             if len(pending) >= self.depth:
-                yield pending.popleft()
+                yield emit()
         while pending:
-            yield pending.popleft()
+            yield emit()

@@ -90,6 +90,19 @@ def tensor_digest(arr) -> str:
     return f"{DIGEST_ALGO}:{c:08x}:{arr.nbytes}"
 
 
+def data_state_digest(state) -> str:
+    """Digest of a data-iterator ``state_dict()`` (the checkpointable
+    data pipeline, ``singa_tpu/data.py``) over its canonical JSON form
+    — sorted keys, compact separators — so dict ordering never matters.
+    Rides the data-state sidecar beside every checkpoint, the
+    two-phase-commit ACK, and the commit marker: the sample-stream
+    offset a resume rewinds to is vouched for end to end, exactly like
+    the tensors."""
+    blob = json.dumps(state, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return f"{DIGEST_ALGO}:{crc32(blob):08x}:{len(blob)}"
+
+
 def record_digest(key: bytes, value: bytes) -> str:
     """Digest of one KV record (Snapshot/BinFile sidecars)."""
     key = key.encode("utf-8") if isinstance(key, str) else bytes(key)
@@ -240,7 +253,8 @@ def replica_buffer_mismatches(arrays: dict) -> dict:
 
 __all__ = [
     "IntegrityError", "DIGEST_ALGO", "WIRE_MAGIC", "WIRE_VERSION",
-    "MAX_MESSAGE_BYTES", "crc32", "tensor_digest", "record_digest",
+    "MAX_MESSAGE_BYTES", "crc32", "tensor_digest", "data_state_digest",
+    "record_digest",
     "digest_tree", "manifest_digest", "verify_tree",
     "write_digest_sidecar", "read_digest_sidecar", "seal_frame",
     "open_frame", "state_fingerprint", "replica_buffer_mismatches",

@@ -214,13 +214,24 @@ class ClusterBase:
         the checkpoint layer's marker write."""
         self._commit_hook = hook
 
-    def ack_save(self, step: int, digest=None):
+    def ack_save(self, step: int, digest=None, data_digest=None):
         """ACK a durably-written shard. ``digest`` (optional) is the
         shard's manifest content digest: the coordinator compares the
         digests of ALL ranks before publishing — replicas that disagree
         mean divergence, and the step stays uncommitted rather than
-        vouching for forked state."""
+        vouching for forked state. ``data_digest`` (optional) is the
+        rank's data-iterator state digest; it is RECORDED per rank in
+        the commit marker (not agreement-checked — the offsets are
+        lockstep by construction, but the marker must vouch for
+        whatever each rank wrote) so any restore can cross-check the
+        data sidecar it lands on."""
         raise NotImplementedError
+
+    def ack_data_digests(self, step: int) -> dict:
+        """{rank: data-state digest} gathered from step N's ACKs (the
+        commit hook records them in the marker). Empty off-coordinator
+        and for steps outside the bounded commit window."""
+        return {}
 
     def wait_commit(self, step: int, timeout: float = 30.0) -> bool:
         raise NotImplementedError
@@ -262,6 +273,7 @@ class SoloCluster(ClusterBase):
         self.world = 1
         self.faults = faults if faults is not None else NULL_PLAN
         self._commit_hook = None
+        self._ack_data: dict = {}
 
     def health(self):
         return {"rank": self.rank, "world": 1, "alive": [self.rank],
@@ -271,10 +283,15 @@ class SoloCluster(ClusterBase):
     def barrier(self, name, timeout=30.0):
         return
 
-    def ack_save(self, step, digest=None):
+    def ack_save(self, step, digest=None, data_digest=None):
         self.faults.on_ack(int(step))
+        # recorded BEFORE the hook runs: the commit marker reads it
+        self._ack_data = {int(step): {0: data_digest}}
         if self._commit_hook is not None:
             self._commit_hook(int(step))
+
+    def ack_data_digests(self, step):
+        return dict(self._ack_data.get(int(step), {}))
 
     def wait_commit(self, step, timeout=30.0):
         return True
@@ -315,6 +332,7 @@ class Coordinator(ClusterBase):
         self._failed_barriers: dict[str, list] = {}
         self._acks: dict[int, set] = {}
         self._ack_digests: dict[int, dict] = {}  # step -> {rank: digest}
+        self._ack_data: dict[int, dict] = {}     # step -> {rank: data dg}
         self._commit_done: dict[int, threading.Event] = {}
         self._commit_ok: dict[int, bool] = {}
         self._commit_claimed: set[int] = set()   # publish/abort decided
@@ -432,7 +450,8 @@ class Coordinator(ClusterBase):
                 self._barrier_arrive(data["name"], rank)
             elif kind == "ack":
                 self._ack_arrive(int(data["step"]), rank,
-                                 data.get("digest"))
+                                 data.get("digest"),
+                                 data.get("data_digest"))
             elif kind == "fp":
                 self._fp_arrive(int(data["seq"]), rank, data.get("fp"))
 
@@ -589,12 +608,14 @@ class Coordinator(ClusterBase):
                 self._acks.setdefault(step, set())
             return ev
 
-    def _ack_arrive(self, step, rank, digest=None):
+    def _ack_arrive(self, step, rank, digest=None, data_digest=None):
         ev = self._commit_slot(step)
         with self._lock:
             self._acks[step].add(rank)
             if digest is not None:
                 self._ack_digests.setdefault(step, {})[rank] = digest
+            if data_digest is not None:
+                self._ack_data.setdefault(step, {})[rank] = data_digest
             complete = len(self._acks[step]) == self.world
             # claim the publish under the lock: a quorum completing
             # AFTER wait_commit's timeout aborted the step must not
@@ -632,14 +653,18 @@ class Coordinator(ClusterBase):
             with self._lock:
                 self._commit_ok[step] = ok
                 _prune_window(self._commit_ok, self._acks,
-                              self._ack_digests, self._commit_done,
-                              self._commit_claimed)
+                              self._ack_digests, self._ack_data,
+                              self._commit_done, self._commit_claimed)
             ev.set()
             self._broadcast("commit", step=step, ok=ok)
 
-    def ack_save(self, step, digest=None):
+    def ack_save(self, step, digest=None, data_digest=None):
         self.faults.on_ack(int(step))
-        self._ack_arrive(int(step), 0, digest)
+        self._ack_arrive(int(step), 0, digest, data_digest)
+
+    def ack_data_digests(self, step):
+        with self._lock:
+            return dict(self._ack_data.get(int(step), {}))
 
     def wait_commit(self, step, timeout=30.0):
         step = int(step)
@@ -961,13 +986,13 @@ class Worker(ClusterBase):
             raise BarrierTimeout(name, slot["missing"], timeout)
 
     # -- two-phase commit ---------------------------------------------------
-    def ack_save(self, step, digest=None):
+    def ack_save(self, step, digest=None, data_digest=None):
         self.faults.on_ack(int(step))
         with self._lock:
             self._commit_done.setdefault(int(step), threading.Event())
         try:
             self._send(self._ep, "ack", step=int(step), rank=self.rank,
-                       digest=digest)
+                       digest=digest, data_digest=data_digest)
         except ConnectionError:
             self._mark_coordinator_dead()
 

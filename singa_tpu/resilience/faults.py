@@ -30,6 +30,7 @@ import time
 import numpy as np
 import jax.numpy as jnp
 
+from ..data import DataWorkerKilled
 from ..tensor import Tensor
 
 
@@ -133,6 +134,30 @@ class FaultPlan:
         and ``restore_latest`` must refuse it."""
         return self._arm("kill_ack", step, 1)
 
+    # -- data-pipeline faults ----------------------------------------------
+    def corrupt_sample(self, index, times=1):
+        """Make the data worker's decode of the sample at EPOCH POSITION
+        ``index`` (its slot in the epoch's permutation, not its dataset
+        index) raise — the corrupt-JPEG shape of failure. The iterator
+        must skip-and-quarantine it (one sample lost, attributed), not
+        die. ``times`` spans re-encounters (a later epoch, or a resume
+        replaying the same position)."""
+        return self._arm("corrupt_sample", index, times)
+
+    def slow_fetch(self, step, seconds=0.5, times=1):
+        """Stall the step-N data fetch by ``seconds`` before it runs —
+        a straggling filesystem / network read, NOT a failure: nothing
+        raises, the batch just arrives late."""
+        return self._arm("slow_fetch", step, times,
+                         seconds=float(seconds))
+
+    def kill_data_worker(self, index):
+        """Kill the prefetch worker abruptly while it decodes the
+        sample at epoch position ``index`` — no error record, no
+        goodbye (a segfaulting decoder). The consumer must detect the
+        death AND name the sample that killed it."""
+        return self._arm("kill_worker", index, 1)
+
     # -- integrity faults --------------------------------------------------
     def corrupt_wire(self, seq, times=1):
         """Flip one bit in each of the next ``times`` control-plane
@@ -186,9 +211,25 @@ class FaultPlan:
 
     def on_data(self, step):
         """Called before each data fetch attempt."""
+        rec = self._take("slow_fetch", step)
+        if rec is not None:
+            time.sleep(rec["seconds"])      # late, not failed
         rec = self._take("data", step)
         if rec is not None:
             raise FaultInjected(f"step {step}: {rec['message']}")
+
+    def on_sample(self, index, path):
+        """Called by the data worker for every sample it dispatches
+        (``index`` is the sample's position in the epoch's
+        permutation)."""
+        if self._take("kill_worker", index) is not None:
+            raise DataWorkerKilled(
+                f"data worker killed at epoch position {index} ({path})")
+        rec = self._take("corrupt_sample", index)
+        if rec is not None:
+            raise FaultInjected(
+                f"injected corrupt sample at epoch position {index} "
+                f"({path})")
 
     def on_saved(self, step):
         """Called after a checkpoint save was dispatched for step N."""
@@ -252,6 +293,9 @@ class _NullPlan(FaultPlan):
         return batch
 
     def on_data(self, step):
+        pass
+
+    def on_sample(self, index, path):
         pass
 
     def on_saved(self, step):

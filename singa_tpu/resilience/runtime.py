@@ -47,6 +47,7 @@ import threading
 import time
 import warnings
 
+from .. import data as _data_mod
 from ..checkpoint import CheckpointManager, DistributedCheckpointManager
 from ..integrity import replica_buffer_mismatches, state_fingerprint
 from .cluster import BarrierTimeout, MembershipError
@@ -253,7 +254,8 @@ class ResilientTrainer:
                     # step rather than eating the kill grace
                     ok = self.mgr.save(
                         completed_step, self.model, force=True,
-                        commit_timeout=self.preempt_commit_timeout)
+                        commit_timeout=self.preempt_commit_timeout,
+                        data_state=self._data_state())
                     if not ok:
                         self._log(
                             f"{signame}: preemption checkpoint of step "
@@ -261,7 +263,8 @@ class ResilientTrainer:
                             "will use the last committed step")
                 else:
                     self.mgr.save(completed_step, self.model,
-                                  force=True)
+                                  force=True,
+                                  data_state=self._data_state())
             self.mgr.wait()     # synchronous: the bytes must be down
             self._log(f"{signame}: checkpointed step {completed_step}, "
                       f"exiting {EXIT_PREEMPTED} for the supervisor")
@@ -299,12 +302,13 @@ class ResilientTrainer:
                 self._yielded_any = True
                 return self.faults.on_batch(step, tuple(batch))
             except StopIteration:
-                if failed is not None:
-                    # a generator that raised is CLOSED, not exhausted:
-                    # this StopIteration is the corpse of the retried
-                    # failure — surface the real error (same rule as
-                    # data.RetryingIterator.__next__; keep them in sync)
-                    raise failed from None
+                # a generator that raised is CLOSED, not exhausted:
+                # this StopIteration is the corpse of the retried
+                # failure — the ONE shared rule
+                # (data.raise_retried_failure, also the
+                # RetryingIterator.__next__ rule) surfaces the real
+                # error instead of truncating the stream
+                _data_mod.raise_retried_failure(failed)
                 if getattr(self, "_yielded_any", False):
                     raise RuntimeError(
                         "data source is exhausted and not re-iterable "
@@ -396,6 +400,51 @@ class ResilientTrainer:
                               summary, "step_retries")
                 attempt += 1
 
+    # -- data-pipeline state -----------------------------------------------
+    def _data_state(self):
+        """The data source's ``state_dict()`` (None for a source that
+        predates the protocol) — captured at EVERY save so a restored
+        checkpoint rewinds the sample stream in lockstep with the
+        tensors."""
+        sd = getattr(self._data, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def _apply_data_state(self, resume_step):
+        """Rewind the data pipeline in LOCKSTEP with a model-state
+        restore (run start, guard rollback, divergence quarantine):
+        load the restored checkpoint's data state and drop the live
+        epoch iterator so the next fetch re-enters the source at the
+        loaded offset — the consumed sample sequence stays bit-
+        identical to a fault-free run's (exactly-once)."""
+        state = getattr(self.mgr, "restored_data_state", None)
+        # probe through delegating wrappers (a DevicePrefetcher around
+        # a plain generator HAS load_state_dict but nothing to apply it
+        # to): not-checkpointable must land on the warning below, not a
+        # TypeError mid-restore
+        loadable = _data_mod.can_load_state(self._data)
+        ld = getattr(self._data, "load_state_dict", None) \
+            if loadable else None
+        if state is not None and callable(ld):
+            self._data.load_state_dict(state)
+            self._it = None
+            self._data_resumed = True
+            self._log(f"data stream rewound to the checkpointed "
+                      f"offset (epoch {state.get('epoch')}, "
+                      f"position {state.get('position')})")
+        elif state is not None:
+            warnings.warn(
+                "the restored checkpoint carries data-iterator state "
+                "but this data source is not checkpointable (no "
+                "load_state_dict); the sample stream will NOT resume "
+                "where the saved run left off", stacklevel=3)
+        elif resume_step and callable(ld):
+            warnings.warn(
+                f"resumed at step {resume_step} from a checkpoint "
+                "without data-iterator state (saved before data-state "
+                "capture?); the sample stream restarts from the "
+                "iterator's current position — exactly-once is NOT "
+                "guaranteed for this resume", stacklevel=3)
+
     # -- cluster health ----------------------------------------------------
     def _check_cluster(self):
         """At a step boundary: raise MembershipError if a peer (or the
@@ -412,8 +461,28 @@ class ResilientTrainer:
         if guard is not None:
             summary["skipped_steps"] = guard.stats()["skipped_total"]
         from ..data import RetryingIterator
-        if isinstance(self._data, RetryingIterator):
-            summary["data_source"] = self._data.counters()
+        summary["data_resumed"] = bool(getattr(self, "_data_resumed",
+                                               False))
+        # walk the wrapper chain — DevicePrefetcher (.iterator),
+        # RetryingIterator (._src_obj), user staging adapters (.inner)
+        # — so retry counters and per-sample quarantine attribution are
+        # visible in the run summary no matter how the pipeline is
+        # stacked, not just in warnings that scrolled away
+        obj, seen = self._data, set()
+        while obj is not None and id(obj) not in seen:
+            seen.add(id(obj))
+            if isinstance(obj, RetryingIterator) and \
+                    "data_source" not in summary:
+                summary["data_source"] = obj.counters()
+            q = getattr(obj, "quarantined", None)
+            if q and "data_quarantined" not in summary:
+                summary["data_quarantined"] = [dict(r) for r in q]
+                summary["data_skipped"] = int(
+                    getattr(obj, "skip_count", len(q)))
+            obj = next((w for w in (getattr(obj, "_src_obj", None),
+                                    getattr(obj, "iterator", None),
+                                    getattr(obj, "inner", None))
+                        if w is not None), None)
         if self.cluster is not None:
             try:
                 summary["cluster"] = self.cluster.health()
@@ -450,6 +519,10 @@ class ResilientTrainer:
             # agreement reached: markers at/after the resume point
             # vouch for a timeline about to be re-run
             self.mgr.invalidate_markers_from(resume)
+        # the data stream rewinds WITH the tensors — on every rollback
+        # and quarantine path, not just at run start: the re-run steps
+        # must consume the exact batches the quarantined timeline did
+        self._apply_data_state(resume)
         return resume
 
     def _maybe_rollback(self, step, bad_streak, summary):
@@ -551,6 +624,7 @@ class ResilientTrainer:
         self._data = data
         self._it = None
         self._yielded_any = False
+        self._data_resumed = False
         self._preempt_signal = None     # a reused trainer starts clean
         summary = {"start": None, "steps_run": 0, "rollbacks": 0,
                    "step_retries": 0, "data_retries": 0,
@@ -584,6 +658,7 @@ class ResilientTrainer:
                 # timeline about to be re-run — cleared now so a later
                 # pre-ACK death cannot hide behind a stale marker
                 self.mgr.invalidate_markers_from(start)
+            self._apply_data_state(start)
             if start:
                 self._log(f"resumed from checkpoint; continuing at "
                           f"step {start}")
@@ -626,7 +701,11 @@ class ResilientTrainer:
                 # predates the bad streak and rollback actually rewinds
                 bad = guard.bad_streak_value() if guard is not None else 0
                 if bad == 0:
-                    self.mgr.save(step, self.model)
+                    # the data state rides every save: captured AFTER
+                    # the step, so it counts this step's batch as
+                    # consumed and a resume fetches the NEXT one
+                    self.mgr.save(step, self.model,
+                                  data_state=self._data_state())
                     self.faults.on_saved(step)
                 if step_callback is not None:
                     step_callback(step, out)

@@ -282,6 +282,7 @@ class _Hub:
         self.world = world
         self.lock = threading.Lock()
         self.acks = {}
+        self.data_digests = {}
         self.committed = set()
         self.hook = None
 
@@ -300,14 +301,21 @@ class FakeCluster:
     def set_commit_hook(self, hook):
         self.hub.hook = hook
 
-    def ack_save(self, step, digest=None):
+    def ack_save(self, step, digest=None, data_digest=None):
         with self.hub.lock:
             self.hub.acks.setdefault(step, set()).add(self.rank)
+            if data_digest is not None:
+                self.hub.data_digests.setdefault(
+                    step, {})[self.rank] = data_digest
             complete = len(self.hub.acks[step]) == self.world
         if complete and self.hub.hook is not None:
             self.hub.hook(step)
             with self.hub.lock:
                 self.hub.committed.add(step)
+
+    def ack_data_digests(self, step):
+        with self.hub.lock:
+            return dict(self.hub.data_digests.get(step, {}))
 
     def wait_commit(self, step, timeout=30.0):
         import time

@@ -1,0 +1,942 @@
+"""Exactly-once data pipeline: checkpointable iterators, deterministic
+resume, sample quarantine (singa_tpu/data.py + the resilience stack).
+
+The contract under test: shuffles are STATELESS (epoch order is a pure
+function of ``(seed, epoch)``), iterator state is just counters, and a
+preempted/rolled-back/re-sharded run consumes a sample sequence
+bit-identical to a fault-free one — with a corrupt sample costing
+exactly one skipped-and-attributed sample, never the job.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from singa_tpu import data as data_mod
+from singa_tpu.data import (DataSampleError, DevicePrefetcher,
+                            ImageBatchIter, NumpyBatchIter,
+                            RetryingIterator, epoch_permutation)
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+def npy_dataset(tmp_path, n=12):
+    """A tiny ImageBatchIter-compatible dataset of .npy 'images' (value
+    == dataset index, so batches self-identify)."""
+    root = tmp_path / "samples"
+    root.mkdir(exist_ok=True)
+    for i in range(n):
+        np.save(root / f"s{i}.npy", np.full((2, 2), i, np.float32))
+    lst = root / "list.txt"
+    with open(lst, "w") as f:
+        for i in range(n):
+            f.write(f"s{i}.npy {i % 3}\n")
+    return str(lst), str(root)
+
+
+def npy_transform(path):
+    return [np.load(path)]
+
+
+def image_iter(tmp_path, batch_size=4, **kw):
+    lst, root = npy_dataset(tmp_path)
+    kw.setdefault("image_folder", root)
+    return ImageBatchIter(lst, batch_size, npy_transform, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the stateless shuffle
+# ---------------------------------------------------------------------------
+
+class TestEpochPermutation:
+    def test_pure_function_of_seed_and_epoch(self):
+        a = epoch_permutation(7, 3, 100)
+        b = epoch_permutation(7, 3, 100)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, epoch_permutation(7, 4, 100))
+        assert not np.array_equal(a, epoch_permutation(8, 3, 100))
+        assert sorted(a.tolist()) == list(range(100))
+
+
+# ---------------------------------------------------------------------------
+# NumpyBatchIter
+# ---------------------------------------------------------------------------
+
+class TestNumpyBatchIterState:
+    def test_resume_mid_epoch_reproduces_exact_order(self):
+        x = np.arange(80, dtype=np.float32).reshape(40, 2)
+        y = np.arange(40)
+        ref = NumpyBatchIter(x, y, 8, seed=5)
+        ref_batches = [b for _e in range(2) for b in ref]
+
+        it = NumpyBatchIter(x, y, 8, seed=5)
+        g = iter(it)
+        got = [next(g), next(g), next(g)]
+        state = it.state_dict()
+        assert state["position"] == 24 and state["epoch"] == 0
+
+        resumed = NumpyBatchIter(x, y, 8, seed=5)
+        resumed.load_state_dict(state)
+        got += [b for _e in range(2) for b in resumed][:len(ref_batches) - 3]
+        for (ax, ay), (bx, by) in zip(got, ref_batches):
+            np.testing.assert_array_equal(ax, bx)
+            np.testing.assert_array_equal(ay, by)
+
+    def test_state_counts_consumed_batches_only(self):
+        it = NumpyBatchIter(np.zeros((16, 2)), np.zeros(16), 4)
+        assert it.state_dict()["position"] == 0
+        g = iter(it)
+        next(g)
+        assert it.state_dict()["position"] == 4
+
+    def test_epoch_wraps_through_state(self):
+        it = NumpyBatchIter(np.zeros((8, 1)), np.zeros(8), 4, seed=1)
+        assert len(list(it)) == 2
+        st = it.state_dict()
+        assert (st["epoch"], st["position"]) == (0, 8)
+        assert len(list(it)) == 2           # wraps into epoch 1
+        assert it.state_dict()["epoch"] == 1
+
+    def test_mismatched_dataset_or_seed_warns(self):
+        it = NumpyBatchIter(np.zeros((8, 1)), np.zeros(8), 4, seed=1)
+        with pytest.warns(UserWarning, match="dataset change"):
+            it.load_state_dict({"epoch": 0, "position": 0,
+                                "num_samples": 99, "seed": 1})
+        it2 = NumpyBatchIter(np.zeros((8, 1)), np.zeros(8), 4, seed=1)
+        with pytest.warns(UserWarning, match="adopting the SAVED seed"):
+            it2.load_state_dict({"epoch": 0, "position": 0,
+                                 "num_samples": 8, "seed": 3})
+        assert it2.seed == 3                # saved stream wins
+
+    def test_rank_sharding_exactly_once_and_elastic(self):
+        """The global stream is rank-sharded deterministically: the
+        union of all ranks' ids per step is the next global-batch slice
+        of the permutation (exactly-once), and state is rank-agnostic —
+        a world-2 state resumes a world-1 iterator at the same global
+        offset (the consumed set stays a clean prefix across the world
+        change)."""
+        x = np.arange(64, dtype=np.float32).reshape(32, 2)
+        y = np.arange(32)
+        stream = epoch_permutation(9, 0, 32)
+        its = [NumpyBatchIter(x, y, 4, seed=9, rank=r, world=2)
+               for r in range(2)]
+        gens = [iter(it) for it in its]
+        for step in range(3):
+            ids = []
+            for it, g in zip(its, gens):
+                next(g)
+                ids.append(it.last_batch_ids)
+            np.testing.assert_array_equal(
+                np.concatenate(ids), stream[8 * step:8 * (step + 1)])
+        st = its[0].state_dict()
+        assert st["position"] == 24         # global samples, not per-rank
+
+        solo = NumpyBatchIter(x, y, 4, seed=9, rank=0, world=1)
+        solo.load_state_dict(st)
+        next(iter(solo))
+        np.testing.assert_array_equal(solo.last_batch_ids,
+                                      stream[24:28])
+
+    def test_world_ragged_without_pad_rejected(self):
+        """world > 1 with an unpadded ragged tail would hand high ranks
+        short (even empty) slices — rank-divergent shapes desync every
+        collective, so construction refuses it, pointing at pad_last."""
+        x = np.zeros((10, 2), np.float32)
+        y = np.zeros(10, np.float32)
+        with pytest.raises(ValueError, match="pad_last=True"):
+            NumpyBatchIter(x, y, 4, world=2, rank=1, drop_last=False)
+        NumpyBatchIter(x, y, 4, world=2, rank=1, drop_last=False,
+                       pad_last=True)              # the sanctioned form
+        NumpyBatchIter(x, y, 4, world=2, rank=1)   # drop_last fine too
+
+    def test_pad_last_constant_shapes_with_mask(self):
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        y = np.arange(10, dtype=np.int32)
+        it = NumpyBatchIter(x, y, 4, shuffle=False, pad_last=True)
+        batches = list(it)
+        assert len(batches) == 3
+        for bx, by, mask in batches:
+            assert bx.shape == (4, 2) and by.shape == (4,)
+            assert mask.shape == (4,) and mask.dtype == np.float32
+        np.testing.assert_array_equal(batches[-1][2], [1, 1, 0, 0])
+        np.testing.assert_array_equal(batches[-1][0][:2], x[8:])
+        np.testing.assert_array_equal(batches[-1][0][2:], 0)
+        assert all((b[2] == 1).all() for b in batches[:-1])
+
+
+class TestPadLastNoRetrace:
+    def test_ragged_tail_pins_one_trace(self):
+        """The PR-4 retrace guard, extended to the data tail: a
+        pad_last stream feeds constant shapes, so a fixed-shape
+        compiled loop stays at exactly ONE trace across the ragged
+        epoch boundary."""
+        from singa_tpu import device, layer, model, opt
+        from singa_tpu.tensor import Tensor
+
+        class MLP(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.fc = layer.Linear(3)
+                self.loss_fn = layer.SoftMaxCrossEntropy()
+
+            def forward(self, x):
+                return self.fc(x)
+
+            def train_one_batch(self, x, y):
+                out = self.forward(x)
+                loss = self.loss_fn(out, y)
+                self.optimizer(loss)
+                return out, loss
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        x = np.random.RandomState(0).randn(10, 4).astype(np.float32)
+        y = np.arange(10) % 3
+        eye = np.eye(3, dtype=np.float32)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1))
+        it = NumpyBatchIter(x, y, 4, seed=2, pad_last=True)
+        first = next(iter(NumpyBatchIter(x, y, 4, seed=2,
+                                         pad_last=True)))
+        tx = Tensor(data=first[0], device=dev, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+        for _epoch in range(2):
+            for bx, by, _mask in it:
+                m(Tensor(data=bx, device=dev, requires_grad=False),
+                  Tensor(data=eye[by.astype(int)], device=dev,
+                         requires_grad=False))
+        recs = list(m._steps.values())
+        assert len(recs) == 1
+        assert recs[0]["n_traces"] == 1, \
+            f"ragged tail retraced: {recs[0]['n_traces']} traces"
+
+
+# ---------------------------------------------------------------------------
+# ImageBatchIter
+# ---------------------------------------------------------------------------
+
+class TestImageBatchIterState:
+    def test_resume_replays_prefetched_but_unconsumed(self, tmp_path):
+        """state_dict reflects CONSUMED batches only: batches the
+        worker prefetched into the queue but the consumer never took
+        are re-decoded after a resume — replayed, not dropped."""
+        it = image_iter(tmp_path, seed=4, capacity=8)
+        it.start()
+        consumed = [next(it), next(it)]
+        state = it.state_dict()
+        it.end()                       # queue may hold prefetched batches
+        assert state["position"] == 8
+
+        resumed = image_iter(tmp_path, seed=4)
+        resumed.load_state_dict(state)
+        resumed.start()
+        nxt = next(resumed)
+        resumed.end()
+
+        ref = image_iter(tmp_path, seed=4)
+        ref.start()
+        ref_batches = [next(ref) for _ in range(3)]
+        ref.end()
+        for got, want in zip(consumed + [nxt], ref_batches):
+            np.testing.assert_array_equal(got[0], want[0])
+            np.testing.assert_array_equal(got[1], want[1])
+
+    def test_end_then_restart_has_no_stale_batches(self, tmp_path):
+        """The end() lifecycle regression: a worker racing a mid-put
+        into the drain must not leak its batch into a restarted
+        iterator (fresh queue + generation tags + a real join)."""
+        it = image_iter(tmp_path, batch_size=2, seed=6, capacity=2)
+        for _round in range(3):
+            it.start()
+            got = next(it)
+            it.end()
+            assert it.p is None
+        # the three consumed batches are the stream's first three
+        ref = image_iter(tmp_path, batch_size=2, seed=6)
+        ref.start()
+        for _ in range(2):
+            next(ref)
+        want = next(ref)
+        ref.end()
+        np.testing.assert_array_equal(got[0], want[0])
+
+    def test_end_joins_process_mode(self, tmp_path):
+        it = image_iter(tmp_path, use_process=True)
+        it.start()
+        next(it)
+        p = it.p
+        it.end()
+        assert it.p is None and not p.is_alive()
+        assert p.exitcode is not None          # joined, not abandoned
+
+    def test_deterministic_given_seed(self, tmp_path):
+        a = image_iter(tmp_path, seed=11)
+        a.start()
+        batch_a = next(a)
+        a.end()
+        b = image_iter(tmp_path, seed=11)
+        b.start()
+        batch_b = next(b)
+        b.end()
+        np.testing.assert_array_equal(batch_a[0], batch_b[0])
+
+
+class TestSampleQuarantine:
+    def test_corrupt_sample_costs_one_skip_with_attribution(
+            self, tmp_path):
+        from singa_tpu.resilience.faults import FaultPlan
+        it = image_iter(tmp_path, seed=0, shuffle=False, skip_budget=3,
+                        faults=FaultPlan().corrupt_sample(2))
+        it.start()
+        with pytest.warns(UserWarning, match="skipped 1 corrupt"):
+            batches = [next(it) for _ in range(3)]
+        it.end()
+        ids = np.concatenate([b[1] for b in batches])
+        assert len(ids) == 11                  # 12 samples, one skipped
+        assert it.skip_count == 1
+        (rec,) = it.quarantined
+        assert rec["index"] == 2 and "s2.npy" in rec["path"]
+        assert it.state_dict()["skip_count"] == 1
+
+    def test_skip_budget_exhaustion_fails_loudly(self, tmp_path):
+        from singa_tpu.resilience.faults import FaultPlan
+        it = image_iter(tmp_path, shuffle=False, skip_budget=1,
+                        faults=FaultPlan().corrupt_sample(0, times=3))
+        it.start()
+        with pytest.raises(DataSampleError,
+                           match="skip budget exhausted") as e:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for _ in range(6):
+                    next(it)
+        it.end()
+        assert e.value.sample is not None
+        assert "s0.npy" in e.value.sample["path"]
+
+    def test_default_budget_zero_keeps_fail_fast(self, tmp_path):
+        lst, root = npy_dataset(tmp_path)
+        os.remove(os.path.join(root, "s1.npy"))
+        it = ImageBatchIter(lst, 4, npy_transform, shuffle=False,
+                            image_folder=root)
+        it.start()
+        with pytest.raises(DataSampleError, match="s1.npy"):
+            next(it)
+        it.end()
+
+    def test_worker_death_names_the_sample(self, tmp_path):
+        from singa_tpu.resilience.faults import FaultPlan
+        it = image_iter(tmp_path, batch_size=2, shuffle=False,
+                        faults=FaultPlan().kill_data_worker(3))
+        it.start()
+        with pytest.raises(DataSampleError,
+                           match="died while decoding") as e:
+            for _ in range(6):
+                next(it)
+        it.end()
+        assert "s3.npy" in str(e.value)
+
+    def test_worker_death_names_the_sample_in_process_mode(
+            self, tmp_path):
+        """use_process=True: the child's memory dies with it, but the
+        black-box attribution file it wrote just before the decode
+        still names the sample that killed it."""
+        from singa_tpu.resilience.faults import FaultPlan
+        it = image_iter(tmp_path, batch_size=2, shuffle=False,
+                        use_process=True,
+                        faults=FaultPlan().kill_data_worker(3))
+        it.start()
+        with pytest.raises(DataSampleError,
+                           match="died while decoding") as e:
+            for _ in range(6):
+                next(it)
+        it.end()
+        assert "s3.npy" in str(e.value)
+        assert it._attr_path is None        # end() cleaned the recorder
+
+
+# ---------------------------------------------------------------------------
+# RetryingIterator
+# ---------------------------------------------------------------------------
+
+class TestRetryingIteratorState:
+    def test_delegates_state_to_source(self):
+        x = np.arange(32, dtype=np.float32).reshape(16, 2)
+        src = NumpyBatchIter(x, np.arange(16), 4, seed=2)
+        it = RetryingIterator(src)
+        g = iter(it)
+        next(g)
+        assert it.state_dict()["position"] == 4
+        it2 = RetryingIterator(NumpyBatchIter(x, np.arange(16), 4,
+                                              seed=2))
+        it2.load_state_dict(it.state_dict())
+        nxt = next(iter(it2))
+        want = list(NumpyBatchIter(x, np.arange(16), 4, seed=2))[1]
+        np.testing.assert_array_equal(nxt[0], want[0])
+
+    def test_factory_rebuild_fast_forwards(self, tmp_path):
+        """A factory rebuild after a source death resumes at the last
+        DELIVERED batch's state: no delivered batch replays, the lost
+        in-flight batch is regenerated."""
+        built = []
+
+        def factory():
+            it = image_iter(tmp_path, seed=5)
+            built.append(it)
+            return it
+
+        ri = RetryingIterator(factory, backoff_base=0.0001, jitter=0)
+        g = iter(ri)
+        first, second = next(g), next(g)
+        built[-1].end()                 # kill the live worker
+        third = next(g)                 # fails -> rebuilds -> resumes
+        built[-1].end()
+        assert ri.rebuilds == 1 and len(built) == 2
+
+        ref = image_iter(tmp_path, seed=5)
+        ref.start()
+        want = [next(ref) for _ in range(3)]
+        ref.end()
+        for got, exp in zip((first, second, third), want):
+            np.testing.assert_array_equal(got[0], exp[0])
+
+
+class TestClosedGeneratorRuleSharedHelper:
+    """The closed-generator-after-retry rule lives ONCE
+    (data.raise_retried_failure); both consumers route through it."""
+
+    @staticmethod
+    def _spy(monkeypatch):
+        calls = []
+        real = data_mod.raise_retried_failure
+
+        def spy(failed):
+            calls.append(failed)
+            real(failed)
+
+        monkeypatch.setattr(data_mod, "raise_retried_failure", spy)
+        return calls
+
+    @staticmethod
+    def _failing_gen():
+        yield (np.ones(1, np.float32),)
+        raise ValueError("flaky source")
+
+    def test_retrying_iterator_goes_through_helper(self, monkeypatch):
+        calls = self._spy(monkeypatch)
+        it = RetryingIterator(self._failing_gen(), backoff_base=0.0001,
+                              jitter=0)
+        g = iter(it)
+        next(g)
+        with pytest.raises(ValueError, match="flaky source"):
+            next(g)                   # retried -> closed -> re-raised
+        assert any(isinstance(c, ValueError) for c in calls)
+
+    def test_trainer_next_batch_goes_through_helper(
+            self, monkeypatch, tmp_path):
+        from singa_tpu.resilience.runtime import ResilientTrainer
+        calls = self._spy(monkeypatch)
+        tr = ResilientTrainer(object(), str(tmp_path / "ck"),
+                              verbose=False, backoff_base=0.0001,
+                              backoff_cap=0.0002,
+                              install_signal_handlers=False)
+        try:
+            tr._data = self._failing_gen()
+            tr._it = None
+            tr._yielded_any = False
+            summary = {"data_retries": 0}
+            tr._next_batch(0, summary)          # first batch delivers
+            with pytest.raises(ValueError, match="flaky source"):
+                tr._next_batch(1, summary)
+            assert any(isinstance(c, ValueError) for c in calls)
+            assert summary["data_retries"] >= 1
+        finally:
+            tr.close()
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher
+# ---------------------------------------------------------------------------
+
+class TestDevicePrefetcherState:
+    def _setup(self, depth=3):
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+        x = np.arange(64, dtype=np.float32).reshape(16, 4)
+        y = np.arange(16, dtype=np.float32)
+        src = NumpyBatchIter(x, y, 4, shuffle=False)
+        return DevicePrefetcher(src, dev, depth=depth), x, y, dev
+
+    def test_state_reflects_yielded_not_staged(self):
+        pf, x, _y, _dev = self._setup(depth=3)
+        g = iter(pf)
+        next(g)
+        # depth=3: the inner iterator is 3 batches ahead, but state is
+        # the 1 batch actually YIELDED
+        assert pf.state_dict()["position"] == 4
+        assert pf.iterator.state_dict()["position"] > 4
+
+    def test_swap_neither_drops_nor_doubles_in_flight(self):
+        """In-flight (staged but unyielded) batches are replayed by a
+        swapped-in iterator, and consumed ones never re-yield."""
+        pf, x, y, dev = self._setup(depth=3)
+        g = iter(pf)
+        got = [next(g), next(g)]
+        state = pf.state_dict()
+
+        src2 = NumpyBatchIter(x, y, 4, shuffle=False)
+        pf2 = DevicePrefetcher(src2, dev, depth=3)
+        pf2.load_state_dict(state)
+        rest = list(pf2)
+        seen = np.concatenate([b[0].numpy() for b in got + rest])
+        np.testing.assert_array_equal(seen, x)     # no gap, no repeat
+
+    def test_exhausted_generator_guard_still_raises(self):
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+        pf = DevicePrefetcher((b for b in [(np.ones(2, np.float32),)]),
+                              dev)
+        assert len(list(pf)) == 1
+        with pytest.raises(RuntimeError, match="already exhausted"):
+            list(pf)
+
+    def test_can_load_state_sees_through_wrappers(self):
+        """The runtime's checkpointability probe answers for the INNER
+        source of a delegating wrapper, not the wrapper's class."""
+        from singa_tpu import device
+        from singa_tpu.data import can_load_state
+        dev = device.create_cpu_device()
+        x = np.zeros((8, 2), np.float32)
+        y = np.zeros(8, np.float32)
+        src = NumpyBatchIter(x, y, 4)
+        assert can_load_state(src)
+        assert can_load_state(DevicePrefetcher(src, dev))
+        assert can_load_state(RetryingIterator(lambda: src))
+        gen = (b for b in [])
+        assert not can_load_state(gen)
+        assert not can_load_state(DevicePrefetcher(gen, dev))
+        assert not can_load_state(RetryingIterator(gen))
+
+    def test_trainer_warns_not_crashes_on_unloadable_wrapper(
+            self, tmp_path):
+        """A restored data state meeting a prefetcher around a plain
+        generator lands on the loud not-checkpointable warning, never a
+        TypeError mid-restore."""
+        from singa_tpu import device
+        from singa_tpu.resilience import ResilientTrainer
+        dev = device.create_cpu_device()
+        tr = ResilientTrainer(object(), str(tmp_path / "ck"),
+                              verbose=False,
+                              install_signal_handlers=False)
+        try:
+            tr.mgr.restored_data_state = {"epoch": 1, "position": 8}
+            tr._data = DevicePrefetcher((b for b in []), dev)
+            tr._data_resumed = False
+            with pytest.warns(UserWarning, match="not checkpointable"):
+                tr._apply_data_state(3)
+            assert tr._data_resumed is False
+        finally:
+            tr.close()
+
+    def test_summary_scan_walks_stacked_pipeline(self, tmp_path):
+        """Quarantine attribution and retry counters surface through
+        the natural TPU stack DevicePrefetcher(RetryingIterator(
+        ImageBatchIter)), not just a bare source."""
+        from singa_tpu import device
+        from singa_tpu.resilience import ResilientTrainer
+        from singa_tpu.resilience.faults import FaultPlan
+        dev = device.create_cpu_device()
+        ri = RetryingIterator(lambda: image_iter(
+            tmp_path, shuffle=False, skip_budget=2,
+            faults=FaultPlan().corrupt_sample(1)))
+        pf = DevicePrefetcher(ri, dev)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            g = iter(pf)
+            for _ in range(3):
+                next(g)
+        tr = ResilientTrainer(object(), str(tmp_path / "ck"),
+                              verbose=False,
+                              install_signal_handlers=False)
+        try:
+            tr._data = pf
+            summary = {}
+            tr._finalize_summary(summary)
+        finally:
+            tr.close()
+            ri._src_obj.end()
+        assert summary["data_quarantined"][0]["index"] == 1
+        assert summary["data_skipped"] == 1
+        assert summary["data_source"]["attempts"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integration
+# ---------------------------------------------------------------------------
+
+def _mlp(seed=7, guard=False, n=32):
+    from singa_tpu import device, layer, model, opt
+    from singa_tpu.resilience import GuardedOptimizer
+    from singa_tpu.tensor import Tensor
+
+    class MLP(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(8)
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(4)
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(seed)
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 6).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, n)]
+    m = MLP()
+    sgd = opt.SGD(lr=0.05, momentum=0.9)
+    m.set_optimizer(GuardedOptimizer(sgd) if guard else sgd)
+    tx = Tensor(data=x[:4], device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True)
+    return m, x, y, dev
+
+
+class _Staged:
+    """Stateful adapter used by the trainer tests: NumpyBatchIter ->
+    device tensors, delegating the state protocol."""
+
+    def __init__(self, inner, dev):
+        from singa_tpu.tensor import Tensor
+        self._Tensor = Tensor
+        self.inner, self.dev = inner, dev
+
+    def __iter__(self):
+        for bx, by in self.inner:
+            yield (self._Tensor(data=bx, device=self.dev,
+                                requires_grad=False),
+                   self._Tensor(data=by, device=self.dev,
+                                requires_grad=False))
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def load_state_dict(self, state):
+        self.inner.load_state_dict(state)
+
+    @property
+    def last_batch_ids(self):
+        return self.inner.last_batch_ids
+
+
+class TestCheckpointDataState:
+    def test_round_trip_with_digest(self, tmp_path):
+        from singa_tpu.checkpoint import CheckpointManager
+        m, x, y, dev = _mlp()
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        state = {"kind": "NumpyBatchIter", "epoch": 1, "position": 12,
+                 "seed": 3, "num_samples": 32}
+        mgr.save(0, m, data_state=state)
+        mgr.wait()
+        assert mgr.last_saved_data_digest is not None
+        mgr2 = CheckpointManager(str(tmp_path / "ck"))
+        assert mgr2.restore_latest(m) == 1
+        assert mgr2.restored_data_state == state
+        mgr.close()
+        mgr2.close()
+
+    def test_save_without_state_restores_none(self, tmp_path):
+        from singa_tpu.checkpoint import CheckpointManager
+        m, *_ = _mlp()
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(0, m)
+        mgr.wait()
+        assert mgr.last_saved_data_digest is None
+        mgr2 = CheckpointManager(str(tmp_path / "ck"))
+        assert mgr2.restore_latest(m) == 1
+        assert mgr2.restored_data_state is None
+        mgr.close()
+        mgr2.close()
+
+    def test_corrupt_sidecar_drives_step_fallback(self, tmp_path):
+        """A tampered data-state sidecar makes the WHOLE step fall back
+        (tensors and data stay consistent at the older step), exactly
+        like corrupt tensor bytes."""
+        from singa_tpu.checkpoint import CheckpointManager
+        m, x, y, dev = _mlp()
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d)
+        st = {"epoch": 0, "position": 8}
+        mgr.save(0, m, data_state=st)
+        mgr.wait()
+        mgr.save(1, m, data_state={"epoch": 0, "position": 16})
+        mgr.wait()
+        p = os.path.join(d, "data_state", "1.json")
+        with open(p) as f:
+            doc = f.read()
+        with open(p, "w") as f:
+            f.write(doc.replace('"position": 16', '"position": 999'))
+        mgr2 = CheckpointManager(d)
+        with pytest.warns(UserWarning, match="not restorable"):
+            assert mgr2.restore_latest(m) == 1   # fell back to step 0
+        assert mgr2.restored_data_state["position"] == 8
+        mgr.close()
+        mgr2.close()
+
+    def test_scrub_flags_corrupt_data_state(self, tmp_path):
+        from singa_tpu.checkpoint import CheckpointManager
+        m, *_ = _mlp()
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d)
+        mgr.save(0, m, data_state={"epoch": 0, "position": 4})
+        mgr.wait()
+        assert mgr.scrub() == {0: "ok"}
+        p = os.path.join(d, "data_state", "0.json")
+        with open(p) as f:
+            doc = f.read()
+        with open(p, "w") as f:
+            f.write(doc.replace('"position": 4', '"position": 5'))
+        with pytest.warns(UserWarning, match="data-state sidecar"):
+            assert mgr.scrub() == {0: "corrupt"}
+        mgr.close()
+
+    def test_rotation_prunes_data_state_sidecars(self, tmp_path):
+        from singa_tpu.checkpoint import CheckpointManager
+        m, *_ = _mlp()
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d, max_to_keep=2)
+        for s in range(4):
+            mgr.save(s, m, data_state={"position": s})
+            mgr.wait()
+        mgr.save(4, m, data_state={"position": 4})
+        mgr.wait()
+        mgr._join_digest_thread()
+        names = sorted(os.listdir(os.path.join(d, "data_state")))
+        assert names == ["3.json", "4.json"]
+        mgr.close()
+
+    def test_distributed_marker_records_data_digests(self, tmp_path):
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.integrity import data_state_digest
+        from singa_tpu.resilience.cluster import SoloCluster
+        m, *_ = _mlp()
+        cluster = SoloCluster()
+        mgr = DistributedCheckpointManager(str(tmp_path / "ck"), cluster)
+        st = {"epoch": 0, "position": 20}
+        assert mgr.save(0, m, data_state=st)
+        manifest = mgr.read_manifest(0)
+        assert manifest["data_digests"] == {"0": data_state_digest(st)}
+        mgr2 = DistributedCheckpointManager(str(tmp_path / "ck"),
+                                            SoloCluster())
+        assert mgr2.restore_latest(m) == 1
+        assert mgr2.restored_data_state == st
+        mgr.close()
+        mgr2.close()
+
+    def test_distributed_rejects_sidecar_contradicting_marker(
+            self, tmp_path):
+        """A data sidecar that disagrees with the digest its rank ACKed
+        into the commit marker is a stale/corrupt resume offset: the
+        source is rejected and restore falls back."""
+        from singa_tpu.checkpoint import DistributedCheckpointManager
+        from singa_tpu.resilience.cluster import SoloCluster
+        m, *_ = _mlp()
+        d = str(tmp_path / "ck")
+        mgr = DistributedCheckpointManager(d, SoloCluster())
+        mgr.save(0, m, data_state={"epoch": 0, "position": 8})
+        mgr.save(2, m, data_state={"epoch": 0, "position": 24})
+        # tamper step 2's sidecar CONSISTENTLY (valid digest, wrong
+        # content): only the marker cross-check can catch it
+        mgr._write_data_state(2, {"epoch": 0, "position": 999})
+        mgr2 = DistributedCheckpointManager(d, SoloCluster())
+        with pytest.warns(UserWarning, match="not restorable"):
+            assert mgr2.restore_latest(m) == 1       # fell back to 0
+        assert mgr2.restored_data_state["position"] == 8
+        mgr.close()
+        mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# the trainer: exactly-once through every recovery path
+# ---------------------------------------------------------------------------
+
+def _run_trainer(ck, steps, faults=None, seed=7, log=None, guard=False,
+                 data_seed=3, **kw):
+    from singa_tpu.resilience import ResilientTrainer
+    m, x, y, dev = _mlp(seed, guard=guard)
+    it = _Staged(NumpyBatchIter(x, y, 4, seed=data_seed), dev)
+    tr = ResilientTrainer(m, ck, save_interval_steps=2, verbose=False,
+                          backoff_base=0.001, backoff_cap=0.002,
+                          faults=faults, **kw)
+
+    def cb(step, out):
+        if log is not None:
+            log[step] = np.asarray(it.last_batch_ids).copy()
+
+    try:
+        summary = tr.run(it, num_steps=steps, step_callback=cb)
+    finally:
+        tr.mgr.wait()       # in-process 'crash': reap the async writer
+    return summary, m
+
+
+def _analytic_stream(total, n=32, seed=3):
+    out, e = [], 0
+    while sum(map(len, out)) < total:
+        out.append(epoch_permutation(seed, e, n))
+        e += 1
+    return np.concatenate(out)[:total]
+
+
+class TestTrainerExactlyOnce:
+    def test_fault_free_run_walks_the_analytic_stream(self, tmp_path):
+        """A fault-free trainer consumes exactly the (seed, epoch)-keyed
+        permutation stream — the ground truth the chaos scenario's
+        bit-identity assertions derive their expectations from."""
+        log = {}
+        _run_trainer(str(tmp_path / "ck"), 12, log=log)
+        flat = np.concatenate([log[i] for i in range(12)])
+        np.testing.assert_array_equal(flat, _analytic_stream(48))
+
+    def test_crash_restart_is_bit_identical(self, tmp_path):
+        from singa_tpu.resilience import FaultPlan, SimulatedCrash
+        ref = {}
+        _run_trainer(str(tmp_path / "ref"), 12, log=ref)
+        ck = str(tmp_path / "ck")
+        log = {}
+        with pytest.raises(SimulatedCrash):
+            _run_trainer(ck, 12, log=log,
+                         faults=FaultPlan().crash_after_save(step=6))
+        summary, _ = _run_trainer(ck, 12, seed=99, log=log)
+        assert summary["start"] == 7
+        assert summary["data_resumed"] is True
+        for i in sorted(log):
+            np.testing.assert_array_equal(log[i], ref[i],
+                                          err_msg=f"step {i}")
+        assert set(log) >= set(range(12)) - {6}   # 6 died pre-callback
+
+    def test_preemption_checkpoint_carries_data_state(self, tmp_path):
+        import signal
+        from singa_tpu.resilience import (EXIT_PREEMPTED, FaultPlan,
+                                          ResilientTrainer)
+        ref = {}
+        _run_trainer(str(tmp_path / "ref"), 10, log=ref)
+        ck = str(tmp_path / "ck")
+        log = {}
+        plan = FaultPlan().preempt_at(step=5, sig=signal.SIGTERM)
+        with pytest.raises(SystemExit) as e:
+            _run_trainer(ck, 10, log=log, faults=plan)
+        assert e.value.code == EXIT_PREEMPTED
+        # preempted AFTER step 5 completed (and its callback ran):
+        # the preemption checkpoint is of step 5, resume runs 6..9
+        summary, _ = _run_trainer(ck, 10, seed=42, log=log)
+        assert summary["start"] == 6 and summary["data_resumed"]
+        for i in range(10):
+            np.testing.assert_array_equal(log[i], ref[i],
+                                          err_msg=f"step {i}")
+
+    def test_rollback_rewinds_data_in_lockstep(self, tmp_path):
+        from singa_tpu.resilience import FaultPlan
+        ref = {}
+        _run_trainer(str(tmp_path / "ref"), 12, log=ref)
+        plan = FaultPlan()
+        for s in (5, 6, 7):
+            plan.poison_batch(step=s)
+        log = {}
+        with pytest.warns(UserWarning, match="rolled back"):
+            summary, _ = _run_trainer(str(tmp_path / "ck"), 12,
+                                      log=log, faults=plan, guard=True,
+                                      rollback_after=3, max_rollbacks=2)
+        assert summary["rollbacks"] == 1
+        # the re-run steps consumed the exact batches of the rolled-
+        # back timeline: per-step ids identical to the fault-free run
+        for i in range(12):
+            np.testing.assert_array_equal(log[i], ref[i],
+                                          err_msg=f"step {i}")
+
+    def test_resume_without_data_state_warns(self, tmp_path):
+        """A checkpoint saved before data-state capture (or by a run
+        with a stateless source) resumes with a LOUD warning that
+        exactly-once is not guaranteed."""
+        from singa_tpu.resilience import ResilientTrainer
+        from singa_tpu.tensor import Tensor
+        ck = str(tmp_path / "ck")
+        # train 3 steps with a STATELESS source (plain list): no
+        # data-state sidecars written
+        m2, x, y, dev = _mlp()
+        tx = Tensor(data=x[:4], device=dev, requires_grad=False)
+        ty = Tensor(data=y[:4], device=dev, requires_grad=False)
+        tr2 = ResilientTrainer(m2, ck, save_interval_steps=1,
+                               verbose=False)
+        tr2.run([(tx, ty)], num_steps=3)
+        tr2.close()
+        data_dir = os.path.join(ck, "data_state")
+        assert not os.path.isdir(data_dir) or not os.listdir(data_dir)
+        # now resume with a STATEFUL source: must warn
+        m3, x, y, dev = _mlp(seed=5)
+        it = _Staged(NumpyBatchIter(x, y, 4, seed=3), dev)
+        tr3 = ResilientTrainer(m3, ck, save_interval_steps=1,
+                               verbose=False)
+        with pytest.warns(UserWarning, match="without data-iterator "
+                                             "state"):
+            tr3.run(it, num_steps=4)
+        tr3.close()
+
+    def test_summary_surfaces_quarantined_samples(self, tmp_path):
+        """ImageBatchIter skip records reach the run summary (behind a
+        RetryingIterator too) — skipped bytes are visible, not just
+        warnings that scrolled away."""
+        from singa_tpu.resilience import FaultPlan, ResilientTrainer
+        from singa_tpu.tensor import Tensor
+        m, x, y, dev = _mlp()
+        lst, root = npy_dataset(tmp_path)
+
+        def transform(path):
+            arr = np.load(path)
+            return [np.tile(arr.reshape(-1), 2)[:6]]
+
+        data_plan = FaultPlan().corrupt_sample(1)
+
+        def factory():
+            return ImageBatchIter(lst, 4, transform, shuffle=False,
+                                  image_folder=root, skip_budget=4,
+                                  faults=data_plan)
+
+        class Wrap:
+            def __init__(self):
+                self.ri = RetryingIterator(factory,
+                                           backoff_base=0.0001)
+
+            def __iter__(self):
+                for bx, by in self.ri:
+                    yield (Tensor(data=bx, device=dev,
+                                  requires_grad=False),
+                           Tensor(data=np.eye(4, dtype=np.float32)[
+                               by % 4], device=dev,
+                               requires_grad=False))
+
+            # expose the underlying source for summary attribution
+            @property
+            def _src_obj(self):
+                return self.ri._src_obj
+
+        w = Wrap()
+        tr = ResilientTrainer(m, str(tmp_path / "ck"),
+                              save_interval_steps=100, verbose=False)
+        with pytest.warns(UserWarning, match="skipped 1 corrupt"):
+            summary = tr.run(w, num_steps=2)
+        tr.close()
+        w.ri._src_obj.end()
+        assert summary["data_skipped"] == 1
+        (rec,) = summary["data_quarantined"]
+        assert "s1.npy" in rec["path"]

@@ -242,3 +242,14 @@ class TestClusterChaos:
         'cordon the host' supervisor code, distinct from 75."""
         chaos_smoke.scenario_divergence_quarantine(
             str(tmp_path), chaos_smoke.Budget(240))
+
+    def test_data_resume_exactly_once(self, tmp_path):
+        """The exactly-once data invariant, end to end: a run killed
+        mid-epoch and resumed consumes per-step sample ids
+        BIT-IDENTICAL to a fault-free run's; the stream rewinds through
+        a divergence-quarantine rollback; an elastic world-size change
+        keeps the flattened consumed stream a clean prefix of the
+        global permutation; and a corrupt sample costs exactly one
+        attributed skip, with an exhausted budget failing loudly."""
+        chaos_smoke.scenario_data_resume(
+            str(tmp_path), chaos_smoke.Budget(240))

@@ -23,15 +23,24 @@ harness) against ``examples/train_elastic.py``:
    quarantines the step and rolls back to the last cluster-agreed
    checkpoint, and when the divergence repeats the run exits 76
    (``EXIT_DIVERGED`` — cordon the host, don't just relaunch).
+6. **data-resume** — the exactly-once data invariant: a run killed
+   mid-epoch and resumed consumes a per-step sample-id sequence
+   BIT-IDENTICAL to a fault-free run's; the same invariant holds after
+   a divergence-quarantine rewind (the data stream rolls back with the
+   tensors) and across an elastic world-size change (the flattened
+   consumed stream stays a clean prefix of the global permutation —
+   nothing replayed, nothing skipped); and, in-process, a corrupt
+   sample costs exactly one skipped-and-attributed sample while an
+   exhausted skip budget fails loudly naming the bytes.
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
-smoke is bounded by ``--budget`` seconds end to end (default 300) —
+smoke is bounded by ``--budget`` seconds end to end (default 420) —
 exceeding it is itself a failure: a chaos path that hangs is exactly
 the bug this suite exists to catch.
 
 Usage::
 
-    python tools/chaos_smoke.py [--budget 300] [--keep-dirs]
+    python tools/chaos_smoke.py [--budget 420] [--keep-dirs]
 """
 
 import argparse
@@ -269,16 +278,178 @@ def scenario_divergence_quarantine(root, budget):
            f"(markers: {committed})")
 
 
+def _expected_stream(total, n=64, seed=0):
+    """The analytic global sample stream ``train_elastic.py`` consumes:
+    epoch after epoch of the stateless ``(seed, epoch)``-keyed
+    permutation (``data.epoch_permutation``) over its ``n``-sample
+    synthetic set — exactly what a fault-free run of ANY world size
+    walks in order. ``tests/test_data_resume.py`` pins a live fault-free
+    trainer to this stream, so asserting against it IS asserting
+    bit-identity with a fault-free run."""
+    sys.path.insert(0, REPO)
+    from singa_tpu.data import epoch_permutation
+    out = []
+    epoch = 0
+    while sum(len(p) for p in out) < total:
+        out.append(epoch_permutation(seed, epoch, n))
+        epoch += 1
+    return np.concatenate(out)[:total]
+
+
+def _final_ids(ids_dir):
+    """{step: consumed sample ids} from the per-step npy dumps — the
+    FINAL timeline (re-runs overwrite their step's file)."""
+    out = {}
+    for f in os.listdir(ids_dir):
+        if f.startswith("ids_step") and f.endswith(".npy"):
+            out[int(f[len("ids_step"):-4])] = np.load(
+                os.path.join(ids_dir, f))
+    return out
+
+
+def scenario_data_resume(root, budget):
+    """Exactly-once data pipeline: kill mid-epoch -> resume ->
+    bit-identical per-step sample ids; same invariant through a
+    quarantine rewind and an elastic world-size change; corrupt samples
+    cost one attributed skip each, an exhausted budget fails loudly."""
+    # -- 1. headline: world-1 kill mid-epoch, resume, bit-identical ----
+    d = os.path.join(root, "ck")
+    ids = os.path.join(root, "ids")
+    port = _free_port()
+    rcs, outs = _run([_cmd(0, 1, port, d,
+                           ["--dump-sample-ids", ids, "--die-at", "9",
+                            "--die-rank", "0"], steps=20)], budget)
+    _check(rcs == [1], f"mid-epoch hard kill lands (got {rcs})", outs[0])
+    rcs2, outs2 = _run([_cmd(0, 1, port, d,
+                             ["--dump-sample-ids", ids], steps=20)],
+                       budget)
+    _check(rcs2 == [0], f"resumed run completes (got {rcs2})", outs2[0])
+    _check("data stream rewound" in outs2[0],
+           "resume rewound the data stream to the checkpointed offset",
+           outs2[0])
+    got = _final_ids(ids)
+    stream = _expected_stream(4 * 20)
+    _check(sorted(got) == list(range(20)),
+           f"every step's sample ids dumped (steps: {sorted(got)})")
+    for k in range(20):
+        np.testing.assert_array_equal(
+            got[k], stream[4 * k:4 * (k + 1)], err_msg=f"step {k}")
+    _check(True, "kill->resume: per-step sample ids BIT-IDENTICAL to a "
+                 "fault-free run (all 20 steps)")
+
+    # -- 2. quarantine rewind: the data stream rolls back too ----------
+    d2 = os.path.join(root, "ck2")
+    ids2 = os.path.join(root, "ids2")
+    port = _free_port()
+    fp = ["--fingerprint-every", "3", "--max-divergence-rollbacks", "2"]
+    rcs, outs = _run([
+        _cmd(0, 2, port, d2, fp + ["--dump-sample-ids", ids2], steps=12),
+        _cmd(1, 2, port, d2, fp + ["--diverge-at", "5",
+                                   "--diverge-rank", "1"], steps=12)],
+        budget)
+    _check(rcs == [0, 0],
+           f"single-shot divergence recovers and completes (got {rcs})",
+           outs[0] + outs[1])
+    _check("quarantined diverged step" in outs[0] + outs[1],
+           "the quarantine-rollback path is what ran", outs[1])
+    got = _final_ids(ids2)
+    stream = _expected_stream(8 * 12)
+    for k in range(12):
+        np.testing.assert_array_equal(
+            got[k], stream[8 * k:8 * (k + 1)], err_msg=f"step {k}")
+    _check(True, "quarantine rewind: re-run steps consumed the exact "
+                 "batches of the quarantined timeline")
+
+    # -- 3. elastic world change: the stream stays a clean prefix ------
+    d3 = os.path.join(root, "ck3")
+    ids3 = os.path.join(root, "ids3")
+    port = _free_port()
+    rcs, outs = _run([
+        _cmd(0, 2, port, d3, ["--dump-sample-ids", ids3], steps=12),
+        _cmd(1, 2, port, d3, ["--die-at", "7", "--die-rank", "1"],
+             steps=12)], budget)
+    _check(rcs == [EXIT_PREEMPTED, 1],
+           f"world-2 loses rank 1, survivor exits 75 (got {rcs})",
+           outs[0])
+    rcs2, outs2 = _run([_cmd(0, 1, port, d3,
+                             ["--dump-sample-ids", ids3], steps=12)],
+                       budget)
+    _check(rcs2 == [0] and "elastic restart" in outs2[0],
+           f"world-1 elastic restart completes (got {rcs2})", outs2[0])
+    got = _final_ids(ids3)
+    flat = np.concatenate([got[k] for k in sorted(got)])
+    stream = _expected_stream(len(flat))
+    np.testing.assert_array_equal(flat, stream)
+    _check(len(flat) >= 64 and
+           sorted(flat[:64].tolist()) == list(range(64)),
+           "elastic resume: flattened stream is a clean prefix of the "
+           f"global permutation ({len(flat)} samples, epoch 0 consumed "
+           "exactly once)")
+
+    # -- 4. corrupt samples: one attributed skip each, bounded ---------
+    sys.path.insert(0, REPO)
+    from singa_tpu.data import DataSampleError, ImageBatchIter
+    from singa_tpu.resilience.faults import FaultPlan
+    sdir = os.path.join(root, "samples")
+    os.makedirs(sdir)
+    for i in range(12):
+        np.save(os.path.join(sdir, f"s{i}.npy"),
+                np.full((2, 2), i, np.float32))
+    lst = os.path.join(sdir, "list.txt")
+    with open(lst, "w") as f:
+        for i in range(12):
+            f.write(f"s{i}.npy {i % 3}\n")
+
+    def transform(path):
+        return [np.load(path)]
+
+    import warnings as _w
+    it = ImageBatchIter(lst, 4, transform, shuffle=False,
+                        image_folder=sdir, skip_budget=2,
+                        faults=FaultPlan().corrupt_sample(1))
+    it.start()
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        batches = [next(it) for _ in range(3)]
+    it.end()
+    consumed = np.concatenate([b[1] for b in batches])
+    _check(len(consumed) == 11 and it.skip_count == 1
+           and it.quarantined[0]["index"] == 1
+           and "s1.npy" in it.quarantined[0]["path"],
+           "a corrupt sample costs exactly one skipped sample, "
+           f"attributed ({it.quarantined[0]['path']})")
+
+    it = ImageBatchIter(lst, 4, transform, shuffle=False,
+                        image_folder=sdir, skip_budget=1,
+                        faults=FaultPlan().corrupt_sample(0, times=3))
+    it.start()
+    try:
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            while True:
+                next(it)
+    except DataSampleError as e:
+        _check("skip budget exhausted" in str(e)
+               and e.sample is not None,
+               f"exhausted skip budget fails LOUDLY, naming the bytes "
+               f"({e.sample['path']})")
+    else:
+        _check(False, "skip budget exhaustion did not raise")
+    finally:
+        it.end()
+
+
 SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("commit-hole", scenario_commit_hole),
              ("barrier-missing", scenario_barrier_missing),
              ("bitflip-restore", scenario_bitflip_restore),
-             ("divergence-quarantine", scenario_divergence_quarantine)]
+             ("divergence-quarantine", scenario_divergence_quarantine),
+             ("data-resume", scenario_data_resume)]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--budget", type=float, default=300.0,
+    ap.add_argument("--budget", type=float, default=420.0,
                     help="hard wall-clock budget in seconds for the "
                          "WHOLE smoke")
     ap.add_argument("--keep-dirs", action="store_true")

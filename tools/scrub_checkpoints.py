@@ -19,6 +19,13 @@ steps — without demotion a corrupt newest step would let
 are NEVER deleted here: a marker whose local shard is corrupt may
 still be restorable from a peer's shard.
 
+Data-state sidecars (``data_state/<step>.json`` — the checkpointable
+data pipeline's resume offsets, digest-guarded like everything else)
+are verified alongside the tensor digests: a step whose resume offset
+fails its digest is flagged ``corrupt`` exactly like flipped tensor
+bytes, because restoring it would silently break the exactly-once
+sample-stream contract.
+
 Exit code: 0 when every verified step is clean, 1 when anything is
 corrupt/unreadable (cron-able: page on nonzero).
 
