@@ -55,13 +55,11 @@ def _lm_train_flops_per_token(d, n_layers, seq, vocab, ff_mult=4,
 # the bench LM's shape — single source for _measure_lm and the MFU math
 LM_SHAPE = {"d_model": 512, "n_layers": 6, "seq": 1024, "vocab": 32000}
 
-# Peak dense fp32/bf16 FLOP/s per chip by TPU generation (public figures),
-# for the MFU estimate. Overridable via BENCH_PEAK_TFLOPS.
-PEAK_FLOPS_BY_KIND = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
-    ("v5lite", 197e12), ("v5", 459e12), ("v4", 275e12), ("v3", 123e12),
-    ("v2", 45e12),
-]
+# Peak dense fp32/bf16 FLOP/s per chip by TPU generation, for the MFU
+# estimate. Overridable via BENCH_PEAK_TFLOPS. The table itself is
+# canonical in singa_tpu.observability.metrics (the trainer's train_mfu
+# gauge reads the same numbers); _peak_flops below adds the env
+# overrides and the fp32-denominator labeling.
 
 
 def _peak_flops(device_kind: str, dtype: str = "bf16"):
@@ -82,11 +80,8 @@ def _peak_flops(device_kind: str, dtype: str = "bf16"):
     env = os.environ.get("BENCH_PEAK_TFLOPS")
     if env:
         return float(env) * 1e12
-    kind = (device_kind or "").lower()
-    for tag, peak in PEAK_FLOPS_BY_KIND:
-        if tag in kind:
-            return peak
-    return None
+    from singa_tpu.observability.metrics import device_peak_flops
+    return device_peak_flops(device_kind)
 
 
 # per-leg SUCCESS markers for the extra hardware probes
@@ -299,11 +294,33 @@ def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
         out, loss = model(tx, ty)
         return loss
 
+    step.model = model   # probes read cost analysis off the same program
     return step
 
 
+def _xla_step_flops(model):
+    """Per-step FLOPs from XLA's cost analysis of the JUST-MEASURED
+    compiled program (``Model.step_flops``) — the numerator of the
+    measured-not-modeled MFU every banked leg reports alongside the
+    analytic one. Costs one AOT re-lower of the already-compiled
+    signature (cheap with the persistent compile cache warm); disable
+    with BENCH_XLA_MFU=0. Returns None on any failure — the analytic
+    MFU still stands."""
+    if os.environ.get("BENCH_XLA_MFU", "1") == "0":
+        return None
+    try:
+        return model.step_flops(compute=True)
+    except Exception as e:   # noqa: BLE001 — telemetry, never a blocker
+        print(f"bench: xla step-flops unavailable ({e})", file=sys.stderr)
+        return None
+
+
 def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
-             layout="NCHW", stem=None):
+             layout="NCHW", stem=None, extras=None):
+    """Returns (images/sec, step_ms); when the caller passes an
+    ``extras`` dict, ``xla_flops_per_step`` is recorded into it (an
+    out-param so the 2-tuple shape external probes consume stays
+    stable)."""
     step = _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
                               layout=layout, stem=stem)
     loss = None
@@ -313,6 +330,8 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
 
     dt = _slope_time(step, lambda l: l.data,
                      max(1, niters // 4), niters)
+    if extras is not None:
+        extras["xla_flops_per_step"] = _xla_step_flops(step.model)
     return batch / dt, dt * 1e3
 
 
@@ -373,15 +392,27 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     layout, layout_src = _conv_layout()
     stem, stem_src = _resnet_stem()
 
+    def _mfu_xla(flops_per_step, rate, units_per_step, peak_flops):
+        """achieved/peak from XLA-counted per-step flops + the measured
+        rate (units/s ÷ units/step = steps/s) — the measured-not-modeled
+        MFU each leg banks beside its analytic estimate."""
+        if not (flops_per_step and peak_flops and units_per_step):
+            return None
+        return flops_per_step * rate / units_per_step / peak_flops
+
+    fp32_extras = {}
     throughput, step_ms = _leg_guard(
         lambda: _measure(dev, batch, niters, warmup, image_size,
-                         depth, "float32", layout=layout, stem=stem),
+                         depth, "float32", layout=layout, stem=stem,
+                         extras=fp32_extras),
         leg_budget, "fp32")
     res = {
         "throughput": throughput,
         "step_ms": step_ms,
         "mfu": (throughput * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak32
                 if peak32 else None),
+        "mfu_xla": _mfu_xla(fp32_extras.get("xla_flops_per_step"),
+                            throughput, batch, peak32),
         # per-dtype denominator honesty: the fp32 leg's MFU is a
         # fraction of the chip's (bf16) matmul peak unless a distinct
         # denominator was supplied — see _peak_flops. Only labeled when
@@ -413,15 +444,18 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         leg_dtype, bf16_mode = _bf16_leg_dtype()
         res["bf16_mode"] = bf16_mode
         try:
+            bf16_extras = {}
             bt, bs = _leg_guard(
                 lambda: _measure(dev, batch, niters, warmup, image_size,
                                  depth, leg_dtype, layout=layout,
-                                 stem=stem),
+                                 stem=stem, extras=bf16_extras),
                 leg_budget, "bf16")
             res["bf16_throughput"] = bt
             res["bf16_step_ms"] = bs
             if peak:
                 res["bf16_mfu"] = bt * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
+            res["bf16_mfu_xla"] = _mfu_xla(
+                bf16_extras.get("xla_flops_per_step"), bt, batch, peak)
         except TimeoutError as e:
             # the zombie leg thread may still hold the chip: stop here —
             # a later leg timed against it would bank a lie
@@ -439,11 +473,17 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             LM_SHAPE["d_model"], LM_SHAPE["n_layers"], LM_SHAPE["seq"],
             LM_SHAPE["vocab"])
         try:
+            lm_extras = {}
             res["lm_tokens_per_sec"] = _leg_guard(
-                lambda: _measure_lm(dev), leg_budget, "lm")
+                lambda: _measure_lm(dev, extras=lm_extras),
+                leg_budget, "lm")
             if peak:
                 res["lm_mfu"] = \
                     res["lm_tokens_per_sec"] * lm_flops / peak
+            res["lm_mfu_xla"] = _mfu_xla(
+                lm_extras.get("xla_flops_per_step"),
+                res["lm_tokens_per_sec"],
+                lm_extras.get("tokens_per_step"), peak)
             # what the LM leg measured: fused-CE-head or full-logits
             # path — without this marker, banked numbers from different
             # modes would read as perf changes between rounds
@@ -462,12 +502,18 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         # the LM counterpart of the CNN bf16 leg
         if os.environ.get("BENCH_LM_BF16", "1") != "0":
             try:
+                lmb_extras = {}
                 res["lm_bf16_tokens_per_sec"] = _leg_guard(
-                    lambda: _measure_lm(dev, compute_dtype="bfloat16"),
+                    lambda: _measure_lm(dev, compute_dtype="bfloat16",
+                                        extras=lmb_extras),
                     leg_budget, "lm_bf16")
                 if peak:
                     res["lm_bf16_mfu"] = \
                         res["lm_bf16_tokens_per_sec"] * lm_flops / peak
+                res["lm_bf16_mfu_xla"] = _mfu_xla(
+                    lmb_extras.get("xla_flops_per_step"),
+                    res["lm_bf16_tokens_per_sec"],
+                    lmb_extras.get("tokens_per_step"), peak)
             except TimeoutError as e:
                 res["lm_bf16_error"] = str(e)[:200]
                 res["leg_timeout"] = "lm_bf16"
@@ -513,11 +559,12 @@ def _setup_lm_step(dev, batch=8, seq=None, compute_dtype=None):
         _, loss = m(ti, tt)
         return loss
 
+    step.model = m       # probes read cost analysis off the same program
     return step
 
 
 def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
-                compute_dtype=None):
+                compute_dtype=None, extras=None):
     seq = seq or LM_SHAPE["seq"]
     step = _setup_lm_step(dev, batch=batch, seq=seq,
                           compute_dtype=compute_dtype)
@@ -528,6 +575,9 @@ def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
 
     dt = _slope_time(step, lambda l: l.data,
                      max(1, niters // 4), niters)
+    if extras is not None:
+        extras["xla_flops_per_step"] = _xla_step_flops(step.model)
+        extras["tokens_per_step"] = batch * seq
     return batch * seq / dt
 
 
@@ -1120,11 +1170,13 @@ def _emit_report(res, live, smoke, obs, errors):
     # round artifact records the full picture (MFU, bf16 leg, LM
     # tokens/s, timing method, partial/suspect flags), not just the
     # headline images/sec
-    for k in ("mfu", "mfu_denominator", "conv_layout", "conv_layout_src",
-              "resnet_stem", "resnet_stem_src", "git",
-              "bf16_throughput", "bf16_step_ms", "bf16_mfu", "bf16_mode",
+    for k in ("mfu", "mfu_xla", "mfu_denominator", "conv_layout",
+              "conv_layout_src", "resnet_stem", "resnet_stem_src", "git",
+              "bf16_throughput", "bf16_step_ms", "bf16_mfu",
+              "bf16_mfu_xla", "bf16_mode",
               "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",
-              "lm_mfu", "lm_bf16_mfu", "lm_error", "lm_bf16_error",
+              "lm_mfu", "lm_mfu_xla", "lm_bf16_mfu", "lm_bf16_mfu_xla",
+              "lm_error", "lm_bf16_error",
               "lm_fused_head", "timing", "timing_suspect",
               "partial", "partial_timeout", "partial_crash",
               "leg_timeout"):

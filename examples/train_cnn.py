@@ -101,6 +101,11 @@ def build_parser():
                          "space-to-depth reformulation")
     ap.add_argument("--npz", default=None,
                     help="npz with arrays x,y (overrides the data arg)")
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="write run telemetry into DIR: spans.jsonl "
+                         "(live trace spans), metrics.json (registry "
+                         "snapshot; feed to tools/metrics_dump.py) and "
+                         "metrics.prom (Prometheus text)")
     ap.add_argument("--resilient", action="store_true",
                     help="train through the fault-tolerant driver "
                          "(checkpoint-restart + NaN guards + retry)")
@@ -109,6 +114,35 @@ def build_parser():
     ap.add_argument("--save-every", type=int, default=50,
                     help="checkpoint interval (steps) for --resilient")
     return ap
+
+
+def _dump_telemetry(args, model):
+    """End-of-run telemetry dump for --telemetry DIR: the metrics
+    snapshot as JSON (the form tools/metrics_dump.py validates and
+    converts) plus its Prometheus rendering; spans.jsonl has been
+    streaming live since startup."""
+    if not args.telemetry:
+        return
+    import json
+
+    from singa_tpu.observability import export, metrics
+    try:
+        # enrich the snapshot with the step's XLA flop count (one AOT
+        # re-lower, end of run — never on the step path)
+        flops = model.step_flops(compute=True)
+        if flops:
+            metrics.default_registry().gauge(
+                "train_step_flops",
+                "XLA-counted FLOPs of one compiled step").set(flops)
+    except Exception:
+        pass
+    snap = metrics.default_registry().snapshot()
+    with open(f"{args.telemetry}/metrics.json", "w") as f:
+        json.dump(snap, f)
+    with open(f"{args.telemetry}/metrics.prom", "w") as f:
+        f.write(export.render_prometheus(snap))
+    print(f"telemetry written to {args.telemetry} "
+          "(spans.jsonl, metrics.json, metrics.prom)", flush=True)
 
 
 def main():
@@ -120,6 +154,13 @@ def main():
 
     from singa_tpu import datasets, device, metric, opt, tensor
     from singa_tpu import models
+
+    if args.telemetry:
+        import os
+
+        from singa_tpu.observability import spans as obs_spans
+        os.makedirs(args.telemetry, exist_ok=True)
+        obs_spans.configure(jsonl_path=f"{args.telemetry}/spans.jsonl")
 
     dev = device.create_cpu_device() if args.cpu \
         else device.create_tpu_device()
@@ -293,8 +334,13 @@ def main():
             print(f"Evaluation accuracy = {np.mean(vaccs):.6f}",
                   flush=True)
         dev.PrintTimeProfiling()
+        _dump_telemetry(args, model)
         return
 
+    from singa_tpu.observability import metrics as obs_metrics
+    from singa_tpu.observability import spans as obs_spans
+    m_step = obs_metrics.default_registry().histogram(
+        "train_step_seconds", "wall-clock duration of one step")
     rng = np.random.RandomState(1)
     for epoch in range(args.epochs):
         if rank == 0:
@@ -310,10 +356,14 @@ def main():
             tbx = stage(bx)
             tby = tensor.Tensor(data=eye[train_y[sel]], device=dev,
                                 requires_grad=False)
-            if args.dist and args.dist_option != "plain":
-                out, loss = model(tbx, tby, args.dist_option, args.spars)
-            else:
-                out, loss = model(tbx, tby)
+            ts = time.perf_counter()
+            with obs_spans.span("step", step=epoch * n_train + b):
+                if args.dist and args.dist_option != "plain":
+                    out, loss = model(tbx, tby, args.dist_option,
+                                      args.spars)
+                else:
+                    out, loss = model(tbx, tby)
+            m_step.observe(time.perf_counter() - ts)
             losses.append(float(loss.data))
             accs.append(acc.evaluate(out, train_y[sel]))
         if rank == 0:
@@ -332,6 +382,7 @@ def main():
                   f"Elapsed Time = {time.time() - t0:.3f}s", flush=True)
 
     dev.PrintTimeProfiling()
+    _dump_telemetry(args, model)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import warnings
 
 import numpy as np
@@ -37,6 +38,25 @@ import jax.numpy as jnp
 from .integrity import (IntegrityError, data_state_digest, digest_tree,
                         manifest_digest, read_digest_sidecar,
                         verify_tree, write_digest_sidecar)
+from .observability import metrics as _obs_metrics
+
+
+def _obs_restore_done(t0, fallback_depth):
+    """Shared restore accounting for both manager flavours: duration,
+    and how many newer steps had to be skipped to find a restorable one
+    (``fallback_depth`` > 0 means work was lost — the gauge a dashboard
+    alarms on)."""
+    reg = _obs_metrics.default_registry()
+    reg.histogram("checkpoint_restore_seconds",
+                  "restore_latest wall-clock, fallbacks included"
+                  ).observe(time.perf_counter() - t0)
+    reg.gauge("checkpoint_restore_fallback_depth",
+              "newer unrestorable steps skipped by the latest restore"
+              ).set(fallback_depth)
+    if fallback_depth:
+        reg.counter("checkpoint_restore_fallbacks_total",
+                    "corrupt/incomplete steps skipped across restores"
+                    ).inc(fallback_depth)
 
 
 def _state_tensor_dict(model):
@@ -414,6 +434,7 @@ class CheckpointManager:
         return expected
 
     def save(self, step, model, force=False, data_state=None):
+        t0 = time.perf_counter()
         # one outstanding digest worker, like orbax's one outstanding
         # write — and joined BEFORE the next orbax save so the worker's
         # all_steps()-based sidecar pruning never overlaps a write
@@ -423,6 +444,14 @@ class CheckpointManager:
                                args=self._ocp.args.StandardSave(arrays),
                                force=force)
         if saved:
+            reg = _obs_metrics.default_registry()
+            reg.counter("checkpoint_saves_total",
+                        "checkpoint saves actually started").inc()
+            # host-side dispatch cost only — the write itself is async;
+            # DistributedCheckpointManager.save adds the commit wait
+            reg.histogram("checkpoint_save_seconds",
+                          "host-side save dispatch (async write "
+                          "excluded)").observe(time.perf_counter() - t0)
             # the data-iterator state rides every save (tiny JSON,
             # synchronous + atomic): on ANY restore of this step the
             # sample stream rewinds in lockstep with the tensors
@@ -516,6 +545,7 @@ class CheckpointManager:
         arrays in the live tensors; the succeeding attempt overwrites
         every entry, so the model never trains on a half-restored mix.)
         """
+        t0 = time.perf_counter()
         self._join_digest_thread()
         self.restored_data_state = None
         steps = sorted(self._mgr.all_steps(), reverse=True)
@@ -547,6 +577,7 @@ class CheckpointManager:
                     shutil.rmtree(os.path.join(self._dir, str(bad_step)),
                                   ignore_errors=True)
                 self._reopen()
+            _obs_restore_done(t0, i)
             return step + 1
         if steps:
             warnings.warn(
@@ -561,6 +592,7 @@ class CheckpointManager:
                 shutil.rmtree(os.path.join(self._dir, str(bad_step)),
                               ignore_errors=True)
             self._reopen()
+        _obs_restore_done(t0, len(steps))
         return 0
 
     def scrub(self, delete=False):
@@ -584,6 +616,7 @@ class CheckpointManager:
         # wait_until_finished) so a healthy in-flight step is never
         # reported, or demoted, as corrupt
         self.wait()
+        scrub_t0 = time.perf_counter()
         report = {}
         for step in self.all_steps():
             if not os.path.isdir(os.path.join(self._dir, str(step))):
@@ -651,6 +684,14 @@ class CheckpointManager:
                     f"{demoted} so rotation keeps only verified steps",
                     stacklevel=2)
                 self._reopen()
+        reg = _obs_metrics.default_registry()
+        reg.histogram("checkpoint_scrub_seconds",
+                      "one at-rest verification pass"
+                      ).observe(time.perf_counter() - scrub_t0)
+        reg.gauge("checkpoint_scrub_bad",
+                  "corrupt/unreadable steps found by the newest scrub"
+                  ).set(sum(1 for s in report.values()
+                            if s in ("corrupt", "unreadable")))
         return report
 
     def start_scrubber(self, interval=3600.0):
@@ -924,6 +965,10 @@ class DistributedCheckpointManager(CheckpointManager):
             else float(commit_timeout)
         ok = self.cluster.wait_commit(step, timeout=timeout)
         if not ok:
+            _obs_metrics.default_registry().counter(
+                "checkpoint_commit_failures_total",
+                "two-phase saves that never gained a commit marker"
+            ).inc()
             warnings.warn(
                 f"checkpoint step {step}: commit did not complete within "
                 f"{timeout:.0f}s (a rank died before its ACK"
@@ -996,6 +1041,7 @@ class DistributedCheckpointManager(CheckpointManager):
         carries the marker's manifest (saved world size + batch extras)
         for the elastic-resume accounting."""
         import shutil
+        t0 = time.perf_counter()
         self._join_digest_thread()
         self.restored_manifest = None
         self.restored_data_state = None
@@ -1060,6 +1106,7 @@ class DistributedCheckpointManager(CheckpointManager):
                     self._reopen()
             self.restored_manifest = manifest
             self.restored_data_state = self._restored_data_state
+            _obs_restore_done(t0, i)
             if int(manifest.get("world", self.cluster.world)) != \
                     self.cluster.world:
                 warnings.warn(
@@ -1084,4 +1131,5 @@ class DistributedCheckpointManager(CheckpointManager):
             # trainer's resume barrier; markers whose shards rotate
             # away are pruned by _publish_commit.
             self._reopen()
+        _obs_restore_done(t0, len(committed))
         return 0

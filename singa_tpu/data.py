@@ -37,6 +37,14 @@ from threading import Thread
 
 import numpy as np
 
+from .observability import metrics as _obs_metrics
+
+
+def _quarantine_counter():
+    return _obs_metrics.default_registry().counter(
+        "data_quarantined_total",
+        "corrupt samples skipped and attributed by the pipeline")
+
 
 def epoch_permutation(seed, epoch, n):
     """The stateless shuffle every checkpointable iterator shares: the
@@ -270,6 +278,7 @@ class ImageBatchIter:
             self.skip_count = int(item["skip_count"])
             if item["skipped"]:
                 self.quarantined.extend(item["skipped"])
+                _quarantine_counter().inc(len(item["skipped"]))
                 first = item["skipped"][0]
                 warnings.warn(
                     f"ImageBatchIter: skipped {len(item['skipped'])} "
@@ -583,10 +592,17 @@ class RetryingIterator:
                                           self.backoff_cap, self.jitter,
                                           self._rng))
                 self.retries += 1
+                _obs_metrics.default_registry().counter(
+                    "data_retries_total",
+                    "transient data-source failures retried").inc()
                 attempt += 1
                 if self._factory is not None:
                     self._it = None     # rebuild a (likely dead) source
                     self.rebuilds += 1
+                    _obs_metrics.default_registry().counter(
+                        "data_rebuilds_total",
+                        "factory data sources rebuilt after failure"
+                    ).inc()
                 else:
                     failed = e
             else:

@@ -305,6 +305,20 @@ class Model(Layer):
         ``Policy(name, loss_scaling=False)`` or pre-wrap yourself to
         opt out)."""
         assert len(inputs) > 0
+        from .observability import metrics as _obs_metrics
+        from .observability import spans as _obs_spans
+        t0 = time.perf_counter()
+        with _obs_spans.span("compile", policy=str(policy)):
+            self._compile_body(inputs, is_train, use_graph, sequential,
+                              policy)
+        _obs_metrics.default_registry().histogram(
+            "model_compile_seconds",
+            "Model.compile wall-clock (dry run + shape inference; the "
+            "XLA trace/compile itself lands on the first step)"
+        ).observe(time.perf_counter() - t0)
+
+    def _compile_body(self, inputs, is_train, use_graph, sequential,
+                      policy):
         from . import mixed_precision as mp
         new_policy = mp.resolve(policy)
         if new_policy != getattr(self, "_policy", None):
@@ -717,6 +731,10 @@ class Model(Layer):
                             [_aval(a) for a in input_arrays])
             rec["avals_key"] = shapes_key
             rec.pop("audit_compiled", None)
+            # the cached cost analysis and FLOP count described the old
+            # program — recompute against the new signature on next use
+            rec.pop("step_flops", None)
+            rec.pop("cost", None)
         if self.dev.verbosity >= 2 and "cost" not in rec:
             # one-time XLA cost analysis of this step signature (the
             # compiled-world per-op metric: flops / bytes, reference
@@ -1147,11 +1165,102 @@ class Model(Layer):
             int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
             for a in state_avals)
         donated = getattr(ma, "alias_size_in_bytes", None)
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:       # cost analysis is backend-best-effort
+            cost = None
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
         return {"memory_analysis": ma, "donated_bytes": donated,
                 "state_bytes": state_bytes, "hlo": hlo,
+                "cost_analysis": cost,
                 "n_traces": rec.get("n_traces"),
                 "policy": self._policy.describe()
                 if getattr(self, "_policy", None) is not None else None}
+
+    def step_flops(self, compute=True):
+        """FLOPs of one compiled training step, from XLA's cost
+        analysis of the signature that last ran — the numerator of an
+        honest MFU (``flops / step_seconds / chip_peak``), derived from
+        the program actually executing rather than an analytic model.
+
+        ``compute=False`` only consults an ALREADY-CACHED analysis
+        (the verbosity>=2 path, a prior ``compiled_step_info()`` /
+        ``step_flops()`` call) and returns None otherwise — the form
+        the resilient trainer uses so MFU telemetry never pays a
+        re-lower on the step path. Returns None when no step has
+        compiled or the backend reports no flops."""
+        rec = getattr(self, "_last_run_rec", None)
+        if rec is None or rec.get("jit") is None or "avals" not in rec:
+            rec = next((r for r in self._steps.values()
+                        if r.get("jit") is not None and "avals" in r),
+                       None)
+        if rec is None:
+            return None
+        if "step_flops" in rec:
+            return rec["step_flops"]
+        cost = rec.get("cost")              # verbosity>=2 capture
+        compiled = rec.get("audit_compiled")
+        if cost is None:
+            if compiled is None:
+                if not compute:
+                    return None             # nothing cached; stay cheap
+                fn = rec["jit"]
+                state_avals, rng_aval, in_avals = rec["avals"]
+                try:
+                    compiled = fn.lower(state_avals, rng_aval,
+                                        *in_avals).compile() \
+                        if hasattr(fn, "lower") else fn
+                    rec["audit_compiled"] = compiled
+                except Exception:
+                    rec["step_flops"] = None
+                    return None
+            try:
+                cost = compiled.cost_analysis()
+            except Exception:
+                cost = None
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        flops = None
+        if isinstance(cost, dict):
+            f = cost.get("flops")
+            if f and f > 0:
+                flops = float(f)
+        rec["step_flops"] = flops
+        return flops
+
+    def profile_step(self, *args):
+        """Run ONE training step under a ``jax.profiler`` trace and
+        return ``(result, {fusion_name: (count, total_seconds)})`` —
+        the measured per-fusion decomposition of the compiled step
+        (reference per-node timing, scheduler.cc:240-298), on demand
+        instead of only at device verbosity>=2. Rows are recorded into
+        the metrics registry (``profile_fusion_seconds``/``_count``
+        gauges) and folded into ``dev.time_profiling`` like the
+        verbosity path's rows. Call with the same args as a training
+        step; profiler failures degrade to an empty table
+        (:func:`singa_tpu.profiling.measure_step_fusions`)."""
+        from . import profiling as _prof
+        from .utils import force_completion
+
+        def run_once():
+            res = self(*args)
+            # the trace must outlive the device work (see the
+            # verbosity>=2 path): block on true completion of the raw
+            # output arrays (Tensors are not jax pytree leaves)
+            leaves = []
+            _flatten(res, leaves)
+            force_completion(leaves)
+            return res
+
+        result, table = _prof.measure_step_fusions(run_once)
+        _prof.record_fusion_metrics(table)
+        for name, (cnt, tot) in table.items():
+            c0, t0 = self.dev.time_profiling.get(
+                f"fusion/{name}", (0, 0.0))
+            self.dev.time_profiling[f"fusion/{name}"] = (c0 + cnt,
+                                                         t0 + tot)
+        return result, table
 
     def save_states(self, fpath, aux_states={}):  # noqa: B006 (parity)
         """Zip of params+states .npz and an attribute JSON, including

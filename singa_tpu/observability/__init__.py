@@ -1,0 +1,33 @@
+"""Unified telemetry for the training runtime.
+
+Three cooperating pieces (see each module's docstring):
+
+- :mod:`.metrics` — the process-wide metrics registry (counters, gauges,
+  histograms with labels), snapshot-first serialization, heartbeat
+  summaries and the fleet aggregation the coordinator publishes.
+- :mod:`.spans` — nested wall-clock trace spans (``compile``, ``step``,
+  ``checkpoint.save``, ``restore``, ``barrier``, ``data.next``) with
+  run/rank/step attribution, and the bounded flight-recorder ring the
+  resilient trainer dumps to ``telemetry/blackbox-<rank>.jsonl`` on
+  every abnormal exit path.
+- :mod:`.export` — Prometheus-text rendering, snapshot schema
+  validation, and the optional localhost HTTP endpoint. The
+  ``tools/metrics_dump.py`` CLI drives these.
+
+Host-side only: nothing here imports jax or runs inside a compiled
+step — ``compiled_step_info()["n_traces"]`` stays 1 with telemetry on,
+and per-step instrumentation cost is microseconds (both pinned by
+``tests/test_observability.py``).
+"""
+
+from . import metrics     # noqa: F401
+from . import spans       # noqa: F401
+from . import export      # noqa: F401
+
+from .metrics import (MetricsRegistry, default_registry,  # noqa: F401
+                      heartbeat_summary, aggregate_summaries,
+                      device_peak_flops)
+from .spans import (FlightRecorder, span, event, context,  # noqa: F401
+                    recorder, configure)
+from .export import (render_prometheus, validate_snapshot,  # noqa: F401
+                     serve_metrics)

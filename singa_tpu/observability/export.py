@@ -1,0 +1,142 @@
+"""Exporters over metric snapshots: Prometheus text, schema validation,
+and an optional localhost HTTP endpoint.
+
+Everything renders from the SNAPSHOT dict (``MetricsRegistry.snapshot``,
+schema ``singa-tpu-metrics/1``), never from live registry internals — so
+``tools/metrics_dump.py`` can convert a metrics.json written by a dead
+run exactly like a live scrape, and the HTTP endpoint is a thin loop
+around ``registry.snapshot()``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from .metrics import SNAPSHOT_SCHEMA, default_registry
+
+
+def _prom_escape(v):
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _labels_text(labels, extra=None):
+    items = list((labels or {}).items()) + list((extra or {}).items())
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def render_prometheus(snapshot):
+    """Prometheus exposition text for one snapshot dict."""
+    validate_snapshot(snapshot)
+    lines = []
+    for m in snapshot["metrics"]:
+        name, kind = m["name"], m["kind"]
+        if m.get("help"):
+            lines.append(f"# HELP {name} {_prom_escape(m['help'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for s in m["series"]:
+            labels = s.get("labels") or {}
+            if kind == "histogram":
+                for le, c in s["buckets"]:
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels_text(labels, {'le': le})} {c}")
+                lines.append(f"{name}_sum{_labels_text(labels)} "
+                             f"{s['sum']}")
+                lines.append(f"{name}_count{_labels_text(labels)} "
+                             f"{s['count']}")
+            else:
+                lines.append(f"{name}{_labels_text(labels)} "
+                             f"{s['value']}")
+    return "\n".join(lines) + "\n"
+
+
+def validate_snapshot(doc):
+    """Structural check of a snapshot dict (the CLI selftest's and any
+    snapshot reader's gate). Raises ValueError naming the first problem;
+    returns the doc for chaining."""
+    if not isinstance(doc, dict):
+        raise ValueError("snapshot is not a dict")
+    if doc.get("schema") != SNAPSHOT_SCHEMA:
+        raise ValueError(
+            f"snapshot schema {doc.get('schema')!r} is not "
+            f"{SNAPSHOT_SCHEMA!r}")
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, list):
+        raise ValueError("snapshot.metrics is not a list")
+    for m in metrics:
+        name = m.get("name")
+        if not name or not isinstance(name, str):
+            raise ValueError("metric without a name")
+        if m.get("kind") not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"metric {name}: unknown kind {m.get('kind')!r}")
+        if not isinstance(m.get("series"), list):
+            raise ValueError(f"metric {name}: series is not a list")
+        for s in m["series"]:
+            if not isinstance(s.get("labels", {}), dict):
+                raise ValueError(f"metric {name}: series labels not a dict")
+            if m["kind"] == "histogram":
+                for field in ("count", "sum", "buckets"):
+                    if field not in s:
+                        raise ValueError(
+                            f"metric {name}: histogram series missing "
+                            f"{field!r}")
+                counts = [c for _le, c in s["buckets"]]
+                if counts != sorted(counts):
+                    raise ValueError(
+                        f"metric {name}: bucket counts not cumulative")
+                if counts and counts[-1] != s["count"]:
+                    raise ValueError(
+                        f"metric {name}: +Inf bucket {counts[-1]} != "
+                        f"count {s['count']}")
+            elif "value" not in s:
+                raise ValueError(f"metric {name}: series missing value")
+    return doc
+
+
+def serve_metrics(registry=None, host="127.0.0.1", port=0):
+    """Start a daemon-thread HTTP endpoint serving the live registry:
+    ``/metrics`` (Prometheus text) and ``/metrics.json`` (snapshot).
+    Returns ``(server, port)``; ``server.shutdown()`` stops it. Binds
+    localhost by default — this is a debugging/scrape endpoint, not a
+    public service."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else default_registry()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                if self.path.startswith("/metrics.json"):
+                    body = json.dumps(reg.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = render_prometheus(reg.snapshot()).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_error(404)
+                    return
+            except Exception as e:      # a scrape must not crash the job
+                self.send_error(500, str(e)[:100])
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):      # silence per-request stderr spam
+            pass
+
+    server = ThreadingHTTPServer((host, int(port)), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="metrics-http")
+    t.start()
+    return server, server.server_address[1]
+
+
+__all__ = ["render_prometheus", "validate_snapshot", "serve_metrics"]

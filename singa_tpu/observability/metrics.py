@@ -1,0 +1,347 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The reference scheduler prints MEASURED per-node accounting of the graph
+it actually runs (src/core/scheduler/scheduler.cc:240-298); a production
+TPU job needs the same honesty one level up — step time, throughput,
+MFU, guard skips, checkpoint/restore durations, cluster health — in ONE
+place every layer reports through, instead of per-module print
+statements that scroll away.
+
+Design constraints (why this is not a prometheus_client dependency):
+
+- **Host-side only, never inside jit.** Every operation here is a dict
+  update under a lock — a few microseconds. Nothing in this module may
+  import jax or touch device values; callers hand in plain floats they
+  already had (the retrace-guard CI pin ``n_traces == 1`` stays the
+  step-path invariant).
+- **Snapshot-first.** ``MetricsRegistry.snapshot()`` is the canonical
+  serialized form (a JSON-able dict, schema ``singa-tpu-metrics/1``);
+  the Prometheus text rendering and the CLI/HTTP exporters
+  (:mod:`.export`, ``tools/metrics_dump.py``) all work from snapshots,
+  so a metrics file written at the end of a run is exactly as
+  exportable as a live registry.
+- **Get-or-create.** ``registry.counter(name)`` returns the existing
+  series on repeat calls (kind-checked), so instrumented layers never
+  need to coordinate creation order.
+
+Usage::
+
+    from singa_tpu.observability import metrics
+    reg = metrics.default_registry()
+    reg.counter("train_steps_total", "completed training steps").inc()
+    reg.histogram("train_step_seconds").observe(dt)
+    doc = reg.snapshot()             # JSON-able
+    text = reg.to_prometheus()       # exposition text
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+SNAPSHOT_SCHEMA = "singa-tpu-metrics/1"
+
+# Default histogram buckets, tuned for wall-clock seconds spanning a
+# sub-millisecond metric op to a minutes-long restore (the upper +inf
+# bucket is implicit).
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                   120.0, 300.0)
+
+# Peak dense matmul FLOP/s per chip by TPU generation (public bf16 MXU
+# figures) — the MFU denominator. The CANONICAL table: bench.py's
+# _peak_flops delegates here (keeping its env overrides), and the
+# trainer's train_mfu gauge reads it directly. Order matters: first
+# substring match wins, so the more specific tags come first.
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v5lite", 197e12), ("v5", 459e12), ("v4", 275e12), ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def device_peak_flops(device_kind):
+    """Peak FLOP/s for a device kind string (``jax_device.device_kind``),
+    or None when the generation is unknown (CPU, emulators)."""
+    kind = (device_kind or "").lower()
+    for tag, peak in PEAK_FLOPS_BY_KIND:
+        if tag in kind:
+            return peak
+    return None
+
+
+def _label_key(label_names, labels):
+    if set(labels) != set(label_names):
+        raise ValueError(
+            f"metric labels {sorted(labels)} do not match the declared "
+            f"label names {sorted(label_names)}")
+    return tuple(str(labels[n]) for n in label_names)
+
+
+class _Metric:
+    """One named metric: a family of series keyed by label values."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help="", label_names=(), lock=None):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._series = {}
+        # the registry's lock is shared: one lock bounds the whole
+        # snapshot, so a snapshot is internally consistent
+        self._lock = lock if lock is not None else threading.Lock()
+
+    def _slot(self, labels):
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            slot = self._series.get(key)
+            if slot is None:
+                slot = self._new_slot()
+                self._series[key] = slot
+            return slot
+
+    def _new_slot(self):
+        raise NotImplementedError
+
+    def _series_doc(self, key, slot):
+        raise NotImplementedError
+
+    def to_doc(self):
+        with self._lock:
+            series = [dict(self._series_doc(k, s),
+                           labels=dict(zip(self.label_names, k)))
+                      for k, s in sorted(self._series.items())]
+        return {"name": self.name, "kind": self.kind, "help": self.help,
+                "labels": list(self.label_names), "series": series}
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (resets only with the process)."""
+
+    kind = "counter"
+
+    def _new_slot(self):
+        return [0.0]
+
+    def _series_doc(self, key, slot):
+        return {"value": slot[0]}
+
+    def inc(self, amount=1, **labels):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        slot = self._slot(labels)
+        with self._lock:
+            slot[0] += amount
+
+    def value(self, **labels):
+        slot = self._slot(labels)
+        with self._lock:
+            return slot[0]
+
+    def total(self):
+        """Sum over every label combination (the heartbeat summaries
+        want one number per rank, not a breakdown)."""
+        with self._lock:
+            return sum(s[0] for s in self._series.values())
+
+
+class Gauge(_Metric):
+    """A value that goes up and down (loss scale, straggler count)."""
+
+    kind = "gauge"
+
+    def _new_slot(self):
+        return [0.0]
+
+    def _series_doc(self, key, slot):
+        return {"value": slot[0]}
+
+    def set(self, value, **labels):
+        slot = self._slot(labels)
+        with self._lock:
+            slot[0] = float(value)
+
+    def inc(self, amount=1, **labels):
+        slot = self._slot(labels)
+        with self._lock:
+            slot[0] += amount
+
+    def dec(self, amount=1, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels):
+        slot = self._slot(labels)
+        with self._lock:
+            return slot[0]
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram with exact min/max/sum/count riding
+    along (the heartbeat summaries and the fleet aggregation need real
+    extrema, not bucket approximations)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", label_names=(), lock=None,
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, label_names, lock)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _new_slot(self):
+        return {"counts": [0] * (len(self.buckets) + 1), "sum": 0.0,
+                "count": 0, "min": math.inf, "max": -math.inf}
+
+    def _series_doc(self, key, slot):
+        cum, acc = [], 0
+        for le, c in zip(self.buckets, slot["counts"]):
+            acc += c
+            cum.append([le, acc])
+        cum.append(["+Inf", slot["count"]])
+        return {"count": slot["count"], "sum": slot["sum"],
+                "min": None if slot["count"] == 0 else slot["min"],
+                "max": None if slot["count"] == 0 else slot["max"],
+                "buckets": cum}
+
+    def observe(self, value, **labels):
+        value = float(value)
+        slot = self._slot(labels)
+        # linear scan beats bisect at these bucket counts and keeps the
+        # hot path allocation-free
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                idx = i
+                break
+        with self._lock:
+            slot["counts"][idx] += 1
+            slot["sum"] += value
+            slot["count"] += 1
+            if value < slot["min"]:
+                slot["min"] = value
+            if value > slot["max"]:
+                slot["max"] = value
+
+    def summary(self, **labels):
+        """{count, sum, min, max, mean} for one series (all None-safe:
+        an empty histogram summarizes to count 0 and None extrema)."""
+        slot = self._slot(labels)
+        with self._lock:
+            n = slot["count"]
+            return {"count": n, "sum": slot["sum"],
+                    "min": None if n == 0 else slot["min"],
+                    "max": None if n == 0 else slot["max"],
+                    "mean": None if n == 0 else slot["sum"] / n}
+
+
+class MetricsRegistry:
+    """Named metrics with get-or-create semantics (see module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, help, labels, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labels, lock=self._lock, **kw)
+                self._metrics[name] = m
+                return m
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        if tuple(labels) != m.label_names:
+            raise ValueError(
+                f"metric {name!r} already registered with labels "
+                f"{m.label_names}, requested {tuple(labels)}")
+        return m
+
+    def counter(self, name, help="", labels=()):
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=()):
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=(),
+                  buckets=DEFAULT_BUCKETS):
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self):
+        """Drop every metric — tests only; live code never resets (a
+        counter that restarts mid-scrape reads as a rollback)."""
+        with self._lock:
+            self._metrics = {}
+
+    def snapshot(self):
+        """The canonical JSON-able serialized form (schema
+        ``singa-tpu-metrics/1``) every exporter consumes."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {"schema": SNAPSHOT_SCHEMA, "ts": time.time(),
+                "metrics": [m.to_doc() for m in metrics]}
+
+    def to_prometheus(self):
+        from .export import render_prometheus
+        return render_prometheus(self.snapshot())
+
+
+# The process-wide default registry every instrumented layer reports
+# through. Module-level singleton, like logging.root: one fleet-wide
+# view needs one process-wide spine.
+REGISTRY = MetricsRegistry()
+
+
+def default_registry():
+    return REGISTRY
+
+
+def heartbeat_summary(registry=None):
+    """The compact per-rank summary that rides cluster heartbeats:
+    step-time stats from ``train_step_seconds`` plus this rank's dropped
+    corrupt-frame count. A few tens of bytes — cheap enough to attach to
+    every beat; None-valued fields mean "no data yet"."""
+    reg = registry if registry is not None else REGISTRY
+    hist = reg.get("train_step_seconds")
+    step = hist.summary() if isinstance(hist, Histogram) else None
+    if step is not None and step["count"] == 0:
+        step = None
+    wires = reg.get("cluster_wire_errors_total")
+    return {"step_time": step,
+            "wire_errors": int(wires.total())
+            if isinstance(wires, Counter) else 0}
+
+
+def aggregate_summaries(summaries):
+    """Fold per-rank heartbeat summaries into ONE fleet view — what the
+    coordinator publishes in its health report: min/max of the ranks'
+    step-time extrema, a count-weighted mean, total steps and wire
+    errors, and how many ranks have reported anything at all."""
+    vals = [s for s in (summaries or {}).values() if isinstance(s, dict)]
+    agg = {"ranks_reporting": len(vals),
+           "wire_errors": sum(int(s.get("wire_errors") or 0)
+                              for s in vals)}
+    steps = [s["step_time"] for s in vals
+             if isinstance(s.get("step_time"), dict)
+             and s["step_time"].get("count")]
+    if steps:
+        total = sum(int(s["count"]) for s in steps)
+        agg["steps"] = total
+        agg["step_time_min"] = min(float(s["min"]) for s in steps)
+        agg["step_time_max"] = max(float(s["max"]) for s in steps)
+        agg["step_time_mean"] = sum(
+            float(s["mean"]) * int(s["count"]) for s in steps) / total
+    return agg
+
+
+__all__ = ["SNAPSHOT_SCHEMA", "DEFAULT_BUCKETS", "PEAK_FLOPS_BY_KIND",
+           "device_peak_flops", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "REGISTRY", "default_registry",
+           "heartbeat_summary", "aggregate_summaries"]

@@ -1,0 +1,240 @@
+"""Nested wall-clock trace spans and the crash flight recorder.
+
+Spans are the narrative counterpart of the metrics registry: where a
+histogram says "step time p50 is 42 ms", the span stream says "step 317
+took 1.9 s, and inside it checkpoint.save took 1.7 s". Each span is one
+JSON record::
+
+    {"kind": "span", "name": "step", "ts": <epoch s>, "dur_s": 0.042,
+     "parent": "run", "rank": 0, "step": 317, ...}
+
+- **Attribution** (run id, rank, step) comes from two places: explicit
+  keyword attrs on the span, and an ambient :func:`context` carried in a
+  ``contextvars.ContextVar`` — so two in-process ranks (threaded tests,
+  the in-process cluster suite) stamp their own rank on every record
+  even though they share the process-global recorder, and the trainer's
+  watchdog worker (which copies its caller's context) inherits it.
+- **Nesting** rides the same contextvar mechanism: a span records the
+  name of the innermost enclosing span as ``parent``.
+- **The flight recorder** is a bounded ring (``deque(maxlen=...)``) of
+  the most recent records. It costs one append per span — nothing is
+  written anywhere until :meth:`FlightRecorder.dump` is called, which
+  the resilient trainer does on every ABNORMAL exit path (preemption,
+  divergence, watchdog kill, membership loss, rollback), writing
+  ``telemetry/blackbox-<rank>.jsonl``: a dump header naming the reason,
+  the ring contents (the last N seconds of spans), and a final metrics
+  snapshot. A post-mortem then shows what the job was doing when it
+  died, not just an exit code.
+- Optionally a live JSONL sink (:meth:`FlightRecorder.attach_jsonl`)
+  mirrors every record to disk as it happens — what
+  ``examples/train_cnn.py --telemetry`` turns on.
+
+Everything here is host-side stdlib; nothing imports jax, so span cost
+is a couple of ``perf_counter`` calls plus a dict build (~µs) and the
+compiled step's ``n_traces`` pin is untouched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+
+# ambient attrs merged into every record (rank, run id); per-context so
+# in-process multi-rank tests attribute correctly
+_CTX = contextvars.ContextVar("singa_tpu_span_ctx", default=None)
+# innermost-enclosing-span name, for the ``parent`` field
+_STACK = contextvars.ContextVar("singa_tpu_span_stack", default=())
+
+DEFAULT_CAPACITY = 1024
+
+
+@contextlib.contextmanager
+def context(**attrs):
+    """Scope ambient attribution: every record made inside the ``with``
+    (in this thread/context, workers that copy it included) carries
+    ``attrs``. Nests by merging."""
+    merged = dict(_CTX.get() or {})
+    merged.update(attrs)
+    token = _CTX.set(merged)
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of telemetry records + optional live
+    JSONL sink (see module docstring)."""
+
+    def __init__(self, capacity=DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._sink_lock = threading.Lock()  # serializes sink I/O only
+        self._ring = deque(maxlen=int(capacity))
+        self._jsonl = None
+        self._jsonl_path = None
+
+    def record(self, rec):
+        with self._lock:
+            self._ring.append(rec)
+        if self._jsonl is not None:
+            # serialize + write OUTSIDE the ring lock: a slow disk may
+            # stall sink writers, never every span-recording thread
+            with self._sink_lock:
+                try:
+                    if self._jsonl is not None:
+                        self._jsonl.write(json.dumps(rec) + "\n")
+                except (OSError, ValueError, TypeError):
+                    # a full disk or closed sink must never take down
+                    # training; the ring still holds the record
+                    pass
+
+    def records(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+    # -- live JSONL sink ---------------------------------------------------
+    def attach_jsonl(self, path):
+        """Mirror every record to ``path`` as it is made (line-buffered
+        append). Returns the absolute path."""
+        path = os.path.abspath(str(path))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with self._sink_lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+            self._jsonl = open(path, "a", buffering=1)
+            self._jsonl_path = path
+        return path
+
+    def detach_jsonl(self):
+        with self._sink_lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+            self._jsonl = None
+            self._jsonl_path = None
+
+    @property
+    def jsonl_path(self):
+        return self._jsonl_path
+
+    # -- the blackbox dump -------------------------------------------------
+    def dump(self, path, reason, rank=None, step=None, extra=None,
+             registry=None):
+        """Write the blackbox: header (reason/rank/step/extra), the ring
+        contents, then a final metrics snapshot. Atomic (tmp + rename)
+        and OVERWRITING — the newest incident is the one the post-mortem
+        wants, and a half-written dump must never pass for a whole one.
+        Returns the absolute path."""
+        from . import metrics as _metrics
+        path = os.path.abspath(str(path))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        header = {"kind": "dump", "ts": time.time(), "reason": str(reason)}
+        if rank is not None:
+            header["rank"] = rank
+        if step is not None:
+            header["step"] = step
+        if extra:
+            header["extra"] = extra
+        reg = registry if registry is not None \
+            else _metrics.default_registry()
+        try:
+            snap = reg.snapshot()
+        except Exception:       # the spans must land even if metrics fail
+            snap = None
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for rec in self.records():
+                f.write(json.dumps(rec) + "\n")
+            if snap is not None:
+                f.write(json.dumps({"kind": "metrics",
+                                    "snapshot": snap}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+# the process-wide default recorder (the trainer, the span context
+# manager, and the --telemetry example all share it)
+_RECORDER = FlightRecorder()
+
+
+def recorder():
+    return _RECORDER
+
+
+def configure(capacity=None, jsonl_path=None):
+    """Adjust the default recorder: ring capacity and/or a live JSONL
+    sink path. Returns the recorder."""
+    if capacity is not None:
+        with _RECORDER._lock:
+            _RECORDER._ring = deque(_RECORDER._ring,
+                                    maxlen=int(capacity))
+    if jsonl_path is not None:
+        _RECORDER.attach_jsonl(jsonl_path)
+    return _RECORDER
+
+
+class span:
+    """Context manager recording one nested wall-clock span::
+
+        with span("checkpoint.save", step=42):
+            mgr.save(...)
+
+    On exit a record lands in the default recorder, stamped with the
+    ambient :func:`context` attrs, the enclosing span's name, and — when
+    the body raised — the exception type under ``error``."""
+
+    __slots__ = ("name", "attrs", "_t0", "_token")
+
+    def __init__(self, name, **attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._token = _STACK.set(_STACK.get() + (self.name,))
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        stack = _STACK.get()
+        _STACK.reset(self._token)
+        rec = {"kind": "span", "name": self.name, "ts": time.time(),
+               "dur_s": dur}
+        if len(stack) > 1:
+            rec["parent"] = stack[-2]
+        ctx = _CTX.get()
+        if ctx:
+            rec.update(ctx)
+        if self.attrs:
+            rec.update(self.attrs)
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        _RECORDER.record(rec)
+        return False
+
+
+def event(name, **attrs):
+    """Record a point-in-time event (no duration) — rollbacks, loss-
+    scale backoffs, quarantines."""
+    rec = {"kind": "event", "name": name, "ts": time.time()}
+    ctx = _CTX.get()
+    if ctx:
+        rec.update(ctx)
+    if attrs:
+        rec.update(attrs)
+    _RECORDER.record(rec)
+
+
+__all__ = ["FlightRecorder", "context", "span", "event", "recorder",
+           "configure", "DEFAULT_CAPACITY"]

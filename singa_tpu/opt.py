@@ -172,6 +172,17 @@ class Optimizer:
     def should_apply_weight_decay(self, name):
         return True
 
+    def telemetry_info(self):
+        """Static facts for the telemetry layer (recorded once as
+        labels/gauges at run start — NEVER per step: the schedule's
+        current value lives in the traced ``lr_value``, and reading it
+        back would add a device round trip). Wrappers (DistOpt,
+        GuardedOptimizer) delegate through ``__getattr__``, so the run
+        record names the innermost real optimizer."""
+        return {"optimizer": type(self).__name__,
+                "lr": float(self.lr.init_value)
+                if hasattr(self.lr, "init_value") else None}
+
     # -- train driving -----------------------------------------------------
     def __call__(self, loss):
         self.backward_and_update(loss)

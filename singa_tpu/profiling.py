@@ -123,3 +123,22 @@ def measure_step_fusions(run_step, logdir=None):
         # behind (including when the step itself raised)
         if logdir is None:
             shutil.rmtree(d, ignore_errors=True)
+
+
+def record_fusion_metrics(table, registry=None):
+    """Publish a measured per-fusion table into the metrics registry
+    (gauges labeled by fusion symbol — SET, not accumulated: each
+    profile run replaces the previous decomposition). Used by
+    ``Model.profile_step``; returns the registry."""
+    from .observability import metrics as _metrics
+    reg = registry if registry is not None else _metrics.default_registry()
+    secs = reg.gauge("profile_fusion_seconds",
+                     "measured device seconds per XLA fusion in the "
+                     "newest profiled step", labels=("fusion",))
+    cnts = reg.gauge("profile_fusion_count",
+                     "event count per XLA fusion in the newest "
+                     "profiled step", labels=("fusion",))
+    for name, (cnt, tot) in table.items():
+        secs.set(tot, fusion=name)
+        cnts.set(cnt, fusion=name)
+    return reg

@@ -54,6 +54,7 @@ from dataclasses import dataclass
 from .. import network as net
 from ..integrity import (MAX_MESSAGE_BYTES, IntegrityError, open_frame,
                          seal_frame)
+from ..observability import metrics as _metrics
 from .faults import NULL_PLAN, DropPeerSignal as _DropPeerSignal
 
 # control-plane protocol version, negotiated in the hello handshake: a
@@ -155,6 +156,22 @@ class ClusterBase:
     _wire_seq = 0          # sent-frame counter (fault-injection keying)
     _wire_errors = 0       # corrupt frames dropped by this member
     _WIRE_WARN_LIMIT = 5   # warn the first few, count the rest silently
+    # zero-arg callable returning this member's heartbeat metric
+    # summary; None uses the process metrics registry
+    # (observability.metrics.heartbeat_summary). Injectable so
+    # in-process multi-rank tests give each member its own numbers.
+    metrics_source = None
+
+    def _metrics_summary(self):
+        """This member's compact metric summary (rides heartbeats; the
+        coordinator aggregates into one fleet view). Never raises —
+        telemetry must not take the control plane down."""
+        try:
+            src = self.metrics_source
+            return src() if callable(src) \
+                else _metrics.heartbeat_summary()
+        except Exception:       # noqa: BLE001 — best-effort by design
+            return None
 
     # -- wire integrity ----------------------------------------------------
     def _send(self, ep, kind, **payload):
@@ -181,6 +198,9 @@ class ClusterBase:
 
     def _note_wire_error(self, exc):
         self._wire_errors += 1
+        _metrics.default_registry().counter(
+            "cluster_wire_errors_total",
+            "corrupt control-plane frames dropped by this process").inc()
         if self._wire_errors <= self._WIRE_WARN_LIMIT:
             warnings.warn(
                 f"cluster rank {self.rank}: dropped corrupt "
@@ -321,6 +341,7 @@ class Coordinator(ClusterBase):
         self._peers: dict[int, net.EndPoint] = {}
         self._last_hb: dict[int, float] = {}
         self._hb_count: dict[int, int] = {}
+        self._worker_metrics: dict[int, dict] = {}  # rank -> hb summary
         self._dead: set[int] = set()
         self._stragglers: set[int] = set()
         # barrier name -> {"arrived": set, "event": Event,
@@ -442,6 +463,11 @@ class Coordinator(ClusterBase):
                 with self._lock:
                     self._last_hb[rank] = time.monotonic()
                     self._hb_count[rank] = self._hb_count.get(rank, 0) + 1
+                    m = data.get("metrics")
+                    if isinstance(m, dict):
+                        # per-rank metric summary riding the beat: the
+                        # digest below publishes the aggregated view
+                        self._worker_metrics[rank] = m
                 try:
                     self._send(ep, "hb-ack", **self._digest())
                 except ConnectionError:
@@ -481,28 +507,56 @@ class Coordinator(ClusterBase):
                 self._fail_barriers_missing(rank)
 
     def _digest(self) -> dict:
+        # the coordinator is a full participant: its own summary joins
+        # the fleet view (computed outside the lock — it only reads the
+        # process metrics registry)
+        own = self._metrics_summary()
         with self._lock:
             now = time.monotonic()
             expected = set(range(1, self.world))
             connected = set(self._last_hb)
             ages = {str(r): round(now - t, 3)
                     for r, t in self._last_hb.items()}
-            return {
-                "world": self.world,
-                "alive": sorted({0} | (connected - self._dead)),
-                "dead": sorted(self._dead),
-                "never_joined": sorted(expected - connected),
-                "stragglers": sorted(self._stragglers - self._dead),
-                "heartbeat_age": ages,
-                "heartbeats": {str(r): c
-                               for r, c in self._hb_count.items()},
-                "wire_errors": self._wire_errors,
-            }
+            summaries = dict(self._worker_metrics)
+            stragglers = sorted(self._stragglers - self._dead)
+            dead = sorted(self._dead)
+            hb_counts = {str(r): c for r, c in self._hb_count.items()}
+            wire_errors = self._wire_errors
+        if own is not None:
+            summaries[0] = own
+        reg = _metrics.default_registry()
+        reg.gauge("cluster_stragglers",
+                  "ranks whose heartbeat is overdue").set(len(stragglers))
+        reg.gauge("cluster_dead_ranks",
+                  "ranks declared dead by silence").set(len(dead))
+        return {
+            "world": self.world,
+            "alive": sorted({0} | (connected - set(dead))),
+            "dead": dead,
+            "never_joined": sorted(expected - connected),
+            "stragglers": stragglers,
+            "heartbeat_age": ages,
+            "heartbeats": hb_counts,
+            "wire_errors": wire_errors,
+            # ONE fleet-wide metric view (min/max/mean step time, total
+            # steps and wire errors), aggregated from the summaries each
+            # rank attached to its heartbeats — small enough to ride
+            # back on every hb-ack, so workers see it too
+            "worker_metrics": dict(
+                _metrics.aggregate_summaries(summaries),
+                stragglers=len(stragglers)),
+        }
 
     # -- health ------------------------------------------------------------
     def health(self):
         d = self._digest()
         d["rank"] = 0
+        with self._lock:
+            # the full per-rank breakdown only in the local health
+            # report (the broadcast digest carries the aggregate)
+            d["worker_metrics_by_rank"] = {
+                str(r): dict(m)
+                for r, m in self._worker_metrics.items()}
         return d
 
     # -- barrier -----------------------------------------------------------
@@ -880,7 +934,12 @@ class Worker(ClusterBase):
             if not self._running:
                 return
             try:
-                self._send(self._ep, "hb", rank=self.rank, seq=seq)
+                # the rank's metric summary rides every beat (a few
+                # tens of bytes): the coordinator's health report
+                # aggregates them into the fleet view
+                self._hb_sent_at = time.monotonic()
+                self._send(self._ep, "hb", rank=self.rank, seq=seq,
+                           metrics=self._metrics_summary())
             except ConnectionError:
                 if self._running:
                     self._mark_coordinator_dead()
@@ -909,9 +968,20 @@ class Worker(ClusterBase):
                 continue        # corrupt frame: dropped and counted
             kind = msg.meta.decode()
             if kind == "hb-ack":
+                now = time.monotonic()
                 with self._lock:
                     self._digest = data
-                    self._last_ack = time.monotonic()
+                    self._last_ack = now
+                    sent = getattr(self, "_hb_sent_at", None)
+                    self._hb_sent_at = None
+                if sent is not None:
+                    # beat-to-ack round trip (control-plane latency; an
+                    # ack matched against the NEWEST un-acked beat, so a
+                    # coalesced/slow ack reads as the large RTT it is)
+                    _metrics.default_registry().histogram(
+                        "cluster_heartbeat_rtt_seconds",
+                        "worker heartbeat send to coordinator ack"
+                    ).observe(now - sent)
             elif kind in ("barrier-ok", "barrier-fail"):
                 with self._lock:
                     slot = self._barriers.get(data["name"])

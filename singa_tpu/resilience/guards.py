@@ -228,6 +228,31 @@ class GuardedOptimizer:
             "grad_norm": float(np.asarray(self.last_grad_norm.data)),
         }
 
+    def record_metrics(self, registry=None):
+        """Publish the guard scalars (ONE host readback batch — the
+        same five scalars :meth:`stats` reads) into the metrics
+        registry as gauges, and return the stats dict. The resilient
+        trainer calls this at run finalization and on every blackbox
+        dump, NOT per step: the step path keeps its single
+        ``bad_streak_value`` readback."""
+        from ..observability import metrics as _metrics
+        reg = registry if registry is not None \
+            else _metrics.default_registry()
+        s = self.stats()
+        reg.gauge("guard_loss_scale",
+                  "current dynamic loss scale").set(s["loss_scale"])
+        reg.gauge("guard_skipped_steps_total",
+                  "guard-skipped (bad) steps since state creation; a "
+                  "gauge because the value rides checkpoints"
+                  ).set(s["skipped_total"])
+        reg.gauge("guard_last_grad_norm",
+                  "global gradient norm of the newest step"
+                  ).set(s["grad_norm"])
+        reg.gauge("guard_bad_streak",
+                  "consecutive guard-flagged bad steps"
+                  ).set(s["bad_streak"])
+        return s
+
     def reset_streaks(self, extra_backoff=False):
         """Zero the streak counters (after the driver rolled state back
         to a checkpoint); optionally back the restored loss scale off

@@ -41,6 +41,7 @@ one-shot generator that runs dry mid-training raises a clear error
 
 from __future__ import annotations
 
+import os
 import random
 import signal
 import threading
@@ -50,6 +51,8 @@ import warnings
 from .. import data as _data_mod
 from ..checkpoint import CheckpointManager, DistributedCheckpointManager
 from ..integrity import replica_buffer_mismatches, state_fingerprint
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
 from .cluster import BarrierTimeout, MembershipError
 from .faults import NULL_PLAN
 from .guards import GuardedOptimizer
@@ -164,9 +167,16 @@ class ResilientTrainer:
                  faults=None, seed=0, verbose=True, cluster=None,
                  commit_timeout=60.0, start_barrier_timeout=60.0,
                  preempt_commit_timeout=10.0, manifest_extra=None,
-                 fingerprint_every=0, max_divergence_rollbacks=2):
+                 fingerprint_every=0, max_divergence_rollbacks=2,
+                 telemetry_dir=None):
         self.model = model
         self.cluster = cluster
+        self._rank = cluster.rank if cluster is not None else 0
+        # flight-recorder blackbox home (``blackbox-<rank>.jsonl``):
+        # beside the checkpoints unless the caller routes it elsewhere
+        self.telemetry_dir = os.path.abspath(str(
+            telemetry_dir if telemetry_dir is not None
+            else os.path.join(str(ckpt_dir), "telemetry")))
         self.start_barrier_timeout = float(start_barrier_timeout)
         self.preempt_commit_timeout = float(preempt_commit_timeout)
         if cluster is not None:
@@ -204,6 +214,39 @@ class ResilientTrainer:
         self._preempt_signal = None
         self._data = None
         self._it = None
+        # telemetry handles (get-or-create on the process registry):
+        # every operation below is a host-side dict update — the
+        # compiled step path (and its n_traces pin) is untouched
+        reg = _metrics.default_registry()
+        self._m_steps = reg.counter(
+            "train_steps_total", "completed training steps")
+        self._m_step_time = reg.histogram(
+            "train_step_seconds", "wall-clock duration of one step")
+        self._m_fetch = reg.histogram(
+            "data_fetch_seconds", "wall-clock wait for the next batch")
+        self._m_throughput = reg.gauge(
+            "train_throughput_samples_per_sec",
+            "samples/s of the newest step (batch dim0 / step seconds)")
+        self._m_mfu = reg.gauge(
+            "train_mfu", "achieved/peak FLOP fraction of the newest "
+            "step (needs a cached XLA cost analysis and a known chip)")
+        self._m_retries = reg.counter(
+            "train_retries_total", "transient-failure retries",
+            labels=("kind",))
+        self._m_timeouts = reg.counter(
+            "train_step_timeouts_total", "watchdog-overdue steps")
+        self._m_rollbacks = reg.counter(
+            "train_rollbacks_total",
+            "state rollbacks to a checkpoint", labels=("kind",))
+        self._m_bad_streak = reg.gauge(
+            "guard_bad_streak", "consecutive guard-flagged bad steps")
+        self._m_first_step = reg.gauge(
+            "restart_to_first_step_seconds",
+            "run() entry to first completed step — the cold-start "
+            "regression gate (compile + restore + first batch)")
+        self._step_flops = None       # resolved lazily after step 1
+        self._last_blackbox = None
+        self._cur_step = None
 
     # -- logging -----------------------------------------------------------
     def _log(self, msg):
@@ -279,6 +322,7 @@ class ResilientTrainer:
         delay = backoff_delay(attempt, self.backoff_base,
                               self.backoff_cap, self.jitter, self._rng)
         summary[kind] += 1
+        self._m_retries.inc(kind=kind)
         self._log(f"{what}: transient failure, retrying "
                   f"in {delay * 1e3:.0f} ms "
                   f"(attempt {attempt + 1})")
@@ -376,6 +420,7 @@ class ResilientTrainer:
                 # that is fatal — we cannot retry while a zombie thread
                 # may yet land its state mutation concurrently
                 summary["step_timeouts"] += 1
+                self._m_timeouts.inc()
                 e.worker.join(self.step_timeout)
                 if e.worker.is_alive():
                     raise StepTimeoutError(
@@ -453,13 +498,42 @@ class ResilientTrainer:
         if self.cluster is not None:
             self.cluster.check()
 
+    # -- flight recorder ---------------------------------------------------
+    def _blackbox_dump(self, reason, step=None):
+        """Dump the in-memory flight recorder to
+        ``<telemetry_dir>/blackbox-<rank>.jsonl`` — called on every
+        ABNORMAL path (preemption, divergence, watchdog kill,
+        membership loss, rollback) so a post-mortem shows the last N
+        seconds of spans and a final metrics snapshot, not just an exit
+        code. Never raises: losing the blackbox must not change how the
+        run dies."""
+        try:
+            guard = self._guard()
+            extra = {"guard": guard.stats()} if guard is not None else None
+            path = os.path.join(self.telemetry_dir,
+                                f"blackbox-{self._rank}.jsonl")
+            self._last_blackbox = _spans.recorder().dump(
+                path, reason, rank=self._rank,
+                step=step if step is not None else self._cur_step,
+                extra=extra)
+            self._log(f"flight recorder dumped to "
+                      f"{self._last_blackbox} ({reason})")
+        except Exception as e:      # noqa: BLE001 — best-effort by design
+            warnings.warn(f"flight-recorder dump failed "
+                          f"({type(e).__name__}: {e})", stacklevel=2)
+
     def _finalize_summary(self, summary):
         """Observability that must survive EVERY exit path (success,
         preemption, membership loss): guard stats, data-pipeline
         flakiness counters, final cluster health."""
         guard = self._guard()
         if guard is not None:
-            summary["skipped_steps"] = guard.stats()["skipped_total"]
+            # one host readback of the guard scalars, recorded as
+            # gauges too (loss scale, skipped total, grad norm)
+            summary["skipped_steps"] = \
+                guard.record_metrics()["skipped_total"]
+        if self._last_blackbox is not None:
+            summary["blackbox"] = self._last_blackbox
         from ..data import RetryingIterator
         summary["data_resumed"] = bool(getattr(self, "_data_resumed",
                                                False))
@@ -508,13 +582,17 @@ class ResilientTrainer:
         strands them there instead of training at inconsistent
         parameter versions. Returns the step to resume from."""
         if self.cluster is not None and self.cluster.world > 1:
-            self.cluster.barrier(f"{prefix}-{step}-{n}",
-                                 timeout=self.start_barrier_timeout)
+            with _spans.span("barrier", barrier=f"{prefix}-{step}-{n}"):
+                self.cluster.barrier(f"{prefix}-{step}-{n}",
+                                     timeout=self.start_barrier_timeout)
         self.mgr.wait()          # never restore under an in-flight save
-        resume = self.mgr.restore_latest(self.model)
+        with _spans.span("restore", reason=prefix, step=step):
+            resume = self.mgr.restore_latest(self.model)
         if self.cluster is not None and self.cluster.world > 1:
-            self.cluster.barrier(f"{prefix}-resume-{resume}-{n}",
-                                 timeout=self.start_barrier_timeout)
+            with _spans.span("barrier",
+                             barrier=f"{prefix}-resume-{resume}-{n}"):
+                self.cluster.barrier(f"{prefix}-resume-{resume}-{n}",
+                                     timeout=self.start_barrier_timeout)
         if isinstance(self.mgr, DistributedCheckpointManager):
             # agreement reached: markers at/after the resume point
             # vouch for a timeline about to be re-run
@@ -540,6 +618,9 @@ class ResilientTrainer:
                                         summary["rollbacks"])
         guard.reset_streaks(extra_backoff=True)
         summary["rollbacks"] += 1
+        self._m_rollbacks.inc(kind="guard")
+        _spans.event("rollback", step=step, resume=resume, kind="guard")
+        self._blackbox_dump("rollback", step=step)
         warnings.warn(
             f"{self.rollback_after} consecutive bad steps at step "
             f"{step}; rolled back to checkpoint, resuming at step "
@@ -609,12 +690,53 @@ class ResilientTrainer:
         if guard is not None:
             guard.reset_streaks()
         summary["divergence_rollbacks"] += 1
+        self._m_rollbacks.inc(kind="quarantine")
+        _spans.event("quarantine", step=step, resume=resume,
+                     divergent=summary["divergent"])
+        self._blackbox_dump("quarantine", step=step)
         warnings.warn(
             f"quarantined diverged step {step}; rolled back to the "
             f"last verified checkpoint, resuming at step {resume} "
             f"(divergence rollback {summary['divergence_rollbacks']}/"
             f"{self.max_divergence_rollbacks})", stacklevel=2)
         return resume
+
+    # -- per-step telemetry ------------------------------------------------
+    def _observe_step(self, step_s, batch, summary, run_t0, first):
+        """Host-side step accounting: duration histogram, throughput,
+        MFU when an XLA cost analysis is already cached (never forces a
+        compile on the step path), and — once per run — the restart-to-
+        first-step latency that gates cold-start regressions."""
+        self._m_steps.inc()
+        self._m_step_time.observe(step_s)
+        if first:
+            lat = time.perf_counter() - run_t0
+            summary["first_step_latency_s"] = round(lat, 6)
+            self._m_first_step.set(lat)
+            _spans.event("first_step", latency_s=lat,
+                         resumed_at=summary["start"])
+            # resolve the step's flop count ONCE, cheaply: only a cost
+            # analysis someone already paid for (verbosity>=2, a prior
+            # compiled_step_info/profile_step call) is consulted
+            sf = getattr(self.model, "step_flops", None)
+            if callable(sf):
+                try:
+                    self._step_flops = sf(compute=False)
+                except Exception:       # audit is best-effort telemetry
+                    self._step_flops = None
+        if step_s > 0:
+            first_arr = next((b for b in batch
+                              if hasattr(b, "shape") and
+                              getattr(b, "shape", ())), None)
+            if first_arr is not None and len(first_arr.shape) > 0:
+                self._m_throughput.set(first_arr.shape[0] / step_s)
+            if self._step_flops:
+                dev = getattr(self.model, "dev", None)
+                peak = _metrics.device_peak_flops(getattr(
+                    getattr(dev, "jax_device", None), "device_kind",
+                    None))
+                if peak:
+                    self._m_mfu.set(self._step_flops / step_s / peak)
 
     # -- the loop ----------------------------------------------------------
     def run(self, data, num_steps, step_callback=None):
@@ -626,6 +748,10 @@ class ResilientTrainer:
         self._yielded_any = False
         self._data_resumed = False
         self._preempt_signal = None     # a reused trainer starts clean
+        self._cur_step = None
+        self._last_blackbox = None
+        run_t0 = time.perf_counter()
+        first_step_done = False
         summary = {"start": None, "steps_run": 0, "rollbacks": 0,
                    "step_retries": 0, "data_retries": 0,
                    "step_timeouts": 0, "skipped_steps": 0,
@@ -633,15 +759,22 @@ class ResilientTrainer:
                    "dead_ranks": [], "elastic": None,
                    "fingerprints": 0, "quarantined_steps": 0,
                    "divergence_rollbacks": 0, "divergent": [],
-                   "diverged": False}
+                   "diverged": False, "first_step_latency_s": None}
         prev_handlers = self._install_handlers()
+        # ambient span attribution: every record made under this run —
+        # trainer spans, checkpoint/cluster events, spans inside the
+        # watchdog worker (it copies the context) — carries this rank
+        span_ctx = _spans.context(rank=self._rank)
+        span_ctx.__enter__()
         try:
             if self.cluster is not None and self.cluster.world > 1:
                 # rendezvous BEFORE restore: a rank that never shows up
                 # is named now, not discovered as a hung collective later
-                self.cluster.barrier("run-start",
-                                     timeout=self.start_barrier_timeout)
-            start = self.mgr.restore_latest(self.model)
+                with _spans.span("barrier", barrier="run-start"):
+                    self.cluster.barrier(
+                        "run-start", timeout=self.start_barrier_timeout)
+            with _spans.span("restore", reason="run-start"):
+                start = self.mgr.restore_latest(self.model)
             summary["start"] = start
             if self.cluster is not None and self.cluster.world > 1:
                 # resume-step agreement: the barrier NAME carries the
@@ -650,8 +783,10 @@ class ResilientTrainer:
                 # strands its peers here and everyone exits 75 LOUDLY
                 # instead of training at inconsistent parameter
                 # versions where no checkpoint could ever commit again
-                self.cluster.barrier(f"resume-{start}",
-                                     timeout=self.start_barrier_timeout)
+                with _spans.span("barrier", barrier=f"resume-{start}"):
+                    self.cluster.barrier(
+                        f"resume-{start}",
+                        timeout=self.start_barrier_timeout)
             if isinstance(self.mgr, DistributedCheckpointManager):
                 # agreement reached (barrier above, or a world of one):
                 # markers at/after the resume point vouch for a
@@ -682,10 +817,28 @@ class ResilientTrainer:
             self._check_preempt(step - 1, start)
             self._check_cluster()
             guard = self._guard()
+            info = getattr(getattr(self.model, "optimizer", None),
+                           "telemetry_info", None)
+            if callable(info):
+                try:        # one static run-config record, never per step
+                    _spans.event("run_config", start=start,
+                                 num_steps=num_steps, **info())
+                except Exception:       # noqa: BLE001 — telemetry only
+                    pass
             while step < num_steps:
-                batch = self._next_batch(step, summary)
-                out = self._run_step(step, batch, summary)
+                self._cur_step = step
+                t_fetch = time.perf_counter()
+                with _spans.span("data.next", step=step):
+                    batch = self._next_batch(step, summary)
+                self._m_fetch.observe(time.perf_counter() - t_fetch)
+                t_step = time.perf_counter()
+                with _spans.span("step", step=step):
+                    out = self._run_step(step, batch, summary)
+                step_s = time.perf_counter() - t_step
                 summary["steps_run"] += 1
+                self._observe_step(step_s, batch, summary, run_t0,
+                                   first=not first_step_done)
+                first_step_done = True
                 # cross-replica fingerprint on its cadence, BEFORE the
                 # save: a diverged step is quarantined — it must never
                 # be checkpointed, and the rollback target is the last
@@ -700,12 +853,14 @@ class ResilientTrainer:
                 # is never checkpointed, so the newest checkpoint always
                 # predates the bad streak and rollback actually rewinds
                 bad = guard.bad_streak_value() if guard is not None else 0
+                self._m_bad_streak.set(bad)  # value already read back
                 if bad == 0:
                     # the data state rides every save: captured AFTER
                     # the step, so it counts this step's batch as
                     # consumed and a resume fetches the NEXT one
-                    self.mgr.save(step, self.model,
-                                  data_state=self._data_state())
+                    with _spans.span("checkpoint.save", step=step):
+                        self.mgr.save(step, self.model,
+                                      data_state=self._data_state())
                     self.faults.on_saved(step)
                 if step_callback is not None:
                     step_callback(step, out)
@@ -718,6 +873,7 @@ class ResilientTrainer:
             return summary
         except _Preempted:
             summary["preempted"] = True
+            self._blackbox_dump("preempted")
             self._finalize_summary(summary)
             if self.exit_on_preempt:
                 raise SystemExit(EXIT_PREEMPTED) from None
@@ -729,11 +885,18 @@ class ResilientTrainer:
             # the divergent host first; resume still lands on the last
             # cross-replica-agreed checkpoint.
             summary["diverged"] = True
+            self._blackbox_dump("diverged", step=e.step)
             self._finalize_summary(summary)
             self._log(f"{e}")
             if self.exit_on_preempt:
                 raise SystemExit(EXIT_DIVERGED) from None
             return summary
+        except StepTimeoutError:
+            # fatal watchdog kill (the in-process grace already ran out
+            # in _run_step): the supervisor restart is the recovery —
+            # leave the last N seconds of evidence behind first
+            self._blackbox_dump("watchdog_kill")
+            raise
         except (MembershipError, BarrierTimeout) as e:
             # RECOVERABLE: the job is still viable at a smaller world.
             # Same supervisor contract as preemption — exit 75, restart
@@ -744,6 +907,7 @@ class ResilientTrainer:
             summary["membership_lost"] = True
             summary["dead_ranks"] = list(getattr(e, "dead", [])) or \
                 list(getattr(e, "missing", []))
+            self._blackbox_dump("membership_lost")
             self._finalize_summary(summary)
             self._log(f"{e}; exiting {EXIT_PREEMPTED} for the "
                       "supervisor (restart at the surviving world size)")
@@ -751,6 +915,7 @@ class ResilientTrainer:
                 raise SystemExit(EXIT_PREEMPTED) from None
             return summary
         finally:
+            span_ctx.__exit__(None, None, None)
             self._restore_handlers(prev_handlers)
 
     def close(self):

@@ -317,3 +317,127 @@ class TestTwoPhaseCommit:
             assert members[1].wait_commit(3, timeout=5.0) is False
         finally:
             _close_all(members)
+
+
+class TestHeartbeatMetrics:
+    """Worker metric summaries ride heartbeats; the coordinator folds
+    them (plus its own) into ONE fleet view published in its health
+    report — min/max/mean step time, total steps and wire errors, and
+    the straggler count."""
+
+    @staticmethod
+    def _summary_for(rank):
+        """A per-rank injected metrics_source: distinct, recognizable
+        step-time stats so the aggregate is checkable exactly."""
+        base = 0.010 * (rank + 1)
+        def src():
+            return {"step_time": {"count": 10 * (rank + 1),
+                                  "sum": base * 10 * (rank + 1),
+                                  "min": base, "max": 10 * base,
+                                  "mean": base},
+                    "wire_errors": rank}
+        return src
+
+    def test_coordinator_aggregates_worker_summaries(self):
+        members = _spawn_cluster(3)
+        try:
+            for m in members:
+                m.metrics_source = self._summary_for(m.rank)
+            deadline = time.monotonic() + 8
+            agg = None
+            while time.monotonic() < deadline:
+                agg = members[0].health().get("worker_metrics") or {}
+                # wait for every rank's POST-injection summary to land
+                # (the first beats carried the empty default)
+                if agg.get("steps") == 60:
+                    break
+                time.sleep(0.05)
+            assert agg.get("ranks_reporting") == 3, agg
+            # min over ranks' minima (rank 0), max over maxima (rank 2)
+            assert agg["step_time_min"] == pytest.approx(0.010)
+            assert agg["step_time_max"] == pytest.approx(0.300)
+            assert agg["steps"] == 10 + 20 + 30
+            # count-weighted mean of the three per-rank means
+            assert agg["step_time_mean"] == pytest.approx(
+                (0.010 * 10 + 0.020 * 20 + 0.030 * 30) / 60)
+            assert agg["wire_errors"] == 0 + 1 + 2
+            assert agg["stragglers"] == 0
+            # the per-rank breakdown rides the LOCAL health report only
+            by_rank = members[0].health()["worker_metrics_by_rank"]
+            assert set(by_rank) >= {"1", "2"}
+            assert by_rank["2"]["wire_errors"] == 2
+        finally:
+            _close_all(members)
+
+    def test_workers_see_fleet_view_on_ack(self):
+        """The aggregate rides back on every hb-ack, so any rank can
+        alarm on fleet-wide regressions without asking the
+        coordinator."""
+        members = _spawn_cluster(2)
+        try:
+            for m in members:
+                m.metrics_source = self._summary_for(m.rank)
+            deadline = time.monotonic() + 8
+            agg = None
+            while time.monotonic() < deadline:
+                agg = members[1].health().get("worker_metrics") or {}
+                if agg.get("steps") == 30:
+                    break
+                time.sleep(0.05)
+            assert agg.get("ranks_reporting") == 2, agg
+            assert agg["steps"] == 10 + 20
+        finally:
+            _close_all(members)
+
+    def test_broken_metrics_source_never_downs_the_control_plane(self):
+        """Telemetry is best-effort BY CONTRACT: a metrics_source that
+        raises must not stop heartbeats, membership, or barriers."""
+        members = _spawn_cluster(2)
+        try:
+            def boom():
+                raise RuntimeError("metrics backend down")
+            for m in members:
+                m.metrics_source = boom
+            beats_at_boom = sum(
+                members[0].health()["heartbeats"].values())
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                h = members[0].health()
+                if sum(h["heartbeats"].values()) >= beats_at_boom + 3:
+                    break                   # beats flow despite boom
+                time.sleep(0.05)
+            assert sum(h["heartbeats"].values()) >= beats_at_boom + 3
+            assert h["dead"] == [] and h["alive"] == [0, 1]
+            for m in members:
+                m.check()                   # nobody raises
+            # barriers still work with telemetry broken
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(
+                    members[1].barrier("b", timeout=10)))
+            t.start()
+            members[0].barrier("b", timeout=10)
+            t.join(10)
+            assert len(done) == 1
+        finally:
+            _close_all(members)
+
+    def test_rtt_histogram_populated_by_live_beats(self):
+        """The worker side records a beat->ack round trip per heartbeat
+        into the process registry."""
+        from singa_tpu.observability import metrics as obs_metrics
+        hist = obs_metrics.default_registry().histogram(
+            "cluster_heartbeat_rtt_seconds")
+        before = hist.summary()["count"]
+        members = _spawn_cluster(2)
+        try:
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                if hist.summary()["count"] > before:
+                    break
+                time.sleep(0.05)
+            s = hist.summary()
+            assert s["count"] > before
+            assert s["max"] < 30.0          # sane wall-clock RTTs
+        finally:
+            _close_all(members)

@@ -177,3 +177,52 @@ class TestExamples:
         # committed checkpoint is step 4, so the rerun repeats step 5
         assert "continuing at step 5" in out2, out2
         assert "training complete" in out2
+
+
+class TestTelemetryExample:
+    """--telemetry DIR: the end-of-run dump contract — live span JSONL,
+    a schema-valid metrics snapshot, and its Prometheus rendering, all
+    consumable by tools/metrics_dump.py."""
+
+    def test_train_cnn_telemetry_dump(self, tmp_path):
+        import json
+
+        tel = str(tmp_path / "tel")
+        out = run_example(["examples/train_cnn.py", "mlp", "synthetic",
+                           "--cpu", "--epochs", "1", "--iters", "2",
+                           "--bs", "8", "--telemetry", tel])
+        assert "telemetry written" in out, out[-500:]
+
+        # metrics.json is a valid singa-tpu-metrics/1 snapshot with the
+        # step histogram populated
+        from singa_tpu.observability import export
+        with open(os.path.join(tel, "metrics.json")) as f:
+            snap = json.load(f)
+        export.validate_snapshot(snap)
+        by_name = {m["name"]: m for m in snap["metrics"]}
+        assert "train_step_seconds" in by_name
+        (series,) = by_name["train_step_seconds"]["series"]
+        assert series["count"] >= 2
+
+        # the Prometheus rendering exists and names the same metric
+        with open(os.path.join(tel, "metrics.prom")) as f:
+            prom = f.read()
+        assert "# TYPE train_step_seconds histogram" in prom
+
+        # spans.jsonl streamed live: compile + per-step spans
+        with open(os.path.join(tel, "spans.jsonl")) as f:
+            recs = [json.loads(ln) for ln in f]
+        names = [r["name"] for r in recs]
+        assert "compile" in names and "step" in names
+
+        # and the CLI converts the snapshot (the post-mortem workflow)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+        proc = subprocess.run(
+            [sys.executable, "tools/metrics_dump.py",
+             os.path.join(tel, "metrics.json")],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "train_step_seconds_count" in proc.stdout

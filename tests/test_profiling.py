@@ -2,7 +2,10 @@
 src/core/scheduler/scheduler.cc:240-298, Graph::Debug scheduler.cc:109-238,
 device knobs include/singa/core/device.h:115-129)."""
 
+import os
+
 import numpy as np
+import pytest
 
 from singa_tpu import device, layer, model, opt, tensor
 
@@ -200,3 +203,130 @@ def test_enrich_folds_metadata_into_fusion_symbols():
     # oversized metadata is truncated, not dropped
     out = _enrich("fusion.1", {"tf_op": "x" * 500})
     assert len(out) < 200 and out.startswith("fusion.1|xxx")
+
+
+class TestProfilerFailureDegradation:
+    """measure_step_fusions must degrade, never mask: a broken profiler
+    yields an empty table (the step result still returned); a broken
+    STEP propagates untouched (re-running an expensive failing step to
+    hide a profiling problem would double the damage)."""
+
+    def test_trace_entry_failure_degrades_to_empty_table(
+            self, monkeypatch):
+        import jax
+
+        from singa_tpu import profiling as prof
+
+        class BrokenTrace:
+            def __init__(self, *a, **k):
+                raise RuntimeError("profiler unavailable")
+
+        monkeypatch.setattr(jax.profiler, "trace", BrokenTrace)
+        result, table = prof.measure_step_fusions(lambda: 42)
+        assert result == 42 and table == {}
+
+    def test_trace_exit_failure_degrades_to_empty_table(
+            self, monkeypatch):
+        import jax
+
+        from singa_tpu import profiling as prof
+
+        class ExplodingExit:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                raise RuntimeError("trace finalization failed")
+
+        monkeypatch.setattr(jax.profiler, "trace", ExplodingExit)
+        result, table = prof.measure_step_fusions(lambda: "ok")
+        assert result == "ok" and table == {}
+
+    def test_step_failure_propagates_untouched(self):
+        from singa_tpu import profiling as prof
+
+        def bad_step():
+            raise ValueError("the step itself is broken")
+
+        with pytest.raises(ValueError, match="step itself"):
+            prof.measure_step_fusions(bad_step)
+
+    def test_parse_failure_degrades_to_empty_table(self, monkeypatch):
+        from singa_tpu import profiling as prof
+
+        monkeypatch.setattr(
+            prof, "parse_trace_dir",
+            lambda d: (_ for _ in ()).throw(RuntimeError("bad trace")))
+        result, table = prof.measure_step_fusions(lambda: 1)
+        assert result == 1 and table == {}
+
+    def test_temp_trace_dir_cleaned_up(self, monkeypatch, tmp_path):
+        import tempfile
+
+        from singa_tpu import profiling as prof
+
+        made = []
+        real = tempfile.mkdtemp
+
+        def spy(**kw):
+            d = real(dir=str(tmp_path), **kw)
+            made.append(d)
+            return d
+
+        monkeypatch.setattr(tempfile, "mkdtemp", spy)
+        prof.measure_step_fusions(lambda: None)
+        assert made and not os.path.exists(made[0])
+
+
+class TestProfileStepAPI:
+    """Model.profile_step: the on-demand per-fusion decomposition,
+    recorded into the metrics registry AND folded into the device's
+    profiling table like the verbosity>=2 path."""
+
+    def test_profile_step_returns_result_and_table(self):
+        m, dev, tx, ty = make_model(verbosity=0)
+        for _ in range(2):      # past the eager first step
+            m(tx, ty)
+        result, table = m.profile_step(tx, ty)
+        out, loss = result
+        assert np.isfinite(float(np.asarray(loss.data)))
+        assert table, "empty fusion table from a live profiler"
+        for name, (cnt, tot) in table.items():
+            assert cnt >= 1 and tot >= 0.0, (name, cnt, tot)
+
+    def test_profile_step_records_into_registry_and_device(self):
+        from singa_tpu.observability import metrics as obs_metrics
+
+        m, dev, tx, ty = make_model(verbosity=0)
+        for _ in range(2):
+            m(tx, ty)
+        _, table = m.profile_step(tx, ty)
+        rows = {k: v for k, v in dev.time_profiling.items()
+                if k.startswith("fusion/")}
+        assert set(rows) == {f"fusion/{n}" for n in table}
+        g = obs_metrics.default_registry().get("profile_fusion_seconds")
+        assert g is not None
+        doc = {tuple(s["labels"].values())[0]: s["value"]
+               for s in g.to_doc()["series"]}
+        for name, (cnt, tot) in table.items():
+            assert doc[name] == tot
+
+    def test_profile_step_degrades_with_broken_profiler(
+            self, monkeypatch):
+        import jax
+
+        class BrokenTrace:
+            def __init__(self, *a, **k):
+                raise RuntimeError("no profiler")
+
+        m, dev, tx, ty = make_model(verbosity=0)
+        for _ in range(2):
+            m(tx, ty)
+        monkeypatch.setattr(jax.profiler, "trace", BrokenTrace)
+        result, table = m.profile_step(tx, ty)
+        assert table == {}
+        _, loss = result
+        assert np.isfinite(float(np.asarray(loss.data)))

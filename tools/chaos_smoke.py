@@ -40,10 +40,17 @@ the bug this suite exists to catch.
 
 Usage::
 
-    python tools/chaos_smoke.py [--budget 420] [--keep-dirs]
+    python tools/chaos_smoke.py [--budget 420] [--keep-dirs] \
+        [--summary-json PATH]
+
+Every kill/restart scenario also measures the restarted run's
+``first_step_latency_s`` (run() entry to first completed step) and
+banks it in the end-of-run measurement summary — the cold-start
+regression series the persistent-compile-cache work gates on.
 """
 
 import argparse
+import json
 import os
 import shutil
 import socket
@@ -121,6 +128,37 @@ def _check(ok, what, detail=""):
     print(f"  ok: {what}")
 
 
+# scenario name -> banked measurements (restart-to-first-step latency);
+# printed as one JSON line at the end and written via --summary-json —
+# the regression series the persistent-compile-cache work will gate on
+BANK = {}
+
+
+def _run_summary(out):
+    """The trainer's end-of-run summary dict from a subprocess's
+    output (the LAST ``summary {...}`` line — a restarted run prints
+    exactly one)."""
+    docs = [ln.split("summary ", 1)[1] for ln in out.splitlines()
+            if ": summary {" in ln]
+    return json.loads(docs[-1]) if docs else None
+
+
+def _bank_restart_latency(scenario, out, leg="restart"):
+    """Measure and ASSERT restart-to-first-step latency: every
+    restarted run must report ``first_step_latency_s`` (run() entry to
+    first completed step — compile + restore + first batch, the
+    cold-start number the ROADMAP wants gated). Banked per scenario."""
+    s = _run_summary(out)
+    _check(s is not None, f"{scenario}/{leg}: run summary found")
+    lat = s.get("first_step_latency_s")
+    _check(isinstance(lat, (int, float)) and 0 < lat < 300,
+           f"{scenario}/{leg}: restart-to-first-step latency measured "
+           f"({lat if lat is None else round(lat, 3)}s)", out)
+    BANK.setdefault(scenario, {})[f"{leg}_first_step_latency_s"] = \
+        round(float(lat), 4)
+    return lat
+
+
 def scenario_dead_rank_elastic(root, budget):
     d = os.path.join(root, "ck")
     dumps = os.path.join(root, "dumps")
@@ -146,6 +184,7 @@ def scenario_dead_rank_elastic(root, budget):
                              ["--dump-restored", restored])], budget)
     _check(rcs2 == [0], f"world-1 restart completes (got {rcs2})",
            outs2[0])
+    _bank_restart_latency("dead-rank-elastic", outs2[0])
     _check(f"continuing at step {last + 1}" in outs2[0],
            f"resumed at step {last + 1} from committed step {last}",
            outs2[0])
@@ -181,6 +220,7 @@ def scenario_commit_hole(root, budget):
     _check(rcs2 == [0] and f"continuing at step {last + 1}" in outs2[0],
            "restart refuses the unmarked step, resumes after step "
            f"{last}", outs2[0])
+    _bank_restart_latency("commit-hole", outs2[0])
 
 
 def scenario_barrier_missing(root, budget):
@@ -230,6 +270,7 @@ def scenario_bitflip_restore(root, budget):
                              ["--dump-restored", restored],
                              steps=12)], budget)
     _check(rcs2 == [0], f"restart completes (got {rcs2})", outs2[0])
+    _bank_restart_latency("bitflip-restore", outs2[0])
     _check(f"dumped restored state of step {prev}" in outs2[0],
            f"corrupt step {last} refused; restore fell back to "
            f"verified step {prev}", outs2[0])
@@ -324,6 +365,7 @@ def scenario_data_resume(root, budget):
                              ["--dump-sample-ids", ids], steps=20)],
                        budget)
     _check(rcs2 == [0], f"resumed run completes (got {rcs2})", outs2[0])
+    _bank_restart_latency("data-resume", outs2[0])
     _check("data stream rewound" in outs2[0],
            "resume rewound the data stream to the checkpointed offset",
            outs2[0])
@@ -376,6 +418,7 @@ def scenario_data_resume(root, budget):
                        budget)
     _check(rcs2 == [0] and "elastic restart" in outs2[0],
            f"world-1 elastic restart completes (got {rcs2})", outs2[0])
+    _bank_restart_latency("data-resume", outs2[0], leg="elastic-restart")
     got = _final_ids(ids3)
     flat = np.concatenate([got[k] for k in sorted(got)])
     stream = _expected_stream(len(flat))
@@ -455,6 +498,9 @@ def main():
     ap.add_argument("--keep-dirs", action="store_true")
     ap.add_argument("--only", default=None,
                     help="run a single scenario by name")
+    ap.add_argument("--summary-json", default=None, metavar="PATH",
+                    help="write the banked per-scenario measurements "
+                         "(restart-to-first-step latencies) to PATH")
     args = ap.parse_args()
 
     budget = Budget(args.budget)
@@ -485,6 +531,15 @@ def main():
         else:
             print(f"[chaos] dirs kept under {root}")
     took = time.monotonic() - t0
+    # the banked measurements (restart-to-first-step latency per
+    # kill/restart scenario): the cold-start regression series
+    if BANK:
+        print(f"[chaos] measurements {json.dumps(BANK, sort_keys=True)}")
+    if args.summary_json:
+        with open(args.summary_json, "w") as f:
+            json.dump({"took_s": round(took, 1), "failed": failed,
+                       "scenarios": BANK}, f, indent=2, sort_keys=True)
+        print(f"[chaos] measurements written to {args.summary_json}")
     if failed:
         print(f"[chaos] FAILED {failed} in {took:.0f}s")
         sys.exit(1)
