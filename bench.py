@@ -520,7 +520,103 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             except Exception as e:
                 res["lm_bf16_error"] = str(e)[:200]
             _emit_partial(res, "lm_bf16")
+    # serving leg (every platform — the engine is CPU-runnable): decode
+    # tok/s + p99 per-token latency of the continuous-batching engine,
+    # banked per record so the serving trajectory is visible in
+    # BENCH_*.json like the training legs'
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            res["serving"] = _leg_guard(
+                lambda: _measure_serving(dev), leg_budget, "serving")
+        except TimeoutError as e:
+            res["serving_error"] = str(e)[:200]
+            res["leg_timeout"] = "serving"
+        except Exception as e:
+            res["serving_error"] = str(e)[:200]
+        _emit_partial(res, "serving")
     return res
+
+
+def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
+                     n_requests=16, new_tokens=32):
+    """The banked serving leg: decode throughput and tail token latency
+    of the continuous-batching engine over a small TransformerLM.
+
+    A private metrics registry keeps bench runs out of the process
+    SLO series; the numbers come from the engine's own histograms —
+    ``decode_tok_s`` is generated tokens over summed decode-tick time,
+    ``p99_token_s`` the p99 of ``serve_token_seconds`` (the quantile
+    summaries the snapshot now carries). The leg also asserts the
+    serve-path invariant: the decode program traced exactly once."""
+    import numpy as np
+
+    from singa_tpu import tensor
+    from singa_tpu.models import transformer
+    from singa_tpu.observability import metrics as obs_metrics
+    from singa_tpu.observability.export import series_quantiles
+
+    vocab = 512
+    model = transformer.TransformerLM(vocab, d_model=128, n_heads=4,
+                                      n_layers=2, max_len=max_len,
+                                      tp=False)
+    model.eval()
+    model(tensor.Tensor(data=np.zeros((1, prefill_len), np.float32),
+                        device=dev, requires_grad=False))
+    reg = obs_metrics.MetricsRegistry()
+    eng = model.compile_serving(slots=slots, max_len=max_len,
+                                prefill_len=prefill_len, registry=reg)
+    rng = np.random.RandomState(0)
+    futs = [eng.submit(rng.randint(1, vocab,
+                                   (int(rng.randint(1, prefill_len)),)),
+                       max_new_tokens=new_tokens)
+            for _ in range(n_requests)]
+    # warmup: compile both programs off the clock
+    eng.run_until_idle()
+    for f in futs:
+        f.result(timeout=1)
+
+    def _series():
+        return reg.get("serve_token_seconds").to_doc()["series"][0]
+
+    tok0 = reg.get("serve_tokens_total").total()
+    pre0 = reg.get("serve_prefill_total").total()
+    before = _series()
+    futs = [eng.submit(rng.randint(1, vocab,
+                                   (int(rng.randint(1, prefill_len)),)),
+                       max_new_tokens=new_tokens)
+            for _ in range(n_requests)]
+    t0 = time.perf_counter()
+    eng.run_until_idle()
+    wall = time.perf_counter() - t0
+    for f in futs:
+        f.result(timeout=1)
+    info = eng.compiled_step_info()
+    assert info["n_traces"] == 1, f"decode retraced: {info}"
+    # each prefill samples one token OUTSIDE any decode tick: the
+    # decode-throughput numerator is decode-produced tokens only, so
+    # the ratio stays honest at any new_tokens setting
+    tok = reg.get("serve_tokens_total").total() - tok0
+    tok -= reg.get("serve_prefill_total").total() - pre0
+    after = _series()
+    # warmup ticks carry the XLA compile: the banked numbers are the
+    # STEADY-state wave, so subtract the pre-wave series
+    delta = {
+        "count": after["count"] - before["count"],
+        "sum": after["sum"] - before["sum"],
+        "buckets": [[le, ca - cb] for (le, ca), (_le, cb)
+                    in zip(after["buckets"], before["buckets"])],
+    }
+    q = series_quantiles(delta)
+    s = delta
+    eng.stop()
+    return {
+        "decode_tok_s": (tok / s["sum"]) if s["sum"] else None,
+        "p99_token_s": q.get("p99"),
+        "p50_token_s": q.get("p50"),
+        "wall_tok_s": tok / wall if wall > 0 else None,
+        "slots": slots, "new_tokens": new_tokens,
+        "n_requests": n_requests,
+    }
 
 
 def _setup_lm_step(dev, batch=8, seq=None, compute_dtype=None):

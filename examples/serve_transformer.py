@@ -1,0 +1,143 @@
+"""Serve a TransformerLM behind the continuous-batching engine and the
+stdlib HTTP gateway — the serving counterpart of train_elastic.py: real
+enough to chaos-test, small enough to read.
+
+Modes:
+
+- default: start the engine + gateway, print ``READY port=N``, then
+  block until SIGTERM/SIGINT. The signal triggers a **graceful drain**
+  (in-flight and queued requests all finish, new ones get 503) and the
+  process exits 0 (``serving.EXIT_DRAINED``) — kill -TERM is how a
+  supervisor rolls a replica, and exit 0 tells it the drain completed.
+- ``--selftest N``: additionally fire N generation requests at the own
+  gateway from client threads, assert every one returns exactly once
+  with the requested token count and that the decode program traced
+  exactly once, print ``SELFTEST OK`` and exit 0 (the CI smoke).
+
+Usage::
+
+    python examples/serve_transformer.py --cpu --port 8901
+    curl -d '{"prompt": [1,2,3], "max_new_tokens": 8}' \
+        http://127.0.0.1:8901/v1/generate
+    curl -X POST http://127.0.0.1:8901/drain     # or: kill -TERM <pid>
+"""
+
+import argparse
+import json
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def _post(port, path, doc, timeout=120.0):
+    import http.client
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("POST", path, json.dumps(doc),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, json.loads(r.read().decode() or "{}")
+    finally:
+        c.close()
+
+
+def _selftest(port, n, vocab, new_tokens=8):
+    rng = np.random.RandomState(0)
+    results = [None] * n
+
+    def one(i):
+        prompt = rng.randint(1, vocab, (int(rng.randint(1, 8)),)).tolist()
+        results[i] = _post(port, "/v1/generate",
+                           {"prompt": prompt,
+                            "max_new_tokens": new_tokens,
+                            "temperature": 0.5, "seed": i})
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    bad = [(i, r) for i, r in enumerate(results)
+           if r is None or r[0] != 200
+           or len(r[1].get("tokens", [])) != new_tokens]
+    if bad:
+        raise SystemExit(f"SELFTEST FAILED: {bad[:3]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0,
+                    help="gateway port (0 = ephemeral, printed as READY)")
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--prefill-len", type=int, default=16)
+    ap.add_argument("--policy", default=None,
+                    help="mixed-precision policy name (e.g. bf16_mixed)")
+    ap.add_argument("--selftest", type=int, default=0, metavar="N",
+                    help="fire N requests at the own gateway, verify, "
+                         "exit 0")
+    ap.add_argument("--drain-timeout", type=float, default=60.0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from singa_tpu import device, tensor
+    from singa_tpu.models import transformer
+    from singa_tpu.serving import ServingReplica, serve_gateway
+
+    dev = device.create_cpu_device() if args.cpu \
+        else device.create_tpu_device()
+    dev.SetRandSeed(0)
+    model = transformer.TransformerLM(
+        args.vocab, d_model=args.d_model, n_heads=args.heads,
+        n_layers=args.layers, max_len=args.max_len, tp=False)
+    model.eval()
+    # one eager forward materialises the lazily-initialised params the
+    # serving adapter host-gathers
+    model(tensor.Tensor(
+        data=np.zeros((1, args.prefill_len), np.float32), device=dev,
+        requires_grad=False))
+
+    engine = model.compile_serving(
+        slots=args.slots, max_len=args.max_len,
+        prefill_len=args.prefill_len, policy=args.policy)
+    replica = ServingReplica(engine, name=f"serve-{args.port}")
+    replica.install_signal_handlers()
+    replica.start()
+    server, port = serve_gateway(engine, port=args.port,
+                                 replica=replica)
+    print(f"READY port={port}", flush=True)
+
+    if args.selftest:
+        _selftest(port, args.selftest, args.vocab)
+        info = engine.compiled_step_info()
+        assert info["n_traces"] == 1, \
+            f"decode retraced: {info['n_traces']}"
+        replica.request_drain()
+        code = replica.drain(timeout=args.drain_timeout)
+        server.shutdown()
+        server.server_close()
+        print(f"SELFTEST OK n={args.selftest} n_traces=1 "
+              f"drain_exit={code}", flush=True)
+        return code
+
+    code = replica.run_until_drained(timeout=args.drain_timeout)
+    # stop accepting, then join in-flight handler threads: every
+    # admitted request's HTTP response is written before exit
+    server.shutdown()
+    server.server_close()
+    print(f"DRAINED exit={code}", flush=True)
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,7 +34,8 @@ from .mixed_precision import Policy  # noqa: F401
 
 _LAZY = ("sonnx", "io", "data", "datasets", "image_tool", "net",
          "snapshot", "native", "channel", "caffe", "network",
-         "checkpoint", "profiling", "resilience", "observability")
+         "checkpoint", "profiling", "resilience", "observability",
+         "serving")
 
 
 def __getattr__(name):

@@ -317,6 +317,36 @@ class Model(Layer):
             "XLA trace/compile itself lands on the first step)"
         ).observe(time.perf_counter() - t0)
 
+    def compile_serving(self, policy=None, **kw):
+        """Build this model's inference engine (``singa_tpu.serving``):
+        the serving sibling of :meth:`compile`.
+
+        Autoregressive models (anything exposing ``decode_adapter`` —
+        the transformer and char-rnn zoo models) get a continuous-
+        batching :class:`~singa_tpu.serving.ServingEngine`: two
+        AOT-compiled fixed-shape programs (batched prefill writing a
+        donated ring KV cache; a one-token O(1) decode step) over a
+        ``slots``-wide in-flight slot array. Everything else — the
+        classifier zoo, ONNX imports through ``sonnx.SONNXModel`` —
+        serves through a fixed-width
+        :class:`~singa_tpu.serving.BatchServingEngine` (pass
+        ``input_shape=`` for the per-sample shape).
+
+        ``policy``: a mixed-precision :class:`Policy` or name
+        (``"bf16_mixed"`` serves in bf16 compute with an f32 head/
+        logits). Defaults to the policy this model was last
+        ``compile``d with, so a bf16-trained model serves bf16 out of
+        the box. The engine is returned un-started; call ``.start()``
+        for the background loop or drive ``step()`` synchronously.
+        Other ``kw`` (``slots``, ``max_len``, ``prefill_len``,
+        ``queue_capacity``, ``faults``, ``registry``, ...) pass through
+        to the engine."""
+        from . import mixed_precision as mp
+        from .serving import build_engine
+        pol = mp.resolve(policy) if policy is not None \
+            else getattr(self, "_policy", None)
+        return build_engine(self, policy=pol, **kw)
+
     def _compile_body(self, inputs, is_train, use_graph, sequential,
                       policy):
         from . import mixed_precision as mp

@@ -46,4 +46,5 @@ class TrainStepMixin:
 
 from . import (mlp, cnn, alexnet, resnet, xceptionnet,  # noqa: F401,E402
                transformer, gan, rbm, char_rnn, qabot,
-               vgg, squeezenet, mobilenet, densenet, shufflenet)
+               vgg, squeezenet, mobilenet, densenet, shufflenet,
+               decode)

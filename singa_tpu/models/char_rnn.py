@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import autograd, layer, model, opt, tensor
+from .. import autograd, layer, model, opt
 from ..tensor import Tensor
 
 
@@ -92,8 +92,14 @@ class CharRNN(model.Model):
 
 
 def sample(model, start_ids, vocab_size, nsamples=100, use_max=False,
-           seed=0):
-    """Autoregressive sampling (reference char_rnn.py sample:164)."""
+           seed=0, temperature=1.0, top_k=None):
+    """Autoregressive sampling (reference char_rnn.py sample:164).
+
+    The token draw routes through the ONE shared sampling helper
+    (:func:`singa_tpu.models.decode.sample_logits`) — the same math the
+    transformer's ``generate()`` and the serving engine use.
+    ``use_max=True`` is greedy (``temperature=0``)."""
+    from . import decode as _decode
     rng = np.random.RandomState(seed)
     ids = list(start_ids)
     out_ids = []
@@ -106,17 +112,144 @@ def sample(model, start_ids, vocab_size, nsamples=100, use_max=False,
         x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[i]],
                    requires_grad=False)
         h, c = model.rnn.step_forward(x, h, c)
+    temp = 0 if use_max else temperature
     for _ in range(nsamples):
-        logits = model.dense(h)
-        probs = np.asarray(
-            tensor.softmax(logits).numpy()).ravel()
-        cur = int(np.argmax(probs)) if use_max else \
-            int(rng.choice(vocab_size, p=probs / probs.sum()))
+        logits = np.asarray(model.dense(h).numpy()).ravel()
+        cur = _decode.sample_logits(logits, temperature=temp,
+                                    top_k=top_k, rng=rng)
         out_ids.append(cur)
         x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[cur]],
                    requires_grad=False)
         h, c = model.rnn.step_forward(x, h, c)
     return out_ids
+
+
+class _CharRNNServeAdapter:
+    """Serving-engine adapter for the stateful LSTM LM: the "cache" is
+    just each slot's ``(h, c)`` recurrent state — O(1) per token by
+    construction, no ring needed (``max_len`` is accepted and ignored).
+    Same prefill/decode signatures as the transformer adapter, so the
+    engine is model-agnostic. A mixed-precision policy is HONORED, not
+    just reported: gates and state run in the policy's compute dtype,
+    logits return f32 (what ``compiled_step_info()["policy"]`` claims
+    must be what executes)."""
+
+    def __init__(self, m, policy=None):
+        self.m = m
+        self.policy = policy
+        if getattr(m.rnn, "Wx", None) is None:
+            raise RuntimeError(
+                "CharRNN serving needs initialized weights: run one "
+                "forward (or restore a checkpoint) before "
+                "compile_serving")
+
+    def _compute_dtype(self):
+        import jax.numpy as jnp
+        if self.policy is not None and \
+                self.policy.compute_dtype is not None:
+            return jnp.dtype(self.policy.compute_dtype)
+        return jnp.dtype(jnp.float32)
+
+    def params(self):
+        import jax
+        import jax.numpy as jnp
+
+        def a(t):
+            return jnp.asarray(np.asarray(jax.device_get(t.data)))
+
+        m = self.m
+        return {"Wx": a(m.rnn.Wx), "Wh": a(m.rnn.Wh), "b": a(m.rnn.b),
+                "dense_w": a(m.dense.W), "dense_b": a(m.dense.b)}
+
+    def init_cache(self, slots, max_len):
+        import jax.numpy as jnp
+        H = self.m.hidden_size
+        cdt = self._compute_dtype()
+        return {"h": jnp.zeros((int(slots), H), cdt),
+                "c": jnp.zeros((int(slots), H), cdt)}
+
+    def _cell(self):
+        import jax
+        import jax.numpy as jnp
+        cdt = self._compute_dtype()
+
+        def cell(P, x, h, c):
+            H = h.shape[-1]
+            g = (x @ P["Wx"].astype(cdt) + h @ P["Wh"].astype(cdt)
+                 + P["b"].astype(cdt))
+            i = jax.nn.sigmoid(g[:, :H])
+            f = jax.nn.sigmoid(g[:, H:2 * H])
+            gg = jnp.tanh(g[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(g[:, 3 * H:])
+            c_new = f * c + i * gg
+            return o * jnp.tanh(c_new), c_new
+
+        return cell
+
+    @staticmethod
+    def _logits(P, h):
+        import jax.numpy as jnp
+        # the softmax-side output is f32 regardless of compute dtype
+        # (the transformer adapter's head convention)
+        return (h.astype(jnp.float32) @ P["dense_w"] + P["dense_b"])
+
+    def prefill_fn(self):
+        import jax
+        import jax.numpy as jnp
+        V = self.m.vocab_size
+        cell = self._cell()
+        cdt = self._compute_dtype()
+        logits_of = self._logits
+
+        def fn(P, cache, tokens, lengths, slot_ids, valid):
+            B, S = tokens.shape
+            H = cache["h"].shape[-1]
+            h0 = jnp.zeros((B, H), cdt)
+
+            def step(hc, t):
+                h, c = hc
+                x = jax.nn.one_hot(tokens[:, t], V, dtype=cdt)
+                h2, c2 = cell(P, x, h, c)
+                live = (t < lengths)[:, None]    # padded tail: freeze
+                return (jnp.where(live, h2, h),
+                        jnp.where(live, c2, c)), None
+
+            (h, c), _ = jax.lax.scan(step, (h0, h0), jnp.arange(S))
+            ch, cc = cache["h"], cache["c"]
+            for b in range(B):          # static width, masked writes
+                keep = valid[b]
+                ch = jnp.where(keep, ch.at[slot_ids[b]].set(h[b]), ch)
+                cc = jnp.where(keep, cc.at[slot_ids[b]].set(c[b]), cc)
+            return {"h": ch, "c": cc}, logits_of(P, h)
+
+        return fn
+
+    def decode_fn(self):
+        import jax
+        import jax.numpy as jnp
+        cell = self._cell()
+        V = self.m.vocab_size
+        cdt = self._compute_dtype()
+        logits_of = self._logits
+
+        def fn(P, cache, tokens, positions, active):
+            x = jax.nn.one_hot(tokens, V, dtype=cdt)
+            h2, c2 = cell(P, x, cache["h"], cache["c"])
+            live = active[:, None]
+            h = jnp.where(live, h2, cache["h"])
+            c = jnp.where(live, c2, cache["c"])
+            return {"h": h, "c": c}, logits_of(P, h)
+
+        return fn
+
+
+def _decode_adapter(self, policy=None):
+    """Serving entry point (``Model.compile_serving``): adapter over
+    this CharRNN's live weights."""
+    return _CharRNNServeAdapter(self, policy=policy)
+
+
+CharRNN.decode_adapter = _decode_adapter
 
 
 def create_model(vocab_size=101, hidden_size=32, **kwargs):

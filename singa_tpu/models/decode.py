@@ -1,0 +1,79 @@
+"""The ONE token-sampling path every decode surface shares.
+
+Greedy / temperature / top-k sampling used to live three times — the
+char-rnn sampler, the transformer's KV-cache ``generate()``, and (with
+this PR) the serving engine would have made a fourth. One wrong-by-one
+top-k cut in any copy silently changes what a model says, so the rule
+is: the math lives HERE, tested once, and every caller — the examples,
+``CharRNN.sample``, ``TransformerLM.generate``, and
+``singa_tpu.serving`` — routes through it.
+
+Two variants with identical semantics:
+
+- :func:`sample_logits` — host-side numpy, one logits vector -> one
+  token id. What the serving engine uses per slot (per-request
+  temperature/top_k/rng without retracing the decode program) and what
+  the char-rnn sampler uses.
+- :func:`sample_logits_jax` — the traced form for in-graph decode loops
+  (``TransformerLM.generate``'s ``lax.scan``). ``temperature``/``top_k``
+  are static python values there (they key the jit cache, as before).
+
+``temperature == 0`` is greedy argmax in both. Ties break toward the
+lowest id (argmax semantics) in both, so greedy host and traced decode
+agree exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def apply_top_k(logits, top_k):
+    """Mask everything below the k-th largest logit to -inf (numpy,
+    last axis). ``top_k`` of None/0 or >= vocab is a no-op."""
+    logits = np.asarray(logits, np.float64)
+    k = int(top_k or 0)
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = np.sort(logits, axis=-1)[..., -k][..., None]
+    return np.where(logits < kth, -np.inf, logits)
+
+
+def sample_logits(logits, temperature=1.0, top_k=None, rng=None):
+    """Sample ONE token id from a 1-D logits vector (host side).
+
+    ``temperature == 0`` -> greedy argmax (``rng`` unused). Otherwise
+    softmax sampling at ``temperature`` over the ``top_k`` largest
+    logits (None/0 = full vocab), drawing from ``rng`` (a
+    ``numpy.random.RandomState``; a fresh seed-0 state when omitted, so
+    callers wanting reproducibility pass their own)."""
+    logits = np.asarray(logits, np.float64).reshape(-1)
+    if temperature == 0:
+        return int(np.argmax(logits))
+    lg = apply_top_k(logits / float(temperature), top_k)
+    lg = lg - np.max(lg)
+    p = np.exp(lg)
+    p = p / p.sum()
+    if rng is None:
+        rng = np.random.RandomState(0)
+    return int(rng.choice(len(p), p=p))
+
+
+def sample_logits_jax(logits, temperature, top_k, key):
+    """Traced twin of :func:`sample_logits` over the LAST axis of
+    ``logits`` (any leading batch dims). ``temperature``/``top_k`` are
+    static python values; ``key`` a jax PRNG key. Returns int32 ids."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if temperature == 0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    lg = logits / temperature
+    if top_k and int(top_k) < logits.shape[-1]:
+        kth = lax.top_k(lg, int(top_k))[0][..., -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    return jax.random.categorical(key, lg, -1).astype(jnp.int32)
+
+
+__all__ = ["apply_top_k", "sample_logits", "sample_logits_jax"]

@@ -433,13 +433,11 @@ def _generate(self, ids, max_new_tokens, temperature=1.0, top_k=None,
             return x, kc, vc
 
         def sample(logits, key):
-            if temperature == 0:
-                return jnp.argmax(logits, -1).astype(jnp.int32)
-            lg = logits / temperature
-            if top_k:
-                kth = lax.top_k(lg, top_k)[0][..., -1:]
-                lg = jnp.where(lg < kth, -jnp.inf, lg)
-            return jax.random.categorical(key, lg, -1).astype(jnp.int32)
+            # the ONE shared sampling path (models/decode.py): greedy /
+            # temperature / top-k math lives there, tested once, shared
+            # with char_rnn.sample and the serving engine
+            from .decode import sample_logits_jax
+            return sample_logits_jax(logits, temperature, top_k, key)
 
         @jax.jit
         def run(Pq, prompt, key):
@@ -485,3 +483,214 @@ def _generate(self, ids, max_new_tokens, temperature=1.0, top_k=None,
 
 
 TransformerLM.generate = _generate
+
+
+class _LMServeAdapter:
+    """Ring-cache prefill/decode adapter: the TransformerLM half of the
+    ``singa_tpu.serving.ServingEngine`` contract.
+
+    Exposes the two pure fixed-shape functions the engine AOT-compiles —
+
+    - ``prefill_fn``: ``(P, cache, tokens (B,S), lengths, slot_ids,
+      valid) -> (cache, logits (B,V))`` — a fixed-width batch of padded
+      prompts runs ONE causal forward and writes each prompt's k/v rows
+      into its assigned slot of the ring cache (``valid=False`` rows are
+      batch padding: computed, never written);
+    - ``decode_fn``: ``(P, cache, tokens (W,), positions (W,),
+      active (W,)) -> (cache, logits (W,V))`` — one token for every slot
+      in O(1): write the new k/v at ``pos % max_len``, attend over the
+      ring (``serving.kv_cache``), return next-token logits.
+
+    Freed-slot hygiene is arithmetic, not bookkeeping: a dead slot's
+    stale rows sit at ring indices the position mask only reaches once
+    the NEW occupant has overwritten them (prefill covers ``[0, len)``,
+    decode writes index ``p`` in the same tick the mask first admits
+    ``p``), so no cross-request leakage is possible by construction.
+
+    Mixed precision follows the training policy's contract: embeddings
+    and the head stay f32, block weights and the cache run in the
+    policy's compute dtype (bf16 serving out of the box), attention
+    softmax and the returned logits are f32.
+    """
+
+    def __init__(self, m, policy=None):
+        self.m = m
+        self.policy = policy
+        at = m.blocks[0].attn
+        if not at.causal:
+            raise NotImplementedError(
+                "serving needs a causal model; this TransformerLM was "
+                "built with causal=False")
+        self.n_heads = at.n_heads
+        self.head_dim = m.d_model // self.n_heads
+        self.scale = 1.0 / math.sqrt(self.head_dim)
+
+    def _compute_dtype(self):
+        import jax.numpy as jnp
+        if self.policy is not None and \
+                self.policy.compute_dtype is not None:
+            return jnp.dtype(self.policy.compute_dtype)
+        cd = self.m.compute_dtype
+        return jnp.dtype(cd) if cd is not None else jnp.dtype(jnp.float32)
+
+    def params(self):
+        return _lm_decode_params(self.m)
+
+    def validate(self, prefill_len, max_len):
+        """Engine-construction-time limits the engine itself can't see:
+        a prompt longer than the positional-embedding table would crash
+        the first compiled prefill with a shape error; fail typed and
+        early instead. (decode clips positions to the table — the ring
+        has made attention sliding-window by then — but prefill indexes
+        ``pos[:S]`` directly.)"""
+        table = int(self.m.pos_emb.input_dim)
+        if int(prefill_len) > table:
+            raise ValueError(
+                f"prefill_len {prefill_len} exceeds this model's "
+                f"positional-embedding table ({table} rows): rebuild "
+                f"the model with max_len >= {prefill_len} or lower "
+                "prefill_len")
+
+    def init_cache(self, slots, max_len):
+        from ..serving import kv_cache
+        return [kv_cache.init_cache(slots, self.n_heads, max_len,
+                                    self.head_dim, self._compute_dtype())
+                for _ in self.m.blocks]
+
+    def _mlp_apply(self):
+        import jax
+        mlp0 = self.m.blocks[0].mlp
+        act = jax.nn.gelu \
+            if getattr(mlp0, "activation", "gelu") == "gelu" \
+            else jax.nn.relu
+        if self.m.moe:
+            from ..parallel.moe import _MoEFFN
+            # drop-free capacity, expert axis inactive — the same decode
+            # convention generate() documents
+            moe_op = _MoEFFN(mlp0.n_experts, mlp0.top_k,
+                             float(mlp0.n_experts), None, ())
+        else:
+            moe_op = None
+
+        def mlp_apply(p, h2, c):
+            if "wg" in p:
+                Bq, Sq, Dq = h2.shape
+                y, _aux = moe_op.forward(h2.reshape(-1, Dq), p["wg"],
+                                         p["w1"], p["b1"], p["w2"],
+                                         p["b2"])
+                return y.reshape(h2.shape).astype(h2.dtype)
+            return (act(h2 @ c(p["w_up"]) + c(p["b_up"]))
+                    @ c(p["w_dn"]) + c(p["b_dn"]))
+
+        return mlp_apply
+
+    def _block(self):
+        """The ONE transformer-block body both serve programs share
+        (LN → QKV → attend → out-proj → LN → MLP). Only the
+        attention+cache step differs between prefill and decode, so it
+        is injected: ``attend(q, k, v, level) -> (merged_out, level)``.
+        One copy means the two compiled programs cannot drift from each
+        other."""
+        import jax.numpy as jnp
+        n_heads = self.n_heads
+        cdt = self._compute_dtype()
+        mlp_apply = self._mlp_apply()
+
+        def c(a):
+            return a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) \
+                else a
+
+        def block(p, x, level, attend):
+            h = _ln(x, p["ln1_s"], p["ln1_b"])
+            q = _split_heads(h @ c(p["wq"]) + c(p["bq"]), n_heads)
+            k = _split_heads(h @ c(p["wk"]) + c(p["bk"]), n_heads)
+            v = _split_heads(h @ c(p["wv"]) + c(p["bv"]), n_heads)
+            o, level = attend(q, k, v, level)
+            x = x + (o.astype(x.dtype) @ c(p["wo"]) + c(p["bo"]))
+            return x + mlp_apply(p, _ln(x, p["ln2_s"], p["ln2_b"]), c), \
+                level
+
+        return block, c, cdt
+
+    def prefill_fn(self):
+        import jax
+        import jax.numpy as jnp
+        from ..serving import kv_cache
+        scale = self.scale
+        block, _c, cdt = self._block()
+
+        def fn(P, cache, tokens, lengths, slot_ids, valid):
+            B, S = tokens.shape
+            x = (jnp.take(P["tok"], tokens, axis=0)
+                 + P["pos"][None, :S]).astype(cdt)
+            causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
+
+            def attend(q, k, v, level):
+                s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                               k.astype(jnp.float32)) * scale
+                att = jax.nn.softmax(jnp.where(causal, s, -jnp.inf), -1)
+                o = _merge_heads(jnp.einsum("bhqk,bhkd->bhqd", att,
+                                            v.astype(jnp.float32)))
+                # B is a static prefill-batch width: this unrolls into
+                # B masked slot writes inside the ONE compiled program
+                for b in range(B):
+                    level = kv_cache.write_prompt(
+                        level, slot_ids[b], k[b], v[b], valid[b])
+                return o, level
+
+            new_cache = []
+            for p, level in zip(P["blocks"], cache):
+                x, level = block(p, x, level, attend)
+                new_cache.append(level)
+            hN = _ln(x, P["lnf_s"], P["lnf_b"])
+            h_last = jnp.take_along_axis(
+                hN, (lengths - 1).astype(jnp.int32)[:, None, None]
+                .clip(0), axis=1)[:, 0]
+            logits = (h_last.astype(jnp.float32) @ P["head_w"]
+                      + P["head_b"])
+            return new_cache, logits
+
+        return fn
+
+    def decode_fn(self):
+        import jax.numpy as jnp
+        from ..serving import kv_cache
+        scale = self.scale
+        block, _c, cdt = self._block()
+
+        def fn(P, cache, tokens, positions, active):
+            positions = positions.astype(jnp.int32)
+            # the learned position table is finite; a sequence decoding
+            # past it holds the last embedding (the ring has already
+            # made attention sliding-window by then)
+            pos_ids = jnp.minimum(positions, P["pos"].shape[0] - 1)
+            x = (jnp.take(P["tok"], tokens, axis=0)
+                 + jnp.take(P["pos"], pos_ids, axis=0))[:, None, :] \
+                .astype(cdt)
+
+            def attend(q, k, v, level):
+                level = kv_cache.write_token(
+                    level, k[:, :, 0], v[:, :, 0], positions)
+                return _merge_heads(kv_cache.attend(
+                    q, level, positions, scale)), level
+
+            new_cache = []
+            for p, level in zip(P["blocks"], cache):
+                x, level = block(p, x, level, attend)
+                new_cache.append(level)
+            hN = _ln(x, P["lnf_s"], P["lnf_b"])[:, 0]
+            logits = (hN.astype(jnp.float32) @ P["head_w"]
+                      + P["head_b"])
+            return new_cache, logits
+
+        return fn
+
+
+def _decode_adapter(self, policy=None):
+    """The serving engine's entry point (``Model.compile_serving``
+    routes autoregressive models here): a :class:`_LMServeAdapter` over
+    this model's live (host-gathered) weights."""
+    return _LMServeAdapter(self, policy=policy)
+
+
+TransformerLM.decode_adapter = _decode_adapter

@@ -16,6 +16,63 @@ import threading
 from .metrics import SNAPSHOT_SCHEMA, default_registry
 
 
+# the quantile summaries every histogram series exports (serving SLOs
+# read p99 token latency straight off the snapshot)
+QUANTILES = {"p50": 0.5, "p95": 0.95, "p99": 0.99}
+
+
+def bucket_quantile(buckets, count, q, lo=None, hi=None):
+    """Estimate the ``q``-quantile of one histogram series from its
+    CUMULATIVE ``[le, count]`` buckets (Prometheus ``histogram_quantile``
+    style: linear interpolation inside the containing bucket), clamped
+    to the series' exact observed ``[lo, hi]`` extrema when given — so a
+    single-observation histogram reports the exact value and no
+    quantile can stray outside what was actually seen. Returns None for
+    an empty series."""
+    count = int(count or 0)
+    if count <= 0:
+        return None
+    target = float(q) * count
+    prev_le, prev_cum = None, 0
+    val = None
+    for le, cum in buckets:
+        if cum >= target and cum > prev_cum:
+            if le == "+Inf":
+                # the overflow bucket has no upper edge; the exact max
+                # (when known) is the honest answer, else the last
+                # finite edge
+                val = hi if hi is not None else prev_le
+            else:
+                lower = prev_le if prev_le is not None \
+                    else (lo if lo is not None else 0.0)
+                lower = min(float(lower), float(le))
+                frac = (target - prev_cum) / (cum - prev_cum)
+                val = lower + (float(le) - lower) * frac
+            break
+        if le != "+Inf":
+            prev_le = float(le)
+        prev_cum = cum
+    if val is None:
+        return None
+    if lo is not None:
+        val = max(val, float(lo))
+    if hi is not None:
+        val = min(val, float(hi))
+    return val
+
+
+def series_quantiles(series, quantiles=None):
+    """``{"p50": v, "p95": v, "p99": v}`` for one histogram series doc
+    (``buckets``/``count`` plus optional exact ``min``/``max``).
+    Values are None when the series is empty."""
+    qs = quantiles if quantiles is not None else QUANTILES
+    return {name: bucket_quantile(series.get("buckets") or [],
+                                  series.get("count"), q,
+                                  lo=series.get("min"),
+                                  hi=series.get("max"))
+            for name, q in qs.items()}
+
+
 def _prom_escape(v):
     return str(v).replace("\\", r"\\").replace('"', r'\"') \
         .replace("\n", r"\n")
@@ -49,6 +106,15 @@ def render_prometheus(snapshot):
                              f"{s['sum']}")
                 lines.append(f"{name}_count{_labels_text(labels)} "
                              f"{s['count']}")
+                # quantile summaries as sibling untyped samples
+                # (`<name>_p99`, not `<name>{quantile=}` — the latter
+                # is reserved for TYPE summary and would make the
+                # histogram exposition invalid)
+                for qname, qv in (s.get("quantiles") or {}).items():
+                    if qv is not None:
+                        lines.append(
+                            f"{name}_{qname}{_labels_text(labels)} "
+                            f"{qv}")
             else:
                 lines.append(f"{name}{_labels_text(labels)} "
                              f"{s['value']}")
@@ -93,6 +159,10 @@ def validate_snapshot(doc):
                     raise ValueError(
                         f"metric {name}: +Inf bucket {counts[-1]} != "
                         f"count {s['count']}")
+                if "quantiles" in s and \
+                        not isinstance(s["quantiles"], dict):
+                    raise ValueError(
+                        f"metric {name}: quantiles is not a dict")
             elif "value" not in s:
                 raise ValueError(f"metric {name}: series missing value")
     return doc
@@ -139,4 +209,5 @@ def serve_metrics(registry=None, host="127.0.0.1", port=0):
     return server, server.server_address[1]
 
 
-__all__ = ["render_prometheus", "validate_snapshot", "serve_metrics"]
+__all__ = ["render_prometheus", "validate_snapshot", "serve_metrics",
+           "bucket_quantile", "series_quantiles", "QUANTILES"]

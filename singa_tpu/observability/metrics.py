@@ -194,15 +194,20 @@ class Histogram(_Metric):
                 "count": 0, "min": math.inf, "max": -math.inf}
 
     def _series_doc(self, key, slot):
+        # lazy import: export renders snapshots (imports this module);
+        # the quantile math lives beside the other exposition helpers
+        from .export import series_quantiles
         cum, acc = [], 0
         for le, c in zip(self.buckets, slot["counts"]):
             acc += c
             cum.append([le, acc])
         cum.append(["+Inf", slot["count"]])
-        return {"count": slot["count"], "sum": slot["sum"],
-                "min": None if slot["count"] == 0 else slot["min"],
-                "max": None if slot["count"] == 0 else slot["max"],
-                "buckets": cum}
+        doc = {"count": slot["count"], "sum": slot["sum"],
+               "min": None if slot["count"] == 0 else slot["min"],
+               "max": None if slot["count"] == 0 else slot["max"],
+               "buckets": cum}
+        doc["quantiles"] = series_quantiles(doc)
+        return doc
 
     def observe(self, value, **labels):
         value = float(value)

@@ -1,0 +1,45 @@
+"""TPU-native inference: continuous batching over AOT-compiled
+fixed-shape programs.
+
+The training runtime's hard-won invariants, applied to serving:
+
+- **one trace, forever** — the decode program's
+  ``compiled_step_info()["n_traces"]`` must stay 1 across ANY pattern of
+  requests finishing mid-batch and new ones refilling their slots (the
+  ``pad_last`` validity-mask idiom from the data pipeline, CI-pinned
+  like the train step's retrace guard);
+- **O(1) per token** — each slot owns a ring of KV rows
+  (:mod:`.kv_cache`); work and memory per emitted token are constant;
+- **exactly-once delivery** — every submitted request resolves its
+  future exactly once (completed, failed, timed out, or rejected —
+  never two of those, never zero), chaos-tested under injected faults;
+- **drainable** — a replica told to drain finishes everything in
+  flight, refuses new work loudly (so a router fails over), and exits
+  ``EXIT_DRAINED`` (0);
+- **observable** — TTFT, per-token latency, queue depth, slot
+  occupancy, and terminal request outcomes flow through the
+  observability registry, and a serve-loop crash dumps the flight
+  recorder to ``telemetry/blackbox-serve.jsonl``.
+
+Layout: :mod:`.engine` (the continuous-batching engines +
+``build_engine``, which ``Model.compile_serving`` fronts),
+:mod:`.kv_cache` (ring-cache math), :mod:`.scheduler` (request queue /
+futures / SLO bookkeeping), :mod:`.fleet` (drainable replicas +
+client-side routing), :mod:`.gateway` (stdlib HTTP front).
+"""
+
+from .engine import (BatchServingEngine, ServingEngine,   # noqa: F401
+                     build_engine)
+from .fleet import (EXIT_DRAINED, FleetRouter,            # noqa: F401
+                    ServingReplica)
+from .gateway import serve_gateway                        # noqa: F401
+from .scheduler import (EngineDraining, QueueFull,        # noqa: F401
+                        Request, RequestQueue, RequestTimeout,
+                        ServeFuture, ServingError)
+
+__all__ = [
+    "ServingEngine", "BatchServingEngine", "build_engine",
+    "ServingReplica", "FleetRouter", "EXIT_DRAINED", "serve_gateway",
+    "ServingError", "QueueFull", "EngineDraining", "RequestTimeout",
+    "ServeFuture", "Request", "RequestQueue",
+]

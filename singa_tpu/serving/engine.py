@@ -1,0 +1,685 @@
+"""Continuous-batching inference engines: prefill/decode split, slot
+array, exactly-once delivery.
+
+Two engines share one control plane (:class:`_EngineBase`: submit /
+background loop / drain / fault handling / SLO metrics / crash
+blackbox):
+
+- :class:`ServingEngine` — autoregressive models (transformer LM,
+  char-rnn). TWO fixed-shape compiled programs per model:
+
+  * **prefill**: ``(P, cache, tokens (B_p, S_pad), lengths, slots,
+    valid) -> (cache, logits (B_p, V))`` — a fixed-width batch of
+    padded prompts writes the DONATED ring KV cache rows of its
+    assigned slots and returns last-token logits. ``valid`` masks
+    padding rows, so admitting 1 or B_p requests runs the same
+    executable.
+  * **decode**: ``(P, cache, tokens (W,), positions (W,), active (W,))
+    -> (cache, logits (W, V))`` — ONE token for every slot in O(1):
+    write the new k/v at ``pos % max_len``, attend over the ring,
+    return logits. The slot array has fixed width ``W``; finished
+    sequences free their slot mid-batch and new requests refill it via
+    the ``active`` validity mask (the ``pad_last`` mask idiom from
+    data.py), so the program NEVER retraces —
+    ``compiled_step_info()["n_traces"]`` is pinned at 1 by CI exactly
+    like the train step's retrace guard.
+
+  Sampling happens host-side per slot through the shared
+  :mod:`singa_tpu.models.decode` helper, which is what lets
+  per-request temperature/top_k/seed vary without touching the
+  compiled program.
+
+- :class:`BatchServingEngine` — stateless models (the CNN/MLP zoo and
+  ONNX imports through ``sonnx.SONNXModel``): each tick gathers up to
+  ``W`` queued requests, pads the batch to the fixed width, runs ONE
+  jitted forward (state threaded functionally, policy scope entered
+  inside the trace), and delivers per-row results. Same queue, same
+  exactly-once futures, same drain.
+
+Fault handling reuses :class:`~singa_tpu.resilience.faults.FaultPlan`:
+``faults.on_step(tick)`` fires BEFORE any tick mutates engine state, so
+an injected transient fault is retried with nothing lost and nothing
+doubled (chaos-tested). Retries beyond ``max_retries`` crash the loop:
+a flight-recorder blackbox (``telemetry/blackbox-serve.jsonl``) is
+dumped and every pending future is failed — once each.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
+from ..resilience.faults import NULL_PLAN, FaultInjected
+from ..models import decode as _decode
+from .scheduler import (EngineDraining, Request, RequestQueue,
+                        RequestTimeout, ServingError)
+
+# donation is a TPU/accelerator optimisation; on CPU jax warns that the
+# donated buffers were unused — expected for OUR two programs, not
+# actionable. The suppression is scoped to our own dispatches (warnings
+# filters are process-global; a module-level ignore would hide genuine
+# donation regressions in the embedding application's unrelated jits).
+# The lock keeps concurrent engines from clobbering each other's
+# catch_warnings save/restore; dispatch returns before execution, so
+# the hold time is microseconds.
+_WARN_LOCK = threading.Lock()
+
+
+def _quiet_donation(fn, *args):
+    with _WARN_LOCK, warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        return fn(*args)
+
+
+class _EngineBase:
+    """Shared control plane: queue, loop thread, drain, faults, SLOs."""
+
+    def __init__(self, *, queue_capacity=64, faults=None, registry=None,
+                 telemetry_dir="telemetry", max_retries=3):
+        self._reg = registry if registry is not None \
+            else _metrics.default_registry()
+        self.queue = RequestQueue(queue_capacity, registry=self._reg)
+        self.faults = faults if faults is not None else NULL_PLAN
+        self.telemetry_dir = telemetry_dir
+        self.max_retries = int(max_retries)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle_evt = threading.Event()
+        self._thread = None
+        self._running = False
+        self._draining = False
+        self._stopped = False
+        self._crashed = None
+        self._tick_count = 0
+        self._retries = self._reg.counter(
+            "serve_retries_total",
+            "serve-loop ticks retried after an injected/transient fault")
+        self._ttft = self._reg.histogram(
+            "serve_ttft_seconds",
+            "request submit to first generated token (queue wait "
+            "included — this is what the caller feels)")
+        self._tok_lat = self._reg.histogram(
+            "serve_token_seconds",
+            "per-token decode latency (one continuous-batching tick)")
+
+    # -- admission ---------------------------------------------------------
+    def _admit(self, req):
+        if self._crashed is not None:
+            self.queue.finish("rejected")
+            raise ServingError(
+                f"engine crashed ({self._crashed}); not accepting "
+                "requests — see the blackbox dump")
+        if self._draining or self._stopped:
+            self.queue.finish("rejected")
+            raise EngineDraining(
+                "engine is draining/stopped; not accepting new requests")
+        self.queue.put(req)
+        self._wake.set()
+        return req.future
+
+    # -- background loop ---------------------------------------------------
+    def start(self):
+        """Run the serve loop on a daemon thread. Idempotent."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serve-loop")
+            self._thread.start()
+        return self
+
+    def _busy(self):
+        raise NotImplementedError
+
+    def _tick(self):
+        raise NotImplementedError
+
+    def _fail_inflight(self, error):
+        raise NotImplementedError
+
+    def _fail_batch(self, batch, exc):
+        """Fail requests that were popped from the queue but died
+        before reaching the slot table / delivery (exactly once)."""
+        err = ServingError(f"serve tick failed: {exc}")
+        err.__cause__ = exc
+        for req in batch:
+            if not req.future.done():
+                req.future.set_error(err)
+                self.queue.finish("failed")
+
+    def _loop(self):
+        consecutive = 0
+        while self._running:
+            if not self._busy():
+                self._idle_evt.set()
+                self._wake.wait(0.02)
+                self._wake.clear()
+                continue
+            self._idle_evt.clear()
+            try:
+                # the fault hook fires BEFORE any state mutates, so a
+                # retry replays the tick cleanly: nothing delivered
+                # twice, nothing dropped
+                self.faults.on_step(self._tick_count)
+                self._tick()
+                self._tick_count += 1
+                consecutive = 0
+            except FaultInjected as e:
+                consecutive += 1
+                self._retries.inc()
+                if consecutive > self.max_retries:
+                    self._crash(e)
+                    return
+            except Exception as e:          # noqa: BLE001 — crash path
+                self._crash(e)
+                return
+        self._idle_evt.set()
+
+    def _crash(self, exc):
+        """Serve-loop death: blackbox dump, then fail every pending
+        future exactly once."""
+        self._crashed = exc
+        self._running = False
+        # no loop will ever process the queue again: refuse at the
+        # door from this instant (exactly-once forbids futures that
+        # never resolve)
+        self._stopped = True
+        try:
+            path = os.path.join(self.telemetry_dir,
+                                "blackbox-serve.jsonl")
+            _spans.recorder().dump(
+                path, reason="serve_loop_crash",
+                extra={"tick": self._tick_count,
+                       "error": f"{type(exc).__name__}: {exc}",
+                       "queue_depth": len(self.queue)},
+                registry=self._reg)
+            print(f"[serving] loop crashed ({type(exc).__name__}: "
+                  f"{exc}); blackbox at {path}")
+        except Exception:   # losing the blackbox must not mask the crash
+            pass
+        err = ServingError(f"serve loop crashed: {exc}")
+        err.__cause__ = exc
+        self.queue.drain_pending(err)
+        self._fail_inflight(err)
+        self._idle_evt.set()
+
+    # -- synchronous stepping (tests, simple callers) ----------------------
+    def step(self):
+        """Run ONE scheduler tick inline (only valid without the
+        background thread). Returns True when there was work."""
+        if self._thread is not None:
+            raise RuntimeError("step() is for synchronous use; the "
+                               "background loop is running")
+        if not self._busy():
+            return False
+        self.faults.on_step(self._tick_count)
+        self._tick()
+        self._tick_count += 1
+        return True
+
+    def run_until_idle(self, max_ticks=10_000):
+        """Synchronously tick until no work remains (tests). Transient
+        injected faults are retried like the background loop would."""
+        ticks = 0
+        consecutive = 0
+        while self._busy():
+            try:
+                self.step()
+                consecutive = 0
+            except FaultInjected:
+                consecutive += 1
+                self._retries.inc()
+                if consecutive > self.max_retries:
+                    raise
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError("engine did not go idle "
+                                   f"within {max_ticks} ticks")
+        return ticks
+
+    # -- drain / stop ------------------------------------------------------
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=60.0):
+        """Graceful drain: refuse new requests, FINISH everything
+        in flight and queued, return True once idle. The drainable-
+        replica contract: a drained engine dropped nothing."""
+        self._draining = True
+        self._wake.set()
+        if self._thread is None:
+            # synchronous engines drain inline
+            self.run_until_idle()
+            return True
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            if self._crashed is not None:
+                return False
+            if not self._busy() and self._idle_evt.wait(0.05):
+                if not self._busy():
+                    return True
+            time.sleep(0.01)
+        return not self._busy()
+
+    def stop(self):
+        """Hard stop: end the loop; queued/in-flight requests are
+        failed (use :meth:`drain` first for a graceful exit)."""
+        self._stopped = True
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._crashed is None:
+            err = EngineDraining("engine stopped")
+            n = self.queue.drain_pending(err)
+            self._fail_inflight(err)
+            return n
+        return 0
+
+
+class ServingEngine(_EngineBase):
+    """Continuous-batching autoregressive engine (module docstring)."""
+
+    def __init__(self, adapter, *, slots=4, max_len=64, prefill_len=16,
+                 prefill_batch=2, policy=None, **kw):
+        super().__init__(**kw)
+        import jax
+
+        self.adapter = adapter
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len)
+        self.prefill_batch = max(1, min(int(prefill_batch), self.slots))
+        if self.prefill_len > self.max_len:
+            raise ValueError(
+                f"prefill_len {self.prefill_len} exceeds the ring "
+                f"length max_len {self.max_len}: prompt rows must fit "
+                "the cache without wrapping over themselves")
+        validate = getattr(adapter, "validate", None)
+        if validate is not None:
+            # model-side limits (e.g. the positional-embedding table)
+            # fail HERE, typed, instead of crashing the first compiled
+            # prefill with a shape error
+            validate(prefill_len=self.prefill_len, max_len=self.max_len)
+        self.policy = policy
+        self._P = adapter.params()
+        self._cache = adapter.init_cache(self.slots, self.max_len)
+        self._slots = [None] * self.slots        # host-side slot table
+
+        self._prefill_rec = {"n_traces": 0}
+        self._decode_rec = {"n_traces": 0}
+        prefill_raw = adapter.prefill_fn()
+        decode_raw = adapter.decode_fn()
+        prefill_rec, decode_rec = self._prefill_rec, self._decode_rec
+
+        def prefill_body(P, cache, tokens, lengths, slot_ids, valid):
+            prefill_rec["n_traces"] += 1
+            return prefill_raw(P, cache, tokens, lengths, slot_ids,
+                               valid)
+
+        def decode_body(P, cache, tokens, positions, active):
+            # host-side trace counter, same contract as Model._build_step:
+            # the serve path must keep this at 1 (CI-pinned)
+            decode_rec["n_traces"] += 1
+            return decode_raw(P, cache, tokens, positions, active)
+
+        # the ring cache is DONATED: the one large serving buffer is
+        # updated in place by XLA instead of doubling per tick
+        self._prefill = jax.jit(prefill_body, donate_argnums=(1,))
+        self._decode = jax.jit(decode_body, donate_argnums=(1,))
+
+        self._occupancy = self._reg.gauge(
+            "serve_slot_occupancy", "active sequences in the slot array")
+        self._reg.gauge("serve_slots",
+                        "slot array width (max in-flight sequences)"
+                        ).set(self.slots)
+        self._tokens_total = self._reg.counter(
+            "serve_tokens_total", "tokens generated")
+        self._decode_steps = self._reg.counter(
+            "serve_decode_steps_total", "continuous-batching decode "
+            "ticks executed")
+        self._prefills = self._reg.counter(
+            "serve_prefill_total", "prompts prefilled into a slot")
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, temperature=0.0,
+               top_k=None, eos_id=None, seed=0, timeout=None):
+        """Queue one generation request; returns its
+        :class:`~singa_tpu.serving.scheduler.ServeFuture` (``.result()``
+        is ``{"tokens": [...], "prompt_len": n, "ttft_s": ...}``).
+        Prompts longer than ``prefill_len`` are rejected here, typed
+        and synchronous."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1 (got {max_new_tokens}): "
+                "the first token is sampled from the prefill logits, "
+                "so every accepted request generates at least one")
+        if prompt.size > self.prefill_len:
+            self.queue.finish("rejected")
+            raise ServingError(
+                f"prompt of {prompt.size} tokens exceeds this engine's "
+                f"prefill_len {self.prefill_len}")
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      eos_id=eos_id, seed=seed, timeout=timeout)
+        return self._admit(req)
+
+    def compiled_step_info(self):
+        """Serve-path retrace audit (the train-step audit's sibling):
+        the decode program's ``n_traces`` must be 1 across ANY refill
+        pattern — that is the continuous-batching invariant CI pins."""
+        return {"n_traces": self._decode_rec["n_traces"],
+                "prefill_n_traces": self._prefill_rec["n_traces"],
+                "slots": self.slots, "max_len": self.max_len,
+                "prefill_len": self.prefill_len,
+                "prefill_batch": self.prefill_batch,
+                "policy": self.policy.describe()
+                if self.policy is not None else None}
+
+    def active_slots(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    # -- loop internals ----------------------------------------------------
+    def _busy(self):
+        return len(self.queue) > 0 or any(
+            s is not None for s in self._slots)
+
+    def _fail_inflight(self, error):
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[i] = None
+                if not slot["req"].future.done():
+                    slot["req"].future.set_error(error)
+                    self.queue.finish("failed")
+        self._occupancy.set(0)
+
+    def _finish_slot(self, i, status="completed"):
+        slot = self._slots[i]
+        self._slots[i] = None
+        req = slot["req"]
+        if status == "completed":
+            req.future.set_result({
+                "tokens": list(req.tokens),
+                "prompt_len": int(req.prompt.size),
+                "ttft_s": (req.first_token_at - req.submitted_at
+                           if req.first_token_at else None)})
+        elif status == "timed_out":
+            # same type a queued expiry raises: callers catch ONE
+            # timeout error regardless of where the deadline hit
+            req.future.set_error(RequestTimeout(
+                f"deadline passed mid-generation after "
+                f"{len(req.tokens)} tokens"))
+        else:
+            req.future.set_error(ServingError(status))
+        self.queue.finish(status)
+
+    def _sample_and_place(self, req, logits, slot_idx, pos):
+        """Shared first-token/next-token bookkeeping: sample through
+        the ONE decode helper, record, finish or keep the slot hot."""
+        tok = _decode.sample_logits(
+            logits, temperature=req.temperature, top_k=req.top_k,
+            rng=req.rng)
+        req.tokens.append(tok)
+        self._tokens_total.inc()
+        done = (len(req.tokens) >= req.max_new_tokens or
+                (req.eos_id is not None and tok == req.eos_id))
+        self._slots[slot_idx] = {"req": req, "pos": pos, "tok": tok}
+        if done:
+            self._finish_slot(slot_idx)
+
+    def _tick(self):
+        now = time.monotonic()
+        # 1) reap deadline-expired in-flight requests (their slot frees
+        #    mid-batch — that is the continuous part of the batching)
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot["req"].expired(now):
+                self._finish_slot(i, status="timed_out")
+
+        # 2) admit: fill free slots, a fixed-width prefill batch per tick
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if free and len(self.queue) > 0:
+            batch = self.queue.pop_batch(
+                min(len(free), self.prefill_batch), now)
+            if batch:
+                try:
+                    with _spans.span("serve.prefill", n=len(batch)):
+                        self._run_prefill(batch, free)
+                except Exception as e:
+                    # popped-but-not-yet-slotted requests are in
+                    # neither the queue nor the slot table: the crash
+                    # path can't see them, so fail them HERE or they
+                    # hang forever (exactly-once applies to errors too)
+                    self._fail_batch(batch, e)
+                    raise
+
+        # 3) decode: one token for EVERY active slot, one fixed program
+        if any(s is not None for s in self._slots):
+            t0 = time.perf_counter()
+            with _spans.span("serve.decode"):
+                self._run_decode()
+            self._tok_lat.observe(time.perf_counter() - t0)
+            self._decode_steps.inc()
+        self._occupancy.set(self.active_slots())
+
+    def _run_prefill(self, batch, free):
+        B, S = self.prefill_batch, self.prefill_len
+        tokens = np.zeros((B, S), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        slot_ids = np.zeros((B,), np.int32)
+        valid = np.zeros((B,), bool)
+        placed = []
+        for b, req in enumerate(batch):
+            n = req.prompt.size
+            tokens[b, :n] = req.prompt
+            lengths[b] = n
+            slot_ids[b] = free[b]
+            valid[b] = True
+            placed.append((req, free[b]))
+        self._cache, logits = _quiet_donation(
+            self._prefill, self._P, self._cache, tokens, lengths,
+            slot_ids, valid)
+        logits = np.asarray(logits)
+        for b, (req, slot_idx) in enumerate(placed):
+            req.first_token_at = time.monotonic()
+            self._ttft.observe(req.first_token_at - req.submitted_at)
+            self._prefills.inc()
+            # the first generated token sits at position prompt_len;
+            # its k/v are written by the NEXT decode tick
+            self._sample_and_place(req, logits[b], slot_idx,
+                                   pos=int(req.prompt.size))
+
+    def _run_decode(self):
+        W = self.slots
+        tokens = np.zeros((W,), np.int32)
+        positions = np.zeros((W,), np.int32)
+        active = np.zeros((W,), bool)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                tokens[i] = slot["tok"]
+                positions[i] = slot["pos"]
+                active[i] = True
+        self._cache, logits = _quiet_donation(
+            self._decode, self._P, self._cache, tokens, positions,
+            active)
+        logits = np.asarray(logits)
+        for i, slot in enumerate(list(self._slots)):
+            if slot is None:
+                continue
+            self._sample_and_place(slot["req"], logits[i], i,
+                                   pos=slot["pos"] + 1)
+
+
+class BatchServingEngine(_EngineBase):
+    """Stateless (non-autoregressive) serving: classifier zoo models
+    and ONNX imports. One jitted fixed-width forward per tick over a
+    padded batch of queued requests (module docstring)."""
+
+    def __init__(self, model, *, input_shape, batch=8,
+                 input_dtype=np.float32, policy=None, **kw):
+        super().__init__(**kw)
+        import jax
+        from ..autograd_base import CTX
+        from ..tensor import Tensor
+        from .. import mixed_precision as mp
+        from ..device import get_default_device
+
+        self.model = model
+        self.batch = int(batch)
+        self.input_shape = tuple(int(d) for d in input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.policy = policy if policy is not None \
+            else getattr(model, "_policy", None)
+        dev = getattr(model, "dev", None) or get_default_device()
+
+        # materialise lazily-initialised params with ONE eager eval
+        # forward (ONNX imports already hold theirs; zoo models may not)
+        x0 = Tensor(
+            data=np.zeros((self.batch,) + self.input_shape,
+                          self.input_dtype),
+            device=dev, requires_grad=False)
+        prev = CTX.training
+        CTX.training = False
+        try:
+            with mp.policy_scope(self.policy):
+                model.forward(x0)
+        finally:
+            CTX.training = prev
+        state_list = model._state_tensors()
+        self._state_arrays = [t.data for t in state_list]
+        rec = {"n_traces": 0}
+        self._rec = rec
+
+        def fwd(state_arrays, x):
+            rec["n_traces"] += 1
+            backup = [t.data for t in state_list]
+            for t, a in zip(state_list, state_arrays):
+                t.data = a
+            prev = CTX.training
+            CTX.training = False
+            try:
+                with mp.policy_scope(self.policy):
+                    out = model.forward(Tensor(data=x, device=dev,
+                                               requires_grad=False))
+            finally:
+                CTX.training = prev
+                for t, a in zip(state_list, backup):
+                    t.data = a
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            leaves = [o.data if isinstance(o, Tensor) else o
+                      for o in outs]
+            if self.policy is not None:
+                leaves = [self.policy.cast_output(x) for x in leaves]
+            return leaves
+
+        self._fwd = jax.jit(fwd)
+        self._occupancy = self._reg.gauge(
+            "serve_slot_occupancy", "active sequences in the slot array")
+        self._reg.gauge("serve_slots",
+                        "slot array width (max in-flight sequences)"
+                        ).set(self.batch)
+
+    def submit(self, x, timeout=None):
+        """Queue one input array of ``input_shape``; the future's
+        result is the model's per-row output (array, or tuple for
+        multi-output models)."""
+        x = np.asarray(x, self.input_dtype)
+        if x.shape != self.input_shape:
+            self.queue.finish("rejected")
+            raise ServingError(
+                f"input shape {x.shape} != engine input_shape "
+                f"{self.input_shape}")
+        req = Request(None, payload=x, timeout=timeout)
+        return self._admit(req)
+
+    def compiled_step_info(self):
+        return {"n_traces": self._rec["n_traces"],
+                "slots": self.batch,
+                "input_shape": self.input_shape,
+                "policy": self.policy.describe()
+                if self.policy is not None else None}
+
+    def _busy(self):
+        return len(self.queue) > 0
+
+    def _fail_inflight(self, error):
+        pass            # stateless: nothing lives between ticks
+
+    def _tick(self):
+        batch = self.queue.pop_batch(self.batch)
+        if not batch:
+            return
+        self._occupancy.set(len(batch))
+        x = np.zeros((self.batch,) + self.input_shape, self.input_dtype)
+        for i, req in enumerate(batch):
+            x[i] = req.payload
+        t0 = time.perf_counter()
+        try:
+            with _spans.span("serve.batch_forward", n=len(batch)):
+                leaves = self._fwd(self._state_arrays, x)
+        except Exception as e:
+            # popped requests are invisible to the crash path's queue
+            # drain — fail them here, exactly once
+            self._fail_batch(batch, e)
+            raise
+        self._tok_lat.observe(time.perf_counter() - t0)
+        leaves = [np.asarray(leaf) for leaf in leaves]
+        for i, req in enumerate(batch):
+            now = time.monotonic()
+            req.first_token_at = now
+            self._ttft.observe(now - req.submitted_at)
+            row = tuple(leaf[i] for leaf in leaves)
+            req.future.set_result(row[0] if len(row) == 1 else row)
+            self.queue.finish("completed")
+        self._occupancy.set(0)
+
+
+def build_engine(model, **kw):
+    """The ``Model.compile_serving`` backend: autoregressive models
+    (anything exposing ``decode_adapter``) get a :class:`ServingEngine`
+    over their ring-cache adapter; everything else — the classifier
+    zoo, ONNX imports — serves statelessly through a
+    :class:`BatchServingEngine` (pass ``input_shape=``)."""
+    if hasattr(model, "decode_adapter"):
+        adapter_kw = {}
+        if "policy" in kw:
+            adapter_kw["policy"] = kw.get("policy")
+        adapter = model.decode_adapter(**adapter_kw)
+        ar_keys = ("slots", "max_len", "prefill_len", "prefill_batch",
+                   "policy", "queue_capacity", "faults", "registry",
+                   "telemetry_dir", "max_retries")
+        unknown = sorted(set(kw) - set(ar_keys))
+        if unknown:
+            raise TypeError(
+                f"unknown serving option(s) {unknown} for "
+                f"autoregressive {type(model).__name__} "
+                f"(accepted: {sorted(ar_keys)})")
+        return ServingEngine(adapter, **kw)
+    if "input_shape" not in kw:
+        raise TypeError(
+            "stateless serving needs input_shape=(per-sample shape); "
+            f"{type(model).__name__} has no decode_adapter")
+    bt_keys = ("input_shape", "batch", "input_dtype", "policy",
+               "queue_capacity", "faults", "registry", "telemetry_dir",
+               "max_retries")
+    unknown = sorted(set(kw) - set(bt_keys))
+    if unknown:
+        raise TypeError(
+            f"unknown serving option(s) {unknown} for stateless "
+            f"{type(model).__name__} (accepted: {sorted(bt_keys)})")
+    return BatchServingEngine(model, **kw)
+
+
+__all__ = ["ServingEngine", "BatchServingEngine", "build_engine"]

@@ -1,0 +1,205 @@
+"""Drainable replicas and client-side fleet routing.
+
+One serving process = one :class:`ServingReplica`: an engine plus
+(optionally) a membership seat in a ``resilience.cluster`` pod — the
+same coordinator/worker control plane training uses for health,
+heartbeat-carried metric summaries, and dead-peer detection, so a
+serving fleet's coordinator health report looks exactly like a training
+pod's.
+
+The drain contract (the serving sibling of the exit-75/76 supervisor
+table):
+
+1. something asks the replica to drain (SIGTERM, the gateway's
+   ``POST /drain``, or :meth:`ServingReplica.drain` directly);
+2. the engine refuses new requests **loudly** — ``submit`` raises
+   :class:`~singa_tpu.serving.scheduler.EngineDraining`, the gateway
+   returns 503 — so a router fails the traffic over instead of letting
+   it rot;
+3. every request already admitted (queued or mid-decode) runs to a
+   normal response: a drained replica drops NOTHING (chaos-proved by
+   the ``serve-drain`` scenario in ``tools/chaos_smoke.py``);
+4. the replica leaves its cluster seat and exits
+   :data:`EXIT_DRAINED` (0) — "done, on purpose": a supervisor must NOT
+   relaunch it (75 means relaunch, 76 means cordon, 0 means the drain
+   you asked for completed).
+
+:class:`FleetRouter` is the client half for in-process fleets (tests,
+chaos drivers, single-host multi-engine setups): least-depth dispatch
+with failover on refusal. Across hosts the same logic belongs to any
+load balancer that honors the gateway's 503 — the router documents the
+semantics, it does not replace your LB.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
+from .scheduler import EngineDraining, QueueFull, ServingError
+
+# the drain exit code: intentional, successful, do-not-relaunch — the
+# 0 row of the README's supervisor exit-code contract table
+EXIT_DRAINED = 0
+
+
+class ServingReplica:
+    """One engine + one (optional) cluster seat + the drain contract."""
+
+    def __init__(self, engine, *, cluster=None, name="replica",
+                 registry=None):
+        self.engine = engine
+        self.cluster = cluster
+        self.name = str(name)
+        self._reg = registry if registry is not None \
+            else _metrics.default_registry()
+        self._drain_evt = threading.Event()
+        self._drain_gauge = self._reg.gauge(
+            "serve_replica_draining",
+            "1 while this replica is draining (refusing new requests)")
+        self._drain_gauge.set(0)
+
+    # -- serving -----------------------------------------------------------
+    def start(self):
+        self.engine.start()
+        return self
+
+    def submit(self, *args, **kwargs):
+        return self.engine.submit(*args, **kwargs)
+
+    @property
+    def draining(self):
+        return self.engine.draining
+
+    def queue_depth(self):
+        return len(self.engine.queue)
+
+    def health(self):
+        """Engine + membership view (what the gateway's ``/healthz``
+        serves)."""
+        eng = self.engine
+        doc = {
+            "name": self.name,
+            "status": ("crashed" if eng._crashed is not None
+                       else "draining" if eng.draining
+                       else "serving"),
+            "queue_depth": len(eng.queue),
+            "active_slots": getattr(eng, "active_slots",
+                                    lambda: None)(),
+            "compiled": eng.compiled_step_info(),
+        }
+        if self.cluster is not None:
+            try:
+                doc["cluster"] = self.cluster.health()
+            except Exception as e:      # noqa: BLE001 — health is advisory
+                doc["cluster"] = {"error": f"{type(e).__name__}: {e}"}
+        return doc
+
+    # -- drain -------------------------------------------------------------
+    def request_drain(self):
+        """Mark the replica draining and wake whoever is blocked in
+        :meth:`run_until_drained`. Idempotent, signal-safe (this is the
+        SIGTERM handler's body: no joins, no blocking)."""
+        self._drain_gauge.set(1)
+        self.engine._draining = True    # refuse from this instant
+        self.engine._wake.set()
+        self._drain_evt.set()
+
+    def drain(self, timeout=60.0):
+        """Execute the full drain: finish everything in flight, close
+        the cluster seat, stop the loop. Returns the process exit code —
+        :data:`EXIT_DRAINED` (0) on a clean drain, 1 when work had to be
+        abandoned (timeout or a crashed serve loop)."""
+        self.request_drain()
+        with _spans.span("serve.drain", replica=self.name):
+            ok = self.engine.drain(timeout=timeout)
+        if self.cluster is not None:
+            try:
+                self.cluster.close()
+            except Exception:   # a dead coordinator must not dirty a
+                pass            # clean drain
+        self.engine.stop()
+        return EXIT_DRAINED if ok else 1
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)):
+        """SIGTERM/SIGINT → :meth:`request_drain` (the handler only
+        flips flags; the blocking drain runs in
+        :meth:`run_until_drained` on the main thread)."""
+        for s in signals:
+            signal.signal(s, lambda _s, _f: self.request_drain())
+        return self
+
+    def run_until_drained(self, poll=0.25, timeout=60.0):
+        """Block the main thread until a drain is requested (signal,
+        gateway, or :meth:`request_drain`), then drain and return the
+        exit code. A serve-loop crash also unblocks — with exit code 1
+        (the blackbox is already on disk by then)."""
+        while not self._drain_evt.wait(poll):
+            if self.engine._crashed is not None:
+                return 1
+        return self.drain(timeout=timeout)
+
+
+class FleetRouter:
+    """Least-depth dispatch over in-process replicas with failover on
+    refusal (draining replica / full queue). Raises
+    :class:`~singa_tpu.serving.scheduler.ServingError` only when EVERY
+    replica refused — one live replica absorbs the whole queue."""
+
+    def __init__(self, replicas, registry=None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = list(replicas)
+        reg = registry if registry is not None \
+            else _metrics.default_registry()
+        self._submitted = reg.counter(
+            "serve_fleet_submitted_total",
+            "requests the router placed on some replica")
+        self._failovers = reg.counter(
+            "serve_fleet_failover_total",
+            "submissions that had to skip a refusing replica")
+        self._rejected = reg.counter(
+            "serve_fleet_rejected_total",
+            "submissions every replica refused")
+
+    @staticmethod
+    def _depth(r):
+        try:
+            return r.queue_depth() if hasattr(r, "queue_depth") \
+                else len(r.engine.queue) if hasattr(r, "engine") \
+                else len(r.queue)
+        except Exception:       # noqa: BLE001 — routing hint only
+            return 0
+
+    def submit(self, *args, **kwargs):
+        order = sorted(self.replicas,
+                       key=lambda r: (bool(r.draining), self._depth(r)))
+        last_exc = None
+        for r in order:
+            try:
+                fut = r.submit(*args, **kwargs)
+            except (EngineDraining, QueueFull) as e:
+                last_exc = e
+                self._failovers.inc()
+                continue
+            self._submitted.inc()
+            return fut
+        self._rejected.inc()
+        raise ServingError(
+            f"all {len(self.replicas)} replicas refused the request "
+            f"(last: {last_exc})")
+
+    def drain_replica(self, idx, timeout=60.0):
+        """Drain ONE replica (rolling-restart building block); the
+        router's failover routes everything new to the survivors."""
+        return self.replicas[idx].drain(timeout=timeout)
+
+    def health(self):
+        return [r.health() if hasattr(r, "health") else None
+                for r in self.replicas]
+
+
+__all__ = ["ServingReplica", "FleetRouter", "EXIT_DRAINED"]

@@ -1,0 +1,188 @@
+"""Stdlib HTTP gateway over a serving engine.
+
+Deliberately dependency-free (``http.server``): the gateway is the thin
+edge of the engine, not a web framework. Endpoints:
+
+- ``POST /v1/generate`` — autoregressive engines. JSON body
+  ``{"prompt": [ids...], "max_new_tokens": n, "temperature": t,
+  "top_k": k, "eos_id": id, "seed": s, "timeout": secs}`` (everything
+  but ``prompt`` optional); 200 with the completed
+  ``{"tokens": [...], "prompt_len": n, "ttft_s": ...}``.
+- ``POST /v1/predict`` — stateless engines. ``{"input": nested list}``;
+  200 with ``{"output": nested list}`` (or ``"outputs"`` for
+  multi-output models).
+- ``GET /healthz`` — replica health JSON. **503 while draining or
+  crashed**, 200 otherwise — this is the load-balancer contract: a
+  draining replica stops receiving traffic because it says so here and
+  on every refused submit, not because anyone remembered to deregister
+  it.
+- ``GET /metrics`` / ``GET /metrics.json`` — Prometheus text / snapshot
+  JSON of the engine's registry (quantile summaries included).
+- ``POST /drain`` — begin a graceful drain; 202 immediately (the drain
+  finishes in the background; watch ``/healthz``).
+
+Refusal mapping: draining/full queue → 503 (fail over), request
+deadline → 504, malformed request → 400, serve-loop crash → 500.
+Handler threads are non-daemon and joined at ``server_close()``, so a
+drained process never exits with a response half-written.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from .scheduler import (EngineDraining, QueueFull, RequestTimeout,
+                        ServingError)
+
+
+def _result_doc(res):
+    import numpy as np
+    if isinstance(res, dict):
+        return res
+    if isinstance(res, tuple):
+        return {"outputs": [np.asarray(r).tolist() for r in res]}
+    return {"output": np.asarray(res).tolist()}
+
+
+def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
+                  default_timeout=120.0):
+    """Start the gateway on a daemon thread. Returns ``(server, port)``;
+    ``server.shutdown(); server.server_close()`` stops it (close joins
+    in-flight handler threads). ``replica`` (a
+    :class:`~singa_tpu.serving.fleet.ServingReplica`) upgrades
+    ``/healthz`` to the full replica view and routes ``/drain`` through
+    the replica's drain contract. Binds localhost by default — put a
+    real LB/mesh in front for anything public."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..observability.export import render_prometheus
+
+    def health_doc():
+        if replica is not None:
+            return replica.health()
+        return {"status": ("crashed" if engine._crashed is not None
+                           else "draining" if engine.draining
+                           else "serving"),
+                "queue_depth": len(engine.queue),
+                "compiled": engine.compiled_step_info()}
+
+    def begin_drain():
+        if replica is not None:
+            replica.request_drain()
+            # run_until_drained (the replica's main thread) finishes it;
+            # a replica-less engine drains on a helper thread instead
+            return
+        threading.Thread(target=engine.drain, daemon=True,
+                         name="gateway-drain").start()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            # one request per connection: keep-alive would park handler
+            # threads in a blocking read, and server_close() JOINS
+            # handler threads (that join is the drain guarantee — it
+            # must never wait on an idle keep-alive socket)
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+            self.close_connection = True
+
+        def do_GET(self):       # noqa: N802 — stdlib API
+            try:
+                if self.path.startswith("/healthz"):
+                    doc = health_doc()
+                    self._reply(200 if doc.get("status") == "serving"
+                                else 503, doc)
+                elif self.path.startswith("/metrics.json"):
+                    self._reply(200, engine._reg.snapshot())
+                elif self.path.startswith("/metrics"):
+                    body = render_prometheus(
+                        engine._reg.snapshot()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    self.close_connection = True
+                else:
+                    self._reply(404, {"error": "unknown path"})
+            except Exception as e:   # noqa: BLE001 — a probe must not kill us
+                try:
+                    self._reply(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+                except Exception:
+                    pass
+
+        def do_POST(self):      # noqa: N802 — stdlib API
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                body = json.loads(raw.decode() or "{}")
+            except Exception:
+                self._reply(400, {"error": "body is not JSON"})
+                return
+            try:
+                if self.path.startswith("/drain"):
+                    begin_drain()
+                    self._reply(202, {"status": "draining"})
+                elif self.path.startswith("/v1/generate"):
+                    self._generate(body)
+                elif self.path.startswith("/v1/predict"):
+                    self._predict(body)
+                else:
+                    self._reply(404, {"error": "unknown path"})
+            except (EngineDraining, QueueFull) as e:
+                self._reply(503, {"error": str(e), "retryable": True})
+            except RequestTimeout as e:
+                self._reply(504, {"error": str(e)})
+            except (ServingError, ValueError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:   # noqa: BLE001 — crash → 500, once
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _generate(self, body):
+            prompt = body.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError(
+                    "generate needs a non-empty integer list 'prompt'")
+            kw = {k: body[k] for k in ("max_new_tokens", "temperature",
+                                       "top_k", "eos_id", "seed",
+                                       "timeout") if k in body}
+            wait = float(kw["timeout"]) \
+                if kw.get("timeout") is not None else default_timeout
+            fut = engine.submit(prompt, **kw)
+            self._reply(200, fut.result(timeout=wait))
+
+        def _predict(self, body):
+            if "input" not in body:
+                raise ValueError("predict needs 'input'")
+            wait = float(body["timeout"]) \
+                if body.get("timeout") is not None else default_timeout
+            fut = engine.submit(body["input"],
+                                timeout=body.get("timeout"))
+            self._reply(200, _result_doc(fut.result(timeout=wait)))
+
+        def log_message(self, *a):   # silence per-request stderr spam
+            pass
+
+    class Server(ThreadingHTTPServer):
+        # joined at server_close(): a drain never abandons a response
+        daemon_threads = False
+        block_on_close = True
+
+    server = Server((host, int(port)), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="serve-gateway")
+    t.start()
+    return server, server.server_address[1]
+
+
+__all__ = ["serve_gateway"]

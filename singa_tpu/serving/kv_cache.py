@@ -1,0 +1,117 @@
+"""Fixed-shape ring KV caches: O(1) autoregressive decode state.
+
+The serving engine's decode program must have ONE shape forever —
+``compiled_step_info()["n_traces"] == 1`` is the serve-path invariant —
+so the attention cache cannot grow with the sequence. Instead each slot
+owns a RING of ``length`` key/value rows per layer: token ``t`` writes
+ring index ``t % length``, and the decode attention masks each index by
+the token position it currently holds. Work and memory per emitted
+token are therefore constant (the compiler-first O(1)-cache design of
+PAPERS.md arxiv 2603.09555); semantically the ring IS sliding-window
+attention over the last ``length`` tokens, and for sequences that fit
+(``pos < length``) it is exactly full causal attention — the
+wraparound-vs-reference test in ``tests/test_serving.py`` pins both.
+
+Everything here is a pure function over arrays, shape-stable by
+construction, ready to be closed over by a jitted prefill/decode body.
+Layout: one cache level is ``(n_slots, n_heads, length, head_dim)``.
+
+Position bookkeeping (who holds ring index ``j`` when the newest
+written token is at position ``p``)::
+
+    t_j = p - ((p - j) % length)        # newest token position at j
+    valid(j) = t_j >= 0                 # j was ever written
+
+which masks exactly the last ``min(p+1, length)`` token positions —
+no flags, no per-slot host state, just arithmetic on ``p``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_cache(n_slots, n_heads, length, head_dim, dtype=jnp.float32):
+    """One layer's ring cache: zeroed ``{"k","v"}`` of shape
+    ``(n_slots, n_heads, length, head_dim)``."""
+    shape = (int(n_slots), int(n_heads), int(length), int(head_dim))
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def ring_positions(pos, length):
+    """For newest-written position ``pos`` (vector over slots), the
+    token position held at each ring index: ``(W, length)`` int32.
+    Negative entries mean "never written"."""
+    j = jnp.arange(length, dtype=jnp.int32)
+    pos = pos.astype(jnp.int32)[:, None]
+    return pos - ((pos - j[None, :]) % length)
+
+
+def ring_mask(pos, length):
+    """``(W, length)`` bool: ring entries holding a real token when the
+    newest written position is ``pos`` per slot."""
+    return ring_positions(pos, length) >= 0
+
+
+def write_token(level, k_new, v_new, pos):
+    """Write one new token per slot at its ring index.
+
+    ``level``: ``{"k","v"}`` of ``(W, H, L, D)``;
+    ``k_new``/``v_new``: ``(W, H, D)``; ``pos``: ``(W,)`` int — the new
+    token's position. Returns the updated level. Every slot is written
+    (the engine masks dead slots by never attending to them; a freed
+    slot's rows are fully overwritten by its next prefill before any
+    mask can reach them)."""
+    L = level["k"].shape[2]
+
+    def upd(c, row, p):
+        return lax.dynamic_update_slice(
+            c, row[:, None, :].astype(c.dtype), (0, p % L, 0))
+
+    return {"k": jax.vmap(upd)(level["k"], k_new,
+                               pos.astype(jnp.int32)),
+            "v": jax.vmap(upd)(level["v"], v_new,
+                               pos.astype(jnp.int32))}
+
+
+def write_prompt(level, slot, k_rows, v_rows, valid):
+    """Write one prompt's rows into one slot, starting at ring index 0.
+
+    ``k_rows``/``v_rows``: ``(H, S, D)`` with ``S <= L`` (the engine's
+    ``prefill_len <= max_len`` contract); ``slot`` scalar int;
+    ``valid`` scalar bool — False rows (prefill-batch padding) leave
+    the cache untouched, which is what lets the prefill program keep a
+    FIXED batch width over a variable number of admitted requests."""
+    k_up = lax.dynamic_update_slice(
+        level["k"], k_rows[None].astype(level["k"].dtype),
+        (slot, 0, 0, 0))
+    v_up = lax.dynamic_update_slice(
+        level["v"], v_rows[None].astype(level["v"].dtype),
+        (slot, 0, 0, 0))
+    return {"k": jnp.where(valid, k_up, level["k"]),
+            "v": jnp.where(valid, v_up, level["v"])}
+
+
+def attend(q, level, pos, scale):
+    """Ring attention for one decode tick.
+
+    ``q``: ``(W, H, 1, D)`` (the new token's query, already written to
+    the ring along with its k/v); ``pos``: ``(W,)`` — the new token's
+    position. Softmax in f32 regardless of cache dtype (bf16 serving
+    keeps its numerics sane), result cast back to ``q.dtype``.
+    Returns ``(W, H, 1, D)``."""
+    L = level["k"].shape[2]
+    s = jnp.einsum("whqd,whld->whql", q.astype(jnp.float32),
+                   level["k"].astype(jnp.float32)) * scale
+    mask = ring_mask(pos, L)[:, None, None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    a = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("whql,whld->whqd", a,
+                     level["v"].astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+__all__ = ["init_cache", "ring_positions", "ring_mask", "write_token",
+           "write_prompt", "attend"]

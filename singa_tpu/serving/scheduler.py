@@ -1,0 +1,202 @@
+"""Request queue, per-request futures, and admission bookkeeping.
+
+The serving engine's control plane is deliberately boring host-side
+python: a bounded deque of :class:`Request` records and a
+:class:`ServeFuture` per request that is fulfilled EXACTLY ONCE — the
+delivery guard is a real invariant (chaos-tested with injected faults),
+not a convention. Rejection is synchronous and loud: a full queue or a
+draining engine refuses at ``submit`` time with a typed error, so a
+load balancer can fail over instead of letting requests rot.
+
+SLO metrics recorded here (all through the PR-6 observability
+registry):
+
+- ``serve_queue_depth`` (gauge) — requests waiting for a slot;
+- ``serve_requests_total{status=...}`` (counter) — terminal outcome of
+  every request: ``completed`` | ``rejected`` | ``timed_out`` |
+  ``failed`` | ``cancelled``;
+- admission wait rides the engine's TTFT histogram (queue time is part
+  of time-to-first-token, which is what the user feels).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+
+
+class ServingError(RuntimeError):
+    """Base class for serve-path failures."""
+
+
+class QueueFull(ServingError):
+    """Admission refused: the bounded request queue is at capacity."""
+
+
+class EngineDraining(ServingError):
+    """Admission refused: the engine is draining (finishing in-flight
+    work, accepting nothing new) or already stopped."""
+
+
+class RequestTimeout(ServingError):
+    """The request's deadline passed before a response completed."""
+
+
+class ServeFuture:
+    """One request's response slot: fulfilled exactly once.
+
+    ``result(timeout)`` blocks for the response and re-raises the
+    request's error. ``deliveries`` counts fulfillment attempts — the
+    exactly-once chaos test asserts it is 1 for every request, and a
+    second delivery attempt raises instead of silently overwriting."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result = None
+        self._error = None
+        self.deliveries = 0
+
+    def _fulfill(self, result=None, error=None):
+        with self._lock:
+            self.deliveries += 1
+            if self._event.is_set():
+                raise RuntimeError(
+                    "double delivery: this request already has a "
+                    "response (exactly-once violation)")
+            self._result = result
+            self._error = error
+            self._event.set()
+
+    def set_result(self, result):
+        self._fulfill(result=result)
+
+    def set_error(self, error):
+        self._fulfill(error=error)
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise RequestTimeout(
+                f"no response within {timeout}s (request still "
+                "in flight)")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class Request:
+    """One generation request: prompt token ids + sampling config.
+
+    ``rng`` is per-request (seeded) so a retried/re-ordered schedule
+    cannot change what any single request samples."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, prompt, max_new_tokens=16, temperature=0.0,
+                 top_k=None, eos_id=None, seed=0, timeout=None,
+                 payload=None):
+        self.id = next(Request._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1) \
+            if prompt is not None else None
+        self.payload = payload          # stateless-mode input array
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.rng = np.random.RandomState(int(seed) + self.id)
+        self.submitted_at = time.monotonic()
+        # `is not None`, not truthiness: timeout=0 means "already due"
+        # (a fail-fast probe), the opposite of no deadline
+        self.deadline = (self.submitted_at + float(timeout)
+                         if timeout is not None else None)
+        self.first_token_at = None      # set by the engine at prefill
+        self.future = ServeFuture()
+        self.tokens: list = []          # generated ids (engine-owned)
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+
+class RequestQueue:
+    """Bounded FIFO admission queue with deadline sweeping."""
+
+    def __init__(self, capacity=64, registry=None):
+        self.capacity = int(capacity)
+        self._q = deque()
+        self._lock = threading.Lock()
+        self._reg = registry if registry is not None \
+            else _metrics.default_registry()
+        self._depth = self._reg.gauge(
+            "serve_queue_depth", "requests admitted but not yet slotted")
+        self._outcomes = self._reg.counter(
+            "serve_requests_total",
+            "terminal request outcomes", labels=("status",))
+
+    def finish(self, status):
+        """Record a request's terminal outcome (engine calls this at
+        the single point each future is fulfilled)."""
+        self._outcomes.inc(status=status)
+
+    def put(self, req):
+        """Admit or raise :class:`QueueFull` (counted as rejected)."""
+        with self._lock:
+            if len(self._q) >= self.capacity:
+                full = True
+            else:
+                self._q.append(req)
+                full = False
+            depth = len(self._q)
+        self._depth.set(depth)
+        if full:
+            self.finish("rejected")
+            raise QueueFull(
+                f"request queue at capacity ({self.capacity}); "
+                "retry against another replica")
+
+    def pop_batch(self, n, now=None):
+        """Up to ``n`` non-expired requests, FIFO. Expired requests are
+        fulfilled with :class:`RequestTimeout` here (counted
+        ``timed_out``) — they never consume a slot."""
+        taken, expired = [], []
+        with self._lock:
+            while self._q and len(taken) < n:
+                req = self._q.popleft()
+                (expired if req.expired(now) else taken).append(req)
+            depth = len(self._q)
+        self._depth.set(depth)
+        for req in expired:
+            req.future.set_error(RequestTimeout(
+                "deadline passed while queued"))
+            self.finish("timed_out")
+        return taken
+
+    def drain_pending(self, error):
+        """Fulfill every queued request with ``error`` (hard-stop
+        path; graceful drain empties the queue by serving it)."""
+        with self._lock:
+            pending = list(self._q)
+            self._q.clear()
+        self._depth.set(0)
+        for req in pending:
+            if not req.future.done():
+                req.future.set_error(error)
+                self.finish("failed")
+        return len(pending)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._q)
+
+
+__all__ = ["ServingError", "QueueFull", "EngineDraining",
+           "RequestTimeout", "ServeFuture", "Request", "RequestQueue"]

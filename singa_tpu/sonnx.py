@@ -1345,6 +1345,12 @@ class SONNXModel(_Model):
     """Imported ONNX graph as a trainable Model
     (reference SONNXModel sonnx.py:2196). Subclass and override
     ``train_one_batch`` to fine-tune; the imported weights are parameters.
+
+    Serving: an imported graph serves through the SAME engine as the
+    zoo models — ``SONNXModel(m).compile_serving(input_shape=...)``
+    returns a fixed-width :class:`~singa_tpu.serving.BatchServingEngine`
+    (the inherited :meth:`~singa_tpu.model.Model.compile_serving` routes
+    stateless models there); see ``docs/serving.md``.
     """
 
     def __init__(self, onnx_model, device="CPU"):

@@ -226,3 +226,17 @@ class TestTelemetryExample:
             timeout=120)
         assert proc.returncode == 0, proc.stderr[-500:]
         assert "train_step_seconds_count" in proc.stdout
+
+
+class TestServeGatewayExample:
+    """The serving gateway smoke: engine + stdlib HTTP gateway + drain,
+    end to end in one subprocess (the chaos serve-drain scenario's
+    building block)."""
+
+    def test_serve_transformer_selftest(self):
+        out = run_example(["examples/serve_transformer.py", "--cpu",
+                           "--selftest", "4"])
+        assert "READY port=" in out, out[-500:]
+        assert "SELFTEST OK" in out, out[-500:]
+        assert "n_traces=1" in out, out[-500:]
+        assert "drain_exit=0" in out, out[-500:]

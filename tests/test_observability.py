@@ -626,3 +626,86 @@ class TestStepPathInvariants:
             per_step = (time.perf_counter() - t0) / n
         # generous even for a loaded CI box; real cost is ~10 µs
         assert per_step < 500e-6, f"{per_step * 1e6:.1f} µs per step"
+
+
+# ---------------------------------------------------------------------------
+# histogram quantile summaries (the serving SLOs read p99 off these)
+# ---------------------------------------------------------------------------
+
+class TestQuantiles:
+    def test_known_uniform_distribution(self, reg):
+        """20k U(0,1) observations: p50/p95/p99 land within bucket
+        resolution of the true quantiles."""
+        h = reg.histogram("lat_seconds")
+        rng = np.random.RandomState(0)
+        for v in rng.uniform(0, 1, 20000):
+            h.observe(v)
+        q = reg.snapshot()["metrics"][0]["series"][0]["quantiles"]
+        assert abs(q["p50"] - 0.5) < 0.06, q
+        assert abs(q["p95"] - 0.95) < 0.06, q
+        assert abs(q["p99"] - 0.99) < 0.06, q
+
+    def test_known_exponential_distribution(self, reg):
+        """Skewed tail: quantiles of Exp(λ=10) vs the closed form
+        −ln(1−q)/λ, within the (coarser, log-spaced) bucket error."""
+        h = reg.histogram("exp_seconds")
+        rng = np.random.RandomState(1)
+        lam = 10.0
+        for v in rng.exponential(1.0 / lam, 50000):
+            h.observe(v)
+        q = reg.snapshot()["metrics"][0]["series"][0]["quantiles"]
+        for name, p in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            true = -np.log(1 - p) / lam
+            assert abs(q[name] - true) / true < 0.5, (name, q[name], true)
+
+    def test_single_observation_is_exact(self, reg):
+        """min/max clamping makes degenerate series EXACT, not
+        bucket-approximate."""
+        h = reg.histogram("one_seconds")
+        h.observe(0.0042)
+        q = reg.snapshot()["metrics"][0]["series"][0]["quantiles"]
+        assert all(abs(v - 0.0042) < 1e-12 for v in q.values()), q
+
+    def test_empty_series_quantiles_are_none(self):
+        q = export.series_quantiles(
+            {"count": 0, "buckets": [["+Inf", 0]],
+             "min": None, "max": None})
+        assert q == {"p50": None, "p95": None, "p99": None}
+
+    def test_quantiles_clamped_to_observed_extrema(self, reg):
+        """All mass in one bucket: interpolation may not stray outside
+        the exact [min, max] actually observed."""
+        h = reg.histogram("narrow_seconds")
+        for v in (0.030, 0.031, 0.032):
+            h.observe(v)                  # all inside the (0.025, 0.05] bucket
+        q = reg.snapshot()["metrics"][0]["series"][0]["quantiles"]
+        for v in q.values():
+            assert 0.030 <= v <= 0.032, q
+
+    def test_prometheus_text_carries_quantiles(self, reg):
+        h = reg.histogram("lat_seconds")
+        for v in (0.001, 0.002, 0.5):
+            h.observe(v)
+        text = export.render_prometheus(reg.snapshot())
+        assert "lat_seconds_p50" in text
+        assert "lat_seconds_p95" in text
+        assert "lat_seconds_p99" in text
+
+    def test_bucket_quantile_math_direct(self):
+        # 10 observations, cumulative over edges [1, 2, +Inf]
+        buckets = [[1.0, 4], [2.0, 8], ["+Inf", 10]]
+        # p50 → target 5 → inside (1, 2]: 1 + (5-4)/(8-4) * 1 = 1.25
+        assert abs(export.bucket_quantile(buckets, 10, 0.5) - 1.25) < 1e-9
+        # p99 → target 9.9 → overflow bucket → exact max when known
+        assert export.bucket_quantile(buckets, 10, 0.99, hi=7.5) == 7.5
+        # ... else the last finite edge
+        assert export.bucket_quantile(buckets, 10, 0.99) == 2.0
+        assert export.bucket_quantile(buckets, 0, 0.5) is None
+
+    def test_validate_accepts_and_checks_quantiles(self, reg):
+        reg.histogram("h").observe(1.0)
+        doc = reg.snapshot()
+        export.validate_snapshot(doc)     # quantiles present: fine
+        doc["metrics"][0]["series"][0]["quantiles"] = "nope"
+        with pytest.raises(ValueError, match="quantiles"):
+            export.validate_snapshot(doc)

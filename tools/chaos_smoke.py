@@ -32,6 +32,13 @@ harness) against ``examples/train_elastic.py``:
    nothing replayed, nothing skipped); and, in-process, a corrupt
    sample costs exactly one skipped-and-attributed sample while an
    exhausted skip budget fails loudly naming the bytes.
+7. **serve-drain** — the serving fleet's drain contract: two gateway
+   replicas (``examples/serve_transformer.py``) share a request
+   stream; one is SIGTERMed mid-stream and must finish every admitted
+   request (zero dropped in-flight responses), refuse new ones so the
+   driver fails over, and exit 0 (``serving.EXIT_DRAINED``) while the
+   survivor absorbs the queue without ever retracing its decode
+   program.
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
 smoke is bounded by ``--budget`` seconds end to end (default 420) —
@@ -482,12 +489,136 @@ def scenario_data_resume(root, budget):
         it.end()
 
 
+def scenario_serve_drain(root, budget):
+    """Serving-fleet drain contract: two gateway replicas absorb one
+    request stream; one replica is SIGTERMed mid-stream and must
+    (a) finish every request it had admitted — zero dropped in-flight
+    responses, (b) refuse new ones so the driver fails over to the
+    survivor, (c) exit 0 (``serving.EXIT_DRAINED``). Every submitted
+    request gets exactly one complete response."""
+    import http.client
+    import signal as _signal
+    import threading
+
+    serve = os.path.join(REPO, "examples", "serve_transformer.py")
+    ports = [_free_port(), _free_port()]
+    cmd = lambda p: [sys.executable, serve, "--cpu", "--port", str(p),  # noqa: E731
+                     "--slots", "2", "--max-len", "48",
+                     "--prefill-len", "8", "--vocab", "32",
+                     "--d-model", "16", "--layers", "1"]
+    procs = [subprocess.Popen(cmd(p), stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for p in ports]
+    try:
+        # wait for both gateways to answer /healthz
+        deadline = time.monotonic() + min(120, budget.remaining())
+        up = set()
+        while len(up) < 2 and time.monotonic() < deadline:
+            for p in ports:
+                if p in up:
+                    continue
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", p,
+                                                   timeout=2)
+                    c.request("GET", "/healthz")
+                    if c.getresponse().status == 200:
+                        up.add(p)
+                    c.close()
+                except OSError:
+                    time.sleep(0.2)
+        _check(len(up) == 2, "serve-drain: both replicas READY")
+
+        N, new_tokens = 12, 8
+        results = [None] * N
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 32, (int(rng.randint(1, 8)),)).tolist()
+                   for _ in range(N)]
+        started = threading.Semaphore(0)
+
+        def one(i):
+            body = json.dumps({"prompt": prompts[i],
+                               "max_new_tokens": new_tokens,
+                               "temperature": 0.0})
+            # preferred replica first; fail over on refusal — the
+            # router/LB behavior a drained replica's 503 exists for
+            order = [ports[i % 2], ports[(i + 1) % 2]]
+            started.release()
+            last = None
+            for attempt in range(10):
+                # preferred first, then ALTERNATE: a transient failure
+                # on the survivor must not strand retries on the
+                # killed replica's port
+                port = order[attempt % 2]
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", port,
+                                                   timeout=120)
+                    c.request("POST", "/v1/generate", body)
+                    r = c.getresponse()
+                    doc = json.loads(r.read().decode() or "{}")
+                    c.close()
+                except OSError as e:     # replica already gone
+                    last = ("conn", str(e))
+                    time.sleep(0.2)
+                    continue
+                if r.status == 200:
+                    results[i] = doc
+                    return
+                last = (r.status, doc)   # 503 while draining: next
+                time.sleep(0.2)
+            results[i] = ("FAILED", last)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(N)]
+        for t in threads[:6]:
+            t.start()
+        for _ in range(6):      # first wave is in flight NOW
+            started.acquire()
+        # kill replica 0 mid-stream: SIGTERM == graceful drain
+        procs[0].send_signal(_signal.SIGTERM)
+        for t in threads[6:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=budget.remaining())
+        rc0 = procs[0].wait(timeout=budget.remaining())
+        out0 = procs[0].communicate()[0]
+
+        _check(rc0 == 0,
+               f"serve-drain: drained replica exited 0 (got {rc0})",
+               out0)
+        _check("DRAINED exit=0" in out0,
+               "serve-drain: replica reported a clean drain", out0)
+        bad = [(i, r) for i, r in enumerate(results)
+               if not isinstance(r, dict)
+               or len(r.get("tokens", [])) != new_tokens]
+        _check(not bad,
+               f"serve-drain: all {N} requests answered exactly once, "
+               f"complete ({len(bad)} bad)", repr(bad[:3]))
+        # survivor still healthy and never retraced
+        c = http.client.HTTPConnection("127.0.0.1", ports[1], timeout=5)
+        c.request("GET", "/healthz")
+        h = json.loads(c.getresponse().read())
+        c.close()
+        _check(h["status"] == "serving"
+               and h["compiled"]["n_traces"] == 1,
+               "serve-drain: survivor serving, decode traced once")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("commit-hole", scenario_commit_hole),
              ("barrier-missing", scenario_barrier_missing),
              ("bitflip-restore", scenario_bitflip_restore),
              ("divergence-quarantine", scenario_divergence_quarantine),
-             ("data-resume", scenario_data_resume)]
+             ("data-resume", scenario_data_resume),
+             ("serve-drain", scenario_serve_drain)]
 
 
 def main():
