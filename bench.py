@@ -534,11 +534,114 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         except Exception as e:
             res["serving_error"] = str(e)[:200]
         _emit_partial(res, "serving")
+    # quant leg (singa_tpu.quant): int8 weight-only inference — ResNet
+    # img/s + LM tok/s + serving decode tok/s + quantized-checkpoint
+    # bytes on disk, each with its MFU where one is defined. Banked and
+    # regression-gated per record like the bf16 leg.
+    if os.environ.get("BENCH_QUANT", "1") != "0":
+        try:
+            res["quant"] = _leg_guard(
+                lambda: _measure_quant(dev, batch=batch,
+                                       image_size=image_size,
+                                       depth=depth, peak=peak),
+                leg_budget, "quant")
+        except TimeoutError as e:
+            res["quant_error"] = str(e)[:200]
+            res["leg_timeout"] = "quant"
+        except Exception as e:
+            res["quant_error"] = str(e)[:200]
+        _emit_partial(res, "quant")
     return res
 
 
+def _measure_quant(dev, batch=32, image_size=224, depth=50, niters=20,
+                   warmup=3, peak=None, lm_batch=8, lm_seq=256):
+    """The banked quant leg: int8 weight-only INFERENCE throughput
+    (``quant.quantize_params`` + in-graph dequant — the 4x-less-HBM
+    deployment form) plus the quantized serving engine and the
+    bytes-on-disk shrink of a quantized checkpoint.
+
+    MFU is reported per sub-leg against the same peak the training legs
+    use (inference = 2 FLOPs/param/unit, no backward)."""
+    import tempfile
+
+    import numpy as np
+
+    from singa_tpu import quant, tensor
+    from singa_tpu.models import resnet, transformer
+
+    out = {"batch": batch, "depth": depth, "image_size": image_size}
+
+    # -- int8 ResNet inference img/s ------------------------------------
+    model = resnet.create_model(depth=depth, num_classes=10,
+                                num_channels=3,
+                                layout=_conv_layout()[0],
+                                stem=_resnet_stem()[0])
+    x = np.random.RandomState(0).randn(
+        batch, 3, image_size, image_size).astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    model.compile([tx], is_train=False, use_graph=True)
+    with tempfile.TemporaryDirectory() as td:
+        # fp32 twin FIRST (quantize_params is one-way), then the int8
+        # archive the same save route writes once the model is quantized
+        fp32_zip = os.path.join(td, "fp32.zip")
+        model.save_states(fp32_zip)
+        q_report = quant.quantize_params(model)
+        int8_zip = os.path.join(td, "int8.zip")
+        model.save_states(int8_zip)
+        out["ckpt_fp32_bytes"] = os.path.getsize(fp32_zip)
+        out["ckpt_int8_bytes"] = os.path.getsize(int8_zip)
+        out["ckpt_ratio"] = round(
+            out["ckpt_fp32_bytes"] / out["ckpt_int8_bytes"], 2)
+    out["quantized_tensors"] = len(q_report)
+    model.eval()
+    o = None
+    for _ in range(warmup):
+        o = model(tx)
+    _force(o.data)
+    dt = _slope_time(lambda: model(tx), lambda t: t.data,
+                     max(1, niters // 4), niters)
+    out["resnet_img_s"] = batch / dt
+    # inference: fwd only (no 3x training multiplier)
+    if peak:
+        out["resnet_mfu"] = out["resnet_img_s"] * \
+            (RESNET50_TRAIN_FLOPS_PER_IMAGE / 3) / peak
+    del model, tx
+
+    # -- int8 LM inference tok/s ----------------------------------------
+    import jax.numpy as jnp  # noqa: F401 (parity with other legs)
+    lm = transformer.TransformerLM(
+        LM_SHAPE["vocab"], d_model=LM_SHAPE["d_model"], n_heads=8,
+        n_layers=LM_SHAPE["n_layers"], max_len=lm_seq, tp=False)
+    ids = np.random.RandomState(0).randint(
+        0, LM_SHAPE["vocab"], (lm_batch, lm_seq)).astype(np.float32)
+    ti = tensor.Tensor(data=ids, device=dev, requires_grad=False)
+    lm.compile([ti], is_train=False, use_graph=True)
+    quant.quantize_params(lm)
+    lm.eval()
+    o = None
+    for _ in range(warmup):
+        o = lm(ti)
+    _force(o.data)
+    dt = _slope_time(lambda: lm(ti), lambda t: t.data,
+                     max(1, niters // 4), niters)
+    out["lm_tok_s"] = lm_batch * lm_seq / dt
+    if peak:
+        lm_fwd_flops = _lm_train_flops_per_token(
+            LM_SHAPE["d_model"], LM_SHAPE["n_layers"], lm_seq,
+            LM_SHAPE["vocab"]) / 3
+        out["lm_mfu"] = out["lm_tok_s"] * lm_fwd_flops / peak
+    del lm, ti
+
+    # -- quantized serving decode tok/s ----------------------------------
+    serve = _measure_serving(dev, policy="int8_weight_only")
+    out["serving_decode_tok_s"] = serve["decode_tok_s"]
+    out["serving_p99_token_s"] = serve["p99_token_s"]
+    return out
+
+
 def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
-                     n_requests=16, new_tokens=32):
+                     n_requests=16, new_tokens=32, policy=None):
     """The banked serving leg: decode throughput and tail token latency
     of the continuous-batching engine over a small TransformerLM.
 
@@ -564,7 +667,8 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
                         device=dev, requires_grad=False))
     reg = obs_metrics.MetricsRegistry()
     eng = model.compile_serving(slots=slots, max_len=max_len,
-                                prefill_len=prefill_len, registry=reg)
+                                prefill_len=prefill_len, policy=policy,
+                                registry=reg)
     rng = np.random.RandomState(0)
     futs = [eng.submit(rng.randint(1, vocab,
                                    (int(rng.randint(1, prefill_len)),)),
@@ -616,6 +720,7 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
         "wall_tok_s": tok / wall if wall > 0 else None,
         "slots": slots, "new_tokens": new_tokens,
         "n_requests": n_requests,
+        "policy": str(policy) if policy is not None else None,
     }
 
 

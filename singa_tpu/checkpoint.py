@@ -124,9 +124,53 @@ def _apply_restored(model, live, restored):
     optimizer aux that a fresh process has not materialised yet
     (announcing the owning param's spec so it keeps sharding like its
     param); skip — loudly — anything without a live home or with a
-    mismatched shape (e.g. resuming into a re-architected model)."""
+    mismatched shape (e.g. resuming into a re-architected model).
+
+    A QUANTIZED checkpoint (``tools/quantize_checkpoint.py`` /
+    ``quant.quantize_state_arrays``) carries int8 payloads plus
+    ``quant-scale/<key>`` fp32 sidecars: restoring one into a model
+    with floating masters dequantizes payload × scale here and then
+    rides :func:`_adapt_float`'s normal rules into the live master
+    dtype — so a 4x-smaller checkpoint restores into fp32 masters with
+    no extra ceremony. Restoring one into a model quantized IN PLACE
+    (``quant.quantize_params`` — live int8 payloads, scales under live
+    ``<prefix>quant-scale/<name>`` tensors) lands the payload verbatim
+    and the sidecar scale into its live scale tensor: an int8 payload
+    without its matching scale is wrong weights, so a sidecar scale
+    with no home is a LOUD skip like any other orphan entry."""
+    from .quant.core import SCALE_PREFIX as _QSCALE
+    from .quant.core import dequantize_entry
+    q_scales = {k[len(_QSCALE):]: a for k, a in restored.items()
+                if k.startswith(_QSCALE)}
     opt = getattr(model, "optimizer", None)
     for k, arr in restored.items():
+        if k.startswith(_QSCALE):
+            base = k[len(_QSCALE):]
+            lt0 = live.get(base)
+            tdt = getattr(getattr(lt0, "data", None), "dtype", None)
+            if tdt is not None and jnp.issubdtype(tdt, jnp.floating):
+                continue    # consumed by the payload's dequant below
+            # int8-live payload: the scale's home is the live
+            # quant-scale tensor ('model/<n>' -> 'model/quant-scale/<n>')
+            head, _sep, tail = base.rpartition("/")
+            home = live.get(f"{head}/{_QSCALE}{tail}" if head
+                            else _QSCALE + tail)
+            if home is not None and \
+                    tuple(np.shape(home.data)) == tuple(np.shape(arr)):
+                home.data = arr
+                continue
+            warnings.warn(
+                f"checkpoint entry {k!r} (quantization scale) has no "
+                "live scale tensor and its payload did not dequantize "
+                "into floating masters; skipped — the restored int8 "
+                "payload may be mis-scaled", stacklevel=3)
+            continue
+        if (k in q_scales
+                and np.dtype(getattr(arr, "dtype", None)) == np.int8):
+            lt0 = live.get(k)
+            tdt = getattr(getattr(lt0, "data", None), "dtype", None)
+            if tdt is not None and jnp.issubdtype(tdt, jnp.floating):
+                arr = dequantize_entry(arr, q_scales[k])
         lt = live.get(k)
         if lt is not None:
             if tuple(np.shape(lt.data)) != tuple(np.shape(arr)):
@@ -136,7 +180,34 @@ def _apply_restored(model, live, restored):
                     f"{tuple(np.shape(lt.data))}; skipped (did the "
                     "architecture change since the save?)", stacklevel=3)
                 continue
-            lt.data = _adapt_float(arr, getattr(lt.data, "dtype", None))
+            tdt = getattr(lt.data, "dtype", None)
+            if (tdt is not None and jnp.dtype(tdt) == jnp.int8
+                    and jnp.issubdtype(
+                        np.dtype(getattr(arr, "dtype", np.int8)),
+                        np.floating)):
+                # an fp32 checkpoint restored into an in-place-
+                # quantized model (warm restart after quantize_params):
+                # landing the float bytes verbatim would make the
+                # dequant scope multiply full-precision weights by the
+                # stale scale (~100x shrink). Re-quantize fresh and
+                # land the new scale beside the payload.
+                from .quant.core import (SCALE_PREFIX, channel_axis,
+                                         quantize_int8)
+                head, _sep, tail = k.rpartition("/")
+                home = live.get(f"{head}/{SCALE_PREFIX}{tail}" if head
+                                else SCALE_PREFIX + tail)
+                if home is None:
+                    warnings.warn(
+                        f"checkpoint entry {k!r} is float but the live "
+                        "tensor is an int8 payload with no live scale "
+                        "tensor; skipped", stacklevel=3)
+                    continue
+                q, s = quantize_int8(np.asarray(arr),
+                                     channel_axis(np.shape(arr)))
+                lt.data = q
+                home.data = s
+                continue
+            lt.data = _adapt_float(arr, tdt)
         elif k.startswith("optimizer/") and opt is not None \
                 and hasattr(opt, "restore_state_tensor"):
             nm = k[len("optimizer/"):]

@@ -72,10 +72,17 @@ def crc32(data: bytes, value: int = 0) -> int:
 def _raw_buffer(arr):
     """Zero-copy byte view of a C-contiguous array — ``tobytes`` would
     duplicate multi-GB checkpoints a second time just to CRC them.
-    Extended dtypes (bfloat16, fp8) refuse the buffer protocol; those
-    fall back to the one copy."""
+    Extended dtypes (bfloat16, fp8 — ml_dtypes registers them as void
+    dtypes) refuse the buffer protocol directly; a ``uint8`` reinterpret
+    view restores the zero-copy path for them, so every quantized-
+    checkpoint dtype (int8 payloads, bf16, fp8 e4m3/e5m2) digests
+    uniformly. ``tobytes`` remains the last-resort single copy."""
     try:
         return memoryview(arr).cast("B")
+    except (ValueError, TypeError):
+        pass
+    try:
+        return memoryview(arr.view(np.uint8)).cast("B")
     except (ValueError, TypeError):
         return arr.tobytes()
 

@@ -41,7 +41,8 @@ from contextvars import ContextVar
 
 import jax.numpy as jnp
 
-__all__ = ["Policy", "resolve", "active_policy", "policy_scope",
+__all__ = ["Policy", "QuantPolicy", "resolve", "active_policy",
+           "policy_scope",
            "fp32_accumulate", "cast_compute", "compute_dtype",
            "param_dtype", "accum_f32"]
 
@@ -52,9 +53,33 @@ _NAMED = {
     "float16_mixed": ("float32", "float16", "float32"),
     "bfloat16": ("bfloat16", "bfloat16", "bfloat16"),
 }
+# quantized presets (singa_tpu.quant): base float dtypes + the quant
+# axes layered on top. Fields: (param, compute, output, weight_quant,
+# compute_quant, grad_quant, cache_quant, quantize_checkpoints,
+# loss_scaling_default). Resolved to QuantPolicy by resolve().
+_QUANT_NAMED = {
+    # weight-only int8 inference/serving: int8 payloads + per-channel
+    # scales, dequantized in graph at the matmul/conv boundary; ring KV
+    # cache in int8; checkpoints persist the int8 bytes (~4x smaller)
+    "int8_weight_only": ("float32", "float32", "float32",
+                         "int8", None, None, "int8", True, False),
+    # fp8 serving: e4m3 weight emulation over bf16 compute, int8 cache
+    "fp8_serving": ("float32", "bfloat16", "float32",
+                    None, "e4m3", None, "int8", False, None),
+    # fp8 training: e4m3 fake-quant compute (STE), e5m2 gradient
+    # emulation through the GuardedOptimizer driver, dynamic loss
+    # scaling on (bf16 compute underneath)
+    "fp8_mixed": ("float32", "bfloat16", "float32",
+                  None, "e4m3", "e5m2", None, False, None),
+    # int8 QAT: fp32 masters/compute with int8 fake-quant at every op
+    # boundary; loss scaling stays armed so the guard rides along
+    "int8_qat": ("float32", "float32", "float32",
+                 None, "int8", None, None, False, True),
+}
 _ALIASES = {"fp32": "float32", "f32": "float32",
             "bf16": "bfloat16", "mixed_bf16": "bf16_mixed",
-            "fp16_mixed": "float16_mixed", "f16_mixed": "float16_mixed"}
+            "fp16_mixed": "float16_mixed", "f16_mixed": "float16_mixed",
+            "int8": "int8_weight_only", "fp8": "fp8_mixed"}
 
 _LOW_BITS = (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
 
@@ -77,12 +102,17 @@ class Policy:
     def __init__(self, name="bf16_mixed", *, param_dtype=None,
                  compute_dtype=None, output_dtype=None, loss_scaling=None):
         key = _ALIASES.get(str(name).lower(), str(name).lower())
-        if key not in _NAMED:
+        if key in _QUANT_NAMED and type(self) is Policy:
+            raise ValueError(
+                f"{name!r} is a quantized preset: construct it via "
+                f"QuantPolicy({name!r}) or mixed_precision.resolve")
+        if key not in _NAMED and key not in _QUANT_NAMED:
             raise ValueError(
                 f"unknown precision policy {name!r}; expected one of "
-                f"{sorted(_NAMED)} (or aliases {sorted(_ALIASES)})")
+                f"{sorted(_NAMED) + sorted(_QUANT_NAMED)} (or aliases "
+                f"{sorted(_ALIASES)})")
         self.name = key
-        p, c, o = _NAMED[key]
+        p, c, o = _NAMED[key] if key in _NAMED else _QUANT_NAMED[key][:3]
         self.param_dtype = _dt(param_dtype if param_dtype is not None
                                else p)
         self.compute_dtype = _dt(compute_dtype if compute_dtype is not None
@@ -155,11 +185,117 @@ class Policy:
         return x
 
 
+class QuantPolicy(Policy):
+    """A precision policy with quantized numerics layered on top
+    (the ``singa_tpu.quant`` subsystem's compile-time contract).
+
+    Named presets (see ``_QUANT_NAMED``):
+
+    - ``"int8_weight_only"`` — inference/serving: weights are int8
+      payloads + per-channel fp32 scales, dequantized in graph at
+      their use sites; the serving ring KV cache runs int8; checkpoint
+      routes persist the int8 bytes (~4x smaller);
+    - ``"fp8_serving"`` — e4m3 weight emulation over bf16 compute with
+      an int8 KV cache;
+    - ``"fp8_mixed"`` — fp8 training: e4m3 fake-quant compute (STE)
+      inside the compiled step, e5m2 gradient emulation through the
+      ``GuardedOptimizer`` driver, dynamic loss scaling on;
+    - ``"int8_qat"`` — int8 quantization-aware training over fp32
+      masters (fake-quant at every op boundary, guard armed).
+
+    ``scales`` (usually via :meth:`with_scales` /
+    ``quant.Calibrator.freeze``) freezes per-op-position activation
+    scales into the policy: the traced program bakes them in as
+    constants instead of deriving a scale from each batch's amax.
+    """
+
+    def __init__(self, name="int8_weight_only", *, scales=None,
+                 loss_scaling=None, **kw):
+        key = _ALIASES.get(str(name).lower(), str(name).lower())
+        if key not in _QUANT_NAMED:
+            raise ValueError(
+                f"unknown quantized policy {name!r}; expected one of "
+                f"{sorted(_QUANT_NAMED)} (plain presets go through "
+                "Policy/resolve)")
+        (_p, _c, _o, self.weight_quant, self.compute_quant,
+         self.grad_quant, self.cache_quant, self.quantize_checkpoints,
+         ls_default) = _QUANT_NAMED[key]
+        if loss_scaling is None:
+            loss_scaling = ls_default
+        super().__init__(key, loss_scaling=loss_scaling, **kw)
+        self.scales = dict(scales) if scales else None
+
+    def describe(self):
+        d = super().describe()
+        d.update({"weight_quant": self.weight_quant,
+                  "compute_quant": self.compute_quant,
+                  "grad_quant": self.grad_quant,
+                  "cache_quant": self.cache_quant})
+        if self.scales:
+            # the frozen scales ARE numerics: two policies with
+            # different calibrations must not compare (or hash) equal,
+            # so a content digest of the scale table rides describe()
+            import zlib
+            blob = ",".join(f"{k}={self.scales[k]!r}"
+                            for k in sorted(self.scales))
+            d["calibrated_ops"] = len(self.scales)
+            d["scales_crc"] = f"{zlib.crc32(blob.encode()):08x}"
+        return d
+
+    def with_scales(self, scales):
+        """A copy of this policy with calibration scales frozen in."""
+        return type(self)(self.name, scales=scales,
+                          loss_scaling=self._loss_scaling,
+                          param_dtype=self.param_dtype,
+                          compute_dtype=self.compute_dtype,
+                          output_dtype=self.output_dtype)
+
+    def apply_compute_quant(self, a, pos):
+        """Fake-quantize one compute operand (op position ``pos`` in
+        the forward's trace order — how frozen calibration scales find
+        their operand). Called by :func:`cast_compute` inside the
+        traced step; STE keeps backward an identity."""
+        kind = self.compute_quant
+        if kind is None:
+            return a
+        from .quant import core as _qcore   # lazy: quant imports us
+        scale = self.scales.get(f"act{pos}") if self.scales else None
+        if kind == "int8":
+            return _qcore.fake_quant_int8(a, scale=scale)
+        return _qcore.fake_quant_fp8(a, kind, scale)
+
+
 def resolve(policy):
-    """str | Policy | None -> Policy | None."""
+    """str | dict | Policy | None -> Policy | None. Strings resolve
+    named presets (quantized ones to :class:`QuantPolicy`); a dict is
+    a ``describe()`` document — the ``meta/precision_policy`` stamp a
+    checkpoint carries — whose name AND per-dtype overrides both
+    round-trip (a ``Policy("bf16_mixed", compute_dtype="float32")``
+    stamp must not come back as stock bf16_mixed). Frozen calibration
+    scales are NOT in the stamp (only their CRC): resolving a
+    calibrated stamp warns that the policy needs re-calibrating."""
     if policy is None or isinstance(policy, Policy):
         return policy
-    return Policy(policy)
+    kw = {}
+    if isinstance(policy, dict):
+        doc = policy
+        policy = doc.get("name")
+        kw = {f: doc[f] for f in ("param_dtype", "compute_dtype",
+                                  "output_dtype") if doc.get(f)}
+        if doc.get("calibrated_ops") or doc.get("scales_crc"):
+            import warnings
+            warnings.warn(
+                f"precision-policy stamp {policy!r} records "
+                f"{doc.get('calibrated_ops')} calibrated scales (crc "
+                f"{doc.get('scales_crc')}) but the scales themselves "
+                "are not stored in the stamp: the resolved policy "
+                "falls back to dynamic per-batch scales — re-run "
+                "quant.Calibrator to restore frozen numerics",
+                stacklevel=2)
+    key = _ALIASES.get(str(policy).lower(), str(policy).lower())
+    if key in _QUANT_NAMED:
+        return QuantPolicy(key, **kw)
+    return Policy(policy, **kw)
 
 
 # Per-context scope stack (same pattern as ops/layout.py): a ContextVar
@@ -167,6 +303,17 @@ def resolve(policy):
 # another thread's trace; ``None`` entries are fp32_accumulate escapes.
 _stack: ContextVar[tuple] = ContextVar("singa_tpu_precision_policy",
                                        default=())
+
+# quantization hooks riding the cast_compute chokepoint:
+# - _observer: a `(tag, array)` callback the quant.Calibrator installs
+#   to record activation ranges during an eager calibration pass;
+# - _qpos: the per-scope op-position counter ([next_index]) that tags
+#   operands in trace order (`act0, act1, ...`) — reset at every
+#   policy-scope entry so the eager calibration pass and the traced
+#   step number the same operands identically.
+_observer: ContextVar = ContextVar("singa_tpu_quant_observer",
+                                   default=None)
+_qpos: ContextVar = ContextVar("singa_tpu_quant_pos", default=None)
 
 
 def active_policy():
@@ -185,9 +332,14 @@ def policy_scope(policy):
         yield
         return
     token = _stack.set(_stack.get() + (resolve(policy),))
+    # fresh op-position counter per scope entry: one forward/step body
+    # numbers its compute operands 0..N in trace order (calibration
+    # tags and frozen quant scales key off these positions)
+    qtok = _qpos.set([0])
     try:
         yield
     finally:
+        _qpos.reset(qtok)
         _stack.reset(token)
 
 
@@ -217,16 +369,42 @@ def cast_compute(*arrays):
     per-op discipline matmul/conv/attention/bias ops apply to their
     inputs). Integers, bools and ``None`` pass through; with no active
     policy this is the identity. Returns a single value for a single
-    argument."""
-    p = active_policy()
-    if p is None or p.compute_dtype is None:
+    argument.
+
+    This is also the quantization chokepoint: each floating operand is
+    (a) reported to an active calibration observer and (b) fake-
+    quantized when the active policy is a :class:`QuantPolicy` with a
+    ``compute_quant`` kind — both keyed by the operand's position in
+    the scope's trace order, so calibration-frozen scales land on
+    exactly the operands they were measured from."""
+    stack = _stack.get()
+    if stack and stack[-1] is None:
+        # inside fp32_accumulate: no casts, AND no position counting /
+        # observation — the escape region must be invisible to the
+        # quantization op-order in BOTH the eager calibration pass and
+        # the policied run, or every later act{i} tag would shift and
+        # frozen scales would land on the wrong operands
         return arrays[0] if len(arrays) == 1 else arrays
-    ct = p.compute_dtype
+    p = stack[-1] if stack else None
+    obs = _observer.get()
+    if (p is None or p.compute_dtype is None) and obs is None:
+        return arrays[0] if len(arrays) == 1 else arrays
+    ct = p.compute_dtype if p is not None else None
+    fq = p if getattr(p, "compute_quant", None) else None
+    pos = _qpos.get() if (obs is not None or fq is not None) else None
     out = []
     for a in arrays:
         if a is not None and hasattr(a, "dtype") and \
-                jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != ct:
-            a = a.astype(ct)
+                jnp.issubdtype(a.dtype, jnp.floating):
+            if ct is not None and a.dtype != ct:
+                a = a.astype(ct)
+            if pos is not None:
+                i = pos[0]
+                pos[0] += 1
+                if obs is not None:
+                    obs(f"act{i}", a)
+                if fq is not None:
+                    a = fq.apply_compute_quant(a, i)
         out.append(a)
     return out[0] if len(out) == 1 else tuple(out)
 

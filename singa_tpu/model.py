@@ -413,9 +413,31 @@ class Model(Layer):
         see one consistent policy wherever the model's code runs —
         including a watchdog worker thread (the scope is entered inside
         the body, so no ContextVar propagation is needed). Nullcontext
-        when the model was compiled without a policy."""
+        when the model was compiled without a policy.
+
+        A weight-quantized model (``quant.quantize_params``) also
+        enters its dequant scope here: int8 payloads are rebound to
+        their in-graph dequantized values for the body's duration, so
+        every path — eager, compiled, serving — consumes fp32 weights
+        while the threaded/stored state stays int8."""
+        import contextlib
         from . import mixed_precision as mp
-        return mp.policy_scope(getattr(self, "_policy", None))
+        stack = contextlib.ExitStack()
+        stack.enter_context(mp.policy_scope(getattr(self, "_policy",
+                                                    None)))
+        if getattr(self, "_quant_pairs", None):
+            from .quant import core as _qcore
+            stack.enter_context(_qcore.dequant_params_scope(self))
+        return stack
+
+    def get_states(self):
+        """Layer state walk, plus the per-channel quantization scales a
+        weight-quantized model carries (``quant-scale/<param>`` — see
+        ``quant.quantize_params``): scales thread through compiled
+        steps, checkpoints and digests exactly like any other state."""
+        states = super().get_states()
+        states.update(getattr(self, "_quant_scales", {}))
+        return states
 
     # -- abstract (zero-compute) materialisation ---------------------------
     def _abstract_call(self, inputs, body):
@@ -1292,13 +1314,46 @@ class Model(Layer):
                                                          t0 + tot)
         return result, table
 
-    def save_states(self, fpath, aux_states={}):  # noqa: B006 (parity)
+    def save_states(self, fpath, aux_states={}, quantize=None):  # noqa: B006 (parity)
         """Zip of params+states .npz and an attribute JSON, including
-        optimizer aux states (reference model.py:244-295)."""
+        optimizer aux states (reference model.py:244-295).
+
+        ``quantize``: a quantized policy (or its name, e.g.
+        ``"int8_weight_only"``) persists eligible weights as int8
+        payloads plus per-channel ``quant-scale/`` fp32 sidecars (~4x
+        smaller archive; lossy — fp32 masters stay untouched in
+        memory). A model compiled under ``int8_weight_only`` quantizes
+        its checkpoints by default; ``load_states`` dequantizes back
+        into fp32 masters. A model already weight-quantized in place
+        (``quant.quantize_params``) saves its int8 state as-is."""
+        from . import mixed_precision as mp
         states = {k: v for k, v in self.get_states().items()}
         attr = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                 for k, v in states.items()}
-        if getattr(self, "_policy", None) is not None:
+        qpol = mp.resolve(quantize) if quantize is not None \
+            else getattr(self, "_policy", None)
+        if quantize is not None and (
+                not isinstance(qpol, mp.QuantPolicy)
+                or qpol.weight_quant is None):
+            # an EXPLICIT quantize= that cannot be honored must fail,
+            # not silently write a full-size fp32 archive the caller
+            # believes is 4x smaller
+            raise ValueError(
+                f"save_states(quantize={quantize!r}): not a weight-"
+                "quantizing policy (only 'int8_weight_only' persists "
+                "int8 payloads; fp8/QAT presets quantize compute, not "
+                "storage)")
+        do_quant = (isinstance(qpol, mp.QuantPolicy)
+                    and qpol.weight_quant is not None
+                    and not getattr(self, "_quant_pairs", None)
+                    and (quantize is not None
+                         or getattr(qpol, "quantize_checkpoints",
+                                    False)))
+        if do_quant:
+            # the archive self-describes as quantized: the preset
+            # round-trips through meta/precision_policy
+            attr["meta/precision_policy"] = qpol.describe()
+        elif getattr(self, "_policy", None) is not None:
             # self-describing checkpoints: params in the archive are the
             # POLICY'S MASTERS (fp32 under bf16_mixed) — record the
             # policy so a reader can tell masters from a pure-16-bit run
@@ -1317,6 +1372,23 @@ class Model(Layer):
         # one batched cross-process gather for every host-sharded param
         arrays = {k: _portable(v) for k, v in to_host_tree(
             {k: v.data for k, v in states.items()}).items()}
+        if do_quant:
+            from .quant import core as _qcore
+            for k, t in states.items():
+                if not _qcore.eligible(t):
+                    continue
+                q, s = _qcore.quantize_int8(
+                    arrays[k], _qcore.channel_axis(np.shape(arrays[k])))
+                arrays[k] = np.asarray(q)
+                arrays[_qcore.SCALE_PREFIX + k] = np.asarray(s)
+                attr[k] = {"shape": list(np.shape(arrays[k])),
+                           "dtype": "int8",
+                           "quant": {"kind": "int8",
+                                     "orig_dtype": attr[k]["dtype"]}}
+                attr[_qcore.SCALE_PREFIX + k] = {
+                    "shape": list(np.shape(arrays[_qcore.SCALE_PREFIX
+                                                  + k])),
+                    "dtype": "float32", "quant_scale": True}
         opt = getattr(self, "optimizer", None)
         if opt is not None and hasattr(opt, "get_states"):
             for k, v in opt.get_states().items():
@@ -1381,9 +1453,22 @@ class Model(Layer):
         model_states = {k: v for k, v in arrays.items()
                         if not k.startswith(("optimizer/", "aux/"))}
         my_states = self.get_states()
+        # quantized archive (save_states(quantize=...)): int8 payloads
+        # carry a quant-scale/ sidecar — restoring into fp32 masters
+        # dequantizes here; restoring into an equally-quantized model
+        # copies payload and scale verbatim (its live tensors are int8,
+        # so the dequant branch never fires for them)
+        from .quant.core import SCALE_PREFIX as _QSCALE
+        from .quant.core import dequantize_entry
+        q_scales = {k[len(_QSCALE):]: v for k, v in arrays.items()
+                    if k.startswith(_QSCALE)}
         for k, v in model_states.items():
             if k in my_states:
-                my_states[k].copy_from_numpy(v)
+                lt = my_states[k]
+                if (k in q_scales and np.dtype(v.dtype) == np.int8
+                        and jnp.issubdtype(lt.dtype, jnp.floating)):
+                    v = dequantize_entry(v, q_scales[k])
+                lt.copy_from_numpy(v)
         opt = getattr(self, "optimizer", None)
         if opt is not None and hasattr(opt, "set_states"):
             opt_states = {k[len("optimizer/"):]: v

@@ -103,24 +103,30 @@ def sample(model, start_ids, vocab_size, nsamples=100, use_max=False,
     rng = np.random.RandomState(seed)
     ids = list(start_ids)
     out_ids = []
-    # re-run with batch 1; borrow the layer weights via step_forward
-    h = Tensor(data=np.zeros((1, model.hidden_size), np.float32),
-               requires_grad=False)
-    c = Tensor(data=np.zeros((1, model.hidden_size), np.float32),
-               requires_grad=False)
-    for i in ids:
-        x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[i]],
+    # re-run with batch 1; borrow the layer weights via step_forward —
+    # under the model's OWN scope (this drives layers directly, not
+    # Model.__call__): a precision policy is honored and a weight-
+    # quantized model's int8 payloads are dequantized, exactly as in
+    # every other forward path
+    with model._policy_scope():
+        h = Tensor(data=np.zeros((1, model.hidden_size), np.float32),
                    requires_grad=False)
-        h, c = model.rnn.step_forward(x, h, c)
-    temp = 0 if use_max else temperature
-    for _ in range(nsamples):
-        logits = np.asarray(model.dense(h).numpy()).ravel()
-        cur = _decode.sample_logits(logits, temperature=temp,
-                                    top_k=top_k, rng=rng)
-        out_ids.append(cur)
-        x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[cur]],
+        c = Tensor(data=np.zeros((1, model.hidden_size), np.float32),
                    requires_grad=False)
-        h, c = model.rnn.step_forward(x, h, c)
+        for i in ids:
+            x = Tensor(data=np.eye(vocab_size, dtype=np.float32)[[i]],
+                       requires_grad=False)
+            h, c = model.rnn.step_forward(x, h, c)
+        temp = 0 if use_max else temperature
+        for _ in range(nsamples):
+            logits = np.asarray(model.dense(h).numpy()).ravel()
+            cur = _decode.sample_logits(logits, temperature=temp,
+                                        top_k=top_k, rng=rng)
+            out_ids.append(cur)
+            x = Tensor(data=np.eye(vocab_size,
+                                   dtype=np.float32)[[cur]],
+                       requires_grad=False)
+            h, c = model.rnn.step_forward(x, h, c)
     return out_ids
 
 
@@ -153,13 +159,19 @@ class _CharRNNServeAdapter:
     def params(self):
         import jax
         import jax.numpy as jnp
+        from ..quant.core import dequant_params_scope
 
         def a(t):
             return jnp.asarray(np.asarray(jax.device_get(t.data)))
 
         m = self.m
-        return {"Wx": a(m.rnn.Wx), "Wh": a(m.rnn.Wh), "b": a(m.rnn.b),
-                "dense_w": a(m.dense.W), "dense_b": a(m.dense.b)}
+        with dequant_params_scope(m):
+            # an in-place-quantized model (quant.quantize_params) hands
+            # the engine dequantized fp32 weights — raw int8 payloads
+            # consumed as floats would be garbage logits
+            return {"Wx": a(m.rnn.Wx), "Wh": a(m.rnn.Wh),
+                    "b": a(m.rnn.b), "dense_w": a(m.dense.W),
+                    "dense_b": a(m.dense.b)}
 
     def init_cache(self, slots, max_len):
         import jax.numpy as jnp

@@ -511,7 +511,25 @@ class _LMServeAdapter:
     and the head stay f32, block weights and the cache run in the
     policy's compute dtype (bf16 serving out of the box), attention
     softmax and the returned logits are f32.
+
+    Quantized serving (``singa_tpu.quant`` presets): under
+    ``"int8_weight_only"`` every block matmul weight is quantized ONCE
+    at engine build into an int8 payload + per-output-channel fp32
+    scale and dequantized in graph at its use site (embeddings and the
+    head stay f32 — they are the parity-critical ends); under
+    ``"fp8_serving"`` block weights are rounded through the e4m3 grid
+    inside the compiled programs. Either way a ``cache_quant`` policy
+    runs the ring KV cache in int8 with per-(slot, ring-index) scale
+    rows — ``kv_cache`` dequantizes into the unchanged f32 softmax.
     """
+
+    # block weights eligible for int8 weight-only quantization (2-D
+    # matmul operands; biases/LN stay f32, MoE expert banks pass
+    # through untouched)
+    _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_up", "w_dn")
+    # build_engine's honored-or-refused contract for quantized policies
+    supports_weight_quant = True
+    supports_cache_quant = True
 
     def __init__(self, m, policy=None):
         self.m = m
@@ -534,7 +552,34 @@ class _LMServeAdapter:
         return jnp.dtype(cd) if cd is not None else jnp.dtype(jnp.float32)
 
     def params(self):
-        return _lm_decode_params(self.m)
+        from ..quant.core import dequant_params_scope
+        with dequant_params_scope(self.m):
+            # a model already weight-quantized in place hands the
+            # engine its DEQUANTIZED weights here (concrete arrays at
+            # build time; re-quantized below under an int8 policy)
+            P = _lm_decode_params(self.m)
+        if getattr(self.policy, "weight_quant", None) == "int8":
+            from ..quant import core as _qcore
+            import jax.numpy as jnp
+            blocks = []
+            for p in P["blocks"]:
+                bp = dict(p)
+                for key in self._QUANT_KEYS:
+                    w = bp.get(key)
+                    if w is not None and w.ndim == 2 and \
+                            jnp.issubdtype(w.dtype, jnp.floating):
+                        q, s = _qcore.quantize_int8(
+                            w, _qcore.channel_axis(w.shape))
+                        bp[key] = {"q": q, "s": s}
+                blocks.append(bp)
+            P = dict(P, blocks=blocks)
+        return P
+
+    def _cache_dtype(self):
+        import jax.numpy as jnp
+        if getattr(self.policy, "cache_quant", None) == "int8":
+            return jnp.dtype(jnp.int8)
+        return self._compute_dtype()
 
     def validate(self, prefill_len, max_len):
         """Engine-construction-time limits the engine itself can't see:
@@ -554,7 +599,7 @@ class _LMServeAdapter:
     def init_cache(self, slots, max_len):
         from ..serving import kv_cache
         return [kv_cache.init_cache(slots, self.n_heads, max_len,
-                                    self.head_dim, self._compute_dtype())
+                                    self.head_dim, self._cache_dtype())
                 for _ in self.m.blocks]
 
     def _mlp_apply(self):
@@ -595,10 +640,28 @@ class _LMServeAdapter:
         n_heads = self.n_heads
         cdt = self._compute_dtype()
         mlp_apply = self._mlp_apply()
+        fp8_w = getattr(self.policy, "compute_quant", None) \
+            if getattr(self.policy, "weight_quant", None) is None else None
+        if fp8_w is not None and fp8_w not in ("e4m3", "e5m2"):
+            fp8_w = None        # int8 fake-quant policies serve as-is
 
         def c(a):
-            return a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) \
-                else a
+            if isinstance(a, dict):
+                # int8 weight-only payload from params(): the in-graph
+                # dequant XLA fuses into the consuming matmul — the
+                # threaded params stay int8, only this use site is fp
+                from ..quant import core as _qcore
+                return _qcore.dequantize_int8(a["q"], a["s"], cdt)
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                return a
+            a = a.astype(cdt)
+            if fp8_w is not None and a.ndim == 2:
+                # fp8_serving: matmul weights rounded through the e4m3
+                # grid inside the compiled programs (biases/LN stay in
+                # the compute dtype — tiny and fragile)
+                from ..quant import core as _qcore
+                a = _qcore.fake_cast(a, fp8_w)
+            return a
 
         def block(p, x, level, attend):
             h = _ln(x, p["ln1_s"], p["ln1_b"])

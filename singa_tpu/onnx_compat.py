@@ -11,6 +11,15 @@ from __future__ import annotations
 
 import numpy as np
 
+
+class UnsupportedOnnxDtype(ValueError):
+    """A TensorProto ``data_type`` this build cannot decode into a
+    numpy array (e.g. an exotic fp8 variant in a quantized model).
+    Carries the dtype NAME, not just the enum int, so a failed
+    quantized-model import says what it hit instead of a bare
+    KeyError."""
+
+
 try:  # prefer the real package when present
     import onnx as _onnx
     from onnx import helper, numpy_helper  # noqa: F401
@@ -45,18 +54,59 @@ except ImportError:
         np.dtype(np.uint32): TensorProto.UINT32,
         np.dtype(np.uint64): TensorProto.UINT64,
     }
+    try:
+        # quantized-model interop (BFLOAT16 = 16 is in the bundled
+        # proto enum; the fp8 ids are the stock onnx values, accepted
+        # numerically so a file produced by newer tooling still opens)
+        import ml_dtypes as _mld
+        _NP_TO_ONNX[np.dtype(_mld.bfloat16)] = TensorProto.BFLOAT16
+        _NP_TO_ONNX[np.dtype(_mld.float8_e4m3fn)] = 17   # FLOAT8E4M3FN
+        _NP_TO_ONNX[np.dtype(_mld.float8_e5m2)] = 19     # FLOAT8E5M2
+    except ImportError:
+        pass
     _ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+    # names for ids this reader knows OF but cannot decode — so an
+    # import of e.g. a FLOAT8E4M3FNUZ-quantized model fails naming the
+    # dtype instead of with a bare KeyError on an integer
+    _ONNX_DTYPE_NAMES = {
+        0: "UNDEFINED", 1: "FLOAT", 2: "UINT8", 3: "INT8", 4: "UINT16",
+        5: "INT16", 6: "INT32", 7: "INT64", 8: "STRING", 9: "BOOL",
+        10: "FLOAT16", 11: "DOUBLE", 12: "UINT32", 13: "UINT64",
+        14: "COMPLEX64", 15: "COMPLEX128", 16: "BFLOAT16",
+        17: "FLOAT8E4M3FN", 18: "FLOAT8E4M3FNUZ", 19: "FLOAT8E5M2",
+        20: "FLOAT8E5M2FNUZ", 21: "UINT4", 22: "INT4", 23: "FLOAT4E2M1",
+    }
+
+    def _onnx_to_np(data_type):
+        try:
+            return _ONNX_TO_NP[data_type]
+        except KeyError:
+            name = _ONNX_DTYPE_NAMES.get(int(data_type),
+                                         f"id {data_type}")
+            raise UnsupportedOnnxDtype(
+                f"ONNX TensorProto dtype {name} ({data_type}) is not "
+                "supported by singa_tpu's bundled ONNX reader "
+                "(supported: "
+                f"{sorted(str(d) for d in _NP_TO_ONNX)})") from None
 
     class _Helper:
         """make_* builders mirroring onnx.helper semantics."""
 
         @staticmethod
         def np_dtype_to_tensor_dtype(dtype):
-            return _NP_TO_ONNX[np.dtype(dtype)]
+            try:
+                return _NP_TO_ONNX[np.dtype(dtype)]
+            except KeyError:
+                raise UnsupportedOnnxDtype(
+                    f"numpy dtype {np.dtype(dtype)!s} has no ONNX "
+                    "TensorProto id in singa_tpu's bundled writer "
+                    f"(supported: {sorted(str(d) for d in _NP_TO_ONNX)})"
+                ) from None
 
         @staticmethod
         def tensor_dtype_to_np_dtype(tensor_dtype):
-            return _ONNX_TO_NP[tensor_dtype]
+            return _onnx_to_np(tensor_dtype)
 
         @staticmethod
         def make_attribute(name, value):
@@ -135,7 +185,7 @@ except ImportError:
             if raw:
                 t.raw_data = vals if isinstance(vals, bytes) else bytes(vals)
             else:
-                np_dtype = _ONNX_TO_NP[data_type]
+                np_dtype = _onnx_to_np(data_type)
                 arr = np.asarray(vals, dtype=np_dtype).ravel()
                 t.raw_data = arr.tobytes()
             return t
@@ -188,7 +238,7 @@ except ImportError:
 
         @staticmethod
         def to_array(t):
-            dtype = _ONNX_TO_NP[t.data_type]
+            dtype = _onnx_to_np(t.data_type)
             shape = tuple(t.dims)
             if t.raw_data:
                 return np.frombuffer(t.raw_data, dtype=dtype).reshape(shape)

@@ -1,0 +1,45 @@
+"""singa_tpu.quant — int8/fp8 quantization subsystem.
+
+Extends the :mod:`singa_tpu.mixed_precision` policy axis beyond
+bf16/fp16 into integer and fp8 numerics, end to end:
+
+- **weight-only int8** (:func:`quantize_params`): fp32 masters become
+  int8 payloads + per-channel fp32 scale sidecars, dequantized IN
+  GRAPH at the matmul/conv boundary — the one-jitted-program contract
+  and the ``n_traces == 1`` pin survive;
+- **fp8 compute / QAT** (``QuantPolicy("fp8_mixed")`` /
+  ``("int8_qat")``): e4m3 weight/activation fake-quant with the
+  straight-through estimator inside the compiled step, e5m2 gradient
+  emulation riding the ``GuardedOptimizer`` loss-scaling driver;
+- **calibration** (:class:`Calibrator`): observe N batches, record
+  activation ranges as registry gauges, freeze scales into the policy;
+- **quantized serving**: ``Model.compile_serving(
+  policy="int8_weight_only" | "fp8_serving")`` quantizes weights at
+  engine build and runs the ring KV cache in int8 (per-slot scale
+  rows, f32 softmax unchanged);
+- **quantized checkpoints**: ``save_states`` / ``CheckpointManager``
+  persist int8 payload + scales with the normal digest sidecars (~4x
+  smaller); ``tools/quantize_checkpoint.py`` converts an existing fp32
+  checkpoint offline.
+
+See ``docs/quantization.md`` for the policy table and workflow.
+"""
+
+from . import core                                   # noqa: F401
+from . import calibrate as calibrate_mod             # noqa: F401
+from .core import (                                  # noqa: F401
+    SCALE_PREFIX, channel_axis, dequant_params_scope,
+    dequantize_fp8, dequantize_int8, dequantize_state_arrays,
+    fake_cast, fake_quant_fp8, fake_quant_int8, quantize_fp8,
+    quantize_int8, quantize_int8_rows, quantize_params,
+    quantize_state_arrays,
+)
+from .calibrate import Calibrator, calibrate         # noqa: F401
+
+__all__ = [
+    "core", "SCALE_PREFIX", "channel_axis", "dequant_params_scope",
+    "dequantize_fp8", "dequantize_int8", "dequantize_state_arrays",
+    "fake_cast", "fake_quant_fp8", "fake_quant_int8", "quantize_fp8",
+    "quantize_int8", "quantize_int8_rows", "quantize_params",
+    "quantize_state_arrays", "Calibrator", "calibrate",
+]

@@ -1,0 +1,147 @@
+"""Amax calibration: observe N batches, freeze scales into the policy.
+
+Dynamic (per-batch amax) quantization makes every step's numerics
+depend on that step's data. For serving and fp8 compute the scales
+should instead be a COMPILE-TIME contract: run a handful of
+representative batches through the model once, record the activation
+ranges each op actually sees, and freeze the resulting scales into the
+:class:`~singa_tpu.mixed_precision.QuantPolicy` — from then on the
+traced program bakes them in as constants.
+
+The observation point is the one chokepoint every matmul / conv /
+attention / RNN operand already flows through:
+``mixed_precision.cast_compute``. While a :class:`Calibrator` scope is
+active, each floating operand is reported to the calibrator tagged by
+its POSITION in the forward's op order (``act0, act1, ...`` — reset at
+every policy-scope entry). Position tags are what make freezing
+line up with execution: the traced step replays ops in the same order
+the eager calibration pass ran them, so ``act{i}``'s frozen scale lands
+on exactly the operand it was measured from. Two calibration runs over
+the same batches therefore produce BIT-IDENTICAL scales (pinned by
+``tests/test_quant.py``): the record is a plain running max of exact
+device amaxes, no averaging, no randomness.
+
+Observed ranges are published as ``quant_amax``/``quant_scale`` gauges
+(label ``tensor``) so a calibration run is inspectable through the
+normal telemetry spine.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+
+from .. import mixed_precision as mp
+from . import core
+
+
+class Calibrator:
+    """Record per-op-position activation amaxes over calibration
+    batches; ``freeze(policy)`` turns them into a scale-frozen policy.
+
+        cal = Calibrator()
+        cal.run(model, batches)             # eager forwards, observed
+        policy = cal.freeze(mp.resolve("fp8_mixed"))
+        model.compile([x], policy=policy)   # scales are now constants
+    """
+
+    def __init__(self, registry=None):
+        self.amax = {}          # tag -> running max |activation|
+        self.batches_seen = 0
+        self._registry = registry
+
+    # -- observation --------------------------------------------------------
+    def record(self, tag, arr):
+        """One observed operand. Tracers are ignored: calibration is an
+        EAGER pass by design (a traced abstract value has no amax)."""
+        if isinstance(arr, jax.core.Tracer):
+            return
+        v = float(np.max(np.abs(np.asarray(arr)))) if np.size(arr) \
+            else 0.0
+        prev = self.amax.get(tag, 0.0)
+        if v > prev:
+            self.amax[tag] = v
+        else:
+            self.amax.setdefault(tag, prev)
+
+    @contextlib.contextmanager
+    def observe(self):
+        """Scope under which ``cast_compute`` reports every floating
+        operand here (nests with any active policy scope)."""
+        token = mp._observer.set(self.record)
+        # a fresh op-position counter even without an active policy
+        # (calibration usually runs BEFORE compile(policy=...)); an
+        # inner policy scope resets it again per forward body
+        qtok = mp._qpos.set([0])
+        try:
+            yield self
+        finally:
+            mp._qpos.reset(qtok)
+            mp._observer.reset(token)
+
+    def run(self, model, batches):
+        """Observe eager forwards of ``model`` over ``batches`` (each a
+        Tensor or tuple of Tensors). The model's own policy scope is
+        entered by its ``__call__``; op positions reset per forward, so
+        every batch lands on the same tags."""
+        was_training = getattr(model, "_train", False)
+        model.eval()
+        try:
+            for b in batches:
+                args = b if isinstance(b, (tuple, list)) else (b,)
+                with self.observe():
+                    model(*args)
+                self.batches_seen += 1
+        finally:
+            model.train(was_training)
+        return self
+
+    # -- freezing -----------------------------------------------------------
+    def scales(self, qmax):
+        """tag -> frozen scale for a grid whose largest magnitude is
+        ``qmax``; an op that only ever saw zeros gets scale 1."""
+        return {tag: (a / float(qmax) if a > 0 else 1.0)
+                for tag, a in sorted(self.amax.items())}
+
+    def freeze(self, policy):
+        """Return ``policy`` with this calibration's scales frozen in
+        (:meth:`QuantPolicy.with_scales`), publishing the observed
+        ranges as registry gauges. Raises if nothing was observed — a
+        zero-batch calibration silently freezing nothing is exactly the
+        bug this loud path prevents."""
+        if not self.amax:
+            raise ValueError(
+                "no activations observed: run(model, batches) (or an "
+                "observe() scope around forwards) before freeze()")
+        pol = mp.resolve(policy)
+        kind = getattr(pol, "compute_quant", None) or "e4m3"
+        qmax = core.INT8_QMAX if kind == "int8" else core.FP8_MAX[kind]
+        scales = self.scales(qmax)
+        from ..observability import metrics as _metrics
+        reg = self._registry if self._registry is not None \
+            else _metrics.default_registry()
+        g_amax = reg.gauge(
+            "quant_amax", "calibration-observed max |activation| per "
+            "op position", labels=("tensor",))
+        g_scale = reg.gauge(
+            "quant_scale", "frozen quantization scale per op position",
+            labels=("tensor",))
+        for tag, a in self.amax.items():
+            g_amax.set(a, tensor=tag)
+            g_scale.set(scales[tag], tensor=tag)
+        reg.gauge("quant_calibration_batches",
+                  "batches observed by the newest calibration run"
+                  ).set(self.batches_seen)
+        return pol.with_scales(scales)
+
+
+def calibrate(model, batches, policy="fp8_mixed", registry=None):
+    """One-call form: observe ``batches`` and return the scale-frozen
+    policy (see :class:`Calibrator`)."""
+    return Calibrator(registry=registry).run(model, batches).freeze(
+        mp.resolve(policy))
+
+
+__all__ = ["Calibrator", "calibrate"]

@@ -1,0 +1,356 @@
+"""int8/fp8 quantization primitives: the numerics layer of
+``singa_tpu.quant``.
+
+Everything here is a pure function over arrays, jit-safe by
+construction, so the SAME code quantizes concretely (checkpoint
+conversion, ``quantize_params``) and symbolically (in-graph dequant /
+fake-quant inside the one compiled step — the ``n_traces == 1`` pin
+survives because quantization adds ops, never shapes).
+
+Two numeric families:
+
+- **int8, symmetric, per-channel** — the weight-only inference format.
+  ``quantize_int8`` maps a float tensor to an int8 payload plus an fp32
+  scale sidecar with ``scale = amax / 127`` per channel; the scale keeps
+  the payload's rank (size-1 on non-channel dims), so dequantization is
+  a bare broadcast multiply with no axis metadata to carry around —
+  checkpoints, the serving adapter and the ring KV cache all ride this
+  one convention.
+- **fp8 (e4m3 / e5m2 via ml_dtypes)** — the compute/grad emulation
+  format. ``fake_cast`` rounds a tensor through the fp8 grid and back
+  (weights/activations take e4m3's 3 mantissa bits, gradients e5m2's
+  wide exponent), optionally pre-scaled by a calibrated per-tensor
+  scale so the representable window sits on the observed amax.
+
+Fake-quant (``fake_quant_int8`` / ``fake_quant_fp8``) is the QAT form:
+forward sees quantized numerics, backward sees identity (the
+straight-through estimator, expressed as ``x + stop_gradient(q(x)-x)``
+so it is correct under BOTH the tape autograd and ``jax.grad``).
+
+``quantize_params`` is the model-level pass: fp32 masters become int8
+payloads in place (4x less parameter memory), scales join the model's
+threaded state, and every forward — eager, compiled eval, the batch
+serving engine — dequantizes IN GRAPH at the top of the traced body
+(``dequant_params_scope``), where XLA fuses the convert+multiply into
+the consuming matmul/conv.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+INT8_QMAX = 127.0
+# largest finite magnitude of each fp8 grid (ml_dtypes finfo)
+FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+FP8_DTYPES = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
+
+# the smallest shapes worth quantizing: 1-D leaves (biases, norm
+# scales, BN stats) stay fp32 — they are a rounding error of the byte
+# budget and the most numerically fragile
+MIN_QUANT_DIM = 2
+MIN_QUANT_SIZE = 16
+
+# checkpoint key prefix for scale sidecars written beside an fp32
+# model's payloads (a LIVE quantized model's scales instead ride
+# get_states under model-local names — see quantize_params)
+SCALE_PREFIX = "quant-scale/"
+
+
+def channel_axis(shape):
+    """The per-channel axis for a weight of ``shape``: the output
+    features of a 2-D matmul weight (last dim — both the layer.Linear
+    ``(in, out)`` and the decode-adapter block weights use that
+    layout), the leading (output-channel) dim for conv-style >2-D
+    weights, None (per-tensor) for anything 1-D."""
+    n = len(shape)
+    if n < 2:
+        return None
+    return n - 1 if n == 2 else 0
+
+
+def _amax(x, axis):
+    """Per-channel absolute max, rank preserved (size-1 elsewhere)."""
+    if axis is None:
+        axes = tuple(range(x.ndim))
+    else:
+        axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    return jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+
+
+def quantize_int8(arr, axis=None, scale=None):
+    """Symmetric int8 quantization: ``(payload int8, scale fp32)`` with
+    ``scale = amax / 127`` per channel (``axis``; None = per-tensor).
+    The scale keeps the payload's rank so ``payload * scale``
+    broadcasts without metadata. All-zero channels get scale 1 (their
+    payload is zero either way — never a divide-by-zero). A frozen
+    (calibrated) ``scale`` overrides the amax derivation."""
+    f = jnp.asarray(arr).astype(jnp.float32)
+    if scale is None:
+        amax = _amax(f, axis)
+        scale = jnp.where(amax > 0, amax / INT8_QMAX,
+                          jnp.ones_like(amax))
+    else:
+        scale = jnp.asarray(scale, jnp.float32)
+    q = jnp.clip(jnp.round(f / scale), -INT8_QMAX, INT8_QMAX) \
+        .astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_int8` (up to the quantization error:
+    at most ``scale/2`` per element). In a traced body this is the
+    in-graph dequant XLA fuses into the consuming matmul/conv."""
+    return (q.astype(jnp.float32) * jnp.asarray(scale).astype(
+        jnp.float32)).astype(dtype)
+
+
+def quantize_int8_rows(x, axes):
+    """Symmetric int8 with the amax reduced over ``axes`` (a tuple) and
+    one scale per REMAINING index, ``axes`` squeezed out of the scale —
+    the per-row form the serving KV cache uses (one scale per written
+    token row, reduced over heads × head_dim). Same numerics contract
+    as :func:`quantize_int8`: ``scale = amax / 127``, all-zero rows get
+    scale 1, payload clipped to ±127."""
+    axes = tuple(axes)
+    f = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, jnp.ones_like(amax))
+    q = jnp.clip(jnp.round(f / scale), -INT8_QMAX, INT8_QMAX) \
+        .astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=axes).astype(jnp.float32)
+
+
+def quantize_fp8(arr, kind="e4m3", scale=None):
+    """Per-tensor scaled fp8 cast: ``(payload fp8, scale fp32)``. With
+    ``scale=None`` the scale is derived from the tensor's own amax so
+    the fp8 window covers it exactly (dynamic quantization); a
+    calibration-frozen scale makes the cast batch-independent."""
+    if kind not in FP8_DTYPES:
+        raise ValueError(f"unknown fp8 kind {kind!r}; expected one of "
+                         f"{sorted(FP8_DTYPES)}")
+    f = jnp.asarray(arr).astype(jnp.float32)
+    if scale is None:
+        amax = jnp.max(jnp.abs(f))
+        scale = jnp.where(amax > 0, amax / FP8_MAX[kind],
+                          jnp.ones_like(amax))
+    scale = jnp.asarray(scale, jnp.float32)
+    # SATURATING cast: e4m3fn has no inf, so an unclipped overflow
+    # (a value outside a calibration-frozen window) would land as NaN
+    # and poison the whole step — clamp to the grid's edge instead,
+    # like every hardware fp8 cast does. No-op for the dynamic scale.
+    m = FP8_MAX[kind]
+    return (jnp.clip(f / scale, -m, m).astype(FP8_DTYPES[kind]),
+            scale)
+
+
+def dequantize_fp8(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * jnp.asarray(scale).astype(
+        jnp.float32)).astype(dtype)
+
+
+def fake_cast(x, kind="e4m3", scale=None):
+    """Round ``x`` through the fp8 grid and back to its own dtype —
+    fp8 numerics without fp8 storage (the emulation form every fp8
+    training recipe bootstraps from). No gradient trickery: callers on
+    a backward path get the rounded values (e5m2 gradient emulation),
+    callers needing STE use :func:`fake_quant_fp8`."""
+    q, s = quantize_fp8(x, kind, scale)
+    return dequantize_fp8(q, s, x.dtype)
+
+
+def _ste(x, quantized):
+    """Straight-through estimator: forward = quantized, backward =
+    identity. ``stop_gradient`` makes it exact under jax.grad; the tape
+    autograd never differentiates through op-internal casts, so the
+    form is correct under both engines."""
+    return x + lax.stop_gradient(quantized - x)
+
+
+def fake_quant_int8(x, axis=None, scale=None):
+    """QAT int8 fake-quant with STE (per-channel when ``axis``; a
+    calibrated ``scale`` freezes the grid)."""
+    q, s = quantize_int8(x, axis, scale)
+    return _ste(x, dequantize_int8(q, s, x.dtype))
+
+
+def fake_quant_fp8(x, kind="e4m3", scale=None):
+    """QAT fp8 fake-quant with STE (per-tensor; calibrated ``scale``
+    freezes the window)."""
+    return _ste(x, fake_cast(x, kind, scale))
+
+
+# ---------------------------------------------------------------------------
+# model / state-dict passes
+# ---------------------------------------------------------------------------
+
+def eligible(tensor_or_arr, require_grad=True):
+    """Whether one state entry is a weight-only-quantization candidate:
+    a trainable (when the entry knows) floating tensor of >= 2 dims and
+    non-trivial size. Biases, norm scales and BN running stats stay
+    fp32 by this rule — they are tiny and fragile."""
+    rg = getattr(tensor_or_arr, "requires_grad", None)
+    if require_grad and rg is False:
+        return False
+    dt = getattr(tensor_or_arr, "dtype", None)
+    if dt is None or not jnp.issubdtype(jnp.dtype(dt), jnp.floating):
+        return False
+    shape = tuple(getattr(tensor_or_arr, "shape", ()))
+    return len(shape) >= MIN_QUANT_DIM and \
+        int(np.prod(shape)) >= MIN_QUANT_SIZE
+
+
+def quantize_state_arrays(arrays, prefix="model/", live=None):
+    """Quantize a flat checkpoint state dict: every eligible ``prefix``
+    entry becomes an int8 payload at its own key plus an fp32 scale at
+    ``quant-scale/<key>``; everything else passes through untouched.
+    ``live`` (optional name -> Tensor of the same keys) contributes
+    requires_grad knowledge — without it any >=2-D float under the
+    prefix is quantized (the offline-tool case, where BN running stats
+    are 1-D and therefore already excluded).
+
+    This is the ~4x-smaller on-disk form: restore detects the scale
+    sidecar key and dequantizes into fp32 masters
+    (``checkpoint._apply_restored`` / ``Model.load_states``)."""
+    out = {}
+    for k, a in arrays.items():
+        cand = a if live is None or k not in live else live[k]
+        if k.startswith(prefix) and SCALE_PREFIX not in k and \
+                not jnp.issubdtype(jnp.dtype(getattr(a, "dtype", "O")),
+                                   jnp.integer) and \
+                eligible(cand, require_grad=live is not None):
+            q, s = quantize_int8(np.asarray(a),
+                                 channel_axis(np.shape(a)))
+            out[k] = np.asarray(q)
+            out[SCALE_PREFIX + k] = np.asarray(s)
+        else:
+            out[k] = a
+    return out
+
+
+def dequantize_entry(payload, scale, dtype=np.float32):
+    """The ONE host-side payload × scale fold every checkpoint-restore
+    site shares (``dequantize_state_arrays``, ``checkpoint
+    ._apply_restored``, ``Model.load_states``) — a format change (int4,
+    NF4, ...) lands here once."""
+    return (np.asarray(payload, np.float32)
+            * np.asarray(scale, np.float32)).astype(dtype)
+
+
+def dequantize_state_arrays(arrays, dtype=np.float32):
+    """Inverse of :func:`quantize_state_arrays`: fold every
+    ``quant-scale/`` sidecar back into its payload and drop the scale
+    keys. Non-quantized entries pass through untouched."""
+    scales = {k[len(SCALE_PREFIX):]: a for k, a in arrays.items()
+              if k.startswith(SCALE_PREFIX)}
+    out = {}
+    for k, a in arrays.items():
+        if k.startswith(SCALE_PREFIX):
+            continue
+        if k in scales:
+            a = dequantize_entry(a, scales[k], dtype)
+        out[k] = a
+    return out
+
+
+def quantize_params(model, policy="int8_weight_only"):
+    """Weight-only int8 pass over a live model: every eligible fp32
+    master becomes an int8 payload IN PLACE (4x less parameter memory,
+    and every checkpoint route — save_states, CheckpointManager,
+    digests — now persists the int8 bytes), with its per-channel scale
+    joining the model's threaded state as ``quant-scale/<name>``.
+
+    The model becomes an inference model: quantized params stop
+    requiring grads, and every forward — eager, the compiled eval step,
+    ``BatchServingEngine`` — dequantizes in graph at the top of the
+    traced body (:func:`dequant_params_scope`, entered by
+    ``Model._policy_scope``), so the one-jitted-program contract and
+    the ``n_traces == 1`` pin survive untouched.
+
+    Returns a per-param report ``{name: {"bytes_fp": .., "bytes_q": ..}}``.
+    """
+    from .. import mixed_precision as mp
+    from ..tensor import Tensor
+    if getattr(model, "_quant_pairs", None):
+        raise RuntimeError(
+            "model is already weight-quantized (quantize_params is a "
+            "one-way inference pass; reload fp32 masters to redo it)")
+    pol = mp.resolve(policy)
+    pairs, scales, report = [], {}, {}
+    for name, t in model.get_states().items():
+        if not eligible(t):
+            continue
+        q, s = quantize_int8(t.data, channel_axis(t.shape))
+        report[name] = {
+            "bytes_fp": int(np.prod(t.shape)) *
+            jnp.dtype(t.dtype).itemsize,
+            "bytes_q": int(np.prod(t.shape)) + int(np.prod(s.shape)) * 4,
+        }
+        t.data = q
+        t.requires_grad = False
+        t.stores_grad = False
+        st = Tensor(data=s, device=t.device, requires_grad=False)
+        st.name = SCALE_PREFIX + name
+        scales[SCALE_PREFIX + name] = st
+        pairs.append((name, t, st))
+    model._quant_pairs = pairs
+    model._quant_scales = scales
+    model._policy = pol
+    # compiled steps/evals close over the old fp32 state identities
+    model._invalidate_compiled()
+    return report
+
+
+@contextlib.contextmanager
+def dequant_params_scope(model):
+    """Rebind every weight-quantized param to its dequantized (fp32)
+    value for the duration of a forward/step body, restoring the int8
+    payload binding on exit. Entered INSIDE traced bodies
+    (``Model._policy_scope``), so the dequant is part of the one
+    compiled program — XLA fuses the convert+multiply into each
+    weight's consumer — while the threaded/donated state stays int8.
+    No-op for unquantized models.
+
+    Rebinding mutates shared ``Tensor.data``, so the scope is guarded:
+    a per-model RLock serializes concurrent eager forwards (a second
+    thread waits, it never double-dequantizes), and a depth counter
+    makes nested entries (adapter build inside an engine scope, the
+    batch engine's jitted body under ``_policy_scope``) no-ops past
+    the first — only the outermost exit restores the int8 binding."""
+    pairs = getattr(model, "_quant_pairs", None)
+    if not pairs:
+        yield
+        return
+    lock = getattr(model, "_quant_scope_lock", None)
+    if lock is None:
+        import threading
+        lock = model._quant_scope_lock = threading.RLock()
+    with lock:
+        depth = getattr(model, "_quant_scope_depth", 0)
+        model._quant_scope_depth = depth + 1
+        saved = None
+        try:
+            if depth == 0:
+                saved = [(t, t.data) for _name, t, _s in pairs]
+                for _name, t, st in pairs:
+                    t.data = dequantize_int8(t.data, st.data)
+            yield
+        finally:
+            model._quant_scope_depth = depth
+            if saved is not None:
+                for t, d in saved:
+                    t.data = d
+
+
+__all__ = [
+    "INT8_QMAX", "FP8_MAX", "FP8_DTYPES", "SCALE_PREFIX",
+    "MIN_QUANT_DIM", "MIN_QUANT_SIZE", "channel_axis",
+    "quantize_int8", "quantize_int8_rows", "dequantize_int8",
+    "dequantize_entry", "quantize_fp8",
+    "dequantize_fp8", "fake_cast", "fake_quant_int8", "fake_quant_fp8",
+    "eligible", "quantize_state_arrays", "dequantize_state_arrays",
+    "quantize_params", "dequant_params_scope",
+]

@@ -283,6 +283,13 @@ class GuardedOptimizer:
         norm_sq = jnp.zeros((), jnp.float32)
         pairs = []
         wire = DistOpt._policy_wire() if dist is not None else None
+        # fp8 training (QuantPolicy "fp8_mixed"): gradients are rounded
+        # through the e5m2 grid after unscaling — the loss scale is the
+        # underflow shield that makes the narrow fp8 mantissa safe, so
+        # the quantized-grad path rides THIS driver by design
+        from .. import mixed_precision as _mp
+        _pol = _mp.active_policy()
+        grad_q = getattr(_pol, "grad_quant", None)
         for p, g in autograd.backward(loss, dy=dy):
             arr = g.data
             excl = dist._shard_axes(p) if dist is not None else ()
@@ -294,6 +301,12 @@ class GuardedOptimizer:
                 arr = dist.all_reduce_wire(arr, exclude=excl, wire=wire)
                 arr = arr / dist.communicator.effective_world_size()
             arr = arr.astype(jnp.float32) * inv
+            if grad_q is not None:
+                from ..quant.core import fake_cast
+                # e5m2 grad emulation, post-unscale: the norm below and
+                # the applied update both see the quantized values, so
+                # the badness verdict judges what actually lands
+                arr = fake_cast(arr, grad_q)
             contrib = jnp.sum(arr * arr)
             if excl:
                 # a shard-excluded param (expert/tensor-parallel) holds a

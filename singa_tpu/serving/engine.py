@@ -550,10 +550,12 @@ class BatchServingEngine(_EngineBase):
             data=np.zeros((self.batch,) + self.input_shape,
                           self.input_dtype),
             device=dev, requires_grad=False)
+        from ..quant.core import dequant_params_scope
         prev = CTX.training
         CTX.training = False
         try:
-            with mp.policy_scope(self.policy):
+            with mp.policy_scope(self.policy), \
+                    dequant_params_scope(model):
                 model.forward(x0)
         finally:
             CTX.training = prev
@@ -570,7 +572,11 @@ class BatchServingEngine(_EngineBase):
             prev = CTX.training
             CTX.training = False
             try:
-                with mp.policy_scope(self.policy):
+                # a weight-quantized model (quant.quantize_params)
+                # dequantizes IN GRAPH here too: the scope rebinds int8
+                # payloads to payload x scale for the traced body only
+                with mp.policy_scope(self.policy), \
+                        dequant_params_scope(model):
                     out = model.forward(Tensor(data=x, device=dev,
                                                requires_grad=False))
             finally:
@@ -646,17 +652,52 @@ class BatchServingEngine(_EngineBase):
         self._occupancy.set(0)
 
 
+def _check_quant_policy(policy, target, *, weights_ok, cache_ok, hint):
+    """A quantized policy the target cannot honor must FAIL at build —
+    serving full fp32 while the caller believes they deployed int8 is
+    the silent no-op this guard exists to prevent. ``hint`` names the
+    working route for THIS target."""
+    wq = getattr(policy, "weight_quant", None)
+    cq = getattr(policy, "cache_quant", None)
+    if wq is not None and not weights_ok:
+        raise ValueError(
+            f"policy {policy.name!r} requests {wq} weight quantization "
+            f"but {target} cannot honor it; it would serve full-"
+            f"precision weights silently. {hint}")
+    if cq is not None and not cache_ok:
+        raise ValueError(
+            f"policy {policy.name!r} requests an {cq} KV cache but "
+            f"{target} has no ring cache to quantize")
+
+
 def build_engine(model, **kw):
     """The ``Model.compile_serving`` backend: autoregressive models
     (anything exposing ``decode_adapter``) get a :class:`ServingEngine`
     over their ring-cache adapter; everything else — the classifier
     zoo, ONNX imports — serves statelessly through a
-    :class:`BatchServingEngine` (pass ``input_shape=``)."""
+    :class:`BatchServingEngine` (pass ``input_shape=``).
+
+    Quantized policies are honored-or-refused: an adapter that does not
+    declare ``supports_weight_quant`` / ``supports_cache_quant`` (the
+    transformer adapter does, the char-rnn's (h,c) slot state cannot)
+    rejects them typed at build, and a stateless engine accepts a
+    weight-quant policy only over an already ``quantize_params``'d
+    model (the cache axis is inert there — it has no KV cache)."""
     if hasattr(model, "decode_adapter"):
         adapter_kw = {}
         if "policy" in kw:
             adapter_kw["policy"] = kw.get("policy")
         adapter = model.decode_adapter(**adapter_kw)
+        if kw.get("policy") is not None:
+            _check_quant_policy(
+                kw["policy"], f"{type(model).__name__}'s decode adapter",
+                weights_ok=getattr(adapter, "supports_weight_quant",
+                                   False),
+                cache_ok=getattr(adapter, "supports_cache_quant",
+                                 False),
+                hint="Serve under a non-quantized policy (an in-place-"
+                "quantized model's weights are dequantized at engine "
+                "build either way)")
         ar_keys = ("slots", "max_len", "prefill_len", "prefill_batch",
                    "policy", "queue_capacity", "faults", "registry",
                    "telemetry_dir", "max_retries")
@@ -679,6 +720,13 @@ def build_engine(model, **kw):
         raise TypeError(
             f"unknown serving option(s) {unknown} for stateless "
             f"{type(model).__name__} (accepted: {sorted(bt_keys)})")
+    if kw.get("policy") is not None:
+        _check_quant_policy(
+            kw["policy"], f"stateless {type(model).__name__} serving",
+            weights_ok=bool(getattr(model, "_quant_pairs", None)),
+            cache_ok=True,   # inert: a batch engine has no KV cache
+            hint="Run quant.quantize_params(model) first, or use a "
+            "non-quantized policy")
     return BatchServingEngine(model, **kw)
 
 

@@ -35,9 +35,44 @@ from jax import lax
 
 def init_cache(n_slots, n_heads, length, head_dim, dtype=jnp.float32):
     """One layer's ring cache: zeroed ``{"k","v"}`` of shape
-    ``(n_slots, n_heads, length, head_dim)``."""
+    ``(n_slots, n_heads, length, head_dim)``.
+
+    ``dtype=int8`` builds the QUANTIZED ring (the
+    ``singa_tpu.quant`` serving presets): int8 payloads plus one fp32
+    scale per (slot, ring index) — ``{"k_scale","v_scale"}`` of shape
+    ``(n_slots, length)`` — written alongside every token/prompt row
+    and folded back in inside :func:`attend`'s f32 softmax. 4x less
+    cache HBM per token; scales init to 1 (a zero payload dequantizes
+    to zero either way)."""
     shape = (int(n_slots), int(n_heads), int(length), int(head_dim))
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    level = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        # two DISTINCT buffers: the engine donates the whole cache
+        # pytree, and donating one shared array twice is an XLA error
+        level["k_scale"] = jnp.ones((int(n_slots), int(length)),
+                                    jnp.float32)
+        level["v_scale"] = jnp.ones((int(n_slots), int(length)),
+                                    jnp.float32)
+    return level
+
+
+def _quant_rows(x, axes):
+    """Per-row cache quantization (one scale per written token row) —
+    the ONE symmetric-int8 convention, shared with weight quantization
+    so the two can never silently diverge."""
+    from ..quant.core import quantize_int8_rows
+    return quantize_int8_rows(x, axes)
+
+
+def _dequant_level(level):
+    """f32 views of a level's k/v — identity for float caches, payload
+    × per-row scale for the quantized ring."""
+    k, v = level["k"], level["v"]
+    if "k_scale" in level:
+        # (W, H, L, D) payload, (W, L) scale -> broadcast over H and D
+        k = k.astype(jnp.float32) * level["k_scale"][:, None, :, None]
+        v = v.astype(jnp.float32) * level["v_scale"][:, None, :, None]
+    return k, v
 
 
 def ring_positions(pos, length):
@@ -63,17 +98,29 @@ def write_token(level, k_new, v_new, pos):
     token's position. Returns the updated level. Every slot is written
     (the engine masks dead slots by never attending to them; a freed
     slot's rows are fully overwritten by its next prefill before any
-    mask can reach them)."""
+    mask can reach them). A quantized level additionally writes each
+    row's fp32 scale into its per-slot scale row."""
     L = level["k"].shape[2]
+    pos = pos.astype(jnp.int32)
 
     def upd(c, row, p):
         return lax.dynamic_update_slice(
             c, row[:, None, :].astype(c.dtype), (0, p % L, 0))
 
-    return {"k": jax.vmap(upd)(level["k"], k_new,
-                               pos.astype(jnp.int32)),
-            "v": jax.vmap(upd)(level["v"], v_new,
-                               pos.astype(jnp.int32))}
+    if "k_scale" not in level:
+        return {"k": jax.vmap(upd)(level["k"], k_new, pos),
+                "v": jax.vmap(upd)(level["v"], v_new, pos)}
+    # quantized ring: one scale per (slot, ring index), amax over (H,D)
+    kq, ks = _quant_rows(k_new, (1, 2))           # (W,H,D) -> (W,)
+    vq, vs = _quant_rows(v_new, (1, 2))
+
+    def upd_s(srow, sval, p):
+        return lax.dynamic_update_slice(srow, sval[None], (p % L,))
+
+    return {"k": jax.vmap(upd)(level["k"], kq, pos),
+            "v": jax.vmap(upd)(level["v"], vq, pos),
+            "k_scale": jax.vmap(upd_s)(level["k_scale"], ks, pos),
+            "v_scale": jax.vmap(upd_s)(level["v_scale"], vs, pos)}
 
 
 def write_prompt(level, slot, k_rows, v_rows, valid):
@@ -83,15 +130,29 @@ def write_prompt(level, slot, k_rows, v_rows, valid):
     ``prefill_len <= max_len`` contract); ``slot`` scalar int;
     ``valid`` scalar bool — False rows (prefill-batch padding) leave
     the cache untouched, which is what lets the prefill program keep a
-    FIXED batch width over a variable number of admitted requests."""
+    FIXED batch width over a variable number of admitted requests. A
+    quantized level quantizes per token row (scale amax over heads ×
+    head_dim) and writes the prompt's scale rows alongside."""
+    if "k_scale" in level:
+        # (H, S, D): one scale per prompt position -> (S,)
+        k_rows, ks = _quant_rows(k_rows, (0, 2))
+        v_rows, vs = _quant_rows(v_rows, (0, 2))
     k_up = lax.dynamic_update_slice(
         level["k"], k_rows[None].astype(level["k"].dtype),
         (slot, 0, 0, 0))
     v_up = lax.dynamic_update_slice(
         level["v"], v_rows[None].astype(level["v"].dtype),
         (slot, 0, 0, 0))
-    return {"k": jnp.where(valid, k_up, level["k"]),
-            "v": jnp.where(valid, v_up, level["v"])}
+    out = {"k": jnp.where(valid, k_up, level["k"]),
+           "v": jnp.where(valid, v_up, level["v"])}
+    if "k_scale" in level:
+        ks_up = lax.dynamic_update_slice(level["k_scale"], ks[None],
+                                         (slot, 0))
+        vs_up = lax.dynamic_update_slice(level["v_scale"], vs[None],
+                                         (slot, 0))
+        out["k_scale"] = jnp.where(valid, ks_up, level["k_scale"])
+        out["v_scale"] = jnp.where(valid, vs_up, level["v_scale"])
+    return out
 
 
 def attend(q, level, pos, scale):
@@ -99,17 +160,18 @@ def attend(q, level, pos, scale):
 
     ``q``: ``(W, H, 1, D)`` (the new token's query, already written to
     the ring along with its k/v); ``pos``: ``(W,)`` — the new token's
-    position. Softmax in f32 regardless of cache dtype (bf16 serving
-    keeps its numerics sane), result cast back to ``q.dtype``.
-    Returns ``(W, H, 1, D)``."""
+    position. Softmax in f32 regardless of cache dtype (bf16 AND int8
+    serving keep their numerics sane — a quantized ring dequantizes
+    its rows here, payload × per-row scale, before the f32 scores),
+    result cast back to ``q.dtype``. Returns ``(W, H, 1, D)``."""
     L = level["k"].shape[2]
+    kf, vf = _dequant_level(level)
     s = jnp.einsum("whqd,whld->whql", q.astype(jnp.float32),
-                   level["k"].astype(jnp.float32)) * scale
+                   kf.astype(jnp.float32)) * scale
     mask = ring_mask(pos, L)[:, None, None, :]
     s = jnp.where(mask, s, -jnp.inf)
     a = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("whql,whld->whqd", a,
-                     level["v"].astype(jnp.float32))
+    out = jnp.einsum("whql,whld->whqd", a, vf.astype(jnp.float32))
     return out.astype(q.dtype)
 
 

@@ -197,12 +197,17 @@ def _binfile_read(path):
 def _encode_array(arr: np.ndarray) -> bytes:
     """dtype-str-len u8 | dtype str | ndim u8 | dims u32* | raw bytes
 
-    Extended dtypes (bfloat16, fp8 — registered by ml_dtypes) have a
-    void ``dtype.str`` ('<V2'), which would round-trip as raw bytes with
-    the real type lost; their registered NAME parses back through
-    ``np.dtype(...)``, so it is stored instead."""
-    dt = (arr.dtype.name if "V" in arr.dtype.str
-          else arr.dtype.str).encode("ascii")
+    Extended dtypes (bfloat16, fp8 — registered by ml_dtypes) need
+    their registered NAME stored: most have a void ``dtype.str``
+    ('<V2'), which would round-trip as raw bytes with the real type
+    lost, and float8_e5m2's is '<f1', which ``np.dtype`` cannot parse
+    back at all. The robust rule is to store ``dtype.str`` only when it
+    provably reconstructs the same dtype, the name otherwise."""
+    try:
+        str_ok = np.dtype(arr.dtype.str) == arr.dtype
+    except TypeError:
+        str_ok = False
+    dt = (arr.dtype.str if str_ok else arr.dtype.name).encode("ascii")
     out = bytearray()
     out += len(dt).to_bytes(1, "little")
     out += dt

@@ -228,6 +228,24 @@ class TestTelemetryExample:
         assert "train_step_seconds_count" in proc.stdout
 
 
+class TestQuantizeCheckpointTool:
+    """The offline fp32 -> int8 checkpoint converter's CI smoke (like
+    metrics_dump's): save, convert, dequantized restore parity, >=3x
+    shrink, clean scrub, and the corrupt-source digest-mismatch path —
+    all inside the tool's own --selftest."""
+
+    def test_selftest_is_green(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "tools/quantize_checkpoint.py",
+             "--selftest"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "selftest: OK" in proc.stdout, proc.stdout[-300:]
+
+
 class TestServeGatewayExample:
     """The serving gateway smoke: engine + stdlib HTTP gateway + drain,
     end to end in one subprocess (the chaos serve-drain scenario's

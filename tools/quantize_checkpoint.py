@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Convert an fp32 checkpoint to int8 weight-only form, offline.
+
+Reads one step of a :class:`~singa_tpu.checkpoint.CheckpointManager`
+directory, verifies it against its content-digest sidecar (a corrupt
+source must never be laundered into a fresh-looking quantized copy —
+**nonzero exit on digest mismatch**), quantizes every eligible
+``model/`` tensor to an int8 payload plus a ``quant-scale/`` fp32
+sidecar (``singa_tpu.quant.quantize_state_arrays``), and writes the
+result as a NEW digest-verified checkpoint directory — ~4x smaller, so
+restore and scrub time drop proportionally.
+
+Optimizer aux (``optimizer/``, ``aux/``) is STRIPPED by default: a
+quantized checkpoint is an inference artifact, and fp32 momentum would
+dwarf the int8 payloads. ``--keep-optimizer`` keeps it (verbatim).
+
+``CheckpointManager.restore_latest`` / ``AsyncModelCheckpointer
+.restore`` on the output dequantize payload × scale back into the
+model's floating masters automatically (``checkpoint._apply_restored``),
+and ``tools/scrub_checkpoints.py`` verifies it like any other
+checkpoint.
+
+Exit codes: 0 converted (or selftest passed), 1 usage/conversion
+failure, 2 source failed digest verification.
+
+Usage::
+
+    python tools/quantize_checkpoint.py SRC_DIR DST_DIR [--step N]
+        [--keep-optimizer] [--json]
+    python tools/quantize_checkpoint.py --selftest
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# conversion is host-side IO + rounding; never grab an accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+EXIT_DIGEST_MISMATCH = 2
+
+
+def _dir_bytes(path):
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def convert(src, dst, step=None, keep_optimizer=False):
+    """Convert ``src``'s ``step`` (default: latest) into ``dst``.
+    Returns a summary dict. Raises ``IntegrityError`` on a source
+    digest mismatch, ``ValueError`` when there is nothing to convert."""
+    import jax
+    import numpy as np
+    from singa_tpu.checkpoint import CheckpointManager
+    from singa_tpu.integrity import digest_tree
+    from singa_tpu.quant import core as qcore
+
+    src_mgr = CheckpointManager(src, sweep=False)   # read-only open
+    try:
+        steps = sorted(src_mgr.all_steps())
+        if not steps:
+            raise ValueError(f"no checkpoint steps in {src!r}")
+        step = int(step) if step is not None else steps[-1]
+        if step not in steps:
+            raise ValueError(f"step {step} not in {src!r} "
+                             f"(has {steps})")
+        meta = src_mgr._mgr.item_metadata(step)
+        tree = dict(getattr(meta, "tree", None) or meta)
+        template = {k: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
+                    for k, m in tree.items()}
+        restored = src_mgr._mgr.restore(
+            step, args=src_mgr._ocp.args.StandardRestore(template))
+        # the load-bearing gate: IntegrityError (exit 2) on mismatch —
+        # corrupt fp32 bytes must fail HERE, not round silently into a
+        # fresh-looking int8 copy that passes every later scrub
+        src_mgr._verify_restored(step, restored)
+    finally:
+        src_mgr.close()
+
+    arrays = dict(restored)
+    if not keep_optimizer:
+        arrays = {k: v for k, v in arrays.items()
+                  if not k.startswith(("optimizer/", "aux/"))}
+    q = qcore.quantize_state_arrays(arrays, prefix="model/")
+    n_q = sum(1 for k in q if k.startswith(qcore.SCALE_PREFIX))
+    if n_q == 0:
+        raise ValueError(
+            f"nothing to quantize in step {step} of {src!r} (already "
+            "quantized, or no eligible >=2-D float model/ tensors)")
+    q = {k: np.asarray(v) for k, v in q.items()}
+
+    dst_mgr = CheckpointManager(dst)
+    try:
+        dst_mgr._mgr.save(step,
+                          args=dst_mgr._ocp.args.StandardSave(q),
+                          force=True)
+        dst_mgr._mgr.wait_until_finished()
+        # synchronous digest sidecar (no training step to overlap)
+        dst_mgr._write_digests(step, digest_tree(q))
+    finally:
+        dst_mgr.close()
+
+    src_b = _dir_bytes(os.path.join(src, str(step)))
+    dst_b = _dir_bytes(os.path.join(dst, str(step)))
+    return {
+        "step": step,
+        "quantized_tensors": n_q,
+        "entries": len(q),
+        "kept_optimizer": bool(keep_optimizer),
+        "src_bytes": src_b,
+        "dst_bytes": dst_b,
+        "ratio": round(src_b / dst_b, 2) if dst_b else None,
+    }
+
+
+def selftest():
+    """End-to-end smoke (run in tier-1 via tests/test_examples.py):
+    save an fp32 model, convert, restore into a FRESH fp32 model,
+    verify dequantized parity + >=3x shrink + a clean scrub, and pin
+    the digest-mismatch exit path."""
+    import tempfile
+
+    import numpy as np
+    from singa_tpu import device, tensor
+    from singa_tpu.checkpoint import CheckpointManager
+    from singa_tpu.integrity import IntegrityError
+    from singa_tpu.models.mlp import MLP
+
+    dev = device.get_default_device()
+
+    def mlp():
+        # big enough that tensor bytes dominate orbax's per-step
+        # metadata overhead — the >=3x assertion measures the payload
+        # shrink, not bookkeeping noise
+        m = MLP(data_size=128, perceptron_size=256, num_classes=16)
+        x = tensor.Tensor(data=np.random.RandomState(0)
+                          .randn(4, 128).astype(np.float32),
+                          device=dev, requires_grad=False)
+        m.forward(x)
+        return m
+
+    with tempfile.TemporaryDirectory() as td:
+        src, dst = os.path.join(td, "fp32"), os.path.join(td, "int8")
+        m = mlp()
+        mgr = CheckpointManager(src)
+        assert mgr.save(0, m, force=True)
+        mgr.wait()
+        mgr.close()
+
+        rep = convert(src, dst)
+        assert rep["quantized_tensors"] >= 2, rep
+        assert rep["ratio"] and rep["ratio"] >= 3.0, \
+            f"expected >=3x smaller, got {rep}"
+
+        # restore into a FRESH fp32 model: payload x scale lands in the
+        # floating masters within the int8 grid's error bound
+        m2 = mlp()
+        out = CheckpointManager(dst, sweep=False)
+        assert out.restore_latest(m2) == 1
+        out.close()
+        for name, t in m.get_states().items():
+            a = np.asarray(t.data)
+            b = np.asarray(m2.get_states()[name].data)
+            assert b.dtype == a.dtype, (name, b.dtype)
+            tol = np.abs(a).max() / 127.0 + 1e-6
+            assert np.abs(a - b).max() <= tol, \
+                (name, float(np.abs(a - b).max()), float(tol))
+
+        # the quantized output scrubs clean like any other checkpoint
+        out = CheckpointManager(dst, sweep=False)
+        assert set(out.scrub().values()) == {"ok"}, out.scrub()
+        out.close()
+
+        # corrupt source bytes -> IntegrityError (the exit-2 path)
+        import glob
+        # the LARGEST file is tensor payload (metadata is small JSON):
+        # flipping a payload byte must surface as a digest mismatch,
+        # not an unreadable-metadata parse error
+        victim = max(
+            (f for f in glob.glob(os.path.join(src, "0", "**", "*"),
+                                  recursive=True) if os.path.isfile(f)),
+            key=os.path.getsize)
+        with open(victim, "r+b") as f:
+            f.seek(256)
+            byte = f.read(1)
+            f.seek(256)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        try:
+            convert(src, os.path.join(td, "int8-2"))
+        except IntegrityError:
+            pass
+        else:
+            raise AssertionError(
+                "corrupt source converted without a digest failure")
+    print("quantize_checkpoint selftest: OK")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("src", nargs="?", help="source CheckpointManager "
+                    "directory (fp32)")
+    ap.add_argument("dst", nargs="?", help="output directory for the "
+                    "quantized checkpoint")
+    ap.add_argument("--step", type=int, default=None,
+                    help="step to convert (default: latest)")
+    ap.add_argument("--keep-optimizer", action="store_true",
+                    help="keep optimizer/aux entries (verbatim fp32) "
+                    "instead of stripping them")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON line")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the end-to-end smoke test and exit")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.src or not args.dst:
+        ap.error("SRC and DST are required (or --selftest)")
+
+    from singa_tpu.integrity import IntegrityError
+    try:
+        rep = convert(args.src, args.dst, step=args.step,
+                      keep_optimizer=args.keep_optimizer)
+    except IntegrityError as e:
+        print(f"DIGEST MISMATCH: {e}", file=sys.stderr)
+        return EXIT_DIGEST_MISMATCH
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(f"step {rep['step']}: {rep['quantized_tensors']} tensors "
+              f"quantized, {rep['src_bytes']} -> {rep['dst_bytes']} "
+              f"bytes ({rep['ratio']}x smaller)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
