@@ -315,12 +315,26 @@ def _xla_step_flops(model):
         return None
 
 
+def _peak_hbm(dev):
+    """Peak-HBM high-water (bytes) via the shared observability helper
+    (``observability.perf.hbm_stats`` — the promoted form of the old
+    ad-hoc ``memory_stats()`` read in tools/tpu_probe_extra.py). None
+    off-accelerator. NOTE: the peak is a process-lifetime high-water
+    mark, so within one bench process later legs see earlier legs'
+    peak too — the banked number is each leg's upper bound; the
+    fresh-process HBM children in tpu_probe_extra stay the precise
+    per-model measurement."""
+    from singa_tpu.observability import perf as _obs_perf
+    stats = _obs_perf.hbm_stats(dev.jax_device)
+    return stats.get("peak_bytes_in_use") if stats else None
+
+
 def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
              layout="NCHW", stem=None, extras=None):
     """Returns (images/sec, step_ms); when the caller passes an
-    ``extras`` dict, ``xla_flops_per_step`` is recorded into it (an
-    out-param so the 2-tuple shape external probes consume stays
-    stable)."""
+    ``extras`` dict, ``xla_flops_per_step`` and ``peak_hbm_bytes`` are
+    recorded into it (an out-param so the 2-tuple shape external
+    probes consume stays stable)."""
     step = _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
                               layout=layout, stem=stem)
     loss = None
@@ -332,6 +346,7 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
                      max(1, niters // 4), niters)
     if extras is not None:
         extras["xla_flops_per_step"] = _xla_step_flops(step.model)
+        extras["peak_hbm_bytes"] = _peak_hbm(dev)
     return batch / dt, dt * 1e3
 
 
@@ -433,6 +448,11 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         "timing": "slope-readback",
         "git": _git_rev(),
     }
+    # peak HBM rides every leg's banked record (the layout/fusion A/B
+    # winners carry their memory cost beside their speed; see
+    # _peak_hbm's monotonicity caveat)
+    if fp32_extras.get("peak_hbm_bytes"):
+        res["hbm_peak_bytes"] = fp32_extras["peak_hbm_bytes"]
     _emit_partial(res, "fp32")
     # bf16 variant — POLICY-DRIVEN by default: Model.compile(
     # policy="bf16_mixed") keeps fp32 masters + dynamic loss scaling and
@@ -456,6 +476,9 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                 res["bf16_mfu"] = bt * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak
             res["bf16_mfu_xla"] = _mfu_xla(
                 bf16_extras.get("xla_flops_per_step"), bt, batch, peak)
+            if bf16_extras.get("peak_hbm_bytes"):
+                res["bf16_hbm_peak_bytes"] = \
+                    bf16_extras["peak_hbm_bytes"]
         except TimeoutError as e:
             # the zombie leg thread may still hold the chip: stop here —
             # a later leg timed against it would bank a lie
@@ -484,6 +507,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                 lm_extras.get("xla_flops_per_step"),
                 res["lm_tokens_per_sec"],
                 lm_extras.get("tokens_per_step"), peak)
+            if lm_extras.get("peak_hbm_bytes"):
+                res["lm_hbm_peak_bytes"] = lm_extras["peak_hbm_bytes"]
             # what the LM leg measured: fused-CE-head or full-logits
             # path — without this marker, banked numbers from different
             # modes would read as perf changes between rounds
@@ -514,6 +539,9 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                     lmb_extras.get("xla_flops_per_step"),
                     res["lm_bf16_tokens_per_sec"],
                     lmb_extras.get("tokens_per_step"), peak)
+                if lmb_extras.get("peak_hbm_bytes"):
+                    res["lm_bf16_hbm_peak_bytes"] = \
+                        lmb_extras["peak_hbm_bytes"]
             except TimeoutError as e:
                 res["lm_bf16_error"] = str(e)[:200]
                 res["leg_timeout"] = "lm_bf16"
@@ -637,6 +665,7 @@ def _measure_quant(dev, batch=32, image_size=224, depth=50, niters=20,
     serve = _measure_serving(dev, policy="int8_weight_only")
     out["serving_decode_tok_s"] = serve["decode_tok_s"]
     out["serving_p99_token_s"] = serve["p99_token_s"]
+    out["hbm_peak_bytes"] = _peak_hbm(dev)
     return out
 
 
@@ -721,6 +750,7 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
         "slots": slots, "new_tokens": new_tokens,
         "n_requests": n_requests,
         "policy": str(policy) if policy is not None else None,
+        "hbm_peak_bytes": _peak_hbm(dev),
     }
 
 
@@ -779,6 +809,7 @@ def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
     if extras is not None:
         extras["xla_flops_per_step"] = _xla_step_flops(step.model)
         extras["tokens_per_step"] = batch * seq
+        extras["peak_hbm_bytes"] = _peak_hbm(dev)
     return batch * seq / dt
 
 

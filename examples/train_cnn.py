@@ -19,6 +19,7 @@ Usage: python examples/train_cnn.py [cnn|alexnet|resnet|xceptionnet|mlp]
             sparseTopK|sparseThreshold] [--spars 0.05] [--cpu]
            [--verbosity 0] [--npz path.npz]
            [--resilient] [--ckpt-dir ckpts_cnn] [--save-every 50]
+           [--profile-every 0] [--anomaly-factor F]
 
 ``-p bf16_mixed`` trains under the mixed-precision compile policy
 (``Model.compile(policy="bf16_mixed")``): fp32 master weights (what
@@ -113,6 +114,13 @@ def build_parser():
                     help="checkpoint directory for --resilient")
     ap.add_argument("--save-every", type=int, default=50,
                     help="checkpoint interval (steps) for --resilient")
+    ap.add_argument("--profile-every", type=int, default=0,
+                    help="with --resilient: run every Nth step under a "
+                         "profiler trace and refresh the "
+                         "profile_fusion_* gauges (0 = off)")
+    ap.add_argument("--anomaly-factor", type=float, default=None,
+                    help="with --resilient: arm the step-time anomaly "
+                         "sentinel at this spike factor (e.g. 3.0)")
     return ap
 
 
@@ -321,7 +329,9 @@ def main():
         model.train()
         trainer = ResilientTrainer(model, args.ckpt_dir,
                                    save_interval_steps=args.save_every,
-                                   verbose=(rank == 0))
+                                   verbose=(rank == 0),
+                                   profile_every=args.profile_every,
+                                   anomaly_factor=args.anomaly_factor)
         summary = trainer.run(pipeline,
                               num_steps=args.epochs * n_train)
         if rank == 0:

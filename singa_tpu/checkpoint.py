@@ -316,6 +316,12 @@ class CheckpointManager:
         self._mgr = self._make_mgr()
         if sweep:
             self._sweep_uncommitted()
+        # steps THIS manager owns: present at init (post-sweep) or
+        # saved by us. A step directory that appears outside this set
+        # is a dead predecessor's late-finalized write — the save
+        # retry below keys on membership here, never on orbax
+        # internals (error text, all_steps caching)
+        self._known_steps = {int(s) for s in self._mgr.all_steps()}
 
     def _make_mgr(self):
         ocp = self._ocp
@@ -511,10 +517,35 @@ class CheckpointManager:
         # all_steps()-based sidecar pruning never overlaps a write
         self._join_digest_thread()
         arrays = {k: t.data for k, t in _state_tensor_dict(model).items()}
-        saved = self._mgr.save(int(step),
-                               args=self._ocp.args.StandardSave(arrays),
-                               force=force)
+        try:
+            saved = self._mgr.save(
+                int(step), args=self._ocp.args.StandardSave(arrays),
+                force=force)
+        except ValueError:
+            # a crashed predecessor's zombie async writer can finalize
+            # its step dir (a rename) AFTER _sweep_uncommitted's rmtree
+            # raced past it at init — orbax then refuses our re-save of
+            # the step a restore legitimately re-ran. Detected
+            # STRUCTURALLY (a step dir on disk that this manager never
+            # owned — not orbax's error text, which is unpinned): apply
+            # the sweep's rule lazily and retry once; an unrelated
+            # ValueError recurs on the retry and propagates.
+            path = os.path.join(self._dir, str(int(step)))
+            if not os.path.isdir(path) or \
+                    int(step) in self._known_steps:
+                raise
+            import shutil
+            warnings.warn(
+                f"removing late-appearing uncommitted checkpoint "
+                f"wreckage {path} (a previous writer's async save "
+                "finalized after the init sweep)", stacklevel=2)
+            shutil.rmtree(path, ignore_errors=True)
+            self._reopen()
+            saved = self._mgr.save(
+                int(step), args=self._ocp.args.StandardSave(arrays),
+                force=force)
         if saved:
+            self._known_steps.add(int(step))
             reg = _obs_metrics.default_registry()
             reg.counter("checkpoint_saves_total",
                         "checkpoint saves actually started").inc()

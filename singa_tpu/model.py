@@ -787,6 +787,15 @@ class Model(Layer):
             # program — recompute against the new signature on next use
             rec.pop("step_flops", None)
             rec.pop("cost", None)
+        # compile/retrace attribution: watch the host-side trace
+        # counter across the dispatch — if THIS call traced (first
+        # compile, a shape/dtype retrace, or the verbosity AOT
+        # re-lower below), its wall-clock lands in compile_seconds and
+        # a compile/retrace flight-recorder event names the signature
+        # (and, on a retrace, the argument that changed). Steady-state
+        # steps pay two dict reads.
+        n_traces0 = rec.get("n_traces", 0)
+        t_compile0 = time.perf_counter()
         if self.dev.verbosity >= 2 and "cost" not in rec:
             # one-time XLA cost analysis of this step signature (the
             # compiled-world per-op metric: flops / bytes, reference
@@ -830,6 +839,14 @@ class Model(Layer):
         else:
             new_state, leaves, next_key = rec["jit"](state_arrays, rng,
                                                      *input_arrays)
+        if rec.get("n_traces", 0) > n_traces0:
+            from .observability import perf as _perf
+            sig = _perf.step_signature(input_arrays)
+            _perf.record_compile(
+                "train_step", time.perf_counter() - t_compile0, sig,
+                prev_signature=rec.get("arg_sig"),
+                step=self._step_count)
+            rec["arg_sig"] = sig
         self.dev._set_rng_state(next_key)  # tracing clobbered dev rng
         if self._dist is not None:
             # bound the async in-flight queue: a host loop can dispatch
@@ -1281,7 +1298,7 @@ class Model(Layer):
         rec["step_flops"] = flops
         return flops
 
-    def profile_step(self, *args):
+    def profile_step(self, *args, record=True):
         """Run ONE training step under a ``jax.profiler`` trace and
         return ``(result, {fusion_name: (count, total_seconds)})`` —
         the measured per-fusion decomposition of the compiled step
@@ -1291,7 +1308,13 @@ class Model(Layer):
         gauges) and folded into ``dev.time_profiling`` like the
         verbosity path's rows. Call with the same args as a training
         step; profiler failures degrade to an empty table
-        (:func:`singa_tpu.profiling.measure_step_fusions`)."""
+        (:func:`singa_tpu.profiling.measure_step_fusions`).
+
+        ``record=False`` skips the registry publish (the device table
+        still folds): the sampling profiler is then the ONE publisher,
+        into ITS registry — without it every sampled step would set
+        each gauge twice and a custom-registry profiler would leak the
+        table into the default registry too."""
         from . import profiling as _prof
         from .utils import force_completion
 
@@ -1306,7 +1329,8 @@ class Model(Layer):
             return res
 
         result, table = _prof.measure_step_fusions(run_once)
-        _prof.record_fusion_metrics(table)
+        if record:
+            _prof.record_fusion_metrics(table)
         for name, (cnt, tot) in table.items():
             c0, t0 = self.dev.time_profiling.get(
                 f"fusion/{name}", (0, 0.0))

@@ -13,21 +13,37 @@ Three cooperating pieces (see each module's docstring):
 - :mod:`.export` — Prometheus-text rendering, snapshot schema
   validation, and the optional localhost HTTP endpoint. The
   ``tools/metrics_dump.py`` CLI drives these.
+- :mod:`.perf` — the performance-observability layer on top: HBM
+  gauges + OOM post-mortems, compile/retrace attribution
+  (``compile_seconds`` + ``retrace`` events naming the changed arg),
+  the sampling step profiler, and the step-time anomaly sentinel.
+- :mod:`.trace_export` — renders the flight-recorder ring into a
+  Chrome-trace ``.trace.json`` that opens in ui.perfetto.dev
+  (``tools/trace_export.py`` is the CLI, the serving gateway serves it
+  at ``/trace.json``).
 
-Host-side only: nothing here imports jax or runs inside a compiled
-step — ``compiled_step_info()["n_traces"]`` stays 1 with telemetry on,
-and per-step instrumentation cost is microseconds (both pinned by
-``tests/test_observability.py``).
+Host-side only: nothing here imports jax at module scope or runs
+inside a compiled step — ``compiled_step_info()["n_traces"]`` stays 1
+with telemetry on, and per-step instrumentation cost is microseconds
+(both pinned by ``tests/test_observability.py`` and
+``tests/test_perf_observability.py``).
 """
 
 from . import metrics     # noqa: F401
 from . import spans       # noqa: F401
 from . import export      # noqa: F401
+from . import perf        # noqa: F401
+from . import trace_export  # noqa: F401
 
 from .metrics import (MetricsRegistry, default_registry,  # noqa: F401
                       heartbeat_summary, aggregate_summaries,
                       device_peak_flops)
 from .spans import (FlightRecorder, span, event, context,  # noqa: F401
-                    recorder, configure)
+                    recorder, configure, open_spans)
 from .export import (render_prometheus, validate_snapshot,  # noqa: F401
                      serve_metrics)
+from .perf import (hbm_stats, record_hbm,                 # noqa: F401
+                   live_array_report, record_compile,
+                   SamplingProfiler, AnomalySentinel)
+from .trace_export import (to_chrome_trace,               # noqa: F401
+                           validate_chrome_trace)

@@ -324,18 +324,29 @@ def heartbeat_summary(registry=None):
             if isinstance(wires, Counter) else 0}
 
 
+# a rank whose mean step time exceeds this multiple of the fleet's
+# count-weighted mean is named a straggler in the aggregated view
+STRAGGLER_FACTOR = 1.5
+
+
 def aggregate_summaries(summaries):
     """Fold per-rank heartbeat summaries into ONE fleet view — what the
     coordinator publishes in its health report: min/max of the ranks'
     step-time extrema, a count-weighted mean, total steps and wire
-    errors, and how many ranks have reported anything at all."""
+    errors, how many ranks have reported anything at all, and — when
+    more than one rank reports step times — cross-rank straggler
+    attribution: the ranks whose own mean step time sits more than
+    :data:`STRAGGLER_FACTOR`× above the fleet mean, so "which host is
+    slow" is answerable straight off the heartbeat-carried summaries."""
     vals = [s for s in (summaries or {}).values() if isinstance(s, dict)]
     agg = {"ranks_reporting": len(vals),
            "wire_errors": sum(int(s.get("wire_errors") or 0)
                               for s in vals)}
-    steps = [s["step_time"] for s in vals
-             if isinstance(s.get("step_time"), dict)
-             and s["step_time"].get("count")]
+    per_rank = {r: s["step_time"] for r, s in (summaries or {}).items()
+                if isinstance(s, dict)
+                and isinstance(s.get("step_time"), dict)
+                and s["step_time"].get("count")}
+    steps = list(per_rank.values())
     if steps:
         total = sum(int(s["count"]) for s in steps)
         agg["steps"] = total
@@ -343,10 +354,16 @@ def aggregate_summaries(summaries):
         agg["step_time_max"] = max(float(s["max"]) for s in steps)
         agg["step_time_mean"] = sum(
             float(s["mean"]) * int(s["count"]) for s in steps) / total
+        fleet = agg["step_time_mean"]
+        agg["step_time_stragglers"] = sorted(
+            (r for r, s in per_rank.items()
+             if float(s["mean"]) > STRAGGLER_FACTOR * fleet),
+            key=str) if len(per_rank) > 1 and fleet > 0 else []
     return agg
 
 
 __all__ = ["SNAPSHOT_SCHEMA", "DEFAULT_BUCKETS", "PEAK_FLOPS_BY_KIND",
-           "device_peak_flops", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "REGISTRY", "default_registry",
-           "heartbeat_summary", "aggregate_summaries"]
+           "STRAGGLER_FACTOR", "device_peak_flops", "Counter", "Gauge",
+           "Histogram", "MetricsRegistry", "REGISTRY",
+           "default_registry", "heartbeat_summary",
+           "aggregate_summaries"]

@@ -5,7 +5,8 @@ histogram says "step time p50 is 42 ms", the span stream says "step 317
 took 1.9 s, and inside it checkpoint.save took 1.7 s". Each span is one
 JSON record::
 
-    {"kind": "span", "name": "step", "ts": <epoch s>, "dur_s": 0.042,
+    {"kind": "span", "name": "step", "ts": <end, epoch s>,
+     "ts_start": <start, epoch s>, "dur_s": 0.042,
      "parent": "run", "rank": 0, "step": 317, ...}
 
 - **Attribution** (run id, rank, step) comes from two places: explicit
@@ -49,6 +50,14 @@ from collections import deque
 _CTX = contextvars.ContextVar("singa_tpu_span_ctx", default=None)
 # innermost-enclosing-span name, for the ``parent`` field
 _STACK = contextvars.ContextVar("singa_tpu_span_stack", default=())
+
+# spans currently INSIDE their ``with`` body, keyed by object id: a
+# blackbox written while the process is dying must show what it was
+# inside (the hung step, the restore that never returned), not only
+# what already finished — FlightRecorder.dump appends these as
+# ``span_open`` records
+_OPEN_LOCK = threading.Lock()
+_OPEN = {}
 
 DEFAULT_CAPACITY = 1024
 
@@ -154,6 +163,14 @@ class FlightRecorder:
             f.write(json.dumps(header) + "\n")
             for rec in self.records():
                 f.write(json.dumps(rec) + "\n")
+            # spans still open at dump time (the hung step, the restore
+            # that never returned): without these the blackbox shows
+            # everything EXCEPT what the process died inside
+            for rec in open_spans():
+                try:
+                    f.write(json.dumps(rec, default=str) + "\n")
+                except (TypeError, ValueError):
+                    continue
             if snap is not None:
                 f.write(json.dumps({"kind": "metrics",
                                     "snapshot": snap}) + "\n")
@@ -194,7 +211,7 @@ class span:
     ambient :func:`context` attrs, the enclosing span's name, and — when
     the body raised — the exception type under ``error``."""
 
-    __slots__ = ("name", "attrs", "_t0", "_token")
+    __slots__ = ("name", "attrs", "_t0", "_token", "_wall0", "_ctx")
 
     def __init__(self, name, **attrs):
         self.name = name
@@ -202,15 +219,21 @@ class span:
 
     def __enter__(self):
         self._token = _STACK.set(_STACK.get() + (self.name,))
+        self._wall0 = time.time()
+        self._ctx = _CTX.get()
+        with _OPEN_LOCK:
+            _OPEN[id(self)] = self
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
+        with _OPEN_LOCK:
+            _OPEN.pop(id(self), None)
         stack = _STACK.get()
         _STACK.reset(self._token)
         rec = {"kind": "span", "name": self.name, "ts": time.time(),
-               "dur_s": dur}
+               "ts_start": self._wall0, "dur_s": dur}
         if len(stack) > 1:
             rec["parent"] = stack[-2]
         ctx = _CTX.get()
@@ -236,5 +259,27 @@ def event(name, **attrs):
     _RECORDER.record(rec)
 
 
-__all__ = ["FlightRecorder", "context", "span", "event", "recorder",
-           "configure", "DEFAULT_CAPACITY"]
+def open_spans(now=None):
+    """``span_open`` records for every span currently inside its
+    ``with`` body (any thread), oldest first: name, start timestamp,
+    age, and the attribution it was entered under. What a post-mortem
+    reads to learn what the process was INSIDE when it died."""
+    now = now if now is not None else time.time()
+    with _OPEN_LOCK:
+        items = list(_OPEN.values())
+    out = []
+    for s in items:
+        rec = {"kind": "span_open", "name": s.name, "ts": now,
+               "ts_start": s._wall0,
+               "age_s": max(0.0, now - s._wall0)}
+        if s._ctx:
+            rec.update(s._ctx)
+        if s.attrs:
+            rec.update(s.attrs)
+        out.append(rec)
+    out.sort(key=lambda r: r["ts_start"])
+    return out
+
+
+__all__ = ["FlightRecorder", "context", "span", "event", "open_spans",
+           "recorder", "configure", "DEFAULT_CAPACITY"]

@@ -1,0 +1,267 @@
+"""Chrome-trace / Perfetto export of the flight-recorder ring.
+
+The recorder's JSONL records (spans, events, in-flight ``span_open``
+records, dump headers, metrics snapshots) render into ONE Chrome Trace
+Event Format document (``{"traceEvents": [...]}``) that opens directly
+in ``ui.perfetto.dev`` or ``chrome://tracing`` — the whole train-and-
+serve session on a timeline instead of a JSONL scroll.
+
+Mapping:
+
+- each distinct ``rank`` attribution becomes a **process** row
+  (``pid``), named ``rank N``;
+- within a rank, records WITHOUT a request id share the ``runtime``
+  thread (``tid`` 0); records carrying a ``request`` attr (the serving
+  engine's per-request trace: ``request.queued`` → ``request.prefill``
+  → ``request.decode_tick``... → ``request.delivered``) each get their
+  own named thread lane, so one gateway request reads as one row;
+- ``span`` / ``span_open`` records are complete (``ph: "X"``) events —
+  start timestamp from ``ts_start`` (falling back to ``ts - dur_s``
+  for pre-PR-9 records), duration from ``dur_s``/``age_s``;
+- ``event`` records are instant (``ph: "i"``) events; the full attr
+  dict rides ``args`` (so a ``retrace`` event's signature diff and a
+  ``profile.sample``'s fusion table are clickable in the UI);
+- a ``metrics`` record (the snapshot a blackbox dump closes with)
+  becomes an instant event whose ``args`` carry the per-fusion
+  ``profile_fusion_seconds`` table and the snapshot's metric names.
+
+Timestamps are microseconds relative to the earliest record, which is
+what the viewers expect. :func:`validate_chrome_trace` is the schema
+gate the CLI selftest and the gateway endpoint run before replying.
+"""
+
+from __future__ import annotations
+
+import json
+
+# span attrs that are structural (consumed by the mapping), not args
+_STRUCTURAL = ("kind", "name", "ts", "ts_start", "dur_s", "age_s",
+               "rank")
+
+
+def _start_ts(rec):
+    if rec.get("ts_start") is not None:
+        return float(rec["ts_start"])
+    ts = rec.get("ts")
+    if ts is None:
+        return None
+    if rec.get("kind") == "span":
+        return float(ts) - float(rec.get("dur_s") or 0.0)
+    return float(ts)
+
+
+def _fusion_args(snapshot):
+    """Pull the per-fusion gauge table out of one metrics snapshot —
+    the 'fusion tables' part of the export contract."""
+    args = {"metrics": sorted(m.get("name", "?")
+                              for m in snapshot.get("metrics", []))}
+    for m in snapshot.get("metrics", []):
+        if m.get("name") == "profile_fusion_seconds":
+            rows = []
+            for s in m.get("series", []):
+                labels = s.get("labels") or {}
+                rows.append([labels.get("fusion", "?"),
+                             s.get("value")])
+            rows.sort(key=lambda r: -(r[1] or 0.0))
+            args["profile_fusion_seconds"] = rows[:32]
+    return args
+
+
+def to_chrome_trace(records):
+    """Render recorder records (dicts, recorder/JSONL order) into a
+    Chrome Trace Event Format document. Unknown record kinds are
+    skipped; an empty input renders an empty (still valid) trace."""
+    recs = [r for r in records if isinstance(r, dict)]
+    tvals = [t for t in (_start_ts(r) for r in recs) if t is not None]
+    t0 = min(tvals) if tvals else 0.0
+
+    pids = {}           # rank value -> pid
+    tids = {}           # (pid, lane key) -> tid
+    meta, events = [], []
+    # dump headers and metrics snapshots are process-global, not any
+    # one rank's work — they get their own "recorder" row instead of
+    # landing in whichever rank happened to claim pid 1 first
+    recorder_pid = [None]
+
+    def pid_recorder():
+        if recorder_pid[0] is None:
+            recorder_pid[0] = 1_000_000
+            meta.append({"ph": "M", "name": "process_name",
+                         "pid": recorder_pid[0], "tid": 0,
+                         "args": {"name": "recorder"}})
+        return recorder_pid[0]
+
+    def pid_of(rec):
+        rank = rec.get("rank", 0)
+        key = str(rank)
+        if key not in pids:
+            pids[key] = len(pids) + 1
+            meta.append({"ph": "M", "name": "process_name",
+                         "pid": pids[key], "tid": 0,
+                         "args": {"name": f"rank {rank}"}})
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": pids[key], "tid": 0,
+                         "args": {"name": "runtime"}})
+        return pids[key]
+
+    def tid_of(pid, rec):
+        rid = rec.get("request")
+        if not rid:
+            return 0
+        key = (pid, str(rid))
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": pid, "tid": tids[key],
+                         "args": {"name": f"request {rid}"}})
+        return tids[key]
+
+    for rec in recs:
+        kind = rec.get("kind")
+        ts = _start_ts(rec)
+        if ts is None:
+            continue
+        ts_us = max(0.0, (ts - t0) * 1e6)
+        if kind == "metrics":
+            events.append({"ph": "i", "name": "metrics_snapshot",
+                           "cat": "metrics", "pid": pid_recorder(),
+                           "tid": 0, "ts": ts_us, "s": "g",
+                           "args": _fusion_args(
+                               rec.get("snapshot") or {})})
+            continue
+        if kind == "dump":
+            events.append({"ph": "i", "name": "blackbox_dump",
+                           "cat": "dump", "pid": pid_recorder(),
+                           "tid": 0, "ts": ts_us, "s": "g",
+                           "args": {k: v for k, v in rec.items()
+                                    if k not in ("kind", "ts")}})
+            continue
+        if kind not in ("span", "span_open", "event"):
+            continue
+        pid = pid_of(rec)
+        tid = tid_of(pid, rec)
+        args = {k: v for k, v in rec.items() if k not in _STRUCTURAL}
+        if kind == "event":
+            events.append({"ph": "i", "name": rec.get("name", "event"),
+                           "cat": "event", "pid": pid, "tid": tid,
+                           "ts": ts_us, "s": "t", "args": args})
+        else:
+            dur_s = rec.get("dur_s", rec.get("age_s", 0.0)) or 0.0
+            if kind == "span_open":
+                args["open"] = True
+            events.append({"ph": "X", "name": rec.get("name", "span"),
+                           "cat": kind, "pid": pid, "tid": tid,
+                           "ts": ts_us,
+                           "dur": max(float(dur_s) * 1e6, 0.0),
+                           "args": args})
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(doc, check_serializable=True):
+    """Structural gate over an exported trace document: raises
+    ValueError naming the first problem, returns the doc for chaining.
+    Checks what the viewers actually require — every event has a phase
+    and pid/tid, non-metadata events have numeric non-negative
+    timestamps, complete events have numeric durations — plus a JSON
+    round-trip (an unserializable arg must fail HERE, not in the
+    browser). A caller about to serialize the doc itself passes
+    ``check_serializable=False`` — its own ``json.dumps`` IS that
+    check, and the doc can hold the whole recorder ring (dumping it
+    twice doubles the endpoint's cost for nothing)."""
+    if not isinstance(doc, dict):
+        raise ValueError("trace is not a dict")
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError("traceEvents is not a list")
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            raise ValueError(f"traceEvents[{i}] is not a dict")
+        ph = e.get("ph")
+        if not isinstance(ph, str) or not ph:
+            raise ValueError(f"traceEvents[{i}]: missing phase 'ph'")
+        if not isinstance(e.get("name"), str):
+            raise ValueError(f"traceEvents[{i}]: missing name")
+        for f in ("pid", "tid"):
+            if not isinstance(e.get(f), int):
+                raise ValueError(f"traceEvents[{i}]: missing {f}")
+        if ph == "M":
+            if not isinstance(e.get("args"), dict):
+                raise ValueError(
+                    f"traceEvents[{i}]: metadata without args")
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            raise ValueError(
+                f"traceEvents[{i}] ({e['name']}): bad ts {ts!r}")
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(
+                    f"traceEvents[{i}] ({e['name']}): bad dur {dur!r}")
+    if check_serializable:
+        try:
+            json.dumps(doc)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"trace is not JSON-serializable: {e}") \
+                from None
+    return doc
+
+
+def records_from_jsonl(path):
+    """Parse one recorder file (a blackbox dump or a live
+    ``spans.jsonl`` sink) back into record dicts, skipping unparseable
+    lines (a torn final line must not void the rest of a post-mortem)."""
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def export_records(records, path):
+    """Render + validate + write ``records`` as ``path`` (a
+    ``.trace.json`` that opens in ui.perfetto.dev). Returns the doc."""
+    doc = validate_chrome_trace(to_chrome_trace(records))
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def live_records(recorder=None, registry=None):
+    """The LIVE process trace as records: the flight-recorder ring,
+    in-flight (still-open) spans, and a closing metrics snapshot (the
+    fusion tables ride it). The one composition both live consumers —
+    the gateway's ``GET /trace.json`` and :func:`export_recorder` —
+    render, so they cannot drift."""
+    import time
+
+    from . import metrics as _metrics
+    from . import spans as _spans
+    rec = recorder if recorder is not None else _spans.recorder()
+    records = list(rec.records()) + _spans.open_spans()
+    reg = registry if registry is not None \
+        else _metrics.default_registry()
+    try:
+        records.append({"kind": "metrics", "ts": time.time(),
+                        "snapshot": reg.snapshot()})
+    except Exception:   # noqa: BLE001 — spans alone still export
+        pass
+    return records
+
+
+def export_recorder(path, recorder=None, registry=None):
+    """Export the LIVE default flight recorder (:func:`live_records`)
+    to ``path`` as a Perfetto-openable trace."""
+    return export_records(live_records(recorder, registry), path)
+
+
+__all__ = ["to_chrome_trace", "validate_chrome_trace",
+           "records_from_jsonl", "export_records", "live_records",
+           "export_recorder"]

@@ -125,6 +125,16 @@ def measure_step_fusions(run_step, logdir=None):
             shutil.rmtree(d, ignore_errors=True)
 
 
+def summarize_table(table, top=5):
+    """Top-``top`` fusions of one measured table by total seconds,
+    JSON-able (``[[name, count, seconds], ...]``) — what the sampling
+    profiler's ``profile.sample`` flight-recorder event carries so a
+    blackbox names the hot fusions without the full table."""
+    rows = sorted(table.items(), key=lambda kv: -kv[1][1])[:int(top)]
+    return [[name[:120], int(cnt), round(tot, 6)]
+            for name, (cnt, tot) in rows]
+
+
 def record_fusion_metrics(table, registry=None):
     """Publish a measured per-fusion table into the metrics registry
     (gauges labeled by fusion symbol — SET, not accumulated: each

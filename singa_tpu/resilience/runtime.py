@@ -52,6 +52,7 @@ from .. import data as _data_mod
 from ..checkpoint import CheckpointManager, DistributedCheckpointManager
 from ..integrity import replica_buffer_mismatches, state_fingerprint
 from ..observability import metrics as _metrics
+from ..observability import perf as _perf
 from ..observability import spans as _spans
 from .cluster import BarrierTimeout, MembershipError
 from .faults import NULL_PLAN
@@ -157,6 +158,19 @@ class ResilientTrainer:
     - ``max_divergence_rollbacks``: quarantine-rollbacks allowed before
       the run exits :data:`EXIT_DIVERGED` (76) — repeated divergence
       means bad hardware, and "restart the same pod" is not a fix.
+    - ``profile_every``: every N steps, run the step under a
+      ``jax.profiler`` trace (``Model.profile_step``) and refresh the
+      ``profile_fusion_*`` gauges — the continuous per-fusion view the
+      MFU work reads. 0 (the default) disables sampling; non-sample
+      steps pay one integer check, and the compiled step's
+      ``n_traces`` pin is untouched (the profiler wraps the
+      already-compiled dispatch).
+    - ``anomaly_factor`` / ``anomaly_sustain`` / ``anomaly_warmup``:
+      arm the step-time anomaly sentinel — ``anomaly_sustain``
+      consecutive steps slower than ``anomaly_factor``× the rolling
+      baseline fire an attributed ``step_anomaly`` event, a one-shot
+      profile capture on the next step, and a blackbox dump. None
+      (the default) disables the sentinel.
     """
 
     def __init__(self, model, ckpt_dir, *, max_to_keep=3,
@@ -168,7 +182,9 @@ class ResilientTrainer:
                  commit_timeout=60.0, start_barrier_timeout=60.0,
                  preempt_commit_timeout=10.0, manifest_extra=None,
                  fingerprint_every=0, max_divergence_rollbacks=2,
-                 telemetry_dir=None):
+                 telemetry_dir=None, profile_every=0,
+                 anomaly_factor=None, anomaly_sustain=3,
+                 anomaly_warmup=10):
         self.model = model
         self.cluster = cluster
         self._rank = cluster.rank if cluster is not None else 0
@@ -247,6 +263,14 @@ class ResilientTrainer:
         self._step_flops = None       # resolved lazily after step 1
         self._last_blackbox = None
         self._cur_step = None
+        # performance observability: the sampling profiler always
+        # exists (the sentinel arms one-shot captures through it even
+        # at profile_every=0); the sentinel only when asked for
+        self._profiler = _perf.SamplingProfiler(profile_every)
+        self._step_was_profiled = False
+        self._sentinel = _perf.AnomalySentinel(
+            factor=anomaly_factor, sustain=anomaly_sustain,
+            warmup=anomaly_warmup) if anomaly_factor else None
 
     # -- logging -----------------------------------------------------------
     def _log(self, msg):
@@ -371,15 +395,44 @@ class ResilientTrainer:
                               summary, "data_retries")
                 attempt += 1
 
+    # a profiled step's wall-clock is dominated by the trace dump +
+    # parse, not the step: its watchdog budget scales by this factor so
+    # routine sampling can never trip a spurious (or fatal) timeout
+    PROFILE_TIMEOUT_FACTOR = 4
+
     def _call_step(self, step, batch, attempt):
         """One step attempt: fault hooks + the model call, optionally
         under the watchdog thread."""
+        # cleared per ATTEMPT, not per observe: a profiled attempt that
+        # dies before _observe_step must not leak its flag onto the
+        # next successful step (which would silently drop that step
+        # from the step-time/MFU/sentinel series)
+        self._step_was_profiled = False
+        will_profile = self._profiler.should_sample(step) and \
+            hasattr(self.model, "profile_step")
+
         def body():
             self.faults.on_step(step, attempt)
+            if will_profile:
+                # the sampled step runs THROUGH the already-compiled
+                # dispatch under a profiler trace (measure_step_fusions)
+                # — no retrace, one trace dump, gauges refreshed. The
+                # flag keeps its inflated wall-clock (trace dump +
+                # parse dominate) OUT of the step-time/MFU/throughput
+                # series — its cost lands in profile_capture_seconds
+                self._step_was_profiled = True
+                t0 = time.perf_counter()
+                out, table = self.model.profile_step(
+                    *batch, record=False)
+                self._profiler.record(
+                    step, table, capture_s=time.perf_counter() - t0)
+                return out
             return self.model(*batch)
 
         if self.step_timeout is None:
             return body()
+        timeout = self.step_timeout * \
+            (self.PROFILE_TIMEOUT_FACTOR if will_profile else 1)
         result, raised = {}, []
         # carry the caller's contextvars into the worker: a use_layout()
         # scope (ops/layout.py ContextVar) entered around run() must be
@@ -396,12 +449,15 @@ class ResilientTrainer:
         worker = threading.Thread(target=work, daemon=True,
                                   name=f"resilient-step-{step}")
         worker.start()
-        worker.join(self.step_timeout)
+        worker.join(timeout)
         if worker.is_alive():
-            raise StepTimeoutError(
-                f"step {step} exceeded the {self.step_timeout}s "
-                "watchdog timeout", worker=worker, result=result,
-                raised=raised)
+            err = StepTimeoutError(
+                f"step {step} exceeded the {timeout}s "
+                "watchdog timeout"
+                + (" (profiled-step budget)" if will_profile else ""),
+                worker=worker, result=result, raised=raised)
+            err.timeout = timeout   # the grace join reuses this budget
+            raise err
         if raised:
             raise raised[0]
         return result.get("out")
@@ -421,11 +477,12 @@ class ResilientTrainer:
                 # may yet land its state mutation concurrently
                 summary["step_timeouts"] += 1
                 self._m_timeouts.inc()
-                e.worker.join(self.step_timeout)
+                grace = getattr(e, "timeout", self.step_timeout)
+                e.worker.join(grace)
                 if e.worker.is_alive():
                     raise StepTimeoutError(
                         f"step {step} still running after "
-                        f"{2 * self.step_timeout}s; a hung backend "
+                        f"{2 * grace}s; a hung backend "
                         "cannot be retried in-process — exit and let "
                         "the supervisor restart from the checkpoint"
                     ) from None
@@ -499,17 +556,33 @@ class ResilientTrainer:
             self.cluster.check()
 
     # -- flight recorder ---------------------------------------------------
-    def _blackbox_dump(self, reason, step=None):
+    def _jax_device(self):
+        dev = getattr(self.model, "dev", None)
+        return getattr(dev, "jax_device", None)
+
+    def _blackbox_dump(self, reason, step=None, error=None):
         """Dump the in-memory flight recorder to
         ``<telemetry_dir>/blackbox-<rank>.jsonl`` — called on every
         ABNORMAL path (preemption, divergence, watchdog kill,
-        membership loss, rollback) so a post-mortem shows the last N
-        seconds of spans and a final metrics snapshot, not just an exit
-        code. Never raises: losing the blackbox must not change how the
-        run dies."""
+        membership loss, rollback, crash) so a post-mortem shows the
+        last N seconds of spans and a final metrics snapshot, not just
+        an exit code. A crash/watchdog dump additionally carries the
+        HBM stats and a bounded ``jax.live_arrays()`` allocation
+        breakdown — the OOM post-mortem. Never raises: losing the
+        blackbox must not change how the run dies."""
         try:
             guard = self._guard()
-            extra = {"guard": guard.stats()} if guard is not None else None
+            extra = {"guard": guard.stats()} if guard is not None else {}
+            if error is not None:
+                extra["error"] = \
+                    f"{type(error).__name__}: {error}"[:500]
+            if reason in ("crash", "watchdog_kill") or error is not None:
+                hbm = _perf.hbm_stats(self._jax_device())
+                if hbm:
+                    extra["hbm"] = hbm
+                live = _perf.live_array_report()
+                if live:
+                    extra["live_arrays"] = live
             path = os.path.join(self.telemetry_dir,
                                 f"blackbox-{self._rank}.jsonl")
             self._last_blackbox = _spans.recorder().dump(
@@ -702,13 +775,26 @@ class ResilientTrainer:
         return resume
 
     # -- per-step telemetry ------------------------------------------------
-    def _observe_step(self, step_s, batch, summary, run_t0, first):
+    def _observe_step(self, step, step_s, batch, summary, run_t0,
+                      first):
         """Host-side step accounting: duration histogram, throughput,
         MFU when an XLA cost analysis is already cached (never forces a
-        compile on the step path), and — once per run — the restart-to-
-        first-step latency that gates cold-start regressions."""
+        compile on the step path), HBM gauges (one ``memory_stats``
+        read; a no-op off-accelerator after the first probe), the
+        anomaly sentinel, and — once per run — the restart-to-
+        first-step latency that gates cold-start regressions.
+
+        A PROFILED step's wall-clock is dominated by the trace dump +
+        parse, not the step: it still counts in train_steps_total, but
+        its duration stays out of the step-time histogram, the
+        throughput/MFU gauges, and the sentinel — operators must never
+        read the sampling overhead as a performance regression (the
+        real sampling cost is profile_capture_seconds)."""
+        profiled = getattr(self, "_step_was_profiled", False)
+        self._step_was_profiled = False
         self._m_steps.inc()
-        self._m_step_time.observe(step_s)
+        if not profiled:
+            self._m_step_time.observe(step_s)
         if first:
             lat = time.perf_counter() - run_t0
             summary["first_step_latency_s"] = round(lat, 6)
@@ -724,7 +810,7 @@ class ResilientTrainer:
                     self._step_flops = sf(compute=False)
                 except Exception:       # audit is best-effort telemetry
                     self._step_flops = None
-        if step_s > 0:
+        if step_s > 0 and not profiled:
             first_arr = next((b for b in batch
                               if hasattr(b, "shape") and
                               getattr(b, "shape", ())), None)
@@ -737,6 +823,18 @@ class ResilientTrainer:
                     None))
                 if peak:
                     self._m_mfu.set(self._step_flops / step_s / peak)
+        # HBM at the step boundary (bytes_in_use / peak / limit gauges)
+        _perf.record_hbm(self._jax_device(), site="train")
+        # the first step carries the XLA compile: feeding it to the
+        # sentinel would seed the baseline orders of magnitude high
+        # and blind it for the whole EMA decay
+        if self._sentinel is not None and not first and not profiled \
+                and self._sentinel.observe(step, step_s):
+            # sustained spike: the sentinel already left the attributed
+            # step_anomaly event — capture a one-shot profile on the
+            # next step and leave the blackbox behind now
+            self._profiler.force_next()
+            self._blackbox_dump("step_anomaly", step=step)
 
     # -- the loop ----------------------------------------------------------
     def run(self, data, num_steps, step_callback=None):
@@ -836,8 +934,8 @@ class ResilientTrainer:
                     out = self._run_step(step, batch, summary)
                 step_s = time.perf_counter() - t_step
                 summary["steps_run"] += 1
-                self._observe_step(step_s, batch, summary, run_t0,
-                                   first=not first_step_done)
+                self._observe_step(step, step_s, batch, summary,
+                                   run_t0, first=not first_step_done)
                 first_step_done = True
                 # cross-replica fingerprint on its cadence, BEFORE the
                 # save: a diverged step is quarantined — it must never
@@ -914,6 +1012,13 @@ class ResilientTrainer:
             if self.exit_on_preempt:
                 raise SystemExit(EXIT_PREEMPTED) from None
             return summary
+        except Exception as e:      # noqa: BLE001 — re-raised below
+            # any other crash (device OOM, an XLA failure past the
+            # retry budget, a bug): leave the post-mortem behind — the
+            # dump carries HBM stats and the live-array allocation
+            # breakdown, so an OOM names where the memory went
+            self._blackbox_dump("crash", error=e)
+            raise
         finally:
             span_ctx.__exit__(None, None, None)
             self._restore_handlers(prev_handlers)

@@ -54,11 +54,12 @@ import warnings
 import numpy as np
 
 from ..observability import metrics as _metrics
+from ..observability import perf as _perf
 from ..observability import spans as _spans
 from ..resilience.faults import NULL_PLAN, FaultInjected
 from ..models import decode as _decode
-from .scheduler import (EngineDraining, Request, RequestQueue,
-                        RequestTimeout, ServingError)
+from .scheduler import (EngineDraining, QueueFull, Request,
+                        RequestQueue, RequestTimeout, ServingError)
 
 # donation is a TPU/accelerator optimisation; on CPU jax warns that the
 # donated buffers were unused — expected for OUR two programs, not
@@ -78,17 +79,40 @@ def _quiet_donation(fn, *args):
         return fn(*args)
 
 
+def _attribute_trace(rec, registry, program, arrays, names, t0):
+    """Compile/retrace attribution for ONE serve-program dispatch that
+    traced (caller checks the ``n_traces`` delta): wall-clock into
+    ``compile_seconds{program}``, signature (diffed against this
+    program's previous trace) into a compile/retrace event — a decode
+    retrace is the broken no-retrace contract, and the event names
+    what changed."""
+    sig = _perf.step_signature(arrays, names=names)
+    _perf.record_compile(program, time.perf_counter() - t0, sig,
+                         prev_signature=rec.get("sig"),
+                         registry=registry)
+    rec["sig"] = sig
+
+
 class _EngineBase:
     """Shared control plane: queue, loop thread, drain, faults, SLOs."""
 
     def __init__(self, *, queue_capacity=64, faults=None, registry=None,
-                 telemetry_dir="telemetry", max_retries=3):
+                 telemetry_dir="telemetry", max_retries=3,
+                 trace_requests=True):
         self._reg = registry if registry is not None \
             else _metrics.default_registry()
         self.queue = RequestQueue(queue_capacity, registry=self._reg)
         self.faults = faults if faults is not None else NULL_PLAN
         self.telemetry_dir = telemetry_dir
         self.max_retries = int(max_retries)
+        # per-request flight-recorder events (request.queued →
+        # request.prefill → request.decode_tick... → request.delivered,
+        # all carrying the request's trace id) — what the Perfetto
+        # exporter reconstructs into one timeline lane per request.
+        # Each event is a µs-scale dict append; trace_requests=False
+        # turns them off for latency-critical deployments.
+        self._trace_requests = bool(trace_requests)
+        self._hbm_dev = None        # set by subclasses (HBM sampling)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._idle_evt = threading.Event()
@@ -120,7 +144,19 @@ class _EngineBase:
             self.queue.finish("rejected")
             raise EngineDraining(
                 "engine is draining/stopped; not accepting new requests")
-        self.queue.put(req)
+        # the queued event lands BEFORE the put: the loop thread can
+        # pop-and-prefill the instant the request is visible, and the
+        # per-request timeline must stay causal (queued < prefill)
+        if self._trace_requests:
+            _spans.event("request.queued", request=req.trace_id,
+                         queue_depth=len(self.queue))
+        try:
+            self.queue.put(req)
+        except QueueFull:
+            if self._trace_requests:
+                _spans.event("request.rejected", request=req.trace_id,
+                             reason="queue_full")
+            raise
         self._wake.set()
         return req.future
 
@@ -195,11 +231,18 @@ class _EngineBase:
         try:
             path = os.path.join(self.telemetry_dir,
                                 "blackbox-serve.jsonl")
+            extra = {"tick": self._tick_count,
+                     "error": f"{type(exc).__name__}: {exc}",
+                     "queue_depth": len(self.queue)}
+            # serve-side OOM post-mortem: where the HBM went
+            hbm = _perf.hbm_stats(self._hbm_dev)
+            if hbm:
+                extra["hbm"] = hbm
+            live = _perf.live_array_report()
+            if live:
+                extra["live_arrays"] = live
             _spans.recorder().dump(
-                path, reason="serve_loop_crash",
-                extra={"tick": self._tick_count,
-                       "error": f"{type(exc).__name__}: {exc}",
-                       "queue_depth": len(self.queue)},
+                path, reason="serve_loop_crash", extra=extra,
                 registry=self._reg)
             print(f"[serving] loop crashed ({type(exc).__name__}: "
                   f"{exc}); blackbox at {path}")
@@ -210,6 +253,12 @@ class _EngineBase:
         self.queue.drain_pending(err)
         self._fail_inflight(err)
         self._idle_evt.set()
+
+    def _sample_hbm(self):
+        """HBM gauges on the serving tick cadence (every 16th tick —
+        decode ticks can be sub-ms; a CPU run costs one probe ever)."""
+        if self._tick_count % 16 == 0:
+            _perf.record_hbm(self._hbm_dev, self._reg, site="serve")
 
     # -- synchronous stepping (tests, simple callers) ----------------------
     def step(self):
@@ -318,6 +367,7 @@ class ServingEngine(_EngineBase):
 
         self._prefill_rec = {"n_traces": 0}
         self._decode_rec = {"n_traces": 0}
+        self._hbm_dev = _perf.first_jax_device(self._cache)
         prefill_raw = adapter.prefill_fn()
         decode_raw = adapter.decode_fn()
         prefill_rec, decode_rec = self._prefill_rec, self._decode_rec
@@ -353,12 +403,15 @@ class ServingEngine(_EngineBase):
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, temperature=0.0,
-               top_k=None, eos_id=None, seed=0, timeout=None):
+               top_k=None, eos_id=None, seed=0, timeout=None,
+               trace_id=None):
         """Queue one generation request; returns its
         :class:`~singa_tpu.serving.scheduler.ServeFuture` (``.result()``
         is ``{"tokens": [...], "prompt_len": n, "ttft_s": ...}``).
         Prompts longer than ``prefill_len`` are rejected here, typed
-        and synchronous."""
+        and synchronous. ``trace_id`` names the request in the
+        per-request flight-recorder trace (the gateway mints one per
+        HTTP request); defaults to ``req-<n>``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -374,7 +427,8 @@ class ServingEngine(_EngineBase):
                 f"prefill_len {self.prefill_len}")
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
-                      eos_id=eos_id, seed=seed, timeout=timeout)
+                      eos_id=eos_id, seed=seed, timeout=timeout,
+                      trace_id=trace_id)
         return self._admit(req)
 
     def compiled_step_info(self):
@@ -410,6 +464,9 @@ class ServingEngine(_EngineBase):
         slot = self._slots[i]
         self._slots[i] = None
         req = slot["req"]
+        if self._trace_requests:
+            _spans.event("request.delivered", request=req.trace_id,
+                         status=status, tokens=len(req.tokens))
         if status == "completed":
             req.future.set_result({
                 "tokens": list(req.tokens),
@@ -473,6 +530,7 @@ class ServingEngine(_EngineBase):
             self._tok_lat.observe(time.perf_counter() - t0)
             self._decode_steps.inc()
         self._occupancy.set(self.active_slots())
+        self._sample_hbm()
 
     def _run_prefill(self, batch, free):
         B, S = self.prefill_batch, self.prefill_len
@@ -488,14 +546,26 @@ class ServingEngine(_EngineBase):
             slot_ids[b] = free[b]
             valid[b] = True
             placed.append((req, free[b]))
+        n0 = self._prefill_rec["n_traces"]
+        t0c = time.perf_counter()
         self._cache, logits = _quiet_donation(
             self._prefill, self._P, self._cache, tokens, lengths,
             slot_ids, valid)
+        if self._prefill_rec["n_traces"] > n0:
+            _attribute_trace(self._prefill_rec, self._reg,
+                             "serve_prefill",
+                             [tokens, lengths, slot_ids, valid],
+                             ("tokens", "lengths", "slot_ids",
+                              "valid"), t0c)
         logits = np.asarray(logits)
         for b, (req, slot_idx) in enumerate(placed):
             req.first_token_at = time.monotonic()
             self._ttft.observe(req.first_token_at - req.submitted_at)
             self._prefills.inc()
+            if self._trace_requests:
+                _spans.event("request.prefill", request=req.trace_id,
+                             slot=slot_idx,
+                             prompt_len=int(req.prompt.size))
             # the first generated token sits at position prompt_len;
             # its k/v are written by the NEXT decode tick
             self._sample_and_place(req, logits[b], slot_idx,
@@ -511,13 +581,30 @@ class ServingEngine(_EngineBase):
                 tokens[i] = slot["tok"]
                 positions[i] = slot["pos"]
                 active[i] = True
+        n0 = self._decode_rec["n_traces"]
+        t0c = time.perf_counter()
         self._cache, logits = _quiet_donation(
             self._decode, self._P, self._cache, tokens, positions,
             active)
+        if self._decode_rec["n_traces"] > n0:
+            _attribute_trace(self._decode_rec, self._reg,
+                             "serve_decode",
+                             [tokens, positions, active],
+                             ("tokens", "positions", "active"), t0c)
         logits = np.asarray(logits)
         for i, slot in enumerate(list(self._slots)):
             if slot is None:
                 continue
+            # decimated past the first 16 tokens: a 4-slot engine
+            # generating hundreds of tokens per request would otherwise
+            # evict the whole flight-recorder ring (capacity 1024) with
+            # ticks, beheading every request lane and crash blackbox
+            n_tok = len(slot["req"].tokens)
+            if self._trace_requests and \
+                    (n_tok < 16 or n_tok % 16 == 0):
+                _spans.event("request.decode_tick",
+                             request=slot["req"].trace_id, slot=i,
+                             pos=slot["pos"] + 1)
             self._sample_and_place(slot["req"], logits[i], i,
                                    pos=slot["pos"] + 1)
 
@@ -591,13 +678,14 @@ class BatchServingEngine(_EngineBase):
             return leaves
 
         self._fwd = jax.jit(fwd)
+        self._hbm_dev = _perf.first_jax_device(self._state_arrays)
         self._occupancy = self._reg.gauge(
             "serve_slot_occupancy", "active sequences in the slot array")
         self._reg.gauge("serve_slots",
                         "slot array width (max in-flight sequences)"
                         ).set(self.batch)
 
-    def submit(self, x, timeout=None):
+    def submit(self, x, timeout=None, trace_id=None):
         """Queue one input array of ``input_shape``; the future's
         result is the model's per-row output (array, or tuple for
         multi-output models)."""
@@ -607,7 +695,8 @@ class BatchServingEngine(_EngineBase):
             raise ServingError(
                 f"input shape {x.shape} != engine input_shape "
                 f"{self.input_shape}")
-        req = Request(None, payload=x, timeout=timeout)
+        req = Request(None, payload=x, timeout=timeout,
+                      trace_id=trace_id)
         return self._admit(req)
 
     def compiled_step_info(self):
@@ -632,6 +721,7 @@ class BatchServingEngine(_EngineBase):
         for i, req in enumerate(batch):
             x[i] = req.payload
         t0 = time.perf_counter()
+        n0 = self._rec["n_traces"]
         try:
             with _spans.span("serve.batch_forward", n=len(batch)):
                 leaves = self._fwd(self._state_arrays, x)
@@ -640,6 +730,9 @@ class BatchServingEngine(_EngineBase):
             # drain — fail them here, exactly once
             self._fail_batch(batch, e)
             raise
+        if self._rec["n_traces"] > n0:
+            _attribute_trace(self._rec, self._reg, "serve_batch",
+                             [x], ("input",), t0)
         self._tok_lat.observe(time.perf_counter() - t0)
         leaves = [np.asarray(leaf) for leaf in leaves]
         for i, req in enumerate(batch):
@@ -647,9 +740,13 @@ class BatchServingEngine(_EngineBase):
             req.first_token_at = now
             self._ttft.observe(now - req.submitted_at)
             row = tuple(leaf[i] for leaf in leaves)
+            if self._trace_requests:
+                _spans.event("request.delivered",
+                             request=req.trace_id, status="completed")
             req.future.set_result(row[0] if len(row) == 1 else row)
             self.queue.finish("completed")
         self._occupancy.set(0)
+        self._sample_hbm()
 
 
 def _check_quant_policy(policy, target, *, weights_ok, cache_ok, hint):
@@ -700,7 +797,7 @@ def build_engine(model, **kw):
                 "build either way)")
         ar_keys = ("slots", "max_len", "prefill_len", "prefill_batch",
                    "policy", "queue_capacity", "faults", "registry",
-                   "telemetry_dir", "max_retries")
+                   "telemetry_dir", "max_retries", "trace_requests")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(
@@ -714,7 +811,7 @@ def build_engine(model, **kw):
             f"{type(model).__name__} has no decode_adapter")
     bt_keys = ("input_shape", "batch", "input_dtype", "policy",
                "queue_capacity", "faults", "registry", "telemetry_dir",
-               "max_retries")
+               "max_retries", "trace_requests")
     unknown = sorted(set(kw) - set(bt_keys))
     if unknown:
         raise TypeError(

@@ -184,6 +184,13 @@ class FleetRouter:
             except (EngineDraining, QueueFull) as e:
                 last_exc = e
                 self._failovers.inc()
+                # the failover joins the request's timeline: a traced
+                # request shows WHICH replica refused it and why
+                ev = {"replica": getattr(r, "name", None),
+                      "reason": type(e).__name__}
+                if kwargs.get("trace_id"):
+                    ev["request"] = kwargs["trace_id"]
+                _spans.event("request.failover", **ev)
                 continue
             self._submitted.inc()
             return fut

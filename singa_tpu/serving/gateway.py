@@ -18,8 +18,18 @@ edge of the engine, not a web framework. Endpoints:
   it.
 - ``GET /metrics`` / ``GET /metrics.json`` — Prometheus text / snapshot
   JSON of the engine's registry (quantile summaries included).
+- ``GET /trace.json`` — the process flight-recorder ring (in-flight
+  spans included) rendered as a Chrome-trace document that opens in
+  ui.perfetto.dev — per-request timeline lanes keyed by the request
+  ids this gateway minted.
 - ``POST /drain`` — begin a graceful drain; 202 immediately (the drain
   finishes in the background; watch ``/healthz``).
+
+Request tracing: every ``/v1/generate`` / ``/v1/predict`` call gets a
+request id (``request_id`` in the body to supply your own, else a
+fresh hex id), passed to the engine as its trace id and echoed in the
+response — the handle that finds this request's lane in
+``/trace.json``.
 
 Refusal mapping: draining/full queue → 503 (fail over), request
 deadline → 504, malformed request → 400, serve-loop crash → 500.
@@ -31,6 +41,7 @@ from __future__ import annotations
 
 import json
 import threading
+import uuid
 
 from .scheduler import (EngineDraining, QueueFull, RequestTimeout,
                         ServingError)
@@ -101,6 +112,14 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                                 else 503, doc)
                 elif self.path.startswith("/metrics.json"):
                     self._reply(200, engine._reg.snapshot())
+                elif self.path.startswith("/trace.json"):
+                    from ..observability import trace_export as _texp
+                    # _reply's own dumps is the single serialization
+                    # AND the serializability check (failure → 500)
+                    self._reply(200, _texp.validate_chrome_trace(
+                        _texp.to_chrome_trace(_texp.live_records(
+                            registry=engine._reg)),
+                        check_serializable=False))
                 elif self.path.startswith("/metrics"):
                     body = render_prometheus(
                         engine._reg.snapshot()).encode()
@@ -129,6 +148,7 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
             except Exception:
                 self._reply(400, {"error": "body is not JSON"})
                 return
+            self._rid = None
             try:
                 if self.path.startswith("/drain"):
                     begin_drain()
@@ -140,13 +160,31 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                 else:
                     self._reply(404, {"error": "unknown path"})
             except (EngineDraining, QueueFull) as e:
-                self._reply(503, {"error": str(e), "retryable": True})
+                self._reply(503, self._err(e, retryable=True))
             except RequestTimeout as e:
-                self._reply(504, {"error": str(e)})
+                self._reply(504, self._err(e))
             except (ServingError, ValueError, TypeError) as e:
-                self._reply(400, {"error": str(e)})
+                self._reply(400, self._err(e))
             except Exception as e:   # noqa: BLE001 — crash → 500, once
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(500, self._err(e, named=True))
+
+        def _err(self, e, named=False, **extra):
+            # error replies keep the minted request id — a FAILED
+            # request's trace lane is the main /trace.json debugging
+            # target, and without the echo a server-minted id is
+            # unfindable
+            doc = {"error": f"{type(e).__name__}: {e}" if named
+                   else str(e), **extra}
+            if getattr(self, "_rid", None):
+                doc["request_id"] = self._rid
+            return doc
+
+        @staticmethod
+        def _mint_rid(body):
+            # the request id minted here rides every engine span/event
+            # for this request — the /trace.json timeline handle
+            rid = body.get("request_id")
+            return str(rid) if rid else uuid.uuid4().hex[:12]
 
         def _generate(self, body):
             prompt = body.get("prompt")
@@ -158,17 +196,24 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                                        "timeout") if k in body}
             wait = float(kw["timeout"]) \
                 if kw.get("timeout") is not None else default_timeout
-            fut = engine.submit(prompt, **kw)
-            self._reply(200, fut.result(timeout=wait))
+            rid = self._rid = self._mint_rid(body)
+            fut = engine.submit(prompt, trace_id=rid, **kw)
+            doc = fut.result(timeout=wait)
+            if isinstance(doc, dict):
+                doc = dict(doc, request_id=rid)
+            self._reply(200, doc)
 
         def _predict(self, body):
             if "input" not in body:
                 raise ValueError("predict needs 'input'")
             wait = float(body["timeout"]) \
                 if body.get("timeout") is not None else default_timeout
+            rid = self._rid = self._mint_rid(body)
             fut = engine.submit(body["input"],
-                                timeout=body.get("timeout"))
-            self._reply(200, _result_doc(fut.result(timeout=wait)))
+                                timeout=body.get("timeout"),
+                                trace_id=rid)
+            doc = _result_doc(fut.result(timeout=wait))
+            self._reply(200, dict(doc, request_id=rid))
 
         def log_message(self, *a):   # silence per-request stderr spam
             pass

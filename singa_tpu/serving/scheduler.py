@@ -97,14 +97,18 @@ class Request:
     """One generation request: prompt token ids + sampling config.
 
     ``rng`` is per-request (seeded) so a retried/re-ordered schedule
-    cannot change what any single request samples."""
+    cannot change what any single request samples. ``trace_id`` names
+    the request in the per-request flight-recorder trace (minted at
+    the gateway for HTTP traffic; defaults to ``req-<n>``) — every
+    span/event the engine records for this request carries it."""
 
     _ids = itertools.count(1)
 
     def __init__(self, prompt, max_new_tokens=16, temperature=0.0,
                  top_k=None, eos_id=None, seed=0, timeout=None,
-                 payload=None):
+                 payload=None, trace_id=None):
         self.id = next(Request._ids)
+        self.trace_id = str(trace_id) if trace_id else f"req-{self.id}"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1) \
             if prompt is not None else None
         self.payload = payload          # stateless-mode input array

@@ -225,6 +225,52 @@ class TestCheckpointManager:
         finally:
             mgr2.close()
 
+    def test_save_reclaims_late_appearing_wreckage(self, tmp_path):
+        """A crashed predecessor's zombie async writer can FINALIZE its
+        step directory (an atomic rename) after the successor's init
+        wreckage sweep already raced past it — orbax then refuses the
+        successor's legitimate re-save of that step ('destination
+        already exists') and the run strands. The save must apply the
+        sweep's rule lazily: reclaim the uncommitted directory and
+        retry (seen flaking in test_resilience's crash-mid-async-save
+        scenario)."""
+        from singa_tpu.checkpoint import CheckpointManager
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(7)
+        x, y = make_xy()
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=True)
+        m(tx, ty)
+        # the zombie: a manager created over the dir BEFORE the
+        # successor exists, whose step-3 save lands only later
+        zombie = CheckpointManager(tmp_path / "run",
+                                   save_interval_steps=1)
+        successor = CheckpointManager(tmp_path / "run",
+                                      save_interval_steps=1)
+        try:
+            zombie.save(3, m)
+            zombie.wait()           # the late finalize: run/3 appears
+            m(tx, ty)
+            with pytest.warns(UserWarning, match="late-appearing"):
+                successor.save(3, m, force=True)
+            successor.wait()
+            assert successor.latest_step() == 3
+            m2 = MLP()
+            m2.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+            m2.compile([tx], is_train=True, use_graph=True)
+            m2(tx, ty)
+            mgr3 = CheckpointManager(tmp_path / "run")
+            try:
+                assert mgr3.restore_latest(m2) == 4
+            finally:
+                mgr3.close()
+        finally:
+            zombie.close()
+            successor.close()
+
     def test_read_only_manager_skips_sweep(self, tmp_path):
         """sweep=False must leave another writer's uncommitted step dirs
         alone (the elastic cross-rank restore path opens dirs it does

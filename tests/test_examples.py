@@ -246,6 +246,49 @@ class TestQuantizeCheckpointTool:
         assert "selftest: OK" in proc.stdout, proc.stdout[-300:]
 
 
+class TestTraceExportTool:
+    """The Perfetto exporter's CI smoke (like metrics_dump's): a
+    synthetic recorder ring exported through the real file path,
+    Chrome-trace schema round-trip, rank rows + per-request lanes —
+    all inside the tool's own --selftest."""
+
+    def test_selftest_is_green(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "tools/trace_export.py", "--selftest"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "selftest ok" in proc.stdout, proc.stdout[-300:]
+
+    def test_converts_telemetry_spans_jsonl(self, tmp_path):
+        """End to end on REAL recorder output: a --telemetry training
+        run's spans.jsonl renders into a schema-valid trace."""
+        import json
+
+        tel = str(tmp_path / "tel")
+        run_example(["examples/train_cnn.py", "mlp", "synthetic",
+                     "--cpu", "--epochs", "1", "--iters", "2",
+                     "--bs", "8", "--telemetry", tel])
+        out = str(tmp_path / "run.trace.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+        proc = subprocess.run(
+            [sys.executable, "tools/trace_export.py",
+             os.path.join(tel, "spans.jsonl"), "-o", out],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        from singa_tpu.observability import trace_export
+        with open(out) as f:
+            doc = json.load(f)
+        trace_export.validate_chrome_trace(doc)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "step" in names and "compile" in names, names
+
+
 class TestServeGatewayExample:
     """The serving gateway smoke: engine + stdlib HTTP gateway + drain,
     end to end in one subprocess (the chaos serve-drain scenario's

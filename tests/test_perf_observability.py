@@ -1,0 +1,690 @@
+"""Continuous performance observability (observability/perf,
+observability/trace_export, and their wiring through the model, the
+resilient trainer, and the serving engine).
+
+The PR's load-bearing acceptance criteria, pinned here:
+
+- with the sampling profiler, HBM gauges, and request tracing ALL
+  enabled, ``compiled_step_info()["n_traces"]`` stays 1 across a
+  fixed-shape training loop and ≥3 serving slot refills, and the
+  measured non-sample-step overhead is bounded;
+- a forced shape change on a compiled step leaves a ``retrace`` event
+  in the flight recorder naming the argument whose signature changed
+  (old vs new shape/dtype), and compile wall-time lands in the
+  ``compile_seconds`` histogram;
+- ``trace_export`` renders a train-and-serve recorder ring into a
+  schema-valid Chrome-trace JSON in which one gateway request's
+  records (queue → prefill → decode ticks → delivery) share its
+  request id.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, layer, model, opt, tensor
+from singa_tpu.models import transformer
+from singa_tpu.observability import (export, metrics, perf, spans,
+                                     trace_export)
+from singa_tpu.resilience import FaultPlan, ResilientTrainer
+from singa_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def reg():
+    return metrics.MetricsRegistry()
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    spans.recorder().clear()
+    yield
+    spans.recorder().clear()
+    spans.recorder().detach_jsonl()
+
+
+class MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def _compiled_mlp(batch=16, seed=7):
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(seed)
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, batch)]
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=y, device=dev, requires_grad=False)
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=True)
+    return m, tx, ty
+
+
+def _batch(dev, batch):
+    rng = np.random.RandomState(1)
+    x = rng.randn(batch, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, batch)]
+    return (tensor.Tensor(data=x, device=dev, requires_grad=False),
+            tensor.Tensor(data=y, device=dev, requires_grad=False))
+
+
+# ---------------------------------------------------------------------------
+# HBM telemetry
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+        self.calls = 0
+
+    def memory_stats(self):
+        self.calls += 1
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+class TestHbm:
+    def test_stats_normalized(self):
+        d = _FakeDevice({"bytes_in_use": 10, "peak_bytes_in_use": 2**30,
+                         "bytes_limit": 2**31, "largest_alloc_size": 7,
+                         "irrelevant": "x"})
+        s = perf.hbm_stats(d)
+        assert s["bytes_in_use"] == 10
+        assert s["peak_bytes_in_use"] == 2**30
+        assert s["peak_gib"] == 1.0
+        assert s["largest_alloc_size"] == 7
+        assert "irrelevant" not in s
+
+    @pytest.mark.parametrize("dev", [
+        None, object(), _FakeDevice(None), _FakeDevice({}),
+        _FakeDevice(RuntimeError("no stats"))])
+    def test_unusable_stats_are_none(self, dev):
+        assert perf.hbm_stats(dev) is None
+
+    def test_raise_errors_keeps_the_diagnostic(self):
+        """Diagnostic callers (the HBM probe children) must see WHY the
+        read failed, not the same None a stats-less CPU produces."""
+        with pytest.raises(RuntimeError, match="driver wedged"):
+            perf.hbm_stats(_FakeDevice(RuntimeError("driver wedged")),
+                           raise_errors=True)
+        # a backend with no memory_stats attribute is still just None
+        assert perf.hbm_stats(object(), raise_errors=True) is None
+
+    def test_record_hbm_sets_gauges(self, reg):
+        d = _FakeDevice({"bytes_in_use": 100, "peak_bytes_in_use": 200,
+                         "bytes_limit": 300, "pool_bytes": 40})
+        s = perf.record_hbm(d, reg, site="train")
+        assert s["bytes_in_use"] == 100
+        assert reg.get("hbm_bytes_in_use").value(site="train") == 100
+        assert reg.get("hbm_peak_bytes_in_use").value(site="train") == 200
+        assert reg.get("hbm_bytes_limit").value(site="train") == 300
+        assert reg.get("hbm_stat_bytes").value(
+            site="train", kind="pool_bytes") == 40
+
+    def test_unavailable_device_probed_once(self, reg):
+        d = _FakeDevice(None)
+        assert perf.record_hbm(d, reg) is None
+        assert perf.record_hbm(d, reg) is None
+        assert d.calls == 1             # second call was a set lookup
+
+    def test_live_array_report_groups_by_shape(self):
+        import jax.numpy as jnp
+        keep = jnp.zeros((33, 7), jnp.float32)      # noqa: F841
+        # unbounded top: a full-suite session holds MANY bigger live
+        # arrays, and the tiny probe must still be findable
+        rep = perf.live_array_report(top=10**6)
+        assert rep is not None and rep["n_arrays"] >= 1
+        assert rep["total_bytes"] > 0
+        assert any(r["shape"] == [33, 7] and r["dtype"] == "float32"
+                   for r in rep["top"]), rep["top"][:5]
+        # JSON-able: it rides blackbox dump headers
+        json.dumps(rep)
+
+
+# ---------------------------------------------------------------------------
+# compile / retrace attribution
+# ---------------------------------------------------------------------------
+
+class TestCompileAttribution:
+    def test_signature_and_diff(self):
+        a = perf.step_signature([np.zeros((16, 8), np.float32),
+                                 np.zeros((16, 4), np.float32)])
+        b = perf.step_signature([np.zeros((12, 8), np.float32),
+                                 np.zeros((16, 4), np.float16)])
+        d = perf.diff_signatures(a, b)
+        assert d == [
+            {"arg": "arg0", "old": [[16, 8], "float32"],
+             "new": [[12, 8], "float32"]},
+            {"arg": "arg1", "old": [[16, 4], "float32"],
+             "new": [[16, 4], "float16"]}]
+        assert perf.diff_signatures(a, a) == []
+        # appearing/vanishing args are named too
+        assert perf.diff_signatures(a[:1], a)[0]["old"] is None
+
+    def test_record_compile_first_vs_retrace(self, reg):
+        sig1 = perf.step_signature([np.zeros((4, 2))])
+        perf.record_compile("p", 0.5, sig1, registry=reg)
+        sig2 = perf.step_signature([np.zeros((6, 2))])
+        perf.record_compile("p", 0.25, sig2, prev_signature=sig1,
+                            registry=reg)
+        h = reg.get("compile_seconds")
+        assert h.summary(program="p")["count"] == 2
+        names = [r["name"] for r in spans.recorder().records()]
+        assert names == ["compile", "retrace"]
+        retrace = spans.recorder().records()[-1]
+        assert retrace["changed"][0]["arg"] == "arg0"
+
+    def test_identical_signature_relower_is_not_a_retrace(self, reg):
+        sig = perf.step_signature([np.zeros((4, 2))])
+        perf.record_compile("p", 0.1, sig, prev_signature=sig,
+                            registry=reg)
+        (rec,) = spans.recorder().records()
+        assert rec["name"] == "compile"     # nothing changed: no alarm
+
+    def test_forced_shape_change_leaves_retrace_event(self):
+        """Acceptance: a forced shape change on a compiled step leaves
+        a retrace event NAMING the changed argument (old vs new
+        shape/dtype), and compile wall-time lands in the
+        compile_seconds histogram."""
+        m, tx, ty = _compiled_mlp(batch=16)
+        for _ in range(3):
+            m(tx, ty)                   # abstract first call + compiled
+        tx2, ty2 = _batch(m.dev, 12)    # forced batch-shape change
+        m(tx2, ty2)
+        recs = spans.recorder().records()
+        compiles = [r for r in recs if r["name"] == "compile"
+                    and r.get("program") == "train_step"]
+        retraces = [r for r in recs if r["name"] == "retrace"
+                    and r.get("program") == "train_step"]
+        assert compiles, recs
+        assert retraces, recs
+        (rt,) = retraces
+        changed = {c["arg"]: c for c in rt["changed"]}
+        assert changed["arg0"]["old"] == [[16, 8], "float32"]
+        assert changed["arg0"]["new"] == [[12, 8], "float32"]
+        assert changed["arg1"]["old"][0] == [16, 4]
+        assert rt["compile_s"] > 0
+        h = metrics.default_registry().get("compile_seconds")
+        assert h.summary(program="train_step")["count"] >= 2
+
+    def test_fixed_shapes_record_exactly_one_compile(self):
+        m, tx, ty = _compiled_mlp()
+        for _ in range(5):
+            m(tx, ty)
+        recs = [r for r in spans.recorder().records()
+                if r.get("program") == "train_step"]
+        assert len(recs) == 1 and recs[0]["name"] == "compile"
+        assert m.compiled_step_info()["n_traces"] == 1
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler + anomaly sentinel (unit)
+# ---------------------------------------------------------------------------
+
+class TestSamplingProfiler:
+    def test_cadence_and_force(self, reg):
+        p = perf.SamplingProfiler(every=3, registry=reg)
+        assert [s for s in range(10) if p.should_sample(s)] == [3, 6, 9]
+        off = perf.SamplingProfiler(every=0, registry=reg)
+        assert not any(off.should_sample(s) for s in range(10))
+        off.force_next()
+        assert off.should_sample(4)     # one-shot arm
+        off.record(4, {"fusion.1": (2, 0.004)})
+        assert not off.should_sample(5)
+
+    def test_record_refreshes_gauges_and_event(self, reg):
+        p = perf.SamplingProfiler(every=2, registry=reg)
+        p.record(6, {"fusion.1": (2, 0.004), "dot.2": (1, 0.001)},
+                 capture_s=0.05)
+        assert reg.get("profile_samples_total").value() == 1
+        assert reg.get("profile_last_sample_step").value() == 6
+        assert reg.get("profile_fusion_seconds").value(
+            fusion="fusion.1") == 0.004
+        assert reg.get("profile_capture_seconds").summary()["count"] == 1
+        (ev,) = spans.recorder().records()
+        assert ev["name"] == "profile.sample" and ev["step"] == 6
+        assert ev["top"][0][0] == "fusion.1"
+
+
+class TestAnomalySentinel:
+    def test_sustained_spike_fires_once(self, reg):
+        s = perf.AnomalySentinel(factor=3.0, sustain=3, warmup=5,
+                                 cooldown=10, registry=reg)
+        fired = [s.observe(i, 0.01) for i in range(20)]
+        assert not any(fired)
+        fired = [s.observe(20 + i, 0.2) for i in range(5)]
+        assert fired.count(True) == 1   # cooldown holds later spikes
+        assert reg.get("perf_anomalies_total").value() == 1
+        (ev,) = [r for r in spans.recorder().records()
+                 if r["name"] == "step_anomaly"]
+        assert ev["step_s"] == pytest.approx(0.2)
+        # the spike-clipped EMA drifts only slowly: the recorded
+        # baseline stays far below the spike it fired on
+        assert ev["baseline_s"] < 0.05
+
+    def test_single_blip_does_not_fire(self, reg):
+        s = perf.AnomalySentinel(factor=3.0, sustain=3, warmup=5,
+                                 registry=reg)
+        for i in range(20):
+            assert not s.observe(i, 0.5 if i == 12 else 0.01)
+        assert reg.get("perf_anomalies_total").value() == 0
+
+    def test_baseline_tracks_regime_change(self, reg):
+        s = perf.AnomalySentinel(factor=3.0, sustain=3, warmup=5,
+                                 cooldown=0, registry=reg)
+        for i in range(30):
+            s.observe(i, 0.01)
+        for i in range(100):
+            s.observe(30 + i, 0.02)     # legitimately slower now
+        assert reg.get("perf_step_baseline_seconds").value() == \
+            pytest.approx(0.02, rel=0.2)
+
+    def test_straggler_attribution_rides_heartbeat_aggregation(self):
+        def one(mean, count=20):
+            return {"step_time": {"count": count, "sum": mean * count,
+                                  "min": mean, "max": mean,
+                                  "mean": mean},
+                    "wire_errors": 0}
+        agg = metrics.aggregate_summaries(
+            {0: one(0.010), 1: one(0.011), 2: one(0.050), 3: one(0.012)})
+        assert agg["step_time_stragglers"] == [2]
+        # a fleet of one never names itself a straggler
+        agg1 = metrics.aggregate_summaries({0: one(0.05)})
+        assert agg1["step_time_stragglers"] == []
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring: n_traces pin, overhead bound, anomaly end-to-end
+# ---------------------------------------------------------------------------
+
+class TestTrainerWiring:
+    def test_everything_on_keeps_n_traces_at_one(self, tmp_path):
+        """Acceptance (training half): sampling profiler + HBM gauges +
+        the full telemetry bundle on, fixed shapes — the compiled step
+        traced exactly once, and profile samples actually happened."""
+        reg = metrics.default_registry()
+        before = reg.counter("profile_samples_total").value()
+        steps_before = reg.counter("train_steps_total").value()
+        hist_before = reg.histogram(
+            "train_step_seconds").summary()["count"]
+        m, tx, ty = _compiled_mlp()
+        tr = ResilientTrainer(m, str(tmp_path / "run"),
+                              save_interval_steps=2, verbose=False,
+                              profile_every=2)
+        try:
+            s = tr.run([(tx, ty)], num_steps=7)
+        finally:
+            tr.close()
+        assert s["steps_run"] == 7
+        assert m.compiled_step_info()["n_traces"] == 1
+        assert reg.counter("profile_samples_total").value() == before + 3
+        # every step counts, but the 3 PROFILED steps' inflated wall
+        # (trace dump + parse) stays OUT of the step-time series — the
+        # dashboards must not read sampling overhead as a regression
+        assert reg.counter("train_steps_total").value() == \
+            steps_before + 7
+        assert reg.histogram("train_step_seconds").summary()["count"] \
+            == hist_before + 4
+        g = reg.get("profile_fusion_seconds")
+        assert g is not None and g.to_doc()["series"], \
+            "sampling profiler recorded no fusion rows"
+        # the per-fusion samples left profile.sample events behind
+        assert any(r["name"] == "profile.sample"
+                   for r in spans.recorder().records())
+
+    def test_failed_profiled_attempt_does_not_leak_the_flag(
+            self, tmp_path):
+        """A profiled attempt that dies after arming the exclusion
+        flag must not drop the NEXT step from the step-time series:
+        the flag is cleared per attempt, and the retried sample still
+        counts."""
+        reg = metrics.default_registry()
+        hist_before = reg.histogram(
+            "train_step_seconds").summary()["count"]
+        m, tx, ty = _compiled_mlp()
+        real = m.profile_step
+        fails = {"left": 1}
+
+        def flaky(*args, **kw):
+            if fails["left"]:
+                fails["left"] -= 1
+                raise RuntimeError("transient profiler failure")
+            return real(*args, **kw)
+
+        m.profile_step = flaky
+        tr = ResilientTrainer(m, str(tmp_path / "run"),
+                              save_interval_steps=3, verbose=False,
+                              profile_every=2, step_retries=2,
+                              backoff_base=0.0)
+        try:
+            s = tr.run([(tx, ty)], num_steps=5)
+        finally:
+            tr.close()
+        assert s["steps_run"] == 5 and s["step_retries"] == 1
+        # steps 2 and 4 sampled (step 2's first attempt failed, the
+        # retry profiled again) → 3 of 5 land in the histogram
+        assert reg.histogram("train_step_seconds").summary()["count"] \
+            == hist_before + 3
+
+    def test_anomaly_sentinel_end_to_end(self, tmp_path):
+        """A sustained injected stall fires the sentinel: attributed
+        event, blackbox dump, and a one-shot profile capture on the
+        next step."""
+        reg = metrics.default_registry()
+        samples0 = reg.counter("profile_samples_total").value()
+        m, tx, ty = _compiled_mlp()
+        plan = FaultPlan()
+        for s in (10, 11, 12):
+            plan.hang_step(s, seconds=0.4)
+        tr = ResilientTrainer(m, str(tmp_path / "run"),
+                              save_interval_steps=4, verbose=False,
+                              faults=plan, anomaly_factor=3.0,
+                              anomaly_sustain=3, anomaly_warmup=4)
+        try:
+            summary = tr.run([(tx, ty)], num_steps=15)
+        finally:
+            tr.close()
+        assert summary["steps_run"] == 15
+        events = [r for r in spans.recorder().records()
+                  if r["name"] == "step_anomaly"]
+        assert events, "sentinel never fired"
+        assert events[0]["step_s"] >= 0.3
+        # the blackbox landed with the step_anomaly reason
+        bb = os.path.join(str(tmp_path / "run"), "telemetry",
+                          "blackbox-0.jsonl")
+        with open(bb) as f:
+            head = json.loads(f.readline())
+        assert head["reason"] == "step_anomaly"
+        # and the forced one-shot capture ran on a later step
+        assert reg.counter("profile_samples_total").value() > samples0
+
+    def test_crash_blackbox_carries_live_array_breakdown(self,
+                                                         tmp_path):
+        """The OOM/crash post-mortem: a step that dies past the retry
+        budget leaves a blackbox whose header names the error and the
+        live-array allocation breakdown."""
+        m, tx, ty = _compiled_mlp()
+        plan = FaultPlan().fail_step(step=3, times=10)
+        tr = ResilientTrainer(m, str(tmp_path / "run"), verbose=False,
+                              faults=plan, step_retries=1,
+                              backoff_base=0.0)
+        try:
+            with pytest.raises(Exception, match="injected step"):
+                tr.run([(tx, ty)], num_steps=6)
+        finally:
+            tr.close()
+        bb = os.path.join(str(tmp_path / "run"), "telemetry",
+                          "blackbox-0.jsonl")
+        with open(bb) as f:
+            head = json.loads(f.readline())
+        assert head["reason"] == "crash"
+        assert "injected step" in head["extra"]["error"]
+        assert head["extra"]["live_arrays"]["n_arrays"] >= 1
+
+    def test_non_sample_step_overhead_bounded(self, reg):
+        """Acceptance: the measured per-step cost of EVERYTHING this PR
+        adds to a non-sample step — the sampling check, the sentinel,
+        and the HBM probe fast path — stays far under a millisecond
+        (mirrors PR 6's instrumentation-overhead bound)."""
+        profiler = perf.SamplingProfiler(every=1000, registry=reg)
+        sentinel = perf.AnomalySentinel(factor=3.0, registry=reg)
+        no_stats_dev = object()
+        perf.record_hbm(no_stats_dev, reg)      # pay the one probe
+        n = 300
+        t0 = time.perf_counter()
+        for i in range(n):
+            profiler.should_sample(i)
+            sentinel.observe(i, 0.001)
+            perf.record_hbm(no_stats_dev, reg)
+        per_step = (time.perf_counter() - t0) / n
+        assert per_step < 500e-6, f"{per_step * 1e6:.1f} µs per step"
+
+
+# ---------------------------------------------------------------------------
+# open spans (satellite): start timestamps + in-flight spans in dumps
+# ---------------------------------------------------------------------------
+
+class TestOpenSpans:
+    def test_span_records_carry_start_timestamp(self):
+        with spans.span("step", step=1):
+            time.sleep(0.002)
+        (rec,) = spans.recorder().records()
+        assert rec["ts_start"] <= rec["ts"]
+        assert rec["ts"] - rec["ts_start"] == pytest.approx(
+            rec["dur_s"], abs=0.05)
+
+    def test_open_spans_visible_while_inside(self):
+        assert spans.open_spans() == []
+        with spans.context(rank=3):
+            with spans.span("restore", step=9):
+                (o,) = spans.open_spans()
+                assert o["kind"] == "span_open"
+                assert o["name"] == "restore" and o["step"] == 9
+                assert o["rank"] == 3       # ambient context captured
+                assert o["age_s"] >= 0
+        assert spans.open_spans() == []
+
+    def test_dump_includes_inflight_spans(self, tmp_path, reg):
+        """The satellite's contract: a blackbox written while a span is
+        still open shows what the process was INSIDE when it died."""
+        rec = spans.FlightRecorder(capacity=8)
+        s = spans.span("step", step=42)
+        s.__enter__()
+        try:
+            path = rec.dump(str(tmp_path / "bb.jsonl"), reason="hang",
+                            registry=reg)
+        finally:
+            s.__exit__(None, None, None)
+        lines = [json.loads(ln) for ln in open(path)]
+        opens = [ln for ln in lines if ln.get("kind") == "span_open"]
+        assert len(opens) == 1
+        assert opens[0]["name"] == "step" and opens[0]["step"] == 42
+        assert "ts_start" in opens[0]
+
+
+# ---------------------------------------------------------------------------
+# serving: per-request traces + refill pin + gateway /trace.json
+# ---------------------------------------------------------------------------
+
+DEV = device.create_cpu_device()
+
+
+def _tiny_engine(slots=2, **kw):
+    np.random.seed(0)
+    m = transformer.TransformerLM(19, d_model=16, n_heads=2,
+                                  n_layers=2, max_len=64, tp=False)
+    m.eval()
+    m(Tensor(data=np.zeros((1, 4), np.float32), device=DEV,
+             requires_grad=False))
+    return m.compile_serving(slots=slots, max_len=32, prefill_len=8,
+                             registry=metrics.MetricsRegistry(), **kw)
+
+
+class TestServingRequestTraces:
+    def test_refills_keep_n_traces_one_and_trace_requests(self):
+        """Acceptance (serving half): request tracing on, ≥3 slot
+        refills — the decode program still traced exactly once, and
+        every request's records (queued → prefill → decode ticks →
+        delivery) share its trace id."""
+        eng = _tiny_engine(slots=2)
+        rng = np.random.RandomState(0)
+        futs = [eng.submit(rng.randint(1, 19, (3,)),
+                           max_new_tokens=int(rng.randint(2, 5)),
+                           trace_id=f"t-{i}")
+                for i in range(8)]
+        eng.run_until_idle()
+        for f in futs:
+            f.result(timeout=5)
+        info = eng.compiled_step_info()
+        assert info["n_traces"] == 1, info
+        # 8 prompts through 2 slots = at least 6 refills
+        assert eng._reg.get("serve_prefill_total").total() == 8
+
+        recs = spans.recorder().records()
+        by_req = {}
+        for r in recs:
+            if r.get("request"):
+                by_req.setdefault(r["request"], []).append(r["name"])
+        assert set(by_req) == {f"t-{i}" for i in range(8)}
+        for rid, names in by_req.items():
+            assert names[0] == "request.queued", (rid, names)
+            assert "request.prefill" in names, (rid, names)
+            assert "request.decode_tick" in names, (rid, names)
+            assert names[-1] == "request.delivered", (rid, names)
+        # serve-program compile attribution fired once per program
+        progs = [r.get("program") for r in recs
+                 if r["name"] == "compile"]
+        assert progs.count("serve_prefill") == 1
+        assert progs.count("serve_decode") == 1
+        eng.stop()
+
+    def test_trace_requests_off_records_nothing(self):
+        eng = _tiny_engine(slots=2, trace_requests=False)
+        fut = eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.run_until_idle()
+        fut.result(timeout=5)
+        assert not any(r.get("request")
+                       for r in spans.recorder().records())
+        eng.stop()
+
+    def test_exported_ring_is_schema_valid_with_request_lanes(self):
+        """Acceptance: the train-and-serve ring renders into a
+        schema-valid Chrome trace where one request's events share a
+        tid (its timeline lane)."""
+        m, tx, ty = _compiled_mlp()
+        for _ in range(3):
+            m(tx, ty)                       # training records
+        eng = _tiny_engine(slots=2)
+        fut = eng.submit([1, 2, 3], max_new_tokens=3, trace_id="r-77")
+        eng.run_until_idle()
+        fut.result(timeout=5)
+        eng.stop()
+        doc = trace_export.validate_chrome_trace(
+            trace_export.to_chrome_trace(
+                spans.recorder().records() + spans.open_spans()))
+        evs = [e for e in doc["traceEvents"]
+               if e.get("args", {}).get("request") == "r-77"]
+        names = [e["name"] for e in evs]
+        assert "request.queued" in names
+        assert "request.decode_tick" in names
+        assert "request.delivered" in names
+        assert len({e["tid"] for e in evs}) == 1, evs
+
+    def test_gateway_mints_request_id_and_serves_trace(self):
+        """End to end through HTTP: the gateway mints the request id,
+        echoes it in the response, and /trace.json serves a
+        schema-valid Chrome trace containing that request's lane."""
+        from singa_tpu.serving import serve_gateway
+        eng = _tiny_engine(slots=2).start()
+        server, port = serve_gateway(eng)
+        try:
+            body = json.dumps({"prompt": [1, 2, 3],
+                               "max_new_tokens": 3}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            doc = json.loads(urllib.request.urlopen(
+                req, timeout=30).read())
+            rid = doc["request_id"]
+            assert rid and doc["tokens"]
+            trace = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace.json",
+                timeout=30).read())
+            trace_export.validate_chrome_trace(trace)
+            mine = [e for e in trace["traceEvents"]
+                    if e.get("args", {}).get("request") == rid]
+            assert {e["name"] for e in mine} >= {
+                "request.queued", "request.prefill",
+                "request.delivered"}
+            # the live trace closes with the metrics snapshot (fusion
+            # tables ride it), like a blackbox export would
+            assert any(e["name"] == "metrics_snapshot"
+                       for e in trace["traceEvents"])
+            # an ERROR reply still echoes the request id — the failed
+            # request's lane is the main debugging target
+            bad = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=json.dumps({"prompt": list(range(99)),
+                                 "request_id": "dbg-1"}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=30)
+                raise AssertionError("oversized prompt accepted")
+            except urllib.error.HTTPError as e:
+                err = json.loads(e.read())
+                assert e.code == 400
+                assert err["request_id"] == "dbg-1", err
+        finally:
+            server.shutdown()
+            server.server_close()
+            eng.stop()
+
+
+class TestTraceExportUnit:
+    def test_empty_input_is_valid(self):
+        doc = trace_export.to_chrome_trace([])
+        trace_export.validate_chrome_trace(doc)
+
+    def test_dump_and_metrics_land_on_the_recorder_row(self):
+        """Process-global records (dump headers, metrics snapshots)
+        must not be misattributed to whichever rank claimed the first
+        pid — they get their own named 'recorder' process row."""
+        doc = trace_export.to_chrome_trace([
+            {"kind": "span", "name": "step", "rank": 1, "ts": 10.0,
+             "ts_start": 9.9, "dur_s": 0.1},
+            {"kind": "dump", "ts": 11.0, "reason": "preempted"},
+        ])
+        (span_ev,) = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        (dump_ev,) = [e for e in doc["traceEvents"]
+                      if e["name"] == "blackbox_dump"]
+        assert dump_ev["pid"] != span_ev["pid"]
+        names = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names["recorder"] == dump_ev["pid"]
+        assert names["rank 1"] == span_ev["pid"]
+
+    def test_pre_pr9_span_without_ts_start_still_renders(self):
+        doc = trace_export.to_chrome_trace(
+            [{"kind": "span", "name": "step", "ts": 10.0,
+              "dur_s": 0.5}])
+        (ev,) = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert ev["dur"] == pytest.approx(0.5e6)
+
+    @pytest.mark.parametrize("bad, match", [
+        ({"traceEvents": "nope"}, "not a list"),
+        ({"traceEvents": [{"name": "x"}]}, "phase"),
+        ({"traceEvents": [{"ph": "X", "name": "x", "pid": 1, "tid": 0,
+                           "ts": 1.0}]}, "dur"),
+        ({"traceEvents": [{"ph": "i", "name": "x", "pid": 1, "tid": 0,
+                           "ts": -1.0}]}, "ts"),
+    ])
+    def test_validator_names_the_problem(self, bad, match):
+        with pytest.raises(ValueError, match=match):
+            trace_export.validate_chrome_trace(bad)
+
+    def test_unserializable_args_fail_at_validate(self):
+        doc = trace_export.to_chrome_trace(
+            [{"kind": "event", "name": "e", "ts": 1.0,
+              "payload": object()}])
+        with pytest.raises(ValueError, match="serializable"):
+            trace_export.validate_chrome_trace(doc)

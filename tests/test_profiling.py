@@ -191,6 +191,24 @@ class TestMeasuredFusionProfiling:
         assert out == {"fusion.12": (1, 80.0 * 1e-6)}
 
 
+def test_trace_parser_against_committed_fixture():
+    """CPU-only tier-1 coverage for parse_trace_dir against a COMMITTED
+    trace fixture (tests/data/trace_fixture): until now the parser's
+    device-lane/metadata path only ran behind a real jax.profiler
+    capture. The fixture has a device lane (preferred over the host
+    lane), repeated fusions with HLO long_name metadata (the _enrich
+    fold), a zero-duration event and a non-'X' phase (both skipped)."""
+    from singa_tpu import profiling as prof
+
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "trace_fixture")
+    out = prof.parse_trace_dir(fixture)
+    assert out == {
+        "fusion.1|convolution.3": (2, pytest.approx(150.0 * 1e-6)),
+        "dot_general.5": (1, pytest.approx(50.0 * 1e-6)),
+    }
+
+
 def test_enrich_folds_metadata_into_fusion_symbols():
     from singa_tpu.profiling import _enrich
     # device-lane fusion symbols gain their HLO long name
@@ -313,6 +331,27 @@ class TestProfileStepAPI:
                for s in g.to_doc()["series"]}
         for name, (cnt, tot) in table.items():
             assert doc[name] == tot
+
+    def test_profile_step_record_false_skips_registry(self):
+        """record=False keeps the registry untouched (the sampling
+        profiler is then the one publisher, into ITS registry) while
+        the device table still folds."""
+        from singa_tpu.observability import metrics as obs_metrics
+
+        m, dev, tx, ty = make_model(verbosity=0)
+        for _ in range(2):
+            m(tx, ty)
+        reg = obs_metrics.default_registry()
+        g = reg.get("profile_fusion_seconds")
+        before = {tuple(s["labels"].values()): s["value"]
+                  for s in g.to_doc()["series"]} if g else {}
+        _, table = m.profile_step(tx, ty, record=False)
+        assert table
+        g = reg.get("profile_fusion_seconds")
+        after = {tuple(s["labels"].values()): s["value"]
+                 for s in g.to_doc()["series"]} if g else {}
+        assert after == before          # no publish
+        assert any(k.startswith("fusion/") for k in dev.time_profiling)
 
     def test_profile_step_degrades_with_broken_profiler(
             self, monkeypatch):

@@ -294,17 +294,25 @@ def _hbm_child(which):
     for _ in range(3):
         loss = step()
     bench._force(loss.data)
+    # the shared observability HBM helper (normalized memory_stats +
+    # derived peak_gib) — the bench legs and the trainer's hbm_* gauges
+    # read the same stats through it; raise_errors keeps a misbehaving
+    # TPU runtime's actual exception in the banked error record
+    from singa_tpu.observability import perf as obs_perf
     try:
-        stats = dev.jax_device.memory_stats() or {}
-    except Exception as e:
+        stats = obs_perf.hbm_stats(dev.jax_device, raise_errors=True)
+    except Exception as e:      # noqa: BLE001 — banked, not hidden
         print(json.dumps({"hbm": which, "error": str(e)[:160]}))
         return
+    if stats is None:
+        print(json.dumps({"hbm": which,
+                          "error": "memory_stats unavailable"}))
+        return
     rec = {"hbm": which, **shape}
-    for k in ("peak_bytes_in_use", "bytes_in_use", "bytes_limit"):
+    for k in ("peak_bytes_in_use", "bytes_in_use", "bytes_limit",
+              "peak_gib"):
         if stats.get(k) is not None:
-            rec[k] = int(stats[k])
-    if rec.get("peak_bytes_in_use"):
-        rec["peak_gib"] = round(rec["peak_bytes_in_use"] / 2**30, 3)
+            rec[k] = stats[k]
     print(json.dumps(rec), flush=True)
 
 

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Flight-recorder → Chrome-trace/Perfetto exporter CLI.
+
+Renders a recorder JSONL file — a blackbox dump
+(``telemetry/blackbox-<rank>.jsonl``) or a live span sink
+(``spans.jsonl`` from ``--telemetry``) — into a ``.trace.json`` that
+opens in https://ui.perfetto.dev or ``chrome://tracing``::
+
+    python tools/trace_export.py run/telemetry/blackbox-0.jsonl
+    python tools/trace_export.py run/telemetry/spans.jsonl -o s.trace.json
+    python tools/trace_export.py --selftest            # CI gate
+
+Each rank renders as a process row; serving requests (records carrying
+the gateway-minted request id) each get their own named thread lane, so
+one request reads queue → prefill → decode ticks → delivery on one row.
+A serving gateway exports the same document live at ``GET /trace.json``.
+
+``--selftest`` (run in CI by ``tests/test_examples.py`` like the other
+tool selftests) synthesizes a train-and-serve recorder ring — training
+spans on two ranks, a retrace event, one full per-request serving
+timeline, an in-flight span, a closing metrics snapshot with a fusion
+table — exports it through the real file path, schema-validates the
+JSON round-trip, and asserts the per-request lane grouping.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _synthetic_records():
+    """A deterministic train-and-serve session's worth of records (no
+    clocks: fixed timestamps so the selftest is reproducible)."""
+    t = 1000.0
+    recs = []
+    # training: two ranks, nested spans, a retrace
+    for rank in (0, 1):
+        recs.append({"kind": "span", "name": "restore", "rank": rank,
+                     "ts_start": t, "ts": t + 0.5, "dur_s": 0.5})
+        for step in range(3):
+            s0 = t + 1 + step * 0.1
+            recs.append({"kind": "span", "name": "step", "rank": rank,
+                         "step": step, "ts_start": s0, "ts": s0 + 0.09,
+                         "dur_s": 0.09, "parent": "run"})
+    recs.append({"kind": "event", "name": "retrace", "rank": 0,
+                 "ts": t + 1.25, "program": "train_step",
+                 "compile_s": 0.8,
+                 "changed": [{"arg": "arg0", "old": [[16, 8], "float32"],
+                              "new": [[12, 8], "float32"]}]})
+    # serving: one request's full timeline + a second interleaved one
+    for rid, off in (("req-a1", 2.0), ("req-b2", 2.05)):
+        recs.append({"kind": "event", "name": "request.queued",
+                     "request": rid, "ts": t + off, "queue_depth": 1})
+        recs.append({"kind": "event", "name": "request.prefill",
+                     "request": rid, "ts": t + off + 0.01, "slot": 0,
+                     "prompt_len": 4})
+        for k in range(3):
+            recs.append({"kind": "event", "name": "request.decode_tick",
+                         "request": rid, "ts": t + off + 0.02 + k * 0.01,
+                         "slot": 0, "pos": 5 + k})
+        recs.append({"kind": "event", "name": "request.delivered",
+                     "request": rid, "ts": t + off + 0.06,
+                     "status": "completed", "tokens": 4})
+    # a span still open at dump time (the satellite's span_open shape)
+    recs.append({"kind": "span_open", "name": "checkpoint.save",
+                 "rank": 0, "ts_start": t + 3.0, "ts": t + 3.4,
+                 "age_s": 0.4, "step": 2})
+    # the snapshot a blackbox closes with, fusion table included
+    recs.append({"kind": "metrics", "ts": t + 3.5, "snapshot": {
+        "schema": "singa-tpu-metrics/1", "ts": t + 3.5, "metrics": [
+            {"name": "profile_fusion_seconds", "kind": "gauge",
+             "help": "", "labels": ["fusion"], "series": [
+                 {"labels": {"fusion": "fusion.1|convolution.3"},
+                  "value": 0.004},
+                 {"labels": {"fusion": "dot_general.5"},
+                  "value": 0.001}]}]}})
+    return recs
+
+
+def selftest():
+    from singa_tpu.observability import trace_export as te
+
+    recs = _synthetic_records()
+    with tempfile.TemporaryDirectory() as td:
+        # through the real file path: JSONL in, .trace.json out
+        src = os.path.join(td, "blackbox-0.jsonl")
+        with open(src, "w") as f:
+            f.write(json.dumps({"kind": "dump", "ts": 999.0,
+                                "reason": "selftest"}) + "\n")
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            f.write("{torn line\n")      # must be skipped, not fatal
+        out = os.path.join(td, "out.trace.json")
+        doc = te.export_records(te.records_from_jsonl(src), out)
+        with open(out) as f:
+            doc2 = json.load(f)          # JSON round-trip
+    te.validate_chrome_trace(doc2)
+    evs = doc2["traceEvents"]
+    if evs != doc["traceEvents"]:
+        raise AssertionError("trace changed across the JSON round-trip")
+
+    names = {e["name"] for e in evs}
+    for needle in ("step", "restore", "retrace", "request.queued",
+                   "request.decode_tick", "request.delivered",
+                   "checkpoint.save", "metrics_snapshot",
+                   "blackbox_dump"):
+        if needle not in names:
+            raise AssertionError(f"exported trace lost {needle!r}")
+
+    # two ranks → two named process rows
+    procs = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    if not {"rank 0", "rank 1"} <= procs:
+        raise AssertionError(f"rank process rows missing: {procs}")
+
+    # one request = one lane: every req-a1 record shares a tid, and
+    # that lane is named after the request id
+    a1 = [e for e in evs if e.get("args", {}).get("request") == "req-a1"]
+    if len(a1) != 6 or len({e["tid"] for e in a1}) != 1:
+        raise AssertionError(f"req-a1 lane broken: {a1}")
+    lanes = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    if "request req-a1" not in lanes or "request req-b2" not in lanes:
+        raise AssertionError(f"request lanes not named: {lanes}")
+
+    # the open span exports as a complete event flagged open
+    (open_ev,) = [e for e in evs if e["name"] == "checkpoint.save"]
+    if not open_ev["args"].get("open") or open_ev["dur"] <= 0:
+        raise AssertionError(f"span_open mis-rendered: {open_ev}")
+
+    # the fusion table survived into the snapshot event's args
+    (snap,) = [e for e in evs if e["name"] == "metrics_snapshot"]
+    fus = snap["args"].get("profile_fusion_seconds")
+    if not fus or fus[0][0] != "fusion.1|convolution.3":
+        raise AssertionError(f"fusion table lost: {snap['args']}")
+
+    # validator catches real breakage
+    bad = {"traceEvents": [{"ph": "X", "name": "x", "pid": 1, "tid": 0,
+                            "ts": -5, "dur": 1}]}
+    try:
+        te.validate_chrome_trace(bad)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("validator accepted a negative timestamp")
+    print("selftest ok: synthetic ring exported, chrome-trace schema "
+          "round-trip, rank rows + per-request lanes, open spans, "
+          "fusion table")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="render a flight-recorder JSONL into a Perfetto-"
+                    "openable Chrome trace")
+    ap.add_argument("recorder", nargs="?",
+                    help="recorder JSONL (blackbox-<rank>.jsonl or a "
+                         "live spans.jsonl sink)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <input>.trace.json)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="export a synthetic ring and validate the "
+                         "schema round-trip (the tier-1 CI gate)")
+    args = ap.parse_args()
+
+    if args.selftest:
+        selftest()
+        return
+    if not args.recorder:
+        ap.error("need a recorder JSONL file (or --selftest)")
+    from singa_tpu.observability import trace_export as te
+
+    records = te.records_from_jsonl(args.recorder)
+    if not records:
+        print(f"no records in {args.recorder}", file=sys.stderr)
+        raise SystemExit(2)
+    out = args.out or (args.recorder + ".trace.json")
+    doc = te.export_records(records, out)
+    spans_n = sum(1 for e in doc["traceEvents"]
+                  if e.get("ph") == "X")
+    print(f"wrote {out}: {len(doc['traceEvents'])} events "
+          f"({spans_n} spans) — open it at https://ui.perfetto.dev")
+
+
+if __name__ == "__main__":
+    main()
