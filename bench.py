@@ -181,8 +181,17 @@ def _resnet_stem():
                             "resnet_stem_ab", "conv7")
 
 
+def _compile_cache_dir():
+    return os.environ.get(
+        "BENCH_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_compile_cache"))
+
+
 def _enable_compile_cache():
-    """Persistent XLA compilation cache for the benchmark children.
+    """Persistent XLA compilation cache for the benchmark children,
+    through the ``singa_tpu.aot`` policy object (hit/miss counters +
+    LRU GC under ``BENCH_COMPILE_CACHE_BUDGET_MB``, default 2048).
 
     The observed TPU windows are short (~50 min) and the full 3-leg
     benchmark spends most of a first attempt compiling (ResNet-50 fp32 +
@@ -190,18 +199,69 @@ def _enable_compile_cache():
     full attempts died at 900s/420s on exactly this). With the cache on
     disk, a second attempt — or a later window, even after a process or
     container restart within the round — deserializes the executables
-    instead of recompiling, so the timed region starts within seconds."""
-    cache_dir = os.environ.get(
-        "BENCH_COMPILE_CACHE",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_compile_cache"))
+    instead of recompiling, so the timed region starts within seconds.
+    Every leg's banked record now carries the hit/miss delta
+    (``_compile_stats``/``_compile_delta``), so a round's BENCH json
+    shows whether its numbers were measured cold or warm."""
     try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        budget_mb = float(os.environ.get(
+            "BENCH_COMPILE_CACHE_BUDGET_MB", "2048"))
+    except ValueError:
+        budget_mb = 2048.0
+    try:
+        from singa_tpu.aot import cache as aot_cache
+        aot_cache.install(aot_cache.CachePolicy(
+            _compile_cache_dir(),
+            size_budget_bytes=int(budget_mb * (1 << 20))))
     except Exception as e:   # cache is an optimisation, never a blocker
         print(f"bench: compile cache unavailable ({e})", file=sys.stderr)
+
+
+def _compile_cache_state():
+    """\"warm\" when the bench compile cache already holds entries,
+    else \"cold\" — stamped on every probe record so the round's
+    timeout streak can be classified (see _probe_timeout_kind: the
+    probe itself never compiles; the stamp says whether the round's
+    FULL ATTEMPTS could still be compile-bound)."""
+    try:
+        from singa_tpu.aot import cache as aot_cache
+        return "warm" if aot_cache.stats(
+            _compile_cache_dir())["entries"] > 0 else "cold"
+    except Exception:   # noqa: BLE001 — classification is best-effort
+        return "cold"
+
+
+def _compile_stats():
+    """Process-wide compile telemetry snapshot: persistent-cache
+    hits/misses plus the ``compile_seconds`` histogram's count/sum —
+    diffed around each leg so its banked record shows what the leg
+    paid in compiles and whether the cache served them."""
+    out = {"cache_hits": 0, "cache_misses": 0, "compiles": 0,
+           "compile_seconds": 0.0}
+    try:
+        from singa_tpu.aot import cache as aot_cache
+        snap = aot_cache.snapshot()
+        out["cache_hits"] = snap["hits"]
+        out["cache_misses"] = snap["misses"]
+    except Exception:   # noqa: BLE001 — telemetry only
+        pass
+    try:
+        from singa_tpu.observability import metrics as _obs
+        h = _obs.default_registry().get("compile_seconds")
+        if h is not None:
+            for series in h.to_doc()["series"]:
+                out["compiles"] += int(series.get("count", 0))
+                out["compile_seconds"] += float(series.get("sum", 0.0))
+    except Exception:   # noqa: BLE001 — telemetry only
+        pass
+    return out
+
+
+def _compile_delta(before):
+    after = _compile_stats()
+    return {k: round(after[k] - before[k], 3) if isinstance(after[k],
+                                                            float)
+            else after[k] - before[k] for k in before}
 
 
 def _force(x):
@@ -335,6 +395,7 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
     ``extras`` dict, ``xla_flops_per_step`` and ``peak_hbm_bytes`` are
     recorded into it (an out-param so the 2-tuple shape external
     probes consume stays stable)."""
+    cc0 = _compile_stats()
     step = _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
                               layout=layout, stem=stem)
     loss = None
@@ -347,6 +408,7 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
     if extras is not None:
         extras["xla_flops_per_step"] = _xla_step_flops(step.model)
         extras["peak_hbm_bytes"] = _peak_hbm(dev)
+        extras["compile"] = _compile_delta(cc0)
     return batch / dt, dt * 1e3
 
 
@@ -453,6 +515,10 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     # _peak_hbm's monotonicity caveat)
     if fp32_extras.get("peak_hbm_bytes"):
         res["hbm_peak_bytes"] = fp32_extras["peak_hbm_bytes"]
+    # per-leg compile telemetry: what the leg paid in compiles and
+    # whether the persistent cache served them (cold vs warm round)
+    if fp32_extras.get("compile"):
+        res["compile"] = fp32_extras["compile"]
     _emit_partial(res, "fp32")
     # bf16 variant — POLICY-DRIVEN by default: Model.compile(
     # policy="bf16_mixed") keeps fp32 masters + dynamic loss scaling and
@@ -479,6 +545,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             if bf16_extras.get("peak_hbm_bytes"):
                 res["bf16_hbm_peak_bytes"] = \
                     bf16_extras["peak_hbm_bytes"]
+            if bf16_extras.get("compile"):
+                res["bf16_compile"] = bf16_extras["compile"]
         except TimeoutError as e:
             # the zombie leg thread may still hold the chip: stop here —
             # a later leg timed against it would bank a lie
@@ -509,6 +577,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                 lm_extras.get("tokens_per_step"), peak)
             if lm_extras.get("peak_hbm_bytes"):
                 res["lm_hbm_peak_bytes"] = lm_extras["peak_hbm_bytes"]
+            if lm_extras.get("compile"):
+                res["lm_compile"] = lm_extras["compile"]
             # what the LM leg measured: fused-CE-head or full-logits
             # path — without this marker, banked numbers from different
             # modes would read as perf changes between rounds
@@ -542,6 +612,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                 if lmb_extras.get("peak_hbm_bytes"):
                     res["lm_bf16_hbm_peak_bytes"] = \
                         lmb_extras["peak_hbm_bytes"]
+                if lmb_extras.get("compile"):
+                    res["lm_bf16_compile"] = lmb_extras["compile"]
             except TimeoutError as e:
                 res["lm_bf16_error"] = str(e)[:200]
                 res["leg_timeout"] = "lm_bf16"
@@ -599,6 +671,7 @@ def _measure_quant(dev, batch=32, image_size=224, depth=50, niters=20,
     from singa_tpu.models import resnet, transformer
 
     out = {"batch": batch, "depth": depth, "image_size": image_size}
+    cc0 = _compile_stats()
 
     # -- int8 ResNet inference img/s ------------------------------------
     model = resnet.create_model(depth=depth, num_classes=10,
@@ -666,6 +739,7 @@ def _measure_quant(dev, batch=32, image_size=224, depth=50, niters=20,
     out["serving_decode_tok_s"] = serve["decode_tok_s"]
     out["serving_p99_token_s"] = serve["p99_token_s"]
     out["hbm_peak_bytes"] = _peak_hbm(dev)
+    out["compile"] = _compile_delta(cc0)
     return out
 
 
@@ -687,6 +761,7 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
     from singa_tpu.observability import metrics as obs_metrics
     from singa_tpu.observability.export import series_quantiles
 
+    cc0 = _compile_stats()
     vocab = 512
     model = transformer.TransformerLM(vocab, d_model=128, n_heads=4,
                                       n_layers=2, max_len=max_len,
@@ -751,6 +826,7 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
         "n_requests": n_requests,
         "policy": str(policy) if policy is not None else None,
         "hbm_peak_bytes": _peak_hbm(dev),
+        "compile": _compile_delta(cc0),
     }
 
 
@@ -797,6 +873,7 @@ def _setup_lm_step(dev, batch=8, seq=None, compute_dtype=None):
 def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
                 compute_dtype=None, extras=None):
     seq = seq or LM_SHAPE["seq"]
+    cc0 = _compile_stats()
     step = _setup_lm_step(dev, batch=batch, seq=seq,
                           compute_dtype=compute_dtype)
     loss = None
@@ -810,6 +887,7 @@ def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
         extras["xla_flops_per_step"] = _xla_step_flops(step.model)
         extras["tokens_per_step"] = batch * seq
         extras["peak_hbm_bytes"] = _peak_hbm(dev)
+        extras["compile"] = _compile_delta(cc0)
     return batch * seq / dt
 
 
@@ -1173,6 +1251,38 @@ def _dead_probe_streak():
     return n
 
 
+def _probe_timeout_kind():
+    """Classify the trailing probe-timeout streak for the round
+    report. The probe child itself runs only ``jax.devices()`` —
+    backend init, zero XLA compiles — so a probe timeout is never
+    compile time; the ambiguity the stamp resolves is what the
+    ROUND's timeouts mean: ``dead_tunnel`` when any timeout in the
+    streak ran against a WARM cache (the round's expensive work — the
+    full benchmark attempts whose compiles historically blew their
+    budgets — cannot be compile-bound either, so a backend that still
+    cannot even init is down, full stop); ``cold_compile_possible``
+    when every timeout ran cold — the probe wasn't compiling, but the
+    round's full attempts may have been, so the banked round numbers
+    (and any attempt-timeout records beside this streak) carry the
+    cold-compile caveat. Once the cache is warm, every future timeout
+    is diagnostic — which is how the warm cache retires BENCH_r05's
+    73-timeout class of ambiguous rounds."""
+    any_warm = False
+    any_cold = False
+    for o in reversed(_load_obs()):
+        if o.get("event") != "probe":
+            continue
+        if o.get("status") != "timeout":
+            break
+        if o.get("compile_cache") == "warm":
+            any_warm = True
+        else:
+            any_cold = True
+    if any_warm or not any_cold:
+        return "dead_tunnel"
+    return "cold_compile_possible"
+
+
 def _probe_cooldown():
     """Dead-tunnel fast-fail: BENCH_r05 burned ~11.5h of round budget on
     73 consecutive probe timeouts — every cycle paid the full 120–180s
@@ -1205,25 +1315,33 @@ def _tpu_phase(errors):
     smoke = []
     streak = _probe_cooldown()
     if streak:
+        kind = _probe_timeout_kind()
         _record_obs("probe_cooldown",
-                    {"consecutive_timeouts": streak, "src": "bench"})
+                    {"consecutive_timeouts": streak, "kind": kind,
+                     "src": "bench"})
         errors.append(
             f"tpu probe skipped: {streak} consecutive probe timeouts "
-            "banked this round (dead tunnel; BENCH_FORCE_PROBE=1 to "
+            f"banked this round ({kind}; BENCH_FORCE_PROBE=1 to "
             "re-probe)")
         return None, []
     # a hung backend init must not eat the whole time budget: probe first
     # (generous enough for a slow cold start), and only run the real
     # benchmark when a chip is actually visible
+    cache_state = _compile_cache_state()
     status, perr = _probe_tpu(180)
-    _record_obs("probe", {"status": status, "err": perr, "src": "bench"})
+    _record_obs("probe", {"status": status, "err": perr, "src": "bench",
+                          "compile_cache": cache_state})
     if status != "ok":
         errors.append(f"tpu probe#1: {perr}")
         print(f"bench: tpu probe failed ({perr}), retrying",
               file=sys.stderr)
         time.sleep(10)
         status, perr = _probe_tpu(180)
-        _record_obs("probe", {"status": status, "err": perr, "src": "bench"})
+        # re-sampled: probe #1's child may have warmed the cache
+        # before dying, and a stale "cold" stamp here would soften
+        # the dead-tunnel classification
+        _record_obs("probe", {"status": status, "err": perr, "src": "bench",
+                              "compile_cache": _compile_cache_state()})
         if status != "ok":
             errors.append(f"tpu probe#2: {perr}")
     if status == "ok":

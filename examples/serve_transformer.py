@@ -80,6 +80,13 @@ def main():
     ap.add_argument("--prefill-len", type=int, default=16)
     ap.add_argument("--policy", default=None,
                     help="mixed-precision policy name (e.g. bf16_mixed)")
+    ap.add_argument("--aot-dir", default=None, metavar="DIR",
+                    help="cold-start elimination (singa_tpu.aot): "
+                         "deserialize matching prefill/decode "
+                         "executables from DIR instead of tracing "
+                         "(persistent compile cache under "
+                         "DIR/xla-cache); programs compiled fresh are "
+                         "exported back so the NEXT spin-up is warm")
     ap.add_argument("--selftest", type=int, default=0, metavar="N",
                     help="fire N requests at the own gateway, verify, "
                          "exit 0")
@@ -107,9 +114,27 @@ def main():
         data=np.zeros((1, args.prefill_len), np.float32), device=dev,
         requires_grad=False))
 
+    serve_kw = {}
+    if args.aot_dir:
+        from singa_tpu.aot import cache as aot_cache
+        serve_kw["aot_store"] = args.aot_dir
+        serve_kw["compile_cache"] = aot_cache.cache_dir_for(
+            args.aot_dir)
     engine = model.compile_serving(
         slots=args.slots, max_len=args.max_len,
-        prefill_len=args.prefill_len, policy=args.policy)
+        prefill_len=args.prefill_len, policy=args.policy, **serve_kw)
+    if args.aot_dir:
+        src = dict(engine.compiled_step_info()["aot"] or {})
+        if not src or any(v != "loaded" for v in src.values()):
+            # cold spin-up: leave warm artifacts behind for the next
+            # replica (the chaos warm-restart scenario's populate
+            # leg); export_aot refreshes the engine's audit state, so
+            # /healthz and /aot.json report "exported" too
+            engine.export_aot()
+            src = dict(engine.compiled_step_info()["aot"] or {})
+        print("AOT " + " ".join(
+            f"{p.split('serve_', 1)[-1]}={v}"
+            for p, v in sorted(src.items())), flush=True)
     replica = ServingReplica(engine, name=f"serve-{args.port}")
     replica.install_signal_handlers()
     replica.start()

@@ -146,7 +146,8 @@ def run_rank(args):
         fingerprint_every=args.fingerprint_every,
         max_divergence_rollbacks=args.max_divergence_rollbacks,
         manifest_extra={"per_replica_batch": per_bs,
-                        "global_batch": global_bs})
+                        "global_batch": global_bs},
+        aot=args.aot_dir or None)
 
     if args.dump_restored:
         # bit-identity probe: what does the last COMMITTED checkpoint
@@ -206,6 +207,11 @@ def main():
                     help="this process's rank; omit to spawn all ranks")
     ap.add_argument("--coordinator", default=None,
                     help="host:port of rank 0's cluster listener")
+    ap.add_argument("--aot-dir", default="",
+                    help="cold-start elimination (singa_tpu.aot): "
+                         "persistent compile cache + exported train-"
+                         "step executable under this dir; a restart "
+                         "deserializes instead of retracing")
     ap.add_argument("--hb-interval", type=float, default=0.25)
     ap.add_argument("--dead-after", type=float, default=2.5)
     ap.add_argument("--commit-timeout", type=float, default=30.0)

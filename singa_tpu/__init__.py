@@ -35,7 +35,7 @@ from .mixed_precision import Policy  # noqa: F401
 _LAZY = ("sonnx", "io", "data", "datasets", "image_tool", "net",
          "snapshot", "native", "channel", "caffe", "network",
          "checkpoint", "profiling", "resilience", "observability",
-         "serving")
+         "serving", "aot")
 
 
 def __getattr__(name):

@@ -1,0 +1,324 @@
+"""The persistent-compile-cache policy: one object, process-wide.
+
+JAX's persistent compilation cache turns a recompile of an
+already-seen program into a disk read, but its raw form is a scatter
+of config flags with no observability and no size control. This module
+fronts it with ONE policy object:
+
+    from singa_tpu import aot
+    aot.install(aot.CachePolicy("/ckpts/aot/xla-cache",
+                                size_budget_bytes=2 << 30))
+
+or, through the surfaces that compile:
+``Model.compile(inputs, compile_cache=policy_or_dir)`` /
+``Model.compile_serving(compile_cache=...)``.
+
+What installing buys beyond the raw flags:
+
+- **hit/miss counters** — a process-wide ``jax.monitoring`` listener
+  counts cache hits and misses into
+  ``compile_cache_hits_total`` / ``compile_cache_misses_total`` on the
+  metrics registry (and a host-side snapshot for cheap deltas), so
+  every traced dispatch can label its ``compile_seconds`` observation
+  ``source="cache"`` or ``source="fresh"``
+  (:func:`classify`) — the cold-start win is a dashboard fact, not an
+  inference from wall clocks;
+- **size budget with LRU GC** — :func:`gc` prunes the cache directory
+  least-recently-used-first down to ``size_budget_bytes`` (JAX writes
+  an ``-atime`` companion per entry exactly for this), run at install
+  and on demand (``tools/aot_cache.py gc``);
+- **enable/disable** — one switch, not four flags.
+
+Everything here is host-side and best-effort: a cache that cannot be
+installed degrades to fresh compiles with a warning, never a failed
+``compile``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+
+from ..observability import metrics as _metrics
+
+# jax.monitoring event names (stable across the jax versions we
+# support; unknown names simply never fire)
+_EVT_HIT = "/jax/compilation_cache/cache_hits"
+_EVT_MISS = "/jax/compilation_cache/cache_misses"
+
+_LOCK = threading.Lock()
+_ACTIVE = None                    # the installed CachePolicy (or None)
+_LISTENING = False
+# monotonically-increasing host counters the listener feeds; snapshot()
+# hands out copies so dispatch sites can diff around a call
+_COUNTS = {"hits": 0, "misses": 0}
+
+
+class CachePolicy:
+    """Persistent-compile-cache configuration (see module docstring).
+
+    - ``directory``: where XLA executables persist.
+    - ``enabled``: False turns the cache OFF at install (the one-switch
+      opt-out).
+    - ``size_budget_bytes``: LRU GC target; None = unbounded.
+    - ``min_compile_seconds`` / ``min_entry_bytes``: JAX's write
+      thresholds. The defaults (0 / -1) cache EVERYTHING including
+      tiny CPU programs — cold-start elimination wants the whole
+      program set warm, not just the expensive tail.
+    """
+
+    def __init__(self, directory, *, enabled=True,
+                 size_budget_bytes=None, min_compile_seconds=0.0,
+                 min_entry_bytes=-1):
+        self.directory = os.path.abspath(str(directory))
+        self.enabled = bool(enabled)
+        self.size_budget_bytes = None if size_budget_bytes is None \
+            else int(size_budget_bytes)
+        self.min_compile_seconds = float(min_compile_seconds)
+        self.min_entry_bytes = int(min_entry_bytes)
+
+    def describe(self):
+        return {"directory": self.directory, "enabled": self.enabled,
+                "size_budget_bytes": self.size_budget_bytes,
+                "min_compile_seconds": self.min_compile_seconds,
+                "min_entry_bytes": self.min_entry_bytes}
+
+    def __repr__(self):
+        return f"CachePolicy({self.describe()!r})"
+
+
+def _listener(event, **kw):
+    """jax.monitoring event listener — must NEVER raise into jax."""
+    try:
+        if event == _EVT_HIT:
+            _COUNTS["hits"] += 1
+            _metrics.default_registry().counter(
+                "compile_cache_hits_total",
+                "XLA compiles served from the persistent cache").inc()
+        elif event == _EVT_MISS:
+            _COUNTS["misses"] += 1
+            _metrics.default_registry().counter(
+                "compile_cache_misses_total",
+                "XLA compiles the persistent cache could not serve"
+            ).inc()
+    except Exception:       # noqa: BLE001 — telemetry must stay silent
+        pass
+
+
+def _ensure_listener():
+    global _LISTENING
+    with _LOCK:
+        if _LISTENING:
+            return
+        try:
+            try:        # public surface first; private path for jax
+                from jax import monitoring  # versions that lack it
+            except ImportError:
+                from jax._src import monitoring
+            monitoring.register_event_listener(_listener)
+            _LISTENING = True
+        except Exception as e:      # noqa: BLE001 — counters degrade
+            warnings.warn(
+                f"compile-cache hit/miss counters unavailable "
+                f"({type(e).__name__}: {e}); compile_seconds will "
+                "label every compile source=fresh", stacklevel=3)
+
+
+def resolve(policy):
+    """Coerce a user-facing ``compile_cache=`` value to a
+    :class:`CachePolicy`: a policy passes through, a path string/
+    PathLike becomes an enabled policy over it, ``False`` a disabled
+    one over the default directory."""
+    if isinstance(policy, CachePolicy):
+        return policy
+    if policy is False:
+        return CachePolicy(default_dir(), enabled=False)
+    if policy is True:
+        return CachePolicy(default_dir())
+    return CachePolicy(os.fspath(policy))
+
+
+def default_dir():
+    return os.path.join(os.path.expanduser("~"), ".cache", "singa_tpu",
+                        "xla-cache")
+
+
+def cache_dir_for(aot_dir):
+    """The ONE definition of where the persistent compile cache lives
+    inside an ``aot/`` sidecar directory — the trainer, the serving
+    example, and the CLI all route through it so the layout can never
+    split the warm cache across divergent conventions."""
+    return os.path.join(os.path.abspath(str(aot_dir)), "xla-cache")
+
+
+def install(policy):
+    """Install ``policy`` (a :class:`CachePolicy`, a directory, True
+    for the default directory, or False to disable) process-wide:
+    configure jax's persistent compilation cache, register the
+    hit/miss listener, and GC down to the size budget. Returns the
+    active policy. Never raises — a cache that cannot install degrades
+    to fresh compiles, loudly."""
+    global _ACTIVE
+    pol = resolve(policy)
+    try:
+        import jax
+        if pol.enabled:
+            os.makedirs(pol.directory, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", pol.directory)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              pol.min_compile_seconds)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              pol.min_entry_bytes)
+            # the config flag alone is only consulted when jax first
+            # checks its cache machinery — a process that already
+            # compiled something has memoized "no cache" for the whole
+            # task (is_cache_used's once-per-task check). reset_cache
+            # drops that memo so installing mid-process works too.
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+            _ensure_listener()
+            if pol.size_budget_bytes is not None:
+                gc(pol)
+        else:
+            jax.config.update("jax_compilation_cache_dir", None)
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+    except Exception as e:      # noqa: BLE001 — optimisation, not a gate
+        warnings.warn(
+            f"persistent compile cache unavailable "
+            f"({type(e).__name__}: {e}); compiles run fresh",
+            stacklevel=2)
+        return _ACTIVE
+    _ACTIVE = pol
+    return pol
+
+
+def active():
+    """The installed :class:`CachePolicy`, or None."""
+    return _ACTIVE
+
+
+def uninstall():
+    """Turn the persistent cache back off (tests, or a one-shot tool
+    that must not leave process-global config behind). The hit/miss
+    listener stays registered — with no cache configured it simply
+    never fires again."""
+    global _ACTIVE
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:       # noqa: BLE001 — symmetric with install
+        pass
+    _ACTIVE = None
+
+
+def snapshot():
+    """Copy of the host-side hit/miss counters — take one BEFORE a
+    dispatch that may compile, then :func:`classify` after."""
+    return dict(_COUNTS)
+
+
+def classify(before):
+    """Label the compile(s) that happened since ``before`` (a
+    :func:`snapshot`): ``"cache"`` when every new compilation was
+    served from the persistent cache, ``"fresh"`` otherwise —
+    including when no cache is installed (no events fire, so nothing
+    can prove a hit)."""
+    hits = _COUNTS["hits"] - before.get("hits", 0)
+    misses = _COUNTS["misses"] - before.get("misses", 0)
+    return "cache" if hits > 0 and misses == 0 else "fresh"
+
+
+def stats(directory=None):
+    """{entries, bytes} of a cache directory (the active policy's when
+    None). Missing directory counts as empty."""
+    d = directory if directory is not None else \
+        (_ACTIVE.directory if _ACTIVE is not None else default_dir())
+    entries = 0
+    total = 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        names = []
+    for n in names:
+        path = os.path.join(d, n)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        total += size
+        if n.endswith("-cache"):
+            entries += 1
+    return {"directory": os.path.abspath(str(d)), "entries": entries,
+            "bytes": total}
+
+
+def gc(policy=None, *, budget_bytes=None):
+    """LRU garbage collection: delete least-recently-used cache
+    entries until the directory fits the budget (the policy's
+    ``size_budget_bytes`` unless overridden). Recency comes from each
+    entry's ``-atime`` companion file (written by jax on every cache
+    read precisely so external GC can be LRU); an entry without one
+    falls back to the cache file's own mtime. Returns a report dict;
+    never raises."""
+    pol = policy if policy is not None else _ACTIVE
+    if pol is None and budget_bytes is None:
+        return {"removed": 0, "bytes_freed": 0, "entries": 0,
+                "bytes": 0}
+    directory = pol.directory if pol is not None else default_dir()
+    budget = budget_bytes if budget_bytes is not None \
+        else getattr(pol, "size_budget_bytes", None)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return {"removed": 0, "bytes_freed": 0, "entries": 0,
+                "bytes": 0}
+    entries = []        # (last_use, total_bytes, [paths])
+    total = 0
+    for n in names:
+        if not n.endswith("-cache"):
+            continue
+        path = os.path.join(directory, n)
+        atime_path = os.path.join(directory, n[:-len("-cache")]
+                                  + "-atime")
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        try:
+            last_use = os.path.getmtime(atime_path)
+            size += os.path.getsize(atime_path)
+        except OSError:
+            atime_path = None
+            last_use = os.path.getmtime(path)
+        total += size
+        entries.append((last_use, size, [p for p in (path, atime_path)
+                                         if p]))
+    removed = 0
+    freed = 0
+    if budget is not None:
+        entries.sort()                      # oldest last-use first
+        over = total - int(budget)
+        for _t, size, paths in entries:
+            if over <= 0:
+                break
+            for p in paths:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            over -= size
+            freed += size
+            removed += 1
+    return {"removed": removed, "bytes_freed": freed,
+            "entries": len(entries) - removed, "bytes": total - freed}
+
+
+__all__ = ["CachePolicy", "install", "active", "resolve", "snapshot",
+           "classify", "stats", "gc", "default_dir", "cache_dir_for"]

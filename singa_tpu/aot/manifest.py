@@ -1,0 +1,207 @@
+"""AOT-artifact manifests: the honored-or-refused contract.
+
+A serialized executable is opaque bytes compiled for ONE world: a
+specific jax/jaxlib pair, backend and topology, argument avals and
+donation layout, and (through the traced body) a specific precision/
+quantization policy. Running it anywhere else is not a slow path — it
+is a silently wrong program. So every artifact an
+:class:`~singa_tpu.aot.export.AotStore` writes carries a manifest
+recording all of those axes plus a ``crc32`` content digest
+(:func:`singa_tpu.integrity.bytes_digest` — the same tagged-digest
+discipline as the checkpoint sidecars), and every load runs
+:func:`verify` BEFORE deserialization.
+
+:func:`verify` raises a typed :class:`AotMismatch` whose ``reason``
+names the FIRST failed axis (``digest`` / ``version`` / ``backend`` /
+``topology`` / ``avals`` / ``donation`` / ``policy`` / ``signature`` /
+``format`` / ``missing``) and whose message carries recorded-vs-live —
+the loud refusal the fallback-and-recompile path and the quarantine
+are driven by. There is no partial acceptance: an artifact is honored
+whole or refused whole.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from ..integrity import bytes_digest
+
+MANIFEST_VERSION = 1
+
+# every refusal names one of these axes (tests pin the vocabulary)
+REASONS = ("missing", "format", "digest", "version", "backend",
+           "topology", "avals", "donation", "policy", "signature")
+
+
+class AotMismatch(RuntimeError):
+    """An AOT artifact was refused: the manifest does not match the
+    live world (or the bytes do not match the manifest). ``reason``
+    is one of :data:`REASONS`; the message carries recorded vs live.
+    The contract: the caller falls back to a LOUD fresh compile and
+    quarantines the artifact — a refused program never executes."""
+
+    def __init__(self, reason, detail):
+        assert reason in REASONS, reason
+        self.reason = reason
+        super().__init__(f"AOT artifact refused ({reason}): {detail}")
+
+
+def environment_stamp(jax_device=None):
+    """The world this process compiles for: jax/jaxlib versions plus
+    backend platform, device kind, and addressable device count of
+    ``jax_device``'s platform (the default backend's when None)."""
+    import jax
+    import jaxlib
+    if jax_device is None:
+        devices = jax.devices()
+        jax_device = devices[0]
+    else:
+        devices = jax.devices(jax_device.platform)
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "platform": str(jax_device.platform),
+            "device_kind": str(getattr(jax_device, "device_kind", "?")),
+            "n_devices": len(devices)}
+
+
+def aval_signature(avals):
+    """JSON-able shape/dtype signature of an argument pytree (concrete
+    arrays or ``ShapeDtypeStruct``s): ``[[dims...], dtype]`` per leaf,
+    plus the treedef string — what :func:`verify` compares against the
+    live call signature. Shardings are deliberately NOT recorded:
+    single-device artifacts are the supported scope (mesh-sharded
+    programs ride the persistent compile cache instead), and the
+    topology axis already pins the device count."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(avals)
+    return {"leaves": [[[int(d) for d in np.shape(a)],
+                        str(getattr(a, "dtype", type(a).__name__))]
+                       for a in leaves],
+            "treedef": str(treedef)}
+
+
+def _policy_stamp(policy):
+    if policy is None:
+        return None
+    desc = getattr(policy, "describe", None)
+    return dict(desc()) if callable(desc) else dict(policy)
+
+
+def build(program, payload, *, avals, donate_argnums=(), policy=None,
+          jax_device=None, extra=None):
+    """Manifest dict for one artifact: identity (program name, format
+    version), environment stamp, call contract (avals + donation +
+    policy), and the content digest over exactly the bytes that will
+    sit on disk."""
+    doc = {
+        "format": MANIFEST_VERSION,
+        "program": str(program),
+        "digest": bytes_digest(payload),
+        "env": environment_stamp(jax_device),
+        "avals": aval_signature(avals),
+        "donation": sorted(int(i) for i in donate_argnums),
+        "policy": _policy_stamp(policy),
+        "created_at": time.time(),
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def verify(manifest, *, payload=None, avals=None, donate_argnums=None,
+           policy=None, jax_device=None, expect_extra=None):
+    """Check a manifest against the live world; raise
+    :class:`AotMismatch` naming the first failed axis. Any axis whose
+    live value is not supplied is skipped (callers verify what they
+    know). ``expect_extra`` maps manifest keys to required values —
+    the program-specific contract (e.g. the train step's static-arg
+    layout, a serving engine's geometry); a mismatch there is reason
+    ``signature``."""
+    if not isinstance(manifest, dict) or "digest" not in manifest:
+        raise AotMismatch("format", "manifest is not a digest-bearing "
+                          "mapping")
+    if manifest.get("format") != MANIFEST_VERSION:
+        raise AotMismatch(
+            "format", f"manifest format {manifest.get('format')!r}, "
+            f"this build reads {MANIFEST_VERSION}")
+    env = manifest.get("env") or {}
+    live_env = environment_stamp(jax_device)
+    for k, reason in (("jax", "version"), ("jaxlib", "version"),
+                      ("platform", "backend"),
+                      ("device_kind", "backend"),
+                      ("n_devices", "topology")):
+        if env.get(k) != live_env[k]:
+            raise AotMismatch(
+                reason, f"{k}: artifact recorded {env.get(k)!r}, "
+                f"this process is {live_env[k]!r}")
+    if payload is not None:
+        got = bytes_digest(payload)
+        if got != manifest["digest"]:
+            raise AotMismatch(
+                "digest", f"artifact bytes digest {got} != recorded "
+                f"{manifest['digest']} — corrupt on disk (crc32 "
+                "detects rot, not an adversary: see the trust-"
+                "boundary note in singa_tpu/aot/export.py)")
+    if avals is not None:
+        live = aval_signature(avals)
+        want = manifest.get("avals") or {}
+        if want.get("leaves") != live["leaves"] or \
+                want.get("treedef") != live["treedef"]:
+            raise AotMismatch(
+                "avals", f"call signature changed: artifact recorded "
+                f"{want.get('leaves')}, live is {live['leaves']}")
+    if donate_argnums is not None:
+        want = manifest.get("donation")
+        live_d = sorted(int(i) for i in donate_argnums)
+        if want != live_d:
+            raise AotMismatch(
+                "donation", f"donation layout changed: artifact "
+                f"recorded {want}, live is {live_d}")
+    if policy is not None or manifest.get("policy") is not None:
+        live_p = _policy_stamp(policy)
+        if manifest.get("policy") != live_p:
+            raise AotMismatch(
+                "policy", f"precision/quant policy changed: artifact "
+                f"recorded {manifest.get('policy')}, live is {live_p}")
+    for k, want in (expect_extra or {}).items():
+        if manifest.get(k) != want:
+            raise AotMismatch(
+                "signature", f"{k}: artifact recorded "
+                f"{manifest.get(k)!r}, live expects {want!r}")
+    return manifest
+
+
+def write(path, doc):
+    """Atomic manifest write (tmp + rename — a torn manifest must read
+    as missing, never as a half-truth)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read(path):
+    """Manifest dict; raises :class:`AotMismatch` with reason
+    ``missing`` (no file) or ``format`` (unparseable)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError:
+        raise AotMismatch("missing", f"no manifest at {path}") from None
+    except ValueError as e:
+        raise AotMismatch("format",
+                          f"manifest {path} is unparseable ({e})") \
+            from None
+    if not isinstance(doc, dict):
+        raise AotMismatch("format", f"manifest {path} is not a mapping")
+    return doc
+
+
+__all__ = ["MANIFEST_VERSION", "REASONS", "AotMismatch",
+           "environment_stamp", "aval_signature", "build", "verify",
+           "write", "read"]

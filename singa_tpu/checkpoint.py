@@ -522,25 +522,39 @@ class CheckpointManager:
                 int(step), args=self._ocp.args.StandardSave(arrays),
                 force=force)
         except ValueError:
-            # a crashed predecessor's zombie async writer can finalize
-            # its step dir (a rename) AFTER _sweep_uncommitted's rmtree
-            # raced past it at init — orbax then refuses our re-save of
-            # the step a restore legitimately re-ran. Detected
-            # STRUCTURALLY (a step dir on disk that this manager never
-            # owned — not orbax's error text, which is unpinned): apply
-            # the sweep's rule lazily and retry once; an unrelated
-            # ValueError recurs on the retry and propagates.
+            # a crashed predecessor's zombie orbax machinery can still
+            # mutate the directory after our init raced past it:
+            # (a) its async writer FINALIZES its step dir (a rename)
+            # after _sweep_uncommitted's rmtree — orbax then refuses
+            # our re-save of the step a restore legitimately re-ran —
+            # detected STRUCTURALLY (a step dir on disk this manager
+            # never owned; not orbax's error text, which is unpinned);
+            # (b) its ROTATION deletes an old step this manager had
+            # already cached in its step list — orbax's next
+            # should-remove scan then raises on the vanished dir.
+            # Both recover the same way: reconcile with the on-disk
+            # state (drop the foreign dir for (a), rebuild the manager
+            # either way) and retry ONCE; an unrelated ValueError
+            # recurs on the retry and propagates.
             path = os.path.join(self._dir, str(int(step)))
-            if not os.path.isdir(path) or \
-                    int(step) in self._known_steps:
-                raise
-            import shutil
-            warnings.warn(
-                f"removing late-appearing uncommitted checkpoint "
-                f"wreckage {path} (a previous writer's async save "
-                "finalized after the init sweep)", stacklevel=2)
-            shutil.rmtree(path, ignore_errors=True)
+            if os.path.isdir(path) and \
+                    int(step) not in self._known_steps:
+                import shutil
+                warnings.warn(
+                    f"removing late-appearing uncommitted checkpoint "
+                    f"wreckage {path} (a previous writer's async save "
+                    "finalized after the init sweep)", stacklevel=2)
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                warnings.warn(
+                    f"checkpoint save of step {step} tripped on stale "
+                    "step bookkeeping (a previous writer's rotation "
+                    "deleted a step this manager had cached?); "
+                    "rebuilding from on-disk state and retrying once",
+                    stacklevel=2)
             self._reopen()
+            self._known_steps &= {int(s)
+                                  for s in self._mgr.all_steps()}
             saved = self._mgr.save(
                 int(step), args=self._ocp.args.StandardSave(arrays),
                 force=force)
@@ -773,10 +787,22 @@ class CheckpointManager:
                 report[step] = "corrupt"
             else:
                 report[step] = "ok"
+        # the aot/ sidecar (exported compiled executables,
+        # singa_tpu.aot) is integrity-covered bytes like any other:
+        # each artifact re-verifies against its manifest digest, and
+        # delete=True QUARANTINES (not rmtree's) the bad ones — the
+        # store's own demotion discipline
+        aot_dir = os.path.join(self._dir, "aot")
+        if os.path.isdir(aot_dir):
+            from .aot.export import AotStore
+            aot_report = AotStore(aot_dir).scrub(delete=delete)
+            for prog, status in aot_report.items():
+                report[f"aot/{prog}"] = status
         if delete:
             import shutil
             demoted = [s for s, st in report.items()
-                       if st in ("corrupt", "unreadable")]
+                       if st in ("corrupt", "unreadable")
+                       and not isinstance(s, str)]   # aot: quarantined
             for s in demoted:
                 shutil.rmtree(os.path.join(self._dir, str(s)),
                               ignore_errors=True)

@@ -87,6 +87,14 @@ def _raw_buffer(arr):
         return arr.tobytes()
 
 
+def bytes_digest(data) -> str:
+    """Tagged content digest of a raw byte blob — the AOT-artifact
+    form (``singa_tpu/aot``): serialized executables are opaque bytes,
+    so the digest covers exactly what sits on disk."""
+    data = bytes(data)
+    return f"{DIGEST_ALGO}:{crc32(data):08x}:{len(data)}"
+
+
 def tensor_digest(arr) -> str:
     """Tagged content digest of an array: dtype + shape + raw bytes.
     Covering dtype/shape means a truncated-and-reshaped or silently
@@ -260,8 +268,8 @@ def replica_buffer_mismatches(arrays: dict) -> dict:
 
 __all__ = [
     "IntegrityError", "DIGEST_ALGO", "WIRE_MAGIC", "WIRE_VERSION",
-    "MAX_MESSAGE_BYTES", "crc32", "tensor_digest", "data_state_digest",
-    "record_digest",
+    "MAX_MESSAGE_BYTES", "crc32", "bytes_digest", "tensor_digest",
+    "data_state_digest", "record_digest",
     "digest_tree", "manifest_digest", "verify_tree",
     "write_digest_sidecar", "read_digest_sidecar", "seal_frame",
     "open_frame", "state_fingerprint", "replica_buffer_mismatches",

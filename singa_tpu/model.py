@@ -45,6 +45,20 @@ from .autograd_base import CTX
 from . import device as device_mod
 
 
+def _aot_cache_snapshot():
+    """Persistent-compile-cache hit/miss counters BEFORE a dispatch
+    that may trace — two dict reads through a cached module ref, so the
+    steady-state step path pays nothing measurable."""
+    global _aot_cache_mod
+    if _aot_cache_mod is None:
+        from .aot import cache
+        _aot_cache_mod = cache
+    return _aot_cache_mod.snapshot()
+
+
+_aot_cache_mod = None
+
+
 class _TensorSlot:
     """Marker for a traced-tensor position in a step-arg layout (distinct
     from a static ``None`` arg such as the default ``spars``)."""
@@ -288,7 +302,7 @@ class Model(Layer):
 
     # -- compile -----------------------------------------------------------
     def compile(self, inputs, is_train=True, use_graph=False,
-                sequential=False, policy=None):
+                sequential=False, policy=None, compile_cache=None):
         """Shape-infer via a dry forward run (reference model.py:156-184),
         decide graph (jit) mode, and detect a distributed optimizer.
 
@@ -303,10 +317,23 @@ class Model(Layer):
         paired with dynamic loss scaling by default: a plain optimizer
         is wrapped in ``resilience.GuardedOptimizer`` here (pass
         ``Policy(name, loss_scaling=False)`` or pre-wrap yourself to
-        opt out)."""
+        opt out).
+
+        ``compile_cache``: a :class:`singa_tpu.aot.CachePolicy` (or a
+        cache directory, or True for the default directory) installing
+        JAX's persistent compilation cache process-wide, so a restart
+        of this same program deserializes its executables instead of
+        recompiling — every traced dispatch then labels its
+        ``compile_seconds`` observation ``source="cache"`` or
+        ``"fresh"``. Process-global by nature (it is ONE jax config);
+        routed through here so the policy travels with the compile
+        call that benefits."""
         assert len(inputs) > 0
         from .observability import metrics as _obs_metrics
         from .observability import spans as _obs_spans
+        if compile_cache is not None:
+            from .aot import cache as _aot_cache
+            _aot_cache.install(compile_cache)
         t0 = time.perf_counter()
         with _obs_spans.span("compile", policy=str(policy)):
             self._compile_body(inputs, is_train, use_graph, sequential,
@@ -340,9 +367,22 @@ class Model(Layer):
         for the background loop or drive ``step()`` synchronously.
         Other ``kw`` (``slots``, ``max_len``, ``prefill_len``,
         ``queue_capacity``, ``faults``, ``registry``, ...) pass through
-        to the engine."""
+        to the engine.
+
+        Cold-start knobs (``singa_tpu.aot``): ``compile_cache=``
+        installs the persistent compilation cache exactly like
+        :meth:`compile`'s; ``aot_store=`` (an
+        :class:`~singa_tpu.aot.AotStore` or its directory) makes the
+        engine deserialize previously exported prefill/decode
+        executables instead of tracing — honored-or-refused against
+        the artifact manifests — and is where
+        ``engine.export_aot()`` writes."""
         from . import mixed_precision as mp
         from .serving import build_engine
+        compile_cache = kw.pop("compile_cache", None)
+        if compile_cache is not None:
+            from .aot import cache as _aot_cache
+            _aot_cache.install(compile_cache)
         pol = mp.resolve(policy) if policy is not None \
             else getattr(self, "_policy", None)
         return build_engine(self, policy=pol, **kw)
@@ -724,7 +764,30 @@ class Model(Layer):
             key = repr(layout)
         rec = self._steps.get(key)
         if rec is None:
-            rec = self._build_step(layout)
+            # warm restart: an AOT store (ResilientTrainer(aot=...))
+            # may hold this signature's exported executable — verify
+            # its manifest and deserialize INSTEAD of tracing. Any
+            # mismatch (version, topology, avals, digest, policy) was
+            # already refused loudly inside the loader and falls
+            # through to the normal fresh build below.
+            store = getattr(self, "_aot_store", None)
+            if store is not None and self._dist is None and \
+                    isinstance(key, tuple):
+                try:
+                    from .aot import export as _aot_export
+                    rec = _aot_export.load_train_step(
+                        self, store, key, input_arrays)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:    # noqa: BLE001 — never blocks
+                    import warnings
+                    warnings.warn(
+                        f"AOT train-step load failed unexpectedly "
+                        f"({type(e).__name__}: {e}); compiling fresh",
+                        stacklevel=3)
+                    rec = None
+            if rec is None:
+                rec = self._build_step(layout)
             self._steps[key] = rec
             if len(self._steps) == 9:
                 import warnings
@@ -796,6 +859,7 @@ class Model(Layer):
         # steps pay two dict reads.
         n_traces0 = rec.get("n_traces", 0)
         t_compile0 = time.perf_counter()
+        cache_counts0 = _aot_cache_snapshot()
         if self.dev.verbosity >= 2 and "cost" not in rec:
             # one-time XLA cost analysis of this step signature (the
             # compiled-world per-op metric: flops / bytes, reference
@@ -840,11 +904,13 @@ class Model(Layer):
             new_state, leaves, next_key = rec["jit"](state_arrays, rng,
                                                      *input_arrays)
         if rec.get("n_traces", 0) > n_traces0:
+            from .aot import cache as _aot_cache
             from .observability import perf as _perf
             sig = _perf.step_signature(input_arrays)
             _perf.record_compile(
                 "train_step", time.perf_counter() - t_compile0, sig,
                 prev_signature=rec.get("arg_sig"),
+                source=_aot_cache.classify(cache_counts0),
                 step=self._step_count)
             rec["arg_sig"] = sig
         self.dev._set_rng_state(next_key)  # tracing clobbered dev rng

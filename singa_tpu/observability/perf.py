@@ -237,12 +237,20 @@ def diff_signatures(old, new):
 
 
 def record_compile(program, seconds, signature, prev_signature=None,
-                   registry=None, **attrs):
+                   registry=None, source="fresh", **attrs):
     """Attribute one trace of a compiled program: observe its wall-time
-    in the ``compile_seconds{program}`` histogram and leave a flight-
-    recorder event — ``compile`` for a first trace (or a re-lower with
-    an identical signature), ``retrace`` when the signature changed,
-    naming the changed argument(s) old vs new.
+    in the ``compile_seconds{program, source}`` histogram and leave a
+    flight-recorder event — ``compile`` for a first trace (or a
+    re-lower with an identical signature), ``retrace`` when the
+    signature changed, naming the changed argument(s) old vs new.
+
+    ``source`` labels where the executable came from: ``"fresh"`` (XLA
+    compiled it now), ``"cache"`` (served whole from the persistent
+    compilation cache — ``singa_tpu.aot.cache.classify`` is the
+    judge), or ``"aot"`` (a deserialized exported executable; no trace
+    happened at all and ``seconds`` is the verify+load cost). The
+    cold-start acceptance gate is "zero ``source="fresh"``
+    observations on a warm restart".
 
     ``seconds`` is the dispatch wall-clock of the call that traced
     (trace + XLA compile + the step's own dispatch — on a first call
@@ -251,19 +259,37 @@ def record_compile(program, seconds, signature, prev_signature=None,
     reg = registry if registry is not None else _metrics.default_registry()
     reg.histogram(
         "compile_seconds",
-        "wall-clock of a dispatch that traced+compiled, by program",
-        labels=("program",)).observe(float(seconds), program=str(program))
+        "wall-clock of a dispatch that traced+compiled, by program "
+        "and executable source (fresh | cache | aot)",
+        labels=("program", "source")).observe(
+            float(seconds), program=str(program), source=str(source))
     changed = diff_signatures(prev_signature, signature) \
         if prev_signature is not None else None
     if changed:
         _spans.event("retrace", program=str(program),
-                     compile_s=round(float(seconds), 4),
+                     compile_s=round(float(seconds), 4), source=source,
                      changed=changed, signature=signature, **attrs)
     else:
         _spans.event("compile", program=str(program),
-                     compile_s=round(float(seconds), 4),
+                     compile_s=round(float(seconds), 4), source=source,
                      signature=signature, **attrs)
     return changed
+
+
+def compile_source_counts(registry=None):
+    """{source: observation count} over the ``compile_seconds``
+    histogram — the warm-restart gate reads this (zero ``fresh`` on a
+    warm path). Empty dict when nothing compiled yet."""
+    reg = registry if registry is not None \
+        else _metrics.default_registry()
+    hist = reg.get("compile_seconds")
+    if hist is None:
+        return {}
+    out = {}
+    for series in hist.to_doc()["series"]:
+        src = series.get("labels", {}).get("source", "fresh")
+        out[src] = out.get(src, 0) + int(series.get("count", 0))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -399,4 +425,5 @@ class AnomalySentinel:
 
 __all__ = ["hbm_stats", "record_hbm", "live_array_report",
            "first_jax_device", "step_signature", "diff_signatures",
-           "record_compile", "SamplingProfiler", "AnomalySentinel"]
+           "record_compile", "compile_source_counts",
+           "SamplingProfiler", "AnomalySentinel"]

@@ -171,6 +171,19 @@ class ResilientTrainer:
       baseline fire an attributed ``step_anomaly`` event, a one-shot
       profile capture on the next step, and a blackbox dump. None
       (the default) disables the sentinel.
+    - ``aot``: cold-start elimination (``singa_tpu.aot``). ``True``
+      keeps an ``aot/`` sidecar beside the checkpoints (a path keeps
+      it there instead): the persistent compilation cache is
+      installed under ``<aot>/xla-cache``, the compiled train step is
+      exported after the first step (single-device models; a
+      mesh-sharded step rides the cache alone), and a restarted
+      worker's restore path deserializes a MATCHING artifact instead
+      of retracing — any mismatch (version, topology, avals, digest,
+      policy) falls back to a loud fresh compile and quarantines the
+      stale artifact. The run summary reports ``compile_sources``
+      (observations per ``compile_seconds`` source label) and
+      ``aot`` (per-program outcomes), the chaos ``warm-restart``
+      gate's evidence. None (the default) changes nothing.
     """
 
     def __init__(self, model, ckpt_dir, *, max_to_keep=3,
@@ -184,7 +197,7 @@ class ResilientTrainer:
                  fingerprint_every=0, max_divergence_rollbacks=2,
                  telemetry_dir=None, profile_every=0,
                  anomaly_factor=None, anomaly_sustain=3,
-                 anomaly_warmup=10):
+                 anomaly_warmup=10, aot=None):
         self.model = model
         self.cluster = cluster
         self._rank = cluster.rank if cluster is not None else 0
@@ -271,6 +284,20 @@ class ResilientTrainer:
         self._sentinel = _perf.AnomalySentinel(
             factor=anomaly_factor, sustain=anomaly_sustain,
             warmup=anomaly_warmup) if anomaly_factor else None
+        # cold-start elimination: persistent compile cache + AOT
+        # train-step artifacts in an aot/ sidecar beside the
+        # checkpoints (class docstring)
+        self._aot_store = None
+        if aot:
+            from ..aot import cache as _aot_cache
+            from ..aot import export as _aot_export
+            aot_dir = os.path.join(str(ckpt_dir), "aot") \
+                if aot is True else os.path.abspath(str(aot))
+            _aot_cache.install(_aot_cache.cache_dir_for(aot_dir))
+            self._aot_store = _aot_export.AotStore(aot_dir)
+            # Model._run_step consults the store before tracing a
+            # fresh signature (the warm-restart load path)
+            model._aot_store = self._aot_store
 
     # -- logging -----------------------------------------------------------
     def _log(self, msg):
@@ -630,6 +657,15 @@ class ResilientTrainer:
                                     getattr(obj, "iterator", None),
                                     getattr(obj, "inner", None))
                         if w is not None), None)
+        # cold-start evidence: where this run's executables came from
+        # (the warm-restart chaos gate asserts zero "fresh" on a warm
+        # path) and the compiled step's trace count — cheap host reads
+        summary["compile_sources"] = _perf.compile_source_counts()
+        rec = getattr(self.model, "_last_run_rec", None)
+        if rec is not None:
+            summary["n_traces"] = rec.get("n_traces")
+        if self._aot_store is not None:
+            summary["aot"] = dict(self._aot_store.outcomes)
         if self.cluster is not None:
             try:
                 summary["cluster"] = self.cluster.health()
@@ -810,6 +846,25 @@ class ResilientTrainer:
                     self._step_flops = sf(compute=False)
                 except Exception:       # audit is best-effort telemetry
                     self._step_flops = None
+            if self._aot_store is not None:
+                # the compiled step exists from THIS step on: persist
+                # it so the next restart deserializes instead of
+                # retracing. skip_if_current makes the warm steady
+                # state free; failure degrades to cache-only warm
+                # starts, loudly, never a dead trainer.
+                from ..aot import export as _aot_export
+                try:
+                    with _spans.span("aot.export_train_step"):
+                        _aot_export.export_train_step(
+                            self.model, self._aot_store,
+                            skip_if_current=True)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:      # noqa: BLE001 — degrade
+                    warnings.warn(
+                        f"AOT train-step export unavailable "
+                        f"({type(e).__name__}: {e}); restarts warm "
+                        "from the compile cache only", stacklevel=2)
         if step_s > 0 and not profiled:
             first_arr = next((b for b in batch
                               if hasattr(b, "shape") and

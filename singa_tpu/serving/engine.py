@@ -79,17 +79,30 @@ def _quiet_donation(fn, *args):
         return fn(*args)
 
 
-def _attribute_trace(rec, registry, program, arrays, names, t0):
+def _cache_counts():
+    """Persistent-compile-cache counter snapshot before a dispatch
+    that may trace (labels the compile source cache-vs-fresh)."""
+    from ..aot import cache as _aot_cache
+    return _aot_cache.snapshot()
+
+
+def _attribute_trace(rec, registry, program, arrays, names, t0,
+                     cache_counts0=None):
     """Compile/retrace attribution for ONE serve-program dispatch that
     traced (caller checks the ``n_traces`` delta): wall-clock into
-    ``compile_seconds{program}``, signature (diffed against this
-    program's previous trace) into a compile/retrace event — a decode
-    retrace is the broken no-retrace contract, and the event names
-    what changed."""
+    ``compile_seconds{program, source}``, signature (diffed against
+    this program's previous trace) into a compile/retrace event — a
+    decode retrace is the broken no-retrace contract, and the event
+    names what changed. ``cache_counts0`` (a persistent-compile-cache
+    counter snapshot taken before the dispatch) labels the source
+    cache-vs-fresh."""
+    from ..aot import cache as _aot_cache
     sig = _perf.step_signature(arrays, names=names)
+    source = _aot_cache.classify(cache_counts0) \
+        if cache_counts0 is not None else "fresh"
     _perf.record_compile(program, time.perf_counter() - t0, sig,
                          prev_signature=rec.get("sig"),
-                         registry=registry)
+                         source=source, registry=registry)
     rec["sig"] = sig
 
 
@@ -260,6 +273,35 @@ class _EngineBase:
         if self._tick_count % 16 == 0:
             _perf.record_hbm(self._hbm_dev, self._reg, site="serve")
 
+    # -- AOT export (cold-start elimination) -------------------------------
+    def export_aot(self, store=None):
+        """Serialize this engine's compiled executables into an AOT
+        store (the engine's own ``aot_store`` when none is given) so
+        the next replica spin-up deserializes instead of tracing.
+        Returns {program: manifest}."""
+        from ..aot import export as _aot_export
+        if store is None:
+            store = getattr(self, "_aot_store", None)
+        if store is None:
+            raise ValueError(
+                "export_aot needs a store: pass one, or build the "
+                "engine with aot_store=")
+        if not isinstance(store, _aot_export.AotStore):
+            store = _aot_export.AotStore(store, registry=self._reg)
+        docs = _aot_export.export_serving(self, store)
+        # keep the warm-restart audit truthful: a cold spin-up that
+        # just exported must not keep reporting refused:missing on
+        # /healthz and /aot.json (a program that WAS deserialized
+        # stays "loaded" — exporting beside it changes nothing)
+        if getattr(self, "_aot_store", None) is None:
+            self._aot_store = store
+        src = dict(getattr(self, "_aot_source", None) or {})
+        for program in docs:
+            if src.get(program) != "loaded":
+                src[program] = "exported"
+        self._aot_source = src
+        return docs
+
     # -- synchronous stepping (tests, simple callers) ----------------------
     def step(self):
         """Run ONE scheduler tick inline (only valid without the
@@ -340,7 +382,7 @@ class ServingEngine(_EngineBase):
     """Continuous-batching autoregressive engine (module docstring)."""
 
     def __init__(self, adapter, *, slots=4, max_len=64, prefill_len=16,
-                 prefill_batch=2, policy=None, **kw):
+                 prefill_batch=2, policy=None, aot_store=None, **kw):
         super().__init__(**kw)
         import jax
 
@@ -387,6 +429,15 @@ class ServingEngine(_EngineBase):
         # updated in place by XLA instead of doubling per tick
         self._prefill = jax.jit(prefill_body, donate_argnums=(1,))
         self._decode = jax.jit(decode_body, donate_argnums=(1,))
+        # warm restart: deserialize previously exported prefill/decode
+        # executables (honored-or-refused per artifact — a refused one
+        # compiles fresh, loudly). The trace that produced a loaded
+        # program happened in the EXPORTING process, so its n_traces
+        # counter reads 1 and the no-retrace pin still holds.
+        self._aot_store = None
+        self._aot_source = None
+        if aot_store is not None:
+            self._load_aot(aot_store)
 
         self._occupancy = self._reg.gauge(
             "serve_slot_occupancy", "active sequences in the slot array")
@@ -400,6 +451,43 @@ class ServingEngine(_EngineBase):
             "ticks executed")
         self._prefills = self._reg.counter(
             "serve_prefill_total", "prompts prefilled into a slot")
+
+    # -- AOT export / warm restart -----------------------------------------
+    def _load_aot(self, store):
+        from ..aot import export as _aot_export
+        from ..observability import perf as _perf2
+        if not isinstance(store, _aot_export.AotStore):
+            # the engine's own registry: aot_loads_total and the
+            # quarantine counter must land beside the engine's
+            # compile_seconds, not in the default registry
+            store = _aot_export.AotStore(store, registry=self._reg)
+        self._aot_store = store
+        prefill_avals, decode_avals = \
+            _aot_export.serving_program_avals(self)
+        geometry = _aot_export.serving_geometry(self)
+        self._aot_source = {}
+        for program, avals, rec, attr in (
+                (_aot_export.SERVE_PREFILL, prefill_avals,
+                 self._prefill_rec, "_prefill"),
+                (_aot_export.SERVE_DECODE, decode_avals,
+                 self._decode_rec, "_decode")):
+            t0 = time.perf_counter()
+            fn, _doc = store.try_load_program(
+                program, avals=avals, donate_argnums=(1,),
+                policy=self.policy, jax_device=self._hbm_dev,
+                expect_extra=geometry)
+            if fn is None:
+                self._aot_source[program] = store.outcomes.get(
+                    program, "fresh")
+                continue
+            setattr(self, attr, fn)
+            rec["n_traces"] = 1
+            sig = _perf2.step_signature(avals[2:])
+            _perf2.record_compile(program,
+                                  time.perf_counter() - t0, sig,
+                                  source="aot", registry=self._reg)
+            rec["sig"] = sig
+            self._aot_source[program] = "loaded"
 
     # -- public API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, temperature=0.0,
@@ -441,7 +529,13 @@ class ServingEngine(_EngineBase):
                 "prefill_len": self.prefill_len,
                 "prefill_batch": self.prefill_batch,
                 "policy": self.policy.describe()
-                if self.policy is not None else None}
+                if self.policy is not None else None,
+                # warm-restart audit: per-program executable source
+                # ("loaded" = deserialized AOT artifact, otherwise the
+                # store's refusal outcome / "fresh"); None without a
+                # store. The chaos warm-restart gate reads this off
+                # /healthz.
+                "aot": self._aot_source}
 
     def active_slots(self):
         return sum(1 for s in self._slots if s is not None)
@@ -548,6 +642,7 @@ class ServingEngine(_EngineBase):
             placed.append((req, free[b]))
         n0 = self._prefill_rec["n_traces"]
         t0c = time.perf_counter()
+        cc0 = _cache_counts()
         self._cache, logits = _quiet_donation(
             self._prefill, self._P, self._cache, tokens, lengths,
             slot_ids, valid)
@@ -556,7 +651,7 @@ class ServingEngine(_EngineBase):
                              "serve_prefill",
                              [tokens, lengths, slot_ids, valid],
                              ("tokens", "lengths", "slot_ids",
-                              "valid"), t0c)
+                              "valid"), t0c, cc0)
         logits = np.asarray(logits)
         for b, (req, slot_idx) in enumerate(placed):
             req.first_token_at = time.monotonic()
@@ -583,6 +678,7 @@ class ServingEngine(_EngineBase):
                 active[i] = True
         n0 = self._decode_rec["n_traces"]
         t0c = time.perf_counter()
+        cc0 = _cache_counts()
         self._cache, logits = _quiet_donation(
             self._decode, self._P, self._cache, tokens, positions,
             active)
@@ -590,7 +686,8 @@ class ServingEngine(_EngineBase):
             _attribute_trace(self._decode_rec, self._reg,
                              "serve_decode",
                              [tokens, positions, active],
-                             ("tokens", "positions", "active"), t0c)
+                             ("tokens", "positions", "active"), t0c,
+                             cc0)
         logits = np.asarray(logits)
         for i, slot in enumerate(list(self._slots)):
             if slot is None:
@@ -615,7 +712,8 @@ class BatchServingEngine(_EngineBase):
     padded batch of queued requests (module docstring)."""
 
     def __init__(self, model, *, input_shape, batch=8,
-                 input_dtype=np.float32, policy=None, **kw):
+                 input_dtype=np.float32, policy=None, aot_store=None,
+                 **kw):
         super().__init__(**kw)
         import jax
         from ..autograd_base import CTX
@@ -679,6 +777,38 @@ class BatchServingEngine(_EngineBase):
 
         self._fwd = jax.jit(fwd)
         self._hbm_dev = _perf.first_jax_device(self._state_arrays)
+        # warm spin-up: deserialize a previously exported batch
+        # forward (honored-or-refused; a refusal compiles fresh).
+        # n_traces reads 1: the trace happened in the exporting
+        # process, and the no-retrace audit still holds.
+        self._aot_store = None
+        self._aot_source = None
+        if aot_store is not None:
+            from ..aot import export as _aot_export
+            from ..observability import perf as _perf2
+            if not isinstance(aot_store, _aot_export.AotStore):
+                aot_store = _aot_export.AotStore(aot_store,
+                                                 registry=self._reg)
+            self._aot_store = aot_store
+            t0 = time.perf_counter()
+            avals = _aot_export.batch_program_avals(self)
+            fn, _doc = aot_store.try_load_program(
+                _aot_export.SERVE_BATCH, avals=avals,
+                donate_argnums=(), policy=self.policy,
+                jax_device=self._hbm_dev,
+                expect_extra=_aot_export.batch_geometry(self))
+            if fn is not None:
+                self._fwd = fn
+                rec["n_traces"] = 1
+                sig = _perf2.step_signature([avals[1]])
+                _perf2.record_compile(
+                    _aot_export.SERVE_BATCH,
+                    time.perf_counter() - t0, sig, source="aot",
+                    registry=self._reg)
+                rec["sig"] = sig
+            self._aot_source = {_aot_export.SERVE_BATCH:
+                                aot_store.outcomes.get(
+                                    _aot_export.SERVE_BATCH, "fresh")}
         self._occupancy = self._reg.gauge(
             "serve_slot_occupancy", "active sequences in the slot array")
         self._reg.gauge("serve_slots",
@@ -704,7 +834,8 @@ class BatchServingEngine(_EngineBase):
                 "slots": self.batch,
                 "input_shape": self.input_shape,
                 "policy": self.policy.describe()
-                if self.policy is not None else None}
+                if self.policy is not None else None,
+                "aot": self._aot_source}
 
     def _busy(self):
         return len(self.queue) > 0
@@ -722,6 +853,7 @@ class BatchServingEngine(_EngineBase):
             x[i] = req.payload
         t0 = time.perf_counter()
         n0 = self._rec["n_traces"]
+        cc0 = _cache_counts()
         try:
             with _spans.span("serve.batch_forward", n=len(batch)):
                 leaves = self._fwd(self._state_arrays, x)
@@ -732,7 +864,7 @@ class BatchServingEngine(_EngineBase):
             raise
         if self._rec["n_traces"] > n0:
             _attribute_trace(self._rec, self._reg, "serve_batch",
-                             [x], ("input",), t0)
+                             [x], ("input",), t0, cc0)
         self._tok_lat.observe(time.perf_counter() - t0)
         leaves = [np.asarray(leaf) for leaf in leaves]
         for i, req in enumerate(batch):
@@ -797,7 +929,8 @@ def build_engine(model, **kw):
                 "build either way)")
         ar_keys = ("slots", "max_len", "prefill_len", "prefill_batch",
                    "policy", "queue_capacity", "faults", "registry",
-                   "telemetry_dir", "max_retries", "trace_requests")
+                   "telemetry_dir", "max_retries", "trace_requests",
+                   "aot_store")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(
@@ -811,7 +944,7 @@ def build_engine(model, **kw):
             f"{type(model).__name__} has no decode_adapter")
     bt_keys = ("input_shape", "batch", "input_dtype", "policy",
                "queue_capacity", "faults", "registry", "telemetry_dir",
-               "max_retries", "trace_requests")
+               "max_retries", "trace_requests", "aot_store")
     unknown = sorted(set(kw) - set(bt_keys))
     if unknown:
         raise TypeError(

@@ -69,6 +69,13 @@ class ServingReplica:
     def submit(self, *args, **kwargs):
         return self.engine.submit(*args, **kwargs)
 
+    def export_aot(self, store=None):
+        """Serialize the engine's compiled programs into an AOT store
+        (``singa_tpu.aot``) so the replica that replaces this one —
+        rolling restart, failover respawn — deserializes instead of
+        retracing. Delegates to ``engine.export_aot``."""
+        return self.engine.export_aot(store)
+
     @property
     def draining(self):
         return self.engine.draining

@@ -112,6 +112,15 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                                 else 503, doc)
                 elif self.path.startswith("/metrics.json"):
                     self._reply(200, engine._reg.snapshot())
+                elif self.path.startswith("/aot.json"):
+                    # warm-restart audit: which executables were
+                    # deserialized vs compiled fresh, plus the store's
+                    # on-disk manifests (None/{} without an AOT store)
+                    store = getattr(engine, "_aot_store", None)
+                    self._reply(200, {
+                        "source": getattr(engine, "_aot_source", None),
+                        "manifests": store.inspect()
+                        if store is not None else {}})
                 elif self.path.startswith("/trace.json"):
                     from ..observability import trace_export as _texp
                     # _reply's own dumps is the single serialization

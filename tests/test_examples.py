@@ -246,6 +246,24 @@ class TestQuantizeCheckpointTool:
         assert "selftest: OK" in proc.stdout, proc.stdout[-300:]
 
 
+class TestAotCacheTool:
+    """The cold-start tool's CI smoke (like the other tool selftests):
+    export → inspect → warm reload (bit-equal) → corrupt a byte →
+    digest refusal + quarantine → doctored version stamp → typed
+    refusal → persistent-cache LRU GC round-trip — all inside the
+    tool's own --selftest."""
+
+    def test_selftest_is_green(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "tools/aot_cache.py", "--selftest"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "selftest: OK" in proc.stdout, proc.stdout[-300:]
+
+
 class TestTraceExportTool:
     """The Perfetto exporter's CI smoke (like metrics_dump's): a
     synthetic recorder ring exported through the real file path,

@@ -188,7 +188,7 @@ class TestCompileAttribution:
         perf.record_compile("p", 0.25, sig2, prev_signature=sig1,
                             registry=reg)
         h = reg.get("compile_seconds")
-        assert h.summary(program="p")["count"] == 2
+        assert h.summary(program="p", source="fresh")["count"] == 2
         names = [r["name"] for r in spans.recorder().records()]
         assert names == ["compile", "retrace"]
         retrace = spans.recorder().records()[-1]
@@ -225,7 +225,8 @@ class TestCompileAttribution:
         assert changed["arg1"]["old"][0] == [16, 4]
         assert rt["compile_s"] > 0
         h = metrics.default_registry().get("compile_seconds")
-        assert h.summary(program="train_step")["count"] >= 2
+        assert h.summary(program="train_step",
+                         source="fresh")["count"] >= 2
 
     def test_fixed_shapes_record_exactly_one_compile(self):
         m, tx, ty = _compiled_mlp()

@@ -39,6 +39,12 @@ harness) against ``examples/train_elastic.py``:
    driver fails over, and exit 0 (``serving.EXIT_DRAINED``) while the
    survivor absorbs the queue without ever retracing its decode
    program.
+8. **warm-restart** — cold-start elimination (``singa_tpu.aot``): a
+   trainer and a serving replica restarted against a populated AOT
+   cache reach the first step / first served token measurably faster
+   than their cold baselines, with ZERO ``source="fresh"`` compiles
+   and ``n_traces`` still 1 — every executable deserialized from an
+   artifact or served from the persistent compile cache.
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
 smoke is bounded by ``--budget`` seconds end to end (default 420) —
@@ -612,13 +618,179 @@ def scenario_serve_drain(root, budget):
                 p.kill()
 
 
+def scenario_warm_restart(root, budget):
+    """Cold-start elimination (``singa_tpu.aot``): kill a trainer and
+    a serving replica, restart both against the populated AOT cache,
+    and assert the warm restarts (a) reach the first step / first
+    served token FASTER than the cold baseline, (b) log ZERO
+    ``compile_seconds{source="fresh"}`` observations — every program
+    deserialized from an artifact or served from the persistent cache
+    — and (c) keep ``n_traces`` pinned at 1. Banked via
+    ``--summary-json`` beside the other cold-start series."""
+    import http.client
+    import signal as _signal
+
+    bank = BANK.setdefault("warm-restart", {})
+
+    # ---- trainer half: cold run, SIGTERM mid-run, warm restart ------
+    ck = os.path.join(root, "ck")
+    aot_train = os.path.join(ck, "aot")
+    cmd = _cmd(0, 1, _free_port(), ck,
+               extra=["--aot-dir", aot_train], steps=6)
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    # let it compile + step a little, then preempt; a tiny run may
+    # already have completed (exit 0) — either way the cache and
+    # the aot/ sidecar are populated, which is what the warm half
+    # needs
+    time.sleep(8)
+    p.send_signal(_signal.SIGTERM)
+    out_cold = p.communicate(timeout=budget.remaining())[0]
+    _check(p.returncode in (EXIT_PREEMPTED, 0),
+           f"warm-restart: cold trainer exited cleanly "
+           f"(got {p.returncode})", out_cold)
+    s_cold = _run_summary(out_cold)
+    _check(s_cold is not None and
+           s_cold.get("aot", {}).get("train_step") in
+           ("exported", "current"),
+           "warm-restart: cold trainer exported its train step",
+           out_cold)
+    cold_first = s_cold["first_step_latency_s"]
+
+    rcs, outs = _run([_cmd(0, 1, _free_port(), ck,
+                           extra=["--aot-dir", aot_train], steps=10)],
+                     budget)
+    _check(rcs[0] == 0, "warm-restart: warm trainer completed",
+           outs[0])
+    s_warm = _run_summary(outs[0])
+    _check(s_warm is not None and s_warm["start"] > 0,
+           "warm-restart: trainer resumed from the checkpoint",
+           outs[0])
+    _check(s_warm.get("aot", {}).get("train_step") == "loaded",
+           f"warm-restart: train step deserialized "
+           f"({s_warm.get('aot')})", outs[0])
+    srcs = s_warm.get("compile_sources") or {}
+    _check(srcs.get("fresh", 0) == 0,
+           f"warm-restart: zero fresh compiles on the warm trainer "
+           f"({srcs})", outs[0])
+    _check(s_warm.get("n_traces") == 1,
+           f"warm-restart: warm trainer n_traces == 1 "
+           f"({s_warm.get('n_traces')})", outs[0])
+    warm_first = s_warm["first_step_latency_s"]
+    _check(warm_first < cold_first,
+           f"warm-restart: first step {warm_first:.3f}s beats the "
+           f"cold {cold_first:.3f}s", outs[0])
+    bank["train_cold_first_step_s"] = round(float(cold_first), 4)
+    bank["train_warm_first_step_s"] = round(float(warm_first), 4)
+
+    # ---- serving half: cold spin-up, kill, warm spin-up -------------
+    serve = os.path.join(REPO, "examples", "serve_transformer.py")
+    aot_serve = os.path.join(root, "aot-serve")
+    scmd = lambda p: [sys.executable, serve, "--cpu",        # noqa: E731
+                      "--port", str(p), "--slots", "2",
+                      "--max-len", "48", "--prefill-len", "8",
+                      "--vocab", "32", "--d-model", "16",
+                      "--layers", "1", "--aot-dir", aot_serve]
+
+    def first_token_latency(port):
+        deadline = time.monotonic() + min(180, budget.remaining())
+        ready = False
+        while time.monotonic() < deadline and not ready:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=2)
+                c.request("GET", "/healthz")
+                ready = c.getresponse().status == 200
+                c.close()
+            except OSError:
+                time.sleep(0.1)
+        _check(ready, "warm-restart: gateway READY")
+        t0 = time.monotonic()
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        c.request("POST", "/v1/generate",
+                  json.dumps({"prompt": [1, 2, 3],
+                              "max_new_tokens": 4}))
+        r = c.getresponse()
+        doc = json.loads(r.read().decode() or "{}")
+        c.close()
+        _check(r.status == 200 and len(doc.get("tokens", [])) == 4,
+               "warm-restart: request served", repr(doc))
+        return time.monotonic() - t0
+
+    def healthz(port):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/healthz")
+        doc = json.loads(c.getresponse().read())
+        c.close()
+        return doc
+
+    def metrics_fresh_count(port):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        c.request("GET", "/metrics.json")
+        snap = json.loads(c.getresponse().read())
+        c.close()
+        n = 0
+        for m in snap.get("metrics", []):
+            if m.get("name") != "compile_seconds":
+                continue
+            for series in m.get("series", []):
+                if series.get("labels", {}).get("source") == "fresh":
+                    n += int(series.get("count", 0))
+        return n
+
+    port = _free_port()
+    p = subprocess.Popen(scmd(port), stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        cold_tok = first_token_latency(port)
+    finally:
+        p.send_signal(_signal.SIGTERM)
+    out0 = p.communicate(timeout=budget.remaining())[0]
+    rc = p.returncode
+    _check(rc == 0, f"warm-restart: cold replica drained 0 (got {rc})",
+           out0)
+    _check("AOT decode=exported prefill=exported" in out0,
+           "warm-restart: cold replica exported its programs", out0)
+
+    port = _free_port()
+    p = subprocess.Popen(scmd(port), stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        warm_tok = first_token_latency(port)
+        h = healthz(port)
+        _check(h["compiled"]["aot"] ==
+               {"serve_prefill": "loaded", "serve_decode": "loaded"},
+               f"warm-restart: replica deserialized both programs "
+               f"({h['compiled'].get('aot')})")
+        _check(h["compiled"]["n_traces"] == 1,
+               "warm-restart: warm replica decode n_traces == 1")
+        fresh = metrics_fresh_count(port)
+        _check(fresh == 0,
+               f"warm-restart: zero fresh compiles on the warm "
+               f"replica (got {fresh})")
+        _check(warm_tok < cold_tok,
+               f"warm-restart: first token {warm_tok:.3f}s beats the "
+               f"cold {cold_tok:.3f}s")
+    finally:
+        p.send_signal(_signal.SIGTERM)
+    # communicate (not bare wait): the drain logs share the stdout
+    # pipe, and an undrained full pipe would block the child forever
+    out1 = p.communicate(timeout=budget.remaining())[0]
+    _check(p.returncode == 0,
+           f"warm-restart: warm replica drained 0 "
+           f"(got {p.returncode})", out1)
+    bank["serve_cold_first_token_s"] = round(float(cold_tok), 4)
+    bank["serve_warm_first_token_s"] = round(float(warm_tok), 4)
+
+
 SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("commit-hole", scenario_commit_hole),
              ("barrier-missing", scenario_barrier_missing),
              ("bitflip-restore", scenario_bitflip_restore),
              ("divergence-quarantine", scenario_divergence_quarantine),
              ("data-resume", scenario_data_resume),
-             ("serve-drain", scenario_serve_drain)]
+             ("serve-drain", scenario_serve_drain),
+             ("warm-restart", scenario_warm_restart)]
 
 
 def main():

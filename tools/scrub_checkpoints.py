@@ -58,15 +58,25 @@ def _scrub_dir(path, delete):
 
 def scrub_root(root, delete=False):
     """Scrub ``root`` (plain or distributed layout). Returns
-    ``{relative_dir: {step: status}}``."""
+    ``{relative_dir: {step_or_aot_artifact: status}}``. A distributed
+    root's shared ``aot/`` sidecar (exported compiled executables —
+    the per-rank scrub only sees per-rank sidecars) is verified here,
+    reported under the ``"aot"`` key."""
     root = os.path.abspath(root)
     rank_dirs = sorted(
         d for d in (os.listdir(root) if os.path.isdir(root) else [])
         if d.startswith("rank") and d[4:].isdigit()
         and os.path.isdir(os.path.join(root, d)))
     if os.path.isdir(os.path.join(root, "commits")) and rank_dirs:
-        return {d: _scrub_dir(os.path.join(root, d), delete)
-                for d in rank_dirs}
+        report = {d: _scrub_dir(os.path.join(root, d), delete)
+                  for d in rank_dirs}
+        aot_dir = os.path.join(root, "aot")
+        if os.path.isdir(aot_dir):
+            from singa_tpu.aot.export import AotStore
+            report["aot"] = {f"aot/{p}": s for p, s in
+                             AotStore(aot_dir).scrub(
+                                 delete=delete).items()}
+        return report
     return {".": _scrub_dir(root, delete)}
 
 
@@ -87,11 +97,14 @@ def main():
     report = scrub_root(args.directory, delete=args.delete)
 
     bad = 0
-    # a distributed step is LOST only when no rank's shard verifies
+    # a distributed step is LOST only when no rank's shard verifies;
+    # aot artifacts (string keys) are counted as bad shards but are
+    # not steps — a corrupt artifact quarantines and recompiles fresh
     steps: dict = {}
     for d, res in report.items():
         for step, status in res.items():
-            steps.setdefault(step, []).append(status)
+            if not isinstance(step, str):
+                steps.setdefault(step, []).append(status)
             if status in ("corrupt", "unreadable"):
                 bad += 1
     lost = sorted(s for s, sts in steps.items()
@@ -103,7 +116,12 @@ def main():
                           "lost_steps": lost, "deleted": args.delete}))
     else:
         for d, res in sorted(report.items()):
-            for step, status in sorted(res.items()):
+            # step keys are ints, aot artifact keys are strings — one
+            # report, sorted stably across both
+            for step, status in sorted(res.items(), key=lambda kv:
+                                       (isinstance(kv[0], str),
+                                        kv[0] if isinstance(kv[0], int)
+                                        else str(kv[0]))):
                 print(f"[scrub] {d}/{step}: {status}")
         if lost:
             print(f"[scrub] LOST step(s) {lost}: no rank's shard "

@@ -155,8 +155,12 @@ def main():
                     f"{'full' if full_probe else 'short'} probe, "
                     "slow cadence")
             status, err = bench._probe_tpu(120 if full_probe else 30)
-            bench._record_obs("probe", {"status": status, "err": err,
-                                        "src": "watch"})
+            bench._record_obs("probe", {
+                "status": status, "err": err, "src": "watch",
+                # bench._probe_timeout_kind classifies the round's
+                # timeout streak from this stamp (warm cache => the
+                # round's full attempts can't be compile-bound)
+                "compile_cache": bench._compile_cache_state()})
             log(f"probe#{n}: {status}{' (' + err + ')' if err else ''}")
         if status != "ok":
             time.sleep(IDLE_SLEEP * (4 if cooldown else 1))
