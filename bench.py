@@ -375,6 +375,30 @@ def _xla_step_flops(model):
         return None
 
 
+def _timeline_capture(step_fn, force):
+    """One profiled step's compute/collective/memcpy/host/idle
+    decomposition (``observability.timeline`` over the SAME compiled
+    program the leg just timed) — banked per leg so the MFU trajectory
+    names WHAT to fix (exposed collectives vs input stalls vs
+    HBM-bound fusions), not just that it moved. ``force`` blocks on
+    the step output (the trace must outlive the device work). Disable
+    with BENCH_TIMELINE=0; any failure degrades to None — the timing
+    numbers still stand."""
+    if os.environ.get("BENCH_TIMELINE", "1") == "0":
+        return None
+    try:
+        from singa_tpu import profiling as _prof
+        from singa_tpu.observability import timeline as _tl
+        events = []
+        _prof.measure_step_fusions(lambda: force(step_fn()),
+                                   events_out=events)
+        return _tl.compact(_tl.analyze(events))
+    except Exception as e:   # noqa: BLE001 — telemetry, never a blocker
+        print(f"bench: timeline capture unavailable ({e})",
+              file=sys.stderr)
+        return None
+
+
 def _peak_hbm(dev):
     """Peak-HBM high-water (bytes) via the shared observability helper
     (``observability.perf.hbm_stats`` — the promoted form of the old
@@ -409,6 +433,8 @@ def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
         extras["xla_flops_per_step"] = _xla_step_flops(step.model)
         extras["peak_hbm_bytes"] = _peak_hbm(dev)
         extras["compile"] = _compile_delta(cc0)
+        extras["timeline"] = _timeline_capture(
+            step, lambda loss: _force(loss.data))
     return batch / dt, dt * 1e3
 
 
@@ -519,6 +545,10 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     # whether the persistent cache served them (cold vs warm round)
     if fp32_extras.get("compile"):
         res["compile"] = fp32_extras["compile"]
+    # per-leg step-timeline decomposition (bucket fractions +
+    # exposed-comm seconds): the MFU trajectory's "what to fix" column
+    if fp32_extras.get("timeline"):
+        res["timeline"] = fp32_extras["timeline"]
     _emit_partial(res, "fp32")
     # bf16 variant — POLICY-DRIVEN by default: Model.compile(
     # policy="bf16_mixed") keeps fp32 masters + dynamic loss scaling and
@@ -547,6 +577,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                     bf16_extras["peak_hbm_bytes"]
             if bf16_extras.get("compile"):
                 res["bf16_compile"] = bf16_extras["compile"]
+            if bf16_extras.get("timeline"):
+                res["bf16_timeline"] = bf16_extras["timeline"]
         except TimeoutError as e:
             # the zombie leg thread may still hold the chip: stop here —
             # a later leg timed against it would bank a lie
@@ -579,6 +611,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                 res["lm_hbm_peak_bytes"] = lm_extras["peak_hbm_bytes"]
             if lm_extras.get("compile"):
                 res["lm_compile"] = lm_extras["compile"]
+            if lm_extras.get("timeline"):
+                res["lm_timeline"] = lm_extras["timeline"]
             # what the LM leg measured: fused-CE-head or full-logits
             # path — without this marker, banked numbers from different
             # modes would read as perf changes between rounds
@@ -614,6 +648,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
                         lmb_extras["peak_hbm_bytes"]
                 if lmb_extras.get("compile"):
                     res["lm_bf16_compile"] = lmb_extras["compile"]
+                if lmb_extras.get("timeline"):
+                    res["lm_bf16_timeline"] = lmb_extras["timeline"]
             except TimeoutError as e:
                 res["lm_bf16_error"] = str(e)[:200]
                 res["leg_timeout"] = "lm_bf16"
@@ -816,9 +852,28 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
     }
     q = series_quantiles(delta)
     s = delta
+    # step-timeline probe AFTER the measured wave (a profiled tick
+    # inside it would decouple the token count from the observed
+    # decode time): a tiny all-ticks-profiled wave banks the serving
+    # decode's bucket decomposition beside the SLO numbers
+    timeline = None
+    if os.environ.get("BENCH_TIMELINE", "1") != "0":
+        try:
+            from singa_tpu.observability import timeline as _tl
+            eng._profile_every = 1
+            probe = [eng.submit(rng.randint(1, vocab, (4,)),
+                                max_new_tokens=4) for _ in range(2)]
+            eng.run_until_idle()
+            for f in probe:
+                f.result(timeout=1)
+            timeline = _tl.compact(eng.last_timeline)
+        except Exception as e:   # noqa: BLE001 — telemetry only
+            print(f"bench: serving timeline probe unavailable ({e})",
+                  file=sys.stderr)
     eng.stop()
     return {
         "decode_tok_s": (tok / s["sum"]) if s["sum"] else None,
+        **({"timeline": timeline} if timeline else {}),
         "p99_token_s": q.get("p99"),
         "p50_token_s": q.get("p50"),
         "wall_tok_s": tok / wall if wall > 0 else None,
@@ -888,6 +943,8 @@ def _measure_lm(dev, batch=8, seq=None, niters=20, warmup=3,
         extras["tokens_per_step"] = batch * seq
         extras["peak_hbm_bytes"] = _peak_hbm(dev)
         extras["compile"] = _compile_delta(cc0)
+        extras["timeline"] = _timeline_capture(
+            step, lambda loss: _force(loss.data))
     return batch * seq / dt
 
 
@@ -1529,7 +1586,20 @@ def _emit_report(res, live, smoke, obs, errors):
               "lm_error", "lm_bf16_error",
               "lm_fused_head", "timing", "timing_suspect",
               "partial", "partial_timeout", "partial_crash",
-              "leg_timeout"):
+              "leg_timeout",
+              # per-leg ride-alongs the trajectory report reads
+              # (tools/bench_report.py): step-timeline decompositions,
+              # peak HBM, compile deltas, and the serving/quant leg
+              # blocks — run_bench sets them on res, and without this
+              # list they would die here instead of reaching the
+              # banked BENCH_rNN.json
+              "timeline", "bf16_timeline", "lm_timeline",
+              "lm_bf16_timeline",
+              "hbm_peak_bytes", "bf16_hbm_peak_bytes",
+              "lm_hbm_peak_bytes", "lm_bf16_hbm_peak_bytes",
+              "compile", "bf16_compile", "lm_compile",
+              "lm_bf16_compile",
+              "serving", "serving_error", "quant", "quant_error"):
         if res.get(k) is not None:
             out[k] = round(res[k], 4) if isinstance(res[k], float) else res[k]
     extras = _fold_extras(obs)

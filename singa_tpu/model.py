@@ -1364,7 +1364,7 @@ class Model(Layer):
         rec["step_flops"] = flops
         return flops
 
-    def profile_step(self, *args, record=True):
+    def profile_step(self, *args, record=True, events_out=None):
         """Run ONE training step under a ``jax.profiler`` trace and
         return ``(result, {fusion_name: (count, total_seconds)})`` —
         the measured per-fusion decomposition of the compiled step
@@ -1380,7 +1380,14 @@ class Model(Layer):
         still folds): the sampling profiler is then the ONE publisher,
         into ITS registry — without it every sampled step would set
         each gauge twice and a custom-registry profiler would leak the
-        table into the default registry too."""
+        table into the default registry too.
+
+        ``events_out``: a list that receives the capture's RAW
+        timestamped trace events (``profiling.parse_trace_events``) —
+        what ``observability.timeline.analyze`` buckets into the
+        compute/collective/memcpy/host/idle step decomposition. Same
+        single parse pass; an out-param so the 2-tuple return shape
+        stays stable."""
         from . import profiling as _prof
         from .utils import force_completion
 
@@ -1394,7 +1401,8 @@ class Model(Layer):
             force_completion(leaves)
             return res
 
-        result, table = _prof.measure_step_fusions(run_once)
+        result, table = _prof.measure_step_fusions(
+            run_once, events_out=events_out)
         if record:
             _prof.record_fusion_metrics(table)
         for name, (cnt, tot) in table.items():

@@ -21,6 +21,11 @@ Three cooperating pieces (see each module's docstring):
   Chrome-trace ``.trace.json`` that opens in ui.perfetto.dev
   (``tools/trace_export.py`` is the CLI, the serving gateway serves it
   at ``/trace.json``).
+- :mod:`.timeline` — step-timeline attribution: buckets the profiler's
+  device trace into compute / collective / memcpy / host / idle,
+  computes exposed-communication seconds and the MFU-loss waterfall,
+  publishes ``timeline_*`` gauges, and labels stragglers with a cause
+  (``comm_bound | data_bound | compute_bound | compile_bound``).
 
 Host-side only: nothing here imports jax at module scope or runs
 inside a compiled step — ``compiled_step_info()["n_traces"]`` stays 1
@@ -34,6 +39,7 @@ from . import spans       # noqa: F401
 from . import export      # noqa: F401
 from . import perf        # noqa: F401
 from . import trace_export  # noqa: F401
+from . import timeline    # noqa: F401
 
 from .metrics import (MetricsRegistry, default_registry,  # noqa: F401
                       heartbeat_summary, aggregate_summaries,

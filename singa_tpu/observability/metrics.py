@@ -37,10 +37,16 @@ Usage::
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 
 SNAPSHOT_SCHEMA = "singa-tpu-metrics/1"
+
+# process start, as close as telemetry can observe it (this module is
+# imported by every instrumented layer's first import) — the build
+# stamp's "when did this process come up"
+_PROCESS_START = time.time()
 
 # Default histogram buckets, tuned for wall-clock seconds spanning a
 # sub-millisecond metric op to a minutes-long restore (the upper +inf
@@ -69,6 +75,57 @@ def device_peak_flops(device_kind):
         if tag in kind:
             return peak
     return None
+
+
+# resolved once per process (subprocess git call), then cached
+_BUILD_STAMP = None
+
+
+def build_stamp():
+    """The build/deploy identity stamped into every snapshot (and so
+    into /metrics.json, heartbeat summaries, and blackbox dumps):
+    ``{"git": <commit or None>, "start_ts": <process start, epoch s>,
+    "pid": ..., "host": ...}`` — what lets a fleet dashboard correlate
+    a perf shift with a deploy instead of guessing. ``git`` honors a
+    ``SINGA_TPU_BUILD_GIT`` env override (containers deployed without
+    a .git directory stamp their image tag there); otherwise one
+    cached ``git rev-parse`` of the installed package's tree, None
+    when neither exists."""
+    global _BUILD_STAMP
+    if _BUILD_STAMP is None:
+        import socket
+        git = os.environ.get("SINGA_TPU_BUILD_GIT") or None
+        if git is None:
+            try:
+                import subprocess
+                here = os.path.abspath(__file__)
+                pkg_dir = os.path.dirname(here)
+                # the repo git walks up to must actually TRACK this
+                # package: a venv's site-packages nested inside some
+                # unrelated application repo would otherwise stamp
+                # that app's HEAD as the library build — worse than
+                # the honest None
+                tracked = subprocess.run(
+                    ["git", "ls-files", "--error-unmatch",
+                     os.path.basename(here)],
+                    capture_output=True, text=True, timeout=5,
+                    cwd=pkg_dir)
+                if tracked.returncode == 0:
+                    proc = subprocess.run(
+                        ["git", "rev-parse", "--short", "HEAD"],
+                        capture_output=True, text=True, timeout=5,
+                        cwd=pkg_dir)
+                    if proc.returncode == 0:
+                        git = proc.stdout.strip() or None
+            except Exception:   # noqa: BLE001 — stamp is best-effort
+                git = None
+        try:
+            host = socket.gethostname()
+        except Exception:       # noqa: BLE001
+            host = None
+        _BUILD_STAMP = {"git": git, "start_ts": _PROCESS_START,
+                        "pid": os.getpid(), "host": host}
+    return dict(_BUILD_STAMP)
 
 
 def _label_key(label_names, labels):
@@ -291,6 +348,7 @@ class MetricsRegistry:
         with self._lock:
             metrics = list(self._metrics.values())
         return {"schema": SNAPSHOT_SCHEMA, "ts": time.time(),
+                "build": build_stamp(),
                 "metrics": [m.to_doc() for m in metrics]}
 
     def to_prometheus(self):
@@ -310,18 +368,39 @@ def default_registry():
 
 def heartbeat_summary(registry=None):
     """The compact per-rank summary that rides cluster heartbeats:
-    step-time stats from ``train_step_seconds`` plus this rank's dropped
-    corrupt-frame count. A few tens of bytes — cheap enough to attach to
-    every beat; None-valued fields mean "no data yet"."""
+    step-time stats from ``train_step_seconds``, this rank's dropped
+    corrupt-frame count, the build stamp (git commit + process start —
+    so the fleet view can correlate a perf shift with a deploy), and —
+    once the sampling profiler has run — the newest step-timeline
+    decomposition (``timeline``: bucket fractions + exposed-comm
+    seconds) plus the rank's compile share of step wall-time, the two
+    inputs of the coordinator's straggler cause labels. A few hundred
+    bytes — cheap enough to attach to every beat; None/absent fields
+    mean "no data yet"."""
     reg = registry if registry is not None else REGISTRY
     hist = reg.get("train_step_seconds")
     step = hist.summary() if isinstance(hist, Histogram) else None
     if step is not None and step["count"] == 0:
         step = None
     wires = reg.get("cluster_wire_errors_total")
-    return {"step_time": step,
-            "wire_errors": int(wires.total())
-            if isinstance(wires, Counter) else 0}
+    out = {"step_time": step,
+           "wire_errors": int(wires.total())
+           if isinstance(wires, Counter) else 0}
+    from . import timeline as _timeline   # lazy: timeline imports us
+    tl = _timeline.timeline_summary(reg, site="train")
+    if tl is not None:
+        out["timeline"] = tl
+    compile_hist = reg.get("compile_seconds")
+    if isinstance(compile_hist, Histogram) and step is not None \
+            and step["sum"]:
+        compile_sum = sum(float(s.get("sum") or 0.0) for s in
+                          compile_hist.to_doc()["series"])
+        if compile_sum:
+            out["compile_share"] = min(
+                1.0, compile_sum / float(step["sum"]))
+    stamp = build_stamp()
+    out["build"] = {"git": stamp["git"], "start_ts": stamp["start_ts"]}
+    return out
 
 
 # a rank whose mean step time exceeds this multiple of the fleet's
@@ -337,7 +416,14 @@ def aggregate_summaries(summaries):
     more than one rank reports step times — cross-rank straggler
     attribution: the ranks whose own mean step time sits more than
     :data:`STRAGGLER_FACTOR`× above the fleet mean, so "which host is
-    slow" is answerable straight off the heartbeat-carried summaries."""
+    slow" is answerable straight off the heartbeat-carried summaries.
+
+    Each named straggler additionally gets a CAUSE label in
+    ``straggler_causes`` (``{rank: comm_bound | data_bound |
+    compute_bound | compile_bound | unknown}``), judged from the
+    timeline fractions and compile share its own heartbeat carried
+    (``observability.timeline.classify_cause``) — "rank 2 is slow"
+    becomes "rank 2 is slow because its collectives are exposed"."""
     vals = [s for s in (summaries or {}).values() if isinstance(s, dict)]
     agg = {"ranks_reporting": len(vals),
            "wire_errors": sum(int(s.get("wire_errors") or 0)
@@ -359,11 +445,21 @@ def aggregate_summaries(summaries):
             (r for r, s in per_rank.items()
              if float(s["mean"]) > STRAGGLER_FACTOR * fleet),
             key=str) if len(per_rank) > 1 and fleet > 0 else []
+        if agg["step_time_stragglers"]:
+            from . import timeline as _timeline   # lazy (imports us)
+            causes = {}
+            for r in agg["step_time_stragglers"]:
+                s = summaries.get(r) or {}
+                tl = s.get("timeline") or {}
+                cause = _timeline.classify_cause(
+                    tl.get("fractions"), s.get("compile_share"))
+                causes[str(r)] = cause or "unknown"
+            agg["straggler_causes"] = causes
     return agg
 
 
 __all__ = ["SNAPSHOT_SCHEMA", "DEFAULT_BUCKETS", "PEAK_FLOPS_BY_KIND",
-           "STRAGGLER_FACTOR", "device_peak_flops", "Counter", "Gauge",
-           "Histogram", "MetricsRegistry", "REGISTRY",
-           "default_registry", "heartbeat_summary",
+           "STRAGGLER_FACTOR", "device_peak_flops", "build_stamp",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "REGISTRY", "default_registry", "heartbeat_summary",
            "aggregate_summaries"]

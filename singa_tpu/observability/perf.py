@@ -313,6 +313,7 @@ class SamplingProfiler:
     def __init__(self, every=0, registry=None):
         self.every = int(every or 0)
         self._force = False
+        self.last_timeline = None     # newest analyzed step timeline
         self._reg = registry if registry is not None \
             else _metrics.default_registry()
         self._samples = self._reg.counter(
@@ -337,8 +338,10 @@ class SamplingProfiler:
         """Arm a one-shot sample (the sentinel's profile capture)."""
         self._force = True
 
-    def record(self, step, table, capture_s=None):
+    def record(self, step, table, capture_s=None, events=None,
+               step_flops=None, peak_flops=None, site="train"):
         from .. import profiling as _profiling
+        from . import timeline as _timeline
         self._force = False
         self._samples.inc()
         self._last.set(step)
@@ -349,6 +352,24 @@ class SamplingProfiler:
                      top=_profiling.summarize_table(table, top=3),
                      **({"capture_s": round(capture_s, 4)}
                         if capture_s is not None else {}))
+        # the step-timeline decomposition rides the SAME capture (no
+        # second trace): bucket the raw events, refresh the timeline_*
+        # gauges, and leave a timeline.sample event whose bounded
+        # per-bucket lanes the Perfetto exporter renders as extra rows
+        if events:
+            tl = _timeline.analyze(events)
+            if tl is not None:
+                wf = _timeline.waterfall(tl, step_flops, peak_flops)
+                _timeline.record_timeline(tl, registry=self._reg,
+                                          site=site, waterfall_doc=wf)
+                self.last_timeline = tl
+                _spans.event(
+                    "timeline.sample", step=step, site=site,
+                    lanes=tl["lanes"], **_timeline.compact(tl),
+                    **({"achieved_mfu": round(wf["achieved_mfu"], 4),
+                        "mfu_loss": {k: round(v, 4)
+                                     for k, v in wf["loss"].items()}}
+                       if wf else {}))
 
 
 # ---------------------------------------------------------------------------

@@ -86,10 +86,50 @@ class FlightRecorder:
         self._ring = deque(maxlen=int(capacity))
         self._jsonl = None
         self._jsonl_path = None
+        # records the bounded ring pushed out (oldest-first): a
+        # beheaded blackbox/trace must SAY it is partial, not read as
+        # "nothing else happened" — dump() stamps this into its
+        # header, and a process-wide counter tracks it
+        self._evicted = 0
+        self._evict_counter = None      # lazy metrics handle
+
+    @property
+    def dropped_records(self):
+        """Ring evictions since this recorder was created — how many
+        records any dump/trace built from it is missing."""
+        with self._lock:
+            return self._evicted
+
+    def _count_eviction(self, n=1):
+        # lazy get-or-create OUTSIDE the ring lock; metrics is a lazy
+        # import here (it never imports spans, but keep the edge soft)
+        c = self._evict_counter
+        if c is None:
+            try:
+                from . import metrics as _metrics
+                c = self._evict_counter = \
+                    _metrics.default_registry().counter(
+                        "recorder_evicted_total",
+                        "flight-recorder ring records pushed out by "
+                        "newer ones (dumps built after evictions are "
+                        "partial and say so)")
+            except Exception:   # noqa: BLE001 — telemetry of telemetry
+                return
+        try:
+            c.inc(n)
+        except Exception:       # noqa: BLE001
+            pass
 
     def record(self, rec):
+        evicted = False
         with self._lock:
+            if self._ring.maxlen is not None and \
+                    len(self._ring) == self._ring.maxlen:
+                self._evicted += 1
+                evicted = True
             self._ring.append(rec)
+        if evicted:
+            self._count_eviction()
         if self._jsonl is not None:
             # serialize + write OUTSIDE the ring lock: a slow disk may
             # stall sink writers, never every span-recording thread
@@ -145,7 +185,17 @@ class FlightRecorder:
         from . import metrics as _metrics
         path = os.path.abspath(str(path))
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        header = {"kind": "dump", "ts": time.time(), "reason": str(reason)}
+        with self._lock:
+            dropped = self._evicted
+            capacity = self._ring.maxlen
+        header = {"kind": "dump", "ts": time.time(),
+                  "reason": str(reason),
+                  # loud partiality: a ring that evicted is a beheaded
+                  # blackbox — the post-mortem must know the N records
+                  # before this window are gone, not conclude they
+                  # never happened
+                  "dropped_records": dropped,
+                  "ring_capacity": capacity}
         if rank is not None:
             header["rank"] = rank
         if step is not None:
@@ -194,8 +244,16 @@ def configure(capacity=None, jsonl_path=None):
     sink path. Returns the recorder."""
     if capacity is not None:
         with _RECORDER._lock:
+            before = len(_RECORDER._ring)
             _RECORDER._ring = deque(_RECORDER._ring,
                                     maxlen=int(capacity))
+            # shrinking below the current length drops the OLDEST
+            # records — counted like any other eviction (header AND
+            # metrics counter, so the two can never disagree)
+            dropped = max(0, before - len(_RECORDER._ring))
+            _RECORDER._evicted += dropped
+        if dropped:
+            _RECORDER._count_eviction(dropped)
     if jsonl_path is not None:
         _RECORDER.attach_jsonl(jsonl_path)
     return _RECORDER

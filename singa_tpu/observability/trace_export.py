@@ -104,17 +104,50 @@ def to_chrome_trace(records):
                          "args": {"name": "runtime"}})
         return pids[key]
 
+    def named_tid(pid, key, label):
+        k = (pid, str(key))
+        if k not in tids:
+            tids[k] = len(tids) + 1
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": pid, "tid": tids[k],
+                         "args": {"name": label}})
+        return tids[k]
+
     def tid_of(pid, rec):
         rid = rec.get("request")
         if not rid:
             return 0
-        key = (pid, str(rid))
-        if key not in tids:
-            tids[key] = len(tids) + 1
-            meta.append({"ph": "M", "name": "thread_name",
-                         "pid": pid, "tid": tids[key],
-                         "args": {"name": f"request {rid}"}})
-        return tids[key]
+        return named_tid(pid, rid, f"request {rid}")
+
+    def timeline_lanes(rec, pid, ts_us):
+        """Extra Perfetto rows for one ``timeline.sample`` event: the
+        profiled step's bucketized device timeline (compute /
+        collective / memcpy / host / idle intervals), placed so the
+        window ENDS at the sample event — one named lane per bucket,
+        so 'where did the step go' is visible on the same trace as the
+        spans that asked."""
+        lanes = rec.get("lanes")
+        window_s = rec.get("window_s")
+        if not isinstance(lanes, dict) or not window_s:
+            return
+        site = rec.get("site", "train")
+        base = max(0.0, ts_us - float(window_s) * 1e6)
+        for bucket, intervals in lanes.items():
+            if not intervals:
+                continue
+            tid = named_tid(pid, f"timeline:{site}:{bucket}",
+                            f"timeline {bucket}")
+            for iv in intervals:
+                try:
+                    rel, dur = float(iv[0]), float(iv[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                events.append({
+                    "ph": "X", "name": bucket, "cat": "timeline",
+                    "pid": pid, "tid": tid,
+                    "ts": base + max(0.0, rel) * 1e6,
+                    "dur": max(0.0, dur) * 1e6,
+                    "args": {"bucket": bucket, "site": site}})
 
     for rec in recs:
         kind = rec.get("kind")
@@ -142,6 +175,13 @@ def to_chrome_trace(records):
         tid = tid_of(pid, rec)
         args = {k: v for k, v in rec.items() if k not in _STRUCTURAL}
         if kind == "event":
+            if rec.get("name") == "timeline.sample":
+                # the bucket lanes render as their own rows; the
+                # instant event keeps the fractions/waterfall args but
+                # not the raw interval list (it would bloat every
+                # click)
+                args.pop("lanes", None)
+                timeline_lanes(rec, pid, ts_us)
             events.append({"ph": "i", "name": rec.get("name", "event"),
                            "cat": "event", "pid": pid, "tid": tid,
                            "ts": ts_us, "s": "t", "args": args})
@@ -246,6 +286,16 @@ def live_records(recorder=None, registry=None):
     from . import spans as _spans
     rec = recorder if recorder is not None else _spans.recorder()
     records = list(rec.records()) + _spans.open_spans()
+    dropped = getattr(rec, "dropped_records", 0)
+    if dropped:
+        # loud partiality: the ring evicted records, so this trace
+        # starts mid-story — say so IN the trace instead of letting an
+        # empty-looking prefix read as "nothing happened"
+        records.append({
+            "kind": "event", "name": "recorder.dropped",
+            "ts": time.time(), "dropped_records": dropped,
+            "note": "flight-recorder ring evicted older records; "
+                    "this trace is partial"})
     reg = registry if registry is not None \
         else _metrics.default_registry()
     try:

@@ -24,17 +24,24 @@ def _is_xla_op_event(name):
     return not any(m in name for m in _RUNTIME_MARKERS)
 
 
-def parse_trace_dir(logdir):
-    """Aggregate complete ('X') events from a ``jax.profiler.trace``
-    output directory into ``{op_name: (count, total_seconds)}``.
+def parse_trace_events(logdir):
+    """Flat list of complete ('X') events from a ``jax.profiler.trace``
+    output directory, one dict per event::
 
-    Prefers device lanes (``/device:...`` processes — real accelerator
-    timelines); on backends without device lanes (CPU) falls back to the
-    host lane filtered down to XLA op/fusion names.
-    """
+        {"name": <enriched symbol>, "ts": <µs or None>, "dur": <µs>,
+         "lane": "device" | "host", "pid": ..., "xla_op": bool}
+
+    ``lane`` is resolved per trace file (``/device:...`` process rows
+    are device lanes); ``xla_op`` records whether the RAW event name
+    looked like an XLA op/fusion symbol (the host-fallback filter —
+    computed before :func:`_enrich` folds metadata into the name).
+    Python source frames (``$...``) and zero-duration events are
+    skipped. This is the ONE gzip+json pass both consumers share: the
+    per-fusion aggregation (:func:`parse_trace_dir`) and the
+    step-timeline bucketizer (``observability.timeline.analyze``)."""
     files = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
                       recursive=True)
-    out = {}
+    out = []
     for path in files:
         try:
             with gzip.open(path, "rt") as fh:
@@ -52,15 +59,45 @@ def parse_trace_dir(logdir):
             if e.get("ph") != "X" or not e.get("dur"):
                 continue
             name = e.get("name", "")
-            pid = e.get("pid")
-            if device_pids:
-                if pid not in device_pids:
-                    continue
-            elif not _is_xla_op_event(name):
+            if name.startswith("$"):        # python source frames
                 continue
-            name = _enrich(name, e.get("args"))
-            cnt, tot = out.get(name, (0, 0.0))
-            out[name] = (cnt + 1, tot + float(e["dur"]) * 1e-6)
+            pid = e.get("pid")
+            ts = e.get("ts")
+            out.append({
+                "name": _enrich(name, e.get("args")),
+                "ts": float(ts) if ts is not None else None,
+                "dur": float(e["dur"]),
+                "lane": "device" if pid in device_pids else "host",
+                "pid": pid,
+                "xla_op": _is_xla_op_event(name)})
+    return out
+
+
+def parse_trace_dir(logdir):
+    """Aggregate complete ('X') events from a ``jax.profiler.trace``
+    output directory into ``{op_name: (count, total_seconds)}``.
+
+    Prefers device lanes (``/device:...`` processes — real accelerator
+    timelines); on backends without device lanes (CPU) falls back to the
+    host lane filtered down to XLA op/fusion names.
+    """
+    return aggregate_events(parse_trace_events(logdir))
+
+
+def aggregate_events(events):
+    """Fold a :func:`parse_trace_events` list into the per-fusion
+    ``{name: (count, total_seconds)}`` table (device lanes preferred,
+    XLA-op host fallback otherwise — same rule one level up)."""
+    has_device = any(e["lane"] == "device" for e in events)
+    out = {}
+    for e in events:
+        if has_device:
+            if e["lane"] != "device":
+                continue
+        elif not e["xla_op"]:
+            continue
+        cnt, tot = out.get(e["name"], (0, 0.0))
+        out[e["name"]] = (cnt + 1, tot + e["dur"] * 1e-6)
     return out
 
 
@@ -80,10 +117,16 @@ def _enrich(name, args):
     return name
 
 
-def measure_step_fusions(run_step, logdir=None):
+def measure_step_fusions(run_step, logdir=None, events_out=None):
     """Run ``run_step()`` (which must block on its outputs) under a
     profiler trace and return the parsed per-op aggregate. Returns
     ``(result, {name: (count, total_seconds)})``.
+
+    ``events_out``: a list that, when supplied, receives the RAW
+    timestamped events (:func:`parse_trace_events`) of the same single
+    parse pass — what ``observability.timeline.analyze`` buckets into
+    compute/collective/memcpy/host/idle. An out-param so the 2-tuple
+    shape every existing caller consumes stays stable.
 
     PROFILER failures degrade to an empty table; a failure of the step
     itself propagates untouched (re-running an expensive failing step to
@@ -114,7 +157,10 @@ def measure_step_fusions(run_step, logdir=None):
         table = {}
         if ctx is not None:
             try:
-                table = parse_trace_dir(d)
+                events = parse_trace_events(d)
+                table = aggregate_events(events)
+                if events_out is not None:
+                    events_out.extend(events)
             except Exception:
                 table = {}
         return result, table

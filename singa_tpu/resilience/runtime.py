@@ -449,10 +449,19 @@ class ResilientTrainer:
                 # series — its cost lands in profile_capture_seconds
                 self._step_was_profiled = True
                 t0 = time.perf_counter()
+                events = []
                 out, table = self.model.profile_step(
-                    *batch, record=False)
+                    *batch, record=False, events_out=events)
+                # the step-timeline decomposition (timeline_* gauges,
+                # exposed-comm, MFU-loss waterfall) rides the same
+                # capture; FLOP counts only when someone already paid
+                # for a cost analysis (never forced on the step path)
+                peak = _metrics.device_peak_flops(getattr(
+                    self._jax_device(), "device_kind", None))
                 self._profiler.record(
-                    step, table, capture_s=time.perf_counter() - t0)
+                    step, table, capture_s=time.perf_counter() - t0,
+                    events=events, step_flops=self._step_flops,
+                    peak_flops=peak)
                 return out
             return self.model(*batch)
 

@@ -111,7 +111,7 @@ class _EngineBase:
 
     def __init__(self, *, queue_capacity=64, faults=None, registry=None,
                  telemetry_dir="telemetry", max_retries=3,
-                 trace_requests=True):
+                 trace_requests=True, profile_every=0):
         self._reg = registry if registry is not None \
             else _metrics.default_registry()
         self.queue = RequestQueue(queue_capacity, registry=self._reg)
@@ -135,6 +135,15 @@ class _EngineBase:
         self._stopped = False
         self._crashed = None
         self._tick_count = 0
+        # every-Nth-tick profiled decode tick (the trainer's
+        # profile_every, serving-side): the tick runs under a profiler
+        # trace through the ALREADY-compiled programs (n_traces pin
+        # untouched), refreshing this registry's profile_fusion_* and
+        # timeline_* gauges with site=serve. 0 disables; non-profiled
+        # ticks pay one integer check.
+        self._profile_every = int(profile_every or 0)
+        self._profiling_now = False
+        self._last_timeline = None
         self._retries = self._reg.counter(
             "serve_retries_total",
             "serve-loop ticks retried after an injected/transient fault")
@@ -194,6 +203,74 @@ class _EngineBase:
     def _fail_inflight(self, error):
         raise NotImplementedError
 
+    def _run_tick(self):
+        """One scheduler tick, every Nth one profiled: the profiled
+        tick runs THROUGH the compiled dispatch under a jax.profiler
+        trace (``measure_step_fusions`` — no retrace, one trace dump)
+        and refreshes ``profile_fusion_*`` plus the step-timeline
+        decomposition (``timeline_*{site=serve}`` gauges, a
+        ``timeline.sample`` event). The profiled tick's inflated
+        per-token latency stays OUT of the SLO series (PR 9's
+        trainer invariant, serving-side): its true cost lands in
+        ``serve_profile_capture_seconds``."""
+        if not (self._profile_every and self._tick_count > 0
+                and self._tick_count % self._profile_every == 0):
+            self._tick()
+            return
+        from .. import profiling as _profiling
+        self._profiling_now = True
+        t0 = time.perf_counter()
+        events = []
+        try:
+            # a failure of the TICK itself propagates untouched (the
+            # loop's crash path owns it, exactly like an unprofiled
+            # tick); measure_step_fusions already degrades profiler
+            # breakage to an empty table
+            _, table = _profiling.measure_step_fusions(
+                self._tick, events_out=events)
+        finally:
+            self._profiling_now = False
+        capture_s = time.perf_counter() - t0
+        try:
+            self._record_profiled_tick(table, events, capture_s)
+        except Exception as e:      # noqa: BLE001 — never a blocker
+            # telemetry must not take the serve loop down (a metric
+            # name/kind collision in a caller's registry would
+            # otherwise crash the engine and fail every inflight
+            # request over bookkeeping)
+            warnings.warn(
+                f"profiled-tick telemetry failed "
+                f"({type(e).__name__}: {e})", stacklevel=2)
+
+    def _record_profiled_tick(self, table, events, capture_s):
+        from .. import profiling as _profiling
+        from ..observability import timeline as _timeline
+        self._reg.counter(
+            "serve_profile_samples_total",
+            "profiled serving ticks (every profile_every-th)").inc()
+        self._reg.histogram(
+            "serve_profile_capture_seconds",
+            "wall-clock of one profiled serving tick (trace dump + "
+            "parse included — the sampling overhead bound)").observe(
+                capture_s)
+        if table:
+            _profiling.record_fusion_metrics(table, registry=self._reg)
+        tl = _timeline.analyze(events)
+        if tl is not None:
+            _timeline.record_timeline(tl, registry=self._reg,
+                                      site="serve")
+            self._last_timeline = tl
+            _spans.event("timeline.sample", site="serve",
+                         tick=self._tick_count, lanes=tl["lanes"],
+                         **_timeline.compact(tl))
+
+    @property
+    def last_timeline(self):
+        """The newest profiled tick's step-timeline decomposition
+        (None before the first sample) — what the gateway serves at
+        ``GET /timeline.json``."""
+        return self._last_timeline
+
     def _fail_batch(self, batch, exc):
         """Fail requests that were popped from the queue but died
         before reaching the slot table / delivery (exactly once)."""
@@ -218,7 +295,7 @@ class _EngineBase:
                 # retry replays the tick cleanly: nothing delivered
                 # twice, nothing dropped
                 self.faults.on_step(self._tick_count)
-                self._tick()
+                self._run_tick()
                 self._tick_count += 1
                 consecutive = 0
             except FaultInjected as e:
@@ -312,7 +389,7 @@ class _EngineBase:
         if not self._busy():
             return False
         self.faults.on_step(self._tick_count)
-        self._tick()
+        self._run_tick()
         self._tick_count += 1
         return True
 
@@ -621,7 +698,11 @@ class ServingEngine(_EngineBase):
             t0 = time.perf_counter()
             with _spans.span("serve.decode"):
                 self._run_decode()
-            self._tok_lat.observe(time.perf_counter() - t0)
+            # a PROFILED tick's dispatch runs under an active trace:
+            # its inflated latency must not read as an SLO regression
+            # (the sampling cost is serve_profile_capture_seconds)
+            if not self._profiling_now:
+                self._tok_lat.observe(time.perf_counter() - t0)
             self._decode_steps.inc()
         self._occupancy.set(self.active_slots())
         self._sample_hbm()
@@ -865,7 +946,10 @@ class BatchServingEngine(_EngineBase):
         if self._rec["n_traces"] > n0:
             _attribute_trace(self._rec, self._reg, "serve_batch",
                              [x], ("input",), t0, cc0)
-        self._tok_lat.observe(time.perf_counter() - t0)
+        # same rule as the autoregressive decode: a PROFILED tick's
+        # trace-inflated latency stays out of the SLO series
+        if not self._profiling_now:
+            self._tok_lat.observe(time.perf_counter() - t0)
         leaves = [np.asarray(leaf) for leaf in leaves]
         for i, req in enumerate(batch):
             now = time.monotonic()
@@ -930,7 +1014,7 @@ def build_engine(model, **kw):
         ar_keys = ("slots", "max_len", "prefill_len", "prefill_batch",
                    "policy", "queue_capacity", "faults", "registry",
                    "telemetry_dir", "max_retries", "trace_requests",
-                   "aot_store")
+                   "aot_store", "profile_every")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(
@@ -944,7 +1028,8 @@ def build_engine(model, **kw):
             f"{type(model).__name__} has no decode_adapter")
     bt_keys = ("input_shape", "batch", "input_dtype", "policy",
                "queue_capacity", "faults", "registry", "telemetry_dir",
-               "max_retries", "trace_requests", "aot_store")
+               "max_retries", "trace_requests", "aot_store",
+               "profile_every")
     unknown = sorted(set(kw) - set(bt_keys))
     if unknown:
         raise TypeError(

@@ -22,6 +22,10 @@ edge of the engine, not a web framework. Endpoints:
   spans included) rendered as a Chrome-trace document that opens in
   ui.perfetto.dev — per-request timeline lanes keyed by the request
   ids this gateway minted.
+- ``GET /timeline.json`` — the newest profiled tick's step-timeline
+  decomposition (compute/collective/memcpy/host/idle fractions +
+  exposed-communication seconds; engines built with
+  ``profile_every=N`` refresh it continuously).
 - ``POST /drain`` — begin a graceful drain; 202 immediately (the drain
   finishes in the background; watch ``/healthz``).
 
@@ -121,6 +125,19 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                         "source": getattr(engine, "_aot_source", None),
                         "manifests": store.inspect()
                         if store is not None else {}})
+                elif self.path.startswith("/timeline.json"):
+                    # the newest profiled tick's step-timeline
+                    # decomposition (engines built with profile_every=N
+                    # refresh it continuously); the interval lanes are
+                    # dropped from the reply — the fractions and the
+                    # exposed-comm number are the dashboard payload,
+                    # /trace.json renders the lanes
+                    tl = getattr(engine, "last_timeline", None)
+                    self._reply(200, {
+                        "site": "serve",
+                        "timeline": ({k: v for k, v in tl.items()
+                                      if k != "lanes"}
+                                     if tl else None)})
                 elif self.path.startswith("/trace.json"):
                     from ..observability import trace_export as _texp
                     # _reply's own dumps is the single serialization

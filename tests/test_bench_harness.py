@@ -344,3 +344,34 @@ def test_lm_train_flops_per_token_pinned():
     head = 512 * 32000
     attn = 6 * (4 * 1024 * 512 * 0.5)
     assert got == 3 * (2 * (proj + head) + attn), got
+
+
+def test_emit_report_banks_per_leg_ride_alongs(bench, capsys):
+    """The banked BENCH record is built through _emit_report's key
+    whitelist — the per-leg timeline decompositions, peak HBM, compile
+    deltas, and the serving/quant blocks that run_bench sets on res
+    must SURVIVE it (they used to die here, leaving the trajectory
+    report with '-' columns on every real round)."""
+    res = dict(TPU_RES)
+    tl = {"fractions": {"compute": 0.5, "collective": 0.1,
+                        "memcpy": 0.05, "host": 0.15, "idle": 0.2},
+          "exposed_collective_s": 4e-05, "collective_total_s": 1.2e-04,
+          "window_s": 4e-04}
+    res.update({
+        "timeline": tl, "bf16_timeline": dict(tl),
+        "lm_timeline": dict(tl),
+        "hbm_peak_bytes": 6 * 2**30, "bf16_hbm_peak_bytes": 7 * 2**30,
+        "compile": {"compiles": 3, "seconds": 12.5},
+        "serving": {"decode_tok_s": 500.0, "p99_token_s": 0.002,
+                    "timeline": dict(tl)},
+        "quant": {"resnet_img_s": 900.0},
+    })
+    bench._emit_report(res, live=True, smoke=[], obs=[], errors=[])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["timeline"]["fractions"]["idle"] == 0.2
+    assert out["bf16_timeline"]["exposed_collective_s"] == 4e-05
+    assert out["lm_timeline"]["window_s"] == 4e-04
+    assert out["hbm_peak_bytes"] == 6 * 2**30
+    assert out["compile"]["seconds"] == 12.5
+    assert out["serving"]["timeline"]["fractions"]["compute"] == 0.5
+    assert out["quant"]["resnet_img_s"] == 900.0

@@ -264,6 +264,24 @@ class TestAotCacheTool:
         assert "selftest: OK" in proc.stdout, proc.stdout[-300:]
 
 
+class TestBenchReportTool:
+    """The bench-trajectory report's CI smoke (like the other tool
+    selftests): a synthetic 4-round BENCH_r*.json trajectory through
+    the real load/extract/delta path, same-platform comparison, the
+    timeline columns, and a known 20% bf16 regression flagged — all
+    inside the tool's own --selftest."""
+
+    def test_selftest_is_green(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "tools/bench_report.py", "--selftest"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "selftest: OK" in proc.stdout, proc.stdout[-300:]
+
+
 class TestTraceExportTool:
     """The Perfetto exporter's CI smoke (like metrics_dump's): a
     synthetic recorder ring exported through the real file path,

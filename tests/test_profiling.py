@@ -197,7 +197,9 @@ def test_trace_parser_against_committed_fixture():
     device-lane/metadata path only ran behind a real jax.profiler
     capture. The fixture has a device lane (preferred over the host
     lane), repeated fusions with HLO long_name metadata (the _enrich
-    fold), a zero-duration event and a non-'X' phase (both skipped)."""
+    fold), a zero-duration event and a non-'X' phase (both skipped),
+    plus the collective / memcpy / host-lane events the step-timeline
+    bucketizer decomposes (tests/test_timeline.py)."""
     from singa_tpu import profiling as prof
 
     fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -206,7 +208,36 @@ def test_trace_parser_against_committed_fixture():
     assert out == {
         "fusion.1|convolution.3": (2, pytest.approx(150.0 * 1e-6)),
         "dot_general.5": (1, pytest.approx(50.0 * 1e-6)),
+        "all-reduce.1": (1, pytest.approx(80.0 * 1e-6)),
+        "all-gather.3": (1, pytest.approx(40.0 * 1e-6)),
+        "infeed.7": (1, pytest.approx(20.0 * 1e-6)),
     }
+
+
+def test_parse_trace_events_keeps_timestamps_and_lanes():
+    """The raw-event view of the SAME parse pass: timestamps, µs
+    durations, device/host lane attribution, and the xla_op marker the
+    host fallback filters by."""
+    from singa_tpu import profiling as prof
+
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "trace_fixture")
+    events = prof.parse_trace_events(fixture)
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    (ar,) = by_name["all-reduce.1"]
+    assert ar["lane"] == "device" and ar["ts"] == 20.0 \
+        and ar["dur"] == 80.0
+    (host,) = by_name["TransferHostToDevice"]
+    assert host["lane"] == "host" and host["ts"] == 280.0
+    (runtime,) = by_name["PjRtCpuExecutable::Execute"]
+    assert runtime["xla_op"] is False       # the host-fallback filter
+    # untimestamped legacy host events survive with ts None
+    assert all(e["ts"] is None for e in by_name["dot_general.5"]
+               if e["lane"] == "host")
+    # zero-duration and non-'X' events skipped, like the aggregate
+    assert "fusion.9" not in by_name
 
 
 def test_enrich_folds_metadata_into_fusion_symbols():

@@ -1,0 +1,337 @@
+#!/usr/bin/env python
+"""Render the banked BENCH_r*.json trajectory as a table (or JSON).
+
+Every benchmark round banks one record (``tools/tpu_watch.py`` /
+``bench.py``), but until now the trajectory was invisible — reading it
+meant eyeballing raw JSON blobs. This CLI folds the records into one
+per-round table: per-leg throughput (img/s, tok/s), MFU, peak HBM,
+compile cost, serving SLOs, and the step-timeline decomposition
+(compute/exposed-comm/idle fractions) the MFU push steers by — each
+with its delta vs the previous record, and loud ``REGRESSION`` flags
+when a throughput metric drops more than the threshold::
+
+    python tools/bench_report.py                  # repo-root records
+    python tools/bench_report.py --dir runs/ --json
+    python tools/bench_report.py --threshold 0.10
+    python tools/bench_report.py --selftest       # CI gate
+
+``--selftest`` (wired into tests/test_examples.py like the other tool
+selftests) synthesizes a three-round trajectory with a known bf16
+regression and asserts the extraction, the deltas, and the flag.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# (column label, extractor) — every metric the trajectory tracks. An
+# extractor returns None when the leg didn't run that round; deltas
+# skip None-to-None and None-to-value transitions.
+METRICS = [
+    ("img_s", lambda p: p.get("value") or p.get("throughput")),
+    ("mfu", lambda p: p.get("mfu")),
+    ("bf16_img_s", lambda p: p.get("bf16_throughput")),
+    ("bf16_mfu", lambda p: p.get("bf16_mfu")),
+    ("lm_tok_s", lambda p: p.get("lm_tokens_per_sec")),
+    ("lm_mfu", lambda p: p.get("lm_mfu")),
+    ("lm_bf16_tok_s", lambda p: p.get("lm_bf16_tokens_per_sec")),
+    ("serve_tok_s", lambda p: (p.get("serving") or {}).get(
+        "decode_tok_s")),
+    ("serve_p99_ms", lambda p: _scale((p.get("serving") or {}).get(
+        "p99_token_s"), 1e3)),
+    ("quant_img_s", lambda p: (p.get("quant") or {}).get(
+        "resnet_img_s")),
+    ("hbm_peak_gib", lambda p: _scale(p.get("hbm_peak_bytes"),
+                                      1 / 2**30)),
+    ("bf16_hbm_gib", lambda p: _scale(p.get("bf16_hbm_peak_bytes"),
+                                      1 / 2**30)),
+    ("compile_s", lambda p: (p.get("compile") or {}).get("seconds")),
+]
+
+# higher-is-better metrics get the regression gate; latency/memory
+# metrics are reported with deltas but a rise there is not flagged
+# (the p99 of a 2-request CPU smoke is far too noisy to gate on)
+GATED = {"img_s", "bf16_img_s", "lm_tok_s", "lm_bf16_tok_s",
+         "serve_tok_s", "quant_img_s"}
+
+# per-leg timeline columns (bucket fractions + exposed comm) — the
+# "what to fix" companion of each MFU number
+TIMELINE_LEGS = [("timeline", "fp32"), ("bf16_timeline", "bf16"),
+                 ("lm_timeline", "lm"),
+                 ("lm_bf16_timeline", "lm_bf16"),
+                 ("serving.timeline", "serving")]
+
+
+def _scale(v, k):
+    return v * k if isinstance(v, (int, float)) else None
+
+
+def _round_no(path):
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_records(directory):
+    """[(round_no, parsed-record dict)] sorted by round, skipping
+    files without a parsed benchmark payload."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_r*.json")),
+                       key=_round_no):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_report: skipping {path} ({e})",
+                  file=sys.stderr)
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if isinstance(doc, dict) and parsed is None and \
+                ("value" in doc or "throughput" in doc):
+            parsed = doc          # a bare bench.py record, unwrapped
+        if not isinstance(parsed, dict):
+            print(f"bench_report: {path} has no parsed record",
+                  file=sys.stderr)
+            continue
+        out.append((doc.get("n", _round_no(path)), parsed))
+    return out
+
+
+def _timeline_doc(parsed, key):
+    node = parsed
+    for part in key.split("."):
+        node = (node or {}).get(part) if isinstance(node, dict) else None
+    return node if isinstance(node, dict) else None
+
+
+def build_report(records, threshold=0.05):
+    """The JSON-able report doc: one row per round with extracted
+    metrics, deltas vs the previous record (fractional), per-leg
+    timeline decompositions, and the regression list."""
+    rows = []
+    # deltas compare a round against the previous record on the SAME
+    # platform: a tpu round after a cpu-fallback round is not a
+    # 100000% speedup, and the cpu round after it is not a regression
+    prev_by_platform = {}
+    for n, parsed in records:
+        vals = {name: fn(parsed) for name, fn in METRICS}
+        row = {"round": n,
+               "measured_at": parsed.get("measured_at"),
+               "git": parsed.get("git"),
+               "platform": parsed.get("platform"),
+               "device_kind": parsed.get("device_kind"),
+               "metrics": vals, "deltas": {}, "regressions": []}
+        prev = prev_by_platform.get(row["platform"])
+        timelines = {}
+        for key, leg in TIMELINE_LEGS:
+            tl = _timeline_doc(parsed, key)
+            if tl:
+                timelines[leg] = {
+                    "fractions": tl.get("fractions"),
+                    "exposed_collective_s":
+                        tl.get("exposed_collective_s")}
+        if timelines:
+            row["timeline"] = timelines
+        if prev is not None:
+            for name, v in vals.items():
+                pv = prev["metrics"].get(name)
+                if isinstance(v, (int, float)) and \
+                        isinstance(pv, (int, float)) and pv:
+                    d = (v - pv) / pv
+                    row["deltas"][name] = d
+                    if name in GATED and d < -threshold:
+                        row["regressions"].append(
+                            {"metric": name, "delta": d,
+                             "prev": pv, "now": v,
+                             "vs_round": prev["round"]})
+        rows.append(row)
+        prev_by_platform[row["platform"]] = row
+    return {"schema": "singa-tpu-bench-report/1", "rounds": rows,
+            "threshold": threshold,
+            "regressions": [r for row in rows
+                            for r in row["regressions"]]}
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if abs(v) >= 1000:
+            return f"{v:,.0f}"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _fmt_delta(d):
+    return "" if d is None else f" ({d:+.1%})"
+
+
+def render_table(report):
+    """Plain-text trajectory table: one block per round (records carry
+    different leg sets per round, so a fixed-width grid would be
+    mostly holes)."""
+    lines = []
+    for row in report["rounds"]:
+        head = f"round r{row['round']:02d}"
+        if row.get("measured_at"):
+            head += f"  {row['measured_at']}"
+        if row.get("git"):
+            head += f"  git {row['git']}"
+        if row.get("device_kind"):
+            head += f"  [{row['device_kind']}]"
+        lines.append(head)
+        for name, _fn in METRICS:
+            v = row["metrics"].get(name)
+            if v is None:
+                continue
+            flag = next((r for r in row["regressions"]
+                         if r["metric"] == name), None)
+            lines.append(
+                f"  {name:<14} {_fmt(v):>12}"
+                f"{_fmt_delta(row['deltas'].get(name))}"
+                + ("   << REGRESSION" if flag else ""))
+        for leg, tl in (row.get("timeline") or {}).items():
+            fr = tl.get("fractions") or {}
+            parts = " ".join(f"{b}={fr[b]:.0%}" for b in
+                             ("compute", "collective", "memcpy",
+                              "host", "idle") if b in fr)
+            exp = tl.get("exposed_collective_s")
+            lines.append(f"  {leg + '_timeline':<14} {parts}"
+                         + (f"  exposed_comm={exp * 1e3:.3g}ms"
+                            if exp is not None else ""))
+        lines.append("")
+    regs = report["regressions"]
+    lines.append(f"{len(report['rounds'])} round(s), "
+                 f"{len(regs)} regression(s) at "
+                 f"threshold {report['threshold']:.0%}")
+    for r in regs:
+        lines.append(f"  REGRESSION {r['metric']}: "
+                     f"{_fmt(r['prev'])} -> {_fmt(r['now'])} "
+                     f"({r['delta']:+.1%})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def selftest():
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        recs = [
+            # r1: fp32 only, no timeline yet
+            {"n": 1, "parsed": {
+                "value": 1000.0, "mfu": 0.12, "platform": "tpu",
+                "device_kind": "TPU v5 lite", "git": "aaa111",
+                "measured_at": "2026-01-01T00:00:00"}},
+            # r2: bf16 + lm appear, timeline banked
+            {"n": 2, "parsed": {
+                "value": 1100.0, "mfu": 0.14, "platform": "tpu",
+                "bf16_throughput": 2400.0, "bf16_mfu": 0.30,
+                "lm_tokens_per_sec": 140000.0,
+                "hbm_peak_bytes": 6 * 2**30, "git": "bbb222",
+                "timeline": {"fractions": {
+                    "compute": 0.5, "collective": 0.1, "memcpy": 0.05,
+                    "host": 0.15, "idle": 0.2},
+                    "exposed_collective_s": 4e-5, "window_s": 4e-4},
+                "serving": {"decode_tok_s": 500.0,
+                            "p99_token_s": 0.002}}},
+            # r3: bf16 REGRESSES 20%, lm improves; a cpu-fallback round
+            # in between must NOT become anyone's comparison baseline
+            {"n": 3, "parsed": {
+                "value": 9.0, "platform": "cpu", "git": "ccc333"}},
+            {"n": 4, "parsed": {
+                "value": 1105.0, "platform": "tpu",
+                "bf16_throughput": 1920.0,
+                "lm_tokens_per_sec": 150000.0, "git": "ddd444"}},
+        ]
+        for r in recs:
+            with open(os.path.join(td, f"BENCH_r{r['n']:02d}.json"),
+                      "w") as f:
+                json.dump(r, f)
+        # a torn file must be skipped, not fatal
+        with open(os.path.join(td, "BENCH_r99.json"), "w") as f:
+            f.write("{torn")
+
+        records = load_records(td)
+        assert [n for n, _p in records] == [1, 2, 3, 4], records
+        report = build_report(records, threshold=0.05)
+        rows = {r["round"]: r for r in report["rounds"]}
+
+        assert rows[1]["metrics"]["img_s"] == 1000.0
+        assert rows[1]["deltas"] == {}           # nothing to diff yet
+        # r2 deltas against r1; legs appearing for the first time have
+        # no delta
+        assert abs(rows[2]["deltas"]["img_s"] - 0.10) < 1e-9
+        assert "bf16_img_s" not in rows[2]["deltas"]
+        assert rows[2]["timeline"]["fp32"]["fractions"]["idle"] == 0.2
+        assert "serving" not in rows[2]["timeline"]  # no timeline there
+        assert rows[2]["metrics"]["serve_tok_s"] == 500.0
+        assert rows[2]["metrics"]["serve_p99_ms"] == 2.0
+        assert rows[2]["metrics"]["hbm_peak_gib"] == 6.0
+        # the cpu-fallback round has no tpu baseline: no delta, no flag
+        assert rows[3]["deltas"] == {} and not rows[3]["regressions"]
+        # r4 compares against r2 (the previous TPU round, ACROSS the
+        # cpu round): the 20% bf16 drop is flagged; the small fp32
+        # wiggle and the lm IMPROVEMENT are not
+        (reg,) = report["regressions"]
+        assert reg["metric"] == "bf16_img_s" and \
+            abs(reg["delta"] + 0.20) < 1e-9 and \
+            reg["vs_round"] == 2, reg
+        assert rows[4]["deltas"]["lm_tok_s"] > 0
+        assert not [r for r in rows[4]["regressions"]
+                    if r["metric"] != "bf16_img_s"]
+
+        text = render_table(report)
+        assert "REGRESSION" in text and "bf16_img_s" in text
+        assert "compute=50%" in text and "exposed_comm" in text
+        json.dumps(report)                       # JSON-able end to end
+    print("selftest: OK — 4-round trajectory extracted, same-platform "
+          "deltas and timeline columns rendered, the 20% bf16 drop "
+          "flagged across the cpu round, torn record skipped")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="render the banked BENCH_r*.json benchmark "
+                    "trajectory (per-leg throughput/MFU/HBM/timeline "
+                    "with regression deltas)")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON instead of the "
+                         "table")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="fractional drop that flags a regression "
+                         "(default 0.05)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in synthetic-trajectory check "
+                         "(the tier-1 CI gate)")
+    args = ap.parse_args()
+    if args.selftest:
+        selftest()
+        return
+    records = load_records(args.dir)
+    if not records:
+        print(f"no BENCH_r*.json records under {args.dir}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    report = build_report(records, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_table(report))
+    # regressions exit nonzero so a cron wrapper can alarm on it
+    if report["regressions"]:
+        raise SystemExit(3)
+
+
+if __name__ == "__main__":
+    main()
