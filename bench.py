@@ -98,6 +98,7 @@ EXTRA_SUCCESS_MARKERS = {
     "hbm_footprint": ("hbm_resnet50_b32_bf16", "hbm_lm_b8_s1024_bf16"),
     "lm_fusion_profile": ("lm_bf16_fusion_profile",),
     "resnet_stem_ab": ("resnet_stem_ab",),
+    "fused_optim_ab": ("fused_optim_ab",),
     "resnet50_bf16_large_batch": ("resnet50_bf16_b128",),
     "mlp_step_time": ("mlp_mnist_b64_step_us",),
     "flash_block_sweep": ("flash_block_best",),
@@ -179,6 +180,38 @@ def _resnet_stem():
     return _measured_choice("BENCH_RESNET_STEM",
                             ("conv7", "space_to_depth"),
                             "resnet_stem_ab", "conv7")
+
+
+def _fused_optim():
+    """Fused-vs-reference optimizer update for the train legs, same
+    mechanism: BENCH_FUSED_OPTIM pin, or the banked ``fused_optim_ab``
+    hardware A/B winner (tools/tpu_probe_extra.py measures the b32
+    bf16 ResNet step both ways; parity is test-pinned), else reference
+    — the Pallas fused path (ops/fused_optim.py) is never on
+    unconditionally."""
+    return _measured_choice("BENCH_FUSED_OPTIM", ("fused", "reference"),
+                            "fused_optim_ab", "reference")
+
+
+def _grad_bucket_mb():
+    """Gradient-psum bucket size (DistOpt ``bucket_mb``) for any
+    multi-device leg/probe, same mechanism: BENCH_BUCKET_MB pin over a
+    small sweep grid, or the banked ``grad_bucket_ab`` winner, else 0
+    (per-gradient streaming psums). Returns (float_mb, source)."""
+    val, src = _measured_choice("BENCH_BUCKET_MB",
+                                ("0", "1", "2", "4", "8", "16"),
+                                "grad_bucket_ab", "0")
+    return float(val), src
+
+
+def _conv_epilogue():
+    """Inference conv-epilogue fusion (BN scale/shift + ReLU in one
+    Pallas pass, ops/fused_epilogue.py) for the inference/serving
+    legs: BENCH_CONV_EPILOGUE pin, else the banked ``conv_epilogue_ab``
+    winner, else reference."""
+    return _measured_choice("BENCH_CONV_EPILOGUE",
+                            ("fused", "reference"),
+                            "conv_epilogue_ab", "reference")
 
 
 def _compile_cache_dir():
@@ -317,7 +350,7 @@ def _bf16_leg_dtype():
 
 
 def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
-                       layout="NCHW", stem=None):
+                       layout="NCHW", stem=None, fused_optim=None):
     """Build + compile THE canonical benchmark ResNet train step (SGD
     momentum 0.9, weight_decay 1e-5, synthetic data) and return its
     step() closure — the single source for the timing legs AND the
@@ -326,16 +359,24 @@ def _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
     ``dtype_name``: "float32" | "bfloat16" (legacy ad-hoc input cast:
     params follow the bf16 input) | "bf16_mixed" (the framework's
     precision policy: fp32 masters + loss scaling, bf16 compute — what
-    production training actually runs)."""
+    production training actually runs).
+
+    ``fused_optim``: True/False pins the Pallas fused optimizer-update
+    path; None resolves the banked ``fused_optim_ab`` winner via
+    ``_fused_optim()`` (reference when unmeasured — the kernel itself
+    additionally declines off-TPU)."""
     from singa_tpu import tensor, opt
     from singa_tpu.models import resnet
     import jax.numpy as jnp
     import numpy as np
 
     stem = stem or _resnet_stem()[0]
+    if fused_optim is None:
+        fused_optim = _fused_optim()[0] == "fused"
     model = resnet.create_model(depth=depth, num_classes=10, num_channels=3,
                                 layout=layout, stem=stem)
-    model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
+    model.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5,
+                                fused=bool(fused_optim)))
 
     x = np.random.randn(batch, 3, image_size, image_size).astype(np.float32)
     y = np.eye(10)[np.random.randint(0, 10, batch)].astype(np.float32)
@@ -414,14 +455,15 @@ def _peak_hbm(dev):
 
 
 def _measure(dev, batch, niters, warmup, image_size, depth, dtype_name,
-             layout="NCHW", stem=None, extras=None):
+             layout="NCHW", stem=None, extras=None, fused_optim=None):
     """Returns (images/sec, step_ms); when the caller passes an
     ``extras`` dict, ``xla_flops_per_step`` and ``peak_hbm_bytes`` are
     recorded into it (an out-param so the 2-tuple shape external
     probes consume stays stable)."""
     cc0 = _compile_stats()
     step = _setup_resnet_step(dev, batch, image_size, depth, dtype_name,
-                              layout=layout, stem=stem)
+                              layout=layout, stem=stem,
+                              fused_optim=fused_optim)
     loss = None
     for _ in range(warmup):
         loss = step()
@@ -494,6 +536,7 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     peak32 = _peak_flops(kind, dtype="fp32")
     layout, layout_src = _conv_layout()
     stem, stem_src = _resnet_stem()
+    fused_mode, fused_src = _fused_optim()
 
     def _mfu_xla(flops_per_step, rate, units_per_step, peak_flops):
         """achieved/peak from XLA-counted per-step flops + the measured
@@ -529,6 +572,8 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         "conv_layout_src": layout_src,
         "resnet_stem": stem,
         "resnet_stem_src": stem_src,
+        "fused_optim": fused_mode,
+        "fused_optim_src": fused_src,
         "platform": platform,
         "device_kind": kind or "unknown",
         # distinguishes honest slope-readback records from the earlier
@@ -743,6 +788,38 @@ def _measure_quant(dev, batch=32, image_size=224, depth=50, niters=20,
     if peak:
         out["resnet_mfu"] = out["resnet_img_s"] * \
             (RESNET50_TRAIN_FLOPS_PER_IMAGE / 3) / peak
+    # conv-epilogue choice (ops/fused_epilogue.py — BN scale/shift +
+    # ReLU in one pass): the kernel only fires inside a traced
+    # forward on TPU, so the fused sub-leg times a JITTED inference
+    # (banked as its own metric — the eager resnet_img_s trend above
+    # stays comparable across rounds) and runs only where the kernel
+    # can actually engage. The choice + source always bank.
+    ep_mode, ep_src = _conv_epilogue()
+    out["conv_epilogue"], out["conv_epilogue_src"] = ep_mode, ep_src
+    if ep_mode == "fused":
+        import jax as _jax
+        if _jax.default_backend() == "tpu":
+            from singa_tpu.ops import fused_epilogue as _fe
+
+            def _fwd(arr):
+                t = tensor.Tensor(data=arr, device=dev,
+                                  requires_grad=False)
+                with model._policy_scope():
+                    return model.forward(t).data
+
+            with _fe.enabled_scope(True):
+                jf = _jax.jit(_fwd)
+                o = None
+                for _ in range(warmup):
+                    o = jf(tx.data)
+                _force(o)
+                dt2 = _slope_time(lambda: jf(tx.data), lambda t: t,
+                                  max(1, niters // 4), niters)
+            out["resnet_img_s_fused_epilogue"] = batch / dt2
+        else:
+            out["conv_epilogue"] = "reference"
+            out["conv_epilogue_note"] = \
+                "fused winner banked but backend is not tpu"
     del model, tx
 
     # -- int8 LM inference tok/s ----------------------------------------
@@ -1578,7 +1655,8 @@ def _emit_report(res, live, smoke, obs, errors):
     # tokens/s, timing method, partial/suspect flags), not just the
     # headline images/sec
     for k in ("mfu", "mfu_xla", "mfu_denominator", "conv_layout",
-              "conv_layout_src", "resnet_stem", "resnet_stem_src", "git",
+              "conv_layout_src", "resnet_stem", "resnet_stem_src",
+              "fused_optim", "fused_optim_src", "git",
               "bf16_throughput", "bf16_step_ms", "bf16_mfu",
               "bf16_mfu_xla", "bf16_mode",
               "bf16_error", "lm_tokens_per_sec", "lm_bf16_tokens_per_sec",

@@ -17,6 +17,7 @@ Usage: python examples/train_cnn.py [cnn|alexnet|resnet|xceptionnet|mlp]
            [-p float32|bfloat16|bf16_mixed] [--layout auto|NCHW|NHWC]
            [--dist] [--dist-option plain|half|partialUpdate|
             sparseTopK|sparseThreshold] [--spars 0.05] [--cpu]
+           [--bucket-mb 0] [--no-overlap] [--fused-optim]
            [--verbosity 0] [--npz path.npz]
            [--resilient] [--ckpt-dir ckpts_cnn] [--save-every 50]
            [--profile-every 0] [--anomaly-factor F]
@@ -85,6 +86,27 @@ def build_parser():
     ap.add_argument("--dist", action="store_true")
     ap.add_argument("--dist-option", default="plain")
     ap.add_argument("--spars", type=float, default=0.05)
+    ap.add_argument("--bucket-mb", default="0",
+                    help="with --dist: gradient-psum bucket size target "
+                         "in MiB (DistOpt bucket_mb) — gradients "
+                         "coalesce into size-targeted buckets, one "
+                         "collective each, issued as backward produces "
+                         "them so XLA hides them under remaining "
+                         "backward compute; 0 = per-gradient streaming "
+                         "psums (default); 'auto' resolves the banked "
+                         "grad_bucket_ab winner via "
+                         "bench._grad_bucket_mb (BENCH_BUCKET_MB pin "
+                         "> measured winner > 0). Read the win off "
+                         "timeline_exposed_collective_seconds")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="with --dist: pin every gradient collective "
+                         "behind the FULL backward (the measured "
+                         "no-overlap baseline an A/B compares against)")
+    ap.add_argument("--fused-optim", action="store_true",
+                    help="route eligible optimizer updates through the "
+                         "one-HBM-pass Pallas kernels "
+                         "(ops/fused_optim.py; declines to the "
+                         "reference path off-TPU)")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--no-augment", action="store_true")
     ap.add_argument("--verbosity", "-v", type=int, default=0)
@@ -225,8 +247,29 @@ def main():
             kw = {"layout": layout, "stem": args.stem}
         model = factory.create_model(num_channels=chans,
                                      num_classes=num_classes, **kw)
-    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
-    opt_obj = opt.DistOpt(sgd) if args.dist else sgd
+    if args.bucket_mb == "auto":
+        # same mechanism as --layout auto: the banked hardware A/B
+        # winner through bench's measured-choice plumbing
+        try:
+            import bench
+            bucket_mb, bucket_src = bench._grad_bucket_mb()
+        except Exception as e:  # noqa: BLE001 — the example must run
+            bucket_mb, bucket_src = 0.0, \
+                f"unmeasured-fallback ({type(e).__name__})"
+        if args.dist:
+            print(f"grad bucket: {bucket_mb} MiB ({bucket_src})",
+                  flush=True)
+    else:
+        bucket_mb = float(args.bucket_mb)
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5,
+                  fused=args.fused_optim)
+    opt_obj = opt.DistOpt(sgd, bucket_mb=bucket_mb,
+                          overlap=not args.no_overlap) \
+        if args.dist else sgd
+    if not args.dist and (bucket_mb or args.no_overlap):
+        print("note: --bucket-mb/--no-overlap shape the gradient "
+              "collectives and need --dist; ignored on a single "
+              "replica", flush=True)
     if args.resilient:
         from singa_tpu.resilience import GuardedOptimizer
         # (Without --resilient, compile(policy="bf16_mixed") wraps a

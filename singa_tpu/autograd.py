@@ -968,6 +968,14 @@ def sign(x):
 
 
 def relu(x):
+    if getattr(x, "_bn_epilogue", None) is not None:
+        # a tagged inference-BN output may fuse scale/shift+relu into
+        # one pass over the conv output (ops/fused_epilogue.py peephole;
+        # opt-in + eligibility-gated — returns None to decline)
+        from .ops import fused_epilogue
+        fused = fused_epilogue.try_relu_epilogue(x)
+        if fused is not None:
+            return fused
     return ReLU()(x)
 
 

@@ -763,12 +763,13 @@ class DevicePrefetcher:
             out, loss = model(tx, ty)
     """
 
-    def __init__(self, iterator, device, depth=2):
+    def __init__(self, iterator, device, depth=2, background=False):
         from .tensor import Tensor
         self._Tensor = Tensor
         self.iterator = iterator       # re-iterated per epoch in __iter__
         self.device = device
         self.depth = max(1, int(depth))
+        self.background = bool(background)
         self._consumed_state = None
 
     # -- state protocol ----------------------------------------------------
@@ -801,9 +802,8 @@ class DevicePrefetcher:
                          requires_grad=False)
             for a in batch)
 
-    def __iter__(self):
+    def _source(self):
         import types
-        from collections import deque
         src = iter(self.iterator)
         if isinstance(self.iterator, types.GeneratorType):
             # an exhausted generator silently yields nothing — make a
@@ -817,6 +817,14 @@ class DevicePrefetcher:
                     "already exhausted; pass a re-iterable (e.g. "
                     "NumpyBatchIter) for multi-epoch use")
             self._consumed_oneshot = True
+        return src
+
+    def __iter__(self):
+        if self.background:
+            yield from self._iter_background()
+            return
+        from collections import deque
+        src = self._source()
         sd = getattr(self.iterator, "state_dict", None)
         pending = deque()   # (staged batch, inner state AFTER that batch)
 
@@ -833,3 +841,70 @@ class DevicePrefetcher:
                 yield emit()
         while pending:
             yield emit()
+
+    def _iter_background(self):
+        """Double-buffered staging on a worker thread: while step N
+        computes, the worker pulls batch N+1 from the source (host
+        decode/augment) AND issues its asynchronous ``device_put`` —
+        the consumer never blocks on either, so the step loop's host
+        gap (``timeline_mfu_loss{host}``) collapses to a queue get.
+
+        Exactly-once semantics are IDENTICAL to the synchronous path:
+        the inner state is snapshotted per staged batch on the worker
+        (the worker is the only thread driving the source, so the
+        snapshot is race-free) and only becomes ``state_dict()``'s
+        answer when that batch is HANDED OUT — staged-but-unconsumed
+        batches replay after a resume, a consumed batch never does. A
+        source failure re-raises at the hand-out point, and abandoning
+        the generator (break / GC) stops and joins the worker."""
+        import queue as _queue
+        import threading
+
+        src = self._source()
+        sd = getattr(self.iterator, "state_dict", None)
+        q = _queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for batch in src:
+                    staged = self._stage(batch)
+                    st = sd() if callable(sd) else None
+                    if not _put(("item", (staged, st))):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised at get
+                _put(("error", e))
+                return
+            _put(("end", None))
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="singa-prefetch")
+        t.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "end":
+                    break
+                if kind == "error":
+                    raise payload
+                staged, st = payload
+                if st is not None:
+                    self._consumed_state = st
+                yield staged
+        finally:
+            stop.set()
+            try:                # unblock a worker stuck on a full queue
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+            t.join(timeout=5)

@@ -628,8 +628,15 @@ class Model(Layer):
             ins = [Tensor(data=next(it), device=self.dev,
                           requires_grad=False) if s is _TENSOR else s
                    for s in layout]
-            with self._policy_scope():
+            from .ops import fused_optim as _fused
+            fused_kinds = []
+            with self._policy_scope(), _fused.trace_collector(fused_kinds):
                 res = self.train_one_batch(*ins)
+            if fused_kinds:
+                # the program contains fused Pallas custom calls whose
+                # FLOPs XLA's cost analysis cannot count — step_flops
+                # must use the reference twin for MFU (see step_flops)
+                rec["fused_kinds"] = sorted(set(fused_kinds))
             leaves = []
             rec["out_tree"]["tree"] = _flatten(res, leaves)
             pol = getattr(self, "_policy", None)
@@ -689,12 +696,14 @@ class Model(Layer):
                 mapped = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                                    out_specs=tuple(out_specs),
                                    **_shard_map_compat_kwargs())
+                rec["raw_fn"] = mapped   # step_flops' reference twin
                 return jax.jit(mapped, donate_argnums=(0,))
 
             rec["builder"] = build
             self._mesh, self._axis = mesh, axis
         else:
             rec["jit"] = jax.jit(fn, donate_argnums=(0,))
+            rec["raw_fn"] = fn
         return rec
 
     def _cast_output_tree(self, res):
@@ -1334,6 +1343,57 @@ class Model(Layer):
             return None
         if "step_flops" in rec:
             return rec["step_flops"]
+        if rec.get("fused_kinds"):
+            # the executed program fuses optimizer updates into Pallas
+            # custom calls, which XLA's cost analysis cannot see into
+            # (on TPU they count ~0 flops; interpret mode counts the
+            # emulation loop instead) — either way the analyzed number
+            # would move vs the unfused program and MFU would lie. Lower
+            # a REFERENCE twin of the same signature with every fused
+            # kernel declined: fused and unfused programs then report
+            # IDENTICAL FLOPs by construction. One extra trace+compile,
+            # on the cost-analysis path only, never the step path
+            # (compute=False still returns None until someone pays it).
+            if not compute:
+                return None
+            raw = rec.get("raw_fn")
+            if raw is None:
+                return None
+            state_avals, rng_aval, in_avals = rec["avals"]
+            from .ops import fused_optim as _fused
+            # a FRESH jit forces a fresh trace (the step's own jit would
+            # serve its cached — fused — jaxpr from lower()); the traced
+            # body mutates live state tensors and the device rng, so
+            # snapshot and restore around it exactly like graph_debug
+            backup = [(t, t.data) for t in (self._state_list or [])]
+            rng_backup = self.dev._get_rng_state()
+            # a fresh closure defeats jax's global trace cache (keyed on
+            # the function object — reusing `raw` would serve the FUSED
+            # jaxpr without ever re-running the body)
+            def _twin_body(state_arrays, rng_key, *input_arrays):
+                return raw(state_arrays, rng_key, *input_arrays)
+
+            try:
+                with _fused.force_reference():
+                    twin = jax.jit(_twin_body, donate_argnums=(0,)).lower(
+                        state_avals, rng_aval, *in_avals).compile()
+                cost = twin.cost_analysis()
+            except Exception:
+                rec["step_flops"] = None
+                return None
+            finally:
+                for t, d in backup:
+                    t.data = d
+                self.dev._set_rng_state(rng_backup)
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            flops = None
+            if isinstance(cost, dict):
+                f = cost.get("flops")
+                if f and f > 0:
+                    flops = float(f)
+            rec["step_flops"] = flops
+            return flops
         cost = rec.get("cost")              # verbosity>=2 capture
         compiled = rec.get("audit_compiled")
         if cost is None:

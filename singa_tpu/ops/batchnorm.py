@@ -152,4 +152,12 @@ def batchnorm_2d(handle: BatchNormHandle, x, scale, bias,
             (x, scale, bias, running_mean, running_var)
     # keep references for ONNX export (BatchNormalization's mean/var inputs)
     op.running_mean, op.running_var = running_mean, running_var
-    return op(*args)
+    out = op(*args)
+    if isinstance(op, _BatchNorm2dInference) and not handle.is_2d:
+        # tag the frozen-stats output with its folding ingredients: a
+        # ReLU consuming it may fuse the whole scale/shift+relu epilogue
+        # into one pass over the conv output (ops/fused_epilogue.py —
+        # opt-in, traced inference only; the tag itself is one attr)
+        out._bn_epilogue = (x, scale, bias, running_mean, running_var,
+                            handle.eps, handle.layout)
+    return out

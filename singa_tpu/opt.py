@@ -164,6 +164,26 @@ class Optimizer:
         mult = self._lr_multipliers.get(name)
         return self.lr_value * mult if mult is not None else self.lr_value
 
+    def _fused_ok(self, name, p):
+        """Whether THIS param's update may take the fused Pallas kernel:
+        the optimizer was built with ``fused=True``, no regularizer or
+        constraint applies to the param (their math is caller-composed
+        and stays on the reference path — declining keeps them correct
+        rather than silently dropped), the param is floating, and the
+        backend-eligibility gate (``ops.fused_optim.available``) says a
+        kernel launch pays for itself. Everything else falls through to
+        the reference elementwise chain, per-param."""
+        if not getattr(self, "fused", False):
+            return False
+        if self._regularizers.get(name, self.regularizer) is not None:
+            return False
+        if self._constraints.get(name, self.constraint) is not None:
+            return False
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            return False
+        from .ops import fused_optim
+        return fused_optim.available(int(np.prod(p.shape)))
+
     # -- lr as a traced value --------------------------------------------
     @property
     def lr_value(self):
@@ -291,15 +311,25 @@ class Optimizer:
 
 class SGD(Optimizer):
     """SGD with momentum / nesterov / weight decay (reference opt.py:174-334,
-    update composed of the same axpy algebra, now one fused XLA kernel)."""
+    update composed of the same axpy algebra, now one fused XLA kernel).
+
+    ``fused=True`` routes eligible per-param updates through the
+    one-HBM-pass Pallas kernel (``ops.fused_optim.sgd_momentum_update``;
+    momentum runs only — a momentum-less SGD has no aux to fuse with).
+    Ineligible params (regularizer/constraint attached, too small for a
+    kernel launch, non-TPU backend without the interpret test hook)
+    keep the reference path per-param. Parity is pinned in
+    tests/test_fused_kernels.py; bench selects the mode via the banked
+    ``fused_optim_ab`` A/B — never unconditionally."""
 
     def __init__(self, lr=0.1, momentum=0.0, dampening=0.0,
-                 weight_decay=0.0, nesterov=False):
+                 weight_decay=0.0, nesterov=False, fused=False):
         super().__init__(lr)
         self.momentum = momentum
         self.dampening = dampening
         self.weight_decay = weight_decay
         self.nesterov = nesterov
+        self.fused = bool(fused)
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires momentum>0 and dampening=0")
@@ -307,8 +337,19 @@ class SGD(Optimizer):
     def apply(self, name, p: Tensor, g: Tensor):
         grad = g.data if isinstance(g, Tensor) else g
         grad = grad.astype(p.dtype)
-        if self.weight_decay != 0 and self.should_apply_weight_decay(name):
-            grad = grad + self.weight_decay * p.data
+        wd = self.weight_decay \
+            if self.weight_decay != 0 and \
+            self.should_apply_weight_decay(name) else 0.0
+        if self.momentum != 0 and self._fused_ok(name, p):
+            from .ops import fused_optim
+            buf = self._get_aux(f"{name}:momentum", p)
+            p.data, buf.data = fused_optim.sgd_momentum_update(
+                p.data, grad, buf.data, self._scaled_lr(name),
+                momentum=self.momentum, dampening=self.dampening,
+                weight_decay=wd, nesterov=self.nesterov)
+            return
+        if wd:
+            grad = grad + wd * p.data
         grad = self.apply_regularizer_constraint(name, p.data, grad)
         if self.momentum != 0:
             buf = self._get_aux(f"{name}:momentum", p)
@@ -363,19 +404,38 @@ class AdaGrad(Optimizer):
 
 
 class Adam(Optimizer):
-    """(reference opt.py:536-660)"""
+    """(reference opt.py:536-660)
+
+    ``fused=True``: eligible params update through the one-HBM-pass
+    Pallas kernel (``ops.fused_optim.adam_update``; amsgrad keeps the
+    reference path — its vmax compare-exchange is a fourth state tensor
+    the fused contract doesn't cover). Same gating/parity story as
+    ``SGD(fused=True)``."""
 
     def __init__(self, lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
-                 weight_decay=0.0, amsgrad=False):
+                 weight_decay=0.0, amsgrad=False, fused=False):
         super().__init__(lr)
         self.beta_1 = beta_1
         self.beta_2 = beta_2
         self.epsilon = epsilon
         self.weight_decay = weight_decay
         self.amsgrad = amsgrad
+        self.fused = bool(fused)
 
     def apply(self, name, p: Tensor, g: Tensor):
         grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
+        if not self.amsgrad and self._fused_ok(name, p):
+            from .ops import fused_optim
+            m = self._get_aux(f"{name}:m", p)
+            v = self._get_aux(f"{name}:v", p)
+            t = self.step_counter.data + 1.0
+            p.data, m.data, v.data = fused_optim.adam_update(
+                p.data, grad, m.data, v.data, self._scaled_lr(name),
+                1 - jnp.power(self.beta_1, t),
+                1 - jnp.power(self.beta_2, t),
+                beta_1=self.beta_1, beta_2=self.beta_2,
+                epsilon=self.epsilon, weight_decay=self.weight_decay)
+            return
         if self.weight_decay != 0:
             grad = grad + self.weight_decay * p.data
         grad = self.apply_regularizer_constraint(name, p.data, grad)
@@ -409,10 +469,26 @@ class DistOpt:
 
     def __init__(self, opt=None, nccl_id=None, local_rank=None,
                  world_size=None, buffSize=None, axis_name="data",
-                 reduce_axes=None):
+                 reduce_axes=None, bucket_mb=None, overlap=True):
         """``reduce_axes``: mesh axes gradients are summed over (default
         just the data axis; add 'seq' under sequence parallelism where the
-        token batch is split over that axis too)."""
+        token batch is split over that axis too).
+
+        ``bucket_mb``: size target (MiB of wire bytes) for gradient-psum
+        bucketing. ``None``/``0`` keeps the per-gradient streaming psum;
+        a positive value makes :meth:`grad_reduce_stream` concatenate
+        gradients — in the reverse-layer order backward produces them —
+        into size-targeted buckets and issue ONE collective per bucket
+        the moment it fills, so XLA can hide the fewer, larger
+        all-reduces under the remaining backward compute (the
+        ``timeline_exposed_collective_seconds`` target). A python attr
+        read at trace time: changing it after ``compile`` needs a
+        recompile, like every other static step config.
+
+        ``overlap=False`` is the measured no-overlap BASELINE: every
+        collective is pinned behind the full backward via
+        ``lax.optimization_barrier``, so an A/B against it shows what
+        the overlap actually buys on the step timeline."""
         from .parallel.communicator import Communicator
         self.opt = opt if opt is not None else SGD()
         self.communicator = Communicator(axis_name=axis_name,
@@ -423,6 +499,10 @@ class DistOpt:
             else self.communicator.local_rank
         self.global_rank = self.communicator.global_rank
         self.axis_name = axis_name
+        self.bucket_mb = float(bucket_mb) if bucket_mb else 0.0
+        if self.bucket_mb < 0:
+            raise ValueError(f"bucket_mb must be >= 0, got {bucket_mb!r}")
+        self.overlap = bool(overlap)
         # sparsification error-feedback residuals (reference sparse modes)
         self._residuals = {}
 
@@ -551,19 +631,145 @@ class DistOpt:
         pol = active_policy()
         return pol.comm_dtype if pol is not None else None
 
+    # -- bucketed gradient reduction ----------------------------------------
+    def _wire_cast_back(self, arr, orig_dtype, wire):
+        """all_reduce_wire's post-reduce rule, factored for the bucketed
+        path: a gradient that was CAST to a 16-bit wire comes back f32;
+        one already on the wire dtype (or reduced with no wire policy)
+        keeps its dtype."""
+        if wire is not None and orig_dtype != wire:
+            return arr.astype(jnp.float32)
+        return arr
+
+    def _flush_bucket(self, key, items, wire):
+        """Reduce one bucket with a SINGLE collective: concatenate the
+        members' (wire-cast) flattened gradients, all-reduce the buffer,
+        split it back, and re-apply the per-gradient cast-back rule —
+        numerically the same elements summed over the same replicas as
+        per-gradient psums, just fewer/larger wire messages."""
+        excl, eff = key
+        casts = [g.data.astype(eff) if g.data.dtype != eff else g.data
+                 for _p, g in items]
+        if len(items) == 1:
+            # a lone member (oversized grad, stream tail) skips the
+            # concat/split round trip
+            (p, g), red = items[0], self.all_reduce(casts[0], exclude=excl)
+            g.data = self._wire_cast_back(red, g.data.dtype, wire)
+            return [(p, g)]
+        buf = jnp.concatenate([c.ravel() for c in casts])
+        red = self.all_reduce(buf, exclude=excl)
+        out, off = [], 0
+        for (p, g), c in zip(items, casts):
+            piece = red[off:off + c.size].reshape(c.shape)
+            off += c.size
+            g.data = self._wire_cast_back(piece, g.data.dtype, wire)
+            out.append((p, g))
+        return out
+
+    def grad_reduce_stream(self, pairs, wire=None):
+        """Generator transform over backward's ``(param, grad)`` stream:
+        yields the same pairs with ``grad.data`` SUMMED over the reduce
+        axes (averaging stays with the consumer, :meth:`update`). The
+        ONE reduction chokepoint the plain and guarded drivers share, so
+        bucketing/overlap config and the 16-bit wire-cast discipline
+        (:meth:`all_reduce_wire` semantics, preserved per-gradient) can
+        never diverge between them.
+
+        - default (``overlap=True, bucket_mb=0``): per-gradient psum the
+          moment backward yields it — the streaming path unchanged;
+        - ``bucket_mb>0``: gradients accumulate into size-targeted
+          buckets keyed by (shard-exclude axes, wire dtype) — members of
+          different keys cannot share a collective — and each bucket
+          reduces with ONE concatenated all-reduce as soon as it fills
+          (backward yields reverse-layer order, so the bucket's grads
+          are the newest ready and the collective overlaps the rest of
+          backward);
+        - ``overlap=False``: every gradient is first pinned behind the
+          COMPLETE backward with ``lax.optimization_barrier`` — the
+          honest no-overlap baseline an A/B measures against (without
+          the barrier XLA's scheduler would overlap anyway, making the
+          "off" leg a lie).
+        """
+        if wire is None:
+            wire = self._policy_wire()
+        if self.overlap and not self.bucket_mb:
+            for p, g in pairs:
+                g.data = self.all_reduce_wire(
+                    g.data, exclude=self._shard_axes(p), wire=wire)
+                yield p, g
+            return
+        if not self.overlap:
+            # materialise the whole backward, then tie every grad to the
+            # full set: no collective can issue before backward finishes
+            pairs = list(pairs)
+            barriered = jax.lax.optimization_barrier(
+                tuple(g.data for _p, g in pairs))
+            for (_p, g), arr in zip(pairs, barriered):
+                g.data = arr
+            pairs = iter(pairs)
+        if not self.bucket_mb:
+            for p, g in pairs:
+                g.data = self.all_reduce_wire(
+                    g.data, exclude=self._shard_axes(p), wire=wire)
+                yield p, g
+            return
+        target = int(self.bucket_mb * (1 << 20))
+        buckets = {}          # (excl, eff_dtype) -> [items, nbytes]
+        order = []            # flush stale buckets in arrival order
+        for p, g in pairs:
+            excl = self._shard_axes(p)
+            eff = np.dtype(wire) if wire is not None \
+                else np.dtype(g.data.dtype)
+            key = (excl, eff)
+            if key not in buckets:
+                buckets[key] = [[], 0]
+                order.append(key)
+            slot = buckets[key]
+            slot[0].append((p, g))
+            slot[1] += int(np.prod(np.shape(g.data))) * eff.itemsize
+            if slot[1] >= target:
+                items, _n = buckets.pop(key)
+                order.remove(key)
+                yield from self._flush_bucket(key, items, wire)
+        for key in order:
+            yield from self._flush_bucket(key, buckets[key][0], wire)
+
+    def _warn_driver_skips_bucketing(self, driver):
+        """The specialised drivers (half / partialUpdate / sparse) keep
+        their own per-gradient reduction paths: a bucket_mb/overlap
+        config would be silently dead there, and a user A/B'ing the
+        overlap knobs under them would bank a comparison of two
+        identical programs. Say so, once per driver."""
+        if not self.bucket_mb and self.overlap:
+            return
+        warned = getattr(self, "_bucket_warned", None)
+        if warned is None:
+            warned = self._bucket_warned = set()
+        if driver in warned:
+            return
+        warned.add(driver)
+        import warnings
+        warnings.warn(
+            f"DistOpt(bucket_mb={self.bucket_mb}, overlap="
+            f"{self.overlap}) has no effect on {driver}: only the "
+            "plain and guarded drivers ride grad_reduce_stream; this "
+            "driver streams per-gradient collectives", stacklevel=3)
+
     # -- training drivers ---------------------------------------------------
     def backward_and_update(self, loss, threshold=2097152):
         """All-reduce each gradient as soon as backward produces it
         (reference opt.py:826-865). ``threshold`` is accepted for parity;
-        XLA handles small-tensor fusion so no manual fused buffer exists.
-        Under an active 16-bit precision policy the reduce moves the
-        policy's comm dtype on the wire; the update math that follows is
-        back in the masters' precision."""
+        XLA handles small-tensor fusion so no manual fused buffer exists
+        — but ``bucket_mb`` (see ``__init__``) additionally coalesces
+        gradients into size-targeted single-collective buckets through
+        :meth:`grad_reduce_stream`, the overlap knob the step timeline's
+        exposed-communication gauge steers. Under an active 16-bit
+        precision policy the reduce moves the policy's comm dtype on the
+        wire; the update math that follows is back in the masters'
+        precision."""
         wire = self._policy_wire()
-        for p, g in autograd.backward(loss):
-            g.data = self.all_reduce_wire(g.data,
-                                          exclude=self._shard_axes(p),
-                                          wire=wire)
+        for p, g in self.grad_reduce_stream(autograd.backward(loss),
+                                            wire=wire):
             self.update(p, g)
         self.opt.step()
 
@@ -596,6 +802,7 @@ class DistOpt:
         POLICY selects the fp16 wire, clipping turns on with it (this
         driver runs unguarded, so an unclipped policy-default fp16 wire
         would let one large gradient sum land inf in the params)."""
+        self._warn_driver_skips_bucketing('backward_and_update_half')
         dtype, clipping = self._half_wire_defaults(dtype, clipping)
         wire = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
                 jnp.bfloat16: jnp.bfloat16,
@@ -634,6 +841,7 @@ class DistOpt:
         but XLA cannot skip a collective on a traced predicate, so every
         gradient is still reduced and only the APPLICATION is masked.
         """
+        self._warn_driver_skips_bucketing('backward_and_partial_update')
         n = max(1, self.communicator.effective_world_size())
         if rotation is not None:
             rot = int(rotation) % n
@@ -660,6 +868,7 @@ class DistOpt:
         stays dense (masked values + psum ride the ICI all-reduce) while the
         semantics — threshold or top-K selection, residual accumulation —
         match the reference."""
+        self._warn_driver_skips_bucketing('backward_and_sparse_update')
         for p, g in autograd.backward(loss):
             name = p.name or f"param/{id(p)}"
             grad = g.data

@@ -290,15 +290,20 @@ class GuardedOptimizer:
         from .. import mixed_precision as _mp
         _pol = _mp.active_policy()
         grad_q = getattr(_pol, "grad_quant", None)
-        for p, g in autograd.backward(loss, dy=dy):
+        stream = autograd.backward(loss, dy=dy)
+        if dist is not None:
+            # the reduction rides DistOpt's shared chokepoint
+            # (grad_reduce_stream): per-grad streaming psums by default
+            # — issued as backward yields, so XLA overlaps them with
+            # remaining backward compute — or the bucketed/no-overlap
+            # form when the DistOpt is configured for it; under a
+            # 16-bit policy the wire carries the policy's comm dtype
+            # (the unscale below is f32 either way)
+            stream = dist.grad_reduce_stream(stream, wire=wire)
+        for p, g in stream:
             arr = g.data
             excl = dist._shard_axes(p) if dist is not None else ()
             if dist is not None:
-                # collectives issue per-grad as backward yields, so XLA
-                # still overlaps them with remaining backward compute;
-                # under a 16-bit policy the wire carries the policy's
-                # comm dtype (the unscale below is f32 either way)
-                arr = dist.all_reduce_wire(arr, exclude=excl, wire=wire)
                 arr = arr / dist.communicator.effective_world_size()
             arr = arr.astype(jnp.float32) * inv
             if grad_q is not None:

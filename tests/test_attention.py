@@ -147,6 +147,7 @@ class TestRingAttention:
                                    rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.pallas
 class TestPallasKernels:
     """Validate the exact Pallas kernel math on CPU via interpreter mode
     (the TPU executes the same kernels compiled). Small block sizes force
@@ -277,6 +278,7 @@ class TestPallasKernels:
         assert max(sizes) <= S * D, sizes  # biggest residual is S x D
 
 
+@pytest.mark.pallas
 class TestRingPallasPath:
     """Ring attention's per-step block computation through the Pallas
     kernel (interpret mode = the exact TPU kernel math): offsets ride in

@@ -503,6 +503,90 @@ class TestDevicePrefetcherState:
         with pytest.raises(RuntimeError, match="already exhausted"):
             list(pf)
 
+    def test_background_epoch_matches_synchronous(self):
+        """background=True (double-buffered staging on a worker thread)
+        yields the SAME batches, in the same order, with the same
+        hand-out state trajectory as the synchronous path."""
+        pf, x, y, dev = self._setup()
+        bg = DevicePrefetcher(NumpyBatchIter(x, y, 4, shuffle=False),
+                              dev, background=True)
+        sync_out, sync_states = [], []
+        for b in pf:
+            sync_out.append(b[0].numpy())
+            sync_states.append(pf.state_dict())
+        bg_out, bg_states = [], []
+        for b in bg:
+            bg_out.append(b[0].numpy())
+            bg_states.append(bg.state_dict())
+        np.testing.assert_array_equal(np.concatenate(sync_out),
+                                      np.concatenate(bg_out))
+        assert sync_states == bg_states
+
+    def test_background_state_reflects_handed_out_not_staged(self):
+        pf, x, y, dev = self._setup()
+        bg = DevicePrefetcher(NumpyBatchIter(x, y, 4, shuffle=False),
+                              dev, depth=3, background=True)
+        g = iter(bg)
+        next(g)
+        assert bg.state_dict()["position"] == 4
+        g.close()          # stops + joins the worker
+
+    def test_background_resume_replays_staged_window(self):
+        pf, x, y, dev = self._setup()
+        bg = DevicePrefetcher(NumpyBatchIter(x, y, 4, shuffle=False),
+                              dev, depth=3, background=True)
+        g = iter(bg)
+        got = [next(g), next(g)]
+        state = bg.state_dict()
+        g.close()
+        res = DevicePrefetcher(NumpyBatchIter(x, y, 4, shuffle=False),
+                               dev, depth=3, background=True)
+        res.load_state_dict(state)
+        rest = list(res)
+        seen = np.concatenate([b[0].numpy() for b in got + rest])
+        np.testing.assert_array_equal(seen, x)     # no gap, no repeat
+
+    def test_background_source_failure_raises_at_handout(self):
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+
+        def bad():
+            yield (np.ones(2, np.float32),)
+            raise ValueError("decode exploded")
+
+        bg = DevicePrefetcher(bad(), dev, background=True)
+        g = iter(bg)
+        next(g)
+        with pytest.raises(ValueError, match="decode exploded"):
+            next(g)
+
+    def test_background_exhausted_generator_guard(self):
+        from singa_tpu import device
+        dev = device.create_cpu_device()
+        bg = DevicePrefetcher((b for b in [(np.ones(2, np.float32),)]),
+                              dev, background=True)
+        assert len(list(bg)) == 1
+        with pytest.raises(RuntimeError, match="already exhausted"):
+            list(bg)
+
+    def test_background_abandonment_stops_worker(self):
+        import threading
+        pf, x, y, dev = self._setup()
+        n0 = threading.active_count()
+        bg = DevicePrefetcher(NumpyBatchIter(x, y, 4, shuffle=False),
+                              dev, depth=1, background=True)
+        g = iter(bg)
+        next(g)
+        g.close()
+        # the staging thread exits once the consumer walks away
+        for _ in range(50):
+            if threading.active_count() <= n0:
+                break
+            import time
+            time.sleep(0.02)
+        assert not [t for t in threading.enumerate()
+                    if t.name == "singa-prefetch" and t.is_alive()]
+
     def test_can_load_state_sees_through_wrappers(self):
         """The runtime's checkpointability probe answers for the INNER
         source of a delegating wrapper, not the wrapper's class."""

@@ -45,6 +45,21 @@ class TestExamples:
                            "--dist", "--dist-option", "half"])
         assert "loss" in out.lower(), out[-500:]
 
+    def test_train_cnn_overlap_fused_flags(self):
+        """The MFU-push knobs through the user CLI: gradient-psum
+        bucketing + the no-overlap baseline + the fused-optimizer flag
+        (which declines to the reference path on CPU) all train on the
+        forced multi-device mesh."""
+        out = run_example(["examples/train_cnn.py", "cnn", "--cpu",
+                           "--epochs", "1", "--iters", "3", "--bs", "8",
+                           "--dist", "--bucket-mb", "4",
+                           "--fused-optim"])
+        assert "loss" in out.lower(), out[-500:]
+        out = run_example(["examples/train_cnn.py", "cnn", "--cpu",
+                           "--epochs", "1", "--iters", "2", "--bs", "8",
+                           "--dist", "--no-overlap"])
+        assert "loss" in out.lower(), out[-500:]
+
     def test_train_cnn_resilient(self, tmp_path):
         """The fault-tolerant driver through the user CLI: trains,
         checkpoints, and a relaunch resumes instead of restarting."""

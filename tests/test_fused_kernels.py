@@ -1,0 +1,417 @@
+"""Fused-kernel numerics + accounting (``pallas`` tier: the exact TPU
+kernel math runs under ``pl.pallas_call(interpret=True)`` on CPU).
+
+Covers the MFU-push kernels of ops/fused_optim.py / ops/fused_epilogue.py:
+
+- SGD-momentum / Adam one-HBM-pass updates: parity against the
+  reference ``opt.SGD``/``opt.Adam`` math (bitwise for f32 SGD),
+  including padding tails, weight decay, nesterov, and lr schedules;
+- eligibility gating: regularizer/constraint params decline per-param,
+  ``force_reference`` declines everything, off switch is the default;
+- end-to-end: a model trained with ``fused=True`` matches its
+  reference twin state-for-state, with ``n_traces`` still 1;
+- FLOPs accounting (the satellite fix): ``Model.step_flops`` of the
+  fused program equals the unfused program's EXACTLY — no phantom MFU
+  jump from cost analysis losing (or inflating) the custom call;
+- the conv epilogue: scale/shift+ReLU kernel parity in both layouts,
+  the BN→ReLU peephole under a jit matching the reference eval, and
+  the enable gate defaulting off.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from singa_tpu import tensor, device, opt, layer, model
+from singa_tpu.ops import fused_epilogue, fused_optim
+
+pytestmark = pytest.mark.pallas
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernels():
+    prev = fused_optim.FORCE_PALLAS_INTERPRET
+    fused_optim.FORCE_PALLAS_INTERPRET = True
+    try:
+        yield
+    finally:
+        fused_optim.FORCE_PALLAS_INTERPRET = prev
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity
+# ---------------------------------------------------------------------------
+
+class TestSgdKernel:
+    # shapes straddle the (rows, 128) tiling: exact tiles, ragged
+    # tails, sub-lane scalars-ish vectors, >1 grid block
+    SHAPES = [(1024,), (64, 64), (7,), (13, 10), (4099,), (3, 3, 3, 5)]
+
+    @pytest.mark.parametrize("wd,nesterov", [(0.0, False), (1e-4, False),
+                                             (1e-4, True)])
+    def test_matches_reference_math(self, wd, nesterov):
+        for i, shape in enumerate(self.SHAPES):
+            p, g, m = _rand(shape, i), _rand(shape, i + 50), \
+                _rand(shape, i + 100)
+            pn, mn = fused_optim.sgd_momentum_update(
+                jnp.asarray(p), jnp.asarray(g), jnp.asarray(m),
+                jnp.float32(0.1), momentum=0.9, dampening=0.0,
+                weight_decay=wd, nesterov=nesterov)
+            g2 = g + wd * p
+            m_ref = (0.9 * m + g2).astype(np.float32)
+            upd = g2 + 0.9 * m_ref if nesterov else m_ref
+            p_ref = (p - 0.1 * upd).astype(np.float32)
+            assert pn.shape == shape and mn.shape == shape
+            np.testing.assert_allclose(np.asarray(pn), p_ref, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(mn), m_ref, atol=1e-6)
+
+    def test_pad_tail_does_not_leak(self):
+        # a shape whose pad region, if mishandled, would fold garbage
+        # into real lanes: exact equality with an unpadded same-values
+        # run via a round-trip through a larger exact-tile shape
+        p, g, m = _rand((1025,)), _rand((1025,), 1), _rand((1025,), 2)
+        pn, mn = fused_optim.sgd_momentum_update(
+            jnp.asarray(p), jnp.asarray(g), jnp.asarray(m),
+            jnp.float32(0.5), momentum=0.5)
+        m_ref = 0.5 * m + g
+        np.testing.assert_allclose(np.asarray(mn), m_ref, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pn), p - 0.5 * m_ref,
+                                   atol=1e-6)
+
+
+class TestAdamKernel:
+    def test_matches_reference_math(self):
+        for shape in ((513,), (32, 32), (9, 7)):
+            p, g = _rand(shape), _rand(shape, 1)
+            m, v = _rand(shape, 2), np.abs(_rand(shape, 3))
+            t = 4.0
+            bc1, bc2 = 1 - 0.9 ** t, 1 - 0.999 ** t
+            pn, mn, vn = fused_optim.adam_update(
+                jnp.asarray(p), jnp.asarray(g), jnp.asarray(m),
+                jnp.asarray(v), jnp.float32(0.01), jnp.float32(bc1),
+                jnp.float32(bc2), beta_1=0.9, beta_2=0.999,
+                epsilon=1e-8, weight_decay=1e-4)
+            g2 = g + 1e-4 * p
+            m_ref = 0.9 * m + 0.1 * g2
+            v_ref = 0.999 * v + 0.001 * g2 * g2
+            p_ref = p - 0.01 * (m_ref / bc1) / (np.sqrt(v_ref / bc2)
+                                                + 1e-8)
+            np.testing.assert_allclose(np.asarray(pn), p_ref, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(mn), m_ref, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(vn), v_ref, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# optimizer integration + gating
+# ---------------------------------------------------------------------------
+
+class _MLP(model.Model):
+    def __init__(self, classes=3):
+        super().__init__()
+        self.fc1 = layer.Linear(32)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def _train(optimizer, steps=5, seed=0):
+    dev = device.create_cpu_device()
+    dev.SetRandSeed(11)
+    rng = np.random.RandomState(seed)
+    m = _MLP()
+    m.set_optimizer(optimizer)
+    xs = rng.randn(16, 6).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    tx = tensor.Tensor(data=xs, device=dev, requires_grad=False)
+    ty = tensor.Tensor(data=ys, device=dev, requires_grad=False)
+    m.compile([tx], is_train=True, use_graph=True)
+    for _ in range(steps):
+        m(tx, ty)
+    states = {k: np.asarray(v.data) for k, v in m.get_states().items()}
+    return states, m
+
+
+class TestFusedOptimizers:
+    def test_sgd_end_to_end_parity_bitwise(self):
+        ref, _ = _train(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+        fus, mf = _train(opt.SGD(lr=0.1, momentum=0.9,
+                                 weight_decay=1e-4, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert rec.get("fused_kinds") == ["sgd"], rec.get("fused_kinds")
+        for k in ref:
+            assert np.array_equal(ref[k], fus[k]), k
+
+    def test_adam_end_to_end_parity(self):
+        ref, _ = _train(opt.Adam(lr=0.01))
+        fus, mf = _train(opt.Adam(lr=0.01, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert rec.get("fused_kinds") == ["adam"]
+        for k in ref:
+            np.testing.assert_allclose(ref[k], fus[k], atol=1e-6,
+                                       err_msg=k)
+
+    def test_fused_keeps_n_traces_at_one(self):
+        _, mf = _train(opt.SGD(lr=0.1, momentum=0.9, fused=True),
+                       steps=6)
+        rec = next(iter(mf._steps.values()))
+        assert rec["n_traces"] == 1, rec["n_traces"]
+
+    def test_lr_schedule_rides_the_kernel(self):
+        sched = opt.ExponentialDecay(0.2, decay_steps=2, decay_rate=0.5)
+        ref, _ = _train(opt.SGD(lr=sched, momentum=0.9), steps=6)
+        sched2 = opt.ExponentialDecay(0.2, decay_steps=2, decay_rate=0.5)
+        fus, _ = _train(opt.SGD(lr=sched2, momentum=0.9, fused=True),
+                        steps=6)
+        for k in ref:
+            assert np.array_equal(ref[k], fus[k]), k
+
+    def test_regularized_param_declines_per_param(self):
+        o = opt.SGD(lr=0.1, momentum=0.9, fused=True)
+        o.register("fc1.W", regularizer=opt.Regularizer("l2", 1e-3))
+        o_ref = opt.SGD(lr=0.1, momentum=0.9)
+        o_ref.register("fc1.W", regularizer=opt.Regularizer("l2", 1e-3))
+        fus, mf = _train(o)
+        ref, _ = _train(o_ref)
+        for k in ref:
+            assert np.array_equal(ref[k], fus[k]), k
+        # the unregularized params still took the kernel
+        rec = next(iter(mf._steps.values()))
+        assert rec.get("fused_kinds") == ["sgd"]
+
+    def test_force_reference_declines_everything(self):
+        with fused_optim.force_reference():
+            _, mf = _train(opt.SGD(lr=0.1, momentum=0.9, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert "fused_kinds" not in rec
+
+    def test_default_is_reference(self):
+        _, mf = _train(opt.SGD(lr=0.1, momentum=0.9))
+        rec = next(iter(mf._steps.values()))
+        assert "fused_kinds" not in rec
+
+    def test_amsgrad_declines(self):
+        _, mf = _train(opt.Adam(lr=0.01, amsgrad=True, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert "fused_kinds" not in rec
+
+
+class TestFusedFlopsAccounting:
+    """The satellite fix: cost analysis cannot see into a Pallas custom
+    call, so a fused step's XLA-counted FLOPs would differ from the
+    reference program's and MFU would move for free. step_flops must
+    report IDENTICAL numbers for both."""
+
+    def test_fused_equals_unfused_exactly(self):
+        _, mr = _train(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+        _, mf = _train(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4,
+                               fused=True))
+        f_ref = mr.step_flops(compute=True)
+        f_fus = mf.step_flops(compute=True)
+        assert f_ref is not None and f_ref == f_fus, (f_ref, f_fus)
+
+    def test_adam_fused_equals_unfused(self):
+        _, mr = _train(opt.Adam(lr=0.01))
+        _, mf = _train(opt.Adam(lr=0.01, fused=True))
+        assert mr.step_flops(compute=True) == \
+            mf.step_flops(compute=True)
+
+    def test_cheap_path_stays_cheap(self):
+        # compute=False on a fused program must not pay the twin
+        # re-lower — it returns None until somebody computes
+        _, mf = _train(opt.SGD(lr=0.1, momentum=0.9, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert "step_flops" not in rec
+        assert mf.step_flops(compute=False) is None
+        assert rec["n_traces"] == 1          # no hidden twin trace
+
+    def test_twin_does_not_poison_live_state(self):
+        _, mf = _train(opt.SGD(lr=0.1, momentum=0.9, fused=True))
+        mf.step_flops(compute=True)
+        assert not any(isinstance(t.data, jax.core.Tracer)
+                       for t in mf._state_list)
+        # and training continues
+        dev = mf.dev
+        rng = np.random.RandomState(4)
+        tx = tensor.Tensor(data=rng.randn(16, 6).astype(np.float32),
+                           device=dev, requires_grad=False)
+        ty = tensor.Tensor(
+            data=np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)],
+            device=dev, requires_grad=False)
+        out, loss = mf(tx, ty)
+        assert np.isfinite(float(loss.data))
+
+
+# ---------------------------------------------------------------------------
+# conv epilogue
+# ---------------------------------------------------------------------------
+
+class TestFusedEpilogue:
+    @pytest.mark.parametrize("layout,shape", [("NCHW", (2, 5, 7, 7)),
+                                              ("NHWC", (2, 7, 7, 5)),
+                                              ("NCHW", (1, 3, 16, 16))])
+    def test_scale_shift_relu_parity(self, layout, shape):
+        rng = np.random.RandomState(0)
+        x = rng.randn(*shape).astype(np.float32)
+        C = shape[1] if layout == "NCHW" else shape[-1]
+        sc = (rng.rand(C) + 0.5).astype(np.float32)
+        sh = rng.randn(C).astype(np.float32)
+        got = fused_epilogue.scale_shift_relu(jnp.asarray(x), sc, sh,
+                                              layout=layout)
+        b = (1, C, 1, 1) if layout == "NCHW" else (1, 1, 1, C)
+        ref = np.maximum(x * sc.reshape(b) + sh.reshape(b), 0)
+        assert got.dtype == x.dtype and got.shape == x.shape
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+    def test_vmem_budget_falls_back_to_reference(self):
+        """A shape whose minimum legal block would exceed the VMEM
+        budget (huge per-channel planes) must compute via plain XLA
+        ops — same numbers, no Mosaic-doomed pallas_call."""
+        assert fused_epilogue._block_rows(8, 262144) is None
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 512, 512).astype(np.float32)
+        sc = (rng.rand(2) + 0.5).astype(np.float32)
+        sh = rng.randn(2).astype(np.float32)
+        got = fused_epilogue.scale_shift_relu(jnp.asarray(x), sc, sh,
+                                              layout="NCHW")
+        ref = np.maximum(x * sc.reshape(1, 2, 1, 1)
+                         + sh.reshape(1, 2, 1, 1), 0)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+    def test_block_rows_respects_byte_budget(self):
+        # bench-shape NCHW activation (rows=2048, L=12544): 256 rows
+        # would be 12.8 MB — the cap must pick a block under budget
+        br = fused_epilogue._block_rows(2048, 12544)
+        assert br is not None
+        assert br * 12544 * 4 <= fused_epilogue._BLOCK_BYTE_BUDGET
+
+    def test_kernel_marks_trace_collector(self):
+        """The epilogue registers with the same trace collector the
+        optimizer kernels use, so a step program containing it is
+        flagged for step_flops' reference-twin accounting; the
+        over-budget reference fallback marks nothing (no custom call
+        to account for)."""
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 4, 8, 8).astype(np.float32))
+        sc = np.ones(4, np.float32)
+        sh = np.zeros(4, np.float32)
+        sink = []
+        with fused_optim.trace_collector(sink):
+            fused_epilogue.scale_shift_relu(x, sc, sh, layout="NCHW")
+        assert sink == ["epilogue"]
+        big = jnp.asarray(
+            rng.randn(1, 2, 512, 512).astype(np.float32))
+        sink2 = []
+        with fused_optim.trace_collector(sink2):
+            fused_epilogue.scale_shift_relu(big, np.ones(2, np.float32),
+                                            np.zeros(2, np.float32),
+                                            layout="NCHW")
+        assert sink2 == []
+
+    def test_fold_bn_is_f32(self):
+        s2, b2 = fused_epilogue.fold_bn(
+            np.ones(4, np.float32), np.zeros(4, np.float32),
+            np.zeros(4, np.float32), np.ones(4, np.float32), 1e-5)
+        assert s2.dtype == jnp.float32 and b2.dtype == jnp.float32
+
+    def _bn_relu_net(self):
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.conv = layer.Conv2d(8, 3)
+                self.bn = layer.BatchNorm2d()
+                self.relu = layer.ReLU()
+
+            def forward(self, x):
+                return self.relu(self.bn(self.conv(x)))
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 16, 16).astype(np.float32)
+        tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+        net = Net()
+        net.compile([tx], is_train=False, use_graph=True)
+        net.eval()
+        net.bn.running_mean.data = jnp.asarray(
+            rng.randn(8).astype(np.float32))
+        net.bn.running_var.data = jnp.asarray(
+            (rng.rand(8) + 0.5).astype(np.float32))
+        return net, dev, x, tx
+
+    def test_peephole_matches_reference_eval(self):
+        net, dev, x, tx = self._bn_relu_net()
+        ref = np.asarray(net(tx).data)      # eager: peephole inactive
+
+        def fwd(arr):
+            return net.forward(tensor.Tensor(
+                data=arr, device=dev, requires_grad=False)).data
+
+        with fused_epilogue.enabled_scope(True):
+            got = np.asarray(jax.jit(fwd)(jnp.asarray(x)))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_peephole_off_by_default(self):
+        assert not fused_epilogue.enabled()
+        net, dev, x, tx = self._bn_relu_net()
+
+        # with the gate off, even a traced eval keeps the reference ops
+        captured = []
+        orig = fused_epilogue.try_relu_epilogue
+
+        def spy(t):
+            r = orig(t)
+            captured.append(r is not None)
+            return r
+
+        fused_epilogue.try_relu_epilogue = spy
+        try:
+            def fwd(arr):
+                return net.forward(tensor.Tensor(
+                    data=arr, device=dev, requires_grad=False)).data
+            jax.jit(fwd)(jnp.asarray(x))
+        finally:
+            fused_epilogue.try_relu_epilogue = orig
+        assert captured and not any(captured)
+
+    def test_frozen_stats_training_declines(self):
+        """freeze_stats BN in TRAINING mode still backprops through
+        scale/bias: its output carries the tag (it runs the inference
+        op) but the peephole must decline while training, or the fused
+        output would silently drop those gradients."""
+        from singa_tpu.autograd_base import CTX
+        net, dev, x, tx = self._bn_relu_net()
+        net.bn.freeze_stats = True
+        y = net.bn(net.conv(tx))
+        assert getattr(y, "_bn_epilogue", None) is not None
+        prev = CTX.training
+        CTX.training = True
+        try:
+            with fused_epilogue.enabled_scope(True):
+                assert fused_epilogue.try_relu_epilogue(y) is None
+        finally:
+            CTX.training = prev
+
+    def test_training_mode_bn_carries_no_tag(self):
+        # training-mode BN outputs carry no folding tag, so the
+        # peephole structurally cannot fire mid-training
+        net, dev, x, tx = self._bn_relu_net()
+        net.train()
+        try:
+            y = net.bn(net.conv(tx))
+            assert getattr(y, "_bn_epilogue", None) is None
+        finally:
+            net.eval()

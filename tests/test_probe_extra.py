@@ -79,6 +79,52 @@ def test_stem_ab_record_and_margin(monkeypatch, banked):
     assert rec["s2d_speedup"] == round(13.0 / 11.0, 3)
 
 
+def test_fused_optim_ab_record_and_margin(monkeypatch, banked):
+    times = {False: 13.0, True: 11.0}      # fused clearly faster
+
+    def fake_measure(dev, batch, niters, warmup, image_size, depth,
+                     dtype_name, layout="NCHW", stem=None,
+                     fused_optim=None):
+        return (32.0 / (times[bool(fused_optim)] / 1e3),
+                times[bool(fused_optim)])
+
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(bench, "_peak_flops", lambda *a, **k: 197e12)
+    monkeypatch.setattr(bench, "_conv_layout",
+                        lambda: ("NHWC", "measured-ab"))
+    rec = probe._fused_optim_ab(types.SimpleNamespace(jax_device=None))
+    assert rec["winner"] == "fused"
+    assert rec["fused_speedup"] == round(13.0 / 11.0, 3)
+    assert rec["reference_step_ms"] == 13.0 and \
+        rec["fused_step_ms"] == 11.0
+    assert [r for _, r in banked
+            if r.get("extra") == "fused_optim_probe"]
+
+    times[True] = 12.9                     # within 2%: default stands
+    banked.clear()
+    rec = probe._fused_optim_ab(types.SimpleNamespace(jax_device=None))
+    assert rec["winner"] == "reference"
+
+
+def test_bench_fused_optim_choice_consumes_banked_winner(monkeypatch):
+    """bench._fused_optim routes through the one _measured_choice
+    mechanism: env pin > fresh banked fused_optim_ab winner >
+    reference default."""
+    monkeypatch.setattr(bench, "_load_obs", lambda: [])
+    monkeypatch.delenv("BENCH_FUSED_OPTIM", raising=False)
+    assert bench._fused_optim() == ("reference", "default-unmeasured")
+    import time
+    monkeypatch.setattr(bench, "_load_obs", lambda: [
+        {"event": "extra", "extra": "fused_optim_ab",
+         "winner": "fused",
+         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+         "git": bench._git_rev()}])
+    val, src = bench._fused_optim()
+    assert (val, src) == ("fused", "measured-ab")
+    monkeypatch.setenv("BENCH_FUSED_OPTIM", "reference")
+    assert bench._fused_optim() == ("reference", "env")
+
+
 def _fake_proc(lines, rc=0):
     return types.SimpleNamespace(stdout="\n".join(lines), stderr="",
                                  returncode=rc)

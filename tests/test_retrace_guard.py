@@ -100,6 +100,49 @@ def test_static_arg_gets_its_own_record_not_a_retrace():
             {k: r["n_traces"] for k, r in m._steps.items()}
 
 
+def test_all_three_mfu_optimizations_keep_one_trace_and_donation():
+    """The PR-13 combination pin: gradient-psum bucketing + the fused
+    Pallas optimizer update + background double-buffered device
+    prefetch, all enabled AT ONCE — the steady-state loop still traces
+    exactly once and the threaded state stays donated (each feature
+    alone passing is not enough; the combination is what production
+    runs)."""
+    from singa_tpu.data import DevicePrefetcher
+    from singa_tpu.ops import fused_optim
+
+    prev = fused_optim.FORCE_PALLAS_INTERPRET
+    fused_optim.FORCE_PALLAS_INTERPRET = True
+    try:
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(11)
+        rng = np.random.RandomState(0)
+        m = MLP()
+        m.set_optimizer(opt.DistOpt(
+            opt.SGD(lr=0.1, momentum=0.9, fused=True), bucket_mb=4))
+        xs = rng.randn(16, 6).astype(np.float32)
+        tx = tensor.Tensor(data=xs, device=dev, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+
+        def batches():
+            for _ in range(6):
+                yield (rng.randn(16, 6).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[
+                           rng.randint(0, 3, 16)])
+
+        for bx, by in DevicePrefetcher(batches(), dev,
+                                       background=True):
+            m(bx, by)
+        rec = _only_rec(m)
+        assert rec["n_traces"] == 1, rec["n_traces"]
+        assert rec.get("fused_kinds") == ["sgd"], \
+            rec.get("fused_kinds")
+        info = m.compiled_step_info()
+        assert info["donated_bytes"], \
+            "state donation lost with bucketing+fused+prefetch on"
+    finally:
+        fused_optim.FORCE_PALLAS_INTERPRET = prev
+
+
 def test_compiled_step_info_reports_trace_count():
     m, batch = _setup()
     for _ in range(4):

@@ -41,6 +41,7 @@ METRICS = [
     ("lm_tok_s", lambda p: p.get("lm_tokens_per_sec")),
     ("lm_mfu", lambda p: p.get("lm_mfu")),
     ("lm_bf16_tok_s", lambda p: p.get("lm_bf16_tokens_per_sec")),
+    ("lm_bf16_mfu", lambda p: p.get("lm_bf16_mfu")),
     ("serve_tok_s", lambda p: (p.get("serving") or {}).get(
         "decode_tok_s")),
     ("serve_p99_ms", lambda p: _scale((p.get("serving") or {}).get(
@@ -59,6 +60,14 @@ METRICS = [
 # (the p99 of a 2-request CPU smoke is far too noisy to gate on)
 GATED = {"img_s", "bf16_img_s", "lm_tok_s", "lm_bf16_tok_s",
          "serve_tok_s", "quant_img_s"}
+
+# per-leg MFU columns the --mfu-floor gate guards (the MFU-push PRs'
+# cron tripwire: a win banked by one round must not silently erode)
+MFU_GATED = {"mfu", "bf16_mfu", "lm_mfu", "lm_bf16_mfu"}
+
+# exposed-comm rises smaller than this (seconds) are timing noise, not
+# an overlap regression — CPU/TPU profiler jitter sits well under it
+EXPOSED_COMM_EPS_S = 1e-4
 
 # per-leg timeline columns (bucket fractions + exposed comm) — the
 # "what to fix" companion of each MFU number
@@ -110,10 +119,18 @@ def _timeline_doc(parsed, key):
     return node if isinstance(node, dict) else None
 
 
-def build_report(records, threshold=0.05):
+def build_report(records, threshold=0.05, mfu_floor=None):
     """The JSON-able report doc: one row per round with extracted
     metrics, deltas vs the previous record (fractional), per-leg
-    timeline decompositions, and the regression list."""
+    timeline decompositions, and the regression list.
+
+    ``mfu_floor`` arms the MFU-push cron gate: a leg whose MFU falls
+    BELOW the floor after the previous same-platform record held it
+    (or keeps dropping past ``threshold`` while already under it) is a
+    regression, and so is a per-leg ``exposed_collective_s`` that
+    rises more than ``threshold`` (plus a noise epsilon) vs the
+    previous same-platform record — the two numbers this PR's overlap
+    and fused-kernel wins are banked in, guarded round over round."""
     rows = []
     # deltas compare a round against the previous record on the SAME
     # platform: a tpu round after a cpu-fallback round is not a
@@ -150,10 +167,35 @@ def build_report(records, threshold=0.05):
                             {"metric": name, "delta": d,
                              "prev": pv, "now": v,
                              "vs_round": prev["round"]})
+                    if mfu_floor is not None and name in MFU_GATED \
+                            and v < mfu_floor \
+                            and (pv >= mfu_floor or d < -threshold):
+                        # lost the floor the previous round held, or
+                        # still sliding while already under it
+                        row["regressions"].append(
+                            {"metric": name, "kind": "mfu_floor",
+                             "floor": mfu_floor, "delta": d,
+                             "prev": pv, "now": v,
+                             "vs_round": prev["round"]})
+            if mfu_floor is not None:
+                for leg, tl in timelines.items():
+                    cur = tl.get("exposed_collective_s")
+                    ptl = (prev.get("timeline") or {}).get(leg) or {}
+                    pv = ptl.get("exposed_collective_s")
+                    if not (isinstance(cur, (int, float))
+                            and isinstance(pv, (int, float))):
+                        continue
+                    if cur > pv * (1 + threshold) + EXPOSED_COMM_EPS_S:
+                        row["regressions"].append(
+                            {"metric": f"{leg}_exposed_comm",
+                             "kind": "exposed_comm",
+                             "delta": (cur - pv) / pv if pv else None,
+                             "prev": pv, "now": cur,
+                             "vs_round": prev["round"]})
         rows.append(row)
         prev_by_platform[row["platform"]] = row
     return {"schema": "singa-tpu-bench-report/1", "rounds": rows,
-            "threshold": threshold,
+            "threshold": threshold, "mfu_floor": mfu_floor,
             "regressions": [r for row in rows
                             for r in row["regressions"]]}
 
@@ -209,11 +251,15 @@ def render_table(report):
     regs = report["regressions"]
     lines.append(f"{len(report['rounds'])} round(s), "
                  f"{len(regs)} regression(s) at "
-                 f"threshold {report['threshold']:.0%}")
+                 f"threshold {report['threshold']:.0%}"
+                 + (f", mfu floor {report['mfu_floor']}"
+                    if report.get("mfu_floor") is not None else ""))
     for r in regs:
-        lines.append(f"  REGRESSION {r['metric']}: "
-                     f"{_fmt(r['prev'])} -> {_fmt(r['now'])} "
-                     f"({r['delta']:+.1%})")
+        kind = f" [{r['kind']}]" if r.get("kind") else ""
+        delta = f" ({r['delta']:+.1%})" if isinstance(
+            r.get("delta"), (int, float)) else ""
+        lines.append(f"  REGRESSION{kind} {r['metric']}: "
+                     f"{_fmt(r['prev'])} -> {_fmt(r['now'])}{delta}")
     return "\n".join(lines)
 
 
@@ -248,8 +294,11 @@ def selftest():
                 "value": 9.0, "platform": "cpu", "git": "ccc333"}},
             {"n": 4, "parsed": {
                 "value": 1105.0, "platform": "tpu",
-                "bf16_throughput": 1920.0,
-                "lm_tokens_per_sec": 150000.0, "git": "ddd444"}},
+                "bf16_throughput": 1920.0, "bf16_mfu": 0.30,
+                "lm_tokens_per_sec": 150000.0, "git": "ddd444",
+                "timeline": {"fractions": {"compute": 0.55},
+                             "exposed_collective_s": 4e-5,
+                             "window_s": 4e-4}}},
         ]
         for r in recs:
             with open(os.path.join(td, f"BENCH_r{r['n']:02d}.json"),
@@ -292,9 +341,48 @@ def selftest():
         assert "REGRESSION" in text and "bf16_img_s" in text
         assert "compute=50%" in text and "exposed_comm" in text
         json.dumps(report)                       # JSON-able end to end
+
+        # --mfu-floor gate: r5 drops bf16 MFU below the floor r2 held
+        # AND exposes more collective time than r2's timeline banked —
+        # both flag (and only with the floor armed)
+        with open(os.path.join(td, "BENCH_r05.json"), "w") as f:
+            json.dump({"n": 5, "parsed": {
+                "value": 1100.0, "platform": "tpu", "git": "eee555",
+                "bf16_throughput": 2400.0, "bf16_mfu": 0.22,
+                "timeline": {"fractions": {"compute": 0.6},
+                             "exposed_collective_s": 9e-4,
+                             "window_s": 4e-4}}}, f)
+        records5 = load_records(td)
+        plain = build_report(records5, threshold=0.05)
+        assert not [r for r in plain["regressions"]
+                    if r.get("kind")], plain["regressions"]
+        armed = build_report(records5, threshold=0.05, mfu_floor=0.30)
+        kinds = {r["metric"]: r for r in armed["regressions"]
+                 if r.get("kind")}
+        floor = kinds["bf16_mfu"]
+        assert floor["kind"] == "mfu_floor" and floor["prev"] == 0.30 \
+            and floor["now"] == 0.22, floor
+        ec = kinds["fp32_exposed_comm"]
+        assert ec["kind"] == "exposed_comm" and ec["prev"] == 4e-5 \
+            and ec["now"] == 9e-4, ec
+        # an MFU already under the floor but HOLDING (tiny wiggle) does
+        # not flag: r6 repeats r5's bf16_mfu
+        with open(os.path.join(td, "BENCH_r06.json"), "w") as f:
+            json.dump({"n": 6, "parsed": {
+                "value": 1100.0, "platform": "tpu",
+                "bf16_throughput": 2400.0, "bf16_mfu": 0.219}}, f)
+        armed6 = build_report(load_records(td), threshold=0.05,
+                              mfu_floor=0.30)
+        assert not [r for r in armed6["regressions"]
+                    if r.get("kind") and r.get("vs_round") == 5], \
+            armed6["regressions"]
+        text5 = render_table(armed)
+        assert "mfu_floor" in text5 and "exposed_comm" in text5
     print("selftest: OK — 4-round trajectory extracted, same-platform "
           "deltas and timeline columns rendered, the 20% bf16 drop "
-          "flagged across the cpu round, torn record skipped")
+          "flagged across the cpu round, torn record skipped, and the "
+          "--mfu-floor gate flags the lost floor + exposed-comm rise "
+          "only when armed")
 
 
 def main():
@@ -311,6 +399,16 @@ def main():
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="fractional drop that flags a regression "
                          "(default 0.05)")
+    ap.add_argument("--mfu-floor", type=float, default=None,
+                    metavar="X",
+                    help="arm the MFU gate: exit 3 when any leg's MFU "
+                         "falls below X after the previous same-"
+                         "platform record held it (or keeps dropping "
+                         "past --threshold under it), or when a leg's "
+                         "timeline exposed_collective_s rises more "
+                         "than --threshold vs the previous record — "
+                         "the cron guard for the overlap/fused-kernel "
+                         "wins")
     ap.add_argument("--selftest", action="store_true",
                     help="run the built-in synthetic-trajectory check "
                          "(the tier-1 CI gate)")
@@ -323,7 +421,8 @@ def main():
         print(f"no BENCH_r*.json records under {args.dir}",
               file=sys.stderr)
         raise SystemExit(2)
-    report = build_report(records, threshold=args.threshold)
+    report = build_report(records, threshold=args.threshold,
+                          mfu_floor=args.mfu_floor)
     if args.json:
         print(json.dumps(report, indent=1))
     else:
