@@ -99,6 +99,8 @@ EXTRA_SUCCESS_MARKERS = {
     "lm_fusion_profile": ("lm_bf16_fusion_profile",),
     "resnet_stem_ab": ("resnet_stem_ab",),
     "fused_optim_ab": ("fused_optim_ab",),
+    "grad_bucket_ab": ("grad_bucket_ab",),
+    "conv_epilogue_ab": ("conv_epilogue_ab",),
     "resnet50_bf16_large_batch": ("resnet50_bf16_b128",),
     "mlp_step_time": ("mlp_mnist_b64_step_us",),
     "flash_block_sweep": ("flash_block_best",),
@@ -715,6 +717,22 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         except Exception as e:
             res["serving_error"] = str(e)[:200]
         _emit_partial(res, "serving")
+    # serving load-sweep leg: the PAGED/speculative engine driven with
+    # synthetic Poisson load across slots × prefill_len × speculative_k
+    # configs; banks tok/s + p99 curves per config so the serving
+    # throughput push is steered by measurements, not guesses
+    # (tools/bench_report.py renders the curves + winner per SLO target)
+    if os.environ.get("BENCH_SERVING_SWEEP", "1") != "0":
+        try:
+            res["serving_sweep"] = _leg_guard(
+                lambda: _measure_serving_sweep(dev), leg_budget,
+                "serving_sweep")
+        except TimeoutError as e:
+            res["serving_sweep_error"] = str(e)[:200]
+            res["leg_timeout"] = "serving_sweep"
+        except Exception as e:
+            res["serving_sweep_error"] = str(e)[:200]
+        _emit_partial(res, "serving_sweep")
     # quant leg (singa_tpu.quant): int8 weight-only inference — ResNet
     # img/s + LM tok/s + serving decode tok/s + quantized-checkpoint
     # bytes on disk, each with its MFU where one is defined. Banked and
@@ -960,6 +978,160 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
         "hbm_peak_bytes": _peak_hbm(dev),
         "compile": _compile_delta(cc0),
     }
+
+
+# default serving_sweep grid: (kv_layout, slots, prefill_len,
+# speculative_k). The ring 4×16 row is the PR-7 baseline the paged
+# rows are judged against; the k>0 rows measure what speculation buys
+# under the same load. BENCH_SWEEP_CONFIGS trims/extends it as
+# "layout:slots:prefill:k" comma-separated triples.
+SWEEP_GRID = (
+    ("ring", 4, 16, 0),
+    ("paged", 4, 16, 0),
+    ("paged", 4, 16, 4),
+    ("paged", 2, 8, 0),
+    ("paged", 2, 8, 4),
+)
+
+
+def _parse_sweep_grid():
+    env = os.environ.get("BENCH_SWEEP_CONFIGS")
+    if not env:
+        return SWEEP_GRID
+    grid = []
+    for part in env.split(","):
+        try:
+            lay, slots, pf, k = part.strip().split(":")
+            if lay not in ("ring", "paged"):
+                raise ValueError(lay)
+            grid.append((lay, int(slots), int(pf), int(k)))
+        except ValueError:
+            print(f"bench: ignoring malformed BENCH_SWEEP_CONFIGS "
+                  f"entry {part!r} (want ring|paged:slots:prefill:k)",
+                  file=sys.stderr)
+    return tuple(grid) or SWEEP_GRID
+
+
+def _measure_serving_sweep(dev, grid=None, n_requests=12,
+                           new_tokens=24, rps=None, seed=0):
+    """The banked ``serving_sweep`` leg: one small TransformerLM served
+    under synthetic POISSON load (seeded exponential inter-arrivals,
+    open loop on the background serve thread) across a grid of
+    (kv_layout, slots, prefill_len, speculative_k) configs. Each
+    config banks steady-state ``decode_tok_s`` (decode tokens over
+    summed tick time), ``wall_tok_s`` (tokens over the whole loaded
+    window — queueing included, what the fleet actually delivers),
+    tick-latency p50/p99, TTFT p99, and — for paged rows — the prefix
+    cache hit count (half the generated prompts share a prefix) and
+    the speculative accepted ratio. Warmup/compile happens off the
+    clock (closed-loop wave before the Poisson window); the no-retrace
+    pin is asserted per config like the plain serving leg."""
+    import numpy as np
+
+    from singa_tpu import tensor
+    from singa_tpu.models import transformer
+    from singa_tpu.observability import metrics as obs_metrics
+    from singa_tpu.observability.export import series_quantiles
+
+    grid = grid if grid is not None else _parse_sweep_grid()
+    rps = float(rps if rps is not None
+                else os.environ.get("BENCH_SWEEP_RPS", "8"))
+    vocab = 512
+    max_pf = max(cfg[2] for cfg in grid)
+    model = transformer.TransformerLM(vocab, d_model=128, n_heads=4,
+                                      n_layers=2,
+                                      max_len=max_pf + new_tokens + 8,
+                                      tp=False)
+    model.eval()
+    model(tensor.Tensor(data=np.zeros((1, max_pf), np.float32),
+                        device=dev, requires_grad=False))
+    out = {"n_requests": n_requests, "new_tokens": new_tokens,
+           "offered_rps": rps, "poisson_seed": seed, "configs": []}
+    for lay, slots, pf, spec_k in grid:
+        rng = np.random.RandomState(seed)
+        reg = obs_metrics.MetricsRegistry()
+        kw = dict(slots=slots, max_len=pf + new_tokens,
+                  prefill_len=pf, registry=reg)
+        if lay == "paged":
+            # block_size 4 so the generated prompts actually span
+            # full blocks and the shared prefix is shareable
+            kw.update(kv_layout="paged", kv_block_size=4,
+                      speculative_k=spec_k)
+        eng = model.compile_serving(**kw)
+        shared = rng.randint(1, vocab, (max(2, pf // 2),))
+
+        def mk_prompt():
+            if rng.rand() < 0.5:
+                tail = rng.randint(
+                    1, vocab,
+                    (int(rng.randint(1, max(2, pf - shared.size + 1))),))
+                return np.concatenate([shared, tail])[:pf]
+            return rng.randint(1, vocab,
+                               (int(rng.randint(1, pf + 1)),))
+
+        # warmup: compile both programs off the clock (synchronous)
+        futs = [eng.submit(mk_prompt(), max_new_tokens=new_tokens)
+                for _ in range(2)]
+        eng.run_until_idle()
+        for f in futs:
+            f.result(timeout=5)
+
+        def _series(name):
+            return reg.get(name).to_doc()["series"][0]
+
+        tok0 = reg.get("serve_tokens_total").total()
+        pre0 = reg.get("serve_prefill_total").total()
+        before = _series("serve_token_seconds")
+        ttft_before = _series("serve_ttft_seconds")
+        eng.start()
+        t0 = time.perf_counter()
+        futs = []
+        for _ in range(n_requests):
+            futs.append(eng.submit(mk_prompt(),
+                                   max_new_tokens=new_tokens))
+            time.sleep(float(rng.exponential(1.0 / rps)))
+        for f in futs:
+            f.result(timeout=120)
+        wall = time.perf_counter() - t0
+        info = eng.compiled_step_info()
+        assert info["n_traces"] == 1, \
+            f"decode retraced in sweep config {lay}:{slots}:{pf}:" \
+            f"{spec_k}: {info}"
+        tok = reg.get("serve_tokens_total").total() - tok0
+        tok -= reg.get("serve_prefill_total").total() - pre0
+        after = _series("serve_token_seconds")
+
+        def _delta(a, b):
+            return {"count": a["count"] - b["count"],
+                    "sum": a["sum"] - b["sum"],
+                    "buckets": [[le, ca - cb] for (le, ca), (_le, cb)
+                                in zip(a["buckets"], b["buckets"])]}
+
+        d = _delta(after, before)
+        q = series_quantiles(d)
+        ttft_q = series_quantiles(_delta(_series("serve_ttft_seconds"),
+                                         ttft_before))
+        # bank what actually RAN, not what was requested: a declined
+        # layout/speculation must not label its row with the claimed
+        # config (the report's winner table steers deployments on it)
+        rec = {"kv_layout": info["kv_layout"], "slots": slots,
+               "prefill_len": pf,
+               "speculative_k": info["speculative_k"],
+               "decode_tok_s": (tok / d["sum"]) if d["sum"] else None,
+               "wall_tok_s": tok / wall if wall > 0 else None,
+               "p99_token_s": q.get("p99"), "p50_token_s": q.get("p50"),
+               "ttft_p99_s": ttft_q.get("p99")}
+        if info["kv_layout"] == "paged":
+            rec["prefix_cache_hits"] = \
+                int(reg.get("prefix_cache_hits_total").total())
+            ratio = reg.get("speculative_accepted_ratio")
+            rec["speculative_accepted_ratio"] = \
+                ratio.value() if ratio is not None \
+                and info["speculative_k"] else None
+        eng.drain(timeout=30)
+        eng.stop()
+        out["configs"].append(rec)
+    return out
 
 
 def _setup_lm_step(dev, batch=8, seq=None, compute_dtype=None):
@@ -1677,7 +1849,8 @@ def _emit_report(res, live, smoke, obs, errors):
               "lm_hbm_peak_bytes", "lm_bf16_hbm_peak_bytes",
               "compile", "bf16_compile", "lm_compile",
               "lm_bf16_compile",
-              "serving", "serving_error", "quant", "quant_error"):
+              "serving", "serving_error", "quant", "quant_error",
+              "serving_sweep", "serving_sweep_error"):
         if res.get(k) is not None:
             out[k] = round(res[k], 4) if isinstance(res[k], float) else res[k]
     extras = _fold_extras(obs)

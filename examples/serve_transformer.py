@@ -80,6 +80,20 @@ def main():
     ap.add_argument("--prefill-len", type=int, default=16)
     ap.add_argument("--policy", default=None,
                     help="mixed-precision policy name (e.g. bf16_mixed)")
+    ap.add_argument("--kv-layout", default="ring",
+                    choices=("ring", "paged"),
+                    help="KV cache layout: the ring (default) or the "
+                         "paged block pool with prefix sharing "
+                         "(docs/serving.md)")
+    ap.add_argument("--kv-block-size", type=int, default=16,
+                    help="paged layout: tokens per KV block")
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="paged layout: pool size in blocks (default "
+                         "slots x ceil(max_len/block_size))")
+    ap.add_argument("--speculative-k", type=int, default=0,
+                    help="speculative decoding: verify-program width "
+                         "(up to K tokens per tick, greedy requests "
+                         "only; needs --kv-layout paged)")
     ap.add_argument("--aot-dir", default=None, metavar="DIR",
                     help="cold-start elimination (singa_tpu.aot): "
                          "deserialize matching prefill/decode "
@@ -120,6 +134,12 @@ def main():
         serve_kw["aot_store"] = args.aot_dir
         serve_kw["compile_cache"] = aot_cache.cache_dir_for(
             args.aot_dir)
+    if args.kv_layout != "ring":
+        serve_kw.update(kv_layout=args.kv_layout,
+                        kv_block_size=args.kv_block_size,
+                        kv_blocks=args.kv_blocks)
+    if args.speculative_k:
+        serve_kw["speculative_k"] = args.speculative_k
     engine = model.compile_serving(
         slots=args.slots, max_len=args.max_len,
         prefill_len=args.prefill_len, policy=args.policy, **serve_kw)

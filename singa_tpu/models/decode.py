@@ -76,4 +76,33 @@ def sample_logits_jax(logits, temperature, top_k, key):
     return jax.random.categorical(key, lg, -1).astype(jnp.int32)
 
 
-__all__ = ["apply_top_k", "sample_logits", "sample_logits_jax"]
+def ngram_propose(history, k, n=2):
+    """Self-drafting n-gram proposer for speculative decoding: up to
+    ``k`` draft tokens guessed from the sequence's OWN history (prompt
+    + generated so far), zero model calls.
+
+    Finds the most recent earlier occurrence of the trailing ``n``-gram
+    and proposes its continuation; pads by repeating the last proposed
+    (or last history) token. Pure and deterministic — draft quality
+    only moves the speculative accept RATE, never the output: the
+    verify program's accept/reject walk guarantees token-for-token
+    identity with sequential greedy decoding regardless of what is
+    proposed here."""
+    k = int(k)
+    if k <= 0:
+        return []
+    h = [int(t) for t in history]
+    out = []
+    if len(h) > n:
+        tail = tuple(h[-n:])
+        for i in range(len(h) - n - 1, -1, -1):
+            if tuple(h[i:i + n]) == tail:
+                out = h[i + n:i + n + k]
+                break
+    while len(out) < k:
+        out.append(out[-1] if out else h[-1])
+    return out[:k]
+
+
+__all__ = ["apply_top_k", "sample_logits", "sample_logits_jax",
+           "ngram_propose"]

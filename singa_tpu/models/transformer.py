@@ -530,6 +530,11 @@ class _LMServeAdapter:
     # build_engine's honored-or-refused contract for quantized policies
     supports_weight_quant = True
     supports_cache_quant = True
+    # the transformer's KV state is pure per-position rows — exactly
+    # what the paged block pool holds; the char-rnn's (h,c) carry is
+    # not, so ITS adapter leaves this False and the engine declines
+    # kv_layout="paged" loudly back to the ring
+    supports_paged = True
 
     def __init__(self, m, policy=None):
         self.m = m
@@ -712,6 +717,103 @@ class _LMServeAdapter:
             logits = (h_last.astype(jnp.float32) @ P["head_w"]
                       + P["head_b"])
             return new_cache, logits
+
+        return fn
+
+    # -- paged block-pool programs ------------------------------------------
+    def init_pool(self, n_blocks, block_size):
+        from ..serving import kv_cache
+        return [kv_cache.init_pool(n_blocks, self.n_heads, block_size,
+                                   self.head_dim, self._cache_dtype())
+                for _ in self.m.blocks]
+
+    def _paged_core(self):
+        """The ONE paged transformer pass both paged programs share:
+        embed ``(R, Q)`` tokens at absolute positions ``pos_abs``,
+        write each layer's fresh k/v rows into the pool through the
+        per-row block tables (``wmask`` drops padding/inactive rows),
+        attend position-exactly (``cache position <= query position`` —
+        a query sees the cached prefix, earlier fresh tokens, and
+        itself), and return the final-LN hidden states. Chunked prefill
+        and the K-token speculative verify are the SAME math at
+        different (R, Q); one body means they cannot drift."""
+        import jax.numpy as jnp
+        from ..serving import kv_cache
+        scale = self.scale
+        block, _c, cdt = self._block()
+
+        def core(P, pool, tables, tokens, pos_abs, wmask):
+            pos_ids = jnp.minimum(pos_abs,
+                                  P["pos"].shape[0] - 1)
+            x = (jnp.take(P["tok"], tokens, axis=0)
+                 + jnp.take(P["pos"], pos_ids, axis=0)).astype(cdt)
+
+            def attend(q, k, v, level):
+                level = kv_cache.write_rows(level, tables, k, v,
+                                            pos_abs, wmask)
+                o = kv_cache.attend_pages(q, level, tables, pos_abs,
+                                          scale)
+                return _merge_heads(o), level
+
+            new_pool = []
+            for p, level in zip(P["blocks"], pool):
+                x, level = block(p, x, level, attend)
+                new_pool.append(level)
+            return new_pool, _ln(x, P["lnf_s"], P["lnf_b"])
+
+        return core
+
+    def paged_prefill_fn(self):
+        """Chunked paged prefill: ``(P, pool, tables (B, n_pages),
+        tokens (B, S) SUFFIX tokens, starts (B,) prefix-hit lengths,
+        lengths (B,) suffix lengths, valid (B,)) -> (pool,
+        logits (B, V))`` — a prefix-cache hit enters here with
+        ``starts > 0`` and its suffix attending to the shared blocks
+        it never recomputed."""
+        import jax.numpy as jnp
+
+        core = self._paged_core()
+
+        def fn(P, pool, tables, tokens, starts, lengths, valid):
+            B, S = tokens.shape
+            pos_abs = starts.astype(jnp.int32)[:, None] \
+                + jnp.arange(S, dtype=jnp.int32)[None, :]
+            wmask = (jnp.arange(S, dtype=jnp.int32)[None, :]
+                     < lengths.astype(jnp.int32)[:, None]) \
+                & valid[:, None]
+            pool, hN = core(P, pool, tables, tokens, pos_abs, wmask)
+            h_last = jnp.take_along_axis(
+                hN, (lengths - 1).astype(jnp.int32)[:, None, None]
+                .clip(0), axis=1)[:, 0]
+            logits = (h_last.astype(jnp.float32) @ P["head_w"]
+                      + P["head_b"])
+            return pool, logits
+
+        return fn
+
+    def paged_decode_fn(self):
+        """Paged decode/verify: ``(P, pool, tables (W, n_pages),
+        tokens (W, K), positions (W,) first-token positions,
+        counts (W,) real tokens per row) -> (pool,
+        logits (W, K, V))``. ``K == 1`` is plain one-token decode;
+        ``K > 1`` scores a speculative draft row in ONE tick —
+        ``logits[:, i]`` is the exact next-token distribution after
+        token ``i``, which is what makes the host accept/reject walk
+        token-identical to sequential greedy."""
+        import jax.numpy as jnp
+
+        core = self._paged_core()
+
+        def fn(P, pool, tables, tokens, positions, counts):
+            W, K = tokens.shape
+            pos_abs = positions.astype(jnp.int32)[:, None] \
+                + jnp.arange(K, dtype=jnp.int32)[None, :]
+            wmask = jnp.arange(K, dtype=jnp.int32)[None, :] \
+                < counts.astype(jnp.int32)[:, None]
+            pool, hN = core(P, pool, tables, tokens, pos_abs, wmask)
+            logits = (hN.astype(jnp.float32) @ P["head_w"]
+                      + P["head_b"])
+            return pool, logits
 
         return fn
 

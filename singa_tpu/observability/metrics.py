@@ -398,6 +398,25 @@ def heartbeat_summary(registry=None):
         if compile_sum:
             out["compile_share"] = min(
                 1.0, compile_sum / float(step["sum"]))
+    # serving KV pool pressure (paged engines only): the fleet view's
+    # early-warning that a replica is running out of blocks — queue
+    # depth rises AFTER the pool saturates, this shows it before
+    kv_total = reg.get("kv_blocks_total")
+    if isinstance(kv_total, Gauge):
+        kv = {"blocks_total": kv_total.value()}
+        in_use = reg.get("kv_blocks_in_use")
+        if isinstance(in_use, Gauge):
+            kv["blocks_in_use"] = in_use.value()
+        cached = reg.get("kv_blocks_cached")
+        if isinstance(cached, Gauge):
+            kv["blocks_cached"] = cached.value()
+        hits = reg.get("prefix_cache_hits_total")
+        if isinstance(hits, Counter):
+            kv["prefix_cache_hits"] = int(hits.total())
+        ratio = reg.get("speculative_accepted_ratio")
+        if isinstance(ratio, Gauge):
+            kv["speculative_accepted_ratio"] = ratio.value()
+        out["serving_kv"] = kv
     stamp = build_stamp()
     out["build"] = {"git": stamp["git"], "start_ts": stamp["start_ts"]}
     return out

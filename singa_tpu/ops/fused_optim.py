@@ -254,3 +254,104 @@ def adam_update(p, g, m, v, lr, bias_corr1, bias_corr2, *, beta_1,
       _to_rows(v, rows))
     return (_from_rows(po, shape, n), _from_rows(mo, shape, n),
             _from_rows(vo, shape, n))
+
+
+# ---------------------------------------------------------------------------
+# RMSProp
+# ---------------------------------------------------------------------------
+
+def _rmsprop_kernel(lr_ref, p_ref, g_ref, r_ref, po_ref, ro_ref, *,
+                    rho, epsilon, weight_decay):
+    lr = lr_ref[0, 0]
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * p
+    # op order mirrors opt.RMSProp.apply exactly (rho*rms first, then
+    # the (1-rho)*g*g term) so f32 params hold BITWISE parity
+    r_new = rho * r_ref[...].astype(jnp.float32) \
+        + (1.0 - rho) * g * g
+    r_stored = r_new.astype(ro_ref.dtype)
+    ro_ref[...] = r_stored
+    po_ref[...] = (p - lr * g
+                   / jnp.sqrt(r_stored.astype(jnp.float32)
+                              + epsilon)).astype(po_ref.dtype)
+
+
+def rmsprop_update(p, g, r, lr, *, rho, epsilon, weight_decay=0.0):
+    """Fused ``opt.RMSProp`` update: returns ``(p_new, rms_new)`` with
+    the input shapes/dtypes preserved, grad+master+rms read once and
+    master+rms written once (aliased in place). Math identical to the
+    reference chain — the rms store-back happens BEFORE the param
+    update reads it, exactly like the reference's
+    ``rms.data = ...; p.data = f(rms.data)`` sequence, so a non-f32
+    rms state quantizes at the same point in both paths."""
+    _mark("rmsprop")
+    shape, n = p.shape, p.size
+    rows = _pad_rows(n)
+    br = _block_rows(rows)
+    blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    kernel = functools.partial(
+        _rmsprop_kernel, rho=float(rho), epsilon=float(epsilon),
+        weight_decay=float(weight_decay))
+    po, ro = pl.pallas_call(
+        kernel,
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0)),
+                  blk, blk, blk],
+        out_specs=[blk, blk],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), p.dtype),
+                   jax.ShapeDtypeStruct((rows, _LANES), r.dtype)],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=_interpret(),
+    )(_scalar(lr), _to_rows(p, rows), _to_rows(g, rows),
+      _to_rows(r, rows))
+    return _from_rows(po, shape, n), _from_rows(ro, shape, n)
+
+
+# ---------------------------------------------------------------------------
+# AdaGrad
+# ---------------------------------------------------------------------------
+
+def _adagrad_kernel(lr_ref, p_ref, g_ref, h_ref, po_ref, ho_ref, *,
+                    epsilon, weight_decay):
+    lr = lr_ref[0, 0]
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * p
+    h_new = h_ref[...].astype(jnp.float32) + g * g
+    h_stored = h_new.astype(ho_ref.dtype)
+    ho_ref[...] = h_stored
+    po_ref[...] = (p - lr * g
+                   / jnp.sqrt(h_stored.astype(jnp.float32)
+                              + epsilon)).astype(po_ref.dtype)
+
+
+def adagrad_update(p, g, h, lr, *, epsilon, weight_decay=0.0):
+    """Fused ``opt.AdaGrad`` update: returns ``(p_new, history_new)``,
+    same one-HBM-pass/aliasing contract as the other kernels. The
+    accumulated-square history is unbounded by design (AdaGrad's
+    semantics); f32 accumulation in-kernel matches the reference's
+    f32 math on f32 state bitwise."""
+    _mark("adagrad")
+    shape, n = p.shape, p.size
+    rows = _pad_rows(n)
+    br = _block_rows(rows)
+    blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    kernel = functools.partial(
+        _adagrad_kernel, epsilon=float(epsilon),
+        weight_decay=float(weight_decay))
+    po, ho = pl.pallas_call(
+        kernel,
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0)),
+                  blk, blk, blk],
+        out_specs=[blk, blk],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), p.dtype),
+                   jax.ShapeDtypeStruct((rows, _LANES), h.dtype)],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=_interpret(),
+    )(_scalar(lr), _to_rows(p, rows), _to_rows(g, rows),
+      _to_rows(h, rows))
+    return _from_rows(po, shape, n), _from_rows(ho, shape, n)

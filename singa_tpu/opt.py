@@ -364,16 +364,37 @@ class SGD(Optimizer):
 
 
 class RMSProp(Optimizer):
-    """(reference opt.py:336-442)"""
+    """(reference opt.py:336-442)
 
-    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0):
+    ``fused=True`` routes eligible per-param updates through the
+    one-HBM-pass Pallas kernel (``ops.fused_optim.rmsprop_update``:
+    grad + master + rms read once, master + rms written once, aliased
+    in place). Same per-param decline rules as ``SGD(fused=True)`` —
+    regularizer/constraint attached, non-floating param, or too small
+    for a kernel launch keeps the reference elementwise chain — same
+    interpret-mode parity pin in the ``pallas`` tier, same
+    ``step_flops`` reference-twin registration (the kernel marks the
+    trace collector, so fused and unfused programs report identical
+    FLOPs)."""
+
+    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0,
+                 fused=False):
         super().__init__(lr)
         self.rho = rho
         self.epsilon = epsilon
         self.weight_decay = weight_decay
+        self.fused = bool(fused)
 
     def apply(self, name, p: Tensor, g: Tensor):
         grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
+        if self._fused_ok(name, p):
+            from .ops import fused_optim
+            rms = self._get_aux(f"{name}:rms", p)
+            p.data, rms.data = fused_optim.rmsprop_update(
+                p.data, grad, rms.data, self._scaled_lr(name),
+                rho=self.rho, epsilon=self.epsilon,
+                weight_decay=self.weight_decay)
+            return
         if self.weight_decay != 0:
             grad = grad + self.weight_decay * p.data
         grad = self.apply_regularizer_constraint(name, p.data, grad)
@@ -385,15 +406,28 @@ class RMSProp(Optimizer):
 
 
 class AdaGrad(Optimizer):
-    """(reference opt.py:444-534)"""
+    """(reference opt.py:444-534)
 
-    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0):
+    ``fused=True``: eligible params update through the one-HBM-pass
+    Pallas kernel (``ops.fused_optim.adagrad_update``). Same
+    gating/parity/FLOPs-twin story as ``RMSProp(fused=True)``."""
+
+    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0,
+                 fused=False):
         super().__init__(lr)
         self.epsilon = epsilon
         self.weight_decay = weight_decay
+        self.fused = bool(fused)
 
     def apply(self, name, p: Tensor, g: Tensor):
         grad = (g.data if isinstance(g, Tensor) else g).astype(p.dtype)
+        if self._fused_ok(name, p):
+            from .ops import fused_optim
+            hist = self._get_aux(f"{name}:history", p)
+            p.data, hist.data = fused_optim.adagrad_update(
+                p.data, grad, hist.data, self._scaled_lr(name),
+                epsilon=self.epsilon, weight_decay=self.weight_decay)
+            return
         if self.weight_decay != 0:
             grad = grad + self.weight_decay * p.data
         grad = self.apply_regularizer_constraint(name, p.data, grad)

@@ -8,7 +8,9 @@ The training runtime's hard-won invariants, applied to serving:
   requests finishing mid-batch and new ones refilling their slots (the
   ``pad_last`` validity-mask idiom from the data pipeline, CI-pinned
   like the train step's retrace guard);
-- **O(1) per token** — each slot owns a ring of KV rows
+- **O(1) per token** — each slot owns a ring of KV rows, or (under
+  ``kv_layout="paged"``) a block table into a fixed refcounted block
+  pool with prefix sharing and speculative multi-token verify ticks
   (:mod:`.kv_cache`); work and memory per emitted token are constant;
 - **exactly-once delivery** — every submitted request resolves its
   future exactly once (completed, failed, timed out, or rejected —
@@ -33,7 +35,8 @@ from .engine import (BatchServingEngine, ServingEngine,   # noqa: F401
 from .fleet import (EXIT_DRAINED, FleetRouter,            # noqa: F401
                     ServingReplica)
 from .gateway import serve_gateway                        # noqa: F401
-from .scheduler import (EngineDraining, QueueFull,        # noqa: F401
+from .scheduler import (BlockPoolExhausted,               # noqa: F401
+                        EngineDraining, QueueFull,
                         Request, RequestQueue, RequestTimeout,
                         ServeFuture, ServingError)
 
@@ -41,5 +44,5 @@ __all__ = [
     "ServingEngine", "BatchServingEngine", "build_engine",
     "ServingReplica", "FleetRouter", "EXIT_DRAINED", "serve_gateway",
     "ServingError", "QueueFull", "EngineDraining", "RequestTimeout",
-    "ServeFuture", "Request", "RequestQueue",
+    "BlockPoolExhausted", "ServeFuture", "Request", "RequestQueue",
 ]

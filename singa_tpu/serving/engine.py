@@ -29,6 +29,19 @@ blackbox):
   per-request temperature/top_k/seed vary without touching the
   compiled program.
 
+  ``kv_layout="paged"`` swaps the ring for the paged BLOCK POOL
+  (:mod:`.kv_cache`): memory scales with live tokens, identical
+  prompt prefixes share refcounted blocks (a prefix-cache hit skips
+  prefill for the shared span), and pool exhaustion is a typed
+  admission refusal — never an eviction of a live sequence.
+  ``speculative_k=K`` (paged only) turns the decode program into a
+  K-token VERIFY program: a host-side n-gram proposer drafts K-1
+  tokens, one tick scores all of them, and the greedy accept/reject
+  walk emits up to K tokens with token-for-token identity to
+  sequential greedy decoding (CI-pinned). Both are still the same
+  two-fixed-shape-program contract; ineligible configurations decline
+  LOUDLY (warning + ring/plain decode), never silently.
+
 - :class:`BatchServingEngine` — stateless models (the CNN/MLP zoo and
   ONNX imports through ``sonnx.SONNXModel``): each tick gathers up to
   ``W`` queued requests, pads the batch to the fixed width, runs ONE
@@ -58,8 +71,9 @@ from ..observability import perf as _perf
 from ..observability import spans as _spans
 from ..resilience.faults import NULL_PLAN, FaultInjected
 from ..models import decode as _decode
-from .scheduler import (EngineDraining, QueueFull, Request,
-                        RequestQueue, RequestTimeout, ServingError)
+from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
+                        Request, RequestQueue, RequestTimeout,
+                        ServingError)
 
 # donation is a TPU/accelerator optimisation; on CPU jax warns that the
 # donated buffers were unused — expected for OUR two programs, not
@@ -357,6 +371,13 @@ class _EngineBase:
         the next replica spin-up deserializes instead of tracing.
         Returns {program: manifest}."""
         from ..aot import export as _aot_export
+        if getattr(self, "kv_layout", "ring") == "paged":
+            raise ValueError(
+                "export_aot is not supported for the paged KV layout "
+                "yet: the serving AOT manifest contract describes the "
+                "ring programs' avals/geometry, and exporting a "
+                "mismatched twin would be a silently wrong program "
+                "(ROADMAP follow-on)")
         if store is None:
             store = getattr(self, "_aot_store", None)
         if store is None:
@@ -459,7 +480,9 @@ class ServingEngine(_EngineBase):
     """Continuous-batching autoregressive engine (module docstring)."""
 
     def __init__(self, adapter, *, slots=4, max_len=64, prefill_len=16,
-                 prefill_batch=2, policy=None, aot_store=None, **kw):
+                 prefill_batch=2, policy=None, aot_store=None,
+                 kv_layout="ring", kv_block_size=16, kv_blocks=None,
+                 speculative_k=0, **kw):
         super().__init__(**kw)
         import jax
 
@@ -481,29 +504,113 @@ class ServingEngine(_EngineBase):
             validate(prefill_len=self.prefill_len, max_len=self.max_len)
         self.policy = policy
         self._P = adapter.params()
-        self._cache = adapter.init_cache(self.slots, self.max_len)
         self._slots = [None] * self.slots        # host-side slot table
+
+        # -- KV layout resolution (decline loudly, never silently) -------
+        kv_layout = str(kv_layout)
+        if kv_layout not in ("ring", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'ring' or 'paged', got "
+                f"{kv_layout!r}")
+        self._kv_declined = None
+        if kv_layout == "paged" and \
+                not getattr(adapter, "supports_paged", False):
+            warnings.warn(
+                f"kv_layout='paged' declined: "
+                f"{type(adapter).__name__} has no paged block-pool "
+                "programs (its decode state is not per-position KV "
+                "rows); serving on the ring layout instead",
+                stacklevel=3)
+            self._kv_declined = "adapter_unsupported"
+            kv_layout = "ring"
+        self.kv_layout = kv_layout
+        # speculative_k = verify-program width: up to speculative_k
+        # tokens emitted per tick (speculative_k - 1 of them drafted).
+        # It needs the paged mask's position-exactness — a wrapped
+        # ring re-attributes a rejected draft's stale row INTO the
+        # sliding window (pos+1 wraps to pos-L+1), so the ring path
+        # declines rather than risking silent corruption.
+        spec = int(speculative_k or 0)
+        self._spec_declined = None
+        if spec > 1 and self.kv_layout != "paged":
+            warnings.warn(
+                "speculative_k declined: speculative decoding needs "
+                "kv_layout='paged' (the ring's wraparound would "
+                "re-attribute rejected-draft rows into the attention "
+                "window); decoding one token per tick",
+                stacklevel=3)
+            self._spec_declined = "requires_paged_layout"
+            spec = 0
+        self._spec_width = max(1, spec)
+        self.speculative_k = self._spec_width \
+            if self._spec_width > 1 else 0
 
         self._prefill_rec = {"n_traces": 0}
         self._decode_rec = {"n_traces": 0}
-        self._hbm_dev = _perf.first_jax_device(self._cache)
-        prefill_raw = adapter.prefill_fn()
-        decode_raw = adapter.decode_fn()
         prefill_rec, decode_rec = self._prefill_rec, self._decode_rec
 
-        def prefill_body(P, cache, tokens, lengths, slot_ids, valid):
-            prefill_rec["n_traces"] += 1
-            return prefill_raw(P, cache, tokens, lengths, slot_ids,
-                               valid)
+        if self.kv_layout == "paged":
+            from . import kv_cache as _kvc
+            self.kv_block_size = int(kv_block_size)
+            if self.kv_block_size < 1:
+                raise ValueError(
+                    f"kv_block_size must be >= 1, got {kv_block_size}")
+            self._max_blocks = -(-self.max_len // self.kv_block_size)
+            # default pool covers slots × max_len (no saving, full
+            # safety); a smaller kv_blocks is where paged memory
+            # elasticity lives — admission backpressure keeps it safe
+            self.kv_blocks = int(kv_blocks) if kv_blocks \
+                else self.slots * self._max_blocks
+            if self.kv_blocks < 1:
+                raise ValueError(
+                    f"kv_blocks must be >= 1, got {kv_blocks}")
+            self._mgr = _kvc.BlockManager(self.kv_blocks,
+                                          self.kv_block_size)
+            self._cache = adapter.init_pool(self.kv_blocks,
+                                            self.kv_block_size)
+            prefill_raw = adapter.paged_prefill_fn()
+            decode_raw = adapter.paged_decode_fn()
 
-        def decode_body(P, cache, tokens, positions, active):
-            # host-side trace counter, same contract as Model._build_step:
-            # the serve path must keep this at 1 (CI-pinned)
-            decode_rec["n_traces"] += 1
-            return decode_raw(P, cache, tokens, positions, active)
+            def prefill_body(P, pool, tables, tokens, starts, lengths,
+                             valid):
+                prefill_rec["n_traces"] += 1
+                return prefill_raw(P, pool, tables, tokens, starts,
+                                   lengths, valid)
 
-        # the ring cache is DONATED: the one large serving buffer is
-        # updated in place by XLA instead of doubling per tick
+            def decode_body(P, pool, tables, tokens, positions,
+                            counts):
+                # host-side trace counter, same contract as
+                # Model._build_step: 1 forever (CI-pinned) — block
+                # tables/draft rows vary per tick but their SHAPES are
+                # fixed, so prefix hits and speculative ticks reuse
+                # the one executable
+                decode_rec["n_traces"] += 1
+                return decode_raw(P, pool, tables, tokens, positions,
+                                  counts)
+        else:
+            self._mgr = None
+            self.kv_block_size = None
+            self.kv_blocks = None
+            self._cache = adapter.init_cache(self.slots, self.max_len)
+            prefill_raw = adapter.prefill_fn()
+            decode_raw = adapter.decode_fn()
+
+            def prefill_body(P, cache, tokens, lengths, slot_ids,
+                             valid):
+                prefill_rec["n_traces"] += 1
+                return prefill_raw(P, cache, tokens, lengths, slot_ids,
+                                   valid)
+
+            def decode_body(P, cache, tokens, positions, active):
+                # host-side trace counter, same contract as
+                # Model._build_step: the serve path must keep this at 1
+                decode_rec["n_traces"] += 1
+                return decode_raw(P, cache, tokens, positions, active)
+
+        self._hbm_dev = _perf.first_jax_device(self._cache)
+        # the KV state (ring cache or block pool) is DONATED: the one
+        # large serving buffer is updated in place by XLA instead of
+        # doubling per tick
         self._prefill = jax.jit(prefill_body, donate_argnums=(1,))
         self._decode = jax.jit(decode_body, donate_argnums=(1,))
         # warm restart: deserialize previously exported prefill/decode
@@ -514,7 +621,21 @@ class ServingEngine(_EngineBase):
         self._aot_store = None
         self._aot_source = None
         if aot_store is not None:
-            self._load_aot(aot_store)
+            if self.kv_layout == "paged":
+                # the AOT aval/geometry contract describes the ring
+                # programs; honoring it against a paged engine would
+                # deserialize the WRONG executable. Refuse typed —
+                # never a silently wrong program (aot-export support
+                # for the paged layout is a ROADMAP follow-on).
+                warnings.warn(
+                    "aot_store declined: the paged KV layout has no "
+                    "AOT manifest contract yet; compiling fresh",
+                    stacklevel=3)
+                self._aot_source = {
+                    "serve_prefill": "refused:paged_layout",
+                    "serve_decode": "refused:paged_layout"}
+            else:
+                self._load_aot(aot_store)
 
         self._occupancy = self._reg.gauge(
             "serve_slot_occupancy", "active sequences in the slot array")
@@ -528,6 +649,39 @@ class ServingEngine(_EngineBase):
             "ticks executed")
         self._prefills = self._reg.counter(
             "serve_prefill_total", "prompts prefilled into a slot")
+        if self.kv_layout == "paged":
+            # pool-pressure gauges: what /metrics.json and the
+            # heartbeat fleet view read to see a replica running out
+            # of KV blocks before requests start backing up
+            self._reg.gauge(
+                "kv_blocks_total",
+                "paged KV pool size in blocks").set(self.kv_blocks)
+            self._blocks_in_use = self._reg.gauge(
+                "kv_blocks_in_use",
+                "pool blocks referenced by live sequences (never "
+                "evicted)")
+            self._blocks_cached = self._reg.gauge(
+                "kv_blocks_cached",
+                "unreferenced blocks held by the prefix cache "
+                "(reclaimable, LRU)")
+            self._prefix_hits = self._reg.counter(
+                "prefix_cache_hits_total",
+                "admitted prompts whose prefix matched cached blocks "
+                "(prefill skipped for the shared span)")
+            self._prefix_tokens = self._reg.counter(
+                "prefix_cache_tokens_total",
+                "prompt tokens served from cached prefix blocks "
+                "instead of prefill compute")
+            self._spec_proposed = self._reg.counter(
+                "speculative_proposed_total",
+                "draft tokens proposed to the verify program")
+            self._spec_accepted = self._reg.counter(
+                "speculative_accepted_total",
+                "draft tokens accepted by the greedy verify rule")
+            self._spec_ratio = self._reg.gauge(
+                "speculative_accepted_ratio",
+                "cumulative accepted/proposed draft-token ratio (the "
+                "speculative speedup is roughly 1 + ratio × (k-1))")
 
     # -- AOT export / warm restart -----------------------------------------
     def _load_aot(self, store):
@@ -590,6 +744,25 @@ class ServingEngine(_EngineBase):
             raise ServingError(
                 f"prompt of {prompt.size} tokens exceeds this engine's "
                 f"prefill_len {self.prefill_len}")
+        if self.kv_layout == "paged":
+            total = int(prompt.size) + int(max_new_tokens)
+            if total > self.max_len:
+                self.queue.finish("rejected")
+                raise ServingError(
+                    f"prompt ({prompt.size}) + max_new_tokens "
+                    f"({int(max_new_tokens)}) = {total} exceeds "
+                    f"max_len {self.max_len}: the paged layout is "
+                    "exact full attention within max_len (no logical "
+                    "slot exists past it) — raise max_len, or use the "
+                    "ring layout for sliding-window generation")
+            if self._mgr.n_for(total) > self._mgr.n_blocks:
+                self.queue.finish("rejected")
+                raise BlockPoolExhausted(
+                    f"request needs {self._mgr.n_for(total)} KV blocks "
+                    f"but the whole pool is {self._mgr.n_blocks} "
+                    f"(× {self.kv_block_size} tokens): it can NEVER "
+                    "be admitted — raise kv_blocks or lower "
+                    "max_new_tokens")
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       eos_id=eos_id, seed=seed, timeout=timeout,
@@ -600,11 +773,13 @@ class ServingEngine(_EngineBase):
         """Serve-path retrace audit (the train-step audit's sibling):
         the decode program's ``n_traces`` must be 1 across ANY refill
         pattern — that is the continuous-batching invariant CI pins."""
-        return {"n_traces": self._decode_rec["n_traces"],
+        info = {"n_traces": self._decode_rec["n_traces"],
                 "prefill_n_traces": self._prefill_rec["n_traces"],
                 "slots": self.slots, "max_len": self.max_len,
                 "prefill_len": self.prefill_len,
                 "prefill_batch": self.prefill_batch,
+                "kv_layout": self.kv_layout,
+                "speculative_k": self.speculative_k,
                 "policy": self.policy.describe()
                 if self.policy is not None else None,
                 # warm-restart audit: per-program executable source
@@ -613,6 +788,18 @@ class ServingEngine(_EngineBase):
                 # store. The chaos warm-restart gate reads this off
                 # /healthz.
                 "aot": self._aot_source}
+        if self._kv_declined:
+            info["kv_layout_declined"] = self._kv_declined
+        if self._spec_declined:
+            info["speculative_declined"] = self._spec_declined
+        if self.kv_layout == "paged":
+            info.update(
+                kv_block_size=self.kv_block_size,
+                kv_blocks=self.kv_blocks,
+                kv_blocks_in_use=self._mgr.blocks_live(),
+                kv_blocks_cached=self._mgr.blocks_cached(),
+                prefix_cache_entries=len(self._mgr._cache))
+        return info
 
     def active_slots(self):
         return sum(1 for s in self._slots if s is not None)
@@ -622,18 +809,46 @@ class ServingEngine(_EngineBase):
         return len(self.queue) > 0 or any(
             s is not None for s in self._slots)
 
+    def _release_blocks(self, slot):
+        """Return a finished/failed paged sequence's block references
+        to the manager (its full prompt blocks enter the prefix
+        cache); no-op for ring slots."""
+        alloc = slot.get("alloc")
+        if alloc is not None and self._mgr is not None:
+            self._mgr.release(alloc, slot["req"].prompt)
+            self._update_pool_gauges()
+
+    def _update_pool_gauges(self):
+        if self._mgr is not None:
+            self._blocks_in_use.set(self._mgr.blocks_live())
+            self._blocks_cached.set(self._mgr.blocks_cached())
+
     def _fail_inflight(self, error):
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._slots[i] = None
+                self._release_blocks(slot)
                 if not slot["req"].future.done():
                     slot["req"].future.set_error(error)
                     self.queue.finish("failed")
         self._occupancy.set(0)
 
+    def _fail_batch(self, batch, exc):
+        # popped-but-never-slotted paged requests carry their block
+        # reservation on the request: give it back before failing them
+        for req in batch:
+            alloc = getattr(req, "_alloc", None)
+            if alloc is not None and self._mgr is not None:
+                self._mgr.release(alloc, req.prompt)
+                req._alloc = None
+        if self._mgr is not None:
+            self._update_pool_gauges()
+        super()._fail_batch(batch, exc)
+
     def _finish_slot(self, i, status="completed"):
         slot = self._slots[i]
         self._slots[i] = None
+        self._release_blocks(slot)
         req = slot["req"]
         if self._trace_requests:
             _spans.event("request.delivered", request=req.trace_id,
@@ -654,9 +869,11 @@ class ServingEngine(_EngineBase):
             req.future.set_error(ServingError(status))
         self.queue.finish(status)
 
-    def _sample_and_place(self, req, logits, slot_idx, pos):
+    def _sample_and_place(self, req, logits, slot_idx, pos,
+                          alloc=None):
         """Shared first-token/next-token bookkeeping: sample through
-        the ONE decode helper, record, finish or keep the slot hot."""
+        the ONE decode helper, record, finish or keep the slot hot.
+        ``alloc`` is the paged block reservation riding the slot."""
         tok = _decode.sample_logits(
             logits, temperature=req.temperature, top_k=req.top_k,
             rng=req.rng)
@@ -664,7 +881,8 @@ class ServingEngine(_EngineBase):
         self._tokens_total.inc()
         done = (len(req.tokens) >= req.max_new_tokens or
                 (req.eos_id is not None and tok == req.eos_id))
-        self._slots[slot_idx] = {"req": req, "pos": pos, "tok": tok}
+        self._slots[slot_idx] = {"req": req, "pos": pos, "tok": tok,
+                                 "alloc": alloc}
         if done:
             self._finish_slot(slot_idx)
 
@@ -676,11 +894,27 @@ class ServingEngine(_EngineBase):
             if slot is not None and slot["req"].expired(now):
                 self._finish_slot(i, status="timed_out")
 
-        # 2) admit: fill free slots, a fixed-width prefill batch per tick
+        # 2) admit: fill free slots, a fixed-width prefill batch per tick.
+        #    A paged engine additionally gates each pop on the block
+        #    pool: the admit predicate RESERVES the request's blocks
+        #    (prefix-shared ones re-referenced) so a batch can never
+        #    over-commit the pool; a request that doesn't fit right now
+        #    stays at the head of the queue (backpressure, FIFO-fair —
+        #    live sequences are never evicted to make room).
         free = [i for i, s in enumerate(self._slots) if s is None]
         if free and len(self.queue) > 0:
+            admit = None
+            if self.kv_layout == "paged":
+                def admit(req):
+                    try:
+                        req._alloc = self._mgr.admit(
+                            req.prompt,
+                            int(req.prompt.size) + req.max_new_tokens)
+                        return True
+                    except BlockPoolExhausted:
+                        return False
             batch = self.queue.pop_batch(
-                min(len(free), self.prefill_batch), now)
+                min(len(free), self.prefill_batch), now, admit=admit)
             if batch:
                 try:
                     with _spans.span("serve.prefill", n=len(batch)):
@@ -708,6 +942,11 @@ class ServingEngine(_EngineBase):
         self._sample_hbm()
 
     def _run_prefill(self, batch, free):
+        if self.kv_layout == "paged":
+            return self._run_prefill_paged(batch, free)
+        return self._run_prefill_ring(batch, free)
+
+    def _run_prefill_ring(self, batch, free):
         B, S = self.prefill_batch, self.prefill_len
         tokens = np.zeros((B, S), np.int32)
         lengths = np.zeros((B,), np.int32)
@@ -747,7 +986,158 @@ class ServingEngine(_EngineBase):
             self._sample_and_place(req, logits[b], slot_idx,
                                    pos=int(req.prompt.size))
 
+    def _run_prefill_paged(self, batch, free):
+        """Paged admission: each popped request arrives with its block
+        reservation already taken (the pop predicate); a prefix-cache
+        hit enters the compiled program with ``start > 0`` and only
+        its SUFFIX tokens — the shared span's prefill is skipped
+        entirely, its K/V served from the refcounted cached blocks."""
+        B, S = self.prefill_batch, self.prefill_len
+        tokens = np.zeros((B, S), np.int32)
+        starts = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self._max_blocks), np.int32)
+        valid = np.zeros((B,), bool)
+        placed = []
+        for b, req in enumerate(batch):
+            alloc = req._alloc
+            suffix = req.prompt[alloc.shared_tokens:]
+            tokens[b, :suffix.size] = suffix
+            starts[b] = alloc.shared_tokens
+            lengths[b] = suffix.size
+            tables[b, :len(alloc.blocks)] = alloc.blocks
+            valid[b] = True
+            placed.append((req, free[b], alloc))
+            if alloc.shared_tokens:
+                self._prefix_hits.inc()
+                self._prefix_tokens.inc(alloc.shared_tokens)
+        n0 = self._prefill_rec["n_traces"]
+        t0c = time.perf_counter()
+        cc0 = _cache_counts()
+        self._cache, logits = _quiet_donation(
+            self._prefill, self._P, self._cache, tables, tokens,
+            starts, lengths, valid)
+        if self._prefill_rec["n_traces"] > n0:
+            _attribute_trace(self._prefill_rec, self._reg,
+                             "serve_prefill",
+                             [tables, tokens, starts, lengths, valid],
+                             ("tables", "tokens", "starts", "lengths",
+                              "valid"), t0c, cc0)
+        logits = np.asarray(logits)
+        self._update_pool_gauges()
+        for b, (req, slot_idx, alloc) in enumerate(placed):
+            req._alloc = None      # the slot owns the reservation now
+            req.first_token_at = time.monotonic()
+            self._ttft.observe(req.first_token_at - req.submitted_at)
+            self._prefills.inc()
+            if self._trace_requests:
+                _spans.event("request.prefill", request=req.trace_id,
+                             slot=slot_idx,
+                             prompt_len=int(req.prompt.size),
+                             prefix_hit_tokens=int(alloc.shared_tokens))
+            # the first generated token sits at position prompt_len;
+            # its k/v are written by the NEXT decode tick
+            self._sample_and_place(req, logits[b], slot_idx,
+                                   pos=int(req.prompt.size),
+                                   alloc=alloc)
+
     def _run_decode(self):
+        if self.kv_layout == "paged":
+            return self._run_decode_paged()
+        return self._run_decode_ring()
+
+    def _run_decode_paged(self):
+        """One verify tick: every active slot's row is its pending
+        token plus up to ``speculative_k - 1`` n-gram drafts; the ONE
+        compiled program writes all rows' k/v and scores every
+        position, and the host accept/reject walk emits the longest
+        prefix of drafts matching greedy — each emitted token is
+        EXACTLY what sequential greedy would have produced (the CI
+        parity invariant). Rejected drafts leave stale rows at
+        positions past the new ``pos``; the position-exact paged mask
+        keeps them unreachable until overwritten."""
+        W, K = self.slots, self._spec_width
+        tokens = np.zeros((W, K), np.int32)
+        positions = np.zeros((W,), np.int32)
+        counts = np.zeros((W,), np.int32)
+        tables = np.zeros((W, self._max_blocks), np.int32)
+        rows = {}
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            req = slot["req"]
+            n = 1
+            if K > 1 and req.temperature == 0:
+                # greedy-only: the accept rule below is exact for
+                # argmax; a sampled request decodes one token per tick
+                # (its per-request rng draw order must not change)
+                remaining = req.max_new_tokens - len(req.tokens)
+                room = self.max_len - slot["pos"]
+                n = max(1, min(K, remaining, room))
+            row = [slot["tok"]]
+            if n > 1:
+                row += _decode.ngram_propose(
+                    list(req.prompt) + req.tokens, n - 1)
+                self._spec_proposed.inc(n - 1)
+            tokens[i, :len(row)] = row
+            positions[i] = slot["pos"]
+            counts[i] = len(row)
+            tables[i, :len(slot["alloc"].blocks)] = \
+                slot["alloc"].blocks
+            rows[i] = row
+        n0 = self._decode_rec["n_traces"]
+        t0c = time.perf_counter()
+        cc0 = _cache_counts()
+        self._cache, logits = _quiet_donation(
+            self._decode, self._P, self._cache, tables, tokens,
+            positions, counts)
+        if self._decode_rec["n_traces"] > n0:
+            _attribute_trace(self._decode_rec, self._reg,
+                             "serve_decode",
+                             [tables, tokens, positions, counts],
+                             ("tables", "tokens", "positions",
+                              "counts"), t0c, cc0)
+        logits = np.asarray(logits)
+        for i, slot in enumerate(list(self._slots)):
+            if slot is None:
+                continue
+            req, row, cnt = slot["req"], rows[i], int(counts[i])
+            emitted = 0
+            done = False
+            for j in range(cnt):
+                tok = _decode.sample_logits(
+                    logits[i, j], temperature=req.temperature,
+                    top_k=req.top_k, rng=req.rng)
+                req.tokens.append(tok)
+                self._tokens_total.inc()
+                emitted += 1
+                done = (len(req.tokens) >= req.max_new_tokens or
+                        (req.eos_id is not None and tok == req.eos_id))
+                if done:
+                    break
+                if j + 1 < cnt and row[j + 1] == tok:
+                    continue        # draft accepted: its k/v row is
+                break               # already correct; score the next
+            if cnt > 1:
+                self._spec_accepted.inc(emitted - 1)
+                proposed = self._spec_proposed.total()
+                if proposed:
+                    self._spec_ratio.set(
+                        self._spec_accepted.total() / proposed)
+            n_tok = len(req.tokens)
+            if self._trace_requests and \
+                    (n_tok < 16 or n_tok % 16 < emitted):
+                _spans.event("request.decode_tick",
+                             request=req.trace_id, slot=i,
+                             pos=slot["pos"] + emitted,
+                             emitted=emitted)
+            self._slots[i] = {"req": req, "pos": slot["pos"] + emitted,
+                              "tok": req.tokens[-1],
+                              "alloc": slot["alloc"]}
+            if done:
+                self._finish_slot(i)
+
+    def _run_decode_ring(self):
         W = self.slots
         tokens = np.zeros((W,), np.int32)
         positions = np.zeros((W,), np.int32)
@@ -1014,7 +1404,8 @@ def build_engine(model, **kw):
         ar_keys = ("slots", "max_len", "prefill_len", "prefill_batch",
                    "policy", "queue_capacity", "faults", "registry",
                    "telemetry_dir", "max_retries", "trace_requests",
-                   "aot_store", "profile_every")
+                   "aot_store", "profile_every", "kv_layout",
+                   "kv_block_size", "kv_blocks", "speculative_k")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(

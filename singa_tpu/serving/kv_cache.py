@@ -1,22 +1,52 @@
-"""Fixed-shape ring KV caches: O(1) autoregressive decode state.
+"""Fixed-shape KV caches: ring buffers and the paged block pool.
 
 The serving engine's decode program must have ONE shape forever —
 ``compiled_step_info()["n_traces"] == 1`` is the serve-path invariant —
-so the attention cache cannot grow with the sequence. Instead each slot
-owns a RING of ``length`` key/value rows per layer: token ``t`` writes
-ring index ``t % length``, and the decode attention masks each index by
-the token position it currently holds. Work and memory per emitted
-token are therefore constant (the compiler-first O(1)-cache design of
-PAPERS.md arxiv 2603.09555); semantically the ring IS sliding-window
-attention over the last ``length`` tokens, and for sequences that fit
-(``pos < length``) it is exactly full causal attention — the
-wraparound-vs-reference test in ``tests/test_serving.py`` pins both.
+so the attention cache cannot grow with the sequence. Two layouts
+satisfy that contract:
 
-Everything here is a pure function over arrays, shape-stable by
-construction, ready to be closed over by a jitted prefill/decode body.
-Layout: one cache level is ``(n_slots, n_heads, length, head_dim)``.
+**Ring** (the original, still the default): each slot owns a RING of
+``length`` key/value rows per layer: token ``t`` writes ring index
+``t % length``, and the decode attention masks each index by the token
+position it currently holds. Work and memory per emitted token are
+constant (the compiler-first O(1)-cache design of PAPERS.md arxiv
+2603.09555); semantically the ring IS sliding-window attention over the
+last ``length`` tokens, and for sequences that fit (``pos < length``)
+it is exactly full causal attention — the wraparound-vs-reference test
+in ``tests/test_serving.py`` pins both. One ring level is
+``(n_slots, n_heads, length, head_dim)`` — a W×L×H×D monolith whether
+the slots are long, short, or empty.
 
-Position bookkeeping (who holds ring index ``j`` when the newest
+**Paged** (``compile_serving(kv_layout="paged")``): one fixed POOL of
+``(n_blocks, n_heads, block_size, head_dim)`` KV blocks per layer plus
+a host-side per-slot block table mapping logical block index
+``position // block_size`` to a pool block id. Memory scales with LIVE
+tokens (each admitted request reserves exactly the blocks its
+``prompt + max_new_tokens`` span needs) instead of slots × max_len, and
+identical prompt prefixes SHARE refcounted blocks: a prefix-cache hit
+skips prefill compute for the shared span entirely (the suffix is
+prefilled chunked, attending to the cached prefix through the same
+block table). Sharing granularity is whole blocks, capped one token
+short of the full prompt (the last prompt token is always prefilled so
+its logits exist); divergence is handled by construction — the
+divergent tail block is never shared, the new request writes its own
+copy (copy-on-write without a device copy). The device math is
+position-exact: logical block ``b`` offset ``o`` holds position
+``b*block_size + o``, attention masks ``position <= query position``,
+so stale rows (freed sequences, rejected speculative drafts) are
+unreachable until overwritten. The host-side :class:`BlockManager`
+owns allocation, refcounts, and the prefix cache; exhaustion is a
+typed :class:`~singa_tpu.serving.scheduler.BlockPoolExhausted`
+admission refusal — a LIVE sequence's blocks are never evicted, only
+unreferenced cached prefixes are reclaimed (LRU).
+
+Everything device-side here is a pure function over arrays,
+shape-stable by construction, ready to be closed over by a jitted
+prefill/decode body. ``dtype=int8`` rides both layouts: per-row fp32
+scales beside the ring, per-(block, offset) scale pools beside the
+paged blocks.
+
+Ring position bookkeeping (who holds ring index ``j`` when the newest
 written token is at position ``p``)::
 
     t_j = p - ((p - j) % length)        # newest token position at j
@@ -175,5 +205,284 @@ def attend(q, level, pos, scale):
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# paged block pool: device math
+# ---------------------------------------------------------------------------
+
+def init_pool(n_blocks, n_heads, block_size, head_dim,
+              dtype=jnp.float32):
+    """One layer's block pool: zeroed ``{"k","v"}`` of shape
+    ``(n_blocks, n_heads, block_size, head_dim)``.
+
+    ``dtype=int8`` builds the QUANTIZED pool: int8 payloads plus one
+    fp32 scale per (block, offset) row — ``{"k_scale","v_scale"}`` of
+    shape ``(n_blocks, block_size)`` — written alongside every row and
+    folded back in inside :func:`gather_pages`. Same per-row symmetric
+    convention as the int8 ring (``quant.core.quantize_int8_rows``),
+    so the two layouts cannot silently diverge numerically."""
+    shape = (int(n_blocks), int(n_heads), int(block_size),
+             int(head_dim))
+    level = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        # distinct buffers (whole-pool donation, like the int8 ring)
+        level["k_scale"] = jnp.ones((int(n_blocks), int(block_size)),
+                                    jnp.float32)
+        level["v_scale"] = jnp.ones((int(n_blocks), int(block_size)),
+                                    jnp.float32)
+    return level
+
+
+def write_rows(level, tables, k_new, v_new, pos, wmask):
+    """Write token rows into their block-table-mapped pool rows.
+
+    ``tables``: ``(R, n_pages)`` int32 pool block ids per row (slot);
+    ``k_new``/``v_new``: ``(R, H, Q, D)`` fresh rows; ``pos``:
+    ``(R, Q)`` absolute token positions; ``wmask``: ``(R, Q)`` bool —
+    False rows (batch padding, inactive slots, draft padding) are
+    DROPPED via an out-of-bounds scatter index, never written. One
+    scatter per tensor, fixed shape for any R/Q."""
+    N = level["k"].shape[0]
+    bs = level["k"].shape[2]
+    pos = pos.astype(jnp.int32)
+    page = jnp.take_along_axis(tables.astype(jnp.int32),
+                               pos // bs, axis=1)        # (R, Q)
+    off = pos % bs
+    # masked rows scatter to block id N: out of bounds, mode="drop"
+    page = jnp.where(wmask, page, N)
+    R, H, Q, D = k_new.shape
+    flat = lambda a: a.transpose(0, 2, 1, 3).reshape(R * Q, H, D)  # noqa: E731
+    pf, of = page.reshape(-1), off.reshape(-1)
+    if "k_scale" not in level:
+        k_rows, v_rows = flat(k_new), flat(v_new)
+        return dict(
+            level,
+            k=level["k"].at[pf, :, of, :].set(
+                k_rows.astype(level["k"].dtype), mode="drop"),
+            v=level["v"].at[pf, :, of, :].set(
+                v_rows.astype(level["v"].dtype), mode="drop"))
+    from ..quant.core import quantize_int8_rows
+    # one scale per (row, token): amax over heads × head_dim
+    kq, ks = quantize_int8_rows(k_new, (1, 3))           # scale (R, Q)
+    vq, vs = quantize_int8_rows(v_new, (1, 3))
+    return dict(
+        level,
+        k=level["k"].at[pf, :, of, :].set(flat(kq), mode="drop"),
+        v=level["v"].at[pf, :, of, :].set(flat(vq), mode="drop"),
+        k_scale=level["k_scale"].at[pf, of].set(
+            ks.reshape(-1), mode="drop"),
+        v_scale=level["v_scale"].at[pf, of].set(
+            vs.reshape(-1), mode="drop"))
+
+
+def gather_pages(level, tables):
+    """Materialise each row's logical KV view from its block table:
+    ``(R, n_pages)`` table -> f32 ``k, v`` of
+    ``(R, H, n_pages*block_size, D)`` with logical index == token
+    position. A quantized pool dequantizes here (payload × per-row
+    scale) into the caller's f32 softmax. Unallocated table entries
+    gather garbage by design — the caller's position mask never admits
+    a position beyond the row's allocated span."""
+    t = tables.astype(jnp.int32)
+    k = jnp.take(level["k"], t, axis=0)     # (R, P, H, bs, D)
+    v = jnp.take(level["v"], t, axis=0)
+    if "k_scale" in level:
+        ks = jnp.take(level["k_scale"], t, axis=0)       # (R, P, bs)
+        vs = jnp.take(level["v_scale"], t, axis=0)
+        k = k.astype(jnp.float32) * ks[:, :, None, :, None]
+        v = v.astype(jnp.float32) * vs[:, :, None, :, None]
+    R, P, H, bs, D = k.shape
+    k = k.transpose(0, 2, 1, 3, 4).reshape(R, H, P * bs, D)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(R, H, P * bs, D)
+    return k, v
+
+
+def attend_pages(q, level, tables, q_pos, scale):
+    """Paged causal attention: each query attends every cached position
+    ``<= its own`` through the row's block table.
+
+    ``q``: ``(R, H, Q, D)``; ``q_pos``: ``(R, Q)`` absolute query
+    positions (the fresh rows are written BEFORE this runs, so a query
+    sees itself and everything earlier — exactly full causal
+    attention). Softmax in f32 regardless of pool dtype, result cast
+    back to ``q.dtype``. Returns ``(R, H, Q, D)``."""
+    kf, vf = gather_pages(level, tables)
+    L = kf.shape[2]
+    s = jnp.einsum("rhqd,rhld->rhql", q.astype(jnp.float32),
+                   kf.astype(jnp.float32)) * scale
+    mask = jnp.arange(L, dtype=jnp.int32)[None, None, None, :] \
+        <= q_pos.astype(jnp.int32)[:, None, :, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    a = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("rhql,rhld->rhqd", a, vf.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# paged block pool: host-side manager (allocation, refcounts, prefix cache)
+# ---------------------------------------------------------------------------
+
+class SlotAlloc:
+    """One admitted sequence's block reservation: the pool block ids
+    covering its full ``prompt + max_new_tokens`` span (shared prefix
+    blocks first, then private blocks), plus how many prompt tokens the
+    prefix-cache hit covers (``shared_tokens`` — prefill skips them)."""
+
+    __slots__ = ("blocks", "shared_tokens", "prompt_blocks")
+
+    def __init__(self, blocks, shared_tokens, prompt_blocks):
+        self.blocks = list(blocks)
+        self.shared_tokens = int(shared_tokens)
+        # how many leading blocks hold FULL prompt content (cacheable
+        # on release); the partial tail / generated blocks never cache
+        self.prompt_blocks = int(prompt_blocks)
+
+
+class BlockManager:
+    """Host-side block accounting for one engine's pool (single loop
+    thread; no locking needed — submit-path callers only read totals).
+
+    Block states: **free** (on the free list), **live** (refcount > 0 —
+    NEVER reclaimed), **cached** (refcount 0 but registered in the
+    prefix cache — reclaimable, LRU). The prefix cache maps a CHAINED
+    content key (this block's tokens + everything before it) to a block
+    id, so a hit guarantees the whole preceding context matches — the
+    only condition under which cached K/V rows are reusable."""
+
+    def __init__(self, n_blocks, block_size):
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self._ref = [0] * self.n_blocks
+        self._key = [None] * self.n_blocks      # prefix-cache key or None
+        self._free = list(range(self.n_blocks - 1, -1, -1))
+        self._cache = {}                        # chained key -> block id
+        self._lru = {}                          # block id -> stamp
+        self._tick = 0
+
+    # -- introspection (gauges, tests) -------------------------------------
+    def blocks_live(self):
+        return sum(1 for r in self._ref if r > 0)
+
+    def blocks_cached(self):
+        return sum(1 for i, r in enumerate(self._ref)
+                   if r == 0 and self._key[i] is not None)
+
+    def blocks_free(self):
+        return len(self._free)
+
+    def n_for(self, n_tokens):
+        """Blocks covering ``n_tokens`` positions."""
+        return -(-int(n_tokens) // self.block_size)
+
+    # -- prefix cache -------------------------------------------------------
+    def _chain_keys(self, prompt):
+        """Chained content keys for each FULL block of ``prompt``."""
+        bs = self.block_size
+        keys, prev = [], ()
+        for b in range(len(prompt) // bs):
+            prev = (prev, tuple(int(t) for t in prompt[b*bs:(b+1)*bs]))
+            keys.append(prev)
+        return keys
+
+    def match_prefix(self, prompt):
+        """Longest cached full-block prefix of ``prompt``, capped one
+        token short of the whole prompt (the last token must be
+        prefilled so its logits exist). Returns
+        ``(block_ids, n_tokens)`` WITHOUT taking references —
+        :meth:`admit` re-matches and takes them atomically."""
+        cap = (len(prompt) - 1) // self.block_size
+        ids = []
+        for key in self._chain_keys(prompt)[:cap]:
+            bid = self._cache.get(key)
+            if bid is None:
+                break
+            ids.append(bid)
+        return ids, len(ids) * self.block_size
+
+    # -- allocation ---------------------------------------------------------
+    def _reclaimable(self, shared):
+        """Free + cached blocks available to a request whose prefix hit
+        covers ``shared`` (those are about to become live — they must
+        not be counted as evictable fuel for the same admission)."""
+        keep = set(shared)
+        cached = sum(1 for i, r in enumerate(self._ref)
+                     if r == 0 and self._key[i] is not None
+                     and i not in keep)
+        return len(self._free) + cached
+
+    def can_admit(self, prompt, total_tokens):
+        """Whether :meth:`admit` would succeed right now (the queue's
+        backpressure gate — a request that cannot be placed THIS tick
+        stays queued, it is not failed)."""
+        shared, _ = self.match_prefix(prompt)
+        need = self.n_for(total_tokens) - len(shared)
+        return need <= self._reclaimable(shared)
+
+    def admit(self, prompt, total_tokens):
+        """Reserve every block the sequence can ever touch (positions
+        ``[0, total_tokens)`` — decode can then never stall or corrupt
+        a neighbour mid-flight). Shared prefix blocks are re-referenced
+        FIRST (so LRU reclaim can never eat the prefix being shared);
+        the rest come from the free list, reclaiming LRU cached blocks
+        when it runs dry. Raises
+        :class:`~singa_tpu.serving.scheduler.BlockPoolExhausted` when
+        the pool cannot cover it without touching a live block."""
+        from .scheduler import BlockPoolExhausted
+        shared, shared_tokens = self.match_prefix(prompt)
+        need = self.n_for(total_tokens) - len(shared)
+        if need > self._reclaimable(shared):
+            live = self.blocks_live()
+            raise BlockPoolExhausted(
+                f"block pool exhausted: need {need} free blocks for a "
+                f"{total_tokens}-token reservation ({len(shared)} "
+                f"shared), have {len(self._free)} free + "
+                f"{self.blocks_cached()} reclaimable cached "
+                f"({live} live blocks are never evicted; pool is "
+                f"{self.n_blocks} × {self.block_size} tokens)")
+        self._tick += 1
+        for bid in shared:
+            self._ref[bid] += 1
+            self._lru[bid] = self._tick
+        fresh = [self._take_free() for _ in range(need)]
+        return SlotAlloc(shared + fresh, shared_tokens,
+                         len(prompt) // self.block_size)
+
+    def _take_free(self):
+        if not self._free:
+            self._evict_lru()
+        bid = self._free.pop()
+        self._ref[bid] = 1
+        return bid
+
+    def _evict_lru(self):
+        """Reclaim the least-recently-used CACHED block (refcount 0).
+        Callers guarantee one exists (can_admit/admit checked)."""
+        victim = min(
+            (i for i in range(self.n_blocks)
+             if self._ref[i] == 0 and self._key[i] is not None),
+            key=lambda i: self._lru.get(i, 0))
+        del self._cache[self._key[victim]]
+        self._key[victim] = None
+        self._lru.pop(victim, None)
+        self._free.append(victim)
+
+    def release(self, alloc, prompt):
+        """Drop a finished/failed sequence's references. Its FULL
+        prompt blocks enter the prefix cache (refcount 0, reclaimable)
+        so the next identical prompt skips their prefill; partial-tail
+        and generated-token blocks free immediately."""
+        keys = self._chain_keys(prompt)
+        self._tick += 1
+        for i, bid in enumerate(alloc.blocks):
+            self._ref[bid] -= 1
+            if i < alloc.prompt_blocks and self._key[bid] is None \
+                    and keys[i] not in self._cache:
+                self._key[bid] = keys[i]
+                self._cache[keys[i]] = bid
+                self._lru[bid] = self._tick
+            if self._ref[bid] == 0 and self._key[bid] is None:
+                self._free.append(bid)
+
+
 __all__ = ["init_cache", "ring_positions", "ring_mask", "write_token",
-           "write_prompt", "attend"]
+           "write_prompt", "attend", "init_pool", "write_rows",
+           "gather_pages", "attend_pages", "SlotAlloc", "BlockManager"]

@@ -48,6 +48,16 @@ class RequestTimeout(ServingError):
     """The request's deadline passed before a response completed."""
 
 
+class BlockPoolExhausted(ServingError):
+    """Admission refused: the paged KV block pool cannot cover the
+    request's ``prompt + max_new_tokens`` reservation without evicting
+    a LIVE sequence's blocks (which never happens — only unreferenced
+    cached prefixes are reclaimable). Raised synchronously at
+    ``submit`` when the request could NEVER fit the pool; a request
+    that merely has to wait for in-flight sequences to finish stays
+    queued instead (backpressure, not failure)."""
+
+
 class ServeFuture:
     """One request's response slot: fulfilled exactly once.
 
@@ -167,15 +177,34 @@ class RequestQueue:
                 f"request queue at capacity ({self.capacity}); "
                 "retry against another replica")
 
-    def pop_batch(self, n, now=None):
+    def pop_batch(self, n, now=None, admit=None):
         """Up to ``n`` non-expired requests, FIFO. Expired requests are
         fulfilled with :class:`RequestTimeout` here (counted
-        ``timed_out``) — they never consume a slot."""
+        ``timed_out``) — they never consume a slot. ``admit`` (an
+        optional predicate) gates each pop: the first refused request
+        STOPS the batch and stays at the head of the queue — the paged
+        engine's block-pool backpressure, FIFO-fair by construction
+        (nothing behind an unplaceable request jumps it)."""
         taken, expired = [], []
         with self._lock:
             while self._q and len(taken) < n:
-                req = self._q.popleft()
-                (expired if req.expired(now) else taken).append(req)
+                req = self._q[0]
+                if req.expired(now):
+                    expired.append(self._q.popleft())
+                    continue
+                if admit is not None and not admit(req):
+                    # the blocked head stays — but the deadline sweep
+                    # must still reach everything queued BEHIND it, or
+                    # a timed-out request would sit unresolved for as
+                    # long as the head waits for blocks
+                    keep = deque()
+                    while self._q:
+                        r = self._q.popleft()
+                        (expired if r.expired(now)
+                         else keep).append(r)
+                    self._q.extend(keep)
+                    break
+                taken.append(self._q.popleft())
             depth = len(self._q)
         self._depth.set(depth)
         for req in expired:
@@ -203,4 +232,5 @@ class RequestQueue:
 
 
 __all__ = ["ServingError", "QueueFull", "EngineDraining",
-           "RequestTimeout", "ServeFuture", "Request", "RequestQueue"]
+           "RequestTimeout", "BlockPoolExhausted", "ServeFuture",
+           "Request", "RequestQueue"]

@@ -106,6 +106,37 @@ class TestAdamKernel:
             np.testing.assert_allclose(np.asarray(vn), v_ref, atol=1e-6)
 
 
+class TestRmspropKernel:
+    def test_matches_reference_math(self):
+        for shape in ((1024,), (13, 10), (4099,)):
+            p, g = _rand(shape), _rand(shape, 1)
+            r = np.abs(_rand(shape, 2))
+            pn, rn = fused_optim.rmsprop_update(
+                jnp.asarray(p), jnp.asarray(g), jnp.asarray(r),
+                jnp.float32(0.05), rho=0.9, epsilon=1e-8,
+                weight_decay=1e-4)
+            g2 = g + 1e-4 * p
+            r_ref = 0.9 * r + 0.1 * g2 * g2
+            p_ref = p - 0.05 * g2 / np.sqrt(r_ref + 1e-8)
+            assert pn.shape == shape and rn.shape == shape
+            np.testing.assert_allclose(np.asarray(rn), r_ref, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(pn), p_ref, atol=1e-6)
+
+
+class TestAdagradKernel:
+    def test_matches_reference_math(self):
+        for shape in ((513,), (32, 32), (9, 7)):
+            p, g = _rand(shape), _rand(shape, 1)
+            h = np.abs(_rand(shape, 2))
+            pn, hn = fused_optim.adagrad_update(
+                jnp.asarray(p), jnp.asarray(g), jnp.asarray(h),
+                jnp.float32(0.1), epsilon=1e-8, weight_decay=0.0)
+            h_ref = h + g * g
+            p_ref = p - 0.1 * g / np.sqrt(h_ref + 1e-8)
+            np.testing.assert_allclose(np.asarray(hn), h_ref, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(pn), p_ref, atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # optimizer integration + gating
 # ---------------------------------------------------------------------------
@@ -163,6 +194,47 @@ class TestFusedOptimizers:
         for k in ref:
             np.testing.assert_allclose(ref[k], fus[k], atol=1e-6,
                                        err_msg=k)
+
+    def test_rmsprop_end_to_end_parity_bitwise(self):
+        ref, _ = _train(opt.RMSProp(lr=0.05, rho=0.9,
+                                    weight_decay=1e-4))
+        fus, mf = _train(opt.RMSProp(lr=0.05, rho=0.9,
+                                     weight_decay=1e-4, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert rec.get("fused_kinds") == ["rmsprop"], \
+            rec.get("fused_kinds")
+        for k in ref:
+            assert np.array_equal(ref[k], fus[k]), k
+
+    def test_adagrad_end_to_end_parity_bitwise(self):
+        ref, _ = _train(opt.AdaGrad(lr=0.1))
+        fus, mf = _train(opt.AdaGrad(lr=0.1, fused=True))
+        rec = next(iter(mf._steps.values()))
+        assert rec.get("fused_kinds") == ["adagrad"]
+        for k in ref:
+            assert np.array_equal(ref[k], fus[k]), k
+
+    def test_rmsprop_regularized_param_declines_per_param(self):
+        o = opt.RMSProp(lr=0.05, fused=True)
+        o.register("fc1.W", regularizer=opt.Regularizer("l2", 1e-3))
+        o_ref = opt.RMSProp(lr=0.05)
+        o_ref.register("fc1.W", regularizer=opt.Regularizer("l2", 1e-3))
+        fus, mf = _train(o)
+        ref, _ = _train(o_ref)
+        for k in ref:
+            assert np.array_equal(ref[k], fus[k]), k
+        rec = next(iter(mf._steps.values()))
+        assert rec.get("fused_kinds") == ["rmsprop"]
+
+    def test_rmsprop_adagrad_flops_twin(self):
+        _, mr = _train(opt.RMSProp(lr=0.05))
+        _, mf = _train(opt.RMSProp(lr=0.05, fused=True))
+        assert mr.step_flops(compute=True) == \
+            mf.step_flops(compute=True)
+        _, ar = _train(opt.AdaGrad(lr=0.1))
+        _, af = _train(opt.AdaGrad(lr=0.1, fused=True))
+        assert ar.step_flops(compute=True) == \
+            af.step_flops(compute=True)
 
     def test_fused_keeps_n_traces_at_one(self):
         _, mf = _train(opt.SGD(lr=0.1, momentum=0.9, fused=True),

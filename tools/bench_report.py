@@ -48,6 +48,7 @@ METRICS = [
         "p99_token_s"), 1e3)),
     ("quant_img_s", lambda p: (p.get("quant") or {}).get(
         "resnet_img_s")),
+    ("sweep_best_tok_s", lambda p: _sweep_best(p.get("serving_sweep"))),
     ("hbm_peak_gib", lambda p: _scale(p.get("hbm_peak_bytes"),
                                       1 / 2**30)),
     ("bf16_hbm_gib", lambda p: _scale(p.get("bf16_hbm_peak_bytes"),
@@ -59,7 +60,12 @@ METRICS = [
 # metrics are reported with deltas but a rise there is not flagged
 # (the p99 of a 2-request CPU smoke is far too noisy to gate on)
 GATED = {"img_s", "bf16_img_s", "lm_tok_s", "lm_bf16_tok_s",
-         "serve_tok_s", "quant_img_s"}
+         "serve_tok_s", "quant_img_s", "sweep_best_tok_s"}
+
+# SLO latency targets (ms) the serving_sweep winner table is computed
+# against: for each, the highest-throughput config whose p99 per-tick
+# latency fits under it ("None" = unconstrained best throughput)
+SWEEP_SLO_TARGETS_MS = (1.0, 5.0, 25.0, None)
 
 # per-leg MFU columns the --mfu-floor gate guards (the MFU-push PRs'
 # cron tripwire: a win banked by one round must not silently erode)
@@ -79,6 +85,48 @@ TIMELINE_LEGS = [("timeline", "fp32"), ("bf16_timeline", "bf16"),
 
 def _scale(v, k):
     return v * k if isinstance(v, (int, float)) else None
+
+
+def _sweep_configs(sweep):
+    return [c for c in (sweep or {}).get("configs") or []
+            if isinstance(c, dict)
+            and isinstance(c.get("decode_tok_s"), (int, float))]
+
+
+def _sweep_best(sweep):
+    """Best decode tok/s across the round's serving_sweep configs —
+    the one scalar the trajectory/regression gate tracks (per-config
+    curves render separately)."""
+    configs = _sweep_configs(sweep)
+    return max((c["decode_tok_s"] for c in configs), default=None)
+
+
+def _cfg_name(c):
+    return (f"{c.get('kv_layout', '?')} s{c.get('slots', '?')}"
+            f" pf{c.get('prefill_len', '?')}"
+            f" k{c.get('speculative_k', 0)}")
+
+
+def sweep_winners(sweep):
+    """Winner per SLO target: for each p99 tick-latency budget, the
+    highest-throughput config that fits under it. The load-sweep's
+    whole point — "which engine config should this fleet run at THIS
+    latency target" answered from banked curves, not guesses."""
+    configs = _sweep_configs(sweep)
+    winners = []
+    for t in SWEEP_SLO_TARGETS_MS:
+        elig = [c for c in configs
+                if t is None
+                or (isinstance(c.get("p99_token_s"), (int, float))
+                    and c["p99_token_s"] * 1e3 <= t)]
+        if not elig:
+            winners.append({"slo_ms": t, "config": None})
+            continue
+        best = max(elig, key=lambda c: c["decode_tok_s"])
+        winners.append({"slo_ms": t, "config": _cfg_name(best),
+                        "decode_tok_s": best["decode_tok_s"],
+                        "p99_ms": _scale(best.get("p99_token_s"), 1e3)})
+    return winners
 
 
 def _round_no(path):
@@ -155,6 +203,32 @@ def build_report(records, threshold=0.05, mfu_floor=None):
                         tl.get("exposed_collective_s")}
         if timelines:
             row["timeline"] = timelines
+        sweep = parsed.get("serving_sweep")
+        sweep_cfgs = _sweep_configs(sweep)
+        if sweep_cfgs:
+            row["serving_sweep"] = {
+                "configs": [
+                    {"name": _cfg_name(c),
+                     "decode_tok_s": c["decode_tok_s"],
+                     "p99_ms": _scale(c.get("p99_token_s"), 1e3),
+                     "prefix_cache_hits": c.get("prefix_cache_hits"),
+                     "speculative_accepted_ratio":
+                         c.get("speculative_accepted_ratio")}
+                    for c in sweep_cfgs],
+                "winners": sweep_winners(sweep)}
+            # per-config same-platform deltas, matched by config name
+            # (a grid change between rounds simply yields no delta)
+            prev_cfgs = {c["name"]: c for c in
+                         ((prev or {}).get("serving_sweep") or {})
+                         .get("configs", [])}
+            for c in row["serving_sweep"]["configs"]:
+                pc = prev_cfgs.get(c["name"])
+                if pc and isinstance(pc.get("decode_tok_s"),
+                                     (int, float)) \
+                        and pc["decode_tok_s"]:
+                    c["delta"] = (c["decode_tok_s"]
+                                  - pc["decode_tok_s"]) \
+                        / pc["decode_tok_s"]
         if prev is not None:
             for name, v in vals.items():
                 pv = prev["metrics"].get(name)
@@ -247,6 +321,34 @@ def render_table(report):
             lines.append(f"  {leg + '_timeline':<14} {parts}"
                          + (f"  exposed_comm={exp * 1e3:.3g}ms"
                             if exp is not None else ""))
+        sw = row.get("serving_sweep")
+        if sw:
+            for c in sw["configs"]:
+                extras = []
+                if c.get("p99_ms") is not None:
+                    extras.append(f"p99={c['p99_ms']:.3g}ms")
+                if c.get("prefix_cache_hits"):
+                    extras.append(f"prefix_hits={c['prefix_cache_hits']}")
+                if isinstance(c.get("speculative_accepted_ratio"),
+                              (int, float)):
+                    extras.append(
+                        f"spec_accept="
+                        f"{c['speculative_accepted_ratio']:.0%}")
+                lines.append(
+                    f"  sweep {c['name']:<22}"
+                    f" {_fmt(c['decode_tok_s']):>10} tok/s"
+                    f"{_fmt_delta(c.get('delta'))}  "
+                    + " ".join(extras))
+            for w in sw["winners"]:
+                target = "unconstrained" if w["slo_ms"] is None \
+                    else f"p99<={w['slo_ms']:g}ms"
+                if w.get("config"):
+                    lines.append(
+                        f"  sweep winner [{target}] {w['config']}"
+                        f" ({_fmt(w['decode_tok_s'])} tok/s)")
+                else:
+                    lines.append(
+                        f"  sweep winner [{target}] none fits")
         lines.append("")
     regs = report["regressions"]
     lines.append(f"{len(report['rounds'])} round(s), "
@@ -276,7 +378,7 @@ def selftest():
                 "value": 1000.0, "mfu": 0.12, "platform": "tpu",
                 "device_kind": "TPU v5 lite", "git": "aaa111",
                 "measured_at": "2026-01-01T00:00:00"}},
-            # r2: bf16 + lm appear, timeline banked
+            # r2: bf16 + lm appear, timeline + serving_sweep banked
             {"n": 2, "parsed": {
                 "value": 1100.0, "mfu": 0.14, "platform": "tpu",
                 "bf16_throughput": 2400.0, "bf16_mfu": 0.30,
@@ -287,7 +389,16 @@ def selftest():
                     "host": 0.15, "idle": 0.2},
                     "exposed_collective_s": 4e-5, "window_s": 4e-4},
                 "serving": {"decode_tok_s": 500.0,
-                            "p99_token_s": 0.002}}},
+                            "p99_token_s": 0.002},
+                "serving_sweep": {"configs": [
+                    {"kv_layout": "ring", "slots": 4,
+                     "prefill_len": 16, "speculative_k": 0,
+                     "decode_tok_s": 500.0, "p99_token_s": 0.0008},
+                    {"kv_layout": "paged", "slots": 4,
+                     "prefill_len": 16, "speculative_k": 4,
+                     "decode_tok_s": 900.0, "p99_token_s": 0.004,
+                     "prefix_cache_hits": 5,
+                     "speculative_accepted_ratio": 0.4}]}}},
             # r3: bf16 REGRESSES 20%, lm improves; a cpu-fallback round
             # in between must NOT become anyone's comparison baseline
             {"n": 3, "parsed": {
@@ -298,7 +409,11 @@ def selftest():
                 "lm_tokens_per_sec": 150000.0, "git": "ddd444",
                 "timeline": {"fractions": {"compute": 0.55},
                              "exposed_collective_s": 4e-5,
-                             "window_s": 4e-4}}},
+                             "window_s": 4e-4},
+                "serving_sweep": {"configs": [
+                    {"kv_layout": "paged", "slots": 4,
+                     "prefill_len": 16, "speculative_k": 4,
+                     "decode_tok_s": 990.0, "p99_token_s": 0.004}]}}},
         ]
         for r in recs:
             with open(os.path.join(td, f"BENCH_r{r['n']:02d}.json"),
@@ -324,6 +439,23 @@ def selftest():
         assert rows[2]["metrics"]["serve_tok_s"] == 500.0
         assert rows[2]["metrics"]["serve_p99_ms"] == 2.0
         assert rows[2]["metrics"]["hbm_peak_gib"] == 6.0
+        # serving_sweep: best-config scalar extracted, per-config
+        # curves + winner-per-SLO table built
+        assert rows[2]["metrics"]["sweep_best_tok_s"] == 900.0
+        sw = rows[2]["serving_sweep"]
+        assert [c["name"] for c in sw["configs"]] == \
+            ["ring s4 pf16 k0", "paged s4 pf16 k4"]
+        by_slo = {w["slo_ms"]: w for w in sw["winners"]}
+        # under a 1ms p99 budget only the ring config fits; the paged
+        # speculative config wins once the budget allows it
+        assert by_slo[1.0]["config"] == "ring s4 pf16 k0", by_slo
+        assert by_slo[5.0]["config"] == "paged s4 pf16 k4"
+        assert by_slo[None]["config"] == "paged s4 pf16 k4"
+        # r4's repeated paged config carries a same-platform delta
+        # (matched by name, across the cpu round); the vanished ring
+        # config simply has none
+        sw4 = rows[4]["serving_sweep"]["configs"]
+        assert abs(sw4[0]["delta"] - 0.10) < 1e-9, sw4
         # the cpu-fallback round has no tpu baseline: no delta, no flag
         assert rows[3]["deltas"] == {} and not rows[3]["regressions"]
         # r4 compares against r2 (the previous TPU round, ACROSS the
@@ -340,6 +472,9 @@ def selftest():
         text = render_table(report)
         assert "REGRESSION" in text and "bf16_img_s" in text
         assert "compute=50%" in text and "exposed_comm" in text
+        assert "sweep paged s4 pf16 k4" in text and \
+            "sweep winner [p99<=1ms] ring s4 pf16 k0" in text and \
+            "spec_accept=40%" in text, text
         json.dumps(report)                       # JSON-able end to end
 
         # --mfu-floor gate: r5 drops bf16 MFU below the floor r2 held
@@ -380,9 +515,10 @@ def selftest():
         assert "mfu_floor" in text5 and "exposed_comm" in text5
     print("selftest: OK — 4-round trajectory extracted, same-platform "
           "deltas and timeline columns rendered, the 20% bf16 drop "
-          "flagged across the cpu round, torn record skipped, and the "
-          "--mfu-floor gate flags the lost floor + exposed-comm rise "
-          "only when armed")
+          "flagged across the cpu round, torn record skipped, the "
+          "serving_sweep curves + winner-per-SLO table built (with "
+          "per-config deltas), and the --mfu-floor gate flags the "
+          "lost floor + exposed-comm rise only when armed")
 
 
 def main():

@@ -255,6 +255,143 @@ def _fused_optim_ab(dev):
     return out
 
 
+def _grad_bucket_ab(dev):
+    """The ``grad_bucket_ab`` producer (ROADMAP open item since PR 13):
+    sweep ``DistOpt(bucket_mb=..., overlap=True)`` on a REAL multi-chip
+    mesh and bank the winning bucket size — ``bench._grad_bucket_mb``
+    and ``train_cnn --bucket-mb auto`` consume it. A wide MLP whose
+    per-layer gradients are MB-scale makes the coalescing measurable;
+    XLA:CPU never overlaps collectives, so this leg only means
+    something where it runs: a multi-device window. A single-chip
+    window banks an honest ``skipped`` marker (the watcher counts the
+    leg done instead of retrying a leg that can never run here) with
+    no ``winner``, so the measured-choice resolver never consumes it."""
+    import jax
+    import numpy as np
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    ndev = len(accel) if accel else len(jax.devices())
+    if ndev < 2:
+        return {"extra": "grad_bucket_ab", "n_devices": ndev,
+                "skipped": "single-device window — gradient-psum "
+                           "bucketing needs a multi-chip mesh"}
+    from singa_tpu import layer, opt, tensor
+    from singa_tpu import model as smodel
+
+    class _WideMLP(smodel.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(2048)
+            self.r1 = layer.ReLU()
+            self.fc2 = layer.Linear(2048)
+            self.r2 = layer.ReLU()
+            self.fc3 = layer.Linear(2048)
+            self.r3 = layer.ReLU()
+            self.fc4 = layer.Linear(16)
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            h = self.r1(self.fc1(x))
+            h = self.r2(self.fc2(h))
+            h = self.r3(self.fc3(h))
+            return self.fc4(h)
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 1024).astype(np.float32)
+    ys = np.eye(16, dtype=np.float32)[rng.randint(0, 16, 64)]
+    out = {"extra": "grad_bucket_ab", "n_devices": ndev,
+           "timing": "slope-readback"}
+    ms = {}
+    for mb in ("0", "1", "4", "16"):
+        m = _WideMLP()
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
+                                    bucket_mb=float(mb), overlap=True))
+        tx = tensor.Tensor(data=xs, device=dev, requires_grad=False)
+        ty = tensor.Tensor(data=ys, device=dev, requires_grad=False)
+        m.compile([tx], is_train=True, use_graph=True)
+        loss = None
+        for _ in range(3):
+            _, loss = m(tx, ty)
+        bench._force(loss.data)
+        dt = bench._slope_time(lambda: m(tx, ty)[1],
+                               lambda l: l.data, 10, 60)
+        ms[mb] = dt * 1e3
+        # per-config record the moment it exists (tunnel-drop safety)
+        emit({"extra": "grad_bucket_probe", "bucket_mb": mb,
+              "step_ms": round(dt * 1e3, 3), "n_devices": ndev,
+              "timing": "slope-readback"})
+    out.update({f"mb{mb}_step_ms": round(v, 3)
+                for mb, v in ms.items()})
+    best = min(ms, key=ms.get)
+    # a bucketed config must beat the streaming baseline by >2% to
+    # win — inside that margin the per-gradient default stands
+    out["winner"] = best if ms[best] < 0.98 * ms["0"] else "0"
+    out["speedup"] = round(ms["0"] / ms[best], 3)
+    return out
+
+
+def _conv_epilogue_ab(dev):
+    """The ``conv_epilogue_ab`` producer (ROADMAP open item since
+    PR 13): THE benchmark ResNet-50 b32 JITTED inference forward with
+    the Pallas conv→BN→ReLU epilogue peephole (ops/fused_epilogue.py)
+    vs the reference XLA ops, same layout/stem the bench legs run.
+    ``bench._conv_epilogue`` and the quant leg's fused sub-leg consume
+    the banked winner. Fused must beat reference by >2% — parity is
+    test-pinned, so the measured-faster form is a labeled optimization,
+    never a model change."""
+    import jax
+    import numpy as np
+    from singa_tpu import tensor
+    from singa_tpu.models import resnet
+    from singa_tpu.ops import fused_epilogue as _fe
+
+    layout, layout_src = bench._conv_layout()
+    m = resnet.create_model(depth=50, num_classes=10, num_channels=3,
+                            layout=layout, stem=bench._resnet_stem()[0])
+    x = np.random.RandomState(0).randn(
+        32, 3, 224, 224).astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+    m.compile([tx], is_train=False, use_graph=True)
+    m.eval()
+
+    def _fwd(arr):
+        t = tensor.Tensor(data=arr, device=dev, requires_grad=False)
+        with m._policy_scope():
+            return m.forward(t).data
+
+    out = {"extra": "conv_epilogue_ab", "batch": 32,
+           "conv_layout": layout, "conv_layout_src": layout_src,
+           "timing": "slope-readback"}
+    ms = {}
+    for mode in ("reference", "fused"):
+        # the peephole engages at TRACE time: a fresh jit per mode,
+        # traced and timed inside the scope
+        with _fe.enabled_scope(mode == "fused"):
+            jf = jax.jit(_fwd)
+            o = None
+            for _ in range(3):
+                o = jf(tx.data)
+            bench._force(o)
+            dt = bench._slope_time(lambda: jf(tx.data), lambda t: t,
+                                   5, 25)
+        ms[mode] = dt * 1e3
+        rec = {"mode": mode, "images_per_sec": round(32 / dt, 1),
+               "step_ms": round(dt * 1e3, 2)}
+        out.update({f"{mode}_{k}": v for k, v in rec.items()
+                    if k != "mode"})
+        emit({"extra": "conv_epilogue_probe", "conv_layout": layout,
+              **rec, "timing": "slope-readback"})
+    out["winner"] = "fused" \
+        if ms["fused"] < 0.98 * ms["reference"] else "reference"
+    out["fused_speedup"] = round(ms["reference"] / ms["fused"], 3)
+    return out
+
+
 def _hbm_footprint(dev):
     """Peak HBM per training step (VERDICT r5 #7 — the TPU counterpart
     of the reference's MemPoolConf pool stats, core.proto:52). Each
@@ -541,6 +678,7 @@ def _resnet_fusion_profile(dev, batch=32, image_size=224, depth=50):
 LEGS = (_resnet_fusion_profile, _resnet_layout_ab,
         _lm_long_context, _lm_decode_throughput, _hbm_footprint,
         _lm_fusion_profile, _resnet_stem_ab, _fused_optim_ab,
+        _grad_bucket_ab, _conv_epilogue_ab,
         _resnet50_bf16_large_batch, _mlp_step_time, _flash_block_sweep)
 
 
