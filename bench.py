@@ -717,6 +717,24 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
         except Exception as e:
             res["serving_error"] = str(e)[:200]
         _emit_partial(res, "serving")
+    # sharded serving leg (BENCH_SERVING_SHARDED=1 opt-in: it needs a
+    # ≥4-device mesh — real on TPU pods, virtual on a CPU smoke via
+    # xla_force_host_platform_device_count): the GSPMD (batch × model)
+    # engine's decode tok/s + per-device KV/HBM bytes, banked beside
+    # the unsharded serving record so bench_report can show what
+    # sharding costs (CPU: collectives unoverlapped) or buys (TPU:
+    # per-chip HBM) per round
+    if os.environ.get("BENCH_SERVING_SHARDED", "0") == "1":
+        try:
+            res["serving_sharded"] = _leg_guard(
+                lambda: _measure_serving_sharded(dev), leg_budget,
+                "serving_sharded")
+        except TimeoutError as e:
+            res["serving_sharded_error"] = str(e)[:200]
+            res["leg_timeout"] = "serving_sharded"
+        except Exception as e:
+            res["serving_sharded_error"] = str(e)[:200]
+        _emit_partial(res, "serving_sharded")
     # serving load-sweep leg: the PAGED/speculative engine driven with
     # synthetic Poisson load across slots × prefill_len × speculative_k
     # configs; banks tok/s + p99 curves per config so the serving
@@ -890,7 +908,6 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
     from singa_tpu import tensor
     from singa_tpu.models import transformer
     from singa_tpu.observability import metrics as obs_metrics
-    from singa_tpu.observability.export import series_quantiles
 
     cc0 = _compile_stats()
     vocab = 512
@@ -914,39 +931,11 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
     for f in futs:
         f.result(timeout=1)
 
-    def _series():
-        return reg.get("serve_token_seconds").to_doc()["series"][0]
-
-    tok0 = reg.get("serve_tokens_total").total()
-    pre0 = reg.get("serve_prefill_total").total()
-    before = _series()
-    futs = [eng.submit(rng.randint(1, vocab,
-                                   (int(rng.randint(1, prefill_len)),)),
-                       max_new_tokens=new_tokens)
-            for _ in range(n_requests)]
-    t0 = time.perf_counter()
-    eng.run_until_idle()
-    wall = time.perf_counter() - t0
-    for f in futs:
-        f.result(timeout=1)
-    info = eng.compiled_step_info()
-    assert info["n_traces"] == 1, f"decode retraced: {info}"
-    # each prefill samples one token OUTSIDE any decode tick: the
-    # decode-throughput numerator is decode-produced tokens only, so
-    # the ratio stays honest at any new_tokens setting
-    tok = reg.get("serve_tokens_total").total() - tok0
-    tok -= reg.get("serve_prefill_total").total() - pre0
-    after = _series()
-    # warmup ticks carry the XLA compile: the banked numbers are the
-    # STEADY-state wave, so subtract the pre-wave series
-    delta = {
-        "count": after["count"] - before["count"],
-        "sum": after["sum"] - before["sum"],
-        "buckets": [[le, ca - cb] for (le, ca), (_le, cb)
-                    in zip(after["buckets"], before["buckets"])],
-    }
-    q = series_quantiles(delta)
-    s = delta
+    wave = _measure_decode_wave(
+        eng, reg,
+        lambda: [eng.submit(
+            rng.randint(1, vocab, (int(rng.randint(1, prefill_len)),)),
+            max_new_tokens=new_tokens) for _ in range(n_requests)])
     # step-timeline probe AFTER the measured wave (a profiled tick
     # inside it would decouple the token count from the observed
     # decode time): a tiny all-ticks-profiled wave banks the serving
@@ -967,14 +956,129 @@ def _measure_serving(dev, slots=4, max_len=96, prefill_len=16,
                   file=sys.stderr)
     eng.stop()
     return {
-        "decode_tok_s": (tok / s["sum"]) if s["sum"] else None,
+        **wave,
         **({"timeline": timeline} if timeline else {}),
-        "p99_token_s": q.get("p99"),
-        "p50_token_s": q.get("p50"),
-        "wall_tok_s": tok / wall if wall > 0 else None,
         "slots": slots, "new_tokens": new_tokens,
         "n_requests": n_requests,
         "policy": str(policy) if policy is not None else None,
+        "hbm_peak_bytes": _peak_hbm(dev),
+        "compile": _compile_delta(cc0),
+    }
+
+
+def _measure_decode_wave(eng, reg, submit):
+    """One steady-state serving wave against an already-WARM engine:
+    ``submit()`` enqueues the wave and returns its futures. The
+    decode-token accounting (each prefill samples one token OUTSIDE
+    any decode tick, so the throughput numerator is decode-produced
+    tokens only) and the histogram-delta p50/p99 math live HERE so
+    the serving and serving_sharded legs measure the same thing by
+    construction. Asserts the no-retrace pin; returns the SLO dict."""
+    from singa_tpu.observability.export import series_quantiles
+
+    def _series():
+        return reg.get("serve_token_seconds").to_doc()["series"][0]
+
+    tok0 = reg.get("serve_tokens_total").total()
+    pre0 = reg.get("serve_prefill_total").total()
+    before = _series()
+    futs = submit()
+    t0 = time.perf_counter()
+    eng.run_until_idle()
+    wall = time.perf_counter() - t0
+    for f in futs:
+        f.result(timeout=1)
+    info = eng.compiled_step_info()
+    assert info["n_traces"] == 1, f"decode retraced: {info}"
+    tok = reg.get("serve_tokens_total").total() - tok0
+    tok -= reg.get("serve_prefill_total").total() - pre0
+    after = _series()
+    # warmup ticks carry the XLA compile: the banked numbers are the
+    # STEADY-state wave, so subtract the pre-wave series
+    delta = {
+        "count": after["count"] - before["count"],
+        "sum": after["sum"] - before["sum"],
+        "buckets": [[le, ca - cb] for (le, ca), (_le, cb)
+                    in zip(after["buckets"], before["buckets"])],
+    }
+    q = series_quantiles(delta)
+    return {
+        "decode_tok_s": (tok / delta["sum"]) if delta["sum"] else None,
+        "p99_token_s": q.get("p99"),
+        "p50_token_s": q.get("p50"),
+        "wall_tok_s": tok / wall if wall > 0 else None,
+    }
+
+
+def _measure_serving_sharded(dev, slots=4, max_len=96, prefill_len=16,
+                             n_requests=16, new_tokens=32,
+                             model_shards=2):
+    """The banked ``serving_sharded`` leg: the SAME small TransformerLM
+    as the serving leg, compiled with ``model_shards=2`` over a
+    (batch × model) GSPMD mesh — decode tok/s, per-device KV/HBM
+    bytes, and a greedy token-parity spot-check against a
+    single-device engine (a sharded leg that silently diverged must
+    never bank a throughput number). Needs ≥ 2·model_shards devices;
+    raises typed otherwise (the leg gate turns that into a
+    ``serving_sharded_error`` row naming the reason)."""
+    import jax
+    import numpy as np
+
+    from singa_tpu import tensor
+    from singa_tpu.models import transformer
+    from singa_tpu.observability import metrics as obs_metrics
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 * model_shards:
+        raise RuntimeError(
+            f"serving_sharded needs a ≥{2 * model_shards}-device mesh "
+            f"(have {n_dev}); on CPU smoke set "
+            "xla_force_host_platform_device_count")
+    cc0 = _compile_stats()
+    vocab = 512
+    model = transformer.TransformerLM(vocab, d_model=128, n_heads=4,
+                                      n_layers=2, max_len=max_len,
+                                      tp=False)
+    model.eval()
+    model(tensor.Tensor(data=np.zeros((1, prefill_len), np.float32),
+                        device=dev, requires_grad=False))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, vocab, (int(rng.randint(1, prefill_len)),))
+               for _ in range(n_requests)]
+
+    # parity spot-check (greedy, short) before the measured wave
+    ref_eng = model.compile_serving(
+        slots=slots, max_len=max_len, prefill_len=prefill_len,
+        registry=obs_metrics.MetricsRegistry())
+    ref_futs = [ref_eng.submit(p, max_new_tokens=4) for p in prompts[:4]]
+    ref_eng.run_until_idle()
+    ref_toks = [f.result(timeout=1)["tokens"] for f in ref_futs]
+    ref_eng.stop()
+
+    reg = obs_metrics.MetricsRegistry()
+    eng = model.compile_serving(
+        slots=slots, max_len=max_len, prefill_len=prefill_len,
+        model_shards=model_shards, registry=reg)
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts[:4]]
+    eng.run_until_idle()           # warmup: compiles off the clock
+    toks = [f.result(timeout=1)["tokens"] for f in futs]
+    assert toks == ref_toks, "sharded greedy tokens diverged"
+
+    wave = _measure_decode_wave(
+        eng, reg,
+        lambda: [eng.submit(p, max_new_tokens=new_tokens)
+                 for p in prompts])
+    info = eng.compiled_step_info()
+    eng.stop()
+    return {
+        **wave,
+        "slots": slots, "new_tokens": new_tokens,
+        "n_requests": n_requests,
+        "mesh": info["mesh"],
+        "model_shards": info["model_shards"],
+        "kv_per_device_bytes": info["kv_per_device_bytes"],
+        "kv_global_bytes": info["kv_global_bytes"],
+        "token_parity": True,
         "hbm_peak_bytes": _peak_hbm(dev),
         "compile": _compile_delta(cc0),
     }
@@ -1850,7 +1954,8 @@ def _emit_report(res, live, smoke, obs, errors):
               "compile", "bf16_compile", "lm_compile",
               "lm_bf16_compile",
               "serving", "serving_error", "quant", "quant_error",
-              "serving_sweep", "serving_sweep_error"):
+              "serving_sweep", "serving_sweep_error",
+              "serving_sharded", "serving_sharded_error"):
         if res.get(k) is not None:
             out[k] = round(res[k], 4) if isinstance(res[k], float) else res[k]
     extras = _fold_extras(obs)

@@ -44,7 +44,7 @@ def _post(port, path, doc, timeout=120.0):
         c.close()
 
 
-def _selftest(port, n, vocab, new_tokens=8):
+def _selftest(port, n, vocab, new_tokens=8, temperature=0.5):
     rng = np.random.RandomState(0)
     results = [None] * n
 
@@ -53,7 +53,7 @@ def _selftest(port, n, vocab, new_tokens=8):
         results[i] = _post(port, "/v1/generate",
                            {"prompt": prompt,
                             "max_new_tokens": new_tokens,
-                            "temperature": 0.5, "seed": i})
+                            "temperature": temperature, "seed": i})
 
     threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
     for t in threads:
@@ -94,6 +94,16 @@ def main():
                     help="speculative decoding: verify-program width "
                          "(up to K tokens per tick, greedy requests "
                          "only; needs --kv-layout paged)")
+    ap.add_argument("--model-shards", type=int, default=0,
+                    help="GSPMD sharded serving: tensor-parallel "
+                         "degree over a (batch × model) device mesh "
+                         "(heads/MLP/vocab sharded, XLA inserts the "
+                         "collectives; greedy-only — "
+                         "docs/serving.md). 0 = single-device")
+    ap.add_argument("--mesh", default=None, metavar="BxM",
+                    help="explicit serving mesh shape, e.g. 2x2 "
+                         "(batch × model axes over the first B*M "
+                         "devices); overrides --model-shards")
     ap.add_argument("--aot-dir", default=None, metavar="DIR",
                     help="cold-start elimination (singa_tpu.aot): "
                          "deserialize matching prefill/decode "
@@ -140,9 +150,24 @@ def main():
                         kv_blocks=args.kv_blocks)
     if args.speculative_k:
         serve_kw["speculative_k"] = args.speculative_k
+    sharded = bool(args.model_shards or args.mesh)
+    if args.mesh:
+        import jax
+        from singa_tpu.parallel import gspmd
+        b, m_ = (int(x) for x in args.mesh.lower().split("x"))
+        serve_kw["mesh"] = gspmd.serving_mesh(
+            jax.devices()[:b * m_], model_shards=m_, batch_shards=b)
+    elif args.model_shards:
+        serve_kw["model_shards"] = args.model_shards
     engine = model.compile_serving(
         slots=args.slots, max_len=args.max_len,
         prefill_len=args.prefill_len, policy=args.policy, **serve_kw)
+    if sharded:
+        info = engine.compiled_step_info()
+        print(f"SHARDED mesh=batch{info['mesh']['batch']}x"
+              f"model{info['mesh']['model']} "
+              f"kv_per_device_bytes={info['kv_per_device_bytes']}",
+              flush=True)
     if args.aot_dir:
         src = dict(engine.compiled_step_info()["aot"] or {})
         if not src or any(v != "loaded" for v in src.values()):
@@ -163,7 +188,10 @@ def main():
     print(f"READY port={port}", flush=True)
 
     if args.selftest:
-        _selftest(port, args.selftest, args.vocab)
+        # sharded serving is greedy-only (in-graph argmax over the
+        # vocab shards): the smoke drives it at temperature 0
+        _selftest(port, args.selftest, args.vocab,
+                  temperature=0.0 if sharded else 0.5)
         info = engine.compiled_step_info()
         assert info["n_traces"] == 1, \
             f"decode retraced: {info['n_traces']}"

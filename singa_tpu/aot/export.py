@@ -530,12 +530,29 @@ def load_train_step(model, store, layout, input_arrays):
 def serving_program_avals(engine):
     """The prefill/decode call avals of a ServingEngine, derived from
     its live params/cache and geometry — the ONE definition both
-    export and engine-side load share, so they can never drift."""
+    export and engine-side load share, so they can never drift. Both
+    KV layouts are described: the ring's slot-array programs and the
+    paged block pool's chunked-prefill/verify programs (tables +
+    absolute positions; the verify width K is ``speculative_k`` or
+    1)."""
     Pa = _tree_sds(engine._P)
     Ca = _tree_sds(engine._cache)
     import jax
     B, S, W = engine.prefill_batch, engine.prefill_len, engine.slots
     i32 = np.dtype(np.int32)
+    if getattr(engine, "kv_layout", "ring") == "paged":
+        npages = engine._max_blocks
+        K = engine._spec_width
+        prefill = (Pa, Ca, jax.ShapeDtypeStruct((B, npages), i32),
+                   jax.ShapeDtypeStruct((B, S), i32),
+                   jax.ShapeDtypeStruct((B,), i32),
+                   jax.ShapeDtypeStruct((B,), i32),
+                   jax.ShapeDtypeStruct((B,), np.dtype(bool)))
+        decode = (Pa, Ca, jax.ShapeDtypeStruct((W, npages), i32),
+                  jax.ShapeDtypeStruct((W, K), i32),
+                  jax.ShapeDtypeStruct((W,), i32),
+                  jax.ShapeDtypeStruct((W,), i32))
+        return prefill, decode
     prefill = (Pa, Ca, jax.ShapeDtypeStruct((B, S), i32),
                jax.ShapeDtypeStruct((B,), i32),
                jax.ShapeDtypeStruct((B,), i32),
@@ -548,12 +565,23 @@ def serving_program_avals(engine):
 
 def serving_geometry(engine):
     """The engine-geometry manifest stamp (``expect_extra``): an
-    artifact exported at different slots/lengths must refuse with
-    reason ``signature`` even before the aval diff names it."""
-    return {"engine": {"slots": engine.slots,
-                       "max_len": engine.max_len,
-                       "prefill_len": engine.prefill_len,
-                       "prefill_batch": engine.prefill_batch}}
+    artifact exported at different slots/lengths — or a different KV
+    LAYOUT (a ring executable honored by a paged engine would be a
+    silently wrong program) — must refuse with reason ``signature``
+    even before the aval diff names it. Paged manifests additionally
+    carry the pool geometry (``kv_block_size``/``kv_blocks``) and the
+    verify width."""
+    geo = {"slots": engine.slots,
+           "max_len": engine.max_len,
+           "prefill_len": engine.prefill_len,
+           "prefill_batch": engine.prefill_batch,
+           "kv_layout": getattr(engine, "kv_layout", "ring")}
+    if geo["kv_layout"] == "paged":
+        geo.update(kv_block_size=engine.kv_block_size,
+                   kv_blocks=engine.kv_blocks,
+                   speculative_k=int(getattr(engine, "speculative_k",
+                                             0)))
+    return {"engine": geo}
 
 
 def batch_program_avals(engine):
@@ -601,12 +629,27 @@ def export_serving(engine, store):
     if not isinstance(engine, ServingEngine):
         raise AotExportError(
             f"{type(engine).__name__} is not AOT-exportable")
+    if getattr(engine, "sharded", False):
+        d = engine._part.describe()
+        raise AotExportError(
+            f"sharded serving programs are not exportable: the "
+            f"NamedSharding executables are bound to this mesh "
+            f"(batch={d['batch']} × model={d['model']}); the "
+            "persistent compile cache is their warm-start path")
     prefill_avals, decode_avals = serving_program_avals(engine)
     geometry = serving_geometry(engine)
+    if engine.kv_layout == "paged":
+        raws = ((SERVE_PREFILL, engine.adapter.paged_prefill_fn(),
+                 prefill_avals),
+                (SERVE_DECODE, engine.adapter.paged_decode_fn(),
+                 decode_avals))
+    else:
+        raws = ((SERVE_PREFILL, engine.adapter.prefill_fn(),
+                 prefill_avals),
+                (SERVE_DECODE, engine.adapter.decode_fn(),
+                 decode_avals))
     out = {}
-    for program, raw, avals in (
-            (SERVE_PREFILL, engine.adapter.prefill_fn(), prefill_avals),
-            (SERVE_DECODE, engine.adapter.decode_fn(), decode_avals)):
+    for program, raw, avals in raws:
         compiled = jax.jit(raw, donate_argnums=(1,)).lower(
             *avals).compile()
         out[program] = store.save_program(

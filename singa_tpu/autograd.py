@@ -830,7 +830,21 @@ class Dropout(Operator):
 # ===========================================================================
 
 def add(a, b):
-    return Add()(a, b)
+    out = Add()(a, b)
+    # residual-tail peephole tag (ops/fused_epilogue.py): a sum whose
+    # operand is a tagged inference-BN output may fuse the whole
+    # scale/shift + add + relu tail into one pass over the conv output
+    # when a ReLU consumes it. One getattr per operand — the tag
+    # itself costs one attribute; eligibility is decided at the ReLU.
+    ta = getattr(a, "_bn_epilogue", None)
+    tb = getattr(b, "_bn_epilogue", None)
+    if ta is not None or tb is not None:
+        # both-tagged (a downsample block adds two BN outputs): fuse
+        # around ONE of them, the other's reference output is the
+        # residual input
+        tag, res = (ta, b) if ta is not None else (tb, a)
+        out._bn_add_epilogue = (tag, res)
+    return out
 
 
 def sub(a, b):
@@ -968,10 +982,12 @@ def sign(x):
 
 
 def relu(x):
-    if getattr(x, "_bn_epilogue", None) is not None:
-        # a tagged inference-BN output may fuse scale/shift+relu into
-        # one pass over the conv output (ops/fused_epilogue.py peephole;
-        # opt-in + eligibility-gated — returns None to decline)
+    if getattr(x, "_bn_epilogue", None) is not None or \
+            getattr(x, "_bn_add_epilogue", None) is not None:
+        # a tagged inference-BN output (or a BN-output + residual sum)
+        # may fuse scale/shift[+add]+relu into one pass over the conv
+        # output (ops/fused_epilogue.py peephole; opt-in +
+        # eligibility-gated — returns None to decline)
         from .ops import fused_epilogue
         fused = fused_epilogue.try_relu_epilogue(x)
         if fused is not None:

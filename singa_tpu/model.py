@@ -116,29 +116,14 @@ def _resolve_leaf_specs(leaves, full_batch, input_specs, axis, user_out):
 
 
 def _fit_state_spec(spec, shape, mesh):
-    """A parameter's announced PartitionSpec, with any dim that does not
-    divide its mesh axes replicated instead (e.g. a vocab of 31 over
-    'model'=2: the layer announces P('model', None) unconditionally
-    because it cannot know the mesh at init; sharding such a dim would
-    make shard_map reject the whole step, so the dim falls back to
+    """Spec-to-mesh fitting now lives in the ONE sharding vocabulary
+    (``parallel/gspmd.py`` — an indivisible dim falls back to
     replication and the layers' offset math detects the full-width
-    tensor)."""
-    if spec is None:
-        return P()
-    fitted = []
-    for dim, names in enumerate(spec):
-        if names is None:
-            fitted.append(None)
-            continue
-        tup = names if isinstance(names, tuple) else (names,)
-        size = 1
-        for n in tup:
-            size *= mesh.shape[n]
-        fitted.append(names if dim < len(shape) and
-                      shape[dim] % size == 0 else None)
-    while fitted and fitted[-1] is None:
-        fitted.pop()
-    return P(*fitted)
+    tensor); this alias keeps the compiled-step and checkpoint
+    live-sharding call sites unchanged. Lazy import: parallel pulls the
+    layer stack in, and model.py is imported before it."""
+    from .parallel.gspmd import fit_state_spec
+    return fit_state_spec(spec, shape, mesh)
 
 
 def _shard_map_compat_kwargs():
@@ -368,6 +353,16 @@ class Model(Layer):
         Other ``kw`` (``slots``, ``max_len``, ``prefill_len``,
         ``queue_capacity``, ``faults``, ``registry``, ...) pass through
         to the engine.
+
+        Sharded serving (``singa_tpu.parallel.gspmd``):
+        ``model_shards=N`` (or an explicit ``mesh=`` with named
+        ``batch``/``model`` axes) runs the prefill/decode programs
+        tensor/vocab-sharded over a (batch × model) device mesh as the
+        SAME single jitted programs — params/KV annotated with
+        NamedSharding, XLA inserts the collectives, greedy argmax
+        computed in graph over the vocab shards. Configs the mesh
+        cannot honor (indivisible heads/vocab/slots, too few devices)
+        are typed declines at build.
 
         Cold-start knobs (``singa_tpu.aot``): ``compile_cache=``
         installs the persistent compilation cache exactly like

@@ -402,8 +402,11 @@ def heartbeat_summary(registry=None):
     # early-warning that a replica is running out of blocks — queue
     # depth rises AFTER the pool saturates, this shows it before
     kv_total = reg.get("kv_blocks_total")
-    if isinstance(kv_total, Gauge):
-        kv = {"blocks_total": kv_total.value()}
+    mesh_model = reg.get("serve_mesh_model")
+    if isinstance(kv_total, Gauge) or isinstance(mesh_model, Gauge):
+        kv = {}
+        if isinstance(kv_total, Gauge):
+            kv["blocks_total"] = kv_total.value()
         in_use = reg.get("kv_blocks_in_use")
         if isinstance(in_use, Gauge):
             kv["blocks_in_use"] = in_use.value()
@@ -416,6 +419,20 @@ def heartbeat_summary(registry=None):
         ratio = reg.get("speculative_accepted_ratio")
         if isinstance(ratio, Gauge):
             kv["speculative_accepted_ratio"] = ratio.value()
+        # sharded engines: the mesh shape + what ONE chip holds — the
+        # fleet view's pool-pressure numbers must be per-device, not
+        # the global logical pool (a paged pool is replicated across
+        # 'batch' with a heads/model slice per chip; a ring shards its
+        # slots over 'batch' too)
+        if isinstance(mesh_model, Gauge):
+            mesh_batch = reg.get("serve_mesh_batch")
+            kv["mesh"] = {
+                "batch": mesh_batch.value()
+                if isinstance(mesh_batch, Gauge) else None,
+                "model": mesh_model.value()}
+            per_dev = reg.get("serve_kv_per_device_bytes")
+            if isinstance(per_dev, Gauge):
+                kv["per_device_bytes"] = per_dev.value()
         out["serving_kv"] = kv
     stamp = build_stamp()
     out["build"] = {"git": stamp["git"], "start_ts": stamp["start_ts"]}

@@ -16,10 +16,14 @@ from .tensor_parallel import (ColumnParallelLinear, RowParallelLinear,
                               TPMLP)
 from .pipeline import pipeline_spmd, stack_stage_params, microbatch
 from .moe import MoEFFN
+from .gspmd import (Partitioner, ShardingDecline, serving_mesh,
+                    serving_partitioner)
 
 __all__ = ["Communicator", "NcclIdHolder", "get_mesh", "collective_context",
            "active_axis", "make_mesh", "MeshConfig",
            "all_reduce", "all_gather", "reduce_scatter", "pmean",
            "copy_to_parallel", "all_to_all", "MoEFFN",
            "ColumnParallelLinear", "RowParallelLinear", "TPMLP",
-           "pipeline_spmd", "stack_stage_params", "microbatch"]
+           "pipeline_spmd", "stack_stage_params", "microbatch",
+           "Partitioner", "ShardingDecline", "serving_mesh",
+           "serving_partitioner"]

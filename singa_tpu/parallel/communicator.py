@@ -96,6 +96,22 @@ def set_mesh(mesh):
     _global_mesh = mesh
 
 
+def partitioner(mesh=None, batch_axis="data", model_axis="model"):
+    """Deprecation-boundary shim onto the ONE sharding vocabulary.
+
+    The communicator's explicit-collective mechanism (shard_map +
+    psum/ppermute) stays for the compiled training step, but layouts
+    belong to :mod:`.gspmd`: this returns the shared
+    :class:`~singa_tpu.parallel.gspmd.Partitioner` over the given (or
+    process-default) mesh so code still living on this mechanism
+    expresses shardings through the same specs the GSPMD serving path
+    uses. New sharded code should annotate with NamedSharding via
+    gspmd and jit — not add hand-rolled collectives here."""
+    from .gspmd import Partitioner
+    return Partitioner(mesh if mesh is not None else get_mesh(),
+                       batch_axis=batch_axis, model_axis=model_axis)
+
+
 class NcclIdHolder:
     """Parity stub for the reference's NcclIdHolder
     (include/singa/io/communicator.h:69): with jax.distributed the

@@ -22,12 +22,12 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from ..autograd_base import Operator
 from ..layer import Layer, _param
 from ..tensor import Tensor
 from .communicator import active_axis, axis_size
+from .gspmd import expert_spec
 
 
 class _MoEFFN(Operator):
@@ -156,8 +156,10 @@ class MoEFFN(Layer):
         self.w2.gaussian(0.0, math.sqrt(2.0 / (D + F)))
         self.b2 = _param((E, D), dev, dtype=x.dtype)
         if self.axis_name:
+            # expert banks announce their layout through the shared
+            # gspmd vocabulary, like every other sharded layer
             for t in (self.w1, self.b1, self.w2, self.b2):
-                t.spec = P(self.axis_name)
+                t.spec = expert_spec(self.axis_name)
 
     def forward(self, x):
         from .. import autograd

@@ -26,6 +26,14 @@ per training step that the in-schedule 1F1B loss avoids entirely.
 Heterogeneous stages (different params AND different activation shapes
 per stage — embedding -> blocks -> head) are first-class via
 :class:`HeteroPipeline1F1B`.
+
+Deprecation boundary: this module (like ``communicator.py``) is the
+explicit-collective MECHANISM layer — it stays for the compiled train
+step, but sharding LAYOUTS belong to :mod:`.gspmd` (the one
+NamedSharding vocabulary training and serving share; see
+``communicator.partitioner`` for the shim). New sharded code should
+annotate arrays with NamedSharding and jit, not add ppermute schedules
+here.
 """
 
 from __future__ import annotations

@@ -17,11 +17,13 @@ from __future__ import annotations
 
 import math
 
-from jax.sharding import PartitionSpec as P
-
 from .. import autograd
 from ..layer import Layer, _param
 from . import ops as collective
+# layouts come from the ONE sharding vocabulary (parallel/gspmd.py) so
+# the shard_map training mechanism and GSPMD serving can never disagree
+# about what "column/row/vocab-parallel" means
+from .gspmd import col_bias_spec, col_spec, row_spec, vocab_spec
 
 
 class ColumnParallelLinear(Layer):
@@ -47,10 +49,10 @@ class ColumnParallelLinear(Layer):
                         dtype=x.dtype)
         std = math.sqrt(2.0 / (in_features + self.out_features))
         self.W.gaussian(0.0, std)
-        self.W.spec = P(None, self.axis_name)
+        self.W.spec = col_spec(self.axis_name)
         if self.bias:
             self.b = _param((self.out_features,), x.device, dtype=x.dtype)
-            self.b.spec = P(self.axis_name)
+            self.b.spec = col_bias_spec(self.axis_name)
 
     def _sharded(self):
         # inside shard_map the payload is the LOCAL shard; a full-width W
@@ -101,7 +103,7 @@ class RowParallelLinear(Layer):
                         dtype=x.dtype)
         std = math.sqrt(2.0 / (in_features + self.out_features))
         self.W.gaussian(0.0, std)
-        self.W.spec = P(self.axis_name, None)
+        self.W.spec = row_spec(self.axis_name)
         if self.bias:
             # replicated
             self.b = _param((self.out_features,), x.device, dtype=x.dtype)
@@ -167,7 +169,7 @@ class VocabParallelEmbedding(Layer):
     def initialize(self, x):
         self.W = _param((self.input_dim, self.output_dim), x.device)
         self.W.gaussian(0.0, 0.02)
-        self.W.spec = P(self.axis_name, None)
+        self.W.spec = vocab_spec(self.axis_name)
 
     def _sharded(self):
         return self.W.shape[0] < self.input_dim  # rows actually sharded

@@ -29,6 +29,18 @@ blackbox):
   per-request temperature/top_k/seed vary without touching the
   compiled program.
 
+  ``mesh=`` / ``model_shards=N`` runs BOTH programs GSPMD-sharded
+  over a named (batch × model) mesh (``parallel/gspmd.py``): params
+  and KV state are annotated with NamedSharding (heads/MLP hidden/
+  vocab over 'model', slots over 'batch'), the SAME pure bodies are
+  jitted once, and XLA inserts every collective — no hand-written
+  psum anywhere on the serve path. The sharded programs compute the
+  greedy argmax IN GRAPH over the vocab-sharded logits (the full
+  (rows, V) array never exists on any device or the host), so
+  sampled requests are a typed submit-time rejection. Every engine
+  invariant survives sharding: one trace per program, whole-state
+  donation, typed declines for configs the mesh cannot honor.
+
   ``kv_layout="paged"`` swaps the ring for the paged BLOCK POOL
   (:mod:`.kv_cache`): memory scales with live tokens, identical
   prompt prefixes share refcounted blocks (a prefix-cache hit skips
@@ -371,13 +383,16 @@ class _EngineBase:
         the next replica spin-up deserializes instead of tracing.
         Returns {program: manifest}."""
         from ..aot import export as _aot_export
-        if getattr(self, "kv_layout", "ring") == "paged":
+        if getattr(self, "sharded", False):
+            d = self._part.describe()
             raise ValueError(
-                "export_aot is not supported for the paged KV layout "
-                "yet: the serving AOT manifest contract describes the "
-                "ring programs' avals/geometry, and exporting a "
-                "mismatched twin would be a silently wrong program "
-                "(ROADMAP follow-on)")
+                f"export_aot is not supported for sharded serving: "
+                f"the compiled programs are bound to this mesh "
+                f"(batch={d['batch']} × model={d['model']} over "
+                f"{d['devices']} devices) and a deserialized "
+                "NamedSharding executable cannot be verified against "
+                "another host's topology — the persistent compile "
+                "cache is the sharded warm-start path")
         if store is None:
             store = getattr(self, "_aot_store", None)
         if store is None:
@@ -482,7 +497,7 @@ class ServingEngine(_EngineBase):
     def __init__(self, adapter, *, slots=4, max_len=64, prefill_len=16,
                  prefill_batch=2, policy=None, aot_store=None,
                  kv_layout="ring", kv_block_size=16, kv_blocks=None,
-                 speculative_k=0, **kw):
+                 speculative_k=0, mesh=None, model_shards=None, **kw):
         super().__init__(**kw)
         import jax
 
@@ -505,6 +520,33 @@ class ServingEngine(_EngineBase):
         self.policy = policy
         self._P = adapter.params()
         self._slots = [None] * self.slots        # host-side slot table
+
+        # -- GSPMD sharded serving (mesh=/model_shards=) ------------------
+        # One NamedSharding partitioner over a named (batch × model)
+        # mesh (parallel/gspmd.py): params/KV annotated, the SAME pure
+        # programs jitted once, XLA inserts every collective. Configs
+        # the mesh cannot honor are typed declines at build — never a
+        # silently replicated "sharded" serve.
+        self._part = None
+        if mesh is not None or model_shards:
+            from ..parallel import gspmd
+            if not getattr(adapter, "supports_sharded", False):
+                raise gspmd.ShardingDecline(
+                    f"{type(adapter).__name__} has no sharded (GSPMD) "
+                    "serve programs: its decode state cannot be "
+                    "partitioned over a (batch × model) mesh — serve "
+                    "this model single-device")
+            part = gspmd.serving_partitioner(
+                mesh=mesh, model_shards=model_shards,
+                max_batch=self.slots)
+            # the slot array (and the ring cache's W axis) shards over
+            # 'batch': the decode program's rows must tile the axis
+            # (auto-built meshes already fit it; an explicit mesh is
+            # the caller's pin and refuses typed here)
+            part.require_divisible("slots", self.slots,
+                                   part.batch_axis)
+            self._part = part
+        self.sharded = self._part is not None
 
         # -- KV layout resolution (decline loudly, never silently) -------
         kv_layout = str(kv_layout)
@@ -568,8 +610,15 @@ class ServingEngine(_EngineBase):
                                           self.kv_block_size)
             self._cache = adapter.init_pool(self.kv_blocks,
                                             self.kv_block_size)
-            prefill_raw = adapter.paged_prefill_fn()
-            decode_raw = adapter.paged_decode_fn()
+            if self.sharded:
+                # sharded programs return argmax TOKENS computed over
+                # the vocab-sharded logits in graph — the full (R, V)
+                # logits array is never gathered or output
+                prefill_raw = adapter.greedy_paged_prefill_fn()
+                decode_raw = adapter.greedy_paged_decode_fn()
+            else:
+                prefill_raw = adapter.paged_prefill_fn()
+                decode_raw = adapter.paged_decode_fn()
 
             def prefill_body(P, pool, tables, tokens, starts, lengths,
                              valid):
@@ -592,8 +641,12 @@ class ServingEngine(_EngineBase):
             self.kv_block_size = None
             self.kv_blocks = None
             self._cache = adapter.init_cache(self.slots, self.max_len)
-            prefill_raw = adapter.prefill_fn()
-            decode_raw = adapter.decode_fn()
+            if self.sharded:
+                prefill_raw = adapter.greedy_prefill_fn()
+                decode_raw = adapter.greedy_decode_fn()
+            else:
+                prefill_raw = adapter.prefill_fn()
+                decode_raw = adapter.decode_fn()
 
             def prefill_body(P, cache, tokens, lengths, slot_ids,
                              valid):
@@ -607,12 +660,41 @@ class ServingEngine(_EngineBase):
                 decode_rec["n_traces"] += 1
                 return decode_raw(P, cache, tokens, positions, active)
 
+        jit_kw_prefill = {}
+        jit_kw_decode = {}
+        if self._part is not None:
+            # annotate the named state + KV layout once, jit the same
+            # pure bodies: XLA's SPMD partitioner inserts the
+            # collectives (heads/MLP/vocab over 'model', slots over
+            # 'batch'). Explicit out_shardings keep the donated cache's
+            # layout identical in and out, so whole-state donation
+            # survives sharding.
+            pspecs, cspecs = adapter.sharding_specs(
+                self._part, self._P, self._cache, self.kv_layout)
+            self._P = self._part.shard(self._P, pspecs)
+            self._cache = self._part.shard(self._cache, cspecs)
+            from ..parallel import gspmd as _gspmd
+            io = _gspmd.serving_arg_specs(self._part, self.kv_layout)
+            p_sh = self._part.sharding_tree(pspecs)
+            c_sh = self._part.sharding_tree(cspecs)
+            tok_sh = self._part.sharding(io["tokens_out"])
+            arg = self._part.sharding
+            jit_kw_prefill = dict(
+                in_shardings=(p_sh, c_sh,
+                              *(arg(s) for s in io["prefill"])),
+                out_shardings=(c_sh, tok_sh))
+            jit_kw_decode = dict(
+                in_shardings=(p_sh, c_sh,
+                              *(arg(s) for s in io["decode"])),
+                out_shardings=(c_sh, tok_sh))
         self._hbm_dev = _perf.first_jax_device(self._cache)
         # the KV state (ring cache or block pool) is DONATED: the one
         # large serving buffer is updated in place by XLA instead of
         # doubling per tick
-        self._prefill = jax.jit(prefill_body, donate_argnums=(1,))
-        self._decode = jax.jit(decode_body, donate_argnums=(1,))
+        self._prefill = jax.jit(prefill_body, donate_argnums=(1,),
+                                **jit_kw_prefill)
+        self._decode = jax.jit(decode_body, donate_argnums=(1,),
+                               **jit_kw_decode)
         # warm restart: deserialize previously exported prefill/decode
         # executables (honored-or-refused per artifact — a refused one
         # compiles fresh, loudly). The trace that produced a loaded
@@ -621,20 +703,26 @@ class ServingEngine(_EngineBase):
         self._aot_store = None
         self._aot_source = None
         if aot_store is not None:
-            if self.kv_layout == "paged":
-                # the AOT aval/geometry contract describes the ring
-                # programs; honoring it against a paged engine would
-                # deserialize the WRONG executable. Refuse typed —
-                # never a silently wrong program (aot-export support
-                # for the paged layout is a ROADMAP follow-on).
+            if self.sharded:
+                # a NamedSharding executable is topology-bound: the
+                # manifest contract cannot vouch for it across hosts.
+                # Refuse typed, naming the mesh — the persistent
+                # compile cache is the sharded warm-start path.
+                d = self._part.describe()
                 warnings.warn(
-                    "aot_store declined: the paged KV layout has no "
-                    "AOT manifest contract yet; compiling fresh",
+                    f"aot_store declined: sharded serving programs "
+                    f"(mesh batch={d['batch']} × model={d['model']}) "
+                    "are not AOT-exportable; compiling fresh (the "
+                    "persistent compile cache still warms them)",
                     stacklevel=3)
-                self._aot_source = {
-                    "serve_prefill": "refused:paged_layout",
-                    "serve_decode": "refused:paged_layout"}
+                reason = (f"refused:sharded_mesh_{d['batch']}x"
+                          f"{d['model']}")
+                self._aot_source = {"serve_prefill": reason,
+                                    "serve_decode": reason}
             else:
+                # ring AND paged manifests carry the layout geometry
+                # (kv_block_size/kv_blocks/speculative_k), so both
+                # round-trip; a layout mismatch refuses typed
                 self._load_aot(aot_store)
 
         self._occupancy = self._reg.gauge(
@@ -682,6 +770,29 @@ class ServingEngine(_EngineBase):
                 "speculative_accepted_ratio",
                 "cumulative accepted/proposed draft-token ratio (the "
                 "speculative speedup is roughly 1 + ratio × (k-1))")
+        if self.sharded:
+            # fleet-view honesty: the mesh shape plus what ONE chip
+            # actually holds — heartbeat_summary's serving_kv block and
+            # /healthz read these so pool-pressure numbers stay
+            # per-device, not global, under sharding
+            d = self._part.describe()
+            self._reg.gauge(
+                "serve_mesh_batch",
+                "serving mesh 'batch' axis degree (slots shard over "
+                "it)").set(d["batch"])
+            self._reg.gauge(
+                "serve_mesh_model",
+                "serving mesh 'model' axis degree (heads/MLP/vocab "
+                "shard over it)").set(d["model"])
+            self._reg.gauge(
+                "serve_kv_per_device_bytes",
+                "KV state bytes ONE device holds (ring: slots/batch × "
+                "heads/model slice; paged: whole pool × heads/model "
+                "slice)").set(self._part.per_device_bytes(self._cache))
+            self._reg.gauge(
+                "serve_kv_global_bytes",
+                "logical (unsharded) KV state bytes across the mesh"
+            ).set(self._part.global_bytes(self._cache))
 
     # -- AOT export / warm restart -----------------------------------------
     def _load_aot(self, store):
@@ -744,6 +855,18 @@ class ServingEngine(_EngineBase):
             raise ServingError(
                 f"prompt of {prompt.size} tokens exceeds this engine's "
                 f"prefill_len {self.prefill_len}")
+        if self.sharded and (temperature != 0 or top_k):
+            # the sharded programs argmax IN GRAPH over vocab-sharded
+            # logits (nothing ever gathers the (rows, V) array), so
+            # there are no host logits to sample from. Typed and
+            # synchronous — never a silent fall-back to greedy.
+            self.queue.finish("rejected")
+            raise ServingError(
+                f"sharded serving is greedy-only: temperature="
+                f"{temperature}, top_k={top_k} would need the full "
+                "vocab logits on the host, which the sharded decode "
+                "program never materialises — submit with "
+                "temperature=0, or serve this model unsharded")
         if self.kv_layout == "paged":
             total = int(prompt.size) + int(max_new_tokens)
             if total > self.max_len:
@@ -792,6 +915,18 @@ class ServingEngine(_EngineBase):
             info["kv_layout_declined"] = self._kv_declined
         if self._spec_declined:
             info["speculative_declined"] = self._spec_declined
+        if self.sharded:
+            # /healthz honesty under sharding: the mesh shape and what
+            # ONE device holds (not the global logical pool)
+            info["mesh"] = self._part.describe()
+            info["model_shards"] = self._part.model_shards
+            info["kv_per_device_bytes"] = \
+                self._part.per_device_bytes(self._cache)
+            info["kv_global_bytes"] = \
+                self._part.global_bytes(self._cache)
+            if self.kv_layout == "ring":
+                info["slots_per_device"] = \
+                    self.slots // self._part.batch_shards
         if self.kv_layout == "paged":
             info.update(
                 kv_block_size=self.kv_block_size,
@@ -869,13 +1004,16 @@ class ServingEngine(_EngineBase):
             req.future.set_error(ServingError(status))
         self.queue.finish(status)
 
-    def _sample_and_place(self, req, logits, slot_idx, pos,
+    def _sample_and_place(self, req, out_row, slot_idx, pos,
                           alloc=None):
-        """Shared first-token/next-token bookkeeping: sample through
-        the ONE decode helper, record, finish or keep the slot hot.
-        ``alloc`` is the paged block reservation riding the slot."""
-        tok = _decode.sample_logits(
-            logits, temperature=req.temperature, top_k=req.top_k,
+        """Shared first-token/next-token bookkeeping: resolve the
+        program output row into a token, record, finish or keep the
+        slot hot. ``out_row`` is a logits vector on the single-device
+        engines and an in-graph-argmax'd token id on the sharded ones
+        — the ONE place that split is decided. ``alloc`` is the paged
+        block reservation riding the slot."""
+        tok = int(out_row) if self.sharded else _decode.sample_logits(
+            out_row, temperature=req.temperature, top_k=req.top_k,
             rng=req.rng)
         req.tokens.append(tok)
         self._tokens_total.inc()
@@ -963,7 +1101,7 @@ class ServingEngine(_EngineBase):
         n0 = self._prefill_rec["n_traces"]
         t0c = time.perf_counter()
         cc0 = _cache_counts()
-        self._cache, logits = _quiet_donation(
+        self._cache, out = _quiet_donation(
             self._prefill, self._P, self._cache, tokens, lengths,
             slot_ids, valid)
         if self._prefill_rec["n_traces"] > n0:
@@ -972,7 +1110,9 @@ class ServingEngine(_EngineBase):
                              [tokens, lengths, slot_ids, valid],
                              ("tokens", "lengths", "slot_ids",
                               "valid"), t0c, cc0)
-        logits = np.asarray(logits)
+        # (B, V) logits single-device; (B,) in-graph argmax tokens when
+        # sharded (the full-vocab array never reaches the host)
+        out = np.asarray(out)
         for b, (req, slot_idx) in enumerate(placed):
             req.first_token_at = time.monotonic()
             self._ttft.observe(req.first_token_at - req.submitted_at)
@@ -983,7 +1123,7 @@ class ServingEngine(_EngineBase):
                              prompt_len=int(req.prompt.size))
             # the first generated token sits at position prompt_len;
             # its k/v are written by the NEXT decode tick
-            self._sample_and_place(req, logits[b], slot_idx,
+            self._sample_and_place(req, out[b], slot_idx,
                                    pos=int(req.prompt.size))
 
     def _run_prefill_paged(self, batch, free):
@@ -1014,7 +1154,7 @@ class ServingEngine(_EngineBase):
         n0 = self._prefill_rec["n_traces"]
         t0c = time.perf_counter()
         cc0 = _cache_counts()
-        self._cache, logits = _quiet_donation(
+        self._cache, out = _quiet_donation(
             self._prefill, self._P, self._cache, tables, tokens,
             starts, lengths, valid)
         if self._prefill_rec["n_traces"] > n0:
@@ -1023,7 +1163,7 @@ class ServingEngine(_EngineBase):
                              [tables, tokens, starts, lengths, valid],
                              ("tables", "tokens", "starts", "lengths",
                               "valid"), t0c, cc0)
-        logits = np.asarray(logits)
+        out = np.asarray(out)      # (B, V) logits, or (B,) sharded toks
         self._update_pool_gauges()
         for b, (req, slot_idx, alloc) in enumerate(placed):
             req._alloc = None      # the slot owns the reservation now
@@ -1037,7 +1177,7 @@ class ServingEngine(_EngineBase):
                              prefix_hit_tokens=int(alloc.shared_tokens))
             # the first generated token sits at position prompt_len;
             # its k/v are written by the NEXT decode tick
-            self._sample_and_place(req, logits[b], slot_idx,
+            self._sample_and_place(req, out[b], slot_idx,
                                    pos=int(req.prompt.size),
                                    alloc=alloc)
 
@@ -1088,7 +1228,7 @@ class ServingEngine(_EngineBase):
         n0 = self._decode_rec["n_traces"]
         t0c = time.perf_counter()
         cc0 = _cache_counts()
-        self._cache, logits = _quiet_donation(
+        self._cache, out = _quiet_donation(
             self._decode, self._P, self._cache, tables, tokens,
             positions, counts)
         if self._decode_rec["n_traces"] > n0:
@@ -1097,7 +1237,9 @@ class ServingEngine(_EngineBase):
                              [tables, tokens, positions, counts],
                              ("tables", "tokens", "positions",
                               "counts"), t0c, cc0)
-        logits = np.asarray(logits)
+        # (W, K, V) logits single-device; (W, K) in-graph argmax tokens
+        # when sharded — the accept walk below only ever needs argmax
+        out = np.asarray(out)
         for i, slot in enumerate(list(self._slots)):
             if slot is None:
                 continue
@@ -1105,9 +1247,10 @@ class ServingEngine(_EngineBase):
             emitted = 0
             done = False
             for j in range(cnt):
-                tok = _decode.sample_logits(
-                    logits[i, j], temperature=req.temperature,
-                    top_k=req.top_k, rng=req.rng)
+                tok = int(out[i, j]) if self.sharded else \
+                    _decode.sample_logits(
+                        out[i, j], temperature=req.temperature,
+                        top_k=req.top_k, rng=req.rng)
                 req.tokens.append(tok)
                 self._tokens_total.inc()
                 emitted += 1
@@ -1150,7 +1293,7 @@ class ServingEngine(_EngineBase):
         n0 = self._decode_rec["n_traces"]
         t0c = time.perf_counter()
         cc0 = _cache_counts()
-        self._cache, logits = _quiet_donation(
+        self._cache, out = _quiet_donation(
             self._decode, self._P, self._cache, tokens, positions,
             active)
         if self._decode_rec["n_traces"] > n0:
@@ -1159,7 +1302,7 @@ class ServingEngine(_EngineBase):
                              [tokens, positions, active],
                              ("tokens", "positions", "active"), t0c,
                              cc0)
-        logits = np.asarray(logits)
+        out = np.asarray(out)      # (W, V) logits, or (W,) sharded toks
         for i, slot in enumerate(list(self._slots)):
             if slot is None:
                 continue
@@ -1173,7 +1316,7 @@ class ServingEngine(_EngineBase):
                 _spans.event("request.decode_tick",
                              request=slot["req"].trace_id, slot=i,
                              pos=slot["pos"] + 1)
-            self._sample_and_place(slot["req"], logits[i], i,
+            self._sample_and_place(slot["req"], out[i], i,
                                    pos=slot["pos"] + 1)
 
 
@@ -1405,7 +1548,8 @@ def build_engine(model, **kw):
                    "policy", "queue_capacity", "faults", "registry",
                    "telemetry_dir", "max_retries", "trace_requests",
                    "aot_store", "profile_every", "kv_layout",
-                   "kv_block_size", "kv_blocks", "speculative_k")
+                   "kv_block_size", "kv_blocks", "speculative_k",
+                   "mesh", "model_shards")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(

@@ -352,3 +352,23 @@ class TestServeGatewayExample:
         assert "SELFTEST OK" in out, out[-500:]
         assert "n_traces=1" in out, out[-500:]
         assert "drain_exit=0" in out, out[-500:]
+
+    def test_serve_transformer_sharded_cpu_mesh(self):
+        """GSPMD sharded serving through the example: --model-shards 2
+        on the hermetic 8-device CPU mesh (XLA_FLAGS inherited from
+        conftest), greedy selftest requests, no-retrace pin, clean
+        drain."""
+        out = run_example(["examples/serve_transformer.py", "--cpu",
+                           "--model-shards", "2", "--slots", "4",
+                           "--selftest", "4"])
+        assert "SHARDED mesh=batch" in out, out[-500:]
+        assert "SELFTEST OK" in out, out[-500:]
+        assert "n_traces=1" in out, out[-500:]
+        assert "drain_exit=0" in out, out[-500:]
+
+    def test_serve_transformer_explicit_mesh(self):
+        out = run_example(["examples/serve_transformer.py", "--cpu",
+                           "--mesh", "2x2", "--slots", "4",
+                           "--selftest", "3"])
+        assert "SHARDED mesh=batch2xmodel2" in out, out[-500:]
+        assert "SELFTEST OK" in out, out[-500:]

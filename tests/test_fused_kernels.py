@@ -487,3 +487,164 @@ class TestFusedEpilogue:
             assert getattr(y, "_bn_epilogue", None) is None
         finally:
             net.eval()
+
+    # -- conv→BN→add→ReLU residual tail --------------------------------
+
+    @pytest.mark.parametrize("layout,shape", [("NCHW", (2, 5, 7, 7)),
+                                              ("NHWC", (2, 7, 7, 5))])
+    def test_scale_shift_add_relu_parity(self, layout, shape):
+        rng = np.random.RandomState(2)
+        x = rng.randn(*shape).astype(np.float32)
+        r = rng.randn(*shape).astype(np.float32)
+        C = shape[1] if layout == "NCHW" else shape[-1]
+        sc = (rng.rand(C) + 0.5).astype(np.float32)
+        sh = rng.randn(C).astype(np.float32)
+        got = fused_epilogue.scale_shift_add_relu(
+            jnp.asarray(x), sc, sh, jnp.asarray(r), layout=layout)
+        b = (1, C, 1, 1) if layout == "NCHW" else (1, 1, 1, C)
+        ref = np.maximum(x * sc.reshape(b) + sh.reshape(b) + r, 0)
+        assert got.dtype == x.dtype and got.shape == x.shape
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+    def test_add_relu_budget_counts_both_tiles(self):
+        """The residual kernel holds TWO full-size tiles per block, so
+        the budgeted row block must halve (or fall back) relative to
+        the plain kernel's — an unscaled budget would be Mosaic-doomed
+        on real silicon at bench shapes."""
+        one = fused_epilogue._block_rows(2048, 12544, 4, n_inputs=1)
+        two = fused_epilogue._block_rows(2048, 12544, 4, n_inputs=2)
+        assert two is not None and two * 2 * 12544 * 4 <= \
+            fused_epilogue._BLOCK_BYTE_BUDGET
+        assert two <= one
+        # a shape where even the minimum block × 2 blows the budget
+        # computes via the reference path (and marks nothing)
+        assert fused_epilogue._block_rows(8, 180000, 4,
+                                          n_inputs=2) is None
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 400, 450).astype(np.float32)
+        r = rng.randn(1, 2, 400, 450).astype(np.float32)
+        sc = (rng.rand(2) + 0.5).astype(np.float32)
+        sh = rng.randn(2).astype(np.float32)
+        sink = []
+        with fused_optim.trace_collector(sink):
+            got = fused_epilogue.scale_shift_add_relu(
+                jnp.asarray(x), sc, sh, jnp.asarray(r), layout="NCHW")
+        ref = np.maximum(x * sc.reshape(1, 2, 1, 1)
+                         + sh.reshape(1, 2, 1, 1) + r, 0)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+    def test_add_kernel_marks_trace_collector(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 4, 8, 8).astype(np.float32))
+        r = jnp.asarray(rng.randn(2, 4, 8, 8).astype(np.float32))
+        sink = []
+        with fused_optim.trace_collector(sink):
+            fused_epilogue.scale_shift_add_relu(
+                x, np.ones(4, np.float32), np.zeros(4, np.float32), r,
+                layout="NCHW")
+        assert sink == ["epilogue"]
+
+    def _residual_net(self, downsample=False):
+        """conv→BN→add→ReLU residual block; ``downsample=True`` runs
+        the skip branch through its own conv+BN (BOTH add operands
+        tagged — the downsample-block shape)."""
+        class Net(model.Model):
+            def __init__(self):
+                super().__init__()
+                self.conv = layer.Conv2d(8, 3, padding=1)
+                self.bn = layer.BatchNorm2d()
+                self.down = layer.Conv2d(8, 1) if downsample else None
+                self.bn_d = layer.BatchNorm2d() if downsample else None
+                self.add = layer.Add()
+                self.relu = layer.ReLU()
+
+            def forward(self, x):
+                out = self.bn(self.conv(x))
+                res = self.bn_d(self.down(x)) if self.down else x
+                return self.relu(self.add(out, res))
+
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(3)
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 8, 16, 16).astype(np.float32)
+        tx = tensor.Tensor(data=x, device=dev, requires_grad=False)
+        net = Net()
+        net.compile([tx], is_train=False, use_graph=True)
+        net.eval()
+        net.bn.running_mean.data = jnp.asarray(
+            rng.randn(8).astype(np.float32))
+        net.bn.running_var.data = jnp.asarray(
+            (rng.rand(8) + 0.5).astype(np.float32))
+        if downsample:
+            net.bn_d.running_mean.data = jnp.asarray(
+                rng.randn(8).astype(np.float32))
+            net.bn_d.running_var.data = jnp.asarray(
+                (rng.rand(8) + 0.5).astype(np.float32))
+        return net, dev, x, tx
+
+    @pytest.mark.parametrize("downsample", [False, True])
+    def test_residual_peephole_matches_reference_eval(self, downsample):
+        net, dev, x, tx = self._residual_net(downsample)
+        ref = np.asarray(net(tx).data)      # eager: peephole inactive
+
+        def fwd(arr):
+            return net.forward(tensor.Tensor(
+                data=arr, device=dev, requires_grad=False)).data
+
+        with fused_epilogue.enabled_scope(True):
+            got = np.asarray(jax.jit(fwd)(jnp.asarray(x)))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_residual_peephole_fires_in_trace(self):
+        """The add output carries the residual tag, and the consuming
+        relu actually takes the fused path inside a jit when enabled
+        (the collector sees the epilogue mark)."""
+        net, dev, x, tx = self._residual_net()
+        y = net.add(net.bn(net.conv(tx)), tx)
+        assert getattr(y, "_bn_add_epilogue", None) is not None
+
+        def fwd(arr):
+            return net.forward(tensor.Tensor(
+                data=arr, device=dev, requires_grad=False)).data
+
+        sink = []
+        with fused_epilogue.enabled_scope(True), \
+                fused_optim.trace_collector(sink):
+            jax.jit(fwd)(jnp.asarray(x))
+        assert "epilogue" in sink
+
+    def test_residual_declines_in_training(self):
+        """The residual branch backprops too: the peephole must
+        decline in training mode exactly like the plain tail."""
+        from singa_tpu.autograd_base import CTX
+        net, dev, x, tx = self._residual_net()
+        net.bn.freeze_stats = True
+        y = net.add(net.bn(net.conv(tx)), tx)
+        assert getattr(y, "_bn_add_epilogue", None) is not None
+        prev = CTX.training
+        CTX.training = True
+        try:
+            with fused_epilogue.enabled_scope(True):
+                assert fused_epilogue.try_relu_epilogue(y) is None
+        finally:
+            CTX.training = prev
+
+    def test_broadcast_residual_declines(self):
+        """A skip connection that broadcasts (shape mismatch) is not
+        the tail the kernel fuses — the peephole returns None and the
+        reference add+relu runs."""
+        net, dev, x, tx = self._residual_net()
+        bn_out = net.bn(net.conv(tx))
+        small = tensor.Tensor(data=np.ones((1, 8, 1, 1), np.float32),
+                              device=dev, requires_grad=False)
+        y = net.add(bn_out, small)
+        assert getattr(y, "_bn_add_epilogue", None) is not None
+
+        def probe(arr):
+            yy = net.add(net.bn(net.conv(tensor.Tensor(
+                data=arr, device=dev, requires_grad=False))), small)
+            with fused_epilogue.enabled_scope(True):
+                return fused_epilogue.try_relu_epilogue(yy) is None
+
+        import jax as _jax
+        assert bool(_jax.jit(probe)(jnp.asarray(x)))

@@ -489,20 +489,64 @@ class TestDeclines:
             m.compile_serving(slots=2, max_len=32, prefill_len=8,
                               kv_layout="circular", registry=_reg())
 
-    def test_paged_aot_store_refused_typed(self, tmp_path):
-        m = tiny_lm()
+    def test_paged_aot_round_trip(self, tmp_path):
+        """Paged AOT is a REAL export now: the manifests carry the
+        pool geometry, a fresh engine deserializes both programs
+        (source 'loaded', n_traces still 1), the warm tokens are
+        identical to the cold engine's, and a DIFFERENT pool geometry
+        refuses typed instead of honoring the wrong executable."""
+        m = tiny_lm(seed=4)
+        kw = dict(slots=2, max_len=32, prefill_len=8,
+                  kv_layout="paged", kv_block_size=4)
+        eng = m.compile_serving(**kw, aot_store=str(tmp_path),
+                                registry=_reg())
+        cold = _greedy(eng, [1, 2, 3, 4, 5], 6)
+        eng.export_aot()
+        src = eng.compiled_step_info()["aot"]
+        assert set(src.values()) == {"exported"}, src
+
+        warm = m.compile_serving(**kw, aot_store=str(tmp_path),
+                                 registry=_reg())
+        info = warm.compiled_step_info()
+        assert info["aot"] == {"serve_prefill": "loaded",
+                               "serve_decode": "loaded"}, info["aot"]
+        assert _greedy(warm, [1, 2, 3, 4, 5], 6) == cold
+        # ≥3 refills through the DESERIALIZED programs, still 1 trace
+        for _ in range(3):
+            assert _greedy(warm, [7, 8, 9], 4) == \
+                _greedy(eng, [7, 8, 9], 4)
+        assert warm.compiled_step_info()["n_traces"] == 1
+        # wrong pool geometry: refused typed, compiled fresh
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
-            eng = m.compile_serving(slots=2, max_len=32, prefill_len=8,
-                                    kv_layout="paged", kv_block_size=4,
-                                    aot_store=str(tmp_path),
-                                    registry=_reg())
-        assert any("aot" in str(x.message).lower() for x in w)
-        assert eng.compiled_step_info()["aot"] == {
-            "serve_prefill": "refused:paged_layout",
-            "serve_decode": "refused:paged_layout"}
-        with pytest.raises(ValueError, match="paged"):
-            eng.export_aot(str(tmp_path))
+            other = m.compile_serving(
+                slots=2, max_len=32, prefill_len=8, kv_layout="paged",
+                kv_block_size=8, aot_store=str(tmp_path),
+                registry=_reg())
+        outcomes = other.compiled_step_info()["aot"]
+        assert all(v.startswith("refused:") for v in outcomes.values()), \
+            outcomes
+        assert any("REFUSED" in str(x.message) for x in w)
+
+    def test_ring_artifact_refused_by_paged_engine(self, tmp_path):
+        """A ring export must never be honored by a paged engine of
+        the same slot geometry — the manifest's kv_layout stamp (plus
+        the aval diff) refuses it typed."""
+        m = tiny_lm(seed=5)
+        ring = m.compile_serving(slots=2, max_len=32, prefill_len=8,
+                                 aot_store=str(tmp_path),
+                                 registry=_reg())
+        _greedy(ring, [1, 2, 3], 4)
+        ring.export_aot()
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            paged = m.compile_serving(
+                slots=2, max_len=32, prefill_len=8, kv_layout="paged",
+                kv_block_size=4, aot_store=str(tmp_path),
+                registry=_reg())
+        outcomes = paged.compiled_step_info()["aot"]
+        assert all(v.startswith("refused:") for v in outcomes.values()), \
+            outcomes
 
 
 class TestGatewayFollowThrough:

@@ -49,6 +49,14 @@ METRICS = [
     ("quant_img_s", lambda p: (p.get("quant") or {}).get(
         "resnet_img_s")),
     ("sweep_best_tok_s", lambda p: _sweep_best(p.get("serving_sweep"))),
+    ("serve_sh_tok_s", lambda p: (p.get("serving_sharded") or {}).get(
+        "decode_tok_s")),
+    ("serve_sh_kv_dev_mib", lambda p: _scale(
+        (p.get("serving_sharded") or {}).get("kv_per_device_bytes"),
+        1 / 2**20)),
+    ("serve_sh_hbm_gib", lambda p: _scale(
+        (p.get("serving_sharded") or {}).get("hbm_peak_bytes"),
+        1 / 2**30)),
     ("hbm_peak_gib", lambda p: _scale(p.get("hbm_peak_bytes"),
                                       1 / 2**30)),
     ("bf16_hbm_gib", lambda p: _scale(p.get("bf16_hbm_peak_bytes"),
@@ -60,7 +68,8 @@ METRICS = [
 # metrics are reported with deltas but a rise there is not flagged
 # (the p99 of a 2-request CPU smoke is far too noisy to gate on)
 GATED = {"img_s", "bf16_img_s", "lm_tok_s", "lm_bf16_tok_s",
-         "serve_tok_s", "quant_img_s", "sweep_best_tok_s"}
+         "serve_tok_s", "quant_img_s", "sweep_best_tok_s",
+         "serve_sh_tok_s"}
 
 # SLO latency targets (ms) the serving_sweep winner table is computed
 # against: for each, the highest-throughput config whose p99 per-tick
@@ -229,6 +238,24 @@ def build_report(records, threshold=0.05, mfu_floor=None):
                     c["delta"] = (c["decode_tok_s"]
                                   - pc["decode_tok_s"]) \
                         / pc["decode_tok_s"]
+        sh = parsed.get("serving_sharded")
+        if isinstance(sh, dict) and \
+                isinstance(sh.get("decode_tok_s"), (int, float)):
+            mesh = sh.get("mesh") or {}
+            blk = {"decode_tok_s": sh["decode_tok_s"],
+                   "mesh": f"{mesh.get('batch', '?')}x"
+                           f"{mesh.get('model', '?')}",
+                   "kv_per_device_mib": _scale(
+                       sh.get("kv_per_device_bytes"), 1 / 2**20),
+                   "hbm_peak_gib": _scale(sh.get("hbm_peak_bytes"),
+                                          1 / 2**30)}
+            # vs the SAME round's unsharded serving record: what
+            # sharding costs (CPU: unoverlapped collectives) or buys
+            # (per-chip memory) this round — never across platforms
+            unsh = (parsed.get("serving") or {}).get("decode_tok_s")
+            if isinstance(unsh, (int, float)) and unsh:
+                blk["vs_unsharded"] = sh["decode_tok_s"] / unsh
+            row["serving_sharded"] = blk
         if prev is not None:
             for name, v in vals.items():
                 pv = prev["metrics"].get(name)
@@ -349,6 +376,19 @@ def render_table(report):
                 else:
                     lines.append(
                         f"  sweep winner [{target}] none fits")
+        sh = row.get("serving_sharded")
+        if sh:
+            parts = [f"mesh {sh['mesh']}",
+                     f"{_fmt(sh['decode_tok_s'])} tok/s"
+                     f"{_fmt_delta(row['deltas'].get('serve_sh_tok_s'))}"]
+            if sh.get("vs_unsharded") is not None:
+                parts.append(f"{sh['vs_unsharded']:.2f}x unsharded")
+            if sh.get("kv_per_device_mib") is not None:
+                parts.append(
+                    f"kv/dev={sh['kv_per_device_mib']:.3g}MiB")
+            if sh.get("hbm_peak_gib") is not None:
+                parts.append(f"hbm/dev={sh['hbm_peak_gib']:.3g}GiB")
+            lines.append("  sharded " + "  ".join(parts))
         lines.append("")
     regs = report["regressions"]
     lines.append(f"{len(report['rounds'])} round(s), "
@@ -390,6 +430,11 @@ def selftest():
                     "exposed_collective_s": 4e-5, "window_s": 4e-4},
                 "serving": {"decode_tok_s": 500.0,
                             "p99_token_s": 0.002},
+                "serving_sharded": {
+                    "decode_tok_s": 400.0,
+                    "mesh": {"batch": 2, "model": 2, "devices": 4},
+                    "kv_per_device_bytes": 8 * 2**20,
+                    "hbm_peak_bytes": 2 * 2**30},
                 "serving_sweep": {"configs": [
                     {"kv_layout": "ring", "slots": 4,
                      "prefill_len": 16, "speculative_k": 0,
@@ -410,6 +455,10 @@ def selftest():
                 "timeline": {"fractions": {"compute": 0.55},
                              "exposed_collective_s": 4e-5,
                              "window_s": 4e-4},
+                "serving_sharded": {
+                    "decode_tok_s": 440.0,
+                    "mesh": {"batch": 2, "model": 2, "devices": 4},
+                    "kv_per_device_bytes": 8 * 2**20},
                 "serving_sweep": {"configs": [
                     {"kv_layout": "paged", "slots": 4,
                      "prefill_len": 16, "speculative_k": 4,
@@ -442,6 +491,17 @@ def selftest():
         # serving_sweep: best-config scalar extracted, per-config
         # curves + winner-per-SLO table built
         assert rows[2]["metrics"]["sweep_best_tok_s"] == 900.0
+        # serving_sharded: decode tok/s + per-device bytes extracted,
+        # the vs-unsharded ratio computed from the SAME round's
+        # serving record, and the r4 repeat carries a same-platform
+        # delta across the cpu round
+        shb = rows[2]["serving_sharded"]
+        assert shb["mesh"] == "2x2" and shb["decode_tok_s"] == 400.0
+        assert abs(shb["vs_unsharded"] - 0.8) < 1e-9, shb
+        assert shb["kv_per_device_mib"] == 8.0
+        assert rows[2]["metrics"]["serve_sh_kv_dev_mib"] == 8.0
+        assert abs(rows[4]["deltas"]["serve_sh_tok_s"] - 0.10) < 1e-9
+        assert "vs_unsharded" not in rows[4]["serving_sharded"]
         sw = rows[2]["serving_sweep"]
         assert [c["name"] for c in sw["configs"]] == \
             ["ring s4 pf16 k0", "paged s4 pf16 k4"]
@@ -475,6 +535,8 @@ def selftest():
         assert "sweep paged s4 pf16 k4" in text and \
             "sweep winner [p99<=1ms] ring s4 pf16 k0" in text and \
             "spec_accept=40%" in text, text
+        assert "sharded mesh 2x2" in text and \
+            "0.80x unsharded" in text and "kv/dev=8MiB" in text, text
         json.dumps(report)                       # JSON-able end to end
 
         # --mfu-floor gate: r5 drops bf16 MFU below the floor r2 held
@@ -517,8 +579,10 @@ def selftest():
           "deltas and timeline columns rendered, the 20% bf16 drop "
           "flagged across the cpu round, torn record skipped, the "
           "serving_sweep curves + winner-per-SLO table built (with "
-          "per-config deltas), and the --mfu-floor gate flags the "
-          "lost floor + exposed-comm rise only when armed")
+          "per-config deltas), the serving_sharded leg rendered with "
+          "its vs-unsharded ratio + per-device bytes, and the "
+          "--mfu-floor gate flags the lost floor + exposed-comm rise "
+          "only when armed")
 
 
 def main():
