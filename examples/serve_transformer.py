@@ -115,6 +115,14 @@ def main():
                     help="fire N requests at the own gateway, verify, "
                          "exit 0")
     ap.add_argument("--drain-timeout", type=float, default=60.0)
+    ap.add_argument("--default-timeout", type=float, default=120.0,
+                    help="per-request deadline budget (seconds) when "
+                         "the body carries no timeout; the engine SLO "
+                         "timeout and the gateway's own wait are both "
+                         "derived from this ONE clock")
+    ap.add_argument("--max-body-bytes", type=int, default=8 << 20,
+                    help="refuse request bodies over this size with "
+                         "413 before reading them")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -184,7 +192,9 @@ def main():
     replica.install_signal_handlers()
     replica.start()
     server, port = serve_gateway(engine, port=args.port,
-                                 replica=replica)
+                                 replica=replica,
+                                 default_timeout=args.default_timeout,
+                                 max_body_bytes=args.max_body_bytes)
     print(f"READY port={port}", flush=True)
 
     if args.selftest:

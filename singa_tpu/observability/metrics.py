@@ -434,6 +434,33 @@ def heartbeat_summary(registry=None):
             if isinstance(per_dev, Gauge):
                 kv["per_device_bytes"] = per_dev.value()
         out["serving_kv"] = kv
+    # fleet resilience (processes running a FleetRouter): breaker /
+    # re-dispatch / shed movement — the coordinator-view evidence that
+    # a replica died and the fleet absorbed it
+    fleet_sub = reg.get("serve_fleet_submitted_total")
+    if isinstance(fleet_sub, Counter):
+        fl = {"submitted": int(fleet_sub.total())}
+        for key, name in (("failovers", "serve_fleet_failover_total"),
+                          ("redispatches",
+                           "serve_fleet_redispatch_total"),
+                          ("sheds", "serve_fleet_shed_total"),
+                          ("rejected", "serve_fleet_rejected_total"),
+                          ("breaker_opens",
+                           "serve_fleet_breaker_open_total")):
+            c = reg.get(name)
+            if isinstance(c, Counter):
+                fl[key] = int(c.total())
+        breaker = reg.get("serve_fleet_breaker_state")
+        if isinstance(breaker, Gauge):
+            series = breaker.to_doc().get("series", [])
+            fl["breakers_open"] = sum(
+                1 for s in series if s.get("value") == 2)
+            fl["breakers_half_open"] = sum(
+                1 for s in series if s.get("value") == 1)
+        stranded = reg.get("serve_stranded_requests_total")
+        if isinstance(stranded, Counter):
+            fl["stranded"] = int(stranded.total())
+        out["serving_fleet"] = fl
     stamp = build_stamp()
     out["build"] = {"git": stamp["git"], "start_ts": stamp["start_ts"]}
     return out

@@ -16,6 +16,12 @@ Faults::
             .preempt_at(step=11)           # real SIGTERM to this process
             .crash_after_save(step=13))    # die mid-async-save
 
+Serving-fleet faults (consumed by the serving engine / fleet router):
+``fail_submit`` (submit dies on the wire), ``crash_after_admit`` (the
+replica dies holding an admitted request — the stranded shape), and
+``slow_replica`` (straggling ticks; drives per-try-timeout
+re-dispatch).
+
 On-disk chaos (for restore-hardening tests) lives beside the plan:
 :func:`truncate_checkpoint` / :func:`corrupt_checkpoint` damage a
 committed checkpoint step directory in place.
@@ -158,6 +164,34 @@ class FaultPlan:
         death AND name the sample that killed it."""
         return self._arm("kill_worker", index, 1)
 
+    # -- serving-fleet faults ----------------------------------------------
+    def fail_submit(self, seq, times=1):
+        """Make the engine's submit path raise ``ConnectionError`` for
+        ``times`` CONSECUTIVE submissions starting at submit number
+        ``seq`` (counting from 1, per engine) — the request dies on the
+        wire before the engine sees it. A fleet router must classify
+        this as a REPLICA failure (breaker fodder), never a request
+        failure. Like ``corrupt_wire``, submit numbers never repeat,
+        so ``times`` spans consecutive submits."""
+        return self._arm("submit_wire", seq, times)
+
+    def crash_after_admit(self, req_id, times=1):
+        """Crash the whole engine the instant after it ADMITS request
+        id ``req_id`` — the stranded-request shape: the submit call
+        succeeded, the replica died, and the future comes back already
+        failed with ``ReplicaCrashed``. Drives a fleet router's
+        exactly-once re-dispatch deterministically."""
+        return self._arm("admit_crash", req_id, times)
+
+    def slow_replica(self, tick, seconds=0.2, times=1):
+        """Stall ``times`` CONSECUTIVE serve-loop ticks starting at
+        tick ``tick`` by ``seconds`` each — a straggling replica, not a
+        dead one: nothing raises, responses just arrive late. Drives a
+        fleet router's per-try timeout → re-dispatch-with-remaining-
+        budget path."""
+        return self._arm("slow_replica", tick, times,
+                         seconds=float(seconds))
+
     # -- integrity faults --------------------------------------------------
     def corrupt_wire(self, seq, times=1):
         """Flip one bit in each of the next ``times`` control-plane
@@ -189,6 +223,15 @@ class FaultPlan:
         rec = self._take("hang", step)
         if rec is not None:
             time.sleep(rec["seconds"])
+        # slow_replica matches CONSECUTIVE ticks from its start (tick
+        # numbers never repeat — same matching rule as corrupt_wire)
+        for rec in self._faults:
+            if rec["kind"] == "slow_replica" and rec["times"] > 0 \
+                    and int(step) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(step), "slow_replica"))
+                time.sleep(rec["seconds"])
+                break
         rec = self._take("step", step)
         if rec is not None:
             raise FaultInjected(f"step {step}: {rec['message']}")
@@ -250,6 +293,25 @@ class FaultPlan:
         if self._take("kill_ack", step) is not None:
             os._exit(1)          # died in the commit hole
 
+    def on_submit(self, seq):
+        """Called by the serving engine for every submit attempt
+        (``seq`` counts from 1 per engine). An armed ``fail_submit``
+        raises ``ConnectionError`` — consecutive matching, like
+        ``on_wire_send``."""
+        for rec in self._faults:
+            if rec["kind"] == "submit_wire" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "submit_wire"))
+                raise ConnectionError(
+                    f"injected submit wire error (submit {seq})")
+
+    def on_admit(self, req_id):
+        """Called right after the serving engine admits request
+        ``req_id``; True tells the engine to crash itself NOW (the
+        crash-after-admit stranded-request fault)."""
+        return self._take("admit_crash", req_id) is not None
+
     def on_wire_send(self, seq, payload):
         """Called with every SEALED outbound control-plane frame;
         returns the bytes to actually send (possibly bit-flipped)."""
@@ -306,6 +368,12 @@ class _NullPlan(FaultPlan):
 
     def on_ack(self, step):
         pass
+
+    def on_submit(self, seq):
+        pass
+
+    def on_admit(self, req_id):
+        return False
 
     def on_wire_send(self, seq, payload):
         return payload

@@ -32,17 +32,22 @@ client-side routing), :mod:`.gateway` (stdlib HTTP front).
 
 from .engine import (BatchServingEngine, ServingEngine,   # noqa: F401
                      build_engine)
-from .fleet import (EXIT_DRAINED, FleetRouter,            # noqa: F401
-                    ServingReplica)
+from .fleet import (EXIT_DRAINED, CircuitBreaker,         # noqa: F401
+                    FleetFuture, FleetRouter, ServingReplica,
+                    ShedPolicy, brownout_shrink_generation)
 from .gateway import serve_gateway                        # noqa: F401
 from .scheduler import (BlockPoolExhausted,               # noqa: F401
                         EngineDraining, QueueFull,
-                        Request, RequestQueue, RequestTimeout,
-                        ServeFuture, ServingError)
+                        ReplicaCrashed, Request, RequestQueue,
+                        RequestShed, RequestTimeout, ServeFuture,
+                        ServingError, budget_remaining, deadline_in)
 
 __all__ = [
     "ServingEngine", "BatchServingEngine", "build_engine",
-    "ServingReplica", "FleetRouter", "EXIT_DRAINED", "serve_gateway",
-    "ServingError", "QueueFull", "EngineDraining", "RequestTimeout",
+    "ServingReplica", "FleetRouter", "FleetFuture", "CircuitBreaker",
+    "ShedPolicy", "brownout_shrink_generation", "EXIT_DRAINED",
+    "serve_gateway", "ServingError", "QueueFull", "EngineDraining",
+    "RequestTimeout", "ReplicaCrashed", "RequestShed",
     "BlockPoolExhausted", "ServeFuture", "Request", "RequestQueue",
+    "deadline_in", "budget_remaining",
 ]

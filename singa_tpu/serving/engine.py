@@ -84,8 +84,8 @@ from ..observability import spans as _spans
 from ..resilience.faults import NULL_PLAN, FaultInjected
 from ..models import decode as _decode
 from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
-                        Request, RequestQueue, RequestTimeout,
-                        ServingError)
+                        ReplicaCrashed, Request, RequestQueue,
+                        RequestTimeout, ServingError)
 
 # donation is a TPU/accelerator optimisation; on CPU jax warns that the
 # donated buffers were unused — expected for OUR two programs, not
@@ -173,6 +173,14 @@ class _EngineBase:
         self._retries = self._reg.counter(
             "serve_retries_total",
             "serve-loop ticks retried after an injected/transient fault")
+        # submit sequence number: the key the fleet-level wire-error
+        # fault fires on (send numbers, like the control plane's)
+        self._submit_seq = 0
+        self._stranded = self._reg.counter(
+            "serve_stranded_requests_total",
+            "requests a serve-loop crash failed while admitted "
+            "(queued or slotted) — each one is re-dispatchable by a "
+            "fleet router with its remaining deadline budget")
         self._ttft = self._reg.histogram(
             "serve_ttft_seconds",
             "request submit to first generated token (queue wait "
@@ -183,9 +191,14 @@ class _EngineBase:
 
     # -- admission ---------------------------------------------------------
     def _admit(self, req):
+        # fleet fault point: the submit RPC dies on the wire before the
+        # engine sees it (raises ConnectionError — what a router's
+        # breaker must classify as a replica failure, not a request one)
+        self._submit_seq += 1
+        self.faults.on_submit(self._submit_seq)
         if self._crashed is not None:
             self.queue.finish("rejected")
-            raise ServingError(
+            raise ReplicaCrashed(
                 f"engine crashed ({self._crashed}); not accepting "
                 "requests — see the blackbox dump")
         if self._draining or self._stopped:
@@ -206,6 +219,12 @@ class _EngineBase:
                              reason="queue_full")
             raise
         self._wake.set()
+        # fleet fault point: the replica dies the instant after it
+        # admitted this request — the stranded-request shape a router's
+        # exactly-once re-dispatch exists for (the future comes back
+        # already failed with ReplicaCrashed)
+        if self.faults.on_admit(req.id):
+            self._crash(RuntimeError("injected crash after admit"))
         return req.future
 
     # -- background loop ---------------------------------------------------
@@ -299,8 +318,11 @@ class _EngineBase:
 
     def _fail_batch(self, batch, exc):
         """Fail requests that were popped from the queue but died
-        before reaching the slot table / delivery (exactly once)."""
-        err = ServingError(f"serve tick failed: {exc}")
+        before reaching the slot table / delivery (exactly once).
+        Typed ReplicaCrashed: a tick exception takes the whole loop
+        down right after this, so these requests are stranded by a
+        dying replica — re-dispatchable, not malformed."""
+        err = ReplicaCrashed(f"serve tick failed: {exc}")
         err.__cause__ = exc
         for req in batch:
             if not req.future.done():
@@ -364,11 +386,21 @@ class _EngineBase:
                   f"{exc}); blackbox at {path}")
         except Exception:   # losing the blackbox must not mask the crash
             pass
-        err = ServingError(f"serve loop crashed: {exc}")
+        err = ReplicaCrashed(f"serve loop crashed: {exc}")
         err.__cause__ = exc
-        self.queue.drain_pending(err)
+        # stranded-request capture: everything admitted (queued or
+        # slotted) dies HERE with a re-dispatchable typed error — the
+        # count is the fleet router's recovery workload
+        stranded = self.queue.drain_pending(err)
+        stranded += self._count_inflight()
         self._fail_inflight(err)
+        if stranded:
+            self._stranded.inc(stranded)
         self._idle_evt.set()
+
+    def _count_inflight(self):
+        """Requests currently holding a slot (subclass-specific)."""
+        return 0
 
     def _sample_hbm(self):
         """HBM gauges on the serving tick cadence (every 16th tick —
@@ -586,6 +618,11 @@ class ServingEngine(_EngineBase):
         self._spec_width = max(1, spec)
         self.speculative_k = self._spec_width \
             if self._spec_width > 1 else 0
+        # brownout knob: while set, no drafts are proposed (each tick
+        # emits one token through the SAME compiled verify program —
+        # rows padded to width 1, no retrace, greedy identity intact).
+        # A fleet shed policy flips this before refusing outright.
+        self._spec_throttled = False
 
         self._prefill_rec = {"n_traces": 0}
         self._decode_rec = {"n_traces": 0}
@@ -939,6 +976,15 @@ class ServingEngine(_EngineBase):
     def active_slots(self):
         return sum(1 for s in self._slots if s is not None)
 
+    def throttle_speculation(self, on=True):
+        """Brownout: suspend draft proposal (one token per tick through
+        the unchanged compiled verify program) while ``on`` — less
+        wasted verify compute under pressure, same greedy tokens.
+        Idempotent; a fleet ``ShedPolicy`` brownout hook is the
+        intended caller. Returns ``self``."""
+        self._spec_throttled = bool(on)
+        return self
+
     # -- loop internals ----------------------------------------------------
     def _busy(self):
         return len(self.queue) > 0 or any(
@@ -957,6 +1003,9 @@ class ServingEngine(_EngineBase):
         if self._mgr is not None:
             self._blocks_in_use.set(self._mgr.blocks_live())
             self._blocks_cached.set(self._mgr.blocks_cached())
+
+    def _count_inflight(self):
+        return self.active_slots()
 
     def _fail_inflight(self, error):
         for i, slot in enumerate(self._slots):
@@ -1207,7 +1256,8 @@ class ServingEngine(_EngineBase):
                 continue
             req = slot["req"]
             n = 1
-            if K > 1 and req.temperature == 0:
+            if K > 1 and req.temperature == 0 \
+                    and not self._spec_throttled:
                 # greedy-only: the accept rule below is exact for
                 # argmax; a sampled request decodes one token per tick
                 # (its per-request rng draw order must not change)

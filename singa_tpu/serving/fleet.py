@@ -1,4 +1,4 @@
-"""Drainable replicas and client-side fleet routing.
+"""Drainable replicas and fault-tolerant client-side fleet routing.
 
 One serving process = one :class:`ServingReplica`: an engine plus
 (optionally) a membership seat in a ``resilience.cluster`` pod — the
@@ -24,25 +24,68 @@ table):
    relaunch it (75 means relaunch, 76 means cordon, 0 means the drain
    you asked for completed).
 
-:class:`FleetRouter` is the client half for in-process fleets (tests,
-chaos drivers, single-host multi-engine setups): least-depth dispatch
-with failover on refusal. Across hosts the same logic belongs to any
-load balancer that honors the gateway's 503 — the router documents the
-semantics, it does not replace your LB.
+:class:`FleetRouter` is the fault-tolerant client half (tests, chaos
+drivers, single-host multi-engine setups; across hosts the same logic
+belongs to any LB that honors the gateway's refusal codes — the router
+documents the semantics, it does not replace your LB). Three layers:
+
+- **Health-gated dispatch** — every submit/settle outcome is
+  classified per replica through a :class:`CircuitBreaker`:
+  ``threshold`` consecutive replica failures (crashed engine, wire
+  error, per-try timeout) eject it into ``open`` with capped
+  exponential backoff; after the backoff ONE half-open probe re-admits
+  it (success closes, failure re-opens with a doubled delay). Open
+  replicas are skipped in the dispatch order — never probed more often
+  than the backoff allows — and a replica whose depth can't even be
+  read sorts *last*.
+- **Exactly-once re-dispatch** — ``submit`` returns a
+  :class:`FleetFuture` that owns delivery. When the holding replica
+  crashes (or a ``per_try_timeout`` fires) the request — pure submit
+  args, idempotent by construction, token-identical on any replica
+  under greedy decode — is resubmitted to a survivor with its
+  **remaining deadline budget**, never a reset clock. The future
+  fulfills exactly once (a late original is simply never consumed; a
+  second fulfillment attempt raises, mirroring ``ServeFuture``'s
+  tested double-delivery guard), so a budget-exhausted request fails
+  typed (:class:`~singa_tpu.serving.scheduler.RequestTimeout` → 504)
+  exactly once instead of hanging silently.
+- **Graceful degradation** — a :class:`ShedPolicy` turns sustained
+  ``QueueFull``/``BlockPoolExhausted`` backpressure into typed
+  fast-fail :class:`~singa_tpu.serving.scheduler.RequestShed` errors
+  carrying ``retry_after`` (the gateway's ``Retry-After`` header), and
+  an optional brownout hook steps request cost down
+  (``max_new_tokens``, speculative drafting) before refusing outright.
 """
 
 from __future__ import annotations
 
 import signal
 import threading
+import time
 
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
-from .scheduler import EngineDraining, QueueFull, ServingError
+from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
+                        ReplicaCrashed, RequestShed, RequestTimeout,
+                        ServingError, budget_remaining, deadline_in)
 
 # the drain exit code: intentional, successful, do-not-relaunch — the
 # 0 row of the README's supervisor exit-code contract table
 EXIT_DRAINED = 0
+
+# submit-time refusals that mean "try a healthier replica, this one is
+# ALIVE but won't take the request" — failover fodder, not breaker fodder
+_BACKPRESSURE = (EngineDraining, QueueFull, BlockPoolExhausted)
+# submit-time failures that mean "this REPLICA is broken" — breaker
+# fodder (ConnectionError ⊂ OSError covers real wire deaths and the
+# injected fail_submit fault)
+_REPLICA_FAILURES = (ReplicaCrashed, OSError)
+
+BREAKER_CLOSED = "closed"
+BREAKER_HALF_OPEN = "half_open"
+BREAKER_OPEN = "open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
+                  BREAKER_OPEN: 2}
 
 
 class ServingReplica:
@@ -150,18 +193,321 @@ class ServingReplica:
         return self.drain(timeout=timeout)
 
 
-class FleetRouter:
-    """Least-depth dispatch over in-process replicas with failover on
-    refusal (draining replica / full queue). Raises
-    :class:`~singa_tpu.serving.scheduler.ServingError` only when EVERY
-    replica refused — one live replica absorbs the whole queue."""
+class CircuitBreaker:
+    """Per-replica health gate: ``closed`` → (``threshold`` consecutive
+    failures) → ``open`` for ``backoff × 2^(opens-1)`` seconds (capped)
+    → ONE ``half_open`` probe → ``closed`` on success, back to ``open``
+    with a doubled delay on failure. Any success resets both the
+    failure streak and the backoff ladder.
 
-    def __init__(self, replicas, registry=None):
+    Pure state machine over an injected clock — the router owns
+    locking and metrics; tier-1 tests drive transitions with a fake
+    ``now``."""
+
+    def __init__(self, threshold=3, backoff=0.25, backoff_cap=30.0):
+        self.threshold = max(1, int(threshold))
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.opens = 0              # backoff ladder position
+        self.open_until = 0.0
+        self.probe_inflight = False
+
+    def admits(self, now):
+        """May the router dispatch to this replica right now? True
+        while closed; an open breaker admits exactly ONE probe once
+        its backoff has elapsed (``begin_probe`` must claim it)."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.probe_inflight:
+            return False
+        return self.state == BREAKER_HALF_OPEN or now >= self.open_until
+
+    def begin_probe(self, now):
+        """Claim the single half-open probe slot before dispatching to
+        a non-closed breaker's replica."""
+        self.state = BREAKER_HALF_OPEN
+        self.probe_inflight = True
+
+    def record_success(self, now):
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.opens = 0
+        self.probe_inflight = False
+
+    def record_failure(self, now):
+        """One replica failure (submit OR settle). Returns True when
+        this failure tripped the breaker open."""
+        self.probe_inflight = False
+        self.consecutive_failures += 1
+        if self.state == BREAKER_HALF_OPEN \
+                or self.consecutive_failures >= self.threshold:
+            self.opens += 1
+            delay = min(self.backoff_cap,
+                        self.backoff * (2 ** (self.opens - 1)))
+            self.open_until = now + delay
+            self.state = BREAKER_OPEN
+            return True
+        return False
+
+
+def brownout_shrink_generation(kwargs):
+    """Default brownout hook: halve ``max_new_tokens`` (floor 1).
+    Returns the stepped-down submit kwargs, or ``None`` when there is
+    nothing left to shrink (→ the shed policy refuses instead)."""
+    mnt = int(kwargs.get("max_new_tokens", 16))
+    if mnt <= 1:
+        return None
+    return dict(kwargs, max_new_tokens=max(1, mnt // 2))
+
+
+class ShedPolicy:
+    """Sustained-backpressure detector + typed fast-fail shed.
+
+    Every all-replicas-backpressured submit records one event; once
+    ``threshold`` events land within ``window_s`` seconds the fleet is
+    *sustainedly* overloaded and the router stops queueing into
+    timeouts: the optional ``brownout`` hook (``kwargs → kwargs|None``,
+    e.g. :func:`brownout_shrink_generation`) gets one chance to step
+    the request's cost down; if there is no hook (or it declines) the
+    request fails fast with :class:`RequestShed` carrying
+    ``retry_after`` — the gateway's ``Retry-After`` contract."""
+
+    def __init__(self, window_s=5.0, threshold=8, retry_after=1.0,
+                 brownout=None):
+        self.window_s = float(window_s)
+        self.threshold = max(1, int(threshold))
+        self.retry_after = float(retry_after)
+        self.brownout = brownout
+        self._events = []
+
+    def _trim(self, now):
+        cutoff = now - self.window_s
+        self._events = [t for t in self._events if t >= cutoff]
+
+    def record_backpressure(self, now):
+        self._events.append(now)
+        self._trim(now)
+
+    def sustained(self, now):
+        self._trim(now)
+        return len(self._events) >= self.threshold
+
+    def apply_brownout(self, kwargs):
+        """Stepped-down kwargs, or None (no hook / hook declined)."""
+        if self.brownout is None:
+            return None
+        return self.brownout(dict(kwargs))
+
+
+class FleetFuture:
+    """A fleet-level response slot that OWNS delivery across replica
+    failures. Wraps the current attempt's ``ServeFuture``; crashes,
+    delivered backpressure, and per-try timeouts re-dispatch the
+    request (pure submit args) to a survivor with the **remaining
+    deadline budget** — never a reset clock. Fulfills exactly once: a
+    late result from a superseded attempt is never consumed, and a
+    second fulfillment attempt raises (the ``ServeFuture`` guard,
+    fleet-level).
+
+    ``result(timeout)`` is the drive loop (same surface as
+    ``ServeFuture.result``); ``deliveries`` / ``attempts`` /
+    ``redispatches`` are the chaos-test counters. Like stdlib futures,
+    completion happens inside ``result`` — poll ``done()`` only after
+    some caller has driven it."""
+
+    def __init__(self, router, args, kwargs):
+        self._router = router
+        self._args = tuple(args)
+        self._kwargs = dict(kwargs)
+        # the ONE clock this request lives on: every re-dispatch's
+        # engine-side timeout is derived from this deadline's remainder
+        self._deadline = deadline_in(self._kwargs.get("timeout"),
+                                     now=router._clock())
+        self._flock = threading.Lock()
+        self._drive = threading.Lock()
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self.deliveries = 0
+        self.attempts = 0
+        self.redispatches = 0
+        self._idx = None            # current attempt's replica index
+        self._fut = None            # current attempt's ServeFuture
+
+    # -- exactly-once fulfillment (mirrors ServeFuture) --------------------
+    def _fulfill(self, result=None, error=None):
+        with self._flock:
+            self.deliveries += 1
+            if self._event.is_set():
+                raise RuntimeError(
+                    "double delivery: this request already has a "
+                    "response (exactly-once violation)")
+            self._result = result
+            self._error = error
+            self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def _finish(self):
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    # -- dispatch ----------------------------------------------------------
+    def _first_dispatch(self):
+        self._idx, self._fut = self._router._place(self._args,
+                                                   self._kwargs)
+        self.attempts = 1
+
+    def _redispatch(self, reason, cause):
+        """Place the request on a survivor with the remaining budget,
+        or fulfill a terminal typed error exactly once and raise it."""
+        rt = self._router
+        budget = budget_remaining(self._deadline, rt._clock())
+        if budget is not None and budget <= 0.0:
+            err = RequestTimeout(
+                f"deadline budget exhausted after {self.attempts} "
+                f"attempt(s) (last replica failure: {reason})")
+            err.__cause__ = cause
+            self._fulfill(error=err)
+            raise err
+        if self.redispatches >= rt.max_redispatch:
+            err = ServingError(
+                f"request failed on {self.attempts} replica(s) "
+                f"(re-dispatch limit {rt.max_redispatch} reached; "
+                f"last: {cause})")
+            err.__cause__ = cause
+            self._fulfill(error=err)
+            raise err
+        kwargs = dict(self._kwargs)
+        if budget is not None:
+            # the remainder, NEVER a fresh full timeout: attempt N+1's
+            # engine-side deadline coincides with the original one
+            kwargs["timeout"] = budget
+        try:
+            idx, fut = rt._place(self._args, kwargs,
+                                 exclude=(self._idx,))
+        except ServingError as e:
+            # no survivor could take it — terminal, exactly once
+            self._fulfill(error=e)
+            raise
+        rt._redispatches.inc()
+        ev = {"from_replica": rt._name(self._idx),
+              "to_replica": rt._name(idx), "reason": reason,
+              "attempt": self.attempts + 1}
+        if budget is not None:
+            ev["budget_s"] = round(budget, 4)
+        if self._kwargs.get("trace_id"):
+            ev["request"] = self._kwargs["trace_id"]
+        _spans.event("request.redispatch", **ev)
+        self._idx, self._fut = idx, fut
+        self.attempts += 1
+        self.redispatches += 1
+
+    # -- the drive loop ----------------------------------------------------
+    def result(self, timeout=None):
+        """Block for the response, re-dispatching across replica
+        failures; re-raises the request's (typed) error. ``timeout``
+        bounds THIS caller's wait — the request's own deadline budget
+        (from its ``timeout`` submit kwarg) bounds the retries."""
+        if self._event.is_set():
+            return self._finish()
+        rt = self._router
+        wall = deadline_in(timeout, now=rt._clock())
+        with self._drive:
+            if self._event.is_set():
+                return self._finish()
+            while True:
+                now = rt._clock()
+                budget = budget_remaining(self._deadline, now)
+                caller = budget_remaining(wall, now)
+                wait, why = None, None
+                for w, k in ((budget, "budget"), (caller, "caller"),
+                             (rt.per_try_timeout, "per_try")):
+                    if w is not None and (wait is None or w < wait):
+                        wait, why = w, k
+                try:
+                    res = self._fut.result(timeout=wait)
+                except RequestTimeout as e:
+                    if self._fut.done():
+                        # the ENGINE delivered the timeout: the
+                        # request's own deadline expired server-side —
+                        # the budget is spent, terminal
+                        self._fulfill(error=e)
+                        raise
+                    if why == "caller":
+                        # this caller's patience ran out, not the
+                        # request's budget: still in flight — mirror
+                        # ServeFuture (no fulfillment, call again)
+                        raise
+                    if why == "budget":
+                        err = RequestTimeout(
+                            f"deadline budget exhausted after "
+                            f"{self.attempts} attempt(s) (request "
+                            "still in flight on the last replica)")
+                        self._fulfill(error=err)
+                        raise err
+                    # per-try timeout: the replica is straggling —
+                    # breaker failure + re-dispatch with the remainder
+                    rt._record_failure(self._idx, "per_try_timeout")
+                    self._redispatch("per_try_timeout", e)
+                except _BACKPRESSURE as e:
+                    # DELIVERED backpressure (hard-stopped engine, 503
+                    # from a wire replica): it never served the
+                    # request, so re-dispatch is trivially exactly-once
+                    self._redispatch(type(e).__name__, e)
+                except _REPLICA_FAILURES as e:
+                    # the holding replica died with the request
+                    # admitted (the stranded shape)
+                    rt._record_failure(self._idx, type(e).__name__)
+                    self._redispatch(type(e).__name__, e)
+                except ServingError as e:
+                    # request-shaped failure: it would fail the same
+                    # way on every replica — terminal, exactly once
+                    self._fulfill(error=e)
+                    raise
+                else:
+                    rt._record_success(self._idx)
+                    self._fulfill(result=res)
+                    return res
+
+
+class FleetRouter:
+    """Health-gated least-depth dispatch over in-process replicas with
+    circuit breakers, exactly-once re-dispatch, and load shedding (see
+    module docstring). ``submit`` returns a :class:`FleetFuture`;
+    it raises only when NO admitted replica accepted the request —
+    typed :class:`RequestShed` under a sustained-backpressure shed,
+    plain ``ServingError`` otherwise.
+
+    ``per_try_timeout`` (seconds, default None=off) bounds ONE
+    replica's attempt; a request whose deadline budget still has
+    remainder when it fires is re-dispatched to a survivor with that
+    remainder. ``max_redispatch`` caps re-dispatches per request."""
+
+    def __init__(self, replicas, registry=None, *,
+                 breaker_threshold=3, breaker_backoff=0.25,
+                 breaker_backoff_cap=30.0, per_try_timeout=None,
+                 max_redispatch=2, shed_policy=None, clock=None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = list(replicas)
+        self.per_try_timeout = per_try_timeout if per_try_timeout \
+            is None else float(per_try_timeout)
+        self.max_redispatch = int(max_redispatch)
+        self.shed_policy = shed_policy
+        self._clock = clock if clock is not None else time.monotonic
+        self._blk = threading.Lock()
+        self._breakers = [CircuitBreaker(breaker_threshold,
+                                         breaker_backoff,
+                                         breaker_backoff_cap)
+                          for _ in self.replicas]
         reg = registry if registry is not None \
             else _metrics.default_registry()
+        self._reg = reg
         self._submitted = reg.counter(
             "serve_fleet_submitted_total",
             "requests the router placed on some replica")
@@ -170,7 +516,37 @@ class FleetRouter:
             "submissions that had to skip a refusing replica")
         self._rejected = reg.counter(
             "serve_fleet_rejected_total",
-            "submissions every replica refused")
+            "submissions every admitted replica refused")
+        self._redispatches = reg.counter(
+            "serve_fleet_redispatch_total",
+            "requests re-dispatched to a survivor after a replica "
+            "crash / delivered backpressure / per-try timeout")
+        self._sheds = reg.counter(
+            "serve_fleet_shed_total",
+            "requests fast-failed by the shed policy under sustained "
+            "backpressure (typed RequestShed, Retry-After at the "
+            "gateway)")
+        self._brownouts = reg.counter(
+            "serve_fleet_brownout_total",
+            "requests stepped down by the shed policy's brownout hook "
+            "instead of being refused")
+        self._breaker_opens = reg.counter(
+            "serve_fleet_breaker_open_total",
+            "circuit-breaker trips (replica ejected from dispatch)",
+            labels=("replica",))
+        self._probes = reg.counter(
+            "serve_fleet_probe_total",
+            "half-open breaker probes dispatched",
+            labels=("replica",))
+        self._breaker_state = reg.gauge(
+            "serve_fleet_breaker_state",
+            "per-replica breaker state: 0=closed 1=half_open 2=open",
+            labels=("replica",))
+        for i in range(len(self.replicas)):
+            self._breaker_state.set(0, replica=self._name(i))
+
+    def _name(self, idx):
+        return getattr(self.replicas[idx], "name", None) or str(idx)
 
     @staticmethod
     def _depth(r):
@@ -179,41 +555,185 @@ class FleetRouter:
                 else len(r.engine.queue) if hasattr(r, "engine") \
                 else len(r.queue)
         except Exception:       # noqa: BLE001 — routing hint only
-            return 0
+            # unreadable depth = suspect replica: sort it LAST (0 would
+            # make the sickest replica the most attractive target)
+            return float("inf")
 
-    def submit(self, *args, **kwargs):
-        order = sorted(self.replicas,
-                       key=lambda r: (bool(r.draining), self._depth(r)))
+    # -- breaker bookkeeping (all under _blk) ------------------------------
+    def _set_state_gauge(self, idx):
+        self._breaker_state.set(
+            _BREAKER_GAUGE[self._breakers[idx].state],
+            replica=self._name(idx))
+
+    def _record_success(self, idx):
+        with self._blk:
+            self._breakers[idx].record_success(self._clock())
+            self._set_state_gauge(idx)
+
+    def _record_failure(self, idx, reason):
+        with self._blk:
+            br = self._breakers[idx]
+            opened = br.record_failure(self._clock())
+            self._set_state_gauge(idx)
+        if opened:
+            self._breaker_opens.inc(replica=self._name(idx))
+            _spans.event("replica.breaker_open",
+                         replica=self._name(idx), reason=reason,
+                         consecutive=br.consecutive_failures,
+                         backoff_s=round(br.open_until
+                                         - self._clock(), 4))
+
+    def breaker_states(self):
+        """{replica name: breaker state} — /healthz fodder."""
+        with self._blk:
+            return {self._name(i): br.state
+                    for i, br in enumerate(self._breakers)}
+
+    # -- placement ---------------------------------------------------------
+    def _order(self, now, exclude=()):
+        """Breaker-admitted replicas, least-depth first, draining
+        last; open-but-probe-due replicas carry probing=True."""
+        out = []
+        with self._blk:
+            for i, r in enumerate(self.replicas):
+                if i in exclude:
+                    continue
+                br = self._breakers[i]
+                if not br.admits(now):
+                    continue
+                out.append((bool(r.draining), self._depth(r), i,
+                            br.state != BREAKER_CLOSED))
+        out.sort(key=lambda t: t[:3])
+        return [(i, probing) for _d, _q, i, probing in out]
+
+    def _place(self, args, kwargs, exclude=()):
+        """One placement pass: try each admitted replica in order.
+        Returns ``(idx, serve_future)``; raises typed when nobody took
+        the request (RequestShed under a sustained-backpressure shed)."""
+        now = self._clock()
         last_exc = None
-        for r in order:
+        saw_replica_failure = False
+        order = self._order(now, exclude)
+        for idx, probing in order:
+            r = self.replicas[idx]
+            if probing:
+                with self._blk:
+                    self._breakers[idx].begin_probe(now)
+                    self._set_state_gauge(idx)
+                self._probes.inc(replica=self._name(idx))
             try:
                 fut = r.submit(*args, **kwargs)
-            except (EngineDraining, QueueFull) as e:
+            except _BACKPRESSURE as e:
+                # alive but refusing: failover fodder (and a probe
+                # SUCCESS — the replica answered), plus shed evidence
                 last_exc = e
                 self._failovers.inc()
-                # the failover joins the request's timeline: a traced
-                # request shows WHICH replica refused it and why
-                ev = {"replica": getattr(r, "name", None),
-                      "reason": type(e).__name__}
-                if kwargs.get("trace_id"):
-                    ev["request"] = kwargs["trace_id"]
-                _spans.event("request.failover", **ev)
+                if probing:
+                    self._record_success(idx)
+                if self.shed_policy is not None and \
+                        not isinstance(e, EngineDraining):
+                    self.shed_policy.record_backpressure(now)
+                self._failover_event(r, e, kwargs)
                 continue
+            except _REPLICA_FAILURES as e:
+                # crashed engine / wire death: breaker fodder — one
+                # dead replica must never kill routing while survivors
+                # exist
+                last_exc = e
+                saw_replica_failure = True
+                self._failovers.inc()
+                self._record_failure(idx, type(e).__name__)
+                self._failover_event(r, e, kwargs)
+                continue
+            except BaseException:
+                # request-shaped refusal (bad params, prompt too long):
+                # the REPLICA answered — release a claimed probe slot
+                # before the error propagates to the caller
+                if probing:
+                    self._record_success(idx)
+                raise
             self._submitted.inc()
-            return fut
+            if probing:
+                self._record_success(idx)
+            return idx, fut
+        if not order:
+            last_exc = last_exc or ServingError(
+                "every replica is ejected (breaker open) or excluded")
+        if not saw_replica_failure and self.shed_policy is not None \
+                and self.shed_policy.sustained(now):
+            self._sheds.inc()
+            raise RequestShed(
+                f"fleet shedding load: sustained backpressure across "
+                f"all {len(self.replicas)} replicas (last: "
+                f"{last_exc}); retry after "
+                f"{self.shed_policy.retry_after}s",
+                retry_after=self.shed_policy.retry_after)
         self._rejected.inc()
         raise ServingError(
             f"all {len(self.replicas)} replicas refused the request "
             f"(last: {last_exc})")
+
+    @staticmethod
+    def _failover_event(r, e, kwargs):
+        # the failover joins the request's timeline: a traced request
+        # shows WHICH replica refused it and why
+        ev = {"replica": getattr(r, "name", None),
+              "reason": type(e).__name__}
+        if kwargs.get("trace_id"):
+            ev["request"] = kwargs["trace_id"]
+        _spans.event("request.failover", **ev)
+
+    # -- public surface ----------------------------------------------------
+    def submit(self, *args, **kwargs):
+        """Place one request; returns a :class:`FleetFuture` (same
+        ``result(timeout)`` / ``deliveries`` surface as
+        ``ServeFuture``). Under a sustained shed the brownout hook gets
+        one chance to step the request down before a typed
+        :class:`RequestShed` refusal."""
+        if self.shed_policy is not None \
+                and self.shed_policy.sustained(self._clock()):
+            stepped = self.shed_policy.apply_brownout(kwargs)
+            if stepped is None:
+                self._sheds.inc()
+                raise RequestShed(
+                    "fleet shedding load: sustained backpressure "
+                    f"(window {self.shed_policy.window_s}s); retry "
+                    f"after {self.shed_policy.retry_after}s",
+                    retry_after=self.shed_policy.retry_after)
+            if stepped != kwargs:
+                self._brownouts.inc()
+            kwargs = stepped
+        fut = FleetFuture(self, args, kwargs)
+        fut._first_dispatch()
+        return fut
 
     def drain_replica(self, idx, timeout=60.0):
         """Drain ONE replica (rolling-restart building block); the
         router's failover routes everything new to the survivors."""
         return self.replicas[idx].drain(timeout=timeout)
 
+    def drain(self, timeout=60.0):
+        """Drain every replica (the fleet-front gateway's POST /drain
+        body). Returns True when all drains were clean."""
+        return all(r.drain(timeout=timeout) == EXIT_DRAINED
+                   for r in self.replicas)
+
+    @property
+    def draining(self):
+        return all(bool(getattr(r, "draining", False))
+                   for r in self.replicas)
+
     def health(self):
-        return [r.health() if hasattr(r, "health") else None
+        docs = [r.health() if hasattr(r, "health") else None
                 for r in self.replicas]
+        states = self.breaker_states()
+        for i, doc in enumerate(docs):
+            if isinstance(doc, dict):
+                doc["breaker"] = states.get(self._name(i))
+        return docs
 
 
-__all__ = ["ServingReplica", "FleetRouter", "EXIT_DRAINED"]
+__all__ = ["ServingReplica", "FleetRouter", "FleetFuture",
+           "CircuitBreaker", "ShedPolicy",
+           "brownout_shrink_generation", "EXIT_DRAINED",
+           "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN"]

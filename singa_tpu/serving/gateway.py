@@ -35,10 +35,16 @@ fresh hex id), passed to the engine as its trace id and echoed in the
 response — the handle that finds this request's lane in
 ``/trace.json``.
 
-Refusal mapping: draining/full queue → 503 (fail over), request
-deadline → 504, malformed request → 400, serve-loop crash → 500.
-Handler threads are non-daemon and joined at ``server_close()``, so a
-drained process never exits with a response half-written.
+Refusal mapping: draining/full queue/exhausted block pool → 503 (fail
+over), shed under sustained backpressure → 503 with a ``Retry-After``
+header (back off, don't hammer), request deadline → 504, malformed
+request → 400, oversized/undeclared body → 413 (refused before a byte
+is read), serve-loop crash → 500. Every generate/predict request
+lives on ONE deadline: the engine-side timeout and the handler's wait
+derive from the same clock, so a fleet retry inherits the true
+remaining budget. Handler threads are non-daemon and joined at
+``server_close()``, so a drained process never exits with a response
+half-written.
 """
 
 from __future__ import annotations
@@ -47,8 +53,9 @@ import json
 import threading
 import uuid
 
-from .scheduler import (EngineDraining, QueueFull, RequestTimeout,
-                        ServingError)
+from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
+                        ReplicaCrashed, RequestShed, RequestTimeout,
+                        ServingError, budget_remaining, deadline_in)
 
 
 def _result_doc(res):
@@ -61,21 +68,37 @@ def _result_doc(res):
 
 
 def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
-                  default_timeout=120.0):
+                  default_timeout=120.0, max_body_bytes=8 << 20):
     """Start the gateway on a daemon thread. Returns ``(server, port)``;
     ``server.shutdown(); server.server_close()`` stops it (close joins
     in-flight handler threads). ``replica`` (a
     :class:`~singa_tpu.serving.fleet.ServingReplica`) upgrades
     ``/healthz`` to the full replica view and routes ``/drain`` through
-    the replica's drain contract. Binds localhost by default — put a
-    real LB/mesh in front for anything public."""
+    the replica's drain contract. ``engine`` may also be a
+    :class:`~singa_tpu.serving.fleet.FleetRouter` — a fleet-front
+    gateway: ``/healthz`` lists every replica (200 while at least one
+    serves), ``/drain`` drains them all, and requests ride the
+    router's breaker/re-dispatch/shed machinery. POST bodies larger
+    than ``max_body_bytes`` (or with a missing/garbage
+    ``Content-Length``) are refused 413 before a byte is read — the
+    gateway never buffers unbounded input. Binds localhost by
+    default — put a real LB/mesh in front for anything public."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from ..observability.export import render_prometheus
 
+    is_fleet = hasattr(engine, "replicas")
+
     def health_doc():
         if replica is not None:
             return replica.health()
+        if is_fleet:
+            docs = engine.health()
+            n_ok = sum(1 for d in docs if isinstance(d, dict)
+                       and d.get("status") == "serving")
+            return {"status": "serving" if n_ok else "unavailable",
+                    "replicas": docs,
+                    "breakers": engine.breaker_states()}
         return {"status": ("crashed" if engine._crashed is not None
                            else "draining" if engine.draining
                            else "serving"),
@@ -94,10 +117,12 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def _reply(self, code, doc):
+        def _reply(self, code, doc, headers=()):
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            for k, v in headers:
+                self.send_header(k, v)
             self.send_header("Content-Length", str(len(body)))
             # one request per connection: keep-alive would park handler
             # threads in a blocking read, and server_close() JOINS
@@ -167,8 +192,27 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                     pass
 
         def do_POST(self):      # noqa: N802 — stdlib API
+            # body cap BEFORE any read: a missing or garbage
+            # Content-Length means "read until the peer hangs up" —
+            # unbounded — and an honest oversized one is refused by
+            # the declared size alone (never buffered then rejected)
+            raw_len = self.headers.get("Content-Length")
             try:
-                n = int(self.headers.get("Content-Length") or 0)
+                n = int(raw_len)
+                if n < 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                self._reply(413, {
+                    "error": f"missing or unparseable Content-Length "
+                             f"{raw_len!r}: the gateway reads exactly "
+                             "the declared bytes"})
+                return
+            if n > max_body_bytes:
+                self._reply(413, {
+                    "error": f"request body of {n} bytes exceeds the "
+                             f"gateway limit of {max_body_bytes}"})
+                return
+            try:
                 raw = self.rfile.read(n) if n else b"{}"
                 body = json.loads(raw.decode() or "{}")
             except Exception:
@@ -185,10 +229,23 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                     self._predict(body)
                 else:
                     self._reply(404, {"error": "unknown path"})
-            except (EngineDraining, QueueFull) as e:
+            except RequestShed as e:
+                # typed fast-fail shed: Retry-After is the contract —
+                # the client backs off instead of hammering an
+                # overloaded fleet into timeouts
+                self._reply(503, self._err(
+                    e, retryable=True, retry_after=e.retry_after),
+                    headers=(("Retry-After",
+                              str(max(1, int(e.retry_after)))),))
+            except (EngineDraining, QueueFull,
+                    BlockPoolExhausted) as e:
                 self._reply(503, self._err(e, retryable=True))
             except RequestTimeout as e:
                 self._reply(504, self._err(e))
+            except ReplicaCrashed as e:
+                # serve-loop crash → 500 (the docstring's refusal map);
+                # still retryable — a fleet LB fails over on it
+                self._reply(500, self._err(e, retryable=True))
             except (ServingError, ValueError, TypeError) as e:
                 self._reply(400, self._err(e))
             except Exception as e:   # noqa: BLE001 — crash → 500, once
@@ -220,11 +277,17 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
             kw = {k: body[k] for k in ("max_new_tokens", "temperature",
                                        "top_k", "eos_id", "seed",
                                        "timeout") if k in body}
+            # ONE deadline: the engine-side timeout and this handler's
+            # wait are the same clock (started here), so a fleet
+            # retry inherits the true remainder and the 504 fires in
+            # lockstep with the request's own expiry
             wait = float(kw["timeout"]) \
                 if kw.get("timeout") is not None else default_timeout
+            deadline = deadline_in(wait)
+            kw["timeout"] = wait
             rid = self._rid = self._mint_rid(body)
             fut = engine.submit(prompt, trace_id=rid, **kw)
-            doc = fut.result(timeout=wait)
+            doc = fut.result(timeout=budget_remaining(deadline))
             if isinstance(doc, dict):
                 doc = dict(doc, request_id=rid)
             self._reply(200, doc)
@@ -234,11 +297,12 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                 raise ValueError("predict needs 'input'")
             wait = float(body["timeout"]) \
                 if body.get("timeout") is not None else default_timeout
+            deadline = deadline_in(wait)
             rid = self._rid = self._mint_rid(body)
-            fut = engine.submit(body["input"],
-                                timeout=body.get("timeout"),
+            fut = engine.submit(body["input"], timeout=wait,
                                 trace_id=rid)
-            doc = _result_doc(fut.result(timeout=wait))
+            doc = _result_doc(fut.result(
+                timeout=budget_remaining(deadline)))
             self._reply(200, dict(doc, request_id=rid))
 
         def log_message(self, *a):   # silence per-request stderr spam

@@ -48,6 +48,48 @@ class RequestTimeout(ServingError):
     """The request's deadline passed before a response completed."""
 
 
+class ReplicaCrashed(ServingError):
+    """The replica that held this request died (serve-loop crash, wire
+    failure, or a crashed engine refusing at the door). Unlike
+    backpressure refusals this is a REPLICA failure, not a request
+    failure: the request itself is pure submit args + a fresh id, so a
+    fleet router may re-dispatch it to a survivor exactly once —
+    deterministic greedy decode makes the retried response
+    token-identical to the one the dead replica would have produced."""
+
+
+class RequestShed(ServingError):
+    """The fleet refused this request on purpose: sustained
+    backpressure (QueueFull / BlockPoolExhausted across every admitted
+    replica) tripped the shed policy. Fast-fail, typed, with a
+    ``retry_after`` hint the gateway turns into a ``Retry-After``
+    header — degrading loudly beats queueing into a timeout."""
+
+    def __init__(self, message, retry_after=1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+def deadline_in(timeout, now=None):
+    """Monotonic deadline for a timeout budget; ``None`` timeout means
+    no deadline. The single clock a request lives on: the gateway and
+    the fleet router both derive engine-side timeouts AND client-side
+    waits from one of these, so a retry inherits the true remainder."""
+    if timeout is None:
+        return None
+    return (now if now is not None else time.monotonic()) \
+        + float(timeout)
+
+
+def budget_remaining(deadline, now=None):
+    """Seconds left until ``deadline``, floored at 0.0 (``None``
+    deadline → ``None``: unlimited)."""
+    if deadline is None:
+        return None
+    return max(0.0, deadline - (now if now is not None
+                                else time.monotonic()))
+
+
 class BlockPoolExhausted(ServingError):
     """Admission refused: the paged KV block pool cannot cover the
     request's ``prompt + max_new_tokens`` reservation without evicting
@@ -232,5 +274,6 @@ class RequestQueue:
 
 
 __all__ = ["ServingError", "QueueFull", "EngineDraining",
-           "RequestTimeout", "BlockPoolExhausted", "ServeFuture",
-           "Request", "RequestQueue"]
+           "RequestTimeout", "ReplicaCrashed", "RequestShed",
+           "BlockPoolExhausted", "ServeFuture", "Request",
+           "RequestQueue", "deadline_in", "budget_remaining"]

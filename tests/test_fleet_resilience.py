@@ -1,0 +1,594 @@
+"""Fleet fault-tolerance matrix (CPU, fast tier): circuit-breaker
+routing, deadline-budgeted exactly-once re-dispatch, and load
+shedding.
+
+- breaker transitions: closed → open after N consecutive failures
+  (capped exponential backoff), skipped while open, ONE half-open
+  probe re-admits (success closes and resets the ladder, failure
+  re-opens with a doubled delay) — driven with a fake clock, so the
+  cadence assertions are exact, not sleep-flaky;
+- a crashed replica never kills routing while a survivor exists (the
+  fleet.py:191 regression), and an unreadable queue depth sorts a
+  replica LAST (the ``_depth`` → 0 regression);
+- exactly-once re-dispatch: a crash-after-admit strands the request,
+  the survivor's re-run is token-identical to an uninterrupted greedy
+  run, and the late-original/double-delivery guard raises;
+- retries never reset the clock: the re-dispatched attempt carries the
+  REMAINING deadline budget, and a budget-exhausted request fails
+  typed (``RequestTimeout`` → the gateway's 504) exactly once — never
+  a silent hang;
+- sustained backpressure sheds typed (``RequestShed`` + retry_after,
+  the gateway's ``Retry-After`` header) with an optional brownout
+  step-down first;
+- gateway contracts: 413 body cap (missing/garbage/oversized
+  Content-Length), one deadline for submit + wait, fleet-front
+  ``/healthz``, and the breaker/re-dispatch/shed counters riding
+  ``heartbeat_summary``.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device
+from singa_tpu.models import transformer
+from singa_tpu.observability import metrics as obs_metrics
+from singa_tpu.resilience.faults import FaultPlan
+from singa_tpu.serving import (BlockPoolExhausted, EngineDraining,
+                               FleetRouter, QueueFull, ReplicaCrashed,
+                               Request, RequestShed, RequestTimeout,
+                               ServeFuture, ServingError,
+                               ServingReplica, serve_gateway)
+from singa_tpu.serving.fleet import (CircuitBreaker, ShedPolicy,
+                                     brownout_shrink_generation)
+from singa_tpu.serving.scheduler import budget_remaining, deadline_in
+from singa_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.serving
+
+DEV = device.create_cpu_device()
+
+
+def _reg():
+    return obs_metrics.MetricsRegistry()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    np.random.seed(0)
+    m = transformer.TransformerLM(19, d_model=16, n_heads=2,
+                                  n_layers=2, max_len=64, tp=False)
+    m.eval()
+    m(Tensor(data=np.zeros((1, 4), np.float32), device=DEV,
+             requires_grad=False))
+    return m
+
+
+def _engine(lm, **kw):
+    kw.setdefault("registry", _reg())
+    return lm.compile_serving(slots=2, max_len=32, prefill_len=8,
+                              **kw)
+
+
+class _FakeReplica:
+    """Replica stand-in with programmable submit behavior — the router
+    mechanics (breakers, budgets, sheds) are host-side and must be
+    testable without compiling an engine."""
+
+    def __init__(self, name, behavior="ok", depth=0):
+        self.name = name
+        self.draining = False
+        self.behavior = behavior
+        self.depth = depth
+        self.calls = 0
+        self.last_kwargs = None
+        self.futures = []
+
+    def queue_depth(self):
+        if self.depth == "raise":
+            raise RuntimeError("queue unreadable")
+        return self.depth
+
+    def submit(self, *args, **kwargs):
+        self.calls += 1
+        self.last_kwargs = dict(kwargs)
+        if self.behavior == "crashed":
+            raise ReplicaCrashed("engine crashed (boom)")
+        if self.behavior == "wire":
+            raise ConnectionError("wire down")
+        if self.behavior == "full":
+            raise QueueFull("request queue at capacity")
+        fut = ServeFuture()
+        self.futures.append(fut)
+        if self.behavior == "ok":
+            fut.set_result({"tokens": [1, 2, 3], "prompt_len": 1,
+                            "ttft_s": 0.0})
+        return fut     # "blackhole": admitted, never fulfilled
+
+    def health(self):
+        return {"name": self.name, "status": "serving"}
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def test_open_halfopen_close_transitions_and_backoff_ladder(self):
+        br = CircuitBreaker(threshold=2, backoff=0.5, backoff_cap=8.0)
+        assert br.state == "closed" and br.admits(0.0)
+        assert br.record_failure(0.0) is False
+        assert br.state == "closed"          # below threshold
+        assert br.record_failure(0.0) is True
+        assert br.state == "open" and br.open_until == 0.5
+        assert not br.admits(0.4)
+        assert br.admits(0.6)                # backoff elapsed: ONE probe
+        br.begin_probe(0.6)
+        assert br.state == "half_open"
+        assert not br.admits(0.6)            # probe slot is claimed
+        br.record_failure(0.6)               # probe failed: doubled delay
+        assert br.state == "open"
+        assert br.open_until == pytest.approx(0.6 + 1.0)
+        br.begin_probe(2.0)
+        br.record_success(2.0)               # probe landed: re-admitted
+        assert br.state == "closed"
+        assert br.opens == 0 and br.consecutive_failures == 0
+
+    def test_backoff_is_capped(self):
+        br = CircuitBreaker(threshold=1, backoff=1.0, backoff_cap=4.0)
+        for _ in range(10):
+            br.record_failure(0.0)
+        assert br.open_until == 4.0          # never past the cap
+
+
+class TestDeadlineBudget:
+    def test_helpers(self):
+        assert deadline_in(None) is None
+        assert budget_remaining(None) is None
+        d = deadline_in(2.0, now=10.0)
+        assert d == 12.0
+        assert budget_remaining(d, now=10.5) == pytest.approx(1.5)
+        assert budget_remaining(d, now=99.0) == 0.0   # floored
+        assert deadline_in(0.0, now=3.0) == 3.0       # 0 = already due
+
+
+class TestBreakerRouting:
+    def test_crashed_replica_ejected_and_probed_on_backoff_only(self):
+        """The tentpole cadence contract: 3 consecutive failures eject
+        the replica; while open it receives ZERO traffic; after the
+        backoff exactly ONE probe; a failed probe doubles the delay; a
+        successful probe re-admits it."""
+        clk = _FakeClock()
+        r0, r1 = _FakeReplica("r0", "crashed"), _FakeReplica("r1")
+        reg = _reg()
+        rt = FleetRouter([r0, r1], registry=reg, breaker_threshold=3,
+                         breaker_backoff=0.5, clock=clk)
+        for _ in range(8):
+            f = rt.submit([1], max_new_tokens=4)
+            assert f.result(timeout=1)["tokens"] == [1, 2, 3]
+            assert f.deliveries == 1
+        # first 3 submits hit r0 (and fail over); then the breaker
+        # opens and r0 is SKIPPED — not poisoned-through
+        assert r0.calls == 3
+        assert rt.breaker_states() == {"r0": "open", "r1": "closed"}
+        assert reg.get("serve_fleet_breaker_state").value(
+            replica="r0") == 2
+        assert reg.get("serve_fleet_breaker_open_total").total() == 1
+        # backoff elapsed: exactly ONE half-open probe, which fails
+        clk.t = 0.6
+        rt.submit([1]).result(timeout=1)
+        assert r0.calls == 4
+        assert reg.get("serve_fleet_probe_total").total() == 1
+        # doubled backoff: no second probe until it elapses
+        rt.submit([1]).result(timeout=1)
+        assert r0.calls == 4
+        # replica recovers; the next due probe closes the breaker
+        r0.behavior = "ok"
+        clk.t = 2.5
+        rt.submit([1]).result(timeout=1)
+        assert r0.calls == 5
+        assert rt.breaker_states()["r0"] == "closed"
+        assert reg.get("serve_fleet_breaker_state").value(
+            replica="r0") == 0
+
+    def test_wire_error_counts_toward_breaker(self):
+        clk = _FakeClock()
+        r0, r1 = _FakeReplica("r0", "wire"), _FakeReplica("r1")
+        rt = FleetRouter([r0, r1], registry=_reg(),
+                         breaker_threshold=2, clock=clk)
+        for _ in range(5):
+            rt.submit([1]).result(timeout=1)
+        assert r0.calls == 2
+        assert rt.breaker_states()["r0"] == "open"
+
+    def test_unreadable_depth_sorts_last_not_first(self):
+        """The _depth regression: a replica whose queue can't be read
+        must sort LAST — returning 0 made the sickest replica the most
+        attractive target."""
+        assert FleetRouter._depth(_FakeReplica("x", depth="raise")) \
+            == float("inf")
+        bad = _FakeReplica("bad", depth="raise")
+        ok = _FakeReplica("ok", depth=7)     # busy but readable
+        rt = FleetRouter([bad, ok], registry=_reg())
+        rt.submit([1]).result(timeout=1)
+        assert ok.calls == 1 and bad.calls == 0
+
+    def test_crashed_engine_failover_regression(self, lm, tmp_path):
+        """fleet.py:191 regression, with REAL engines: one crashed
+        replica raises ReplicaCrashed (a ServingError subclass the old
+        failover clause let through) — routing must survive while the
+        healthy replica has capacity."""
+        e0 = _engine(lm, telemetry_dir=str(tmp_path))
+        e1 = _engine(lm)
+        e0._crash(RuntimeError("boom"))
+        with pytest.raises(ReplicaCrashed, match="crashed"):
+            e0.submit([1, 2], max_new_tokens=2)
+        rt = FleetRouter(
+            [ServingReplica(e0, name="r0", registry=_reg()),
+             ServingReplica(e1, name="r1", registry=_reg())],
+            registry=_reg())
+        futs = [rt.submit([1, 2, 3], max_new_tokens=3,
+                          temperature=0.0) for _ in range(4)]
+        e1.run_until_idle()
+        for f in futs:
+            assert len(f.result(timeout=10)["tokens"]) == 3
+            assert f.deliveries == 1
+
+    def test_injected_submit_wire_fault_fails_over(self, lm):
+        """resilience/faults.py fleet fault point: the submit RPC dies
+        on the wire (ConnectionError) before the engine sees it; the
+        router classifies it as a replica failure and fails over."""
+        plan = FaultPlan().fail_submit(1, times=3)
+        e0 = _engine(lm, faults=plan)
+        e1 = _engine(lm)
+        rt = FleetRouter(
+            [ServingReplica(e0, name="r0", registry=_reg()),
+             ServingReplica(e1, name="r1", registry=_reg())],
+            registry=_reg(), breaker_threshold=5)
+        f = rt.submit([1, 2], max_new_tokens=2, temperature=0.0)
+        e1.run_until_idle()
+        assert len(f.result(timeout=10)["tokens"]) == 2
+        assert [k for _s, k in plan.fired] == ["submit_wire"]
+        assert e0._submit_seq == 1 and e1._submit_seq == 1
+
+
+class TestExactlyOnceRedispatch:
+    def test_redispatch_token_identity_vs_uninterrupted_run(
+            self, lm, tmp_path):
+        """THE acceptance invariant: a crash-after-admit strands the
+        request on replica 0; the survivor's re-run produces tokens
+        bitwise identical to an uninterrupted greedy run (same
+        weights, deterministic decode) — and delivery happens exactly
+        once."""
+        plan = FaultPlan()
+        e0 = _engine(lm, faults=plan, telemetry_dir=str(tmp_path))
+        e1 = _engine(lm)
+        prompt = [1, 2, 3, 4]
+        ref = e1.submit(prompt, max_new_tokens=6, temperature=0.0)
+        e1.run_until_idle()
+        ref_tokens = ref.result(timeout=10)["tokens"]
+        assert len(ref_tokens) == 6
+        e1.start()
+        reg = _reg()
+        rt = FleetRouter(
+            [ServingReplica(e0, name="r0", registry=_reg()),
+             ServingReplica(e1, name="r1", registry=_reg())],
+            registry=reg)
+        plan.crash_after_admit(next(Request._ids) + 1)
+        f = rt.submit(prompt, max_new_tokens=6, temperature=0.0,
+                      timeout=30)
+        res = f.result(timeout=30)
+        assert res["tokens"] == ref_tokens
+        assert f.deliveries == 1
+        assert f.attempts == 2 and f.redispatches == 1
+        assert reg.get("serve_fleet_redispatch_total").total() == 1
+        # the dead replica counted its stranded request
+        assert e0._reg.get(
+            "serve_stranded_requests_total").total() == 1
+        e1.stop()
+
+    def test_budget_exhausted_fails_typed_504_exactly_once(self):
+        """Retries never reset the clock: the re-dispatched attempt
+        carries the REMAINING budget, and when it runs out the request
+        fails RequestTimeout (the gateway's 504) exactly once — not a
+        silent hang, not a fresh 120s."""
+        b0 = _FakeReplica("b0", "blackhole")
+        b1 = _FakeReplica("b1", "blackhole")
+        rt = FleetRouter([b0, b1], registry=_reg(),
+                         per_try_timeout=0.08)
+        f = rt.submit([1], timeout=0.12)
+        t0 = time.monotonic()
+        with pytest.raises(RequestTimeout, match="budget exhausted"):
+            f.result()
+        took = time.monotonic() - t0
+        assert took < 1.0                    # bounded by the budget
+        assert f.done() and f.deliveries == 1
+        # the second attempt inherited the REMAINDER, not a reset clock
+        assert b1.calls == 1
+        assert 0.0 < b1.last_kwargs["timeout"] < 0.12 - 0.08 + 0.02
+        # exactly once: a second result() re-raises, no new delivery
+        with pytest.raises(RequestTimeout):
+            f.result()
+        assert f.deliveries == 1
+
+    def test_slow_replica_second_attempt_under_remainder(self, lm):
+        """Acceptance: an injected slow-replica fault fires the
+        per-try timeout; the survivor's attempt runs under the
+        ORIGINAL deadline's remainder and completes well inside it."""
+        plan = FaultPlan().slow_replica(0, seconds=4.0, times=1)
+        e0 = _engine(lm, faults=plan)
+        e1 = _engine(lm)
+
+        class _Recorder(ServingReplica):
+            def submit(self, *a, **kw):
+                self.seen = dict(kw)
+                return super().submit(*a, **kw)
+
+        r1 = _Recorder(e1, name="r1", registry=_reg())
+        e0.start()
+        e1.start()
+        # warm the survivor so the re-dispatched attempt measures
+        # decode speed, not first-request compile time
+        e1.submit([1], max_new_tokens=1,
+                  temperature=0.0).result(timeout=60)
+        rt = FleetRouter(
+            [ServingReplica(e0, name="r0", registry=_reg()), r1],
+            registry=_reg(), per_try_timeout=2.0)
+        t0 = time.monotonic()
+        f = rt.submit([1, 2, 3], max_new_tokens=4, temperature=0.0,
+                      timeout=30.0)
+        res = f.result(timeout=30)
+        took = time.monotonic() - t0
+        assert len(res["tokens"]) == 4 and f.redispatches == 1
+        assert took < 30.0
+        assert 0.0 < r1.seen["timeout"] < 30.0 - 2.0 + 0.1
+        e0.stop()
+        e1.stop()
+
+    def test_double_delivery_raises_on_late_original(self):
+        """The once-guard, fleet-level: after the future fulfilled, a
+        second fulfillment attempt raises (mirrors ServeFuture's
+        tested guard) — a late original can never overwrite the
+        survivor's response."""
+        r = _FakeReplica("r")
+        rt = FleetRouter([r], registry=_reg())
+        f = rt.submit([1])
+        assert f.result(timeout=1)["tokens"] == [1, 2, 3]
+        with pytest.raises(RuntimeError, match="double delivery"):
+            f._fulfill(result={"tokens": [9]})
+        assert f.result(timeout=1)["tokens"] == [1, 2, 3]
+
+    def test_delivered_backpressure_is_redispatched(self):
+        """An error DELIVERED through the future that means 'never
+        served' (hard-stopped engine → EngineDraining) re-dispatches
+        instead of failing the caller."""
+        h0 = _FakeReplica("h0", "blackhole")
+        r1 = _FakeReplica("r1")
+        rt = FleetRouter([h0, r1], registry=_reg())
+        f = rt.submit([1], timeout=10)
+        h0.futures[0].set_error(EngineDraining("engine stopped"))
+        assert f.result(timeout=5)["tokens"] == [1, 2, 3]
+        assert f.redispatches == 1 and f.deliveries == 1
+
+
+class TestShedPolicy:
+    def test_sustained_backpressure_sheds_typed_and_fast(self):
+        clk = _FakeClock()
+        shed = ShedPolicy(window_s=30.0, threshold=3, retry_after=2.5)
+        f0 = _FakeReplica("f0", "full")
+        f1 = _FakeReplica("f1", "full")
+        reg = _reg()
+        rt = FleetRouter([f0, f1], registry=reg, shed_policy=shed,
+                         clock=clk)
+        # below the threshold: the all-refused error stays plain
+        with pytest.raises(ServingError) as ei:
+            rt.submit([1])
+        assert not isinstance(ei.value, RequestShed)
+        # this pass crosses the threshold → typed shed w/ retry_after
+        with pytest.raises(RequestShed) as ei:
+            rt.submit([1])
+        assert ei.value.retry_after == 2.5
+        # sustained: fast-fail at the door — no replica is touched
+        calls = f0.calls + f1.calls
+        with pytest.raises(RequestShed):
+            rt.submit([1])
+        assert f0.calls + f1.calls == calls
+        assert reg.get("serve_fleet_shed_total").total() == 2
+
+    def test_brownout_steps_down_before_refusing(self):
+        clk = _FakeClock()
+        shed = ShedPolicy(window_s=30.0, threshold=1, retry_after=1.0,
+                          brownout=brownout_shrink_generation)
+        g = _FakeReplica("g")
+        reg = _reg()
+        rt = FleetRouter([g], registry=reg, shed_policy=shed,
+                         clock=clk)
+        shed.record_backpressure(clk())
+        f = rt.submit([1], max_new_tokens=8)
+        assert f.result(timeout=1)["tokens"] == [1, 2, 3]
+        assert g.last_kwargs["max_new_tokens"] == 4   # halved
+        assert reg.get("serve_fleet_brownout_total").total() == 1
+        # nothing left to shrink → the hook declines → typed shed
+        with pytest.raises(RequestShed):
+            rt.submit([1], max_new_tokens=1)
+
+    def test_engine_speculation_throttle_is_a_brownout_knob(self, lm):
+        eng = lm.compile_serving(slots=2, max_len=32, prefill_len=8,
+                                 kv_layout="paged", speculative_k=4,
+                                 registry=_reg())
+        assert eng._spec_throttled is False
+        eng.throttle_speculation(True)
+        fut = eng.submit([1, 2, 3], max_new_tokens=5, temperature=0.0)
+        eng.run_until_idle()
+        assert len(fut.result(timeout=10)["tokens"]) == 5
+        # throttled: no drafts proposed, one token per tick
+        assert eng._reg.get("speculative_proposed_total").total() == 0
+        eng.throttle_speculation(False)
+
+
+class TestCrashSurfacing:
+    def test_crash_strands_admitted_requests_typed_and_counted(
+            self, lm, tmp_path):
+        eng = _engine(lm, telemetry_dir=str(tmp_path))
+        f1 = eng.submit([1, 2], max_new_tokens=2)
+        f2 = eng.submit([3, 4], max_new_tokens=2)
+        eng._crash(RuntimeError("boom"))
+        for f in (f1, f2):
+            with pytest.raises(ReplicaCrashed,
+                               match="serve loop crashed"):
+                f.result(timeout=1)
+        assert eng._reg.get(
+            "serve_stranded_requests_total").total() == 2
+        with pytest.raises(ReplicaCrashed):
+            eng.submit([5], max_new_tokens=1)
+
+
+class TestGatewayContracts:
+    @staticmethod
+    def _raw_post(port, head):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(head.encode())
+        data = s.recv(4096).decode()
+        s.close()
+        return data
+
+    def test_body_cap_413_matrix_and_single_deadline(self, lm):
+        eng = _engine(lm)
+        eng.start()
+        srv, port = serve_gateway(eng, max_body_bytes=256)
+        try:
+            # missing Content-Length: refused before any read
+            resp = self._raw_post(
+                port, "POST /v1/generate HTTP/1.1\r\n"
+                      "Host: t\r\nConnection: close\r\n\r\n")
+            assert resp.startswith("HTTP/1.1 413")
+            # garbage Content-Length
+            resp = self._raw_post(
+                port, "POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+                      "Content-Length: banana\r\n"
+                      "Connection: close\r\n\r\n")
+            assert resp.startswith("HTTP/1.1 413")
+            # declared size over the cap: refused by the DECLARATION
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=10)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": [1] * 500}))
+            r = c.getresponse()
+            doc = json.loads(r.read())
+            c.close()
+            assert r.status == 413 and "exceeds" in doc["error"]
+            # one deadline: an already-due request 504s (typed), and
+            # the engine-side Request carried the SAME clock
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=10)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": [1, 2],
+                                  "max_new_tokens": 4,
+                                  "timeout": 0.0}))
+            r = c.getresponse()
+            r.read()
+            c.close()
+            assert r.status == 504
+            # a healthy request still round-trips
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=30)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": [1, 2],
+                                  "max_new_tokens": 3,
+                                  "temperature": 0.0}))
+            r = c.getresponse()
+            doc = json.loads(r.read())
+            c.close()
+            assert r.status == 200 and len(doc["tokens"]) == 3
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            eng.stop()
+
+    def test_fleet_front_gateway_health_shed_and_retry_after(self):
+        shed = ShedPolicy(window_s=30.0, threshold=1, retry_after=2.0)
+        rep = _FakeReplica("r0")
+        rt = FleetRouter([rep], registry=_reg(), shed_policy=shed)
+        srv, port = serve_gateway(rt)
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=10)
+            c.request("GET", "/healthz")
+            r = c.getresponse()
+            doc = json.loads(r.read())
+            c.close()
+            assert r.status == 200
+            assert doc["breakers"] == {"r0": "closed"}
+            assert doc["replicas"][0]["status"] == "serving"
+            # routed generate round-trips through the router
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=10)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": [1, 2],
+                                  "max_new_tokens": 2}))
+            r = c.getresponse()
+            doc = json.loads(r.read())
+            c.close()
+            assert r.status == 200 and doc["tokens"] == [1, 2, 3]
+            # sustained shed → 503 + the Retry-After contract
+            shed.record_backpressure(time.monotonic())
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=10)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": [1, 2]}))
+            r = c.getresponse()
+            doc = json.loads(r.read())
+            retry_after = r.getheader("Retry-After")
+            c.close()
+            assert r.status == 503
+            assert retry_after == "2"
+            assert doc["retryable"] is True
+            assert doc["retry_after"] == 2.0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestObservability:
+    def test_heartbeat_summary_carries_fleet_block(self):
+        clk = _FakeClock()
+        reg = _reg()
+        r0, r1 = _FakeReplica("r0", "crashed"), _FakeReplica("r1")
+        rt = FleetRouter([r0, r1], registry=reg, breaker_threshold=1,
+                         clock=clk)
+        rt.submit([1]).result(timeout=1)
+        hs = obs_metrics.heartbeat_summary(reg)
+        fl = hs["serving_fleet"]
+        assert fl["submitted"] == 1
+        assert fl["failovers"] == 1
+        assert fl["breaker_opens"] == 1
+        assert fl["breakers_open"] == 1
+        assert fl["sheds"] == 0
+
+    def test_block_pool_exhausted_is_backpressure_to_the_router(self):
+        """BlockPoolExhausted at submit is failover + shed evidence,
+        never a breaker failure (the replica is healthy, the request
+        just can't fit it)."""
+        class _PoolFull(_FakeReplica):
+            def submit(self, *a, **kw):
+                self.calls += 1
+                raise BlockPoolExhausted("pool too small")
+
+        p = _PoolFull("p")
+        ok = _FakeReplica("ok")
+        rt = FleetRouter([p, ok], registry=_reg(),
+                         breaker_threshold=1)
+        for _ in range(3):
+            assert rt.submit([1]).result(timeout=1)["tokens"] \
+                == [1, 2, 3]
+        assert p.calls == 3              # still tried: breaker closed
+        assert rt.breaker_states()["p"] == "closed"

@@ -39,7 +39,16 @@ harness) against ``examples/train_elastic.py``:
    driver fails over, and exit 0 (``serving.EXIT_DRAINED``) while the
    survivor absorbs the queue without ever retracing its decode
    program.
-8. **warm-restart** — cold-start elimination (``singa_tpu.aot``): a
+8. **serve-crash** — fleet fault tolerance under a HARD kill: two
+   gateway replicas behind a real ``FleetRouter``; one is SIGKILLed
+   mid-stream (no drain handler runs). Zero failed client responses:
+   every stranded request is re-dispatched to the survivor on its
+   remaining deadline budget and the delivered tokens are bitwise
+   identical to an uninterrupted greedy run; the circuit breaker
+   ejects the corpse (gauge → open) and the redispatch/failover
+   counters ride ``heartbeat_summary``. Banks the recovered-request
+   count and the kill window's p99 time-to-response.
+9. **warm-restart** — cold-start elimination (``singa_tpu.aot``): a
    trainer and a serving replica restarted against a populated AOT
    cache reach the first step / first served token measurably faster
    than their cold baselines, with ZERO ``source="fresh"`` compiles
@@ -618,6 +627,247 @@ def scenario_serve_drain(root, budget):
                 p.kill()
 
 
+def scenario_serve_crash(root, budget):
+    """Fleet fault tolerance under a HARD kill: two gateway replicas
+    (identical weights — both seed 0) absorb one request stream
+    through a real in-driver ``FleetRouter``; replica 0 is SIGKILLed
+    mid-stream (no drain, no goodbye). The contract: (a) ZERO failed
+    client responses — every request stranded in the dead replica is
+    re-dispatched to the survivor on its REMAINING deadline budget and
+    delivered exactly once, (b) the re-dispatched tokens are bitwise
+    identical to an uninterrupted greedy run on the survivor, (c) the
+    breaker ejects the dead replica (gauge → open) and the
+    redispatch/failover counters move, visible in
+    ``heartbeat_summary``. Banks the recovered-request count and the
+    p99 time-to-response across the kill window."""
+    import http.client
+    import signal as _signal
+    import threading
+
+    # the other scenarios are subprocess-only; this one drives a real
+    # in-driver FleetRouter, so the repo root must be importable
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from singa_tpu import serving
+    from singa_tpu.observability import metrics as obs_metrics
+
+    serve = os.path.join(REPO, "examples", "serve_transformer.py")
+    ports = [_free_port(), _free_port()]
+    cmd = lambda p: [sys.executable, serve, "--cpu", "--port", str(p),  # noqa: E731
+                     "--slots", "2", "--max-len", "48",
+                     "--prefill-len", "8", "--vocab", "32",
+                     "--d-model", "16", "--layers", "1"]
+    procs = [subprocess.Popen(cmd(p), stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for p in ports]
+
+    class HttpReplica:
+        """The wire between the router and a gateway subprocess, with
+        router-visible failure semantics: a dead socket at submit is a
+        wire error (breaker fodder), a connection that dies mid-read
+        is ``ReplicaCrashed`` (re-dispatch), a 503 is backpressure."""
+
+        def __init__(self, name, port):
+            self.name = name
+            self.port = port
+            self.draining = False
+            self._lock = threading.Lock()
+            self._outstanding = 0
+
+        def queue_depth(self):
+            with self._lock:
+                return self._outstanding
+
+        def health(self):
+            c = http.client.HTTPConnection("127.0.0.1", self.port,
+                                           timeout=2)
+            try:
+                c.request("GET", "/healthz")
+                return json.loads(c.getresponse().read())
+            finally:
+                c.close()
+
+        def submit(self, prompt, **kw):
+            body = json.dumps(
+                {"prompt": list(prompt),
+                 **{k: kw[k] for k in ("max_new_tokens",
+                                       "temperature", "timeout")
+                    if kw.get(k) is not None}})
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.port, timeout=120)
+            try:
+                conn.request("POST", "/v1/generate", body)
+            except OSError as e:      # refused/reset at the door
+                conn.close()
+                raise ConnectionError(
+                    f"{self.name}: submit wire error: {e}") from e
+            fut = serving.ServeFuture()
+            with self._lock:
+                self._outstanding += 1
+
+            def _read():
+                try:
+                    r = conn.getresponse()
+                    doc = json.loads(r.read().decode() or "{}")
+                    if r.status == 200:
+                        fut.set_result(doc)
+                    elif r.status == 503:
+                        fut.set_error(serving.EngineDraining(
+                            f"{self.name}: 503 {doc.get('error')}"))
+                    else:
+                        fut.set_error(serving.ServingError(
+                            f"{self.name}: HTTP {r.status}: "
+                            f"{doc.get('error')}"))
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:   # SIGKILL mid-response
+                    fut.set_error(serving.ReplicaCrashed(
+                        f"{self.name}: connection died "
+                        f"mid-request: {e}"))
+                finally:
+                    conn.close()
+                    with self._lock:
+                        self._outstanding -= 1
+
+            threading.Thread(target=_read, daemon=True).start()
+            return fut
+
+    try:
+        deadline = time.monotonic() + min(120, budget.remaining())
+        up = set()
+        while len(up) < 2 and time.monotonic() < deadline:
+            for p in ports:
+                if p in up:
+                    continue
+                try:
+                    c = http.client.HTTPConnection("127.0.0.1", p,
+                                                   timeout=2)
+                    c.request("GET", "/healthz")
+                    if c.getresponse().status == 200:
+                        up.add(p)
+                    c.close()
+                except OSError:
+                    time.sleep(0.2)
+        _check(len(up) == 2, "serve-crash: both replicas READY")
+
+        r0 = HttpReplica("r0", ports[0])
+        r1 = HttpReplica("r1", ports[1])
+        reg = obs_metrics.MetricsRegistry()
+        rt = serving.FleetRouter([r0, r1], registry=reg,
+                                 breaker_threshold=2,
+                                 breaker_backoff=2.0,
+                                 max_redispatch=3)
+
+        N, new_tokens = 12, 8
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, 32,
+                               (int(rng.randint(1, 8)),)).tolist()
+                   for _ in range(N)]
+        results, lat = [None] * N, [None] * N
+        errors = [None] * N
+
+        def one(i):
+            t0 = time.monotonic()
+            try:
+                f = rt.submit(prompts[i], max_new_tokens=new_tokens,
+                              temperature=0.0, timeout=90.0)
+                results[i] = (f.result(), f.redispatches)
+            except Exception as e:  # noqa: BLE001
+                errors[i] = f"{type(e).__name__}: {e}"
+            lat[i] = time.monotonic() - t0
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(N)]
+        for t in threads[:6]:
+            t.start()
+        # kill the moment replica 0 actually holds admitted work —
+        # those requests are the stranded ones the re-dispatch exists
+        # for (SIGKILL: no drain handler runs, sockets just die)
+        kill_deadline = time.monotonic() + 30
+        while (r0.queue_depth() < 2
+               and time.monotonic() < kill_deadline):
+            time.sleep(0.01)
+        _check(r0.queue_depth() >= 1,
+               "serve-crash: replica 0 holds in-flight work at kill")
+        procs[0].send_signal(_signal.SIGKILL)
+        for t in threads[6:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=budget.remaining())
+        procs[0].wait(timeout=budget.remaining())
+
+        _check(not any(errors),
+               f"serve-crash: zero failed client responses "
+               f"({sum(e is not None for e in errors)} failed)",
+               repr([e for e in errors if e][:3]))
+        bad = [i for i, (doc, _rd) in enumerate(results)
+               if len(doc.get("tokens", [])) != new_tokens]
+        _check(not bad,
+               f"serve-crash: all {N} responses complete "
+               f"({len(bad)} short)")
+        recovered = sum(rd for _doc, rd in results)
+        _check(recovered >= 1,
+               f"serve-crash: stranded requests were re-dispatched "
+               f"({recovered} recovered)")
+
+        # bitwise token identity: every delivered answer must equal an
+        # uninterrupted greedy run on the survivor (same seed-0
+        # weights in both replicas, temperature 0)
+        for i in range(N):
+            c = http.client.HTTPConnection("127.0.0.1", ports[1],
+                                           timeout=120)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": prompts[i],
+                                  "max_new_tokens": new_tokens,
+                                  "temperature": 0.0}))
+            ref = json.loads(c.getresponse().read())
+            c.close()
+            if results[i][0]["tokens"] != ref["tokens"]:
+                raise AssertionError(
+                    f"serve-crash: request {i} tokens diverged from "
+                    f"the uninterrupted run: "
+                    f"{results[i][0]['tokens']} != {ref['tokens']}")
+        print(f"  ok: serve-crash: all {N} responses bitwise "
+              f"identical to uninterrupted greedy runs")
+
+        # breaker ejected the corpse; counters moved and ride the
+        # heartbeat
+        _check(rt.breaker_states()["r0"] == "open",
+               "serve-crash: breaker OPEN on the killed replica")
+        _check(reg.get("serve_fleet_redispatch_total").total()
+               >= 1, "serve-crash: redispatch counter moved")
+        hs = obs_metrics.heartbeat_summary(reg)["serving_fleet"]
+        _check(hs["redispatches"] >= 1 and hs["breaker_opens"] >= 1
+               and hs["breakers_open"] >= 1,
+               f"serve-crash: heartbeat_summary carries the fleet "
+               f"block {hs}")
+        # survivor is intact: still serving, decode never retraced
+        h = r1.health()
+        _check(h["status"] == "serving"
+               and h["compiled"]["n_traces"] == 1,
+               "serve-crash: survivor serving, decode traced once")
+
+        # the kill window's latency tail: requests that either had to
+        # be re-dispatched off the corpse or were submitted after the
+        # kill (they ate the breaker's discovery cost)
+        kill_lat = [lat[i] for i in range(N)
+                    if lat[i] is not None
+                    and (results[i][1] > 0 or i >= 6)]
+        p99 = float(np.percentile(kill_lat, 99)) if kill_lat else 0.0
+        BANK["serve-crash"] = {
+            "recovered_requests": int(recovered),
+            "p99_ttr_kill_window_s": round(p99, 4),
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def scenario_warm_restart(root, budget):
     """Cold-start elimination (``singa_tpu.aot``): kill a trainer and
     a serving replica, restart both against the populated AOT cache,
@@ -790,6 +1040,7 @@ SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("divergence-quarantine", scenario_divergence_quarantine),
              ("data-resume", scenario_data_resume),
              ("serve-drain", scenario_serve_drain),
+             ("serve-crash", scenario_serve_crash),
              ("warm-restart", scenario_warm_restart)]
 
 
