@@ -741,15 +741,20 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
     # throughput push is steered by measurements, not guesses
     # (tools/bench_report.py renders the curves + winner per SLO target)
     if os.environ.get("BENCH_SERVING_SWEEP", "1") != "0":
-        try:
+        sweep_box = {}  # shared with the leg so a timeout keeps
+        try:            # whatever configs already finished
             res["serving_sweep"] = _leg_guard(
-                lambda: _measure_serving_sweep(dev), leg_budget,
-                "serving_sweep")
+                lambda: _measure_serving_sweep(dev, out=sweep_box),
+                leg_budget, "serving_sweep")
         except TimeoutError as e:
             res["serving_sweep_error"] = str(e)[:200]
             res["leg_timeout"] = "serving_sweep"
+            if sweep_box.get("configs"):
+                res["serving_sweep"] = dict(sweep_box, partial=True)
         except Exception as e:
             res["serving_sweep_error"] = str(e)[:200]
+            if sweep_box.get("configs"):
+                res["serving_sweep"] = dict(sweep_box, partial=True)
         _emit_partial(res, "serving_sweep")
     # quant leg (singa_tpu.quant): int8 weight-only inference — ResNet
     # img/s + LM tok/s + serving decode tok/s + quantized-checkpoint
@@ -1117,7 +1122,7 @@ def _parse_sweep_grid():
 
 
 def _measure_serving_sweep(dev, grid=None, n_requests=12,
-                           new_tokens=24, rps=None, seed=0):
+                           new_tokens=24, rps=None, seed=0, out=None):
     """The banked ``serving_sweep`` leg: one small TransformerLM served
     under synthetic POISSON load (seeded exponential inter-arrivals,
     open loop on the background serve thread) across a grid of
@@ -1149,8 +1154,13 @@ def _measure_serving_sweep(dev, grid=None, n_requests=12,
     model.eval()
     model(tensor.Tensor(data=np.zeros((1, max_pf), np.float32),
                         device=dev, requires_grad=False))
-    out = {"n_requests": n_requests, "new_tokens": new_tokens,
-           "offered_rps": rps, "poisson_seed": seed, "configs": []}
+    # `out` may be a caller-shared dict: each config is banked into it
+    # the moment it completes, so a _leg_guard timeout salvages every
+    # config that finished instead of discarding the whole sweep
+    out = out if out is not None else {}
+    out.update({"n_requests": n_requests, "new_tokens": new_tokens,
+                "offered_rps": rps, "poisson_seed": seed})
+    out.setdefault("configs", [])
     for lay, slots, pf, spec_k in grid:
         rng = np.random.RandomState(seed)
         reg = obs_metrics.MetricsRegistry()

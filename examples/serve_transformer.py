@@ -23,9 +23,11 @@ Usage::
 """
 
 import argparse
+import base64
 import json
 import sys
 import threading
+import time
 
 import numpy as np
 
@@ -42,6 +44,68 @@ def _post(port, path, doc, timeout=120.0):
         return r.status, json.loads(r.read().decode() or "{}")
     finally:
         c.close()
+
+
+def _make_handoff(peers, timeout):
+    """The deadline drain's migration callable: offer each unfinished
+    request to the peer gateways — sealed-snapshot inject first (the
+    continuation is bitwise-identical, zero recomputed prefill), typed
+    409 refusal → recompute via /v1/generate on the same peer, dead
+    peer → next peer. Ownership moves to a relay thread (the drain
+    must not block on a peer's decode); the thread resolves the
+    request's future exactly once, typed on total failure."""
+
+    def handoff(req, snapshot, budget):
+        if not peers:
+            return False
+
+        def run():
+            from singa_tpu.serving import EngineDraining
+            doc = None
+            for p in peers:
+                try:
+                    if snapshot is not None:
+                        st, d = _post(p, "/v1/inject", {
+                            "meta": base64.b64encode(
+                                snapshot["meta"]).decode(),
+                            "frame": base64.b64encode(
+                                snapshot["frame"]).decode(),
+                            "timeout": timeout}, timeout=timeout)
+                        if st == 200:
+                            doc = d
+                            break
+                        if st != 409:
+                            continue    # peer trouble: next peer
+                        # 409 = typed refusal: recompute, same peer
+                    body = {"prompt": [int(t) for t in req.prompt],
+                            "max_new_tokens": req.max_new_tokens,
+                            "temperature": req.temperature,
+                            "request_id": req.trace_id,
+                            "timeout": timeout}
+                    if req.top_k is not None:
+                        body["top_k"] = req.top_k
+                    if req.eos_id is not None:
+                        body["eos_id"] = req.eos_id
+                    st, d = _post(p, "/v1/generate", body,
+                                  timeout=timeout)
+                    if st == 200:
+                        doc = d
+                        break
+                except OSError:
+                    continue
+            if req.future.done():
+                return
+            if doc is None:
+                req.future.set_error(EngineDraining(
+                    "handoff failed: no peer accepted the request"))
+            else:
+                req.future.set_result(doc)
+
+        threading.Thread(target=run, daemon=True,
+                         name="handoff-relay").start()
+        return True
+
+    return handoff
 
 
 def _selftest(port, n, vocab, new_tokens=8, temperature=0.5):
@@ -115,6 +179,27 @@ def main():
                     help="fire N requests at the own gateway, verify, "
                          "exit 0")
     ap.add_argument("--drain-timeout", type=float, default=60.0)
+    ap.add_argument("--drain-deadline", type=float, default=None,
+                    help="preemption budget (seconds) armed on "
+                         "SIGTERM/SIGINT: finish what fits, hand off "
+                         "(--handoff-peers) or fail-typed the rest by "
+                         "the deadline instead of waiting out "
+                         "--drain-timeout")
+    ap.add_argument("--handoff-peers", default=None, metavar="PORTS",
+                    help="comma-separated peer gateway ports: a "
+                         "deadline drain migrates unfinished requests "
+                         "there (POST /v1/inject with the sealed KV "
+                         "snapshot; recompute via /v1/generate when "
+                         "the peer refuses typed)")
+    ap.add_argument("--spill-bytes", type=int, default=0,
+                    help="host-RAM spill tier byte budget for evicted "
+                         "cached-prefix KV blocks (paged layout; 0 = "
+                         "off)")
+    ap.add_argument("--snapshot-every", type=int, default=0,
+                    help="checkpoint in-flight KV snapshots every N "
+                         "ticks so a crash re-dispatch resumes from "
+                         "the last snapshot instead of token zero "
+                         "(0 = off)")
     ap.add_argument("--default-timeout", type=float, default=120.0,
                     help="per-request deadline budget (seconds) when "
                          "the body carries no timeout; the engine SLO "
@@ -158,6 +243,10 @@ def main():
                         kv_blocks=args.kv_blocks)
     if args.speculative_k:
         serve_kw["speculative_k"] = args.speculative_k
+    if args.spill_bytes:
+        serve_kw["spill_bytes"] = args.spill_bytes
+    if args.snapshot_every:
+        serve_kw["snapshot_every"] = args.snapshot_every
     sharded = bool(args.model_shards or args.mesh)
     if args.mesh:
         import jax
@@ -189,7 +278,7 @@ def main():
             f"{p.split('serve_', 1)[-1]}={v}"
             for p, v in sorted(src.items())), flush=True)
     replica = ServingReplica(engine, name=f"serve-{args.port}")
-    replica.install_signal_handlers()
+    replica.install_signal_handlers(deadline=args.drain_deadline)
     replica.start()
     server, port = serve_gateway(engine, port=args.port,
                                  replica=replica,
@@ -213,7 +302,30 @@ def main():
               f"drain_exit={code}", flush=True)
         return code
 
-    code = replica.run_until_drained(timeout=args.drain_timeout)
+    handoff = None
+    if args.handoff_peers:
+        peers = [int(p) for p in args.handoff_peers.split(",") if p]
+        handoff = _make_handoff(peers, args.default_timeout)
+    drain_started = {}
+
+    def _watch():
+        replica._drain_evt.wait()
+        drain_started["t"] = time.monotonic()
+
+    threading.Thread(target=_watch, daemon=True,
+                     name="drain-watch").start()
+    # poll=0.05: a preemption deadline is seconds — the gap between
+    # the signal and the blocking drain must not eat half the budget
+    code = replica.run_until_drained(poll=0.05,
+                                     timeout=args.drain_timeout,
+                                     handoff=handoff)
+    # DRAIN_DONE times the ENGINE drain (the preemption-deadline
+    # contract) — printed before server_close(), whose handler-thread
+    # join legitimately extends past the deadline while migrated
+    # responses relay back from the peers
+    if "t" in drain_started:
+        print(f"DRAIN_DONE in={time.monotonic() - drain_started['t']:.2f}s",
+              flush=True)
     # stop accepting, then join in-flight handler threads: every
     # admitted request's HTTP response is written before exit
     server.shutdown()

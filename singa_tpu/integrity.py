@@ -223,6 +223,32 @@ def open_frame(meta: bytes, sealed: bytes) -> bytes:
     return payload
 
 
+def frame_meta(doc: dict) -> bytes:
+    """Canonical metadata bytes for a sealed frame: sorted keys, compact
+    separators, utf-8 — the same canonical-JSON form
+    :func:`data_state_digest` uses, so the bytes (and hence the header
+    CRC :func:`seal_frame` computes over them) are independent of dict
+    insertion order on either side of the wire."""
+    return json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def parse_frame_meta(meta: bytes) -> dict:
+    """Decode :func:`frame_meta` bytes back into the metadata dict.
+    Raises :class:`IntegrityError` on non-JSON or non-object metadata —
+    the caller has usually just CRC-verified ``meta`` via
+    :func:`open_frame`, so a parse failure means a protocol bug, not
+    line noise, but it still must surface typed."""
+    try:
+        doc = json.loads(bytes(meta).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise IntegrityError(f"frame metadata is not canonical JSON: {e}")
+    if not isinstance(doc, dict):
+        raise IntegrityError(
+            f"frame metadata must be a JSON object, got {type(doc).__name__}")
+    return doc
+
+
 # ---------------------------------------------------------------------------
 # replica fingerprints (host side)
 # ---------------------------------------------------------------------------
@@ -272,5 +298,6 @@ __all__ = [
     "data_state_digest", "record_digest",
     "digest_tree", "manifest_digest", "verify_tree",
     "write_digest_sidecar", "read_digest_sidecar", "seal_frame",
-    "open_frame", "state_fingerprint", "replica_buffer_mismatches",
+    "open_frame", "frame_meta", "parse_frame_meta",
+    "state_fingerprint", "replica_buffer_mismatches",
 ]

@@ -419,6 +419,17 @@ def heartbeat_summary(registry=None):
         ratio = reg.get("speculative_accepted_ratio")
         if isinstance(ratio, Gauge):
             kv["speculative_accepted_ratio"] = ratio.value()
+        # host-RAM spill tier (evicted cached prefixes parked in host
+        # memory): restore-vs-spill movement shows whether the tier is
+        # saving prefills or just churning
+        for key, name in (("spills", "serve_kv_spill_total"),
+                          ("restores", "serve_kv_restore_total")):
+            c = reg.get(name)
+            if isinstance(c, Counter):
+                kv[key] = int(c.total())
+        spill_b = reg.get("serve_kv_spill_bytes")
+        if isinstance(spill_b, Gauge):
+            kv["spill_bytes"] = spill_b.value()
         # sharded engines: the mesh shape + what ONE chip holds — the
         # fleet view's pool-pressure numbers must be per-device, not
         # the global logical pool (a paged pool is replicated across
@@ -434,6 +445,24 @@ def heartbeat_summary(registry=None):
             if isinstance(per_dev, Gauge):
                 kv["per_device_bytes"] = per_dev.value()
         out["serving_kv"] = kv
+    # live-KV handoff (preemption-deadline drains): migrated-out/-in,
+    # typed refusals, recompute fallbacks, checkpoint cadence — the
+    # fleet-view evidence a preempted replica's work moved instead of
+    # being recomputed
+    ho_keys = (("out", "serve_handoff_out_total"),
+               ("in", "serve_handoff_in_total"),
+               ("refused", "serve_handoff_refused_total"),
+               ("fallback", "serve_handoff_fallback_total"),
+               ("kv_checkpoints", "serve_kv_checkpoint_total"),
+               ("prefill_tokens", "serve_prefill_tokens_total"))
+    if any(isinstance(reg.get(n), Counter)
+           for _k, n in ho_keys[:4]):
+        ho = {}
+        for key, name in ho_keys:
+            c = reg.get(name)
+            if isinstance(c, Counter):
+                ho[key] = int(c.total())
+        out["serving_handoff"] = ho
     # fleet resilience (processes running a FleetRouter): breaker /
     # re-dispatch / shed movement — the coordinator-view evidence that
     # a replica died and the fleet absorbed it
@@ -446,7 +475,9 @@ def heartbeat_summary(registry=None):
                           ("sheds", "serve_fleet_shed_total"),
                           ("rejected", "serve_fleet_rejected_total"),
                           ("breaker_opens",
-                           "serve_fleet_breaker_open_total")):
+                           "serve_fleet_breaker_open_total"),
+                          ("handoffs", "serve_fleet_handoff_total"),
+                          ("resumes", "serve_fleet_resume_total")):
             c = reg.get(name)
             if isinstance(c, Counter):
                 fl[key] = int(c.total())

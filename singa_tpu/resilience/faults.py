@@ -192,6 +192,32 @@ class FaultPlan:
         return self._arm("slow_replica", tick, times,
                          seconds=float(seconds))
 
+    def corrupt_handoff(self, seq, times=1):
+        """Flip one bit in each of the next ``times`` SEALED KV-handoff
+        frames this engine extracts, starting from handoff number
+        ``seq`` (counting from 1, per engine). The survivor's
+        ``open_frame`` must refuse the frame typed
+        (``HandoffRefused``) and the handoff must fall back to
+        recompute re-dispatch — corrupt KV is never injected."""
+        return self._arm("handoff_corrupt", seq, times)
+
+    def slow_handoff(self, seq, seconds=0.2, times=1):
+        """Stall ``times`` CONSECUTIVE handoff extractions starting at
+        handoff number ``seq`` by ``seconds`` each — a straggling
+        migration, not a dead one. Drives the deadline-drain budget
+        accounting: a handoff that no longer fits the remaining budget
+        degrades to recompute re-dispatch."""
+        return self._arm("handoff_slow", seq, times,
+                         seconds=float(seconds))
+
+    def kill_mid_handoff(self, seq):
+        """Hard-kill this process (``os._exit(1)``) in the middle of
+        handoff number ``seq`` — after the snapshot is extracted but
+        before it reaches a survivor: the dying replica dies HARDER
+        mid-migration. The fleet's crash path must still recover the
+        request by recompute (or from its last cadence checkpoint)."""
+        return self._arm("handoff_kill", seq, 1)
+
     # -- integrity faults --------------------------------------------------
     def corrupt_wire(self, seq, times=1):
         """Flip one bit in each of the next ``times`` control-plane
@@ -329,6 +355,35 @@ class FaultPlan:
             return payload
         return payload[:-1] + bytes([payload[-1] ^ 0x01])
 
+    def on_handoff_send(self, seq, frame):
+        """Called with every SEALED outbound KV-handoff frame (``seq``
+        counts from 1 per engine); returns the bytes to actually hand
+        off. Handoff numbers never repeat, so all three handoff faults
+        match CONSECUTIVE handoffs from their start seq (the
+        ``corrupt_wire`` rule). ``kill_mid_handoff`` dies here —
+        snapshot extracted, survivor never reached."""
+        for rec in self._faults:
+            if rec["kind"] == "handoff_kill" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "handoff_kill"))
+                os._exit(1)      # died mid-migration
+        for rec in self._faults:
+            if rec["kind"] == "handoff_slow" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "handoff_slow"))
+                time.sleep(rec["seconds"])
+                break
+        for rec in self._faults:
+            if rec["kind"] == "handoff_corrupt" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "handoff_corrupt"))
+                if frame:
+                    return frame[:-1] + bytes([frame[-1] ^ 0x01])
+        return frame
+
     def on_fingerprint(self, step, model):
         """Called right before the step-N cross-replica fingerprint is
         computed; a ``diverge_at`` fault mutates the first floating
@@ -377,6 +432,9 @@ class _NullPlan(FaultPlan):
 
     def on_wire_send(self, seq, payload):
         return payload
+
+    def on_handoff_send(self, seq, frame):
+        return frame
 
     def on_fingerprint(self, step, model):
         pass

@@ -36,8 +36,9 @@ from .fleet import (EXIT_DRAINED, CircuitBreaker,         # noqa: F401
                     FleetFuture, FleetRouter, ServingReplica,
                     ShedPolicy, brownout_shrink_generation)
 from .gateway import serve_gateway                        # noqa: F401
+from .kv_cache import HostSpillTier                       # noqa: F401
 from .scheduler import (BlockPoolExhausted,               # noqa: F401
-                        EngineDraining, QueueFull,
+                        EngineDraining, HandoffRefused, QueueFull,
                         ReplicaCrashed, Request, RequestQueue,
                         RequestShed, RequestTimeout, ServeFuture,
                         ServingError, budget_remaining, deadline_in)
@@ -48,6 +49,7 @@ __all__ = [
     "ShedPolicy", "brownout_shrink_generation", "EXIT_DRAINED",
     "serve_gateway", "ServingError", "QueueFull", "EngineDraining",
     "RequestTimeout", "ReplicaCrashed", "RequestShed",
-    "BlockPoolExhausted", "ServeFuture", "Request", "RequestQueue",
+    "BlockPoolExhausted", "HandoffRefused", "HostSpillTier",
+    "ServeFuture", "Request", "RequestQueue",
     "deadline_in", "budget_remaining",
 ]

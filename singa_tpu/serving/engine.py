@@ -75,17 +75,20 @@ import os
 import threading
 import time
 import warnings
+from collections import deque
 
 import numpy as np
 
+from .. import integrity as _integrity
 from ..observability import metrics as _metrics
 from ..observability import perf as _perf
 from ..observability import spans as _spans
 from ..resilience.faults import NULL_PLAN, FaultInjected
 from ..models import decode as _decode
-from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
-                        ReplicaCrashed, Request, RequestQueue,
-                        RequestTimeout, ServingError)
+from .scheduler import (BlockPoolExhausted, EngineDraining,
+                        HandoffRefused, QueueFull, ReplicaCrashed,
+                        Request, RequestQueue, RequestTimeout,
+                        ServingError, budget_remaining, deadline_in)
 
 # donation is a TPU/accelerator optimisation; on CPU jax warns that the
 # donated buffers were unused — expected for OUR two programs, not
@@ -103,6 +106,51 @@ def _quiet_donation(fn, *args):
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable")
         return fn(*args)
+
+
+# KV level arrays in their ONE canonical serialization order: every
+# snapshot/spill frame packs present keys in this order, so the bytes
+# on both sides of a handoff agree by construction.
+_LEVEL_KEYS = ("k", "v", "k_scale", "v_scale")
+
+
+def _pack_arrays(arrays):
+    """``(specs, payload)`` for a list of host arrays: per-array
+    dtype/shape specs (frame metadata) plus one concatenated byte
+    blob (frame payload). The inverse of :func:`_unpack_arrays`."""
+    specs, chunks = [], []
+    for arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        specs.append({"dtype": str(arr.dtype),
+                      "shape": [int(d) for d in arr.shape]})
+        chunks.append(arr.tobytes())
+    return specs, b"".join(chunks)
+
+
+def _unpack_arrays(specs, payload):
+    """Rebuild the packed arrays from a CRC-verified frame. Length
+    mismatches raise IntegrityError: the CRC vouched for the bytes,
+    so a mismatch against the specs is a protocol bug — still typed,
+    still never written into a pool. ``jnp.dtype`` resolves extended
+    dtypes (bfloat16, fp8) that plain numpy refuses by name."""
+    import jax.numpy as jnp
+    payload = bytes(payload)
+    out, off = [], 0
+    for spec in specs:
+        dt = jnp.dtype(str(spec["dtype"]))
+        shape = tuple(int(d) for d in spec["shape"])
+        n = int(dt.itemsize) * int(np.prod(shape, dtype=np.int64))
+        chunk = payload[off:off + n]
+        if len(chunk) != n:
+            raise _integrity.IntegrityError(
+                f"frame payload truncated: array {spec} needs {n}B, "
+                f"{len(chunk)}B left")
+        out.append(np.frombuffer(chunk, dtype=dt).reshape(shape))
+        off += n
+    if off != len(payload):
+        raise _integrity.IntegrityError(
+            f"frame payload has {len(payload) - off} trailing bytes")
+    return out
 
 
 def _cache_counts():
@@ -176,6 +224,13 @@ class _EngineBase:
         # submit sequence number: the key the fleet-level wire-error
         # fault fires on (send numbers, like the control plane's)
         self._submit_seq = 0
+        # deadline drain: the handoff callable (set per-drain), the
+        # absolute budget clock, and an EWMA of tick cost the handoff
+        # pass uses to predict whether a request fits the budget
+        self._handoff = None
+        self._drain_deadline = None
+        self._tick_ewma = 0.0
+        self._handoff_seq = 0
         self._stranded = self._reg.counter(
             "serve_stranded_requests_total",
             "requests a serve-loop crash failed while admitted "
@@ -486,25 +541,50 @@ class _EngineBase:
     def draining(self):
         return self._draining
 
-    def drain(self, timeout=60.0):
+    def drain(self, timeout=60.0, handoff=None):
         """Graceful drain: refuse new requests, FINISH everything
         in flight and queued, return True once idle. The drainable-
-        replica contract: a drained engine dropped nothing."""
+        replica contract: a drained engine dropped nothing.
+
+        ``handoff`` turns ``timeout`` from a wait into a BUDGET
+        (preemption-deadline drain): each tick the engine migrates
+        queued requests and any in-flight request that cannot finish
+        inside the remaining budget through
+        ``handoff(request, snapshot_or_None, budget_s) -> bool`` —
+        True means a survivor took ownership of delivering the
+        response; anything else fails the request typed
+        (:class:`EngineDraining`, the fleet's recompute re-dispatch
+        rung). Either way drain returns by the deadline with nothing
+        unresolved left behind."""
+        self._handoff = handoff
+        self._drain_deadline = time.monotonic() + float(timeout)
         self._draining = True
         self._wake.set()
         if self._thread is None:
             # synchronous engines drain inline
             self.run_until_idle()
             return True
-        deadline = time.monotonic() + float(timeout)
-        while time.monotonic() < deadline:
+        deadline = self._drain_deadline
+        while True:
             if self._crashed is not None:
                 return False
             if not self._busy() and self._idle_evt.wait(0.05):
                 if not self._busy():
                     return True
+            now = time.monotonic()
+            if now >= deadline:
+                if handoff is None:
+                    return not self._busy()
+                # deadline drain: the handoff pass runs at tick
+                # boundaries, and a tick already in flight (the first
+                # decode compile, say) cannot be interrupted — so past
+                # the deadline the budget is simply negative (the next
+                # pass migrates EVERYTHING) and we give the loop a
+                # bounded grace to reach that boundary rather than
+                # abandoning work a survivor could continue
+                if now >= deadline + getattr(self, "_drain_grace", 5.0):
+                    return not self._busy()
             time.sleep(0.01)
-        return not self._busy()
 
     def stop(self):
         """Hard stop: end the loop; queued/in-flight requests are
@@ -529,7 +609,8 @@ class ServingEngine(_EngineBase):
     def __init__(self, adapter, *, slots=4, max_len=64, prefill_len=16,
                  prefill_batch=2, policy=None, aot_store=None,
                  kv_layout="ring", kv_block_size=16, kv_blocks=None,
-                 speculative_k=0, mesh=None, model_shards=None, **kw):
+                 speculative_k=0, mesh=None, model_shards=None,
+                 spill_bytes=0, snapshot_every=0, **kw):
         super().__init__(**kw)
         import jax
 
@@ -552,6 +633,15 @@ class ServingEngine(_EngineBase):
         self.policy = policy
         self._P = adapter.params()
         self._slots = [None] * self.slots        # host-side slot table
+        # live-KV handoff state: validated snapshot injects waiting
+        # for a free slot (+ paged blocks), cadence checkpoints a
+        # crashed replica's router resumes from, and the drain pass's
+        # wall-clock reserve for the final snapshot/transfer
+        self._injects = deque()
+        self.snapshot_every = int(snapshot_every or 0)
+        self._kv_checkpoints = {}       # trace_id -> {"meta","frame"}
+        self._drain_reserve = 0.25
+        self._drain_grace = 5.0
 
         # -- GSPMD sharded serving (mesh=/model_shards=) ------------------
         # One NamedSharding partitioner over a named (batch × model)
@@ -774,6 +864,30 @@ class ServingEngine(_EngineBase):
             "ticks executed")
         self._prefills = self._reg.counter(
             "serve_prefill_total", "prompts prefilled into a slot")
+        self._prefill_tok = self._reg.counter(
+            "serve_prefill_tokens_total",
+            "prompt tokens run through the prefill program (suffix "
+            "only under paged prefix hits) — the recompute cost a KV "
+            "handoff or spill restore avoids")
+        self._handoff_out = self._reg.counter(
+            "serve_handoff_out_total",
+            "requests a deadline drain migrated to a survivor "
+            "(snapshot or recompute handoff, accepted by the receiver)")
+        self._handoff_in = self._reg.counter(
+            "serve_handoff_in_total",
+            "live KV snapshots this engine accepted for injection")
+        self._handoff_refused = self._reg.counter(
+            "serve_handoff_refused_total",
+            "snapshot injects refused typed (CRC failure or geometry/"
+            "policy mismatch) — corrupt KV is never written")
+        self._handoff_fallback = self._reg.counter(
+            "serve_handoff_fallback_total",
+            "drain handoffs that fell back to recompute re-dispatch")
+        self._ckpt_count = self._reg.counter(
+            "serve_kv_checkpoint_total",
+            "in-flight KV snapshots checkpointed on the "
+            "snapshot_every cadence (crash re-dispatch resumes from "
+            "the newest one instead of token zero)")
         if self.kv_layout == "paged":
             # pool-pressure gauges: what /metrics.json and the
             # heartbeat fleet view read to see a replica running out
@@ -830,6 +944,55 @@ class ServingEngine(_EngineBase):
                 "serve_kv_global_bytes",
                 "logical (unsharded) KV state bytes across the mesh"
             ).set(self._part.global_bytes(self._cache))
+
+        # -- host-RAM spill tier (paged, single-device) -------------------
+        self.spill_bytes = int(spill_bytes or 0)
+        self._spill_tier = None
+        self._spill_declined = None
+        if self.spill_bytes > 0:
+            if self.kv_layout != "paged":
+                warnings.warn(
+                    "spill_bytes declined: the host-RAM spill tier "
+                    "parks evicted cached-prefix BLOCKS, which only "
+                    "the paged layout has", stacklevel=3)
+                self._spill_declined = "requires_paged_layout"
+            elif self.sharded:
+                warnings.warn(
+                    "spill_bytes declined: a sharded pool's blocks "
+                    "are sliced over the mesh ('model' axis) — a "
+                    "host spill/restore would need per-device "
+                    "gathers; serve single-device to spill",
+                    stacklevel=3)
+                self._spill_declined = "sharded"
+            else:
+                from . import kv_cache as _kvc_spill
+                tier = _kvc_spill.HostSpillTier(self.spill_bytes)
+                self._spill_tier = tier
+                spill_c = self._reg.counter(
+                    "serve_kv_spill_total",
+                    "cached-prefix blocks spilled to the host-RAM "
+                    "tier on pool eviction")
+                restore_c = self._reg.counter(
+                    "serve_kv_restore_total",
+                    "prefix blocks restored from the host-RAM tier "
+                    "instead of being re-prefilled")
+                spill_g = self._reg.gauge(
+                    "serve_kv_spill_bytes",
+                    "bytes the host-RAM spill tier currently holds "
+                    f"(budget {self.spill_bytes})")
+
+                def _on_spill():
+                    spill_c.inc()
+                    spill_g.set(tier.bytes_used)
+
+                def _on_restore():
+                    restore_c.inc()
+                    spill_g.set(tier.bytes_used)
+
+                self._mgr.attach_spill(
+                    tier, self._spill_block_read,
+                    self._spill_block_write,
+                    on_spill=_on_spill, on_restore=_on_restore)
 
     # -- AOT export / warm restart -----------------------------------------
     def _load_aot(self, store):
@@ -971,6 +1134,17 @@ class ServingEngine(_EngineBase):
                 kv_blocks_in_use=self._mgr.blocks_live(),
                 kv_blocks_cached=self._mgr.blocks_cached(),
                 prefix_cache_entries=len(self._mgr._cache))
+            if self._spill_tier is not None:
+                info["spill"] = {
+                    "budget_bytes": self._spill_tier.budget_bytes,
+                    "bytes_used": self._spill_tier.bytes_used,
+                    "entries": len(self._spill_tier),
+                    "spilled_total": self._mgr.spilled_total,
+                    "restored_total": self._mgr.restored_total}
+        if self._spill_declined:
+            info["spill_declined"] = self._spill_declined
+        if self.snapshot_every:
+            info["snapshot_every"] = self.snapshot_every
         return info
 
     def active_slots(self):
@@ -985,9 +1159,402 @@ class ServingEngine(_EngineBase):
         self._spec_throttled = bool(on)
         return self
 
+    # -- live KV handoff (extract / inject / checkpoint) -------------------
+    def _handoff_geometry(self):
+        """What must match EXACTLY between two engines for a KV
+        snapshot (or spilled block) to be bit-meaningful in the
+        receiver's pool: layout, layer count, cache dtype +
+        quantization, head geometry, position space, and the
+        quantization policy. Rides every frame's CRC-covered meta."""
+        level = self._cache[0]
+        shape = tuple(int(d) for d in level["k"].shape)
+        g = {"layout": self.kv_layout,
+             "n_layers": len(self._cache),
+             "dtype": str(level["k"].dtype),
+             "quantized": "k_scale" in level,
+             "heads": shape[1], "head_dim": shape[3],
+             "max_len": int(self.max_len),
+             "policy": self.policy.describe()
+             if self.policy is not None else None}
+        if self.kv_layout == "paged":
+            g["block_size"] = int(self.kv_block_size)
+        return g
+
+    @staticmethod
+    def _geometry_mismatch(got, want):
+        """Canonical-JSON comparison (tuples/lists, key order, and
+        int/float JSON round-trips must not create false mismatches)."""
+        try:
+            return _integrity.frame_meta({"g": got}) != \
+                _integrity.frame_meta({"g": want})
+        except (TypeError, ValueError):
+            return True
+
+    def _snapshot_slot(self, i):
+        """Seal slot ``i``'s live state: generated tokens + sampling
+        config in the frame meta, the slot's KV rows (ring) or blocks
+        (paged block-table walk) as the payload. Pure read — the slot
+        keeps running."""
+        slot = self._slots[i]
+        req = slot["req"]
+        arrays = []
+        if self.kv_layout == "paged":
+            bids = np.asarray(slot["alloc"].blocks, np.int32)
+            for level in self._cache:
+                for name in _LEVEL_KEYS:
+                    if name in level:
+                        arrays.append(np.asarray(level[name][bids]))
+        else:
+            for level in self._cache:
+                for name in _LEVEL_KEYS:
+                    if name in level:
+                        arrays.append(np.asarray(level[name][i]))
+        specs, payload = _pack_arrays(arrays)
+        doc = {"v": 1, "kind": "kv_snapshot",
+               "geometry": self._handoff_geometry(),
+               "prompt": [int(t) for t in req.prompt],
+               "tokens": [int(t) for t in req.tokens],
+               "pos": int(slot["pos"]), "tok": int(slot["tok"]),
+               "max_new_tokens": int(req.max_new_tokens),
+               "temperature": req.temperature, "top_k": req.top_k,
+               "eos_id": req.eos_id, "trace_id": req.trace_id,
+               # the request's OWN remaining deadline budget (None =
+               # unlimited) — the survivor re-arms this clock, so a
+               # migration never resets nor shortens a request's life
+               "timeout_s": budget_remaining(req.deadline),
+               "arrays": specs}
+        meta = _integrity.frame_meta(doc)
+        return {"meta": meta,
+                "frame": _integrity.seal_frame(meta, payload)}
+
+    def snapshot_slot(self, i):
+        """Public extract: :meth:`_snapshot_slot` plus the fleet fault
+        point (``corrupt_handoff`` / ``slow_handoff`` /
+        ``kill_mid_handoff`` fire on the sealed frame here, exactly
+        like wire sends). Sharded engines refuse typed — each device
+        holds only a KV slice, so recompute re-dispatch is their
+        failover path."""
+        if self.sharded:
+            raise HandoffRefused(
+                "sharded engines cannot snapshot a slot: each device "
+                "holds only its slice of the KV state — re-dispatch "
+                "(recompute) is the sharded failover path")
+        if self._slots[i] is None:
+            raise ValueError(f"slot {i} is empty")
+        snap = self._snapshot_slot(i)
+        self._handoff_seq += 1
+        frame = self.faults.on_handoff_send(self._handoff_seq,
+                                            snap["frame"])
+        return {"meta": snap["meta"], "frame": frame}
+
+    def inject_snapshot(self, meta, frame, timeout=None):
+        """Validate a sealed KV snapshot and queue it for injection;
+        returns the continuation's ServeFuture (same result shape as
+        :meth:`submit`). Validation is synchronous and REFUSES typed
+        (:class:`HandoffRefused`, counted) on a CRC failure or any
+        geometry/policy mismatch — corrupt or wrong-shape KV is never
+        written into the pool. A validated snapshot waits for a free
+        slot (and, paged, its block reservation) exactly like an
+        admitted request; continuation after placement is bitwise
+        identical to an uninterrupted greedy run."""
+        if self._crashed is not None:
+            raise ReplicaCrashed(
+                f"engine crashed ({self._crashed}); not accepting "
+                "snapshots")
+        if self._draining or self._stopped:
+            raise EngineDraining(
+                "engine is draining/stopped; not accepting snapshots")
+        if self.sharded:
+            self._handoff_refused.inc()
+            raise HandoffRefused(
+                "sharded engines do not accept KV snapshots: the pool "
+                "is sliced over the mesh")
+        try:
+            payload = _integrity.open_frame(meta, frame)
+            doc = _integrity.parse_frame_meta(meta)
+        except _integrity.IntegrityError as e:
+            self._handoff_refused.inc()
+            raise HandoffRefused(f"snapshot frame refused: {e}")
+        if doc.get("kind") != "kv_snapshot":
+            self._handoff_refused.inc()
+            raise HandoffRefused(
+                f"frame kind {doc.get('kind')!r} is not a KV snapshot")
+        want = self._handoff_geometry()
+        if self._geometry_mismatch(doc.get("geometry"), want):
+            self._handoff_refused.inc()
+            raise HandoffRefused(
+                f"snapshot geometry {doc.get('geometry')} does not "
+                f"match this engine's {want}")
+        try:
+            arrays = _unpack_arrays(doc["arrays"], payload)
+            prompt = np.asarray(doc["prompt"], np.int32).reshape(-1)
+            pos, tok = int(doc["pos"]), int(doc["tok"])
+            max_new = int(doc["max_new_tokens"])
+        except (_integrity.IntegrityError, KeyError, TypeError,
+                ValueError) as e:
+            self._handoff_refused.inc()
+            raise HandoffRefused(f"snapshot refused: {e}")
+        if self.kv_layout == "paged":
+            total = int(prompt.size) + max_new
+            if total > self.max_len or \
+                    self._mgr.n_for(total) > self._mgr.n_blocks:
+                self._handoff_refused.inc()
+                raise HandoffRefused(
+                    f"snapshot needs {total} token positions "
+                    f"({self._mgr.n_for(total)} blocks) but this "
+                    f"engine caps at max_len {self.max_len} / "
+                    f"{self._mgr.n_blocks} blocks")
+        # the request keeps ITS deadline (snapshot-carried remainder);
+        # `timeout` bounds only how long the snapshot may wait for a
+        # slot — a handoff budget must not shorten the request's life
+        req = Request(prompt, max_new_tokens=max_new,
+                      temperature=doc.get("temperature", 0.0),
+                      top_k=doc.get("top_k"),
+                      eos_id=doc.get("eos_id"),
+                      timeout=doc.get("timeout_s"),
+                      trace_id=doc.get("trace_id"))
+        req.tokens = [int(t) for t in doc.get("tokens", [])]
+        self._handoff_in.inc()
+        done = (len(req.tokens) >= req.max_new_tokens or
+                (req.eos_id is not None and req.tokens and
+                 req.tokens[-1] == req.eos_id))
+        if done:
+            # the dying replica finished it between snapshot and send
+            req.future.set_result({"tokens": list(req.tokens),
+                                   "prompt_len": int(prompt.size),
+                                   "ttft_s": None})
+            self.queue.finish("completed")
+            return req.future
+        self._injects.append((req, {"pos": pos, "tok": tok}, arrays,
+                              deadline_in(timeout)))
+        self._wake.set()
+        return req.future
+
+    def _place_injects(self, now):
+        """Move validated snapshots into free slots (paged: once their
+        block reservation fits — BlockPoolExhausted is backpressure,
+        the snapshot stays pending). The write path is host-side
+        ``.at[].set`` on the cache arrays OUTSIDE the two compiled
+        serve programs: no retrace, and the fresh buffers are donated
+        on the next tick exactly like any other."""
+        while self._injects:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                return
+            req, state, arrays, place_by = self._injects[0]
+            if req.expired(now) or \
+                    (place_by is not None and now > place_by):
+                self._injects.popleft()
+                if not req.future.done():
+                    req.future.set_error(RequestTimeout(
+                        "deadline passed before the snapshot could "
+                        "be placed"))
+                    self.queue.finish("timed_out")
+                continue
+            alloc = None
+            if self.kv_layout == "paged":
+                try:
+                    alloc = self._mgr.admit(
+                        req.prompt,
+                        int(req.prompt.size) + req.max_new_tokens)
+                except BlockPoolExhausted:
+                    return          # backpressure: retry next tick
+            self._injects.popleft()
+            try:
+                self._write_snapshot(arrays, free[0], alloc)
+            except Exception as e:  # noqa: BLE001 — typed refusal below
+                if alloc is not None:
+                    from . import kv_cache as _kvc_r
+                    # never cache the partially-written blocks: a
+                    # zero-prompt_blocks release frees them uncached
+                    self._mgr.release(
+                        _kvc_r.SlotAlloc(alloc.blocks,
+                                         alloc.shared_tokens, 0),
+                        req.prompt)
+                    self._update_pool_gauges()
+                self._handoff_refused.inc()
+                if not req.future.done():
+                    req.future.set_error(HandoffRefused(
+                        f"snapshot write failed: {e}"))
+                    self.queue.finish("failed")
+                continue
+            self._slots[free[0]] = {"req": req, "pos": state["pos"],
+                                    "tok": state["tok"],
+                                    "alloc": alloc}
+            if self._trace_requests:
+                _spans.event("request.injected",
+                             request=req.trace_id, slot=free[0],
+                             tokens=len(req.tokens))
+            self._update_pool_gauges()
+
+    def _write_snapshot(self, arrays, slot_idx, alloc):
+        """Write a validated snapshot's rows into the pool. Paged
+        allocations skip their already-correct leading blocks (prefix
+        cache hits / spill restores cover the same positions with
+        bitwise-identical content under greedy determinism)."""
+        import jax.numpy as jnp
+        if self.kv_layout == "paged":
+            skip = alloc.shared_tokens // self.kv_block_size
+            bids = jnp.asarray(alloc.blocks[skip:], jnp.int32)
+        it = iter(arrays)
+        new_cache = []
+        for level in self._cache:
+            upd = dict(level)
+            for name in _LEVEL_KEYS:
+                if name not in level:
+                    continue
+                arr = next(it)
+                if self.kv_layout == "paged":
+                    if arr.shape[0] != len(alloc.blocks) or \
+                            tuple(arr.shape[1:]) != \
+                            tuple(level[name].shape[1:]):
+                        raise HandoffRefused(
+                            f"snapshot array {name} shape "
+                            f"{arr.shape} does not cover this "
+                            f"allocation ({len(alloc.blocks)} blocks "
+                            f"of {tuple(level[name].shape[1:])})")
+                    sub = arr[skip:]
+                    if len(sub):
+                        upd[name] = level[name].at[bids].set(
+                            jnp.asarray(sub))
+                else:
+                    if tuple(arr.shape) != \
+                            tuple(level[name].shape[1:]):
+                        raise HandoffRefused(
+                            f"snapshot array {name} shape "
+                            f"{arr.shape} does not match this ring's "
+                            f"slot rows {level[name].shape[1:]}")
+                    upd[name] = level[name].at[slot_idx].set(
+                        jnp.asarray(arr))
+            new_cache.append(upd)
+        self._cache = new_cache
+
+    def _checkpoint_inflight(self):
+        """Cadence crash armor: snapshot every active slot to host
+        memory, keyed by trace id. Best-effort — a checkpoint failure
+        must never take the serve loop down."""
+        if self.sharded:
+            return
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            try:
+                snap = self._snapshot_slot(i)
+            except Exception:       # noqa: BLE001 — best-effort
+                continue
+            self._kv_checkpoints[slot["req"].trace_id] = snap
+            self._ckpt_count.inc()
+
+    def take_kv_checkpoint(self, trace_id):
+        """Newest cadence checkpoint for ``trace_id`` (None when none
+        exists). Host memory, so it survives a serve-loop crash — the
+        fleet router's re-dispatch injects it into a survivor and
+        resumes mid-stream instead of from token zero."""
+        return self._kv_checkpoints.get(str(trace_id))
+
+    # -- host-RAM spill tier plumbing (BlockManager's device access) -------
+    def _spill_block_read(self, bid):
+        """Pull ONE pool block's rows (every layer, payloads and
+        scales) to host for the spill tier."""
+        arrays = []
+        for level in self._cache:
+            for name in _LEVEL_KEYS:
+                if name in level:
+                    arrays.append(np.asarray(level[name][int(bid)]))
+        specs, payload = _pack_arrays(arrays)
+        doc = {"v": 1, "kind": "kv_block",
+               "geometry": self._handoff_geometry(), "arrays": specs}
+        return _integrity.frame_meta(doc), payload
+
+    def _spill_block_write(self, bid, meta, payload):
+        """Restore one spilled block's rows into pool block ``bid``.
+        Raises on any mismatch — the BlockManager catches and degrades
+        to re-prefilling the span, never writes a wrong block."""
+        import jax.numpy as jnp
+        doc = _integrity.parse_frame_meta(meta)
+        if doc.get("kind") != "kv_block" or self._geometry_mismatch(
+                doc.get("geometry"), self._handoff_geometry()):
+            raise HandoffRefused(
+                "spilled block does not match this engine's pool "
+                "geometry")
+        arrays = _unpack_arrays(doc.get("arrays", ()), payload)
+        it = iter(arrays)
+        new_cache = []
+        for level in self._cache:
+            upd = dict(level)
+            for name in _LEVEL_KEYS:
+                if name in level:
+                    arr = next(it)
+                    if tuple(arr.shape) != \
+                            tuple(level[name].shape[1:]):
+                        raise HandoffRefused(
+                            f"spilled block array {name} shape "
+                            f"{arr.shape} != {level[name].shape[1:]}")
+                    upd[name] = level[name].at[int(bid)].set(
+                        jnp.asarray(arr))
+            new_cache.append(upd)
+        self._cache = new_cache
+
+    # -- deadline drain (handoff pass) -------------------------------------
+    def _drain_handoff_pass(self, now):
+        """Migrate what cannot finish inside the drain budget: queued
+        requests outright (they would cost a full prefill + decode),
+        and any active slot whose remaining tokens — at the EWMA tick
+        cost, plus a snapshot/transfer reserve — overrun the budget.
+        Requests that fit keep decoding here and finish normally."""
+        budget = budget_remaining(self._drain_deadline, now)
+        for req in self.queue.pop_batch(len(self.queue), now):
+            self._handoff_request(req, None, budget)
+        per_tick = max(self._tick_ewma, 1e-4)
+        for i, slot in enumerate(list(self._slots)):
+            if slot is None:
+                continue
+            budget = budget_remaining(self._drain_deadline)
+            req = slot["req"]
+            remaining = req.max_new_tokens - len(req.tokens)
+            if budget is None or remaining * per_tick \
+                    + self._drain_reserve <= budget:
+                continue            # it fits: let it finish here
+            snap = None
+            try:
+                snap = self.snapshot_slot(i)
+            except Exception:       # noqa: BLE001 — recompute handoff
+                snap = None
+            self._slots[i] = None
+            self._release_blocks(slot)
+            self._handoff_request(req, snap, budget)
+        self._occupancy.set(self.active_slots())
+
+    def _handoff_request(self, req, snapshot, budget):
+        """One rung of the fallback ladder: offer the request (with
+        its snapshot when one exists) to the drain's handoff callable;
+        a decline or error falls back to failing it typed with
+        :class:`EngineDraining` — the fleet router's recompute
+        re-dispatch picks it up with the remaining deadline budget."""
+        ok = False
+        try:
+            ok = bool(self._handoff(req, snapshot, budget))
+        except Exception:           # noqa: BLE001 — fallback below
+            ok = False
+        if ok:
+            self._handoff_out.inc()
+            self.queue.finish("migrated")
+            if self._trace_requests:
+                _spans.event("request.migrated",
+                             request=req.trace_id,
+                             snapshot=snapshot is not None,
+                             tokens=len(req.tokens))
+            return
+        self._handoff_fallback.inc()
+        if not req.future.done():
+            req.future.set_error(EngineDraining(
+                "drain deadline: request was not migrated in time — "
+                "re-dispatch with the remaining budget"))
+            self.queue.finish("failed")
+
     # -- loop internals ----------------------------------------------------
     def _busy(self):
-        return len(self.queue) > 0 or any(
+        return len(self.queue) > 0 or len(self._injects) > 0 or any(
             s is not None for s in self._slots)
 
     def _release_blocks(self, slot):
@@ -1015,6 +1582,13 @@ class ServingEngine(_EngineBase):
                 if not slot["req"].future.done():
                     slot["req"].future.set_error(error)
                     self.queue.finish("failed")
+        # validated-but-unplaced snapshot injects die here too —
+        # exactly-once forbids futures that never resolve
+        while self._injects:
+            req, _state, _arrays, _by = self._injects.popleft()
+            if not req.future.done():
+                req.future.set_error(error)
+                self.queue.finish("failed")
         self._occupancy.set(0)
 
     def _fail_batch(self, batch, exc):
@@ -1034,6 +1608,8 @@ class ServingEngine(_EngineBase):
         self._slots[i] = None
         self._release_blocks(slot)
         req = slot["req"]
+        # a finished request's cadence checkpoint is dead weight
+        self._kv_checkpoints.pop(req.trace_id, None)
         if self._trace_requests:
             _spans.event("request.delivered", request=req.trace_id,
                          status=status, tokens=len(req.tokens))
@@ -1075,6 +1651,13 @@ class ServingEngine(_EngineBase):
 
     def _tick(self):
         now = time.monotonic()
+        tick_t0 = now
+        # 0) deadline drain: migrate what the budget cannot cover;
+        #    then place validated snapshot injects into free slots
+        if self._draining and self._handoff is not None:
+            self._drain_handoff_pass(now)
+        if self._injects:
+            self._place_injects(now)
         # 1) reap deadline-expired in-flight requests (their slot frees
         #    mid-batch — that is the continuous part of the batching)
         for i, slot in enumerate(self._slots):
@@ -1127,6 +1710,13 @@ class ServingEngine(_EngineBase):
             self._decode_steps.inc()
         self._occupancy.set(self.active_slots())
         self._sample_hbm()
+        # 4) cadence crash armor + the drain pass's tick-cost EWMA
+        if self.snapshot_every and \
+                self._tick_count % self.snapshot_every == 0:
+            self._checkpoint_inflight()
+        dt = time.monotonic() - tick_t0
+        self._tick_ewma = dt if not self._tick_ewma \
+            else 0.8 * self._tick_ewma + 0.2 * dt
 
     def _run_prefill(self, batch, free):
         if self.kv_layout == "paged":
@@ -1147,6 +1737,7 @@ class ServingEngine(_EngineBase):
             slot_ids[b] = free[b]
             valid[b] = True
             placed.append((req, free[b]))
+            self._prefill_tok.inc(int(n))
         n0 = self._prefill_rec["n_traces"]
         t0c = time.perf_counter()
         cc0 = _cache_counts()
@@ -1197,6 +1788,7 @@ class ServingEngine(_EngineBase):
             tables[b, :len(alloc.blocks)] = alloc.blocks
             valid[b] = True
             placed.append((req, free[b], alloc))
+            self._prefill_tok.inc(int(suffix.size))
             if alloc.shared_tokens:
                 self._prefix_hits.inc()
                 self._prefix_tokens.inc(alloc.shared_tokens)
@@ -1599,7 +2191,8 @@ def build_engine(model, **kw):
                    "telemetry_dir", "max_retries", "trace_requests",
                    "aot_store", "profile_every", "kv_layout",
                    "kv_block_size", "kv_blocks", "speculative_k",
-                   "mesh", "model_shards")
+                   "mesh", "model_shards", "spill_bytes",
+                   "snapshot_every")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(

@@ -65,9 +65,10 @@ import time
 
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
-from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
-                        ReplicaCrashed, RequestShed, RequestTimeout,
-                        ServingError, budget_remaining, deadline_in)
+from .scheduler import (BlockPoolExhausted, EngineDraining,
+                        HandoffRefused, QueueFull, ReplicaCrashed,
+                        RequestShed, RequestTimeout, ServingError,
+                        budget_remaining, deadline_in)
 
 # the drain exit code: intentional, successful, do-not-relaunch — the
 # 0 row of the README's supervisor exit-code contract table
@@ -99,6 +100,10 @@ class ServingReplica:
         self._reg = registry if registry is not None \
             else _metrics.default_registry()
         self._drain_evt = threading.Event()
+        # preemption budget: request_drain(deadline=) pins the drain's
+        # absolute deadline here (monotonic), so the blocking drain —
+        # wherever it runs — honors the budget the preemption gave us
+        self._drain_deadline_at = None
         self._drain_gauge = self._reg.gauge(
             "serve_replica_draining",
             "1 while this replica is draining (refusing new requests)")
@@ -140,6 +145,10 @@ class ServingReplica:
                                     lambda: None)(),
             "compiled": eng.compiled_step_info(),
         }
+        if eng.draining and self._drain_deadline_at is not None:
+            # preemption honesty: how much budget the drain has left
+            doc["drain_deadline_s"] = round(
+                budget_remaining(self._drain_deadline_at), 4)
         if self.cluster is not None:
             try:
                 doc["cluster"] = self.cluster.health()
@@ -148,23 +157,36 @@ class ServingReplica:
         return doc
 
     # -- drain -------------------------------------------------------------
-    def request_drain(self):
+    def request_drain(self, deadline=None):
         """Mark the replica draining and wake whoever is blocked in
         :meth:`run_until_drained`. Idempotent, signal-safe (this is the
-        SIGTERM handler's body: no joins, no blocking)."""
+        SIGTERM handler's body: no joins, no blocking). ``deadline``
+        (seconds) arms a preemption budget: the blocking drain uses it
+        instead of its default timeout, migrating what cannot finish
+        when a handoff callable is armed."""
+        if deadline is not None:
+            self._drain_deadline_at = \
+                time.monotonic() + float(deadline)
         self._drain_gauge.set(1)
         self.engine._draining = True    # refuse from this instant
         self.engine._wake.set()
         self._drain_evt.set()
 
-    def drain(self, timeout=60.0):
-        """Execute the full drain: finish everything in flight, close
-        the cluster seat, stop the loop. Returns the process exit code —
-        :data:`EXIT_DRAINED` (0) on a clean drain, 1 when work had to be
-        abandoned (timeout or a crashed serve loop)."""
+    def drain(self, timeout=60.0, handoff=None):
+        """Execute the full drain: finish everything in flight (or,
+        with ``handoff`` and a deadline budget, migrate what does not
+        fit — see ``engine.drain``), close the cluster seat, stop the
+        loop. Returns the process exit code — :data:`EXIT_DRAINED` (0)
+        on a clean drain, 1 when work had to be abandoned (timeout or
+        a crashed serve loop). A stop after a deadline drain fails any
+        leftovers typed (:class:`EngineDraining`) so a fleet router
+        re-dispatches them — nothing is ever left unresolved."""
         self.request_drain()
+        if self._drain_deadline_at is not None:
+            # the preemption's budget, not the caller's default
+            timeout = budget_remaining(self._drain_deadline_at)
         with _spans.span("serve.drain", replica=self.name):
-            ok = self.engine.drain(timeout=timeout)
+            ok = self.engine.drain(timeout=timeout, handoff=handoff)
         if self.cluster is not None:
             try:
                 self.cluster.close()
@@ -174,23 +196,28 @@ class ServingReplica:
         return EXIT_DRAINED if ok else 1
 
     def install_signal_handlers(self, signals=(signal.SIGTERM,
-                                               signal.SIGINT)):
+                                               signal.SIGINT),
+                                deadline=None):
         """SIGTERM/SIGINT → :meth:`request_drain` (the handler only
         flips flags; the blocking drain runs in
-        :meth:`run_until_drained` on the main thread)."""
+        :meth:`run_until_drained` on the main thread). ``deadline``
+        arms the preemption budget the signal carries — a TPU
+        maintenance SIGTERM gives seconds, not minutes."""
         for s in signals:
-            signal.signal(s, lambda _s, _f: self.request_drain())
+            signal.signal(
+                s, lambda _s, _f: self.request_drain(deadline=deadline))
         return self
 
-    def run_until_drained(self, poll=0.25, timeout=60.0):
+    def run_until_drained(self, poll=0.25, timeout=60.0, handoff=None):
         """Block the main thread until a drain is requested (signal,
         gateway, or :meth:`request_drain`), then drain and return the
         exit code. A serve-loop crash also unblocks — with exit code 1
-        (the blackbox is already on disk by then)."""
+        (the blackbox is already on disk by then). ``handoff`` is the
+        deadline drain's migration callable (``engine.drain``)."""
         while not self._drain_evt.wait(poll):
             if self.engine._crashed is not None:
                 return 1
-        return self.drain(timeout=timeout)
+        return self.drain(timeout=timeout, handoff=handoff)
 
 
 class CircuitBreaker:
@@ -387,13 +414,23 @@ class FleetFuture:
             # the remainder, NEVER a fresh full timeout: attempt N+1's
             # engine-side deadline coincides with the original one
             kwargs["timeout"] = budget
+        # checkpoint rung first: a banked KV snapshot on the failed
+        # replica resumes decode where it left off instead of
+        # recomputing the prompt + every generated token from zero
         try:
-            idx, fut = rt._place(self._args, kwargs,
-                                 exclude=(self._idx,))
-        except ServingError as e:
-            # no survivor could take it — terminal, exactly once
-            self._fulfill(error=e)
-            raise
+            resumed = rt._resume_from_checkpoint(self, budget)
+        except Exception:   # noqa: BLE001 — recovery rung, best-effort
+            resumed = None
+        if resumed is not None:
+            idx, fut = resumed
+        else:
+            try:
+                idx, fut = rt._place(self._args, kwargs,
+                                     exclude=(self._idx,))
+            except ServingError as e:
+                # no survivor could take it — terminal, exactly once
+                self._fulfill(error=e)
+                raise
         rt._redispatches.inc()
         ev = {"from_replica": rt._name(self._idx),
               "to_replica": rt._name(idx), "reason": reason,
@@ -530,6 +567,14 @@ class FleetRouter:
             "serve_fleet_brownout_total",
             "requests stepped down by the shed policy's brownout hook "
             "instead of being refused")
+        self._handoffs = reg.counter(
+            "serve_fleet_handoff_total",
+            "drain-deadline requests migrated to a survivor (live-KV "
+            "inject or mid-flight recompute) instead of being dropped")
+        self._resumes = reg.counter(
+            "serve_fleet_resume_total",
+            "crash re-dispatches that resumed from a KV checkpoint "
+            "instead of recomputing from token zero")
         self._breaker_opens = reg.counter(
             "serve_fleet_breaker_open_total",
             "circuit-breaker trips (replica ejected from dispatch)",
@@ -707,10 +752,144 @@ class FleetRouter:
         fut._first_dispatch()
         return fut
 
-    def drain_replica(self, idx, timeout=60.0):
+    def drain_replica(self, idx, timeout=60.0, handoff=False):
         """Drain ONE replica (rolling-restart building block); the
-        router's failover routes everything new to the survivors."""
-        return self.replicas[idx].drain(timeout=timeout)
+        router's failover routes everything new to the survivors.
+        ``handoff=True`` arms live-KV migration: work that cannot
+        finish inside the budget moves to a survivor mid-flight
+        (snapshot inject, recompute fallback) instead of failing."""
+        cb = self._handoff_to_survivors(idx) if handoff else None
+        return self.replicas[idx].drain(timeout=timeout, handoff=cb)
+
+    # -- live-KV handoff (drain-deadline migration) ------------------------
+    def _handoff_to_survivors(self, idx):
+        """The draining engine's ``handoff(req, snapshot, budget)``
+        callable: the migration ladder. For each survivor in dispatch
+        order — (1) inject the sealed KV snapshot (continuation is
+        bitwise-identical, zero recomputed prefill); (2) on a typed
+        :class:`HandoffRefused` (corrupt frame, geometry mismatch) fall
+        back to recompute on the SAME survivor — corrupt KV is never
+        injected anywhere; (3) backpressure → next survivor. Returns
+        True once some survivor owns the request (a relay thread wires
+        its response into the original future), False when nobody could
+        take it (the engine then fails it typed → PR-16 re-dispatch)."""
+
+        def _handoff(req, snapshot, budget):
+            now = self._clock()
+            for sidx, _probing in self._order(now, exclude=(idx,)):
+                r = self.replicas[sidx]
+                fut = None
+                if snapshot is not None:
+                    eng = getattr(r, "engine", r)
+                    inject = getattr(eng, "inject_snapshot", None)
+                    if inject is not None:
+                        try:
+                            fut = inject(snapshot["meta"],
+                                         snapshot["frame"],
+                                         timeout=budget)
+                        except HandoffRefused:
+                            fut = None      # recompute, same survivor
+                        except _BACKPRESSURE:
+                            continue
+                        except _REPLICA_FAILURES as e:
+                            self._record_failure(sidx,
+                                                 type(e).__name__)
+                            continue
+                if fut is None:
+                    try:
+                        # the request's OWN remaining clock, not the
+                        # drain budget (that only bounds the handoff)
+                        fut = r.submit(
+                            list(req.prompt),
+                            max_new_tokens=req.max_new_tokens,
+                            temperature=req.temperature,
+                            top_k=req.top_k, eos_id=req.eos_id,
+                            timeout=budget_remaining(req.deadline),
+                            trace_id=req.trace_id)
+                    except _BACKPRESSURE:
+                        continue
+                    except _REPLICA_FAILURES as e:
+                        self._record_failure(sidx, type(e).__name__)
+                        continue
+                self._handoffs.inc()
+                _spans.event("request.handoff",
+                             from_replica=self._name(idx),
+                             to_replica=self._name(sidx),
+                             request=req.trace_id,
+                             migrated=snapshot is not None)
+                self._relay(fut, req.future)
+                return True
+            return False
+
+        return _handoff
+
+    @staticmethod
+    def _relay(src, dst):
+        """Pipe a survivor's future into the original request's future
+        from a daemon thread (the draining engine cannot block on its
+        peer's decode loop)."""
+
+        def _pipe():
+            try:
+                res = src.result(timeout=None)
+            except BaseException as e:      # noqa: BLE001 — relayed
+                if not dst.done():
+                    dst.set_error(e)
+            else:
+                if not dst.done():
+                    dst.set_result(res)
+
+        threading.Thread(target=_pipe, name="kv-handoff-relay",
+                         daemon=True).start()
+
+    def _resume_from_checkpoint(self, ffut, budget):
+        """Crash-recovery rung above recompute: if the dead replica's
+        engine banked a KV checkpoint for this request (snapshot_every
+        cadence), inject it into a survivor so decode resumes from the
+        last checkpoint instead of token zero. Returns ``(idx, fut)``
+        or None (no checkpoint / no engine access / survivor refused
+        typed → caller falls through to plain recompute)."""
+        trace_id = ffut._kwargs.get("trace_id")
+        if not trace_id or ffut._idx is None:
+            return None
+        dead = self.replicas[ffut._idx]
+        eng = getattr(dead, "engine", dead)
+        take = getattr(eng, "take_kv_checkpoint", None)
+        if take is None:
+            return None
+        try:
+            snap = take(trace_id)
+        except Exception:   # noqa: BLE001 — dead engine, best-effort
+            snap = None
+        if snap is None:
+            return None
+        now = self._clock()
+        for sidx, _probing in self._order(now, exclude=(ffut._idx,)):
+            seng = getattr(self.replicas[sidx], "engine",
+                           self.replicas[sidx])
+            inject = getattr(seng, "inject_snapshot", None)
+            if inject is None:
+                continue
+            try:
+                fut = inject(snap["meta"], snap["frame"],
+                             timeout=budget)
+            except HandoffRefused:
+                # typed refusal: corrupt/mismatched checkpoint — it
+                # would be refused everywhere; recompute instead
+                return None
+            except _BACKPRESSURE:
+                continue
+            except _REPLICA_FAILURES as e:
+                self._record_failure(sidx, type(e).__name__)
+                continue
+            self._resumes.inc()
+            self._submitted.inc()
+            _spans.event("request.resume_from_checkpoint",
+                         from_replica=self._name(ffut._idx),
+                         to_replica=self._name(sidx),
+                         request=trace_id)
+            return sidx, fut
+        return None
 
     def drain(self, timeout=60.0):
         """Drain every replica (the fleet-front gateway's POST /drain

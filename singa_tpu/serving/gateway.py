@@ -27,7 +27,14 @@ edge of the engine, not a web framework. Endpoints:
   exposed-communication seconds; engines built with
   ``profile_every=N`` refresh it continuously).
 - ``POST /drain`` — begin a graceful drain; 202 immediately (the drain
-  finishes in the background; watch ``/healthz``).
+  finishes in the background; watch ``/healthz``). ``?deadline=2.5``
+  (or ``{"deadline": 2.5}``) arms a preemption budget: finish what
+  fits, hand off / fail-typed the rest by the deadline.
+- ``POST /v1/inject`` — live-KV handoff receive: ``{"meta": b64,
+  "frame": b64, "timeout": secs}`` (a sealed snapshot from a draining
+  peer); 200 with the continuation's response, **409 on a typed
+  refusal** (corrupt frame, geometry mismatch) — the sender falls back
+  to recompute re-dispatch, corrupt KV is never injected.
 
 Request tracing: every ``/v1/generate`` / ``/v1/predict`` call gets a
 request id (``request_id`` in the body to supply your own, else a
@@ -49,13 +56,16 @@ half-written.
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 import uuid
+from urllib.parse import parse_qs, urlsplit
 
-from .scheduler import (BlockPoolExhausted, EngineDraining, QueueFull,
-                        ReplicaCrashed, RequestShed, RequestTimeout,
-                        ServingError, budget_remaining, deadline_in)
+from .scheduler import (BlockPoolExhausted, EngineDraining,
+                        HandoffRefused, QueueFull, ReplicaCrashed,
+                        RequestShed, RequestTimeout, ServingError,
+                        budget_remaining, deadline_in)
 
 
 def _result_doc(res):
@@ -105,13 +115,14 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                 "queue_depth": len(engine.queue),
                 "compiled": engine.compiled_step_info()}
 
-    def begin_drain():
+    def begin_drain(deadline=None):
         if replica is not None:
-            replica.request_drain()
+            replica.request_drain(deadline=deadline)
             # run_until_drained (the replica's main thread) finishes it;
             # a replica-less engine drains on a helper thread instead
             return
-        threading.Thread(target=engine.drain, daemon=True,
+        kw = {} if deadline is None else {"timeout": float(deadline)}
+        threading.Thread(target=engine.drain, kwargs=kw, daemon=True,
                          name="gateway-drain").start()
 
     class Handler(BaseHTTPRequestHandler):
@@ -221,10 +232,23 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
             self._rid = None
             try:
                 if self.path.startswith("/drain"):
-                    begin_drain()
-                    self._reply(202, {"status": "draining"})
+                    # ?deadline=2.5 arms a preemption budget: the
+                    # drain finishes what fits and migrates/fails the
+                    # rest by then instead of waiting out the default
+                    q = parse_qs(urlsplit(self.path).query)
+                    deadline = body.get("deadline")
+                    if deadline is None and q.get("deadline"):
+                        deadline = q["deadline"][0]
+                    begin_drain(deadline=None if deadline is None
+                                else float(deadline))
+                    doc = {"status": "draining"}
+                    if deadline is not None:
+                        doc["deadline_s"] = float(deadline)
+                    self._reply(202, doc)
                 elif self.path.startswith("/v1/generate"):
                     self._generate(body)
+                elif self.path.startswith("/v1/inject"):
+                    self._inject(body)
                 elif self.path.startswith("/v1/predict"):
                     self._predict(body)
                 else:
@@ -237,9 +261,18 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                     e, retryable=True, retry_after=e.retry_after),
                     headers=(("Retry-After",
                               str(max(1, int(e.retry_after)))),))
+            except HandoffRefused as e:
+                # typed inject refusal (corrupt frame, geometry
+                # mismatch): 409 — recompute-redispatch territory, NOT
+                # a fail-over-and-retry-the-same-bytes 503
+                self._reply(409, self._err(e, retryable=False))
             except (EngineDraining, QueueFull,
                     BlockPoolExhausted) as e:
-                self._reply(503, self._err(e, retryable=True))
+                # Retry-After rides every backpressure refusal: a
+                # draining replica tells the client when to re-probe
+                # the fleet instead of hammering this instance
+                self._reply(503, self._err(e, retryable=True),
+                            headers=(("Retry-After", "1"),))
             except RequestTimeout as e:
                 self._reply(504, self._err(e))
             except ReplicaCrashed as e:
@@ -291,6 +324,32 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
             if isinstance(doc, dict):
                 doc = dict(doc, request_id=rid)
             self._reply(200, doc)
+
+        def _inject(self, body):
+            # live-KV handoff receive: a draining/dying peer POSTs a
+            # sealed snapshot here; the engine validates (CRC +
+            # geometry) before ANY bytes touch the pool — a refusal is
+            # 409 and the sender falls back to recompute re-dispatch
+            try:
+                meta = base64.b64decode(body["meta"])
+                frame = base64.b64decode(body["frame"])
+            except (KeyError, TypeError, ValueError):
+                raise ValueError(
+                    "inject needs base64 'meta' and 'frame'")
+            eng = replica.engine if replica is not None else \
+                engine.engine if hasattr(engine, "engine") else engine
+            inject = getattr(eng, "inject_snapshot", None)
+            if inject is None:
+                raise ValueError(
+                    "this endpoint's engine does not accept KV "
+                    "snapshots")
+            wait = float(body["timeout"]) \
+                if body.get("timeout") is not None else default_timeout
+            deadline = deadline_in(wait)
+            fut = inject(meta, frame, timeout=wait)
+            doc = fut.result(timeout=budget_remaining(deadline))
+            self._reply(200, doc if isinstance(doc, dict)
+                        else {"tokens": doc})
 
         def _predict(self, body):
             if "input" not in body:

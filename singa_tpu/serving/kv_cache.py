@@ -318,6 +318,80 @@ def attend_pages(q, level, tables, q_pos, scale):
 
 
 # ---------------------------------------------------------------------------
+# host-RAM spill tier for evicted cached-prefix blocks
+# ---------------------------------------------------------------------------
+
+class HostSpillTier:
+    """Byte-budgeted host-RAM tier for evicted cached-prefix KV blocks.
+
+    When pool pressure evicts an unreferenced cached-prefix block
+    (:meth:`BlockManager._evict_lru`), its rows are pulled to host and
+    parked here as a CRC-sealed frame (:func:`integrity.seal_frame`)
+    keyed by the same chained content key the device prefix cache uses.
+    A later prefix hit RESTORES the rows into a fresh pool block instead
+    of re-prefilling the span — graceful degradation under pressure, not
+    recompute. LIVE blocks never reach this tier by construction:
+    eviction only ever selects refcount-0 cached blocks.
+
+    The budget is exact: an insert evicts LRU entries until the new
+    entry fits, and an entry larger than the whole budget is refused
+    outright. A frame that fails its CRC on the way back out is dropped
+    (counted in ``drops``) and the caller re-prefills — corrupt rows are
+    never restored into the pool."""
+
+    def __init__(self, budget_bytes):
+        from collections import OrderedDict
+        self.budget_bytes = int(budget_bytes)
+        self._entries = OrderedDict()   # key -> (meta, sealed_frame)
+        self.bytes_used = 0
+        self.drops = 0                  # CRC-failed frames discarded
+
+    def __len__(self):
+        return len(self._entries)
+
+    @staticmethod
+    def _size(meta, sealed):
+        return len(meta) + len(sealed)
+
+    def put(self, key, meta, payload):
+        """Seal and store one evicted block's rows. Returns True when
+        stored, False when the entry alone exceeds the byte budget."""
+        from .. import integrity as _integrity
+        meta = bytes(meta)
+        sealed = _integrity.seal_frame(meta, payload)
+        size = self._size(meta, sealed)
+        if size > self.budget_bytes:
+            return False
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes_used -= self._size(*old)
+        while self._entries and self.bytes_used + size > self.budget_bytes:
+            _k, (m, s) = self._entries.popitem(last=False)
+            self.bytes_used -= self._size(m, s)
+        self._entries[key] = (meta, sealed)
+        self.bytes_used += size
+        return True
+
+    def get(self, key):
+        """``(meta, payload)`` for a stored key after CRC verification,
+        or None (absent, or corrupt — corrupt entries are dropped)."""
+        from .. import integrity as _integrity
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        meta, sealed = entry
+        try:
+            payload = _integrity.open_frame(meta, sealed)
+        except _integrity.IntegrityError:
+            self._entries.pop(key, None)
+            self.bytes_used -= self._size(meta, sealed)
+            self.drops += 1
+            return None
+        self._entries.move_to_end(key)          # LRU refresh
+        return meta, payload
+
+
+# ---------------------------------------------------------------------------
 # paged block pool: host-side manager (allocation, refcounts, prefix cache)
 # ---------------------------------------------------------------------------
 
@@ -357,6 +431,28 @@ class BlockManager:
         self._cache = {}                        # chained key -> block id
         self._lru = {}                          # block id -> stamp
         self._tick = 0
+        # host-RAM spill tier (attach_spill): evicted cached prefixes
+        # park here instead of vanishing
+        self._spill = None
+        self._spill_read = None
+        self._spill_write = None
+        self._on_spill = None
+        self._on_restore = None
+        self.spilled_total = 0
+        self.restored_total = 0
+
+    def attach_spill(self, tier, reader, writer,
+                     on_spill=None, on_restore=None):
+        """Arm the host-RAM spill tier. The manager has no device
+        access, so the engine supplies ``reader(bid) -> (meta, bytes)``
+        (pull one pool block's rows to host) and
+        ``writer(bid, meta, payload)`` (push them back). ``on_spill`` /
+        ``on_restore`` are metric hooks called once per block moved."""
+        self._spill = tier
+        self._spill_read = reader
+        self._spill_write = writer
+        self._on_spill = on_spill
+        self._on_restore = on_restore
 
     # -- introspection (gauges, tests) -------------------------------------
     def blocks_live(self):
@@ -443,8 +539,44 @@ class BlockManager:
             self._ref[bid] += 1
             self._lru[bid] = self._tick
         fresh = [self._take_free() for _ in range(need)]
+        shared_tokens += self._restore_spilled(prompt, shared, fresh)
         return SlotAlloc(shared + fresh, shared_tokens,
                          len(prompt) // self.block_size)
+
+    def _restore_spilled(self, prompt, shared, fresh):
+        """Continue the prefix chain past the device-cache hit against
+        the spill tier: each consecutive hit restores its rows into the
+        next fresh block (which then re-enters the prefix cache under
+        its chained key) and extends the shared span — the tokens it
+        covers skip prefill. Returns extra shared tokens. Restored
+        blocks come out of the SAME ``fresh`` reservation, so admission
+        accounting (``can_admit``/``_reclaimable``) is unchanged."""
+        if self._spill is None or self._spill_write is None or not fresh:
+            return 0
+        keys = self._chain_keys(prompt)
+        cap = (len(prompt) - 1) // self.block_size   # match_prefix cap
+        restored = 0
+        for j in range(len(shared), cap):
+            if restored >= len(fresh):
+                break
+            hit = self._spill.get(keys[j])
+            if hit is None:
+                break
+            meta, payload = hit
+            bid = fresh[restored]
+            try:
+                self._spill_write(bid, meta, payload)
+            except Exception:
+                break       # degrade to re-prefilling the span
+            if keys[j] not in self._cache:
+                self._key[bid] = keys[j]
+                self._cache[keys[j]] = bid
+            self._lru[bid] = self._tick
+            restored += 1
+            self.restored_total += 1
+            if self._on_restore is not None:
+                self._on_restore()
+        return restored * self.block_size
 
     def _take_free(self):
         if not self._free:
@@ -455,11 +587,23 @@ class BlockManager:
 
     def _evict_lru(self):
         """Reclaim the least-recently-used CACHED block (refcount 0).
-        Callers guarantee one exists (can_admit/admit checked)."""
+        Callers guarantee one exists (can_admit/admit checked). With a
+        spill tier attached the victim's rows move to host RAM first —
+        only cached-prefix blocks ever reach this point, so a LIVE
+        block can never be spilled."""
         victim = min(
             (i for i in range(self.n_blocks)
              if self._ref[i] == 0 and self._key[i] is not None),
             key=lambda i: self._lru.get(i, 0))
+        if self._spill is not None and self._spill_read is not None:
+            try:
+                meta, payload = self._spill_read(victim)
+                if self._spill.put(self._key[victim], meta, payload):
+                    self.spilled_total += 1
+                    if self._on_spill is not None:
+                        self._on_spill()
+            except Exception:
+                pass        # spilling is best-effort; eviction is not
         del self._cache[self._key[victim]]
         self._key[victim] = None
         self._lru.pop(victim, None)
@@ -485,4 +629,5 @@ class BlockManager:
 
 __all__ = ["init_cache", "ring_positions", "ring_mask", "write_token",
            "write_prompt", "attend", "init_pool", "write_rows",
-           "gather_pages", "attend_pages", "SlotAlloc", "BlockManager"]
+           "gather_pages", "attend_pages", "SlotAlloc", "BlockManager",
+           "HostSpillTier"]

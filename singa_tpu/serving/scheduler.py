@@ -90,6 +90,16 @@ def budget_remaining(deadline, now=None):
                                 else time.monotonic()))
 
 
+class HandoffRefused(ServingError):
+    """A live-KV snapshot inject was refused, typed: the sealed frame
+    failed :func:`integrity.open_frame` (corruption in flight), or its
+    geometry/policy metadata does not match this engine's compiled
+    programs (layout, dtype, head/block shape, cache quantization).
+    Corrupt or wrong-shape KV state is NEVER written into a survivor's
+    pool — the caller falls back to plain recompute re-dispatch with
+    whatever deadline budget remains."""
+
+
 class BlockPoolExhausted(ServingError):
     """Admission refused: the paged KV block pool cannot cover the
     request's ``prompt + max_new_tokens`` reservation without evicting
@@ -275,5 +285,6 @@ class RequestQueue:
 
 __all__ = ["ServingError", "QueueFull", "EngineDraining",
            "RequestTimeout", "ReplicaCrashed", "RequestShed",
-           "BlockPoolExhausted", "ServeFuture", "Request",
-           "RequestQueue", "deadline_in", "budget_remaining"]
+           "BlockPoolExhausted", "HandoffRefused", "ServeFuture",
+           "Request", "RequestQueue", "deadline_in",
+           "budget_remaining"]

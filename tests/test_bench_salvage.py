@@ -282,3 +282,30 @@ def test_resnet_stem_env_and_banked(monkeypatch, capsys):
         {"event": "extra", "ts": _ts(60), "extra": "resnet_stem_ab",
          "winner": "space_to_depth"}])
     assert bench._resnet_stem() == ("space_to_depth", "measured-ab")
+
+
+def test_serving_sweep_banks_configs_across_leg_timeout():
+    """The sweep's per-config banking rides a caller-shared box, so a
+    _leg_guard timeout salvages every config that finished instead of
+    discarding the whole leg (the call site stamps the salvage
+    ``partial: True``)."""
+    import inspect
+    import time as _time
+    assert "out" in inspect.signature(
+        bench._measure_serving_sweep).parameters
+
+    def fake_sweep(out):
+        out["configs"] = []
+        out["configs"].append({"kv_layout": "ring", "slots": 2})
+        out["configs"].append({"kv_layout": "paged", "slots": 4})
+        _time.sleep(30)                 # the third config hangs
+
+    box = {}
+    try:
+        bench._leg_guard(lambda: fake_sweep(box), 0.3, "serving_sweep")
+        raise AssertionError("expected TimeoutError")
+    except TimeoutError:
+        pass
+    assert len(box["configs"]) == 2     # both finished configs survive
+    salvaged = dict(box, partial=True)
+    assert salvaged["partial"] and len(salvaged["configs"]) == 2

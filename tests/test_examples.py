@@ -372,3 +372,20 @@ class TestServeGatewayExample:
                            "--selftest", "3"])
         assert "SHARDED mesh=batch2xmodel2" in out, out[-500:]
         assert "SELFTEST OK" in out, out[-500:]
+
+    @pytest.mark.chaos
+    def test_serve_preempt_live_kv_handoff(self, tmp_path):
+        """The preemption drill, end to end in real subprocesses: a
+        two-replica fleet, SIGTERM one mid-request under a 2s-class
+        deadline — zero failed responses, migrated continuations
+        token-identical to an uninterrupted run, and STRICTLY fewer
+        re-prefilled tokens than the forced-recompute baseline (the
+        scenario is shared with ``tools/chaos_smoke.py
+        --only serve-preempt`` — one source of truth)."""
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "chaos_smoke", os.path.join(ROOT, "tools", "chaos_smoke.py"))
+        chaos_smoke = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(chaos_smoke)
+        chaos_smoke.scenario_serve_preempt(
+            str(tmp_path), chaos_smoke.Budget(300))

@@ -1,0 +1,445 @@
+"""Live KV handoff suite (CPU, fast tier): preemption-deadline drain,
+migrate-don't-recompute failover, and the host-RAM spill tier.
+
+- extract → inject continuation is BITWISE identical to an
+  uninterrupted greedy run, for the ring, paged, int8-KV, and
+  speculative engines — and the injected slot never retraces the
+  decode program (``n_traces == 1``);
+- a corrupt frame or geometry mismatch is a TYPED refusal
+  (``HandoffRefused``, counted) — corrupt KV is never written into a
+  pool, and the target engine keeps serving;
+- fleet drain with ``handoff=True`` migrates in-flight KV to a
+  survivor (zero re-prefilled tokens), and a ``corrupt_handoff`` fault
+  degrades to recompute re-dispatch — still token-identical;
+- cadence checkpoints (``snapshot_every``) let a crashed replica's
+  re-dispatch resume mid-stream instead of from token zero;
+- BlockManager spill-tier invariants: a LIVE block is never spilled,
+  a restored prefix keeps its chained content key, and the host tier's
+  byte budget is exact (oversized entries refused outright);
+- gateway: ``POST /drain?deadline=``, draining 503s carry Retry-After,
+  ``/healthz`` exposes the remaining drain deadline, and ``/v1/inject``
+  accepts sealed snapshots (409 on refusal).
+"""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, integrity
+from singa_tpu.models import transformer
+from singa_tpu.observability import metrics as obs_metrics
+from singa_tpu.resilience.faults import FaultPlan
+from singa_tpu.serving import (FleetRouter, HandoffRefused, HostSpillTier,
+                               ServingReplica, serve_gateway)
+from singa_tpu.serving.kv_cache import BlockManager
+from singa_tpu.serving.scheduler import ReplicaCrashed
+from singa_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.serving
+
+DEV = device.create_cpu_device()
+
+PROMPT = [3, 1, 4, 1, 5]
+
+
+def _reg():
+    return obs_metrics.MetricsRegistry()
+
+
+def tiny_lm(vocab=19, max_len=64):
+    """Fresh tiny LM with DETERMINISTIC weights: the device PRNG key
+    must be re-seeded (np.random alone is not enough — gaussian/uniform
+    init draws from the device key), so two separately built models are
+    weight-identical and cross-engine token comparisons are meaningful."""
+    DEV.set_rand_seed(0)
+    np.random.seed(0)
+    m = transformer.TransformerLM(vocab, d_model=16, n_heads=2,
+                                  n_layers=2, max_len=max_len, tp=False)
+    m.eval()
+    m(Tensor(data=np.zeros((1, 4), np.float32), device=DEV,
+             requires_grad=False))
+    return m
+
+
+PAGED = dict(kv_layout="paged", kv_block_size=4, kv_blocks=24)
+
+
+def _engine(m, reg, **kw):
+    return m.compile_serving(slots=2, max_len=48, prefill_len=8,
+                             registry=reg, **kw)
+
+
+def _step_until_midflight(eng, max_new, ticks=12):
+    """Drive the (unstarted) engine one tick at a time until some slot
+    holds a request with ≥2 generated tokens but is not finished —
+    the snapshot must capture genuinely mid-flight state."""
+    for _ in range(ticks):
+        eng.step()
+        for i, slot in enumerate(eng._slots):
+            if slot is not None and len(slot["req"].tokens) >= 2:
+                assert len(slot["req"].tokens) < max_new
+                return i
+    raise AssertionError("never reached mid-flight state")
+
+
+def _serving_kw(name):
+    if name == "ring":
+        return {}
+    if name == "paged":
+        return dict(PAGED)
+    if name == "int8":
+        from singa_tpu import mixed_precision as mp
+        return dict(policy=mp.resolve("int8_weight_only"))
+    if name == "spec":
+        return dict(PAGED, speculative_k=3)
+    raise ValueError(name)
+
+
+class TestSnapshotInjectIdentity:
+    @pytest.mark.parametrize("cfg", ["ring", "paged", "int8", "spec"])
+    def test_continuation_bitwise_identical(self, cfg):
+        """THE handoff acceptance pin: run the reference uninterrupted
+        on the source, then snapshot a second run mid-flight and inject
+        it into a weight-identical target — the migrated future's full
+        token list equals the reference, the target never re-prefills,
+        and its decode program stays single-trace."""
+        kw = _serving_kw(cfg)
+        m = tiny_lm()
+        reg_src, reg_dst = _reg(), _reg()
+        src = _engine(m, reg_src, **kw)
+        dst = _engine(m, reg_dst, **kw)
+
+        ref_fut = src.submit(PROMPT, max_new_tokens=12)
+        src.run_until_idle()
+        ref = ref_fut.result(timeout=10)["tokens"]
+        assert len(ref) == 12
+
+        fut = src.submit(PROMPT, max_new_tokens=12, trace_id="mig")
+        i = _step_until_midflight(src, 12)
+        snap = src.snapshot_slot(i)
+
+        out_fut = dst.inject_snapshot(snap["meta"], snap["frame"])
+        dst.run_until_idle()
+        out = out_fut.result(timeout=10)
+        assert out["tokens"] == ref, (cfg, out["tokens"], ref)
+        assert dst.compiled_step_info()["n_traces"] == 1
+        assert reg_dst.get("serve_handoff_in_total").value() == 1
+        # migrate, don't recompute: the target never prefilled a token
+        assert reg_dst.get("serve_prefill_tokens_total").value() == 0
+
+        # donation survives inject: the injected buffers feed the next
+        # tick like any other — a fresh request still serves, still on
+        # the one trace
+        fut2 = dst.submit([2, 7, 1], max_new_tokens=4)
+        dst.run_until_idle()
+        assert len(fut2.result(timeout=10)["tokens"]) == 4
+        assert dst.compiled_step_info()["n_traces"] == 1
+        src.stop()
+        dst.stop()
+        del fut
+
+
+class TestHandoffRefusal:
+    def _midflight_snapshot(self):
+        m = tiny_lm()
+        reg = _reg()
+        src = _engine(m, reg, **PAGED)
+        src.submit(PROMPT, max_new_tokens=12)
+        i = _step_until_midflight(src, 12)
+        snap = src.snapshot_slot(i)
+        return m, src, snap
+
+    def test_corrupt_frame_and_geometry_mismatch_refused_typed(self):
+        """One flipped bit → CRC refusal; an intact frame from a
+        different geometry (other ring length, other layout) → geometry
+        refusal. Both typed, both counted, and the target engine keeps
+        serving — corrupt KV is never written."""
+        m, src, snap = self._midflight_snapshot()
+        reg_dst = _reg()
+        # same weights, different geometry: ring layout, shorter ring
+        dst = m.compile_serving(slots=2, max_len=32, prefill_len=8,
+                                registry=reg_dst)
+        bad = snap["frame"][:-1] + bytes([snap["frame"][-1] ^ 1])
+        with pytest.raises(HandoffRefused):
+            dst.inject_snapshot(snap["meta"], bad)
+        with pytest.raises(HandoffRefused):
+            dst.inject_snapshot(snap["meta"], snap["frame"])
+        assert reg_dst.get("serve_handoff_refused_total").value() == 2
+        assert len(dst._injects) == 0
+        # the refusals left the target untouched: it still serves
+        fut = dst.submit([1, 2], max_new_tokens=3)
+        dst.run_until_idle()
+        assert len(fut.result(timeout=10)["tokens"]) == 3
+        src.stop()
+        dst.stop()
+
+
+class TestFleetHandoff:
+    def _pair(self, src_kw=None, survivor_started=True):
+        """(src engine+replica, survivor engine+replica, router) — the
+        source is NOT started so tests can drive it tick by tick into a
+        deterministic mid-flight state before draining."""
+        m = tiny_lm()
+        reg0, reg1 = _reg(), _reg()
+        e0 = _engine(m, reg0, **dict(PAGED, **(src_kw or {})))
+        e1 = _engine(m, reg1, **PAGED)
+        r0 = ServingReplica(e0, name="r0", registry=reg0)
+        r1 = ServingReplica(e1, name="r1", registry=reg1)
+        rreg = _reg()
+        rt = FleetRouter([r0, r1], registry=rreg)
+        # reference comes from the survivor BEFORE it starts (greedy
+        # determinism: prefix-cache reuse never changes the tokens)
+        ref_fut = e1.submit(PROMPT, max_new_tokens=24)
+        e1.run_until_idle()
+        ref = ref_fut.result(timeout=10)["tokens"]
+        pf_base = reg1.get("serve_prefill_tokens_total").value()
+        if survivor_started:
+            r1.start()
+        return e0, e1, reg0, reg1, rt, rreg, ref, pf_base, r1
+
+    def test_drain_handoff_migrates_token_identical(self):
+        e0, e1, reg0, reg1, rt, rreg, ref, pf_base, r1 = self._pair()
+        fut = e0.submit(PROMPT, max_new_tokens=24, trace_id="mig-1")
+        _step_until_midflight(e0, 24)
+        # budget below the snapshot reserve: everything must migrate
+        code = rt.drain_replica(0, timeout=0.05, handoff=True)
+        assert code == 0
+        assert fut.result(timeout=60)["tokens"] == ref
+        assert reg0.get("serve_handoff_out_total").value() >= 1
+        assert reg1.get("serve_handoff_in_total").value() >= 1
+        assert rreg.get("serve_fleet_handoff_total").value() >= 1
+        # the survivor continued the KV — it re-prefilled NOTHING
+        assert reg1.get("serve_prefill_tokens_total").value() == pf_base
+        r1.drain(timeout=30)
+
+    def test_corrupt_handoff_falls_back_to_recompute(self):
+        """``faults.corrupt_handoff`` flips a bit in the sealed frame
+        on extract: the survivor refuses it typed and the router
+        degrades to recompute re-dispatch — the response is still
+        token-identical, delivered exactly once."""
+        faults = FaultPlan()
+        faults.corrupt_handoff(1, times=1)
+        e0, e1, reg0, reg1, rt, rreg, ref, pf_base, r1 = \
+            self._pair(src_kw=dict(faults=faults))
+        fut = e0.submit(PROMPT, max_new_tokens=24, trace_id="corrupt-1")
+        _step_until_midflight(e0, 24)
+        code = rt.drain_replica(0, timeout=0.05, handoff=True)
+        assert code == 0
+        assert fut.result(timeout=60)["tokens"] == ref
+        assert fut.deliveries == 1
+        assert reg1.get("serve_handoff_refused_total").value() >= 1
+        # recompute path: the survivor DID prefill this time
+        assert reg1.get("serve_prefill_tokens_total").value() > pf_base
+        r1.drain(timeout=30)
+
+    def test_checkpoint_resume_after_crash(self):
+        """``snapshot_every`` cadence checkpoints survive a serve-loop
+        crash in host memory: the fleet re-dispatch injects the newest
+        one into a survivor and resumes mid-stream — token-identical,
+        zero re-prefilled tokens."""
+        e0, e1, reg0, reg1, rt, rreg, ref, pf_base, r1 = \
+            self._pair(src_kw=dict(snapshot_every=1))
+        ff = rt.submit(PROMPT, max_new_tokens=24, timeout=60,
+                       trace_id="ckpt-1")
+        _step_until_midflight(e0, 24)
+        assert e0.take_kv_checkpoint("ckpt-1") is not None
+        assert reg0.get("serve_kv_checkpoint_total").value() >= 1
+        e0._crashed = RuntimeError("injected crash")
+        e0._fail_inflight(ReplicaCrashed("injected"))
+        assert ff.result(timeout=60)["tokens"] == ref
+        assert rreg.get("serve_fleet_resume_total").value() >= 1
+        assert reg1.get("serve_prefill_tokens_total").value() == pf_base
+        r1.drain(timeout=30)
+
+
+class TestSpillTierUnits:
+    """BlockManager + HostSpillTier invariants with a fake device
+    (reader/writer close over a dict) — no engine, no compile."""
+
+    def _mgr(self, n_blocks=4, block_size=2, budget=1 << 16):
+        mgr = BlockManager(n_blocks, block_size)
+        tier = HostSpillTier(budget)
+        store = {}
+
+        def reader(bid):
+            return b"meta", store.get(bid, b"rows-%d" % bid)
+
+        writes = []
+
+        def writer(bid, meta, payload):
+            writes.append((bid, meta, payload))
+            store[bid] = payload
+
+        mgr.attach_spill(tier, reader, writer)
+        return mgr, tier, writes
+
+    def test_live_blocks_are_never_spilled(self):
+        """Eviction only ever selects refcount-0 cached blocks; when
+        live blocks pin the whole pool, admission fails typed and the
+        spill tier stays empty."""
+        from singa_tpu.serving.scheduler import BlockPoolExhausted
+        mgr, tier, _ = self._mgr(n_blocks=4, block_size=2)
+        a = mgr.admit([1, 2, 3, 4], 8)          # all 4 blocks live
+        assert mgr.blocks_live() == 4
+        with pytest.raises(BlockPoolExhausted):
+            mgr.admit([9, 8], 4)
+        assert len(tier) == 0 and mgr.spilled_total == 0
+        mgr.release(a, [1, 2, 3, 4])
+
+    def test_evict_spills_and_restore_keeps_chained_key(self):
+        """Releasing a prompt caches its full blocks; pool pressure
+        spills them to the host tier; re-admitting the same prompt
+        restores them into fresh blocks under the SAME chained content
+        key (so the whole preceding context is still guaranteed)."""
+        mgr, tier, writes = self._mgr(n_blocks=4, block_size=2)
+        prompt = [1, 2, 3, 4, 5]                # 2 full blocks + tail
+        keys = mgr._chain_keys(prompt)
+        a = mgr.admit(prompt, 6)
+        mgr.release(a, prompt)
+        assert mgr.blocks_cached() == 2
+        # pressure: a disjoint admission reclaims the cached blocks
+        b = mgr.admit([9, 8, 7, 6], 8)
+        assert mgr.spilled_total == 2 and len(tier) == 2
+        mgr.release(b, [9, 8, 7, 6])
+        for bid in b.blocks:                    # evict B's cache too
+            if mgr._key[bid] is not None:
+                del mgr._cache[mgr._key[bid]]
+                mgr._key[bid] = None
+                mgr._free.append(bid)
+        c = mgr.admit(prompt, 6)
+        assert mgr.restored_total == 2
+        assert c.shared_tokens == 4             # restored span skips prefill
+        assert writes, "restore never reached the device writer"
+        restored_bids = [w[0] for w in writes]
+        for j, bid in enumerate(restored_bids):
+            assert mgr._key[bid] == keys[j]
+            assert mgr._cache[keys[j]] == bid
+
+    def test_byte_budget_exact_and_oversized_refused(self):
+        meta, payload = b"m" * 4, b"p" * 60
+        size = len(meta) + len(integrity.seal_frame(meta, payload))
+        tier = HostSpillTier(size * 2)          # room for exactly two
+        assert tier.put("a", meta, payload)
+        assert tier.put("b", meta, payload)
+        assert tier.bytes_used == 2 * size
+        assert tier.put("c", meta, payload)     # evicts LRU ("a")
+        assert tier.bytes_used == 2 * size
+        assert tier.get("a") is None
+        assert tier.get("b") is not None and tier.get("c") is not None
+        assert not tier.put("big", meta, payload * 100)
+        assert tier.bytes_used == 2 * size
+        assert len(tier) == 2
+
+    def test_corrupt_spilled_frame_dropped_not_restored(self):
+        tier = HostSpillTier(1 << 16)
+        tier.put("k", b"meta", b"payload")
+        m, sealed = tier._entries["k"]
+        tier._entries["k"] = (m, sealed[:-1] +
+                              bytes([sealed[-1] ^ 1]))
+        assert tier.get("k") is None
+        assert tier.drops == 1 and len(tier) == 0
+
+    def test_engine_spill_restore_roundtrip(self):
+        """End-to-end on a real paged engine with a tight pool: serving
+        three disjoint prompts evicts (and spills) the first one's
+        cached prefix; re-serving it restores instead of re-prefilling,
+        and the tokens match the first run exactly."""
+        m = tiny_lm()
+        reg = _reg()
+        eng = m.compile_serving(slots=1, max_len=24, prefill_len=8,
+                                registry=reg, kv_layout="paged",
+                                kv_block_size=4, kv_blocks=6,
+                                spill_bytes=4 << 20)
+        rng = np.random.RandomState(7)
+        prompts = [list(map(int, rng.randint(1, 19, (8,))))
+                   for _ in range(3)]
+        first = {}
+        for p in prompts:
+            fut = eng.submit(p, max_new_tokens=4)
+            eng.run_until_idle()
+            first[tuple(p)] = fut.result(timeout=10)["tokens"]
+        assert eng._mgr.spilled_total >= 1
+        assert reg.get("serve_kv_spill_total").value() >= 1
+        fut = eng.submit(prompts[0], max_new_tokens=4)
+        eng.run_until_idle()
+        assert fut.result(timeout=10)["tokens"] == \
+            first[tuple(prompts[0])]
+        assert eng._mgr.restored_total >= 1
+        assert reg.get("serve_kv_restore_total").value() >= 1
+        assert reg.get("serve_kv_spill_bytes").value() > 0
+        eng.stop()
+
+
+class TestGatewayHandoff:
+    def _client(self, port):
+        import http.client
+        return http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+
+    def _post(self, port, path, doc):
+        c = self._client(port)
+        try:
+            c.request("POST", path, json.dumps(doc))
+            r = c.getresponse()
+            body = json.loads(r.read().decode() or "{}")
+            return r.status, body, dict(r.getheaders())
+        finally:
+            c.close()
+
+    def _get(self, port, path):
+        c = self._client(port)
+        try:
+            c.request("GET", path)
+            r = c.getresponse()
+            return r.status, r.read().decode()
+        finally:
+            c.close()
+
+    def test_inject_endpoint_and_deadline_drain(self):
+        m = tiny_lm()
+        reg_src, reg_dst = _reg(), _reg()
+        src = _engine(m, reg_src, **PAGED)
+        dst = _engine(m, reg_dst, **PAGED)
+
+        ref_fut = src.submit(PROMPT, max_new_tokens=12)
+        src.run_until_idle()
+        ref = ref_fut.result(timeout=10)["tokens"]
+        src.submit(PROMPT, max_new_tokens=12)
+        i = _step_until_midflight(src, 12)
+        snap = src.snapshot_slot(i)
+
+        rep = ServingReplica(dst, name="gw", registry=reg_dst).start()
+        server, port = serve_gateway(dst, replica=rep)
+        try:
+            doc = {"meta":
+                   base64.b64encode(snap["meta"]).decode(),
+                   "frame":
+                   base64.b64encode(snap["frame"]).decode()}
+            st, out, _h = self._post(port, "/v1/inject", doc)
+            assert st == 200 and out["tokens"] == ref
+            bad = dict(doc, frame=base64.b64encode(
+                snap["frame"][:-1] +
+                bytes([snap["frame"][-1] ^ 1])).decode())
+            st, out, _h = self._post(port, "/v1/inject", bad)
+            assert st == 409, out
+            assert reg_dst.get("serve_handoff_refused_total") \
+                .value() >= 1
+
+            st, out, _h = self._post(port, "/drain?deadline=30", {})
+            assert st == 202 and out.get("deadline_s") is not None
+            st, body = self._get(port, "/healthz")
+            assert st == 503
+            health = json.loads(body)
+            assert health["status"] == "draining"
+            assert health.get("drain_deadline_s") is not None
+            st, out, hdrs = self._post(port, "/v1/generate",
+                                       {"prompt": [1],
+                                        "max_new_tokens": 1})
+            assert st == 503 and out.get("retryable")
+            assert hdrs.get("Retry-After") == "1"
+        finally:
+            server.shutdown()
+            server.server_close()
+            rep.drain(timeout=10)
+            src.stop()

@@ -48,12 +48,20 @@ harness) against ``examples/train_elastic.py``:
    ejects the corpse (gauge → open) and the redispatch/failover
    counters ride ``heartbeat_summary``. Banks the recovered-request
    count and the kill window's p99 time-to-response.
-9. **warm-restart** — cold-start elimination (``singa_tpu.aot``): a
-   trainer and a serving replica restarted against a populated AOT
-   cache reach the first step / first served token measurably faster
-   than their cold baselines, with ZERO ``source="fresh"`` compiles
-   and ``n_traces`` still 1 — every executable deserialized from an
-   artifact or served from the persistent compile cache.
+9. **serve-preempt** — preemption-deadline drain with live-KV
+   handoff: a replica with ``--handoff-peers`` and a sub-second
+   ``--drain-deadline`` takes a SIGTERM mid-stream; zero failed client
+   responses, migrated continuations token-identical to uninterrupted
+   runs, the drain honors the deadline, and the handoff leg recomputes
+   STRICTLY fewer prefill tokens on the survivor than a forced
+   re-dispatch baseline; plus the host-RAM spill tier (evicted cached
+   prefixes spill under pool pressure and restore on a re-prompt).
+10. **warm-restart** — cold-start elimination (``singa_tpu.aot``): a
+    trainer and a serving replica restarted against a populated AOT
+    cache reach the first step / first served token measurably faster
+    than their cold baselines, with ZERO ``source="fresh"`` compiles
+    and ``n_traces`` still 1 — every executable deserialized from an
+    artifact or served from the persistent compile cache.
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
 smoke is bounded by ``--budget`` seconds end to end (default 420) —
@@ -868,6 +876,270 @@ def scenario_serve_crash(root, budget):
                 p.kill()
 
 
+def scenario_serve_preempt(root, budget):
+    """Preemption-deadline drain with live-KV handoff: two gateway
+    replicas; replica 0 runs with ``--handoff-peers <survivor>`` and a
+    sub-second ``--drain-deadline``, takes a SIGTERM mid-stream, and
+    must (a) migrate what cannot finish — zero failed client
+    responses, every migrated continuation token-identical to an
+    uninterrupted run on the survivor, (b) report ``DRAIN_DONE``
+    within the deadline (plus process slack), never the full drain
+    timeout, (c) move the handoff counters on the survivor. A second
+    leg re-runs the SAME workload against a replica WITHOUT peers (the
+    forced re-dispatch baseline) and asserts the handoff leg recomputed
+    STRICTLY fewer prefill tokens on the survivor. A final sub-step
+    exercises the host-RAM spill tier on the survivor (evict a cached
+    prefix under pool pressure, re-prompt, assert spill+restore
+    counters moved)."""
+    import http.client
+    import signal as _signal
+    import threading
+
+    serve = os.path.join(REPO, "examples", "serve_transformer.py")
+    deadline_s = 0.2
+
+    def _get_json(port, path, timeout=10):
+        c = http.client.HTTPConnection("127.0.0.1", port,
+                                       timeout=timeout)
+        try:
+            c.request("GET", path)
+            r = c.getresponse()
+            return r.status, json.loads(r.read().decode() or "{}")
+        finally:
+            c.close()
+
+    def _counter_total(port, name):
+        _st, doc = _get_json(port, "/metrics.json")
+        for m in doc.get("metrics", []):
+            if m.get("name") == name:
+                return sum(s.get("value", 0)
+                           for s in m.get("series", []))
+        return 0
+
+    def _wait_ready(ports_up):
+        deadline = time.monotonic() + min(120, budget.remaining())
+        up = set()
+        while len(up) < len(ports_up) and time.monotonic() < deadline:
+            for p in ports_up:
+                if p in up:
+                    continue
+                try:
+                    st, _ = _get_json(p, "/healthz", timeout=2)
+                    if st == 200:
+                        up.add(p)
+                except OSError:
+                    time.sleep(0.2)
+        return len(up) == len(ports_up)
+
+    # paged + small pool + spill tier on BOTH replicas: the survivor's
+    # pool pressure drives the spill sub-step, and snapshots need the
+    # same geometry on both ends
+    base = ["--cpu", "--slots", "2", "--max-len", "96",
+            "--prefill-len", "16", "--vocab", "32", "--d-model", "16",
+            "--layers", "1", "--kv-layout", "paged",
+            "--kv-block-size", "8", "--kv-blocks", "12",
+            "--spill-bytes", str(4 << 20)]
+    survivor_port = _free_port()
+    surv = subprocess.Popen(
+        [sys.executable, serve, "--port", str(survivor_port)] + base,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    N, new_tokens = 6, 64
+    rng = np.random.RandomState(7)
+
+    def _leg_prompts():
+        # distinct 16-token prompts (2 full blocks each): measurable
+        # prefill cost, no accidental shared prefixes — and a FRESH
+        # set per leg, so the handoff leg cannot warm the survivor's
+        # prefix/spill caches for the baseline leg's workload
+        return [rng.randint(1, 32, (16,)).tolist() for _ in range(N)]
+
+    def run_leg(name, with_peers, prompts):
+        """One preemption leg against a fresh replica 0; returns the
+        survivor's kill-window prefill-token delta for the leg."""
+        port0 = _free_port()
+        extra = ["--drain-deadline", str(deadline_s),
+                 "--drain-timeout", "60"]
+        if with_peers:
+            extra += ["--handoff-peers", str(survivor_port)]
+        p0 = subprocess.Popen(
+            [sys.executable, serve, "--port", str(port0)]
+            + base + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            _check(_wait_ready([port0]),
+                   f"serve-preempt/{name}: replica 0 READY")
+            pf_before = _counter_total(survivor_port,
+                                       "serve_prefill_tokens_total")
+            results = [None] * N
+
+            def one(i):
+                body = json.dumps({"prompt": prompts[i],
+                                   "max_new_tokens": new_tokens,
+                                   "temperature": 0.0,
+                                   "timeout": 120.0})
+                order = [port0, survivor_port]
+                last = None
+                for attempt in range(12):
+                    port = order[min(attempt, 1)] if attempt < 2 \
+                        else order[attempt % 2]
+                    try:
+                        c = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=120)
+                        c.request("POST", "/v1/generate", body)
+                        r = c.getresponse()
+                        doc = json.loads(r.read().decode() or "{}")
+                        c.close()
+                    except OSError as e:
+                        last = ("conn", str(e))
+                        time.sleep(0.2)
+                        continue
+                    if r.status == 200:
+                        results[i] = doc
+                        return
+                    last = (r.status, doc)
+                    time.sleep(0.2)
+                results[i] = ("FAILED", last)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(N)]
+            for t in threads:
+                t.start()
+            # SIGTERM the moment replica 0 actually holds admitted
+            # work — in-flight slots are what the snapshot handoff
+            # migrates, queued work rides the recompute rung
+            kill_by = time.monotonic() + 30
+            while time.monotonic() < kill_by:
+                try:
+                    _st, h = _get_json(port0, "/healthz", timeout=2)
+                except OSError:
+                    break
+                if (h.get("active_slots") or 0) >= 1 and \
+                        h.get("queue_depth", 0) >= 1:
+                    break
+                time.sleep(0.01)
+            p0.send_signal(_signal.SIGTERM)
+            for t in threads:
+                t.join(timeout=budget.remaining())
+            rc0 = p0.wait(timeout=budget.remaining())
+            out0 = p0.communicate()[0]
+
+            bad = [(i, r) for i, r in enumerate(results)
+                   if not isinstance(r, dict)
+                   or len(r.get("tokens", [])) != new_tokens]
+            _check(not bad,
+                   f"serve-preempt/{name}: zero failed client "
+                   f"responses ({len(bad)} bad)",
+                   repr(bad[:3]) + "\n" + out0)
+            # the deadline was honored: DRAIN_DONE well inside the
+            # 60s drain timeout (generous slack covers handoff POSTs
+            # + process teardown on a loaded CPU host)
+            done = [ln for ln in out0.splitlines()
+                    if ln.startswith("DRAIN_DONE in=")]
+            _check(len(done) == 1,
+                   f"serve-preempt/{name}: DRAIN_DONE printed", out0)
+            took = float(done[0].split("=")[1].rstrip("s"))
+            _check(took < deadline_s + 10.0,
+                   f"serve-preempt/{name}: drain honored the "
+                   f"{deadline_s}s deadline (took {took:.2f}s)", out0)
+            if with_peers:
+                _check(rc0 == 0,
+                       f"serve-preempt/{name}: clean handoff drain "
+                       f"exits 0 (got {rc0})", out0)
+            # the kill-window recompute work, measured BEFORE the
+            # reference re-runs below (those get prefix-cache hits
+            # from the kill-window serves — their cost is not a
+            # constant that can be subtracted back out)
+            pf_after = _counter_total(survivor_port,
+                                      "serve_prefill_tokens_total")
+            # migrated continuations must be token-identical to an
+            # uninterrupted greedy run (identical seed-0 weights)
+            for i in range(N):
+                c = http.client.HTTPConnection(
+                    "127.0.0.1", survivor_port, timeout=120)
+                c.request("POST", "/v1/generate",
+                          json.dumps({"prompt": prompts[i],
+                                      "max_new_tokens": new_tokens,
+                                      "temperature": 0.0}))
+                ref = json.loads(c.getresponse().read())
+                c.close()
+                if results[i]["tokens"] != ref["tokens"]:
+                    raise AssertionError(
+                        f"serve-preempt/{name}: request {i} diverged "
+                        f"from the uninterrupted run: "
+                        f"{results[i]['tokens']} != {ref['tokens']}")
+            print(f"  ok: serve-preempt/{name}: all {N} responses "
+                  f"token-identical to uninterrupted runs")
+            return pf_after - pf_before, out0
+        finally:
+            if p0.poll() is None:
+                p0.kill()
+                p0.wait(timeout=20)
+
+    try:
+        _check(_wait_ready([survivor_port]),
+               "serve-preempt: survivor READY")
+        handoff_delta, out_h = run_leg("handoff", with_peers=True,
+                                       prompts=_leg_prompts())
+        h_in = _counter_total(survivor_port, "serve_handoff_in_total")
+        _check(h_in >= 1,
+               f"serve-preempt: survivor injected >=1 live-KV "
+               f"snapshot (serve_handoff_in_total={h_in})", out_h)
+        prompts = _leg_prompts()
+        baseline_delta, _out_b = run_leg("baseline", with_peers=False,
+                                         prompts=prompts)
+        _check(handoff_delta < baseline_delta,
+               f"serve-preempt: handoff leg recomputed strictly fewer "
+               f"prefill tokens ({handoff_delta} < {baseline_delta})")
+
+        # spill tier: the survivor's pool (12 blocks) cannot hold a
+        # full request + the previous request's cached prefix, so each
+        # admission evicts-and-spills the prior prefix; re-prompting
+        # restores it from host RAM instead of re-prefilling
+        sp_before = _counter_total(survivor_port, "serve_kv_spill_total")
+        rs_before = _counter_total(survivor_port,
+                                   "serve_kv_restore_total")
+        for p in (prompts[0], prompts[1], prompts[2], prompts[0]):
+            c = http.client.HTTPConnection("127.0.0.1", survivor_port,
+                                           timeout=120)
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": p,
+                                  "max_new_tokens": new_tokens,
+                                  "temperature": 0.0}))
+            r = c.getresponse()
+            _check(r.status == 200,
+                   f"serve-preempt/spill: request served "
+                   f"({r.status})", r.read().decode())
+            c.close()
+        spills = _counter_total(survivor_port,
+                                "serve_kv_spill_total") - sp_before
+        restores = _counter_total(survivor_port,
+                                  "serve_kv_restore_total") - rs_before
+        _check(spills >= 1 and restores >= 1,
+               f"serve-preempt/spill: spill+restore counters moved "
+               f"(spills={spills} restores={restores})")
+
+        # survivor never retraced through all of it
+        _st, h = _get_json(survivor_port, "/healthz")
+        _check(h["status"] == "serving"
+               and h["compiled"]["n_traces"] == 1,
+               "serve-preempt: survivor serving, decode traced once")
+        BANK["serve-preempt"] = {
+            "handoff_prefill_tokens": int(handoff_delta),
+            "baseline_prefill_tokens": int(baseline_delta),
+            "snapshot_injects": int(h_in),
+            "spills": int(spills), "restores": int(restores),
+        }
+    finally:
+        if surv.poll() is None:
+            surv.terminate()
+        try:
+            surv.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            surv.kill()
+
+
 def scenario_warm_restart(root, budget):
     """Cold-start elimination (``singa_tpu.aot``): kill a trainer and
     a serving replica, restart both against the populated AOT cache,
@@ -1041,6 +1313,7 @@ SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("data-resume", scenario_data_resume),
              ("serve-drain", scenario_serve_drain),
              ("serve-crash", scenario_serve_crash),
+             ("serve-preempt", scenario_serve_preempt),
              ("warm-restart", scenario_warm_restart)]
 
 
