@@ -13,6 +13,15 @@ Modes:
   gateway from client threads, assert every one returns exactly once
   with the requested token count and that the decode program traced
   exactly once, print ``SELFTEST OK`` and exit 0 (the CI smoke).
+- ``--autoscale MIN``: fleet mode. MIN in-process replicas (each its
+  own engine + metrics registry) behind a ``FleetRouter``, an
+  ``Autoscaler`` supervising the population against SLO targets
+  (scale-up on sustained breach, drain+handoff retirement on calm,
+  crash/stale replacement, flap quarantine), ONE gateway fronting the
+  router. With ``--aot-dir`` every spawned replica must pass the
+  warm-admission gate (zero fresh compiles); backpressure 503s carry
+  a ``Retry-After`` from the scaler's observed spawn-to-ready median.
+  ``--selftest`` prints ``AUTOSCALE OK`` instead of ``SELFTEST OK``.
 
 Usage::
 
@@ -131,6 +140,82 @@ def _selftest(port, n, vocab, new_tokens=8, temperature=0.5):
         raise SystemExit(f"SELFTEST FAILED: {bad[:3]}")
 
 
+def _run_autoscale(args, model, serve_kw):
+    """Fleet mode: ``--autoscale MIN`` replicas behind a FleetRouter
+    with an Autoscaler driving the population (see module docstring).
+    Single-device engines only — the sharded flags don't compose with
+    in-process fleet replicas."""
+    import itertools
+    import signal as _signal
+
+    from singa_tpu.observability import metrics as obs_metrics
+    from singa_tpu.serving import (Autoscaler, AutoscaleTargets,
+                                   FleetRouter, ServingReplica,
+                                   ShedPolicy, serve_gateway)
+
+    seq = itertools.count()
+
+    def spawn():
+        i = next(seq)
+        reg = obs_metrics.MetricsRegistry()
+        eng = model.compile_serving(
+            slots=args.slots, max_len=args.max_len,
+            prefill_len=args.prefill_len, policy=args.policy,
+            registry=reg, **serve_kw)
+        if args.aot_dir:
+            src = dict(eng.compiled_step_info()["aot"] or {})
+            if not src or any(v != "loaded" for v in src.values()):
+                # cold spin-up exports back: the NEXT spawn (the one
+                # the warm-admission gate judges) deserializes
+                eng.export_aot()
+        return ServingReplica(eng, name=f"r{i}").start()
+
+    fleet_reg = obs_metrics.MetricsRegistry()
+    router = FleetRouter([spawn() for _ in range(args.autoscale)],
+                         registry=fleet_reg,
+                         shed_policy=ShedPolicy(window_s=1.0))
+    scaler = Autoscaler(
+        router, spawn,
+        targets=AutoscaleTargets(min_replicas=args.autoscale,
+                                 max_replicas=args.max_replicas),
+        registry=fleet_reg, interval=args.autoscale_interval,
+        require_warm=bool(args.aot_dir),
+        probe_timeout=args.default_timeout)
+    scaler.start()
+    server, port = serve_gateway(
+        router, port=args.port,
+        default_timeout=args.default_timeout,
+        max_body_bytes=args.max_body_bytes,
+        retry_after=scaler.retry_after_hint)
+    print(f"READY port={port} replicas={router.population()}",
+          flush=True)
+
+    def shutdown():
+        scaler.stop()
+        ok = router.drain(timeout=args.drain_timeout)
+        server.shutdown()
+        server.server_close()
+        return 0 if ok else 1
+
+    if args.selftest:
+        _selftest(port, args.selftest, args.vocab, temperature=0.5)
+        st = scaler.status()
+        code = shutdown()
+        print(f"AUTOSCALE OK n={args.selftest} "
+              f"population={st['population']} "
+              f"quarantined={st['quarantined_seats']} "
+              f"drain_exit={code}", flush=True)
+        return code
+
+    stop = threading.Event()
+    for s in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(s, lambda *_: stop.set())
+    stop.wait()
+    code = shutdown()
+    print(f"DRAINED exit={code}", flush=True)
+    return code
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=0,
@@ -175,6 +260,19 @@ def main():
                          "(persistent compile cache under "
                          "DIR/xla-cache); programs compiled fresh are "
                          "exported back so the NEXT spin-up is warm")
+    ap.add_argument("--autoscale", type=int, default=0, metavar="MIN",
+                    help="fleet mode: MIN in-process replicas behind "
+                         "a FleetRouter with an SLO-driven Autoscaler "
+                         "supervising the population (scale-up on "
+                         "sustained breach, drain+handoff retirement, "
+                         "crash replacement, flap quarantine); with "
+                         "--aot-dir spawns must pass the "
+                         "warm-admission gate (0 = single-replica "
+                         "mode)")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="autoscale population ceiling")
+    ap.add_argument("--autoscale-interval", type=float, default=0.25,
+                    help="supervision tick period (seconds)")
     ap.add_argument("--selftest", type=int, default=0, metavar="N",
                     help="fire N requests at the own gateway, verify, "
                          "exit 0")
@@ -247,6 +345,8 @@ def main():
         serve_kw["spill_bytes"] = args.spill_bytes
     if args.snapshot_every:
         serve_kw["snapshot_every"] = args.snapshot_every
+    if args.autoscale:
+        return _run_autoscale(args, model, serve_kw)
     sharded = bool(args.model_shards or args.mesh)
     if args.mesh:
         import jax

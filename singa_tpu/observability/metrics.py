@@ -492,6 +492,39 @@ def heartbeat_summary(registry=None):
         if isinstance(stranded, Counter):
             fl["stranded"] = int(stranded.total())
         out["serving_fleet"] = fl
+    # autoscaler decisions (processes running serving.autoscaler):
+    # population movement + the flap-damping evidence — a fleet view
+    # where replace_total climbs while quarantine stays 0 is a crash
+    # loop the damping never caught
+    pop = reg.get("autoscale_population")
+    if isinstance(pop, Gauge):
+        asc = {"population": pop.value()}
+        for key, name in (("up", "autoscale_up_total"),
+                          ("down", "autoscale_down_total"),
+                          ("replace", "autoscale_replace_total"),
+                          ("quarantine", "autoscale_quarantine_total"),
+                          ("warm_refused",
+                           "autoscale_warm_refused_total"),
+                          ("spawn_failed",
+                           "autoscale_spawn_failed_total")):
+            c = reg.get(name)
+            if isinstance(c, Counter):
+                asc[key] = int(c.total())
+        for key, name in (("pending_spawns",
+                           "autoscale_pending_spawns"),
+                          ("rung", "autoscale_rung"),
+                          ("quarantined", "autoscale_quarantined")):
+            g = reg.get(name)
+            if isinstance(g, Gauge):
+                asc[key] = g.value()
+        spawn = reg.get("autoscale_spawn_seconds")
+        if isinstance(spawn, Histogram):
+            series = spawn.to_doc().get("series") or []
+            if series and series[0]["count"]:
+                q = series[0].get("quantiles") or {}
+                asc["spawn_p50_s"] = q.get("p50")
+                asc["spawn_p99_s"] = q.get("p99")
+        out["autoscale"] = asc
     stamp = build_stamp()
     out["build"] = {"git": stamp["git"], "start_ts": stamp["start_ts"]}
     return out
@@ -502,7 +535,7 @@ def heartbeat_summary(registry=None):
 STRAGGLER_FACTOR = 1.5
 
 
-def aggregate_summaries(summaries):
+def aggregate_summaries(summaries, ages=None, stale_after=None):
     """Fold per-rank heartbeat summaries into ONE fleet view — what the
     coordinator publishes in its health report: min/max of the ranks'
     step-time extrema, a count-weighted mean, total steps and wire
@@ -517,12 +550,30 @@ def aggregate_summaries(summaries):
     compute_bound | compile_bound | unknown}``), judged from the
     timeline fractions and compile share its own heartbeat carried
     (``observability.timeline.classify_cause``) — "rank 2 is slow"
-    becomes "rank 2 is slow because its collectives are exposed"."""
-    vals = [s for s in (summaries or {}).values() if isinstance(s, dict)]
+    becomes "rank 2 is slow because its collectives are exposed".
+
+    ``ages`` (``{rank: seconds since last heartbeat}``) with
+    ``stale_after`` marks ranks whose last beat is older than the
+    threshold as STALE: their last-known gauges are dead data, not
+    current load, so they are EXCLUDED from every aggregate above and
+    surfaced separately as ``stale`` (``{rank: age}``) — an
+    autoscaler reading this view must never scale on a silent
+    replica's frozen numbers."""
+    summaries = dict(summaries or {})
+    stale = {}
+    if ages and stale_after:
+        for r in list(summaries):
+            age = ages.get(str(r), ages.get(r))
+            if age is not None and float(age) > float(stale_after):
+                stale[str(r)] = round(float(age), 3)
+                summaries.pop(r)
+    vals = [s for s in summaries.values() if isinstance(s, dict)]
     agg = {"ranks_reporting": len(vals),
            "wire_errors": sum(int(s.get("wire_errors") or 0)
                               for s in vals)}
-    per_rank = {r: s["step_time"] for r, s in (summaries or {}).items()
+    if stale:
+        agg["stale"] = stale
+    per_rank = {r: s["step_time"] for r, s in summaries.items()
                 if isinstance(s, dict)
                 and isinstance(s.get("step_time"), dict)
                 and s["step_time"].get("count")}

@@ -104,6 +104,16 @@ class ClusterConfig:
     dead_after: float = 2.5            # silence before a rank is dead
     connect_timeout: float = 15.0      # worker's coordinator-dial budget
     recv_slice: float = 0.25           # receiver-loop poll granularity
+    stale_beats: float = 3.0           # heartbeats of silence before a
+    #   rank's last-carried metric summary is STALE (dead data): the
+    #   aggregate fleet view excludes it and surfaces the age instead
+    #   of reporting frozen gauges as current
+
+    @property
+    def stale_after(self) -> float:
+        """Seconds of silence before a rank's summary is stale
+        (``stale_beats`` × ``heartbeat_interval``)."""
+        return self.stale_beats * self.heartbeat_interval
 
 
 def _addr(coordinator: str):
@@ -541,9 +551,13 @@ class Coordinator(ClusterBase):
             # ONE fleet-wide metric view (min/max/mean step time, total
             # steps and wire errors), aggregated from the summaries each
             # rank attached to its heartbeats — small enough to ride
-            # back on every hb-ack, so workers see it too
+            # back on every hb-ack, so workers see it too. Ranks whose
+            # last beat is older than cfg.stale_after carry DEAD data:
+            # excluded from the aggregates, surfaced as {rank: age}
             "worker_metrics": dict(
-                _metrics.aggregate_summaries(summaries),
+                _metrics.aggregate_summaries(
+                    summaries, ages=ages,
+                    stale_after=self.cfg.stale_after),
                 stragglers=len(stragglers)),
         }
 
@@ -553,10 +567,17 @@ class Coordinator(ClusterBase):
         d["rank"] = 0
         with self._lock:
             # the full per-rank breakdown only in the local health
-            # report (the broadcast digest carries the aggregate)
-            d["worker_metrics_by_rank"] = {
-                str(r): dict(m)
-                for r, m in self._worker_metrics.items()}
+            # report (the broadcast digest carries the aggregate);
+            # each entry carries its staleness verdict so a reader
+            # can tell a live gauge from a dead rank's last words
+            by_rank = {str(r): dict(m)
+                       for r, m in self._worker_metrics.items()}
+        for r, m in by_rank.items():
+            age = d.get("heartbeat_age", {}).get(r)
+            m["hb_age_s"] = age
+            m["stale"] = bool(age is not None
+                              and age > self.cfg.stale_after)
+        d["worker_metrics_by_rank"] = by_rank
         return d
 
     # -- barrier -----------------------------------------------------------

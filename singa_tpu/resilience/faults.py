@@ -20,7 +20,13 @@ Serving-fleet faults (consumed by the serving engine / fleet router):
 ``fail_submit`` (submit dies on the wire), ``crash_after_admit`` (the
 replica dies holding an admitted request — the stranded shape), and
 ``slow_replica`` (straggling ticks; drives per-try-timeout
-re-dispatch).
+re-dispatch). Autoscaler faults (consumed by
+``serving.autoscaler.Autoscaler``): ``stale_heartbeat`` (a replica's
+observation goes stale — dead data the supervisor must not scale
+on), ``flapping_replica`` (spawned replacements crash right after
+admission — drives flap damping / quarantine), and ``slow_spawn``
+(spin-up stalls — drives the spawn-to-ready accounting behind the
+gateway's derived Retry-After).
 
 On-disk chaos (for restore-hardening tests) lives beside the plan:
 :func:`truncate_checkpoint` / :func:`corrupt_checkpoint` damage a
@@ -218,6 +224,38 @@ class FaultPlan:
         request by recompute (or from its last cadence checkpoint)."""
         return self._arm("handoff_kill", seq, 1)
 
+    # -- autoscaler faults -------------------------------------------------
+    def stale_heartbeat(self, tick, times=1, name=None):
+        """Mark a replica's observation STALE for ``times``
+        CONSECUTIVE autoscaler observation passes starting at pass
+        ``tick`` (counting from 1 per supervisor) — last-known gauges
+        with no fresh heartbeat behind them. ``name`` pins the fault
+        to one replica (None = the first replica observed each pass).
+        The supervisor must exclude the stale gauges from load
+        decisions and, past its persistence window, replace the
+        silent replica. (Worker-side heartbeat silence is
+        ``drop_peer`` / ``delay_heartbeat``; this fault drives the
+        SUPERVISOR's view.)"""
+        return self._arm("hb_stale", tick, times, name=name)
+
+    def flapping_replica(self, spawn, times=3):
+        """Doom ``times`` CONSECUTIVE replica spawns starting at
+        spawn number ``spawn`` (counting from 1 per supervisor): each
+        spawned replica passes warm admission and then crashes
+        immediately — the ready↔dead flap shape. Drives the
+        autoscaler's flap damping: after its threshold the seat must
+        be QUARANTINED, not respawned forever."""
+        return self._arm("flap", spawn, times)
+
+    def slow_spawn(self, spawn, seconds=0.2, times=1):
+        """Stall ``times`` CONSECUTIVE replica spawns starting at
+        spawn number ``spawn`` by ``seconds`` each — a cold image
+        pull, a slow AOT deserialize. Drives the spawn-to-ready
+        accounting behind the gateway's derived ``Retry-After`` and
+        the supervisor's spawn-timeout path."""
+        return self._arm("slow_spawn", spawn, times,
+                         seconds=float(seconds))
+
     # -- integrity faults --------------------------------------------------
     def corrupt_wire(self, seq, times=1):
         """Flip one bit in each of the next ``times`` control-plane
@@ -384,6 +422,48 @@ class FaultPlan:
                     return frame[:-1] + bytes([frame[-1] ^ 0x01])
         return frame
 
+    def on_observe(self, seq, name=None):
+        """Called by the autoscaler for each replica it observes in
+        pass ``seq`` (counting from 1 per supervisor). True marks
+        this replica's observation STALE (``stale_heartbeat``).
+        Observation passes never repeat, so matching is consecutive
+        (the ``corrupt_wire`` rule); a fault armed with a ``name``
+        only fires for that replica."""
+        for rec in self._faults:
+            if rec["kind"] != "hb_stale" or rec["times"] <= 0 \
+                    or int(seq) < rec["step"]:
+                continue
+            want = rec.get("name")
+            if want is not None and name is not None \
+                    and str(want) != str(name):
+                continue
+            rec["times"] -= 1
+            self.fired.append((int(seq), "hb_stale"))
+            return True
+        return False
+
+    def on_spawn(self, seq):
+        """Called at the start of replica spawn number ``seq``
+        (counting from 1 per supervisor). Sleeps for an armed
+        ``slow_spawn``; returns True when an armed
+        ``flapping_replica`` dooms this spawn (crash right after
+        admission). Spawn numbers never repeat — consecutive
+        matching, like ``on_wire_send``."""
+        for rec in self._faults:
+            if rec["kind"] == "slow_spawn" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "slow_spawn"))
+                time.sleep(rec["seconds"])
+                break
+        for rec in self._faults:
+            if rec["kind"] == "flap" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "flap"))
+                return True
+        return False
+
     def on_fingerprint(self, step, model):
         """Called right before the step-N cross-replica fingerprint is
         computed; a ``diverge_at`` fault mutates the first floating
@@ -435,6 +515,12 @@ class _NullPlan(FaultPlan):
 
     def on_handoff_send(self, seq, frame):
         return frame
+
+    def on_observe(self, seq, name=None):
+        return False
+
+    def on_spawn(self, seq):
+        return False
 
     def on_fingerprint(self, step, model):
         pass

@@ -30,6 +30,8 @@ futures / SLO bookkeeping), :mod:`.fleet` (drainable replicas +
 client-side routing), :mod:`.gateway` (stdlib HTTP front).
 """
 
+from .autoscaler import (Autoscaler, AutoscaleTargets,    # noqa: F401
+                         SpawnFailed, WarmAdmissionRefused)
 from .engine import (BatchServingEngine, ServingEngine,   # noqa: F401
                      build_engine)
 from .fleet import (EXIT_DRAINED, CircuitBreaker,         # noqa: F401
@@ -45,6 +47,8 @@ from .scheduler import (BlockPoolExhausted,               # noqa: F401
 
 __all__ = [
     "ServingEngine", "BatchServingEngine", "build_engine",
+    "Autoscaler", "AutoscaleTargets", "SpawnFailed",
+    "WarmAdmissionRefused",
     "ServingReplica", "FleetRouter", "FleetFuture", "CircuitBreaker",
     "ShedPolicy", "brownout_shrink_generation", "EXIT_DRAINED",
     "serve_gateway", "ServingError", "QueueFull", "EngineDraining",

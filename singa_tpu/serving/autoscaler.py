@@ -1,0 +1,834 @@
+"""SLO-driven warm autoscaler: replica lifecycle supervision over a
+:class:`~singa_tpu.serving.fleet.FleetRouter`.
+
+PR 16 made the router survive a replica crash and PR 17 made a drain
+migrate its in-flight KV — but nothing *managed the population*: a
+load spike ended in sheds and a dead replica stayed dead until an
+operator noticed. The :class:`Autoscaler` closes that loop. It reads
+the per-replica gauges that already exist (windowed p99 TTFT from
+``serve_ttft_seconds``, queue depth, paged-KV pool pressure, breaker
+states) and drives three lifecycle verbs against SLO targets:
+
+- **scale-up** — spawn a replica pre-warmed from ``tools/aot_cache.py
+  prebuild`` artifacts and admit it only after the warm-admission
+  gate: ready health, a served first token, and **zero**
+  ``compile_seconds{source="fresh"}`` entries. A cold-compiling
+  replica admitted into the rotation is itself a fault — it eats its
+  first requests' latency budget tracing programs — so the gate
+  refuses it typed (:class:`WarmAdmissionRefused`).
+- **scale-down** — pick the least-loaded victim and retire it through
+  the PR-17 path: ``drain(deadline=)`` with live-KV handoff armed, so
+  every in-flight request either finishes or migrates. Zero dropped
+  responses is the contract, not an aspiration.
+- **replacement** — a replica whose breaker stays open, whose
+  heartbeats go stale, or whose engine crashed is removed and
+  respawned into the same *seat*.
+
+Robustness is the point, not elasticity alone:
+
+- **hysteresis** — a breach (or calm) must be *sustained* for a
+  window before any decision fires; one slow request never burns a
+  spawn, one idle tick never drains a replica.
+- **per-direction cooldowns** — after a scale-up (scale-down) the
+  same direction is locked out for its own cooldown, so the
+  population cannot oscillate at the tick rate.
+- **flap damping** — a seat whose replicas cycle ready↔dead
+  ``flap_threshold`` times inside ``flap_window_s`` is
+  **quarantined**: the supervisor stops respawning it (a crash loop
+  respawned forever is a money fire, not fault tolerance). The
+  population floor shrinks by the quarantined seats — quarantine
+  beats the min bound by design.
+- **degradation ladder** — brownout → shed → scale-up. The effective
+  scale-up window never undercuts the PR-16
+  :class:`~singa_tpu.serving.fleet.ShedPolicy` window, so a transient
+  spike is absorbed by brownout/shed *before* it burns a replica
+  spawn; the current rung rides the ``autoscale_rung`` gauge.
+
+Decisions are observable: ``autoscale_{up,down,replace,quarantine}_
+total`` counters, ``autoscale_population`` / ``autoscale_pending_
+spawns`` / ``autoscale_rung`` gauges, and an ``autoscale_spawn_
+seconds`` histogram of spawn-to-ready durations whose rolling median
+feeds :meth:`Autoscaler.retry_after_hint` — the gateway's 503
+``Retry-After`` during a scale-up tells clients when capacity
+actually lands instead of a constant.
+
+The supervisor is a pure state machine over an injected clock:
+``tick(now)`` makes every decision, ``start()`` merely runs ticks on
+a daemon thread. Tier-1 tests drive ``tick`` directly with fake
+replicas, ``sync=True`` (spawns/retires run inline) and a hand-rolled
+``now`` — no sleeps, no threads, no flakes. Chaos
+(``tools/chaos_smoke.py --only serve-autoscale``) drives the same
+class over real gateway subprocesses.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import warnings
+from collections import deque
+from dataclasses import asdict, dataclass
+
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
+from ..resilience.faults import NULL_PLAN, SimulatedCrash
+from .fleet import BREAKER_OPEN, EXIT_DRAINED
+from .scheduler import ServingError
+
+
+class SpawnFailed(ServingError):
+    """A replica spawn did not produce an admissible replica."""
+
+
+class WarmAdmissionRefused(SpawnFailed):
+    """The warm-admission gate refused a replica that compiled fresh
+    (``compile_seconds{source="fresh"}`` > 0) — it would eat its first
+    requests' latency budget tracing programs. Prebuild the AOT
+    artifacts (``tools/aot_cache.py prebuild``) and spawn with the
+    store attached."""
+
+
+# degradation ladder rungs (the autoscale_rung gauge)
+RUNG_HEALTHY = 0        # SLOs met
+RUNG_SHED = 1           # breach: brownout/shed (PR-16) absorbing it
+RUNG_SPAWN = 2          # breach sustained: capacity is coming
+
+
+@dataclass
+class AutoscaleTargets:
+    """SLO targets + robustness knobs. Defaults suit tests and the
+    CPU chaos drill; production wants windows/cooldowns in the tens
+    of seconds."""
+
+    ttft_p99_s: float = 1.0      # windowed p99 TTFT ceiling
+    queue_high: float = 4.0      # mean queue depth per ready replica
+    queue_low: float = 0.5       # ... below which the fleet is calm
+    pool_high: float = 0.9       # paged-KV blocks in_use/total ceiling
+    pool_low: float = 0.5
+    min_replicas: int = 1
+    max_replicas: int = 4
+    up_window_s: float = 2.0     # breach must be sustained this long
+    down_window_s: float = 10.0  # calm must be sustained this long
+    up_cooldown_s: float = 5.0   # per-direction lockouts
+    down_cooldown_s: float = 15.0
+    stale_after_s: float = 3.0   # heartbeat age beyond which gauges
+    #                              are dead data, not load signal
+    replace_after_s: float = 1.0  # breaker-open / stale persistence
+    #                               before a replica is declared dead
+    flap_threshold: int = 3      # ready↔dead cycles → quarantine
+    flap_window_s: float = 60.0
+    recover_fraction: float = 0.5  # calm needs p99 ≤ target × this
+    drain_deadline_s: float = 30.0  # scale-down drain budget
+    spawn_timeout_s: float = 120.0  # spawn-to-ready ceiling
+
+
+def fresh_compile_count(replica_or_registry):
+    """``compile_seconds{source="fresh"}`` total observations for a
+    replica's own registry (``replica.engine._reg``) or a registry
+    passed directly. None when unmeasurable (no registry / no
+    histogram yet) — the gate can only assert what it can see."""
+    reg = replica_or_registry
+    if not isinstance(reg, _metrics.MetricsRegistry):
+        reg = getattr(getattr(replica_or_registry, "engine", None),
+                      "_reg", None)
+    if reg is None:
+        return None
+    hist = reg.get("compile_seconds")
+    if hist is None:
+        return None
+    return sum(int(s.get("count") or 0)
+               for s in hist.to_doc().get("series", [])
+               if (s.get("labels") or {}).get("source") == "fresh")
+
+
+class _Spawn:
+    """One in-flight spawn: worker thread fills, tick reaps."""
+
+    __slots__ = ("seq", "purpose", "seat", "started", "duration",
+                 "replica", "error", "flap", "done", "thread")
+
+    def __init__(self, seq, purpose, seat, started):
+        self.seq = seq
+        self.purpose = purpose      # "up" | "replace"
+        self.seat = seat
+        self.started = started
+        self.duration = None
+        self.replica = None
+        self.error = None
+        self.flap = False
+        self.done = False
+        self.thread = None
+
+
+class _Retire:
+    """One in-flight retirement (drain + handoff on a worker)."""
+
+    __slots__ = ("idx", "name", "started", "error", "done", "thread",
+                 "code")
+
+    def __init__(self, idx, name, started):
+        self.idx = idx
+        self.name = name
+        self.started = started
+        self.error = None
+        self.code = None
+        self.done = False
+        self.thread = None
+
+
+class Autoscaler:
+    """Supervisor for the replica population behind ``router``.
+
+    ``spawn`` is a zero-arg callable returning a READY-ish replica
+    (an object with ``submit``/``health``/``queue_depth`` — a
+    :class:`~singa_tpu.serving.fleet.ServingReplica`, or any
+    duck-typed stand-in); it may block for the full spin-up (the
+    supervisor runs it on a worker thread unless ``sync=True``). The
+    warm-admission gate then probes one token and asserts zero fresh
+    compiles before :meth:`FleetRouter.add_replica`.
+
+    Injectables (all optional) keep tier-1 tests deterministic:
+    ``clock`` (monotonic seconds), ``observe(now) -> {name: obs}``
+    replacing the built-in gauge reader, ``retire(idx, replica,
+    deadline)`` replacing drain+handoff retirement, ``destroy
+    (replica)`` for corpse disposal, ``fresh_compiles(replica)`` for
+    the warm gate, and ``faults`` (a
+    :class:`~singa_tpu.resilience.faults.FaultPlan` — ``slow_spawn``,
+    ``flapping_replica`` and ``stale_heartbeat`` inject here)."""
+
+    def __init__(self, router, spawn, *, targets=None, registry=None,
+                 clock=None, interval=1.0, observe=None, retire=None,
+                 destroy=None, fresh_compiles=None, require_warm=True,
+                 probe_prompt=(1, 2, 3), probe_timeout=60.0,
+                 faults=None, sync=False):
+        import time as _time
+        self.router = router
+        self.targets = targets if targets is not None \
+            else AutoscaleTargets()
+        self.interval = float(interval)
+        self.require_warm = bool(require_warm)
+        self.probe_prompt = list(probe_prompt)
+        self.probe_timeout = float(probe_timeout)
+        self.sync = bool(sync)
+        self._spawn_fn = spawn
+        self._observe_fn = observe
+        self._retire_fn = retire
+        self._destroy_fn = destroy
+        self._fresh_fn = fresh_compiles if fresh_compiles is not None \
+            else fresh_compile_count
+        self._faults = faults if faults is not None else NULL_PLAN
+        self._clock = clock if clock is not None else _time.monotonic
+        self._tick_lock = threading.Lock()
+        self._lock = threading.Lock()   # pending/duration bookkeeping
+        self._pending = []              # [_Spawn]
+        self._retiring = []             # [_Retire]
+        self._spawn_seq = 0
+        self._obs_seq = 0
+        self._seats = {}                # seat id -> {deaths, quarantined}
+        self._seat_by_name = {}         # replica name -> seat id
+        self._next_seat = 0
+        self._suspect_since = {}        # name -> first suspect time
+        self._breach_since = None
+        self._calm_since = None
+        self._last_up = -math.inf
+        self._last_down = -math.inf
+        self._spawn_durations = deque(maxlen=16)
+        self._ttft_prev = {}            # name -> last histogram series
+        self._running = False
+        self._stop_evt = threading.Event()
+        self._thread = None
+
+        reg = registry if registry is not None else router._reg
+        self._reg = reg
+        self._c_up = reg.counter(
+            "autoscale_up_total",
+            "scale-up decisions (spawn initiated after a sustained "
+            "SLO breach)")
+        self._c_down = reg.counter(
+            "autoscale_down_total",
+            "scale-down decisions (drain+handoff retirement of the "
+            "least-loaded replica)")
+        self._c_replace = reg.counter(
+            "autoscale_replace_total",
+            "replacement decisions (dead/stale/breaker-open replica "
+            "respawned into its seat)")
+        self._c_quarantine = reg.counter(
+            "autoscale_quarantine_total",
+            "seats quarantined by flap damping (ready<->dead cycled "
+            "past the threshold; NOT respawned)")
+        self._c_warm_refused = reg.counter(
+            "autoscale_warm_refused_total",
+            "spawned replicas the warm-admission gate refused "
+            "(compiled fresh instead of loading AOT artifacts)")
+        self._c_spawn_failed = reg.counter(
+            "autoscale_spawn_failed_total",
+            "spawns that errored or timed out before admission")
+        self._g_pop = reg.gauge(
+            "autoscale_population", "live replicas behind the router")
+        self._g_pending = reg.gauge(
+            "autoscale_pending_spawns", "spawns in flight")
+        self._g_rung = reg.gauge(
+            "autoscale_rung",
+            "degradation ladder rung: 0=healthy 1=shed/brownout "
+            "absorbing a breach 2=scale-up in flight")
+        self._g_quarantined = reg.gauge(
+            "autoscale_quarantined", "seats parked by flap damping")
+        self._h_spawn = reg.histogram(
+            "autoscale_spawn_seconds",
+            "spawn-to-warm-admission durations (the Retry-After "
+            "median's source)")
+        self._g_pop.set(router.population())
+        self._g_pending.set(0)
+        self._g_rung.set(RUNG_HEALTHY)
+        self._g_quarantined.set(0)
+
+    # -- supervisor loop ---------------------------------------------------
+    def start(self):
+        """Run :meth:`tick` every ``interval`` s on a daemon thread."""
+        self._running = True
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while self._running:
+            try:
+                self.tick()
+            except Exception as e:   # noqa: BLE001 — supervisor must
+                warnings.warn(       # outlive a bad tick
+                    f"autoscaler tick failed: {type(e).__name__}: {e}",
+                    stacklevel=2)
+            self._stop_evt.wait(self.interval)
+
+    def stop(self):
+        self._running = False
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- the decision tick -------------------------------------------------
+    def tick(self, now=None):
+        """One supervision pass. Returns a decision summary dict
+        (``population``, ``pending``, ``rung``, ``breach``, ``calm``,
+        ``actions`` — a list of human-readable decision strings)."""
+        with self._tick_lock:
+            now = self._clock() if now is None else float(now)
+            actions = []
+            self._reap_spawns(now, actions)
+            self._reap_retires(actions)
+            self._obs_seq += 1
+            obs = self._observations(now)
+            self.observations = obs
+            self._scan_replacements(now, obs, actions)
+            load = self._load(obs)
+            self._update_windows(now, load)
+            self._maybe_scale_up(now, load, actions)
+            self._maybe_scale_down(now, obs, load, actions)
+            self._enforce_floor(now, actions)
+            pop = self.router.population()
+            pending = sum(1 for s in self._pending if not s.done)
+            rung = (RUNG_SPAWN if pending else
+                    RUNG_SHED if load["breach"] else RUNG_HEALTHY)
+            self._g_pop.set(pop)
+            self._g_pending.set(pending)
+            self._g_rung.set(rung)
+            self._g_quarantined.set(self.quarantined_count())
+            return {"now": now, "population": pop, "pending": pending,
+                    "rung": rung, "breach": load["breach"],
+                    "calm": load["calm"], "actions": actions}
+
+    # -- observations ------------------------------------------------------
+    def _observations(self, now):
+        if self._observe_fn is not None:
+            obs = dict(self._observe_fn(now) or {})
+        else:
+            obs = self._fleet_observations()
+        t = self.targets
+        for name, o in obs.items():
+            age = o.get("age_s")
+            if age is not None and age > t.stale_after_s:
+                o["stale"] = True
+            if self._faults.on_observe(self._obs_seq, name):
+                o["stale"] = True
+                if o.get("age_s") is None:
+                    o["age_s"] = math.inf
+            o.setdefault("stale", False)
+        return obs
+
+    def _fleet_observations(self):
+        """Per-replica load/health snapshot straight off the gauges
+        that already exist: health doc, router queue depth, breaker
+        state, windowed TTFT p99 (delta of ``serve_ttft_seconds``
+        between ticks — a lifetime histogram never forgets a breach),
+        paged-KV pool pressure."""
+        breakers = self.router.breaker_states()
+        obs = {}
+        for idx, r in self.router.live_replicas():
+            name = self.router._name(idx)
+            try:
+                doc = r.health() if hasattr(r, "health") else {}
+                status = doc.get("status", "serving")
+            except Exception:   # noqa: BLE001 — unreachable = dead
+                status = "crashed"
+            reg = getattr(getattr(r, "engine", None), "_reg", None)
+            depth = self.router._depth(r)
+            obs[name] = {
+                "idx": idx,
+                "status": status,
+                "ready": status == "serving",
+                "queue_depth": None if depth == math.inf else depth,
+                "breaker": breakers.get(name),
+                "ttft_p99_s": self._windowed_ttft_p99(name, reg),
+                "pool_pressure": self._pool_pressure(reg),
+                "age_s": None,
+            }
+        return obs
+
+    def _windowed_ttft_p99(self, name, reg):
+        hist = reg.get("serve_ttft_seconds") if reg is not None \
+            else None
+        if not isinstance(hist, _metrics.Histogram):
+            return None
+        series = hist.to_doc().get("series") or []
+        if not series:
+            return None
+        s = series[0]
+        prev = self._ttft_prev.get(name)
+        self._ttft_prev[name] = s
+        if not s["count"]:
+            return None
+        if prev is not None:
+            if s["count"] == prev["count"]:
+                return None     # no traffic this window: no signal
+            if s["count"] > prev["count"]:
+                from ..observability.export import series_quantiles
+                delta = {
+                    "count": s["count"] - prev["count"],
+                    "min": None, "max": s.get("max"),
+                    "buckets": [[le, c - pc] for (le, c), (_, pc)
+                                in zip(s["buckets"],
+                                       prev["buckets"])],
+                }
+                return series_quantiles(delta)["p99"]
+        return (s.get("quantiles") or {}).get("p99")
+
+    @staticmethod
+    def _pool_pressure(reg):
+        if reg is None:
+            return None
+        total = reg.get("kv_blocks_total")
+        in_use = reg.get("kv_blocks_in_use")
+        if not isinstance(total, _metrics.Gauge) \
+                or not isinstance(in_use, _metrics.Gauge):
+            return None
+        cap = total.value()
+        return None if not cap else float(in_use.value()) / float(cap)
+
+    # -- load evaluation ---------------------------------------------------
+    def _load(self, obs):
+        """Fleet-level breach/calm verdicts over READY, NON-STALE
+        replicas only — the staleness satellite's contract: never
+        scale on dead data."""
+        t = self.targets
+        live = [o for o in obs.values()
+                if o.get("ready") and not o.get("stale")]
+        ttfts = [o["ttft_p99_s"] for o in live
+                 if o.get("ttft_p99_s") is not None]
+        depths = [o["queue_depth"] for o in live
+                  if o.get("queue_depth") is not None]
+        pools = [o["pool_pressure"] for o in live
+                 if o.get("pool_pressure") is not None]
+        ttft = max(ttfts) if ttfts else None
+        depth = (sum(depths) / len(depths)) if depths else None
+        pool = max(pools) if pools else None
+        breach = bool(live) and (
+            (ttft is not None and ttft > t.ttft_p99_s)
+            or (depth is not None and depth > t.queue_high)
+            or (pool is not None and pool > t.pool_high))
+        calm = bool(live) and not breach and (
+            (ttft is None or ttft <= t.ttft_p99_s * t.recover_fraction)
+            and (depth is None or depth <= t.queue_low)
+            and (pool is None or pool <= t.pool_low))
+        return {"ttft_p99_s": ttft, "queue_depth_mean": depth,
+                "pool_pressure": pool, "breach": breach, "calm": calm,
+                "ready": len(live)}
+
+    def _update_windows(self, now, load):
+        if load["breach"]:
+            if self._breach_since is None:
+                self._breach_since = now
+            self._calm_since = None
+        elif load["calm"]:
+            if self._calm_since is None:
+                self._calm_since = now
+            self._breach_since = None
+        else:
+            self._breach_since = None
+            self._calm_since = None
+
+    def _effective_up_window(self):
+        """The ladder: scale-up never fires before the ShedPolicy has
+        had its full window to absorb the spike — brownout → shed →
+        spawn, in that order."""
+        w = self.targets.up_window_s
+        shed = getattr(self.router, "shed_policy", None)
+        if shed is not None:
+            w = max(w, float(getattr(shed, "window_s", 0.0)))
+        return w
+
+    # -- lifecycle: spawn --------------------------------------------------
+    def _initiate_spawn(self, now, purpose, seat, actions, reason):
+        self._spawn_seq += 1
+        rec = _Spawn(self._spawn_seq, purpose, seat, now)
+        self._pending.append(rec)
+        actions.append(f"spawn[{purpose}] #{rec.seq}: {reason}")
+        _spans.event("autoscale.spawn", purpose=purpose, seq=rec.seq,
+                     reason=reason)
+        if self.sync:
+            self._spawn_worker(rec)
+            self._reap_spawns(now, actions)     # admit this tick
+        else:
+            rec.thread = threading.Thread(
+                target=self._spawn_worker, args=(rec,),
+                name=f"autoscale-spawn-{rec.seq}", daemon=True)
+            rec.thread.start()
+
+    def _spawn_worker(self, rec):
+        t0 = self._clock()
+        try:
+            rec.flap = bool(self._faults.on_spawn(rec.seq))
+            replica = self._spawn_fn()
+            self._await_ready(replica)
+            self._warm_admission(replica)
+            rec.duration = self._clock() - t0
+            rec.replica = replica
+        except BaseException as e:      # noqa: BLE001 — reaped typed
+            rec.error = e
+        rec.done = True
+
+    def _await_ready(self, replica):
+        """Poll ``health()`` until the replica reports ``serving``
+        (bounded by ``spawn_timeout_s``). In sync mode one check —
+        in-process replicas are ready the moment ``spawn`` returns."""
+        import time as _time
+        if not hasattr(replica, "health"):
+            return
+        deadline = _time.monotonic() + self.targets.spawn_timeout_s
+        while True:
+            try:
+                status = replica.health().get("status")
+            except Exception as e:      # noqa: BLE001
+                status = f"unreachable: {e}"
+            if status == "serving":
+                return
+            if self.sync or _time.monotonic() >= deadline:
+                raise SpawnFailed(
+                    f"spawned replica never became ready "
+                    f"(last status: {status})")
+            _time.sleep(0.05)
+
+    def _warm_admission(self, replica):
+        """The gate: one probe token end to end, then assert zero
+        fresh compiles. Admission order matters — the probe forces
+        prefill+decode through the compile path, so the count AFTER
+        it is the honest one."""
+        fut = replica.submit(list(self.probe_prompt),
+                             max_new_tokens=1, temperature=0.0,
+                             timeout=self.probe_timeout)
+        fut.result(timeout=self.probe_timeout)
+        fresh = self._fresh_fn(replica)
+        if self.require_warm and fresh:
+            raise WarmAdmissionRefused(
+                f"replica compiled {fresh} program(s) fresh during "
+                f"warm admission; prebuild AOT artifacts "
+                f"(tools/aot_cache.py prebuild) so spawns land warm")
+
+    def _reap_spawns(self, now, actions):
+        for rec in [r for r in self._pending if r.done]:
+            self._pending.remove(rec)
+            if rec.error is not None:
+                self._c_spawn_failed.inc()
+                if isinstance(rec.error, WarmAdmissionRefused):
+                    self._c_warm_refused.inc()
+                actions.append(
+                    f"spawn #{rec.seq} failed: "
+                    f"{type(rec.error).__name__}: {rec.error}")
+                _spans.event("autoscale.spawn_failed", seq=rec.seq,
+                             error=type(rec.error).__name__)
+                continue
+            idx = self.router.add_replica(rec.replica)
+            name = self.router._name(idx)
+            seat = rec.seat if rec.seat is not None \
+                else self._new_seat()
+            self._seat_by_name[name] = seat
+            dur = rec.duration if rec.duration is not None \
+                else now - rec.started
+            with self._lock:
+                self._spawn_durations.append(dur)
+            self._h_spawn.observe(dur)
+            actions.append(f"admitted {name} (slot {idx}, "
+                           f"{dur:.3f}s spawn-to-ready)")
+            _spans.event("autoscale.admitted", replica=name,
+                         slot=idx, purpose=rec.purpose,
+                         spawn_s=round(dur, 4))
+            if rec.flap:    # flapping_replica fault: the fresh
+                self._doom(rec.replica)   # replica dies right away
+
+    def _new_seat(self):
+        seat = self._next_seat
+        self._next_seat += 1
+        self._seats[seat] = {"deaths": deque(), "quarantined": False}
+        return seat
+
+    def _doom(self, replica):
+        eng = getattr(replica, "engine", replica)
+        crash = getattr(eng, "_crash", None)
+        if crash is None:
+            crash = getattr(replica, "kill", None)
+        if crash is None:
+            return
+        try:
+            crash(SimulatedCrash(
+                "flapping_replica: injected post-admission crash"))
+        except TypeError:
+            try:
+                crash()
+            except Exception:   # noqa: BLE001 — best-effort corpse
+                pass
+        except Exception:       # noqa: BLE001
+            pass
+
+    # -- lifecycle: replacement + flap damping -----------------------------
+    def _scan_replacements(self, now, obs, actions):
+        t = self.targets
+        for name, o in list(obs.items()):
+            idx = o.get("idx")
+            if idx is None or self.router.replicas[idx] is None:
+                continue
+            if any(rt.idx == idx and not rt.done
+                   for rt in self._retiring):
+                continue        # scale-down owns this one
+            crashed = o.get("status") == "crashed"
+            suspect = crashed or o.get("stale") \
+                or o.get("breaker") == BREAKER_OPEN
+            if not suspect:
+                self._suspect_since.pop(name, None)
+                continue
+            since = self._suspect_since.setdefault(name, now)
+            if not crashed and now - since < t.replace_after_s:
+                continue        # hysteresis: one stale beat ≠ dead
+            self._suspect_since.pop(name, None)
+            self._replace_dead(now, idx, name, o, actions)
+
+    def _replace_dead(self, now, idx, name, o, actions):
+        corpse = self.router.remove_replica(idx)
+        self._destroy(corpse)
+        self._ttft_prev.pop(name, None)
+        seat_id = self._seat_by_name.pop(name, None)
+        if seat_id is None:
+            seat_id = self._new_seat()
+        seat = self._seats[seat_id]
+        deaths = seat["deaths"]
+        deaths.append(now)
+        while deaths and now - deaths[0] > self.targets.flap_window_s:
+            deaths.popleft()
+        cause = ("crashed" if o.get("status") == "crashed"
+                 else "stale_heartbeat" if o.get("stale")
+                 else "breaker_open")
+        if len(deaths) >= self.targets.flap_threshold \
+                and not seat["quarantined"]:
+            seat["quarantined"] = True
+            self._c_quarantine.inc()
+            actions.append(
+                f"quarantined seat {seat_id} ({name}): "
+                f"{len(deaths)} ready<->dead cycles inside "
+                f"{self.targets.flap_window_s:.0f}s")
+            _spans.event("autoscale.quarantine", replica=name,
+                         seat=seat_id, cycles=len(deaths),
+                         cause=cause)
+            return
+        if seat["quarantined"]:
+            return              # already parked: never respawn
+        if self.router.population() + len(self._pending) \
+                >= self.targets.max_replicas:
+            actions.append(f"replace {name} deferred: at max "
+                           f"population")
+            return
+        self._c_replace.inc()
+        _spans.event("autoscale.replace", replica=name,
+                     seat=seat_id, cause=cause)
+        self._initiate_spawn(now, "replace", seat_id, actions,
+                             f"{name} {cause}")
+
+    def _destroy(self, replica):
+        if replica is None:
+            return
+        if self._destroy_fn is not None:
+            try:
+                self._destroy_fn(replica)
+            except Exception:   # noqa: BLE001 — corpse disposal
+                pass
+            return
+        eng = getattr(replica, "engine", replica)
+        try:
+            eng.stop()
+        except Exception:       # noqa: BLE001
+            pass
+
+    # -- lifecycle: scale up/down ------------------------------------------
+    def _maybe_scale_up(self, now, load, actions):
+        t = self.targets
+        if self._breach_since is None:
+            return
+        if now - self._breach_since < self._effective_up_window():
+            return              # the shed rung is still absorbing it
+        if now - self._last_up < t.up_cooldown_s:
+            return
+        if self._pending or self.router.population() \
+                + len(self._pending) >= t.max_replicas:
+            return
+        self._last_up = now
+        self._c_up.inc()
+        self._initiate_spawn(
+            now, "up", None, actions,
+            f"breach sustained {now - self._breach_since:.1f}s "
+            f"(ttft_p99={load['ttft_p99_s']}, "
+            f"queue={load['queue_depth_mean']}, "
+            f"pool={load['pool_pressure']})")
+
+    def _maybe_scale_down(self, now, obs, load, actions):
+        t = self.targets
+        if self._calm_since is None \
+                or now - self._calm_since < t.down_window_s:
+            return
+        if now - self._last_down < t.down_cooldown_s:
+            return
+        if self._pending or any(not r.done for r in self._retiring):
+            return              # one lifecycle mutation at a time
+        if self.router.population() <= t.min_replicas:
+            return
+        victim = None           # least-loaded ready replica
+        for name, o in obs.items():
+            if not o.get("ready") or o.get("stale"):
+                continue
+            idx = o.get("idx")
+            if idx is None or self.router.replicas[idx] is None:
+                continue
+            depth = o.get("queue_depth")
+            depth = math.inf if depth is None else depth
+            if victim is None or depth < victim[0]:
+                victim = (depth, idx, name)
+        if victim is None:
+            return
+        _depth, idx, name = victim
+        self._last_down = now
+        self._c_down.inc()
+        actions.append(f"retire {name} (slot {idx}): calm "
+                       f"{now - self._calm_since:.1f}s")
+        _spans.event("autoscale.retire", replica=name, slot=idx)
+        rec = _Retire(idx, name, now)
+        self._retiring.append(rec)
+        if self.sync:
+            self._retire_worker(rec)
+        else:
+            rec.thread = threading.Thread(
+                target=self._retire_worker, args=(rec,),
+                name=f"autoscale-retire-{name}", daemon=True)
+            rec.thread.start()
+
+    def _retire_worker(self, rec):
+        try:
+            if self._retire_fn is not None:
+                rec.code = self._retire_fn(
+                    rec.idx, self.router.replicas[rec.idx],
+                    self.targets.drain_deadline_s)
+            else:
+                # PR-17 path: deadline drain with live-KV handoff to
+                # the survivors — zero dropped in-flight responses
+                rec.code = self.router.drain_replica(
+                    rec.idx, timeout=self.targets.drain_deadline_s,
+                    handoff=True)
+        except BaseException as e:      # noqa: BLE001 — reaped typed
+            rec.error = e
+        self.router.remove_replica(rec.idx)
+        rec.done = True
+
+    def _reap_retires(self, actions):
+        for rec in [r for r in self._retiring if r.done]:
+            self._retiring.remove(rec)
+            self._seat_by_name.pop(rec.name, None)
+            self._ttft_prev.pop(rec.name, None)
+            if rec.error is not None:
+                actions.append(
+                    f"retire {rec.name} errored: "
+                    f"{type(rec.error).__name__}: {rec.error}")
+            else:
+                clean = rec.code in (EXIT_DRAINED, None, True)
+                actions.append(f"retired {rec.name} "
+                               f"({'clean' if clean else 'dirty'} "
+                               f"drain)")
+
+    def _enforce_floor(self, now, actions):
+        """Population floor = min_replicas minus quarantined seats:
+        quarantine beats the min bound (that IS flap damping), but a
+        fleet that merely started small or lost spawns is topped up."""
+        floor = max(0, self.targets.min_replicas
+                    - self.quarantined_count())
+        missing = floor - self.router.population() \
+            - len(self._pending)
+        for _ in range(missing):
+            self._initiate_spawn(now, "up", None, actions,
+                                 "below population floor")
+
+    # -- introspection -----------------------------------------------------
+    def quarantined_count(self):
+        return sum(1 for s in self._seats.values()
+                   if s["quarantined"])
+
+    def retry_after_hint(self):
+        """Seconds until capacity plausibly lands: the rolling median
+        of recent spawn-to-ready durations minus the oldest pending
+        spawn's elapsed time (floor 1s). None when no spawn is in
+        flight or no history exists — the gateway then falls back to
+        its constant. This is the satellite contract: a 503 during a
+        scale-up carries an *observed* Retry-After."""
+        with self._lock:
+            durs = sorted(self._spawn_durations)
+        pending = [s for s in self._pending if not s.done]
+        if not pending or not durs:
+            return None
+        median = durs[len(durs) // 2]
+        elapsed = self._clock() - min(s.started for s in pending)
+        return max(1.0, median - elapsed)
+
+    def spawn_stats(self):
+        """{count, p50_s, p99_s} over the recorded spawn-to-ready
+        durations (the chaos drill banks these)."""
+        doc = self._h_spawn.to_doc().get("series") or []
+        if not doc:
+            return {"count": 0, "p50_s": None, "p99_s": None}
+        q = doc[0].get("quantiles") or {}
+        return {"count": doc[0]["count"], "p50_s": q.get("p50"),
+                "p99_s": q.get("p99")}
+
+    def status(self):
+        """One introspection doc (the example's AUTOSCALE log line
+        and chaos assertions read this)."""
+        return {
+            "population": self.router.population(),
+            "pending_spawns": sum(1 for s in self._pending
+                                  if not s.done),
+            "retiring": sum(1 for r in self._retiring if not r.done),
+            "quarantined_seats": self.quarantined_count(),
+            "rung": int(self._g_rung.value()),
+            "spawn": self.spawn_stats(),
+            "targets": asdict(self.targets),
+        }
+
+
+__all__ = ["Autoscaler", "AutoscaleTargets", "SpawnFailed",
+           "WarmAdmissionRefused", "fresh_compile_count",
+           "RUNG_HEALTHY", "RUNG_SHED", "RUNG_SPAWN"]

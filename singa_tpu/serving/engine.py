@@ -541,6 +541,21 @@ class _EngineBase:
     def draining(self):
         return self._draining
 
+    def ttft_stats(self):
+        """Caller-felt TTFT quantiles, ``{"count", "p50_s", "p99_s"}``.
+
+        Reads the ``serve_ttft_seconds`` histogram (queue wait
+        included — the number the SLO is written against); quantiles
+        are None until at least one request has produced a first
+        token. This is the supervisor-facing accessor: an autoscaler
+        or dashboard should call this instead of digging through the
+        registry snapshot."""
+        h = self._ttft
+        doc = h._series_doc(None, h._slot({}))
+        q = doc.get("quantiles") or {}
+        return {"count": int(doc.get("count", 0) or 0),
+                "p50_s": q.get("p50"), "p99_s": q.get("p99")}
+
     def drain(self, timeout=60.0, handoff=None):
         """Graceful drain: refuse new requests, FINISH everything
         in flight and queued, return True once idle. The drainable-

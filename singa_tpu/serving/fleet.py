@@ -523,7 +523,14 @@ class FleetRouter:
     ``per_try_timeout`` (seconds, default None=off) bounds ONE
     replica's attempt; a request whose deadline budget still has
     remainder when it fires is re-dispatched to a survivor with that
-    remainder. ``max_redispatch`` caps re-dispatches per request."""
+    remainder. ``max_redispatch`` caps re-dispatches per request.
+
+    Membership is dynamic: :meth:`add_replica` admits a new replica
+    into dispatch, :meth:`remove_replica` retires a slot. Removal
+    TOMBSTONES the slot (``replicas[idx] is None``) instead of
+    shifting the list — in-flight :class:`FleetFuture`\\ s hold their
+    origin index for crash re-dispatch exclusion, so indices must stay
+    stable for the router's lifetime."""
 
     def __init__(self, replicas, registry=None, *,
                  breaker_threshold=3, breaker_backoff=0.25,
@@ -538,10 +545,15 @@ class FleetRouter:
         self.shed_policy = shed_policy
         self._clock = clock if clock is not None else time.monotonic
         self._blk = threading.Lock()
+        self._breaker_params = (breaker_threshold, breaker_backoff,
+                                breaker_backoff_cap)
         self._breakers = [CircuitBreaker(breaker_threshold,
                                          breaker_backoff,
                                          breaker_backoff_cap)
                           for _ in self.replicas]
+        # last-known names of tombstoned slots (health/trace labels
+        # must keep naming a slot after its replica object is gone)
+        self._slot_names = {}
         reg = registry if registry is not None \
             else _metrics.default_registry()
         self._reg = reg
@@ -591,7 +603,54 @@ class FleetRouter:
             self._breaker_state.set(0, replica=self._name(i))
 
     def _name(self, idx):
-        return getattr(self.replicas[idx], "name", None) or str(idx)
+        r = self.replicas[idx]
+        if r is None:
+            return self._slot_names.get(idx, str(idx))
+        return getattr(r, "name", None) or str(idx)
+
+    # -- membership --------------------------------------------------------
+    def add_replica(self, replica):
+        """Admit a replica into dispatch (fresh closed breaker).
+        Returns its slot index. The caller owns readiness: admit only
+        replicas that already answer ``/healthz``-level probes — the
+        autoscaler's warm-admission gate lives above this."""
+        if replica is None:
+            raise ValueError("cannot add a None replica")
+        with self._blk:
+            self.replicas.append(replica)
+            self._breakers.append(CircuitBreaker(*self._breaker_params))
+            idx = len(self.replicas) - 1
+            self._set_state_gauge(idx)
+        _spans.event("fleet.replica_added",
+                     replica=self._name(idx), slot=idx)
+        return idx
+
+    def remove_replica(self, idx):
+        """Tombstone slot ``idx`` and return its replica (None if the
+        slot was already empty). The slot never dispatches again; its
+        index is never reused. Call AFTER the replica is drained or
+        declared dead — removal does not stop the engine."""
+        with self._blk:
+            r = self.replicas[idx]
+            if r is not None:
+                self._slot_names[idx] = \
+                    getattr(r, "name", None) or str(idx)
+            self.replicas[idx] = None
+        if r is not None:
+            _spans.event("fleet.replica_removed",
+                         replica=self._slot_names[idx], slot=idx)
+        return r
+
+    def live_replicas(self):
+        """``[(idx, replica)]`` for the non-tombstoned slots."""
+        with self._blk:
+            return [(i, r) for i, r in enumerate(self.replicas)
+                    if r is not None]
+
+    def population(self):
+        """Live (non-tombstoned) replica count."""
+        with self._blk:
+            return sum(1 for r in self.replicas if r is not None)
 
     @staticmethod
     def _depth(r):
@@ -629,10 +688,12 @@ class FleetRouter:
                                          - self._clock(), 4))
 
     def breaker_states(self):
-        """{replica name: breaker state} — /healthz fodder."""
+        """{replica name: breaker state} — /healthz fodder
+        (tombstoned slots omitted)."""
         with self._blk:
             return {self._name(i): br.state
-                    for i, br in enumerate(self._breakers)}
+                    for i, br in enumerate(self._breakers)
+                    if self.replicas[i] is not None}
 
     # -- placement ---------------------------------------------------------
     def _order(self, now, exclude=()):
@@ -641,7 +702,7 @@ class FleetRouter:
         out = []
         with self._blk:
             for i, r in enumerate(self.replicas):
-                if i in exclude:
+                if i in exclude or r is None:
                     continue
                 br = self._breakers[i]
                 if not br.admits(now):
@@ -709,13 +770,13 @@ class FleetRouter:
             self._sheds.inc()
             raise RequestShed(
                 f"fleet shedding load: sustained backpressure across "
-                f"all {len(self.replicas)} replicas (last: "
+                f"all {self.population()} replicas (last: "
                 f"{last_exc}); retry after "
                 f"{self.shed_policy.retry_after}s",
                 retry_after=self.shed_policy.retry_after)
         self._rejected.inc()
         raise ServingError(
-            f"all {len(self.replicas)} replicas refused the request "
+            f"all {self.population()} replicas refused the request "
             f"(last: {last_exc})")
 
     @staticmethod
@@ -758,8 +819,11 @@ class FleetRouter:
         ``handoff=True`` arms live-KV migration: work that cannot
         finish inside the budget moves to a survivor mid-flight
         (snapshot inject, recompute fallback) instead of failing."""
+        r = self.replicas[idx]
+        if r is None:
+            raise ValueError(f"slot {idx} is tombstoned (removed)")
         cb = self._handoff_to_survivors(idx) if handoff else None
-        return self.replicas[idx].drain(timeout=timeout, handoff=cb)
+        return r.drain(timeout=timeout, handoff=cb)
 
     # -- live-KV handoff (drain-deadline migration) ------------------------
     def _handoff_to_survivors(self, idx):
@@ -853,6 +917,8 @@ class FleetRouter:
         if not trace_id or ffut._idx is None:
             return None
         dead = self.replicas[ffut._idx]
+        if dead is None:        # tombstoned slot: no checkpoint access
+            return None
         eng = getattr(dead, "engine", dead)
         take = getattr(eng, "take_kv_checkpoint", None)
         if take is None:
@@ -892,19 +958,20 @@ class FleetRouter:
         return None
 
     def drain(self, timeout=60.0):
-        """Drain every replica (the fleet-front gateway's POST /drain
-        body). Returns True when all drains were clean."""
+        """Drain every live replica (the fleet-front gateway's POST
+        /drain body). Returns True when all drains were clean."""
         return all(r.drain(timeout=timeout) == EXIT_DRAINED
-                   for r in self.replicas)
+                   for _i, r in self.live_replicas())
 
     @property
     def draining(self):
         return all(bool(getattr(r, "draining", False))
-                   for r in self.replicas)
+                   for _i, r in self.live_replicas())
 
     def health(self):
-        docs = [r.health() if hasattr(r, "health") else None
-                for r in self.replicas]
+        docs = [None if r is None
+                else r.health() if hasattr(r, "health") else None
+                for r in list(self.replicas)]
         states = self.breaker_states()
         for i, doc in enumerate(docs):
             if isinstance(doc, dict):

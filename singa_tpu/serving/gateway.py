@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import base64
 import json
+import math
 import threading
 import uuid
 from urllib.parse import parse_qs, urlsplit
@@ -78,7 +79,8 @@ def _result_doc(res):
 
 
 def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
-                  default_timeout=120.0, max_body_bytes=8 << 20):
+                  default_timeout=120.0, max_body_bytes=8 << 20,
+                  retry_after=None):
     """Start the gateway on a daemon thread. Returns ``(server, port)``;
     ``server.shutdown(); server.server_close()`` stops it (close joins
     in-flight handler threads). ``replica`` (a
@@ -92,12 +94,31 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
     than ``max_body_bytes`` (or with a missing/garbage
     ``Content-Length``) are refused 413 before a byte is read — the
     gateway never buffers unbounded input. Binds localhost by
-    default — put a real LB/mesh in front for anything public."""
+    default — put a real LB/mesh in front for anything public.
+
+    ``retry_after`` (seconds, or a zero-arg callable returning
+    seconds-or-None) sets the ``Retry-After`` on backpressure 503s.
+    Wire it to :meth:`Autoscaler.retry_after_hint
+    <singa_tpu.serving.autoscaler.Autoscaler.retry_after_hint>` and a
+    503 emitted while the fleet is scaling up tells clients when
+    capacity actually lands — the rolling median of observed
+    spawn-to-ready durations — instead of a constant; None (or no
+    hint) falls back to the constant 1s."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from ..observability.export import render_prometheus
 
     is_fleet = hasattr(engine, "replicas")
+
+    def retry_after_header():
+        v = retry_after() if callable(retry_after) else retry_after
+        try:
+            v = None if v is None else float(v)
+        except (TypeError, ValueError):
+            v = None
+        if v is None or v <= 0:
+            return "1"
+        return str(max(1, int(math.ceil(v))))
 
     def health_doc():
         if replica is not None:
@@ -270,9 +291,12 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
                     BlockPoolExhausted) as e:
                 # Retry-After rides every backpressure refusal: a
                 # draining replica tells the client when to re-probe
-                # the fleet instead of hammering this instance
+                # the fleet instead of hammering this instance; the
+                # hint (when wired) is spawn-to-ready derived, so the
+                # back-off tracks real warm-up time
                 self._reply(503, self._err(e, retryable=True),
-                            headers=(("Retry-After", "1"),))
+                            headers=(("Retry-After",
+                                      retry_after_header()),))
             except RequestTimeout as e:
                 self._reply(504, self._err(e))
             except ReplicaCrashed as e:

@@ -1,0 +1,551 @@
+"""SLO-driven autoscaler matrix (CPU, fast tier): replica lifecycle
+supervision over a FleetRouter — every decision driven through
+``tick(now)`` with a hand-rolled clock and fake replicas, so the
+hysteresis/cooldown cadence assertions are exact, not sleep-flaky.
+
+- scale-up: a breach must be SUSTAINED for the up-window (a transient
+  spike resets the epoch and never burns a spawn), the per-direction
+  cooldown locks out back-to-back spawns, the population never exceeds
+  max_replicas, and the degradation ladder widens the effective window
+  to the ShedPolicy's (brownout/shed absorbs the spike first);
+- scale-down: sustained calm retires the LEAST-loaded replica through
+  the PR-17 drain path with live-KV handoff armed, respects its own
+  cooldown, and never sinks below min_replicas;
+- replacement: a crashed replica is respawned into its seat the tick
+  it is seen; stale-heartbeat / breaker-open need ``replace_after_s``
+  of persistence first (one stale beat is not a death);
+- staleness satellite: a stale replica's frozen gauges are EXCLUDED
+  from the load verdicts (never scale on dead data), and
+  ``aggregate_summaries`` surfaces stale ranks instead of folding
+  their last-known numbers into the fleet view;
+- flap damping: ready↔dead cycles past ``flap_threshold`` inside the
+  window QUARANTINE the seat — the respawn loop provably stops and
+  the population floor shrinks by the parked seat;
+- warm admission: a replica that compiled fresh during its probe is
+  refused typed (``WarmAdmissionRefused``) and counted;
+- Retry-After satellite: the hint is the rolling spawn-duration
+  median minus the pending spawn's elapsed time (floor 1s), None
+  without pending spawns or history, and the gateway renders a
+  callable hint as a ceil'd 503 header;
+- membership: router add/remove with tombstoned slots (names and
+  breaker bookkeeping survive), and the autoscale decision counters
+  ride ``heartbeat_summary``.
+"""
+
+import itertools
+import json
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from singa_tpu import device
+from singa_tpu.models import transformer
+from singa_tpu.observability import metrics as obs_metrics
+from singa_tpu.resilience.faults import FaultPlan
+from singa_tpu.serving import (Autoscaler, AutoscaleTargets,
+                               FleetRouter, ServeFuture,
+                               serve_gateway)
+from singa_tpu.serving.autoscaler import (RUNG_HEALTHY, RUNG_SHED,
+                                          RUNG_SPAWN,
+                                          fresh_compile_count)
+from singa_tpu.serving.fleet import EXIT_DRAINED, ShedPolicy
+from singa_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.serving
+
+DEV = device.create_cpu_device()
+
+
+def _reg():
+    return obs_metrics.MetricsRegistry()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    np.random.seed(0)
+    m = transformer.TransformerLM(19, d_model=16, n_heads=2,
+                                  n_layers=2, max_len=64, tp=False)
+    m.eval()
+    m(Tensor(data=np.zeros((1, 4), np.float32), device=DEV,
+             requires_grad=False))
+    return m
+
+
+class _Fut:
+    def __init__(self, value=None, error=None):
+        self._value = value
+        self._error = error
+
+    def result(self, timeout=None):
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Rep:
+    """Replica stand-in: mutable depth/status, recorded drains and
+    probes — the supervisor state machine is host-side and must be
+    testable without compiling an engine."""
+
+    def __init__(self, name, depth=0):
+        self.name = name
+        self.depth = depth
+        self.status = "serving"
+        self.draining = False
+        self.drains = []
+        self.probes = 0
+
+    def queue_depth(self):
+        return self.depth
+
+    def health(self):
+        if self.status == "unreachable":
+            raise ConnectionError("replica gone")
+        return {"name": self.name, "status": self.status,
+                "queue_depth": self.depth}
+
+    def submit(self, *args, **kwargs):
+        self.probes += 1
+        return _Fut(value={"tokens": [1], "prompt_len": 3})
+
+    def drain(self, timeout=60.0, handoff=None):
+        self.drains.append((timeout, handoff))
+        self.draining = True
+        self.status = "draining"
+        return EXIT_DRAINED
+
+    def kill(self):
+        self.status = "crashed"
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _targets(**kw):
+    """Tight windows so the matrix drives whole lifecycles in a few
+    hand-rolled seconds."""
+    base = dict(min_replicas=1, max_replicas=3, queue_high=4.0,
+                queue_low=0.5, up_window_s=1.0, down_window_s=2.0,
+                up_cooldown_s=2.0, down_cooldown_s=5.0,
+                stale_after_s=1.0, replace_after_s=0.5,
+                flap_threshold=3, flap_window_s=60.0)
+    base.update(kw)
+    return AutoscaleTargets(**base)
+
+
+def _mk(n=1, *, targets=None, shed=None, faults=None,
+        require_warm=False, fresh=None, spawn_hook=None, destroy=None):
+    clk = _Clock()
+    reg = _reg()
+    reps = [_Rep(f"r{i}") for i in range(n)]
+    router = FleetRouter(reps, registry=reg, shed_policy=shed,
+                         clock=clk)
+    spawned = []
+    seq = itertools.count(n)
+
+    def spawn():
+        r = _Rep(f"r{next(seq)}")
+        spawned.append(r)
+        if spawn_hook is not None:
+            spawn_hook(r)
+        return r
+
+    sc = Autoscaler(router, spawn,
+                    targets=targets if targets is not None
+                    else _targets(),
+                    registry=reg, clock=clk, sync=True,
+                    require_warm=require_warm, fresh_compiles=fresh,
+                    faults=faults, destroy=destroy)
+    return SimpleNamespace(sc=sc, router=router, reps=reps,
+                           spawned=spawned, clk=clk, reg=reg)
+
+
+def _count(f, name):
+    return int(f.reg.get(f"autoscale_{name}_total").total())
+
+
+def _gauge(f, name):
+    return f.reg.get(f"autoscale_{name}").value()
+
+
+class TestScaleUp:
+    def test_breach_must_sustain_window(self):
+        f = _mk(1)
+        f.reps[0].depth = 10
+        r = f.sc.tick(now=0.0)
+        assert r["breach"] and r["rung"] == RUNG_SHED
+        f.sc.tick(now=0.5)
+        assert f.router.population() == 1    # hysteresis holding
+        r = f.sc.tick(now=1.1)
+        assert f.router.population() == 2
+        assert _count(f, "up") == 1
+        assert any(a.startswith("spawn[up]") for a in r["actions"])
+        assert any(a.startswith("admitted r1") for a in r["actions"])
+        # the spawned replica went through the warm-admission probe
+        assert f.spawned[0].probes == 1
+
+    def test_transient_spike_resets_the_epoch(self):
+        f = _mk(1)
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.0)
+        f.reps[0].depth = 0
+        f.sc.tick(now=0.5)           # spike gone: epoch resets
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.8)           # new epoch starts here
+        f.sc.tick(now=1.5)           # 1.5s of cumulative breach, but
+        assert f.router.population() == 1   # only 0.7s contiguous
+        f.sc.tick(now=1.9)
+        assert f.router.population() == 2
+
+    def test_up_cooldown_and_max_population(self):
+        f = _mk(1, spawn_hook=lambda r: setattr(r, "depth", 10))
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=1.1)
+        assert f.router.population() == 2
+        f.sc.tick(now=2.0)           # window ok, cooldown (2s) not
+        assert f.router.population() == 2
+        f.sc.tick(now=3.2)
+        assert f.router.population() == 3
+        assert _count(f, "up") == 2
+        f.sc.tick(now=6.0)           # still breaching, but at max
+        f.sc.tick(now=9.0)
+        assert f.router.population() == 3
+
+    def test_ladder_never_undercuts_shed_window(self):
+        """Brownout/shed absorbs the spike for its full window before
+        a spawn fires, even with a tighter up_window."""
+        f = _mk(1, shed=ShedPolicy(window_s=3.0))
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=1.5)           # past up_window_s=1, not shed's
+        assert f.router.population() == 1
+        f.sc.tick(now=3.1)
+        assert f.router.population() == 2
+
+    def test_rung_gauge_rides_the_ladder(self):
+        f = _mk(1)
+        assert f.sc.tick(now=0.0)["rung"] == RUNG_HEALTHY
+        f.reps[0].depth = 10
+        assert f.sc.tick(now=0.1)["rung"] == RUNG_SHED
+        assert int(_gauge(f, "rung")) == RUNG_SHED
+
+
+class TestScaleDown:
+    def test_sustained_calm_retires_least_loaded_via_drain(self):
+        f = _mk(3)
+        f.reps[0].depth = 1          # mean 1/3 <= queue_low
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=1.0)
+        assert f.router.population() == 3    # down_window_s=2 holding
+        r = f.sc.tick(now=2.1)
+        assert f.router.population() == 2
+        assert _count(f, "down") == 1
+        # least-loaded victim (r1, depth 0) went through the PR-17
+        # drain path with the live-KV handoff callback armed
+        victim = f.reps[1]
+        assert len(victim.drains) == 1
+        timeout, handoff = victim.drains[0]
+        assert timeout == pytest.approx(
+            f.sc.targets.drain_deadline_s)
+        assert callable(handoff)
+        assert f.router.replicas[1] is None
+        assert any(a.startswith("retire r1") for a in r["actions"])
+        r = f.sc.tick(now=2.2)
+        assert any("retired r1 (clean drain)" in a
+                   for a in r["actions"])
+
+    def test_down_cooldown_and_min_floor(self):
+        f = _mk(3)
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=2.1)           # first retirement
+        assert f.router.population() == 2
+        f.sc.tick(now=3.0)           # calm, but cooldown (5s) holds
+        f.sc.tick(now=5.0)
+        assert f.router.population() == 2
+        f.sc.tick(now=7.5)           # cooldown expired
+        assert f.router.population() == 1
+        assert _count(f, "down") == 2
+        f.sc.tick(now=13.0)          # at min_replicas: never below
+        f.sc.tick(now=20.0)
+        assert f.router.population() == 1
+        assert _count(f, "down") == 2
+
+    def test_floor_tops_up_an_undersized_fleet(self):
+        f = _mk(1, targets=_targets(min_replicas=2))
+        r = f.sc.tick(now=0.0)
+        assert f.router.population() == 2
+        assert any("below population floor" in a for a in r["actions"])
+
+
+class TestReplacement:
+    def test_crashed_replica_replaced_immediately(self):
+        corpses = []
+        f = _mk(2, destroy=corpses.append)
+        f.reps[0].kill()
+        f.sc.tick(now=0.0)
+        assert _count(f, "replace") == 1
+        assert f.router.replicas[0] is None      # tombstoned slot
+        assert f.router.population() == 2        # respawn admitted
+        assert corpses == [f.reps[0]]
+        live = [r for _, r in f.router.live_replicas()]
+        assert f.spawned[0] in live
+
+    def test_stale_heartbeat_needs_persistence_before_replace(self):
+        plan = FaultPlan()
+        plan.stale_heartbeat(2, times=10, name="r0")
+        f = _mk(2, faults=plan)
+        f.sc.tick(now=0.0)                       # pass 1: healthy
+        f.sc.tick(now=0.2)                       # pass 2: stale seen
+        assert f.sc.observations["r0"]["stale"] is True
+        assert f.sc.observations["r0"]["age_s"] > 0
+        f.sc.tick(now=0.4)       # 0.2s suspect < replace_after_s=0.5
+        assert _count(f, "replace") == 0
+        assert f.router.population() == 2
+        f.sc.tick(now=0.8)                       # 0.6s: replaced
+        assert _count(f, "replace") == 1
+        assert f.router.replicas[0] is None
+        assert f.router.population() == 2
+        # the fault is pinned to r0: the replacement stays in rotation
+        f.sc.tick(now=1.4)
+        f.sc.tick(now=2.4)
+        assert _count(f, "replace") == 1
+
+    def test_stale_gauges_excluded_from_load(self):
+        """The staleness satellite's contract: a silent replica's
+        frozen queue gauge must never drive a scale-up."""
+        plan = FaultPlan()
+        plan.stale_heartbeat(1, times=50, name="r0")
+        f = _mk(2, faults=plan,
+                targets=_targets(replace_after_s=100.0))
+        f.reps[0].depth = 50                     # frozen dead data
+        f.reps[1].depth = 1                      # not calm, not breach
+        for now in (0.0, 1.5, 3.0, 4.5):
+            r = f.sc.tick(now=now)
+            assert r["breach"] is False
+        assert _count(f, "up") == 0
+        assert f.router.population() == 2
+
+
+class TestFlapDamping:
+    def test_quarantine_after_threshold_stops_respawn(self):
+        plan = FaultPlan()
+        plan.flapping_replica(1, times=10)       # doom every spawn
+        f = _mk(1, faults=plan)
+        f.reps[0].kill()
+        f.sc.tick(now=0.0)           # death 1 -> respawn (doomed)
+        assert _count(f, "replace") == 1
+        f.sc.tick(now=0.3)           # death 2 -> respawn (doomed)
+        assert _count(f, "replace") == 2
+        r = f.sc.tick(now=0.6)       # death 3 -> QUARANTINE
+        assert _count(f, "quarantine") == 1
+        assert any("quarantined seat" in a for a in r["actions"])
+        assert len(f.spawned) == 2   # the respawn loop stopped
+        assert f.router.population() == 0
+        # the floor shrank by the parked seat: no topping up either
+        for now in (1.0, 2.0, 5.0):
+            r = f.sc.tick(now=now)
+            assert r["actions"] == []
+        assert len(f.spawned) == 2
+        assert r["pending"] == 0
+        assert _gauge(f, "population") == 0
+        assert _gauge(f, "quarantined") == 1
+        st = f.sc.status()
+        assert st["quarantined_seats"] == 1
+        assert st["population"] == 0
+        assert f.sc.quarantined_count() == 1
+
+    def test_deaths_outside_window_are_pruned(self):
+        f = _mk(1, targets=_targets(flap_threshold=2,
+                                    flap_window_s=1.0))
+        f.reps[0].kill()
+        f.sc.tick(now=0.0)           # death 1, healthy respawn
+        assert _count(f, "replace") == 1
+        f.spawned[0].kill()
+        f.sc.tick(now=5.0)           # death 2, but death 1 aged out
+        assert _count(f, "replace") == 2
+        assert _count(f, "quarantine") == 0
+        assert f.router.population() == 1
+
+
+class TestWarmAdmission:
+    def test_gate_refuses_cold_replica(self):
+        f = _mk(1, require_warm=True, fresh=lambda r: 2)
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.0)
+        r = f.sc.tick(now=1.1)
+        assert f.router.population() == 1        # NOT admitted
+        assert _count(f, "warm_refused") == 1
+        assert _count(f, "spawn_failed") == 1
+        assert any("WarmAdmissionRefused" in a for a in r["actions"])
+        # the probe ran first: the count asserted is the post-probe one
+        assert f.spawned[0].probes == 1
+
+    def test_gate_admits_warm_and_optional(self):
+        f = _mk(1, require_warm=True, fresh=lambda r: 0)
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=1.1)
+        assert f.router.population() == 2
+        assert _count(f, "warm_refused") == 0
+        # require_warm=False admits a cold replica (dev mode)
+        g = _mk(1, require_warm=False, fresh=lambda r: 7)
+        g.reps[0].depth = 10
+        g.sc.tick(now=0.0)
+        g.sc.tick(now=1.1)
+        assert g.router.population() == 2
+        assert _count(g, "warm_refused") == 0
+
+    def test_fresh_compile_count_reads_the_source_label(self):
+        assert fresh_compile_count(_reg()) is None   # no histogram
+        reg = _reg()
+        h = reg.histogram("compile_seconds", "compile wall time",
+                          labels=("source",))
+        h.observe(1.0, source="fresh")
+        h.observe(0.5, source="fresh")
+        h.observe(0.01, source="aot")
+        assert fresh_compile_count(reg) == 2
+
+
+class TestRetryAfterHint:
+    def test_hint_none_then_observed_then_floor(self):
+        f = _mk(1, spawn_hook=lambda r: setattr(r, "depth", 10))
+        assert f.sc.retry_after_hint() is None   # no history
+        f.reps[0].depth = 10
+
+        # record one spawn-to-ready duration: the spawn fn "takes" 4s
+        orig = f.sc._spawn_fn
+
+        def slow():
+            f.clk.t += 4.0
+            return orig()
+
+        f.sc._spawn_fn = slow
+        f.clk.t = 0.0
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=1.1)
+        assert f.router.population() == 2
+        assert f.sc.spawn_stats()["count"] == 1
+        assert f.sc.spawn_stats()["p50_s"] == pytest.approx(4.0)
+        assert f.sc.retry_after_hint() is None   # nothing pending
+
+        # a pending spawn: hint = median - elapsed, floored at 1s
+        gate = threading.Event()
+
+        def blocked():
+            gate.wait(10.0)
+            return orig()
+
+        f.sc._spawn_fn = blocked
+        f.sc.sync = False
+        f.clk.t = 6.0
+        r = f.sc.tick(now=6.0)       # cooldown expired; spawn pends
+        assert r["pending"] == 1 and r["rung"] == RUNG_SPAWN
+        assert f.sc.retry_after_hint() == pytest.approx(4.0)
+        f.clk.t = 9.5                # 3.5s elapsed: 0.5 floors to 1
+        assert f.sc.retry_after_hint() == pytest.approx(1.0)
+        gate.set()
+        f.sc._pending[0].thread.join(timeout=5.0)
+        r = f.sc.tick(now=9.6)
+        assert f.router.population() == 3
+        assert f.sc.retry_after_hint() is None
+
+
+class TestGatewayRetryAfter:
+    def _post(self, port, path, doc):
+        import http.client
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            c.request("POST", path, json.dumps(doc))
+            r = c.getresponse()
+            body = json.loads(r.read().decode() or "{}")
+            return r.status, body, dict(r.getheaders())
+        finally:
+            c.close()
+
+    def test_503_carries_the_hint_ceiled(self, lm):
+        eng = lm.compile_serving(slots=2, max_len=32, prefill_len=8,
+                                 registry=_reg())
+        assert eng.drain(timeout=10.0)   # submits now backpressure
+        server, port = serve_gateway(eng, port=0,
+                                     retry_after=lambda: 7.2)
+        try:
+            status, body, headers = self._post(
+                port, "/v1/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 2})
+            assert status == 503, body
+            assert headers.get("Retry-After") == "8"
+        finally:
+            server.shutdown()
+            server.server_close()
+        # a None/invalid hint falls back to the constant "1"
+        server, port = serve_gateway(eng, port=0,
+                                     retry_after=lambda: None)
+        try:
+            status, _body, headers = self._post(
+                port, "/v1/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 2})
+            assert status == 503
+            assert headers.get("Retry-After") == "1"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestMembershipAndSummaries:
+    def test_router_add_remove_tombstones(self):
+        reps = [_Rep("r0"), _Rep("r1")]
+        rt = FleetRouter(reps, registry=_reg())
+        extra = _Rep("r2")
+        idx = rt.add_replica(extra)
+        assert idx == 2 and rt.population() == 3
+        corpse = rt.remove_replica(1)
+        assert corpse is reps[1]
+        assert rt.replicas[1] is None
+        assert rt.population() == 2
+        assert [i for i, _ in rt.live_replicas()] == [0, 2]
+        assert rt._name(1) == "r1"   # names survive the tombstone
+        h = rt.health()
+        assert h[1] is None
+        assert len(h) == 3
+        assert "r1" not in rt.breaker_states()
+        # routing still works around the hole
+        fut = rt.submit([1, 2, 3], max_new_tokens=1, timeout=5.0)
+        assert fut.result(timeout=5.0)["tokens"] == [1]
+
+    def test_heartbeat_summary_carries_autoscale_block(self):
+        f = _mk(1)
+        f.reps[0].depth = 10
+        f.sc.tick(now=0.0)
+        f.sc.tick(now=1.1)
+        asc = obs_metrics.heartbeat_summary(f.reg).get("autoscale")
+        assert asc is not None
+        assert asc["population"] == 2
+        assert asc["up"] == 1
+        assert asc["down"] == 0
+        assert asc["quarantined"] == 0
+        assert asc["spawn_p50_s"] is not None
+
+    def test_aggregate_summaries_surfaces_stale_ranks(self):
+        step = {"count": 10, "sum": 1.0, "min": 0.05, "max": 0.2,
+                "mean": 0.1}
+        s = {"0": {"step_time": dict(step), "wire_errors": 0},
+             "1": {"step_time": dict(step, count=20, sum=4.0),
+                   "wire_errors": 5}}
+        agg = obs_metrics.aggregate_summaries(
+            s, ages={"0": 0.1, "1": 5.0}, stale_after=0.75)
+        assert agg["stale"] == {"1": 5.0}
+        assert agg["ranks_reporting"] == 1   # rank 1 excluded
+        assert agg["steps"] == 10
+        assert agg["wire_errors"] == 0       # not rank 1's 5
+        # no ages: everyone folds in, nothing marked
+        agg = obs_metrics.aggregate_summaries(s)
+        assert "stale" not in agg
+        assert agg["steps"] == 30

@@ -369,6 +369,40 @@ class TestHeartbeatMetrics:
         finally:
             _close_all(members)
 
+    def test_silent_rank_marked_stale_before_dead(self):
+        """The staleness satellite: a rank that stops beating is
+        flagged ``stale`` (age surfaced) in the coordinator's per-rank
+        health view and its last-known summary leaves the fleet
+        aggregates — frozen gauges are surfaced as dead data, not
+        reported as current load an autoscaler might act on. With
+        FAST's cadence the stale verdict (> 3 beats of silence) lands
+        strictly before the dead-peer verdict (1.0s)."""
+        plan = FaultPlan()
+        plan.drop_peer(4)            # beats 1-3 land, then silence
+        members = _spawn_cluster(2, {1: plan})
+        try:
+            for m in members:
+                m.metrics_source = self._summary_for(m.rank)
+            deadline = time.monotonic() + 10
+            hit = None
+            while time.monotonic() < deadline:
+                h = members[0].health()
+                br = (h.get("worker_metrics_by_rank") or {}).get("1")
+                if br and br.get("stale"):
+                    hit = (h, br)
+                    break
+                time.sleep(0.02)
+            assert hit is not None, "rank 1 never went stale"
+            h, br = hit
+            assert br["hb_age_s"] > FAST.stale_after
+            # ... and the aggregate excluded it, surfacing the age
+            agg = h.get("worker_metrics") or {}
+            assert "1" in (agg.get("stale") or {}), agg
+            # a healthy rank 0 keeps reporting: never zero visibility
+            assert agg.get("ranks_reporting", 0) >= 1
+        finally:
+            _close_all(members)
+
     def test_workers_see_fleet_view_on_ack(self):
         """The aggregate rides back on every hb-ack, so any rank can
         alarm on fleet-wide regressions without asking the

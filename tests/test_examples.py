@@ -373,6 +373,33 @@ class TestServeGatewayExample:
         assert "SHARDED mesh=batch2xmodel2" in out, out[-500:]
         assert "SELFTEST OK" in out, out[-500:]
 
+    def test_serve_transformer_autoscale(self):
+        """The supervised-fleet mode: a 2-replica floor behind a
+        FleetRouter with the Autoscaler owning the population, selftest
+        traffic through the gateway, clean drain of every replica."""
+        out = run_example(["examples/serve_transformer.py", "--cpu",
+                           "--autoscale", "2", "--selftest", "4"])
+        assert "READY port=" in out, out[-500:]
+        assert "replicas=2" in out, out[-500:]
+        assert "AUTOSCALE OK" in out, out[-500:]
+        assert "drain_exit=0" in out, out[-500:]
+
+    @pytest.mark.chaos
+    def test_serve_autoscale_lifecycle_drill(self, tmp_path):
+        """The autoscaler drill, end to end in real subprocesses: AOT
+        prebuild, warm scale-up under sustained load (zero fresh
+        compiles fleet-wide), crash replacement with re-dispatch,
+        calm scale-down through the drain path, and flap quarantine
+        stopping the respawn loop (shared with ``tools/chaos_smoke.py
+        --only serve-autoscale`` — one source of truth)."""
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "chaos_smoke", os.path.join(ROOT, "tools", "chaos_smoke.py"))
+        chaos_smoke = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(chaos_smoke)
+        chaos_smoke.scenario_serve_autoscale(
+            str(tmp_path), chaos_smoke.Budget(300))
+
     @pytest.mark.chaos
     def test_serve_preempt_live_kv_handoff(self, tmp_path):
         """The preemption drill, end to end in real subprocesses: a

@@ -132,12 +132,24 @@ def cmd_prebuild(args):
         print(f"unknown --spec {args.spec!r} (lm | mlp)",
               file=sys.stderr)
         return 2
+    from singa_tpu.aot import cache as aot_cache
+    st = aot_cache.stats(_cache_dir_for(aot_dir))
+    if getattr(args, "json", False):
+        # machine-readable doc: an autoscaler's spawn path (or CI)
+        # parses this to assert the artifacts it will warm-admit
+        # against actually exist before a replica ever boots
+        print(json.dumps({
+            "aot_dir": aot_dir, "spec": args.spec,
+            "programs": {p: {"digest": d["digest"], "env": d["env"]}
+                         for p, d in docs.items()},
+            "cache": {"entries": st["entries"], "bytes": st["bytes"],
+                      "directory": st["directory"]},
+        }, indent=1, sort_keys=True))
+        return 0
     for program, doc in docs.items():
         print(f"[aot] exported {program}: {doc['digest']} "
               f"(jax {doc['env']['jax']}, "
               f"{doc['env']['platform']}/{doc['env']['device_kind']})")
-    from singa_tpu.aot import cache as aot_cache
-    st = aot_cache.stats(_cache_dir_for(aot_dir))
     print(f"[aot] compile cache: {st['entries']} entries, "
           f"{st['bytes']} bytes under {st['directory']}")
     return 0
@@ -321,6 +333,9 @@ def main():
     pb.add_argument("--bs", type=int, default=8)
     pb.add_argument("--features", type=int, default=32)
     pb.add_argument("--classes", type=int, default=10)
+    pb.add_argument("--json", action="store_true",
+                    help="print a machine-readable export doc "
+                         "(digests + cache stats) instead of prose")
 
     ins = sub.add_parser("inspect", help="print artifact manifests")
     ins.add_argument("--aot-dir", required=True)

@@ -62,15 +62,25 @@ harness) against ``examples/train_elastic.py``:
     than their cold baselines, with ZERO ``source="fresh"`` compiles
     and ``n_traces`` still 1 — every executable deserialized from an
     artifact or served from the persistent compile cache.
+11. **serve-autoscale** — the SLO-driven warm autoscaler supervising
+    real gateway subprocesses: a queue-depth breach scales up with a
+    replica admitted through the warm gate (zero fresh compiles, an
+    observed Retry-After while the spawn is in flight), a SIGKILLed
+    replica is replaced with zero failed client responses, sustained
+    calm retires the least-loaded replica through the drain path
+    (every in-flight request delivered), and a flap-injected respawn
+    loop is quarantined after the threshold instead of burning spawns
+    forever. Banks spawn-to-ready p50/p99 and the recovered-request
+    count.
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
-smoke is bounded by ``--budget`` seconds end to end (default 420) —
+smoke is bounded by ``--budget`` seconds end to end (default 600) —
 exceeding it is itself a failure: a chaos path that hangs is exactly
 the bug this suite exists to catch.
 
 Usage::
 
-    python tools/chaos_smoke.py [--budget 420] [--keep-dirs] \
+    python tools/chaos_smoke.py [--budget 600] [--keep-dirs] \
         [--summary-json PATH]
 
 Every kill/restart scenario also measures the restarted run's
@@ -1305,6 +1315,380 @@ def scenario_warm_restart(root, budget):
     bank["serve_warm_first_token_s"] = round(float(warm_tok), 4)
 
 
+def scenario_serve_autoscale(root, budget):
+    """SLO-driven warm autoscaler over real gateway subprocesses: an
+    in-driver ``Autoscaler`` + ``FleetRouter`` supervise replicas that
+    are each an ``examples/serve_transformer.py`` process spawned from
+    prebuilt AOT artifacts. Four legs, one continuous request stream:
+
+    (a) **warm scale-up** — a queue-depth burst breaches the SLO; the
+        spawned replica passes the warm-admission gate with ZERO
+        ``compile_seconds{source="fresh"}`` observations, and while the
+        spawn is in flight :meth:`retry_after_hint` serves an observed
+        (not constant) Retry-After;
+    (b) **replacement** — a replica is SIGKILLed mid-stream; the
+        supervisor respawns it and the router re-dispatches its
+        stranded work — zero failed client responses;
+    (c) **scale-down** — sustained calm retires the least-loaded
+        replica through the drain path (exit 0, every in-flight
+        request delivered);
+    (d) **flap quarantine** — ``FaultPlan.flapping_replica`` dooms
+        every respawn; after ``flap_threshold`` ready↔dead cycles the
+        seat is quarantined and the respawn loop STOPS (the crash-loop
+        money fire the damper exists for).
+
+    Banks spawn-to-ready p50/p99 and the recovered-request count."""
+    import http.client
+    import signal as _signal
+    import threading
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from singa_tpu import serving
+    from singa_tpu.observability import metrics as obs_metrics
+    from singa_tpu.resilience.faults import FaultPlan
+
+    serve = os.path.join(REPO, "examples", "serve_transformer.py")
+    aot_dir = os.path.join(root, "aot")
+    geometry = ["--vocab", "32", "--d-model", "16", "--heads", "2",
+                "--layers", "1", "--slots", "2", "--max-len", "48",
+                "--prefill-len", "8"]
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "aot_cache.py"),
+         "prebuild", "--aot-dir", aot_dir, "--cpu", "--spec", "lm"]
+        + geometry,
+        timeout=budget.remaining(), capture_output=True, text=True)
+    _check(rc.returncode == 0, "serve-autoscale: AOT prebuild",
+           rc.stdout + rc.stderr)
+
+    class GwReplica:
+        """Wire between the router/autoscaler and one gateway
+        subprocess (the serve-crash idiom plus lifecycle verbs: the
+        autoscaler drains, kills and autopsies through this)."""
+
+        def __init__(self, name, port, proc):
+            self.name = name
+            self.port = port
+            self.proc = proc
+            self.draining = False
+            self._lock = threading.Lock()
+            self._outstanding = 0
+
+        def queue_depth(self):
+            with self._lock:
+                return self._outstanding
+
+        def _get_json(self, path, timeout=5):
+            c = http.client.HTTPConnection("127.0.0.1", self.port,
+                                           timeout=timeout)
+            try:
+                c.request("GET", path)
+                return json.loads(c.getresponse().read() or b"{}")
+            finally:
+                c.close()
+
+        def health(self):
+            return self._get_json("/healthz")
+
+        def fresh_compiles(self):
+            n = 0
+            for m in self._get_json("/metrics.json").get("metrics",
+                                                         []):
+                if m.get("name") != "compile_seconds":
+                    continue
+                for s in m.get("series", []):
+                    if s.get("labels", {}).get("source") == "fresh":
+                        n += int(s.get("count", 0))
+            return n
+
+        def submit(self, prompt, **kw):
+            body = json.dumps(
+                {"prompt": list(prompt),
+                 **{k: kw[k] for k in ("max_new_tokens",
+                                       "temperature", "timeout")
+                    if kw.get(k) is not None}})
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.port, timeout=120)
+            try:
+                conn.request("POST", "/v1/generate", body)
+            except OSError as e:
+                conn.close()
+                raise ConnectionError(
+                    f"{self.name}: submit wire error: {e}") from e
+            fut = serving.ServeFuture()
+            with self._lock:
+                self._outstanding += 1
+
+            def _read():
+                try:
+                    r = conn.getresponse()
+                    doc = json.loads(r.read().decode() or "{}")
+                    if r.status == 200:
+                        fut.set_result(doc)
+                    elif r.status == 503:
+                        fut.set_error(serving.EngineDraining(
+                            f"{self.name}: 503 {doc.get('error')}"))
+                    else:
+                        fut.set_error(serving.ServingError(
+                            f"{self.name}: HTTP {r.status}: "
+                            f"{doc.get('error')}"))
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:   # SIGKILL mid-response
+                    fut.set_error(serving.ReplicaCrashed(
+                        f"{self.name}: connection died "
+                        f"mid-request: {e}"))
+                finally:
+                    conn.close()
+                    with self._lock:
+                        self._outstanding -= 1
+
+            threading.Thread(target=_read, daemon=True).start()
+            return fut
+
+        def drain(self, timeout=60.0, handoff=None):
+            """Scale-down retirement: the gateway's own drain finishes
+            every admitted request before the process exits 0 (the
+            router's handoff callable is for in-process engines; a
+            subprocess drains itself)."""
+            self.draining = True
+            try:
+                c = http.client.HTTPConnection(
+                    "127.0.0.1", self.port, timeout=10)
+                c.request("POST", "/drain", "{}")
+                c.getresponse().read()
+                c.close()
+            except OSError:
+                pass        # already dying: the wait below judges it
+            try:
+                code = self.proc.wait(timeout=timeout + 30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                return 1
+            return serving.EXIT_DRAINED if code == 0 else 1
+
+        def kill(self):
+            if self.proc.poll() is None:
+                self.proc.send_signal(_signal.SIGKILL)
+
+        def destroy(self):
+            self.kill()
+            try:
+                self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                pass
+
+    spawned = []
+
+    def spawn():
+        port = _free_port()
+        proc = subprocess.Popen(
+            [sys.executable, serve, "--cpu", "--port", str(port),
+             "--aot-dir", aot_dir] + geometry,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        r = GwReplica(f"g{len(spawned)}", port, proc)
+        spawned.append(r)
+        return r
+
+    reg = obs_metrics.MetricsRegistry()
+    errors, stop_trickle = [], threading.Event()
+
+    def _await(cond, what, timeout=150.0):
+        deadline = time.monotonic() + min(timeout, budget.remaining())
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"serve-autoscale: timed out waiting "
+                             f"for {what}")
+
+    try:
+        r0 = spawn()
+        _await(lambda: r0.proc.poll() is None and _probe(r0),
+               "base replica READY")
+        rt = serving.FleetRouter([r0], registry=reg,
+                                 breaker_threshold=2,
+                                 breaker_backoff=0.5,
+                                 max_redispatch=3)
+        plan = FaultPlan()
+        scaler = serving.Autoscaler(
+            rt, spawn,
+            targets=serving.AutoscaleTargets(
+                min_replicas=1, max_replicas=2, queue_high=2.0,
+                queue_low=1.0, up_window_s=0.6, down_window_s=1.5,
+                up_cooldown_s=2.0, down_cooldown_s=2.0,
+                replace_after_s=0.5, flap_threshold=3,
+                flap_window_s=120.0, drain_deadline_s=60.0,
+                spawn_timeout_s=120.0),
+            registry=reg, interval=0.25, require_warm=True,
+            fresh_compiles=lambda r: r.fresh_compiles(),
+            destroy=lambda r: r.destroy(), probe_timeout=60.0,
+            faults=plan)
+        scaler.start()
+
+        def trickle():
+            # one request always in flight: scale-down retirement has
+            # real in-flight work to deliver, and ANY dropped response
+            # anywhere in the run is a scenario failure
+            rng = np.random.RandomState(3)
+            while not stop_trickle.is_set():
+                p = rng.randint(1, 32, (4,)).tolist()
+                try:
+                    f = rt.submit(p, max_new_tokens=4,
+                                  temperature=0.0, timeout=60.0)
+                    doc = f.result(timeout=60.0)
+                    if len(doc.get("tokens", [])) != 4:
+                        errors.append(f"trickle: short {doc}")
+                except serving.RequestShed:
+                    time.sleep(0.2)     # the shed rung is working
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"trickle: {type(e).__name__}: {e}")
+
+        tr = threading.Thread(target=trickle, daemon=True)
+        tr.start()
+
+        # ---- leg (a): sustained load -> breach -> warm scale-up -----
+        # a one-shot burst drains before the hysteresis window
+        # elapses (that is the POINT of hysteresis); breaching the SLO
+        # takes load that STAYS: 8 closed-loop workers for ~12s keep
+        # the per-replica queue depth pinned above queue_high
+        burst, hints = [], []
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(1, 32, (8,)).tolist()
+                   for _ in range(10)]
+        load_until = time.monotonic() + 12.0
+
+        def load_worker(w):
+            while time.monotonic() < load_until:
+                try:
+                    f = rt.submit(prompts[w % len(prompts)],
+                                  max_new_tokens=24,
+                                  temperature=0.0, timeout=120.0)
+                    doc = f.result(timeout=120.0)
+                    if len(doc.get("tokens", [])) != 24:
+                        errors.append(f"load {w}: short")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(
+                        f"load {w}: {type(e).__name__}: {e}")
+                    return
+
+        for w in range(8):
+            t = threading.Thread(target=load_worker, args=(w,))
+            t.start()
+            burst.append(t)
+        _await(lambda: (hints.append(scaler.retry_after_hint())
+                        or rt.population() >= 2),
+               "warm scale-up to 2 replicas")
+        for t in burst:
+            t.join(timeout=budget.remaining())
+        _check(reg.get("autoscale_up_total").total() >= 1,
+               "serve-autoscale: scale-up decision fired")
+        _check(reg.get("autoscale_warm_refused_total").total() == 0
+               and reg.get("autoscale_spawn_failed_total").total()
+               == 0,
+               "serve-autoscale: spawn admitted through the warm gate")
+        fresh = {r.name: r.fresh_compiles()
+                 for _i, r in rt.live_replicas()}
+        _check(all(n == 0 for n in fresh.values()),
+               f"serve-autoscale: zero fresh compiles fleet-wide "
+               f"({fresh})")
+
+        # ---- leg (b): SIGKILL -> replacement ------------------------
+        victim = next(r for _i, r in rt.live_replicas())
+        pop_before = rt.population()
+        inflight = []
+
+        def one_kill(i):
+            try:
+                f = rt.submit(prompts[i], max_new_tokens=24,
+                              temperature=0.0, timeout=120.0)
+                doc = f.result(timeout=120.0)
+                if len(doc.get("tokens", [])) != 24:
+                    errors.append(f"kill-leg {i}: short")
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"kill-leg {i}: {type(e).__name__}: {e}")
+
+        for i in range(6):
+            t = threading.Thread(target=one_kill, args=(i,))
+            t.start()
+            inflight.append(t)
+        _await(lambda: victim.queue_depth() >= 1,
+               "victim holds in-flight work", timeout=30.0)
+        victim.kill()
+        _await(lambda: (hints.append(scaler.retry_after_hint())
+                        or (reg.get("autoscale_replace_total").total()
+                            >= 1 and rt.population() >= pop_before)),
+               "replacement respawn")
+        for t in inflight:
+            t.join(timeout=budget.remaining())
+        _check(any(h is not None and h >= 1.0 for h in hints),
+               "serve-autoscale: retry_after_hint served an observed "
+               "(>=1s) value while a spawn was in flight")
+
+        # ---- leg (c): calm -> drain-based scale-down ----------------
+        _await(lambda: (reg.get("autoscale_down_total").total() >= 1
+                        and rt.population() == 1
+                        and scaler.status()["retiring"] == 0),
+               "calm scale-down to the 1-replica floor")
+        stop_trickle.set()
+        tr.join(timeout=60)
+        _check(not errors,
+               f"serve-autoscale: zero failed client responses "
+               f"({len(errors)} failed)", repr(errors[:4]))
+        recovered = int(
+            reg.get("serve_fleet_redispatch_total").total())
+        _check(recovered >= 1,
+               f"serve-autoscale: stranded requests re-dispatched "
+               f"({recovered} recovered)")
+
+        # ---- leg (d): flap quarantine -------------------------------
+        plan.flapping_replica(1, times=3)   # every respawn is doomed
+        last = next(r for _i, r in rt.live_replicas())
+        last.kill()
+        _await(lambda: reg.get("autoscale_quarantine_total").total()
+               >= 1, "flap quarantine")
+        n_spawned = len(spawned)
+        time.sleep(2.0)     # a quarantined seat must STAY parked
+        _check(len(spawned) == n_spawned
+               and scaler.status()["pending_spawns"] == 0,
+               "serve-autoscale: quarantine stopped the respawn loop "
+               f"(population {rt.population()})")
+        _check(reg.get("autoscale_population").value() == 0,
+               "serve-autoscale: population gauge tracks the "
+               "quarantined fleet")
+        hs = obs_metrics.heartbeat_summary(reg)["autoscale"]
+        _check(hs["up"] >= 1 and hs["down"] >= 1
+               and hs["replace"] >= 1 and hs["quarantine"] >= 1
+               and hs["spawn_p50_s"] is not None,
+               f"serve-autoscale: heartbeat_summary carries the "
+               f"autoscale block {hs}")
+        st = scaler.spawn_stats()
+        BANK["serve-autoscale"] = {
+            "spawn_to_ready_p50_s": round(st["p50_s"], 4),
+            "spawn_to_ready_p99_s": round(st["p99_s"], 4),
+            "spawns": int(st["count"]),
+            "recovered_requests": recovered,
+        }
+        scaler.stop()
+    finally:
+        stop_trickle.set()
+        for r in spawned:
+            if r.proc.poll() is None:
+                r.proc.kill()
+        for r in spawned:
+            try:
+                r.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+def _probe(r):
+    try:
+        return r.health().get("status") == "serving"
+    except OSError:
+        return False
+
+
 SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("commit-hole", scenario_commit_hole),
              ("barrier-missing", scenario_barrier_missing),
@@ -1314,12 +1698,13 @@ SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("serve-drain", scenario_serve_drain),
              ("serve-crash", scenario_serve_crash),
              ("serve-preempt", scenario_serve_preempt),
-             ("warm-restart", scenario_warm_restart)]
+             ("warm-restart", scenario_warm_restart),
+             ("serve-autoscale", scenario_serve_autoscale)]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--budget", type=float, default=420.0,
+    ap.add_argument("--budget", type=float, default=600.0,
                     help="hard wall-clock budget in seconds for the "
                          "WHOLE smoke")
     ap.add_argument("--keep-dirs", action="store_true")
