@@ -17,6 +17,7 @@ Usage: python examples/train_cnn.py [cnn|alexnet|resnet|xceptionnet|mlp]
            [-p float32|bfloat16|bf16_mixed] [--layout auto|NCHW|NHWC]
            [--dist] [--dist-option plain|half|partialUpdate|
             sparseTopK|sparseThreshold] [--spars 0.05] [--cpu]
+           [--mesh DxM] [--fsdp]
            [--bucket-mb 0] [--no-overlap] [--fused-optim]
            [--verbosity 0] [--npz path.npz]
            [--resilient] [--ckpt-dir ckpts_cnn] [--save-every 50]
@@ -86,6 +87,19 @@ def build_parser():
     ap.add_argument("--dist", action="store_true")
     ap.add_argument("--dist-option", default="plain")
     ap.add_argument("--spars", type=float, default=0.05)
+    ap.add_argument("--mesh", default=None, metavar="DxM",
+                    help="GSPMD train mesh 'DxM' (data x model degrees, "
+                         "e.g. 8x1) — the train step compiles as ONE "
+                         "jitted program with NamedSharding in/out "
+                         "(Model.compile(mesh=...)); XLA inserts the "
+                         "grad collectives. Mirrors serve_transformer's "
+                         "--mesh")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO/FSDP on the GSPMD path: optimizer state "
+                         "+ fp32 masters sharded over 'data', gathered "
+                         "just-in-time inside the step (~Nx per-chip "
+                         "optimizer-state headroom). Implies a default "
+                         "data mesh when --mesh is not given")
     ap.add_argument("--bucket-mb", default="0",
                     help="with --dist: gradient-psum bucket size target "
                          "in MiB (DistOpt bucket_mb) — gradients "
@@ -315,10 +329,24 @@ def main():
             t = t.as_type(jnp.bfloat16)
         return t
 
+    mesh_obj = None
+    if args.mesh or args.fsdp:
+        from singa_tpu.parallel import gspmd
+        if args.mesh:
+            d_, m_ = (int(v) for v in args.mesh.lower().split("x"))
+        else:
+            import jax
+            d_, m_ = len(jax.devices()), 1
+        mesh_obj = gspmd.train_mesh(data=d_, model=m_)
+        print(f"GSPMD train mesh=data{d_}xmodel{m_}"
+              f"{' fsdp=data' if args.fsdp else ''}", flush=True)
+
     tx = stage(train_x[:args.bs])
     model.compile([tx], is_train=True, use_graph=True,
                   policy="bf16_mixed" if args.precision == "bf16_mixed"
-                  else None)
+                  else None,
+                  mesh=mesh_obj,
+                  fsdp_axis="data" if args.fsdp else None)
 
     eye = np.eye(num_classes, dtype=np.float32)
     acc = metric.Accuracy()

@@ -106,7 +106,14 @@ def run_rank(args):
     # saved_world compares per-process DEVICE degrees, a different
     # quantity (1 per process here), so it is not passed.
     mesh = mesh_mod.elastic_mesh()
+    if args.mesh:
+        # explicit GSPMD train mesh (data x model): same axis names as
+        # the elastic mesh, so checkpoint shardings re-land unchanged
+        from singa_tpu.parallel import gspmd
+        d_, m_ = (int(v) for v in args.mesh.lower().split("x"))
+        mesh = gspmd.train_mesh(data=d_, model=m_)
     communicator.set_mesh(mesh)
+    use_gspmd = bool(args.mesh or args.fsdp)
 
     faults = FaultPlan()
     if args.die_at >= 0 and args.rank == args.die_rank:
@@ -136,7 +143,13 @@ def run_rank(args):
                        requires_grad=False)
 
     m = build_model(args.lr)
-    m.compile([tx], is_train=True, use_graph=True)
+    m.compile([tx], is_train=True, use_graph=True,
+              mesh=mesh if use_gspmd else None,
+              fsdp_axis="data" if args.fsdp else None)
+    if use_gspmd:
+        print(f"rank {args.rank}: GSPMD train "
+              f"mesh=data{mesh.shape['data']}xmodel{mesh.shape['model']}"
+              f"{' fsdp=data' if args.fsdp else ''}", flush=True)
 
     trainer = ResilientTrainer(
         m, args.dir, max_to_keep=args.keep,
@@ -202,6 +215,14 @@ def main():
                     help="PER-REPLICA batch size (the elastic invariant)")
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--mesh", default=None, metavar="DxM",
+                    help="GSPMD train mesh 'DxM' (data x model): compile "
+                         "the step as ONE jitted NamedSharding program "
+                         "instead of the shard_map driver")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO/FSDP over 'data' on the GSPMD path "
+                         "(optimizer state + masters sharded, gathered "
+                         "just-in-time)")
     ap.add_argument("--world", type=int, default=1)
     ap.add_argument("--rank", type=int, default=None,
                     help="this process's rank; omit to spawn all ranks")
@@ -273,6 +294,8 @@ def main():
             cmd += [f"--{k.replace('_', '-')}", str(v)]
         if args.cpu:
             cmd.append("--cpu")
+        if args.fsdp:   # bools are skipped above; forward explicitly
+            cmd.append("--fsdp")
         procs.append(subprocess.Popen(cmd))
     rcs = [p.wait() for p in procs]
     print(f"launcher: rank exit codes {rcs}", flush=True)

@@ -21,7 +21,12 @@ not exist yet — restores momentum/moments too and replays the exact
 trajectory. Every entry restores onto the CURRENT topology: live
 counterparts keep their sharding, fresh optimizer aux adopts its owning
 param's live sharding (never the layout persisted by a possibly
-different mesh).
+different mesh). ZeRO/FSDP needs no special handling here for the same
+reason: the sharded-optimizer layout is recomputed from announced specs
+when the GSPMD step compiles (``gspmd.fsdp_state_spec``), so a
+ZeRO-sharded checkpoint restores bit-identical into the same mesh, a
+different data degree, or an unsharded model — the live run owns the
+layout, the checkpoint owns the bytes.
 """
 
 from __future__ import annotations

@@ -16,11 +16,20 @@ mode buffers ops into the C++ Graph on the first call and replays it after
   (scheduler.cc:671-688) and its topological scheduling for free;
 - later calls replay the compiled executable.
 
-Distributed: if the model's optimizer is a ``DistOpt``, the compiled step is
-``shard_map``'d over the mesh 'data' axis — inputs batch-sharded, state
-replicated — and the per-gradient ``psum`` calls inside the tape become ICI
-all-reduces that XLA overlaps with remaining backward compute (the TPU form
-of the reference's stream-overlap design, opt.py:826-865).
+Distributed, two generations:
+
+- legacy (``DistOpt`` without ``compile(mesh=)``): the compiled step is
+  ``shard_map``'d over the mesh 'data' axis — inputs batch-sharded, state
+  replicated — and the per-gradient ``psum`` calls inside the tape become
+  ICI all-reduces that XLA overlaps with remaining backward compute (the
+  TPU form of the reference's stream-overlap design, opt.py:826-865);
+- GSPMD (``compile(mesh=...)`` / ``fsdp_axis=`` / ``DistOpt(zero=True)``):
+  the SAME step body jitted once with NamedSharding in/out annotations
+  from ``parallel/gspmd.py`` — no shard_map, no hand-written psum (the
+  communicator is identity outside its collective context); XLA's SPMD
+  partitioner inserts the gradient all-reduces, and under FSDP shards
+  optimizer state + masters over 'data' with just-in-time gathers
+  (reduce-scatter grads → sharded update → all-gather params).
 """
 
 from __future__ import annotations
@@ -195,6 +204,8 @@ class Model(Layer):
         self._steps = {}           # static-arg signature -> compiled step
         self._state_list = None
         self._dist = None
+        self._gspmd_mesh = None    # compile(mesh=...) → GSPMD train step
+        self._fsdp_axis = None     # ZeRO/FSDP shard axis (GSPMD only)
         self._policy = None        # mixed_precision.Policy (compile arg)
         self._step_count = 0
         self._eval_steps = {}      # input signature -> compiled eval step
@@ -287,9 +298,28 @@ class Model(Layer):
 
     # -- compile -----------------------------------------------------------
     def compile(self, inputs, is_train=True, use_graph=False,
-                sequential=False, policy=None, compile_cache=None):
+                sequential=False, policy=None, compile_cache=None,
+                mesh=None, fsdp_axis=None):
         """Shape-infer via a dry forward run (reference model.py:156-184),
         decide graph (jit) mode, and detect a distributed optimizer.
+
+        ``mesh``: a named :class:`jax.sharding.Mesh` (e.g.
+        ``parallel.gspmd.train_mesh(data=8)``) switching the compiled
+        train step onto the GSPMD path: ONE jitted program whose
+        state/batch arguments carry explicit NamedShardings from the
+        ``parallel/gspmd.py`` spec vocabulary — no shard_map wrapper,
+        no hand-written psum; XLA's SPMD partitioner inserts the
+        gradient all-reduces. Bitwise-parity-pinned against the legacy
+        shard_map DP driver (the CI multichip leg).
+
+        ``fsdp_axis``: ZeRO/FSDP memory layout on the GSPMD path —
+        params, fp32 masters and optimizer aux sharded over this mesh
+        axis (``True`` means ``'data'``) and gathered just-in-time
+        inside the program (XLA emits reduce-scatter grads → sharded
+        update → all-gather params), ~N× optimizer-state headroom per
+        chip. Implied by a ``DistOpt(zero=True)`` optimizer; with no
+        explicit ``mesh`` the default data mesh of the model's
+        platform is used.
 
         ``policy``: a :class:`singa_tpu.mixed_precision.Policy` (or its
         name, e.g. ``"bf16_mixed"``) activating mixed-precision compile:
@@ -322,7 +352,7 @@ class Model(Layer):
         t0 = time.perf_counter()
         with _obs_spans.span("compile", policy=str(policy)):
             self._compile_body(inputs, is_train, use_graph, sequential,
-                              policy)
+                              policy, mesh=mesh, fsdp_axis=fsdp_axis)
         _obs_metrics.default_registry().histogram(
             "model_compile_seconds",
             "Model.compile wall-clock (dry run + shape inference; the "
@@ -383,7 +413,7 @@ class Model(Layer):
         return build_engine(self, policy=pol, **kw)
 
     def _compile_body(self, inputs, is_train, use_graph, sequential,
-                      policy):
+                      policy, mesh=None, fsdp_axis=None):
         from . import mixed_precision as mp
         new_policy = mp.resolve(policy)
         if new_policy != getattr(self, "_policy", None):
@@ -438,6 +468,21 @@ class Model(Layer):
             # a wrapper (e.g. resilience.GuardedOptimizer) around a
             # DistOpt: the mesh/collective plumbing keys off the DistOpt
             self._dist = opt.inner
+        if fsdp_axis is True:
+            from .parallel.gspmd import DATA_AXIS
+            fsdp_axis = DATA_AXIS
+        if fsdp_axis is None and self._dist is not None and \
+                getattr(self._dist, "zero", False):
+            # DistOpt(zero=True) is the optimizer-side spelling of
+            # compile(fsdp_axis=...): same GSPMD+FSDP program
+            fsdp_axis = self._dist.axis_name
+        if (mesh, fsdp_axis) != (self._gspmd_mesh, self._fsdp_axis) \
+                and self._steps:
+            # a re-compile that changes the partitioning mode must not
+            # replay executables built for the old layout
+            self._invalidate_compiled()
+        self._gspmd_mesh = mesh
+        self._fsdp_axis = fsdp_axis
         self._compiled = True
         self.train(is_train)
 
@@ -591,12 +636,19 @@ class Model(Layer):
         if opt is not None:
             (opt.opt if hasattr(opt, "opt") else opt)._frozen = True
 
+    def _gspmd_active(self):
+        """True when the train step compiles on the GSPMD path (one
+        jitted program, NamedSharding in/out, XLA-inserted collectives)
+        instead of the legacy shard_map + explicit-psum path."""
+        return self._gspmd_mesh is not None or self._fsdp_axis is not None
+
     def _build_step(self, layout):
         self._ensure_state()
         state_list = self._state_list
         rec = {"jit": None, "builder": None, "out_tree": {},
                "leaf_specs": None, "input_specs": None}
         dist = self._dist
+        gspmd = self._gspmd_active()
         n_inputs = sum(1 for s in layout if s is _TENSOR)
 
         def fn(state_arrays, rng_key, *input_arrays):
@@ -610,9 +662,13 @@ class Model(Layer):
             # step's key — no host-side eager split per step (it cost more
             # than the whole dispatch of a small compiled step)
             rng_key, next_key = jax.random.split(rng_key)
-            if dist is not None:
+            if dist is not None and not gspmd:
                 # distinct rng per batch-shard (data and, under sequence
-                # parallelism, seq); model-parallel members share the key
+                # parallelism, seq); model-parallel members share the key.
+                # The GSPMD path traces OUTSIDE shard_map (axis names are
+                # unbound — axis_index would not even trace) and draws
+                # global-batch randomness from the one shared key, which
+                # XLA partitions like any other value.
                 for ax in dist.communicator.reduce_axes:
                     rng_key = jax.random.fold_in(
                         rng_key, jax.lax.axis_index(ax))
@@ -639,10 +695,13 @@ class Model(Layer):
                 # step-boundary output cast: compute may run 16-bit but
                 # what the host sees is the policy's output dtype
                 leaves = [pol.cast_output(x) for x in leaves]
-            if dist is not None:
+            if dist is not None and not gspmd:
                 # output leaves that end up replicated (loss scalars,
                 # metrics, param snapshots) are averaged across batch-like
-                # shards so the replicated out-spec is sound
+                # shards so the replicated out-spec is sound. GSPMD leaves
+                # are already GLOBAL values — XLA stitches them; a pmean
+                # would both double-average and fail to trace (unbound
+                # axis names outside shard_map).
                 specs = rec["leaf_specs"]
                 raxes = tuple(dist.communicator.reduce_axes)
                 leaves = [x if specs[i] != P() else jax.lax.pmean(x, raxes)
@@ -650,7 +709,77 @@ class Model(Layer):
             new_state = [t.data for t in state_list]
             return new_state, leaves, next_key
 
-        if dist is not None:
+        if gspmd:
+            from jax.sharding import NamedSharding
+            from .parallel import gspmd as _gspmd
+            from .parallel.communicator import get_mesh
+            mesh = self._gspmd_mesh
+            if mesh is None:
+                # fsdp_axis-only compile: default data mesh over the
+                # devices of the model's platform
+                mesh = (dist.communicator.mesh
+                        if dist is not None and
+                        dist.communicator.mesh is not None
+                        else get_mesh(devices=jax.devices(
+                            self.dev.jax_device.platform)))
+            fsdp = self._fsdp_axis
+            axis = dist.axis_name if dist is not None else _gspmd.DATA_AXIS
+            if axis not in mesh.shape:
+                raise _gspmd.ShardingDecline(
+                    f"train mesh {dict(mesh.shape)} has no batch axis "
+                    f"{axis!r}: build it via parallel.gspmd.train_mesh "
+                    "or parallel.mesh.MeshConfig")
+            if fsdp is not None and fsdp not in mesh.shape:
+                raise _gspmd.ShardingDecline(
+                    f"fsdp_axis {fsdp!r} is not in the train mesh "
+                    f"{dict(mesh.shape)}")
+            if dist is not None:
+                # keep the communicator's mesh pointer current so
+                # checkpoint manifests / heartbeats describe the mesh
+                # this model actually trains on (its collectives stay
+                # identity — the GSPMD body never enters the context)
+                dist.communicator.mesh = mesh
+
+            def build(sample_inputs, rng):
+                # output shapes are known from the first (abstract) full-
+                # batch rehearsal; an output is batch-sharded iff its
+                # leading dim is the global batch
+                leaves = []
+                _flatten(self._eager_out, leaves)
+                full_batch = sample_inputs[0].shape[0]
+                # per-state layouts from the ONE sharding vocabulary:
+                # announced tensor/expert specs mesh-fitted; under FSDP
+                # each state tensor additionally shards its first
+                # divisible replicated dim over the fsdp axis
+                if fsdp is not None:
+                    state_specs = [_gspmd.fsdp_state_spec(
+                        t.spec, t.shape, mesh, axis=fsdp)
+                        for t in state_list]
+                else:
+                    state_specs = [_fit_state_spec(t.spec, t.shape, mesh)
+                                   for t in state_list]
+                self._state_specs = state_specs
+                user_in = getattr(self, "input_specs", None)
+                rec["input_specs"] = list(user_in) if user_in is not None \
+                    else [P(axis)] * n_inputs
+                rec["leaf_specs"] = _resolve_leaf_specs(
+                    leaves, full_batch, rec["input_specs"], axis,
+                    getattr(self, "output_specs", None))
+
+                def ns(s):
+                    return NamedSharding(mesh, s)
+
+                in_sh = ([ns(s) for s in state_specs], ns(P()),
+                         *[ns(s) for s in rec["input_specs"]])
+                out_sh = ([ns(s) for s in state_specs],
+                          [ns(s) for s in rec["leaf_specs"]], ns(P()))
+                rec["raw_fn"] = fn   # step_flops' reference twin
+                return jax.jit(fn, in_shardings=in_sh,
+                               out_shardings=out_sh, donate_argnums=(0,))
+
+            rec["builder"] = build
+            self._mesh, self._axis = mesh, axis
+        elif dist is not None:
             from .parallel.communicator import get_mesh
             mesh = dist.communicator.mesh
             if mesh is None:
@@ -776,7 +905,7 @@ class Model(Layer):
             # through to the normal fresh build below.
             store = getattr(self, "_aot_store", None)
             if store is not None and self._dist is None and \
-                    isinstance(key, tuple):
+                    not self._gspmd_active() and isinstance(key, tuple):
                 try:
                     from .aot import export as _aot_export
                     rec = _aot_export.load_train_step(
@@ -804,7 +933,7 @@ class Model(Layer):
         if rec["jit"] is None:
             rec["jit"] = rec["builder"](input_arrays, rng)
         state_arrays = [t.data for t in self._state_list]
-        if self._dist is not None:
+        if self._dist is not None or self._gspmd_active():
             from jax.sharding import NamedSharding
             rep = NamedSharding(self._mesh, P())
             place = self._place_mesh
@@ -918,7 +1047,7 @@ class Model(Layer):
                 step=self._step_count)
             rec["arg_sig"] = sig
         self.dev._set_rng_state(next_key)  # tracing clobbered dev rng
-        if self._dist is not None:
+        if self._dist is not None or self._gspmd_active():
             # bound the async in-flight queue: a host loop can dispatch
             # compiled steps much faster than they run, and hundreds of
             # queued multi-device programs starve the collective
@@ -1229,15 +1358,21 @@ class Model(Layer):
                     "(the compiled step is positional); got keyword "
                     f"arguments {sorted(kwargs)}")
             return self._run_step(*args)
-        if self._dist is not None:
+        if self._dist is not None or self._gspmd_active():
+            # the sharded (shard_map) eval path needs a communicator for
+            # its cross-shard reductions and consumes state in the TRAIN
+            # layout — under FSDP that layout splits whole weights, so
+            # eval instead gathers below and runs the eager forward
             if (not kwargs and self.graph_mode and args
+                    and self._dist is not None
+                    and self._fsdp_axis is None
                     and getattr(self, "_mesh", None) is not None
                     and all(isinstance(a, Tensor) for a in args)):
                 res = self._run_eval(*args)
                 if res is not NotImplemented:
                     return res
-            # fallback (no mesh yet / odd batch / kwargs): gather state
-            # to the model device and run the eager forward
+            # fallback (no mesh yet / odd batch / kwargs / FSDP): gather
+            # state to the model device and run the eager forward
             self._unshard_state()
         prev = CTX.training
         CTX.training = False

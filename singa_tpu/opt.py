@@ -503,10 +503,19 @@ class DistOpt:
 
     def __init__(self, opt=None, nccl_id=None, local_rank=None,
                  world_size=None, buffSize=None, axis_name="data",
-                 reduce_axes=None, bucket_mb=None, overlap=True):
+                 reduce_axes=None, bucket_mb=None, overlap=True,
+                 zero=False):
         """``reduce_axes``: mesh axes gradients are summed over (default
         just the data axis; add 'seq' under sequence parallelism where the
         token batch is split over that axis too).
+
+        ``zero=True``: ZeRO/FSDP — optimizer state and fp32 masters
+        sharded over the data axis, gathered just-in-time inside the
+        compiled step. Implies the GSPMD train path
+        (``Model.compile`` picks it up as ``fsdp_axis=axis_name``); the
+        specialized drivers (half/partialUpdate/sparse) keep replicated
+        state and raise a typed :class:`ShardingDecline` instead of
+        running a silently replicated "ZeRO" step.
 
         ``bucket_mb``: size target (MiB of wire bytes) for gradient-psum
         bucketing. ``None``/``0`` keeps the per-gradient streaming psum;
@@ -537,6 +546,7 @@ class DistOpt:
         if self.bucket_mb < 0:
             raise ValueError(f"bucket_mb must be >= 0, got {bucket_mb!r}")
         self.overlap = bool(overlap)
+        self.zero = bool(zero)
         # sparsification error-feedback residuals (reference sparse modes)
         self._residuals = {}
 
@@ -768,6 +778,25 @@ class DistOpt:
         for key in order:
             yield from self._flush_bucket(key, buckets[key][0], wire)
 
+    def _decline_zero(self, driver):
+        """``zero=True`` under a specialized driver is REFUSED, not
+        warned: these drivers keep their own per-gradient reduction +
+        replicated optimizer state, so a ZeRO request would silently
+        train with full-size state on every chip while the run reports
+        "ZeRO" — the exact lie the typed-decline discipline exists to
+        prevent. Use the plain driver (``model(tx, ty)`` /
+        ``backward_and_update``) on the GSPMD path, or drop zero."""
+        if not getattr(self, "zero", False):
+            return
+        from .parallel.gspmd import ShardingDecline
+        raise ShardingDecline(
+            f"DistOpt(zero=True) cannot run the {driver} driver: it "
+            "keeps replicated optimizer state and hand-rolled "
+            "per-gradient collectives, so the requested ZeRO sharding "
+            "would silently not happen. Use the plain driver "
+            "(backward_and_update via the compiled GSPMD step) or "
+            "construct the DistOpt without zero=True")
+
     def _warn_driver_skips_bucketing(self, driver):
         """The specialised drivers (half / partialUpdate / sparse) keep
         their own per-gradient reduction paths: a bucket_mb/overlap
@@ -836,6 +865,7 @@ class DistOpt:
         POLICY selects the fp16 wire, clipping turns on with it (this
         driver runs unguarded, so an unclipped policy-default fp16 wire
         would let one large gradient sum land inf in the params)."""
+        self._decline_zero('backward_and_update_half')
         self._warn_driver_skips_bucketing('backward_and_update_half')
         dtype, clipping = self._half_wire_defaults(dtype, clipping)
         wire = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
@@ -875,6 +905,7 @@ class DistOpt:
         but XLA cannot skip a collective on a traced predicate, so every
         gradient is still reduced and only the APPLICATION is masked.
         """
+        self._decline_zero('backward_and_partial_update')
         self._warn_driver_skips_bucketing('backward_and_partial_update')
         n = max(1, self.communicator.effective_world_size())
         if rotation is not None:
@@ -902,6 +933,7 @@ class DistOpt:
         stays dense (masked values + psum ride the ICI all-reduce) while the
         semantics — threshold or top-K selection, residual accumulation —
         match the reference."""
+        self._decline_zero('backward_and_sparse_update')
         self._warn_driver_skips_bucketing('backward_and_sparse_update')
         for p, g in autograd.backward(loss):
             name = p.name or f"param/{id(p)}"

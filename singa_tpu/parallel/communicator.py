@@ -10,6 +10,16 @@ A Communicator's ops are *context sensitive*: inside a compiled step that
 the Model layer has shard_map'd over the mesh, ``all_reduce`` lowers to
 ``lax.psum`` on the 'data' axis; outside any mesh context it degrades to the
 identity (a world of one), so single-chip scripts run unchanged.
+
+Deprecation boundary: this module is the LEGACY explicit-collective
+mechanism. The GSPMD train step (``Model.compile(mesh=...)``) traces the
+same step body OUTSIDE any collective context — the identity degradation
+above is exactly what lets one body serve both generations — and lets XLA
+insert the gradient collectives from ``NamedSharding`` annotations. The
+shard_map driver, the pipeline schedules, and sync-BN's in-graph pmeans
+still run through here; new sharded code should not add collectives here
+(see :func:`partitioner` and docs/distributed.md "One sharding
+vocabulary").
 """
 
 from __future__ import annotations
@@ -100,13 +110,14 @@ def partitioner(mesh=None, batch_axis="data", model_axis="model"):
     """Deprecation-boundary shim onto the ONE sharding vocabulary.
 
     The communicator's explicit-collective mechanism (shard_map +
-    psum/ppermute) stays for the compiled training step, but layouts
-    belong to :mod:`.gspmd`: this returns the shared
-    :class:`~singa_tpu.parallel.gspmd.Partitioner` over the given (or
-    process-default) mesh so code still living on this mechanism
-    expresses shardings through the same specs the GSPMD serving path
-    uses. New sharded code should annotate with NamedSharding via
-    gspmd and jit — not add hand-rolled collectives here."""
+    psum/ppermute) stays for the LEGACY training driver and the
+    pipeline schedules, but layouts belong to :mod:`.gspmd`: this
+    returns the shared :class:`~singa_tpu.parallel.gspmd.Partitioner`
+    over the given (or process-default) mesh so code still living on
+    this mechanism expresses shardings through the same specs the
+    GSPMD train step and serving path use. New sharded code should
+    annotate with NamedSharding via gspmd and jit — not add
+    hand-rolled collectives here."""
     from .gspmd import Partitioner
     return Partitioner(mesh if mesh is not None else get_mesh(),
                        batch_axis=batch_axis, model_axis=model_axis)

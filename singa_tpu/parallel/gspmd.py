@@ -14,15 +14,20 @@ program text runs on 1 chip or 6000.
 
 Two mechanisms coexist during the migration:
 
-- **GSPMD (this module)** — serving and anything newly written: one
-  jitted program over NamedSharding-annotated arrays.
+- **GSPMD (this module)** — serving AND the train step
+  (``Model.compile(mesh=...)``): one jitted program over
+  NamedSharding-annotated arrays. The train program's state shardings
+  come from :func:`fit_state_spec` (and :func:`fsdp_state_spec` under
+  ZeRO/FSDP), its batch inputs from the 'data' axis; XLA inserts the
+  gradient all-reduces (or reduce-scatter/all-gather under FSDP).
 - **shard_map + explicit collectives** (``communicator.py``,
-  ``ops.py``, ``pipeline.py``) — the training step's existing
-  mechanism. It STAYS (the Model layer's compiled step is built on it)
-  but it is a deprecation boundary: its layers announce their layouts
-  through this module's spec vocabulary (so the two mechanisms can
-  never disagree about what "column-parallel" means), and new sharded
-  code should not add hand-rolled collectives.
+  ``ops.py``, ``pipeline.py``) — the train step's LEGACY mechanism,
+  still the default when ``compile`` is called without ``mesh=``. It
+  remains the bitwise-parity reference the GSPMD path is pinned
+  against, but it is a deprecation boundary: its layers announce their
+  layouts through this module's spec vocabulary (so the two mechanisms
+  can never disagree about what "column-parallel" means), and new
+  sharded code should not add hand-rolled collectives.
 
 Declines are TYPED, never silent: a config the mesh cannot honor
 (heads that don't divide the model axis, a vocab that doesn't split, a
@@ -39,6 +44,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BATCH_AXIS = "batch"
 MODEL_AXIS = "model"
+# the TRAINING batch axis (serving uses BATCH_AXIS; training meshes come
+# from parallel.mesh whose dp axis has always been named 'data')
+DATA_AXIS = "data"
 
 
 class ShardingDecline(ValueError):
@@ -119,6 +127,38 @@ def fit_state_spec(spec, shape, mesh):
     return P(*fitted)
 
 
+def fsdp_state_spec(spec, shape, mesh, axis=DATA_AXIS):
+    """ZeRO/FSDP layout for ONE param / optimizer-aux / master tensor:
+    the announced spec (mesh-fitted through :func:`fit_state_spec`)
+    with the first still-replicated dim that divides the ``axis``
+    degree additionally sharded over it. Params never announce the
+    data axis themselves, so this composes with tensor/expert layouts
+    instead of double-sharding a dim. Scalars (step counter, loss
+    scale) and tensors with no divisible dim stay replicated — an
+    honest fallback, not a decline: FSDP is a memory layout, and a
+    handful of tiny replicated leaves does not change the N× headroom
+    the big buffers provide."""
+    if axis not in mesh.shape:
+        raise ShardingDecline(
+            f"fsdp axis {axis!r} is not in the mesh "
+            f"{dict(mesh.shape)}: build the train mesh with a "
+            f"{axis!r} axis (parallel.mesh.MeshConfig names it)")
+    base = fit_state_spec(spec, shape, mesh)
+    deg = int(mesh.shape[axis])
+    if deg <= 1 or not shape:
+        return base
+    entries = list(base) + [None] * (len(shape) - len(base))
+    for dim, names in enumerate(entries):
+        if names is None and shape[dim] % deg == 0:
+            entries[dim] = axis
+            break
+    else:
+        return base
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
 # ---------------------------------------------------------------------------
 # mesh construction
 # ---------------------------------------------------------------------------
@@ -158,6 +198,38 @@ def serving_mesh(devices=None, model_shards=1, batch_shards=None):
         b = n // m
     arr = np.asarray(devices[:b * m]).reshape(b, m)
     return Mesh(arr, (BATCH_AXIS, MODEL_AXIS))
+
+
+def train_mesh(devices=None, data=-1, model=1, stage=1):
+    """A named training mesh over the (data × model × stage)
+    vocabulary. ONE table with the shard_map world: ``stage`` binds to
+    ``parallel.mesh``'s existing ``pipe`` axis name (pipeline stages),
+    so pipeline layouts, ``elastic_mesh`` resharding, and checkpoint
+    live-sharding all keep speaking the same axis names across the
+    GSPMD migration. ``data=-1`` means "everything left" — the elastic
+    default. Fully explicit degrees may use a leading device SUBSET
+    (trailing devices idle — the caller chose, same contract as
+    :func:`serving_mesh` with an explicit batch degree). Typed
+    declines for device counts the degrees cannot tile."""
+    import jax
+    from . import mesh as mesh_mod
+    if devices is None:
+        devices = jax.devices()
+    d, m, s = int(data), int(model), int(stage)
+    if m < 1 or s < 1:
+        raise ShardingDecline(
+            f"model={m} / stage={s} degrees must be >= 1")
+    n = len(devices)
+    need = m * s * (d if d != -1 else 1)
+    if need > n or n % (m * s) != 0:
+        raise ShardingDecline(
+            f"train mesh data={d} model={m} stage={s} cannot tile the "
+            f"{n} available devices: degrees must cover the device "
+            "set exactly")
+    if d != -1:
+        devices = list(devices)[:d * m * s]
+    cfg = mesh_mod.MeshConfig(data=d, model=m, pipe=s)
+    return mesh_mod.make_mesh(devices, cfg)
 
 
 def serving_partitioner(mesh=None, model_shards=None, devices=None,
@@ -427,9 +499,10 @@ def serving_arg_specs(part, kv_layout):
     }
 
 
-__all__ = ["BATCH_AXIS", "MODEL_AXIS", "ShardingDecline",
+__all__ = ["BATCH_AXIS", "MODEL_AXIS", "DATA_AXIS", "ShardingDecline",
            "replicated_spec", "col_spec", "col_bias_spec", "row_spec",
            "vocab_spec", "expert_spec", "batch_spec", "fit_state_spec",
-           "serving_mesh", "serving_partitioner", "Partitioner",
+           "fsdp_state_spec", "serving_mesh", "train_mesh",
+           "serving_partitioner", "Partitioner",
            "lm_param_specs", "ring_cache_specs", "pool_specs",
            "serving_arg_specs"]

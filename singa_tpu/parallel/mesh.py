@@ -17,11 +17,19 @@ from jax.sharding import Mesh
 
 @dataclass
 class MeshConfig:
-    """Logical parallelism degrees; -1 on ``data`` means "everything left"."""
+    """Logical parallelism degrees; -1 on ``data`` means "everything left".
+
+    ONE axis table for both train-step generations: the GSPMD path's
+    ``parallel.gspmd.train_mesh(data=, model=, stage=)`` builds through
+    this config with its ``stage`` vocabulary bound to the existing
+    ``pipe`` axis NAME, so pipeline layouts, ``elastic_mesh``
+    resharding and checkpoint live-sharding keep speaking identical
+    axis names across the migration (a rename would silently orphan
+    every announced PartitionSpec)."""
 
     data: int = -1      # dp replicas
     model: int = 1      # tp shards
-    pipe: int = 1       # pp stages
+    pipe: int = 1       # pp stages ('stage' in the gspmd train vocabulary)
     seq: int = 1        # sp shards (long-context)
     expert: int = 1     # ep shards (MoE experts)
 

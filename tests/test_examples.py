@@ -71,6 +71,22 @@ class TestExamples:
         out = run_example(args[:4] + ["2"] + args[5:])   # 2 epochs now
         assert "resumed from checkpoint" in out, out[-500:]
 
+    def test_train_cnn_gspmd_mesh_fsdp(self):
+        """The GSPMD train-step migration through the user CLI: --mesh
+        2x1 compiles the single-jit sharded step on the hermetic CPU
+        mesh, --fsdp shards optimizer state over the data axis
+        (mirrors test_serve_transformer_explicit_mesh for training)."""
+        out = run_example(["examples/train_cnn.py", "mlp", "synthetic",
+                           "--cpu", "--epochs", "1", "--iters", "2",
+                           "--bs", "8", "--mesh", "2x1"])
+        assert "GSPMD train mesh=data2xmodel1" in out, out[-500:]
+        assert "loss" in out.lower(), out[-500:]
+        out = run_example(["examples/train_cnn.py", "mlp", "synthetic",
+                           "--cpu", "--epochs", "1", "--iters", "2",
+                           "--bs", "8", "--mesh", "2x1", "--fsdp"])
+        assert "GSPMD train mesh=data2xmodel1 fsdp=data" in out, out[-500:]
+        assert "loss" in out.lower(), out[-500:]
+
     def test_train_resnet_perf_modes(self):
         """The round-5 perf modes through the user CLI: channels-last
         trunk + space-to-depth stem on the resnet family."""

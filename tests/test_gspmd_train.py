@@ -1,0 +1,239 @@
+"""Tier-1 pins for the single-jit GSPMD train step (the shard_map ->
+GSPMD migration): Model.compile(mesh=) builds ONE jitted program whose
+params, optimizer aux, and batch carry explicit NamedShardings, with
+XLA inserting the gradient collectives — pinned BITWISE against the
+legacy shard_map DP driver. The ZeRO/FSDP mode (DistOpt(zero=True) or
+compile(fsdp_axis=)) shards optimizer state over 'data' and is pinned
+on its HLO collective schedule and its per-device byte accounting.
+Runs on the hermetic 8-virtual-CPU-device mesh (conftest XLA_FLAGS).
+"""
+
+import numpy as np
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from singa_tpu import device, layer, model, opt
+from singa_tpu.parallel import gspmd, mesh as mesh_mod
+from singa_tpu.parallel.communicator import set_mesh
+from singa_tpu.tensor import Tensor
+
+
+class MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def make_xy(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+    return x, y
+
+
+@pytest.fixture
+def dp4():
+    msh = mesh_mod.make_mesh(jax.devices("cpu")[:4],
+                             mesh_mod.MeshConfig())
+    set_mesh(msh)
+    yield msh
+    set_mesh(None)
+
+
+def _train(dev, msh, steps=3, seed=7, mesh_kw=None, zero=False):
+    """One eager + `steps` compiled steps; returns (model, losses)."""
+    dev.SetRandSeed(seed)
+    x, y = make_xy()
+    tx = Tensor(data=x, device=dev, requires_grad=False)
+    ty = Tensor(data=y, device=dev, requires_grad=False)
+    m = MLP()
+    d = opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9), world_size=4,
+                    zero=zero)
+    d.communicator.mesh = msh
+    m.set_optimizer(d)
+    kw = {"mesh": mesh_kw} if mesh_kw is not None else {}
+    m.compile([tx], is_train=True, use_graph=True, **kw)
+    m(tx, ty)
+    losses = [np.asarray(m(tx, ty)[1].data) for _ in range(steps)]
+    return m, losses
+
+
+class TestGspmdParity:
+    def test_bitwise_matches_shardmap_dp(self, dp4):
+        """The migration's acceptance pin: loss AND every param/aux
+        tensor bitwise-equal to the shard_map DP driver across 3
+        compiled steps (power-of-2 batch/world: every mean is an exact
+        exponent shift, so the two collective schedules commute)."""
+        dev = device.create_cpu_device()
+        ref, ref_losses = _train(dev, dp4)               # shard_map
+        g, g_losses = _train(dev, dp4, mesh_kw=dp4)      # GSPMD
+        for a, b in zip(ref_losses, g_losses):
+            np.testing.assert_array_equal(a, b)
+        ref_states = {k: np.asarray(t.data)
+                      for k, t in ref.get_states().items()}
+        for k, t in g.get_states().items():
+            np.testing.assert_array_equal(np.asarray(t.data),
+                                          ref_states[k], err_msg=k)
+
+    def test_single_trace_donation_and_collective(self, dp4):
+        """ONE trace for eager+compiled steps, donated buffers (the
+        in-place update path survived the migration), and XLA actually
+        inserted the gradient all-reduce (no hand-written psum)."""
+        dev = device.create_cpu_device()
+        g, _ = _train(dev, dp4, mesh_kw=dp4)
+        info = g.compiled_step_info()
+        assert info["n_traces"] == 1
+        assert (info["donated_bytes"] or 0) > 0
+        assert "all-reduce" in info["hlo"]
+
+
+class TestFsdp:
+    def test_hlo_schedule_and_state_bytes(self, dp4):
+        """The ZeRO pin: per-device optimizer-state bytes ~= replicated
+        / N, and the HLO carries the gather/scatter schedule — NOT N
+        all-reduces. XLA:CPU lowers reduce-scatter as all-reduce +
+        dynamic-slice (no reduce-scatter op on that backend); TPU emits
+        the op itself, so the pin accepts either spelling."""
+        dev = device.create_cpu_device()
+        f, losses = _train(dev, dp4, mesh_kw=dp4, zero=True)
+        info = f.compiled_step_info()
+        assert info["n_traces"] == 1
+        assert (info["donated_bytes"] or 0) > 0
+        hlo = info["hlo"]
+        assert "all-gather" in hlo
+        assert "reduce-scatter" in hlo or \
+            ("all-reduce" in hlo and "dynamic-slice" in hlo)
+        state = [t.data for t in f._state_list]
+        per_dev = gspmd.Partitioner.per_device_bytes(state)
+        glob = gspmd.Partitioner.global_bytes(state)
+        assert glob / max(1, per_dev) > 0.8 * 4
+        assert all(np.isfinite(loss) for loss in losses)
+
+    def test_fsdp_axis_flag_without_distopt(self, dp4):
+        """compile(fsdp_axis='data') shards state with a PLAIN
+        optimizer too — ZeRO is a layout, not a DistOpt feature."""
+        dev = device.create_cpu_device()
+        dev.SetRandSeed(7)
+        x, y = make_xy()
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        m = MLP()
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        m.compile([tx], is_train=True, use_graph=True, mesh=dp4,
+                  fsdp_axis="data")
+        m(tx, ty)
+        m(tx, ty)
+        state = [t.data for t in m._state_list]
+        ratio = (gspmd.Partitioner.global_bytes(state)
+                 / max(1, gspmd.Partitioner.per_device_bytes(state)))
+        assert ratio > 0.8 * 4
+
+
+class TestMigratedPathGauges:
+    def test_exposed_comm_gauge_publishes_on_gspmd_step(self, dp4):
+        """The PR-13 regression guard survives the migration: the
+        profiled GSPMD step still feeds the timeline decomposition and
+        `timeline_exposed_collective_seconds` publishes (on one CPU
+        host the exposed time is ~0 — the pin is the series exists)."""
+        from singa_tpu.observability import metrics as obs_metrics
+        from singa_tpu.observability import timeline
+        dev = device.create_cpu_device()
+        g, _ = _train(dev, dp4, mesh_kw=dp4)
+        x, y = make_xy()
+        tx = Tensor(data=x, device=dev, requires_grad=False)
+        ty = Tensor(data=y, device=dev, requires_grad=False)
+        ev = []
+        g.profile_step(tx, ty, record=False, events_out=ev)
+        tl = timeline.analyze(ev)
+        reg = obs_metrics.MetricsRegistry()
+        timeline.record_timeline(tl, registry=reg, site="train")
+        gauge = reg.get("timeline_exposed_collective_seconds")
+        assert gauge is not None
+        assert np.isfinite(gauge.value(site="train"))
+
+
+class TestZeroDriverDeclines:
+    """DistOpt(zero=True) + a specialized hand-rolled driver would
+    silently keep replicated state — each driver declines TYPED."""
+
+    @pytest.mark.parametrize("driver,args", [
+        ("backward_and_update_half", (None,)),
+        ("backward_and_partial_update", (None,)),
+        ("backward_and_sparse_update", (None,)),
+    ])
+    def test_typed_decline(self, driver, args):
+        d = opt.DistOpt(opt.SGD(lr=0.1), world_size=4, zero=True)
+        with pytest.raises(gspmd.ShardingDecline, match="zero=True"):
+            getattr(d, driver)(*args)
+
+    def test_plain_distopt_drivers_not_declined(self):
+        """zero=False must leave the specialized drivers reachable
+        (they fail later on the None loss, not on the zero gate)."""
+        d = opt.DistOpt(opt.SGD(lr=0.1), world_size=4)
+        with pytest.raises(Exception) as ei:
+            d.backward_and_update_half(None)
+        assert not isinstance(ei.value, gspmd.ShardingDecline)
+
+
+class TestFsdpStateSpec:
+    def test_shards_first_divisible_replicated_dim(self, dp4):
+        assert gspmd.fsdp_state_spec(P(), (8, 4), dp4) == P("data")
+
+    def test_composes_with_announced_model_spec(self, dp4):
+        # dim0 already belongs to 'model': FSDP takes the next dim
+        got = gspmd.fsdp_state_spec(P("model"), (8, 8), dp4)
+        assert got == P("model", "data")
+
+    def test_indivisible_and_scalar_stay_replicated(self, dp4):
+        base = gspmd.fit_state_spec(P(), (6,), dp4)
+        assert gspmd.fsdp_state_spec(P(), (6,), dp4) == base
+        assert gspmd.fsdp_state_spec(P(), (), dp4) == \
+            gspmd.fit_state_spec(P(), (), dp4)
+
+    def test_unknown_axis_declines(self, dp4):
+        with pytest.raises(gspmd.ShardingDecline):
+            gspmd.fsdp_state_spec(P(), (8,), dp4, axis="nonexistent")
+
+
+class TestTrainMesh:
+    def test_stage_binds_to_pipe_axis_name(self):
+        msh = gspmd.train_mesh(jax.devices("cpu")[:8], data=2, model=2,
+                               stage=2)
+        assert msh.shape["data"] == 2
+        assert msh.shape["model"] == 2
+        # ONE axis table: 'stage' is the existing 'pipe' NAME, so
+        # announced PartitionSpecs keep resolving across the migration
+        assert msh.shape["pipe"] == 2
+        assert "stage" not in msh.shape
+
+    def test_explicit_degrees_take_device_subset(self):
+        # 8 devices, data=2 model=1: leading 2 devices, rest idle
+        # (the serving_mesh explicit-degree contract)
+        msh = gspmd.train_mesh(jax.devices("cpu"), data=2, model=1)
+        assert msh.devices.size == 2
+
+    def test_elastic_data_defaults_to_everything_left(self):
+        msh = gspmd.train_mesh(jax.devices("cpu")[:8], model=2)
+        assert msh.shape["data"] == 4
+
+    def test_untileable_degrees_decline(self):
+        devs = jax.devices("cpu")[:4]
+        with pytest.raises(gspmd.ShardingDecline):
+            gspmd.train_mesh(devs, data=4, model=2)
+        with pytest.raises(gspmd.ShardingDecline):
+            gspmd.train_mesh(devs, model=0)
+        with pytest.raises(gspmd.ShardingDecline):
+            gspmd.train_mesh(devs, model=3)

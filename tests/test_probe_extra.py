@@ -106,6 +106,94 @@ def test_fused_optim_ab_record_and_margin(monkeypatch, banked):
     assert rec["winner"] == "reference"
 
 
+def test_ab_box_salvages_completed_configs(monkeypatch, banked):
+    """The A/B box contract: a config that dies mid-sweep leaves every
+    FINISHED config's summary field already in the caller's box (the
+    per-config write happens before the next config starts)."""
+    calls = []
+
+    def fake_measure(dev, batch, niters, warmup, image_size, depth,
+                     dtype_name, layout="NCHW", stem=None,
+                     fused_optim=None):
+        calls.append(bool(fused_optim))
+        if fused_optim:
+            raise RuntimeError("tunnel died mid-sweep")
+        return 32.0 / (13.0 / 1e3), 13.0
+
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(bench, "_peak_flops", lambda *a, **k: 197e12)
+    monkeypatch.setattr(bench, "_conv_layout",
+                        lambda: ("NHWC", "measured-ab"))
+    box = {}
+    with pytest.raises(RuntimeError):
+        probe._fused_optim_ab(types.SimpleNamespace(jax_device=None),
+                              out=box)
+    assert box["extra"] == "fused_optim_ab"
+    assert box["reference_step_ms"] == 13.0      # completed half kept
+    assert "fused_step_ms" not in box
+    # the completed config's probe record banked before the crash
+    assert [r for _, r in banked
+            if r.get("extra") == "fused_optim_probe"]
+
+
+def test_run_one_leg_banks_partial_on_timeout(monkeypatch, banked):
+    """main()'s banking contract: a hung box leg banks the box under
+    `{leg}_partial` (NOT the success marker — the watcher retries, the
+    data survives) and STOPS the window; a mid-sweep exception banks
+    the partial but lets later legs run."""
+    import time as _time
+
+    def _fused_optim_ab(dev, out=None):
+        out.update({"extra": "fused_optim_ab",
+                    "reference_step_ms": 13.0})
+        _time.sleep(30)          # the second config hangs
+
+    assert probe._run_one_leg(_fused_optim_ab, None, 0.2) is False
+    # hung leg: the window must stop (the chip may still be occupied)
+    (_, rec), = [(e, r) for e, r in banked
+                 if r.get("extra", "").startswith("fused_optim_ab")]
+    assert rec["extra"] == "fused_optim_ab_partial"
+    assert rec["partial"] is True
+    assert rec["reference_step_ms"] == 13.0
+    assert "hung" in rec["error"]
+
+    banked.clear()
+
+    def _grad_bucket_ab(dev, out=None):
+        out.update({"extra": "grad_bucket_ab", "mb0_step_ms": 5.0})
+        raise RuntimeError("config mb=1 died")
+
+    assert probe._run_one_leg(_grad_bucket_ab, None, 5) is True
+    (_, rec), = [(e, r) for e, r in banked]
+    assert rec["extra"] == "grad_bucket_ab_partial"
+    assert rec["partial"] is True and rec["mb0_step_ms"] == 5.0
+
+    banked.clear()
+
+    # an empty box (died before any config) banks the plain error name
+    def _conv_epilogue_ab(dev, out=None):
+        raise RuntimeError("compile failed")
+
+    assert probe._run_one_leg(_conv_epilogue_ab, None, 5) is True
+    (_, rec), = [(e, r) for e, r in banked]
+    assert rec["extra"] == "_conv_epilogue_ab_error"
+
+
+def test_fold_extras_keeps_partial_until_success(monkeypatch):
+    """A salvaged `{leg}_partial` record folds into the round artifact
+    (flagged partial) only while no full success exists."""
+    obs = [{"event": "extra", "extra": "grad_bucket_ab_partial",
+            "partial": True, "mb0_step_ms": 5.0, "error": "hung"}]
+    folded = bench._fold_extras(obs)
+    assert folded["grad_bucket_ab_partial"]["partial"] is True
+    assert "grad_bucket_ab" not in folded
+    obs.append({"event": "extra", "extra": "grad_bucket_ab",
+                "winner": "4", "error": None})
+    folded = bench._fold_extras(obs)
+    assert "grad_bucket_ab_partial" not in folded
+    assert folded["grad_bucket_ab"]["winner"] == "4"
+
+
 def test_bench_fused_optim_choice_consumes_banked_winner(monkeypatch):
     """bench._fused_optim routes through the one _measured_choice
     mechanism: env pin > fresh banked fused_optim_ab winner >

@@ -216,7 +216,7 @@ def _resnet_stem_ab(dev):
     return out
 
 
-def _fused_optim_ab(dev):
+def _fused_optim_ab(dev, out=None):
     """Third MFU lever, same mechanism as the layout/stem A/Bs: THE
     benchmark bf16 b32 ResNet-50 step with the Pallas fused
     optimizer-update kernels (ops/fused_optim.py, SGD momentum in one
@@ -224,14 +224,18 @@ def _fused_optim_ab(dev):
     elementwise chain. Parity is pinned in tests; bench._fused_optim()
     consumes the banked winner so the full benchmark that follows runs
     the measured-faster form. Fused must beat reference by >2% to win —
-    inside that margin the reference default stands."""
+    inside that margin the reference default stands. Summary fields
+    accumulate in the caller's ``out`` box as each config completes, so
+    a config that hangs or dies still salvages the finished half under
+    a ``_partial`` marker (main's banking contract)."""
     peak = bench._peak_flops(getattr(dev.jax_device, "device_kind", ""))
     layout, layout_src = bench._conv_layout()
     leg_dtype, bf16_mode = bench._bf16_leg_dtype()
-    out = {"extra": "fused_optim_ab", "batch": 32, "dtype": leg_dtype,
-           "bf16_mode": bf16_mode,
-           "conv_layout": layout, "conv_layout_src": layout_src,
-           "timing": "slope-readback"}
+    out = {} if out is None else out
+    out.update({"extra": "fused_optim_ab", "batch": 32,
+                "dtype": leg_dtype, "bf16_mode": bf16_mode,
+                "conv_layout": layout, "conv_layout_src": layout_src,
+                "timing": "slope-readback"})
     ms = {}
     for mode in ("reference", "fused"):
         thr, step_ms = bench._measure(dev, batch=32, niters=20, warmup=3,
@@ -255,7 +259,7 @@ def _fused_optim_ab(dev):
     return out
 
 
-def _grad_bucket_ab(dev):
+def _grad_bucket_ab(dev, out=None):
     """The ``grad_bucket_ab`` producer (ROADMAP open item since PR 13):
     sweep ``DistOpt(bucket_mb=..., overlap=True)`` on a REAL multi-chip
     mesh and bank the winning bucket size — ``bench._grad_bucket_mb``
@@ -265,15 +269,21 @@ def _grad_bucket_ab(dev):
     something where it runs: a multi-device window. A single-chip
     window banks an honest ``skipped`` marker (the watcher counts the
     leg done instead of retrying a leg that can never run here) with
-    no ``winner``, so the measured-choice resolver never consumes it."""
+    no ``winner``, so the measured-choice resolver never consumes it.
+    Per-config step times land in the caller's ``out`` box INSIDE the
+    sweep loop, so a later config's hang still salvages every finished
+    bucket size under a ``_partial`` marker (main's banking
+    contract)."""
     import jax
     import numpy as np
+    out = {} if out is None else out
     accel = [d for d in jax.devices() if d.platform != "cpu"]
     ndev = len(accel) if accel else len(jax.devices())
     if ndev < 2:
-        return {"extra": "grad_bucket_ab", "n_devices": ndev,
-                "skipped": "single-device window — gradient-psum "
-                           "bucketing needs a multi-chip mesh"}
+        out.update({"extra": "grad_bucket_ab", "n_devices": ndev,
+                    "skipped": "single-device window — gradient-psum "
+                               "bucketing needs a multi-chip mesh"})
+        return out
     from singa_tpu import layer, opt, tensor
     from singa_tpu import model as smodel
 
@@ -304,8 +314,8 @@ def _grad_bucket_ab(dev):
     rng = np.random.RandomState(0)
     xs = rng.randn(64, 1024).astype(np.float32)
     ys = np.eye(16, dtype=np.float32)[rng.randint(0, 16, 64)]
-    out = {"extra": "grad_bucket_ab", "n_devices": ndev,
-           "timing": "slope-readback"}
+    out.update({"extra": "grad_bucket_ab", "n_devices": ndev,
+                "timing": "slope-readback"})
     ms = {}
     for mb in ("0", "1", "4", "16"):
         m = _WideMLP()
@@ -321,12 +331,13 @@ def _grad_bucket_ab(dev):
         dt = bench._slope_time(lambda: m(tx, ty)[1],
                                lambda l: l.data, 10, 60)
         ms[mb] = dt * 1e3
-        # per-config record the moment it exists (tunnel-drop safety)
+        # per-config record the moment it exists (tunnel-drop safety),
+        # and the summary field lands in the box before the NEXT config
+        # starts — a hang at mb=16 still salvages mb 0/1/4
+        out[f"mb{mb}_step_ms"] = round(dt * 1e3, 3)
         emit({"extra": "grad_bucket_probe", "bucket_mb": mb,
               "step_ms": round(dt * 1e3, 3), "n_devices": ndev,
               "timing": "slope-readback"})
-    out.update({f"mb{mb}_step_ms": round(v, 3)
-                for mb, v in ms.items()})
     best = min(ms, key=ms.get)
     # a bucketed config must beat the streaming baseline by >2% to
     # win — inside that margin the per-gradient default stands
@@ -335,7 +346,7 @@ def _grad_bucket_ab(dev):
     return out
 
 
-def _conv_epilogue_ab(dev):
+def _conv_epilogue_ab(dev, out=None):
     """The ``conv_epilogue_ab`` producer (ROADMAP open item since
     PR 13): THE benchmark ResNet-50 b32 JITTED inference forward with
     the Pallas conv→BN→ReLU epilogue peephole (ops/fused_epilogue.py)
@@ -343,7 +354,10 @@ def _conv_epilogue_ab(dev):
     ``bench._conv_epilogue`` and the quant leg's fused sub-leg consume
     the banked winner. Fused must beat reference by >2% — parity is
     test-pinned, so the measured-faster form is a labeled optimization,
-    never a model change."""
+    never a model change. Summary fields accumulate in the caller's
+    ``out`` box as each mode completes, so a hang in the second mode
+    still salvages the first under a ``_partial`` marker (main's
+    banking contract)."""
     import jax
     import numpy as np
     from singa_tpu import tensor
@@ -364,9 +378,10 @@ def _conv_epilogue_ab(dev):
         with m._policy_scope():
             return m.forward(t).data
 
-    out = {"extra": "conv_epilogue_ab", "batch": 32,
-           "conv_layout": layout, "conv_layout_src": layout_src,
-           "timing": "slope-readback"}
+    out = {} if out is None else out
+    out.update({"extra": "conv_epilogue_ab", "batch": 32,
+                "conv_layout": layout, "conv_layout_src": layout_src,
+                "timing": "slope-readback"})
     ms = {}
     for mode in ("reference", "fused"):
         # the peephole engages at TRACE time: a fresh jit per mode,
@@ -681,6 +696,46 @@ LEGS = (_resnet_fusion_profile, _resnet_layout_ab,
         _grad_bucket_ab, _conv_epilogue_ab,
         _resnet50_bf16_large_batch, _mlp_step_time, _flash_block_sweep)
 
+# multi-config A/B legs that accumulate their summary into an ``out``
+# box as each config completes: these run under bench._leg_guard so a
+# hung config banks the finished half instead of losing the round
+AB_BOX_LEGS = {"fused_optim_ab", "grad_bucket_ab", "conv_epilogue_ab"}
+
+
+def _run_one_leg(fn, dev, leg_timeout):
+    """Run one probe leg with the banking contract. Box legs
+    (AB_BOX_LEGS) run under a watchdog; on a hang or mid-sweep death
+    the box's completed configs bank under ``{leg}_partial`` — NOT the
+    success marker, so the watcher still retries, but the data survives
+    the window. Returns False when the window must STOP (a hung leg's
+    abandoned thread may still occupy the exclusive-access chip — any
+    later leg would measure interleaved work and lie)."""
+    name = fn.__name__.lstrip("_")
+    box = {} if name in AB_BOX_LEGS else None
+    try:
+        if box is not None:
+            rec = bench._leg_guard(lambda: fn(dev, out=box),
+                                   leg_timeout, name)
+        else:
+            rec = fn(dev)
+        if rec:
+            emit(rec)
+        return True
+    except TimeoutError as e:
+        if box:
+            emit({**box, "extra": f"{name}_partial", "partial": True,
+                  "error": str(e)[:200]})
+        else:
+            emit({"extra": f"{fn.__name__}_error", "error": str(e)[:200]})
+        return box is None
+    except Exception as e:
+        if box:
+            emit({**box, "extra": f"{name}_partial", "partial": True,
+                  "error": str(e)[:200]})
+        else:
+            emit({"extra": f"{fn.__name__}_error", "error": str(e)[:200]})
+        return True
+
 
 def main():
     bench._enable_compile_cache()
@@ -726,16 +781,15 @@ def main():
               "device_kind": getattr(d, "device_kind", "?")})
         from singa_tpu import device as sdev
         dev = sdev.create_tpu_device()
+        leg_timeout = float(os.environ.get("TPU_EXTRA_LEG_TIMEOUT",
+                                           "600"))
         for fn in LEGS:
             if fn.__name__.lstrip("_") not in legs:
                 continue
-            try:
-                rec = fn(dev)
-                if rec:
-                    emit(rec)
-            except Exception as e:
-                emit({"extra": f"{fn.__name__}_error",
-                      "error": str(e)[:200]})
+            if not _run_one_leg(fn, dev, leg_timeout):
+                print(f"{fn.__name__}: hung leg — stopping the window "
+                      "(partial results banked)", file=sys.stderr)
+                break
 
 
 if __name__ == "__main__":
