@@ -756,6 +756,22 @@ def run_bench(batch=32, niters=50, warmup=8, image_size=224, depth=50,
             if sweep_box.get("configs"):
                 res["serving_sweep"] = dict(sweep_box, partial=True)
         _emit_partial(res, "serving_sweep")
+    # disaggregated-pool serving leg (BENCH_SERVING_DISAGG=1 opt-in:
+    # it compiles three engines): one prefill + two decode replicas
+    # behind the FleetRouter's prefix-affinity transfer path under
+    # Poisson load — banks TTFT p99 on the prefill pool and per-token
+    # p50/p99 on the decode pool, the SLO split disaggregation buys
+    if os.environ.get("BENCH_SERVING_DISAGG", "0") == "1":
+        try:
+            res["serving_disagg"] = _leg_guard(
+                lambda: _measure_serving_disagg(dev), leg_budget,
+                "serving_disagg")
+        except TimeoutError as e:
+            res["serving_disagg_error"] = str(e)[:200]
+            res["leg_timeout"] = "serving_disagg"
+        except Exception as e:
+            res["serving_disagg_error"] = str(e)[:200]
+        _emit_partial(res, "serving_disagg")
     # quant leg (singa_tpu.quant): int8 weight-only inference — ResNet
     # img/s + LM tok/s + serving decode tok/s + quantized-checkpoint
     # bytes on disk, each with its MFU where one is defined. Banked and
@@ -1087,6 +1103,145 @@ def _measure_serving_sharded(dev, slots=4, max_len=96, prefill_len=16,
         "hbm_peak_bytes": _peak_hbm(dev),
         "compile": _compile_delta(cc0),
     }
+
+
+def _measure_serving_disagg(dev, slots=4, max_len=96, prefill_len=16,
+                            n_requests=24, new_tokens=32, rps=8.0,
+                            seed=0):
+    """The banked ``serving_disagg`` leg: the SAME small TransformerLM
+    split into disaggregated pools — one prefill replica transferring
+    every sealed KV snapshot to one of two decode replicas through a
+    ``FleetRouter``'s prefix-affinity routing — under seeded Poisson
+    load. Banks the SLO split the architecture exists for: TTFT p99
+    measured on the PREFILL pool (admission + chunked prefill, no
+    decode ticks competing) and per-token p50/p99 measured on the
+    DECODE pool (steady decode, no prefill bubbles), plus decode
+    tok/s, transfer count, and the affinity hit ratio. Half the
+    prompts share a prefix so affinity has something to hit."""
+    import numpy as np
+
+    from singa_tpu import tensor
+    from singa_tpu.models import transformer
+    from singa_tpu.observability import metrics as obs_metrics
+    from singa_tpu.observability.export import series_quantiles
+    from singa_tpu.serving import FleetRouter, ServingReplica
+
+    cc0 = _compile_stats()
+    vocab = 512
+    model = transformer.TransformerLM(vocab, d_model=128, n_heads=4,
+                                      n_layers=2, max_len=max_len,
+                                      tp=False)
+    model.eval()
+    model(tensor.Tensor(data=np.zeros((1, prefill_len), np.float32),
+                        device=dev, requires_grad=False))
+    kw = dict(slots=slots, max_len=max_len, prefill_len=prefill_len,
+              kv_layout="paged", kv_block_size=4)
+    preg = obs_metrics.MetricsRegistry()
+    dregs = [obs_metrics.MetricsRegistry() for _ in range(2)]
+    pe = model.compile_serving(pool_role="prefill", registry=preg,
+                               **kw)
+    des = [model.compile_serving(pool_role="decode", registry=r, **kw)
+           for r in dregs]
+    rreg = obs_metrics.MetricsRegistry()
+    reps = [ServingReplica(pe, name="p0", registry=preg).start()]
+    reps += [ServingReplica(d, name=f"d{i}",
+                            registry=dregs[i]).start()
+             for i, d in enumerate(des)]
+    rt = FleetRouter(reps, registry=rreg)
+
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(1, vocab, (max(2, prefill_len // 2),))
+
+    def mk_prompt():
+        if rng.rand() < 0.5:
+            tail = rng.randint(
+                1, vocab,
+                (int(rng.randint(1, max(2, prefill_len
+                                        - shared.size + 1))),))
+            return np.concatenate([shared, tail])[:prefill_len]
+        return rng.randint(1, vocab,
+                           (int(rng.randint(1, prefill_len + 1)),))
+
+    try:
+        # warmup: both pools compile off the clock
+        futs = [rt.submit(mk_prompt(), max_new_tokens=new_tokens,
+                          timeout=120) for _ in range(2)]
+        for f in futs:
+            f.result(timeout=120)
+
+        def _series(reg, name):
+            # a pool replica the affinity hash hasn't routed to yet
+            # has an empty histogram — treat it as all-zero
+            m = reg.get(name)
+            series = m.to_doc()["series"] if m is not None else []
+            return series[0] if series else None
+
+        def _delta(a, b):
+            if a is None:
+                return None
+            if b is None:
+                return dict(a, buckets=[list(x) for x in a["buckets"]])
+            return {"count": a["count"] - b["count"],
+                    "sum": a["sum"] - b["sum"],
+                    "buckets": [[le, ca - cb] for (le, ca), (_le, cb)
+                                in zip(a["buckets"], b["buckets"])]}
+
+        def _merge(ds):
+            ds = [d for d in ds if d is not None]
+            return {"count": sum(d["count"] for d in ds),
+                    "sum": sum(d["sum"] for d in ds),
+                    "buckets": [[row[0][0],
+                                 sum(r[1] for r in row)] for row
+                                in zip(*(d["buckets"] for d in ds))]}
+
+        ttft0 = _series(preg, "serve_ttft_seconds")
+        tpot0 = [_series(r, "serve_token_seconds") for r in dregs]
+        tok0 = sum(r.get("serve_tokens_total").total() for r in dregs)
+        t0 = time.perf_counter()
+        futs = []
+        for _ in range(n_requests):
+            futs.append(rt.submit(mk_prompt(),
+                                  max_new_tokens=new_tokens,
+                                  timeout=120))
+            time.sleep(float(rng.exponential(1.0 / rps)))
+        for f in futs:
+            f.result(timeout=120)
+        wall = time.perf_counter() - t0
+        # the no-retrace pin, per role: decode replicas trace their
+        # decode program exactly once; the prefill replica decodes
+        # only on colocate fallback (0 traces when the pool is clean)
+        for e in des:
+            info = e.compiled_step_info()
+            assert info["n_traces"] == 1, f"decode retraced: {info}"
+        assert pe.compiled_step_info()["n_traces"] <= 1, \
+            f"prefill-side decode retraced: {pe.compiled_step_info()}"
+        tok = sum(r.get("serve_tokens_total").total()
+                  for r in dregs) - tok0
+        ttft_q = series_quantiles(_delta(
+            _series(preg, "serve_ttft_seconds"), ttft0))
+        d = _merge([_delta(_series(r, "serve_token_seconds"), t)
+                    for r, t in zip(dregs, tpot0)])
+        q = series_quantiles(d)
+        pools = rt.pools_summary()
+        return {
+            "prefill_ttft_p99_s": ttft_q.get("p99"),
+            "decode_p99_token_s": q.get("p99"),
+            "decode_p50_token_s": q.get("p50"),
+            "decode_tok_s": (tok / d["sum"]) if d["sum"] else None,
+            "wall_tok_s": tok / wall if wall > 0 else None,
+            "transferred": pools["transfers"]["transferred"],
+            "colocate_fallback":
+                pools["transfers"]["colocate_fallback"],
+            "affinity_hit_ratio": pools["affinity"]["hit_ratio"],
+            "slots": slots, "new_tokens": new_tokens,
+            "n_requests": n_requests, "offered_rps": rps,
+            "decode_replicas": len(des),
+            "hbm_peak_bytes": _peak_hbm(dev),
+            "compile": _compile_delta(cc0),
+        }
+    finally:
+        for r in reps:
+            r.drain(timeout=60)
 
 
 # default serving_sweep grid: (kv_layout, slots, prefill_len,

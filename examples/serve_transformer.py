@@ -13,6 +13,18 @@ Modes:
   gateway from client threads, assert every one returns exactly once
   with the requested token count and that the decode program traced
   exactly once, print ``SELFTEST OK`` and exit 0 (the CI smoke).
+- ``--pool-role prefill --decode-peers P1,P2``: disaggregated pools
+  across processes. This replica admits and chunk-prefills only; each
+  finished prefill is sealed (CRC-framed KV snapshot) and transferred
+  to a decode gateway chosen by prefix affinity (rendezvous hash of
+  the prompt's block-aligned chain key over the peer list, so a
+  repeated prefix keeps landing where its KV already lives). Failure
+  ladder per transfer: typed 409 refusal (corrupt frame) or a dead
+  peer → next-best peer → recompute via ``/v1/generate`` on any live
+  peer → typed error; no live peers at seal time → colocate (this
+  replica decodes it after all). ``--pool-role decode`` marks the
+  receiving side (it serves ``/v1/inject`` continuations and plain
+  generates). Both sides must share KV geometry.
 - ``--autoscale MIN``: fleet mode. MIN in-process replicas (each its
   own engine + metrics registry) behind a ``FleetRouter``, an
   ``Autoscaler`` supervising the population against SLO targets
@@ -115,6 +127,109 @@ def _make_handoff(peers, timeout):
         return True
 
     return handoff
+
+
+def _make_pool_transfer(peers, timeout, reg, affinity, block_size):
+    """The prefill pool's transfer callable (``engine.set_transfer``):
+    route each sealed slot to a decode gateway by prefix affinity and
+    walk the failure ladder across processes. Rungs: typed 409
+    refusal or a dead socket → next-best peer; all injects refused →
+    recompute via ``/v1/generate`` on a live peer (greedy makes the
+    recompute bitwise-identical, it just pays prefill again); nothing
+    live at seal time → return False, which is the colocate rung (the
+    prefill engine keeps the slot and decodes it itself). A relay
+    thread owns the request once we return True — the engine tick
+    must never block on a peer's decode — and resolves the future
+    exactly once, typed on total failure."""
+    from singa_tpu.serving import affinity_hash, prefix_chain_key
+
+    dead = set()
+    owner = {}              # prefix chain key → port that served it
+    hits = reg.counter("serve_pool_affinity_hit_total",
+                       "transfers landing on the decode peer that "
+                       "already served this prefix chain")
+    misses = reg.counter("serve_pool_affinity_miss_total",
+                         "transfers landing on a decode peer cold "
+                         "for this prefix chain")
+    retries = reg.counter("serve_pool_transfer_retry_total",
+                          "transfer attempts that moved to the "
+                          "next-best decode peer (refused frame or "
+                          "dead socket)")
+
+    def transfer(req, snapshot, _resnap):
+        live = [p for p in peers if p not in dead]
+        if not live:
+            return False                    # colocate rung
+        key = prefix_chain_key([int(t) for t in req.prompt],
+                               block_size)
+        if affinity and key is not None:
+            order = sorted(live, key=lambda p: affinity_hash(
+                key, salt=str(p)), reverse=True)
+        else:
+            order = live[hash(req.trace_id) % len(live):] + \
+                live[:hash(req.trace_id) % len(live)]
+
+        def run():
+            import http.client as _hc
+
+            from singa_tpu.serving import ReplicaCrashed
+            doc, served_by = None, None
+            # a peer SIGKILLed mid-response surfaces as any of these
+            wire_dead = (OSError, _hc.HTTPException, ValueError)
+            for p in order:
+                try:
+                    st, d = _post(p, "/v1/inject", {
+                        "meta": base64.b64encode(
+                            snapshot["meta"]).decode(),
+                        "frame": base64.b64encode(
+                            snapshot["frame"]).decode(),
+                        "timeout": timeout}, timeout=timeout)
+                except wire_dead:
+                    dead.add(p)
+                    retries.inc()
+                    continue
+                if st == 200:
+                    doc, served_by = d, p
+                    break
+                retries.inc()   # 409: refused typed; the frame is
+                                # bad everywhere, the recompute rung
+                                # below picks it up
+            if doc is None:
+                for p in order:
+                    if p in dead:
+                        continue
+                    try:
+                        st, d = _post(
+                            p, "/v1/generate",
+                            {"prompt": [int(t) for t in req.prompt],
+                             "max_new_tokens": req.max_new_tokens,
+                             "temperature": req.temperature,
+                             "request_id": req.trace_id,
+                             "timeout": timeout}, timeout=timeout)
+                    except wire_dead:
+                        dead.add(p)
+                        continue
+                    if st == 200:
+                        doc, served_by = d, p
+                        break
+            if req.future.done():
+                return
+            if doc is None:
+                req.future.set_error(ReplicaCrashed(
+                    "pool transfer failed: no decode peer took the "
+                    "request"))
+                return
+            if key is not None:
+                (hits if owner.get(key) == served_by
+                 else misses).inc()
+                owner[key] = served_by
+            req.future.set_result(doc)
+
+        threading.Thread(target=run, daemon=True,
+                         name="pool-transfer-relay").start()
+        return True
+
+    return transfer
 
 
 def _selftest(port, n, vocab, new_tokens=8, temperature=0.5):
@@ -289,6 +404,28 @@ def main():
                          "there (POST /v1/inject with the sealed KV "
                          "snapshot; recompute via /v1/generate when "
                          "the peer refuses typed)")
+    ap.add_argument("--pool-role", default=None,
+                    choices=("prefill", "decode"),
+                    help="disaggregated pools: tag this replica's "
+                         "role (prefill seals+transfers finished "
+                         "slots to --decode-peers; decode receives "
+                         "/v1/inject continuations). Both sides must "
+                         "share KV geometry")
+    ap.add_argument("--decode-peers", default=None, metavar="PORTS",
+                    help="comma-separated decode gateway ports the "
+                         "prefill pool transfers sealed KV to "
+                         "(prefix-affinity ordered; failure ladder "
+                         "in the module docstring)")
+    ap.add_argument("--no-affinity", action="store_true",
+                    help="order decode peers round-robin instead of "
+                         "by prefix affinity (the A/B measurement "
+                         "baseline for the affinity hit counters)")
+    ap.add_argument("--fault-corrupt-transfer", type=int, default=0,
+                    metavar="SEQ",
+                    help="chaos: arm FaultPlan.corrupt_handoff(SEQ) — "
+                         "flip a bit in the SEQ-th sealed KV frame so "
+                         "the receiving decode peer refuses it typed "
+                         "(0 = off)")
     ap.add_argument("--spill-bytes", type=int, default=0,
                     help="host-RAM spill tier byte budget for evicted "
                          "cached-prefix KV blocks (paged layout; 0 = "
@@ -345,6 +482,13 @@ def main():
         serve_kw["spill_bytes"] = args.spill_bytes
     if args.snapshot_every:
         serve_kw["snapshot_every"] = args.snapshot_every
+    if args.pool_role:
+        serve_kw["pool_role"] = args.pool_role
+    if args.fault_corrupt_transfer:
+        from singa_tpu.resilience.faults import FaultPlan
+        plan = FaultPlan()
+        plan.corrupt_handoff(args.fault_corrupt_transfer, times=1)
+        serve_kw["faults"] = plan
     if args.autoscale:
         return _run_autoscale(args, model, serve_kw)
     sharded = bool(args.model_shards or args.mesh)
@@ -377,6 +521,12 @@ def main():
         print("AOT " + " ".join(
             f"{p.split('serve_', 1)[-1]}={v}"
             for p, v in sorted(src.items())), flush=True)
+    if args.decode_peers:
+        peers = [int(p) for p in args.decode_peers.split(",") if p]
+        engine.set_transfer(_make_pool_transfer(
+            peers, args.default_timeout, engine._reg,
+            affinity=not args.no_affinity,
+            block_size=args.kv_block_size))
     replica = ServingReplica(engine, name=f"serve-{args.port}")
     replica.install_signal_handlers(deadline=args.drain_deadline)
     replica.start()

@@ -492,6 +492,40 @@ def heartbeat_summary(registry=None):
         if isinstance(stranded, Counter):
             fl["stranded"] = int(stranded.total())
         out["serving_fleet"] = fl
+    # disaggregated prefill/decode pools: this replica's role tag
+    # (engine-published gauge) plus, on router processes, per-pool
+    # depth, transfer movement, and the affinity hit ratio — the
+    # fleet-view evidence that prefix routing is actually keeping
+    # decode-side caches warm
+    role_g = reg.get("serve_pool_role")
+    if isinstance(role_g, Gauge):
+        out["pool_role"] = {1: "prefill", 2: "decode"}.get(
+            int(role_g.value() or 0), "colocated")
+    pool_xfer = reg.get("serve_pool_transfer_total")
+    if isinstance(pool_xfer, Counter):
+        pl = {"transferred": int(pool_xfer.total())}
+        for key, name in (("retries", "serve_pool_transfer_retry_total"),
+                          ("colocate_fallback",
+                           "serve_pool_colocate_fallback_total"),
+                          ("dup_discarded",
+                           "serve_pool_dup_discarded_total"),
+                          ("brownouts", "serve_pool_brownout_total"),
+                          ("saturated", "serve_pool_saturated_total")):
+            c = reg.get(name)
+            if isinstance(c, Counter):
+                pl[key] = int(c.total())
+        hits_c = reg.get("serve_pool_affinity_hit_total")
+        miss_c = reg.get("serve_pool_affinity_miss_total")
+        h = int(hits_c.total()) if isinstance(hits_c, Counter) else 0
+        ms = int(miss_c.total()) if isinstance(miss_c, Counter) else 0
+        pl["affinity"] = {"hits": h, "misses": ms,
+                          "hit_ratio": (h / (h + ms)) if h + ms
+                          else 0.0}
+        depth = reg.get("serve_pool_depth")
+        if isinstance(depth, Gauge):
+            pl["depth"] = {s["labels"].get("pool"): s.get("value")
+                           for s in depth.to_doc().get("series", [])}
+        out["serving_pools"] = pl
     # autoscaler decisions (processes running serving.autoscaler):
     # population movement + the flap-damping evidence — a fleet view
     # where replace_total climbs while quarantine stays 0 is a crash

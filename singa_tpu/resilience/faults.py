@@ -224,6 +224,41 @@ class FaultPlan:
         request by recompute (or from its last cadence checkpoint)."""
         return self._arm("handoff_kill", seq, 1)
 
+    # -- disaggregated-pool transfer faults --------------------------------
+    # The prefill→decode TRANSFER path (post-prefill KV migration
+    # between role-tagged pools) has its own delivery hook
+    # (``on_transfer_send``) because its failure shapes differ from a
+    # drain handoff's: frames can be dropped or DUPLICATED in flight,
+    # not just corrupted. Corruption itself is NOT re-registered here
+    # — ``corrupt_handoff`` already covers it (the transfer extracts
+    # its snapshot through the same ``on_handoff_send`` sealing hook),
+    # exactly like ``kill_mid_handoff`` covers dying mid-extraction.
+
+    def slow_transfer(self, seq, seconds=0.2, times=1):
+        """Stall ``times`` CONSECUTIVE prefill→decode transfer
+        deliveries starting at transfer number ``seq`` by ``seconds``
+        each — a congested interconnect, not a failure: the frame
+        arrives late. Drives the transfer ladder's deadline-budget
+        accounting."""
+        return self._arm("transfer_slow", seq, times,
+                         seconds=float(seconds))
+
+    def drop_transfer(self, seq, times=1):
+        """Silently DROP ``times`` consecutive transfer deliveries
+        starting at transfer number ``seq`` — the frame leaves the
+        prefill replica and never arrives. The router must treat the
+        lost delivery as a failed attempt (retry next-best peer →
+        colocate fallback), never hang the request."""
+        return self._arm("transfer_drop", seq, times)
+
+    def dup_transfer(self, seq, times=1):
+        """DUPLICATE ``times`` consecutive transfer deliveries
+        starting at transfer number ``seq`` — the frame arrives twice
+        (a retransmit race). The router's exactly-once guard must
+        DISCARD the second copy, not double-inject it: one decode
+        future per request, ``deliveries == 1``."""
+        return self._arm("transfer_dup", seq, times)
+
     # -- autoscaler faults -------------------------------------------------
     def stale_heartbeat(self, tick, times=1, name=None):
         """Mark a replica's observation STALE for ``times``
@@ -422,6 +457,37 @@ class FaultPlan:
                     return frame[:-1] + bytes([frame[-1] ^ 0x01])
         return frame
 
+    def on_transfer_send(self, seq, frame):
+        """Called once per prefill→decode transfer DELIVERY attempt
+        (``seq`` counts from 1 per engine) with the sealed frame;
+        returns the list of frames that actually arrive at the decode
+        peer: ``[frame]`` (clean), ``[]`` (dropped in flight),
+        ``[frame, frame]`` (duplicated — the receiver-side
+        exactly-once guard's fodder). ``slow_transfer`` sleeps first.
+        Transfer numbers never repeat, so all three faults match
+        CONSECUTIVE deliveries from their start seq (the
+        ``corrupt_wire`` rule)."""
+        for rec in self._faults:
+            if rec["kind"] == "transfer_slow" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "transfer_slow"))
+                time.sleep(rec["seconds"])
+                break
+        for rec in self._faults:
+            if rec["kind"] == "transfer_drop" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "transfer_drop"))
+                return []
+        for rec in self._faults:
+            if rec["kind"] == "transfer_dup" and rec["times"] > 0 \
+                    and int(seq) >= rec["step"]:
+                rec["times"] -= 1
+                self.fired.append((int(seq), "transfer_dup"))
+                return [frame, frame]
+        return [frame]
+
     def on_observe(self, seq, name=None):
         """Called by the autoscaler for each replica it observes in
         pass ``seq`` (counting from 1 per supervisor). True marks
@@ -515,6 +581,9 @@ class _NullPlan(FaultPlan):
 
     def on_handoff_send(self, seq, frame):
         return frame
+
+    def on_transfer_send(self, seq, frame):
+        return [frame]
 
     def on_observe(self, seq, name=None):
         return False

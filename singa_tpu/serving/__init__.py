@@ -38,12 +38,14 @@ from .fleet import (EXIT_DRAINED, CircuitBreaker,         # noqa: F401
                     FleetFuture, FleetRouter, ServingReplica,
                     ShedPolicy, brownout_shrink_generation)
 from .gateway import serve_gateway                        # noqa: F401
-from .kv_cache import HostSpillTier                       # noqa: F401
+from .kv_cache import (HostSpillTier, affinity_hash,      # noqa: F401
+                       prefix_chain_key)
 from .scheduler import (BlockPoolExhausted,               # noqa: F401
-                        EngineDraining, HandoffRefused, QueueFull,
-                        ReplicaCrashed, Request, RequestQueue,
-                        RequestShed, RequestTimeout, ServeFuture,
-                        ServingError, budget_remaining, deadline_in)
+                        EngineDraining, HandoffRefused, PoolSaturated,
+                        QueueFull, ReplicaCrashed, Request,
+                        RequestQueue, RequestShed, RequestTimeout,
+                        ServeFuture, ServingError, budget_remaining,
+                        deadline_in)
 
 __all__ = [
     "ServingEngine", "BatchServingEngine", "build_engine",
@@ -53,7 +55,8 @@ __all__ = [
     "ShedPolicy", "brownout_shrink_generation", "EXIT_DRAINED",
     "serve_gateway", "ServingError", "QueueFull", "EngineDraining",
     "RequestTimeout", "ReplicaCrashed", "RequestShed",
-    "BlockPoolExhausted", "HandoffRefused", "HostSpillTier",
+    "PoolSaturated", "BlockPoolExhausted", "HandoffRefused",
+    "HostSpillTier", "affinity_hash", "prefix_chain_key",
     "ServeFuture", "Request", "RequestQueue",
     "deadline_in", "budget_remaining",
 ]

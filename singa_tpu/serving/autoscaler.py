@@ -101,6 +101,10 @@ class AutoscaleTargets:
     of seconds."""
 
     ttft_p99_s: float = 1.0      # windowed p99 TTFT ceiling
+    tpot_p99_s: float = 1.0      # windowed p99 per-token latency
+    #                              ceiling (disaggregated pools only:
+    #                              TPOT is the decode pool's SLO the
+    #                              way TTFT is the prefill pool's)
     queue_high: float = 4.0      # mean queue depth per ready replica
     queue_low: float = 0.5       # ... below which the fleet is calm
     pool_high: float = 0.9       # paged-KV blocks in_use/total ceiling
@@ -145,13 +149,14 @@ class _Spawn:
     """One in-flight spawn: worker thread fills, tick reaps."""
 
     __slots__ = ("seq", "purpose", "seat", "started", "duration",
-                 "replica", "error", "flap", "done", "thread")
+                 "replica", "error", "flap", "done", "thread", "pool")
 
-    def __init__(self, seq, purpose, seat, started):
+    def __init__(self, seq, purpose, seat, started, pool=None):
         self.seq = seq
         self.purpose = purpose      # "up" | "replace"
         self.seat = seat
         self.started = started
+        self.pool = pool            # target pool role (or None)
         self.duration = None
         self.replica = None
         self.error = None
@@ -234,6 +239,8 @@ class Autoscaler:
         self._last_down = -math.inf
         self._spawn_durations = deque(maxlen=16)
         self._ttft_prev = {}            # name -> last histogram series
+        self._tpot_prev = {}            # ... for serve_token_seconds
+        self.last_load = None           # newest _load verdict (status)
         self._running = False
         self._stop_evt = threading.Event()
         self._thread = None
@@ -323,7 +330,8 @@ class Autoscaler:
             obs = self._observations(now)
             self.observations = obs
             self._scan_replacements(now, obs, actions)
-            load = self._load(obs)
+            load = self._load(obs, now)
+            self.last_load = load
             self._update_windows(now, load)
             self._maybe_scale_up(now, load, actions)
             self._maybe_scale_down(now, obs, load, actions)
@@ -338,7 +346,9 @@ class Autoscaler:
             self._g_quarantined.set(self.quarantined_count())
             return {"now": now, "population": pop, "pending": pending,
                     "rung": rung, "breach": load["breach"],
-                    "calm": load["calm"], "actions": actions}
+                    "calm": load["calm"],
+                    "grow_pool": load.get("grow_pool"),
+                    "actions": actions}
 
     # -- observations ------------------------------------------------------
     def _observations(self, now):
@@ -375,6 +385,7 @@ class Autoscaler:
                 status = "crashed"
             reg = getattr(getattr(r, "engine", None), "_reg", None)
             depth = self.router._depth(r)
+            role_fn = getattr(self.router, "_role", None)
             obs[name] = {
                 "idx": idx,
                 "status": status,
@@ -382,22 +393,32 @@ class Autoscaler:
                 "queue_depth": None if depth == math.inf else depth,
                 "breaker": breakers.get(name),
                 "ttft_p99_s": self._windowed_ttft_p99(name, reg),
+                "tpot_p99_s": self._windowed_tpot_p99(name, reg),
                 "pool_pressure": self._pool_pressure(reg),
+                "pool_role": role_fn(idx) if role_fn is not None
+                else "colocated",
                 "age_s": None,
             }
         return obs
 
     def _windowed_ttft_p99(self, name, reg):
-        hist = reg.get("serve_ttft_seconds") if reg is not None \
-            else None
+        return self._windowed_p99(name, reg, "serve_ttft_seconds",
+                                  self._ttft_prev)
+
+    def _windowed_tpot_p99(self, name, reg):
+        return self._windowed_p99(name, reg, "serve_token_seconds",
+                                  self._tpot_prev)
+
+    def _windowed_p99(self, name, reg, metric, prev_map):
+        hist = reg.get(metric) if reg is not None else None
         if not isinstance(hist, _metrics.Histogram):
             return None
         series = hist.to_doc().get("series") or []
         if not series:
             return None
         s = series[0]
-        prev = self._ttft_prev.get(name)
-        self._ttft_prev[name] = s
+        prev = prev_map.get(name)
+        prev_map[name] = s
         if not s["count"]:
             return None
         if prev is not None:
@@ -428,10 +449,16 @@ class Autoscaler:
         return None if not cap else float(in_use.value()) / float(cap)
 
     # -- load evaluation ---------------------------------------------------
-    def _load(self, obs):
+    def _load(self, obs, now=None):
         """Fleet-level breach/calm verdicts over READY, NON-STALE
         replicas only — the staleness satellite's contract: never
-        scale on dead data."""
+        scale on dead data. With role-tagged replicas (disaggregated
+        prefill/decode pools) the breach also learns a per-pool
+        verdict (``grow_pool``): a TTFT breach means prefill is the
+        bottleneck (prompts queueing for their first token), a
+        TPOT breach or sustained decode-pool transfer pressure means
+        decode is — the spawn that answers the breach lands in the
+        pool that is actually short."""
         t = self.targets
         live = [o for o in obs.values()
                 if o.get("ready") and not o.get("stale")]
@@ -444,17 +471,57 @@ class Autoscaler:
         ttft = max(ttfts) if ttfts else None
         depth = (sum(depths) / len(depths)) if depths else None
         pool = max(pools) if pools else None
+        roles = {o.get("pool_role") for o in live}
+        pooled = bool(roles & {"prefill", "decode"})
+        tpot = None
+        xfer_pressed = False
+        if pooled:
+            tpots = [o["tpot_p99_s"] for o in live
+                     if o.get("tpot_p99_s") is not None]
+            tpot = max(tpots) if tpots else None
+            pp = getattr(self.router, "_pool_pressure", None)
+            if pp is not None and now is not None:
+                xfer_pressed = pp.sustained(now)
         breach = bool(live) and (
             (ttft is not None and ttft > t.ttft_p99_s)
             or (depth is not None and depth > t.queue_high)
-            or (pool is not None and pool > t.pool_high))
+            or (pool is not None and pool > t.pool_high)
+            or (tpot is not None and tpot > t.tpot_p99_s)
+            or xfer_pressed)
         calm = bool(live) and not breach and (
             (ttft is None or ttft <= t.ttft_p99_s * t.recover_fraction)
             and (depth is None or depth <= t.queue_low)
-            and (pool is None or pool <= t.pool_low))
-        return {"ttft_p99_s": ttft, "queue_depth_mean": depth,
+            and (pool is None or pool <= t.pool_low)
+            and (tpot is None
+                 or tpot <= t.tpot_p99_s * t.recover_fraction))
+        grow = None
+        if pooled and breach:
+            if (tpot is not None and tpot > t.tpot_p99_s) \
+                    or (pool is not None and pool > t.pool_high) \
+                    or xfer_pressed:
+                # decode-side evidence wins: slow tokens, a pressed
+                # KV pool, or transfers bouncing off the decode pool
+                grow = "decode"
+            elif ttft is not None and ttft > t.ttft_p99_s:
+                grow = "prefill"
+            else:
+                # queue breach only: blame the pool whose replicas
+                # actually hold the depth
+                by_role = {}
+                for o in live:
+                    d = o.get("queue_depth")
+                    if d is not None:
+                        by_role.setdefault(o.get("pool_role"),
+                                           []).append(d)
+                means = {r: sum(v) / len(v)
+                         for r, v in by_role.items()
+                         if r in ("prefill", "decode")}
+                grow = max(means, key=means.get) if means \
+                    else "decode"
+        return {"ttft_p99_s": ttft, "tpot_p99_s": tpot,
+                "queue_depth_mean": depth,
                 "pool_pressure": pool, "breach": breach, "calm": calm,
-                "ready": len(live)}
+                "grow_pool": grow, "ready": len(live)}
 
     def _update_windows(self, now, load):
         if load["breach"]:
@@ -480,13 +547,15 @@ class Autoscaler:
         return w
 
     # -- lifecycle: spawn --------------------------------------------------
-    def _initiate_spawn(self, now, purpose, seat, actions, reason):
+    def _initiate_spawn(self, now, purpose, seat, actions, reason,
+                        pool=None):
         self._spawn_seq += 1
-        rec = _Spawn(self._spawn_seq, purpose, seat, now)
+        rec = _Spawn(self._spawn_seq, purpose, seat, now, pool=pool)
         self._pending.append(rec)
-        actions.append(f"spawn[{purpose}] #{rec.seq}: {reason}")
+        tag = f"[{purpose}:{pool}]" if pool else f"[{purpose}]"
+        actions.append(f"spawn{tag} #{rec.seq}: {reason}")
         _spans.event("autoscale.spawn", purpose=purpose, seq=rec.seq,
-                     reason=reason)
+                     pool=pool, reason=reason)
         if self.sync:
             self._spawn_worker(rec)
             self._reap_spawns(now, actions)     # admit this tick
@@ -496,11 +565,26 @@ class Autoscaler:
                 name=f"autoscale-spawn-{rec.seq}", daemon=True)
             rec.thread.start()
 
+    @staticmethod
+    def _spawn_accepts_pool(fn):
+        import inspect
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            return False
+        return "pool_role" in params or any(
+            p.kind == p.VAR_KEYWORD for p in params.values())
+
     def _spawn_worker(self, rec):
         t0 = self._clock()
         try:
             rec.flap = bool(self._faults.on_spawn(rec.seq))
-            replica = self._spawn_fn()
+            # per-pool verdict rides into the spawn when the factory
+            # can honor it (pool-agnostic factories stay untouched)
+            if rec.pool and self._spawn_accepts_pool(self._spawn_fn):
+                replica = self._spawn_fn(pool_role=rec.pool)
+            else:
+                replica = self._spawn_fn()
             self._await_ready(replica)
             self._warm_admission(replica)
             rec.duration = self._clock() - t0
@@ -627,6 +711,7 @@ class Autoscaler:
         corpse = self.router.remove_replica(idx)
         self._destroy(corpse)
         self._ttft_prev.pop(name, None)
+        self._tpot_prev.pop(name, None)
         seat_id = self._seat_by_name.pop(name, None)
         if seat_id is None:
             seat_id = self._new_seat()
@@ -660,8 +745,15 @@ class Autoscaler:
         self._c_replace.inc()
         _spans.event("autoscale.replace", replica=name,
                      seat=seat_id, cause=cause)
+        # a dead pool replica respawns into the SAME pool: replacing
+        # a decode replica with a colocated one would silently shrink
+        # the pool the fleet is already short on
+        role = o.get("pool_role")
         self._initiate_spawn(now, "replace", seat_id, actions,
-                             f"{name} {cause}")
+                             f"{name} {cause}",
+                             pool=role if role in ("prefill",
+                                                   "decode")
+                             else None)
 
     def _destroy(self, replica):
         if replica is None:
@@ -696,8 +788,10 @@ class Autoscaler:
             now, "up", None, actions,
             f"breach sustained {now - self._breach_since:.1f}s "
             f"(ttft_p99={load['ttft_p99_s']}, "
+            f"tpot_p99={load.get('tpot_p99_s')}, "
             f"queue={load['queue_depth_mean']}, "
-            f"pool={load['pool_pressure']})")
+            f"pool={load['pool_pressure']})",
+            pool=load.get("grow_pool"))
 
     def _maybe_scale_down(self, now, obs, load, actions):
         t = self.targets
@@ -761,6 +855,7 @@ class Autoscaler:
             self._retiring.remove(rec)
             self._seat_by_name.pop(rec.name, None)
             self._ttft_prev.pop(rec.name, None)
+            self._tpot_prev.pop(rec.name, None)
             if rec.error is not None:
                 actions.append(
                     f"retire {rec.name} errored: "
@@ -817,6 +912,7 @@ class Autoscaler:
     def status(self):
         """One introspection doc (the example's AUTOSCALE log line
         and chaos assertions read this)."""
+        load = self.last_load or {}
         return {
             "population": self.router.population(),
             "pending_spawns": sum(1 for s in self._pending
@@ -824,6 +920,8 @@ class Autoscaler:
             "retiring": sum(1 for r in self._retiring if not r.done),
             "quarantined_seats": self.quarantined_count(),
             "rung": int(self._g_rung.value()),
+            "grow_pool": load.get("grow_pool"),
+            "tpot_p99_s": load.get("tpot_p99_s"),
             "spawn": self.spawn_stats(),
             "targets": asdict(self.targets),
         }

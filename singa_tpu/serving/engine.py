@@ -625,7 +625,8 @@ class ServingEngine(_EngineBase):
                  prefill_batch=2, policy=None, aot_store=None,
                  kv_layout="ring", kv_block_size=16, kv_blocks=None,
                  speculative_k=0, mesh=None, model_shards=None,
-                 spill_bytes=0, snapshot_every=0, **kw):
+                 spill_bytes=0, snapshot_every=0,
+                 pool_role="colocated", **kw):
         super().__init__(**kw)
         import jax
 
@@ -657,6 +658,30 @@ class ServingEngine(_EngineBase):
         self._kv_checkpoints = {}       # trace_id -> {"meta","frame"}
         self._drain_reserve = 0.25
         self._drain_grace = 5.0
+        # disaggregated prefill/decode pools: the role tag is ROUTING
+        # metadata (the fleet router reads it for pool placement and
+        # arms a prefill engine's transfer callable); the engine stays
+        # fully capable either way — a decode replica can recompute a
+        # prompt from scratch and a prefill replica can decode to the
+        # end (the colocate-fallback rung of the degradation ladder)
+        pool_role = str(pool_role)
+        if pool_role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"pool_role must be 'colocated', 'prefill' or "
+                f"'decode', got {pool_role!r}")
+        self.pool_role = pool_role
+        if pool_role != "colocated":
+            # published so heartbeat_summary (registry-only view) can
+            # report the replica's role: 1=prefill 2=decode
+            self._reg.gauge(
+                "serve_pool_role",
+                "this replica's disaggregated-pool role: "
+                "1=prefill 2=decode (absent/0 = colocated)").set(
+                1 if pool_role == "prefill" else 2)
+        self._transfer = None           # armed by FleetRouter
+        self._transfer_seq = 0
+        self._transfer_out = None
+        self._colocated = None
 
         # -- GSPMD sharded serving (mesh=/model_shards=) ------------------
         # One NamedSharding partitioner over a named (batch × model)
@@ -1173,6 +1198,88 @@ class ServingEngine(_EngineBase):
         intended caller. Returns ``self``."""
         self._spec_throttled = bool(on)
         return self
+
+    # -- disaggregated pools (prefill→decode transfer) ---------------------
+    def set_transfer(self, cb):
+        """Arm the prefill→decode transfer callable (a
+        :class:`~singa_tpu.serving.fleet.FleetRouter` wiring its
+        pools). ``cb(request, snapshot, resnap) -> bool``: True means a
+        decode replica took ownership of delivering the response (the
+        slot frees WITHOUT fulfilling the future — the router's relay
+        owns it now); False/raise keeps the request here end-to-end
+        (colocate fallback). ``resnap()`` re-extracts a FRESH sealed
+        snapshot of the same slot — the retry-on-next-peer rung calls
+        it so a frame corrupted at extraction is not re-delivered
+        verbatim. ``None`` disarms. Returns ``self``."""
+        self._transfer = cb
+        if cb is not None:
+            self._transfer_out = self._reg.counter(
+                "serve_pool_transfer_out_total",
+                "slots this prefill-role engine migrated to a decode "
+                "replica right after prefill (KV transfer accepted)")
+            self._colocated = self._reg.counter(
+                "serve_pool_colocate_total",
+                "requests this prefill-role engine kept end-to-end "
+                "because no decode replica could take the transfer "
+                "(the colocate-fallback rung)")
+        return self
+
+    def _transfer_pass(self):
+        """Offer every active slot whose transfer has not been decided
+        yet to the armed transfer callable (runs between prefill and
+        decode in :meth:`_tick`, so an accepted slot never pays a
+        local decode tick). A decline is sticky per request — the
+        colocate fallback decodes it here to the end rather than
+        re-negotiating every tick."""
+        for i, slot in enumerate(list(self._slots)):
+            if slot is None:
+                continue
+            req = slot["req"]
+            if req.future.done() or getattr(req, "_xfer_declined",
+                                            False):
+                continue
+            try:
+                snap = self.snapshot_slot(i)
+            except Exception:   # noqa: BLE001 — sharded/typed decline
+                snap = None
+            moved = False
+            if snap is not None:
+                def _resnap(idx=i):
+                    return self.snapshot_slot(idx)
+                try:
+                    moved = bool(self._transfer(req, snap, _resnap))
+                except Exception:   # noqa: BLE001 — colocate fallback
+                    moved = False
+            if moved:
+                # mirror the drain pass's migrate-out: the slot frees
+                # WITHOUT fulfilling the future (the router's relay
+                # delivers the decode replica's response into it)
+                self._slots[i] = None
+                self._release_blocks(slot)
+                self._kv_checkpoints.pop(req.trace_id, None)
+                self._transfer_out.inc()
+                self.queue.finish("migrated")
+                if self._trace_requests:
+                    _spans.event("request.transfer_out",
+                                 request=req.trace_id,
+                                 tokens=len(req.tokens))
+            else:
+                req._xfer_declined = True
+                self._colocated.inc()
+                if self._trace_requests:
+                    _spans.event("request.colocate_fallback",
+                                 request=req.trace_id)
+        self._occupancy.set(self.active_slots())
+
+    def transfer_deliveries(self, frame):
+        """The transfer-path fault point: the list of frames ONE
+        delivery attempt actually lands at the decode peer —
+        ``[frame]`` clean, ``[]`` dropped in flight, ``[frame, frame]``
+        duplicated (``faults.slow_transfer`` / ``drop_transfer`` /
+        ``dup_transfer``). Sequence numbers count deliveries from 1
+        per engine, like handoff extraction numbers."""
+        self._transfer_seq += 1
+        return self.faults.on_transfer_send(self._transfer_seq, frame)
 
     # -- live KV handoff (extract / inject / checkpoint) -------------------
     def _handoff_geometry(self):
@@ -1712,6 +1819,13 @@ class ServingEngine(_EngineBase):
                     self._fail_batch(batch, e)
                     raise
 
+        # 2b) disaggregated pools: offer freshly-prefilled slots to the
+        #     decode pool BEFORE paying a local decode tick (an
+        #     accepted transfer frees the slot; a declined one decodes
+        #     here — the colocate fallback)
+        if self._transfer is not None:
+            self._transfer_pass()
+
         # 3) decode: one token for EVERY active slot, one fixed program
         if any(s is not None for s in self._slots):
             t0 = time.perf_counter()
@@ -2207,7 +2321,7 @@ def build_engine(model, **kw):
                    "aot_store", "profile_every", "kv_layout",
                    "kv_block_size", "kv_blocks", "speculative_k",
                    "mesh", "model_shards", "spill_bytes",
-                   "snapshot_every")
+                   "snapshot_every", "pool_role")
         unknown = sorted(set(kw) - set(ar_keys))
         if unknown:
             raise TypeError(

@@ -55,6 +55,22 @@ documents the semantics, it does not replace your LB). Three layers:
   carrying ``retry_after`` (the gateway's ``Retry-After`` header), and
   an optional brownout hook steps request cost down
   (``max_new_tokens``, speculative drafting) before refusing outright.
+
+When replicas carry pool roles (``build_engine(pool_role=...)``) the
+router also runs **disaggregated prefill/decode**: fresh prompts land
+on the prefill pool; each finished prefill's sealed KV snapshot
+transfers to a decode replica chosen by **prefix affinity**
+(rendezvous hash of the prompt's block-aligned prefix chain — the
+same keys the paged prefix cache uses, so repeated prefixes keep
+hitting the replica whose cache is already warm). Every transfer edge
+is defended: CRC refusal or a dropped frame retries ONCE on the
+next-best peer with a freshly re-sealed snapshot; duplicate
+deliveries are discarded by the exactly-once guard; a decode replica
+dying mid-request re-dispatches through the FleetFuture budget,
+resuming from its newest KV checkpoint; and a saturated decode pool
+degrades down a ladder — brownout (shrink ``max_new_tokens``) →
+colocate fallback (the prefill replica decodes end-to-end) → typed
+:class:`~singa_tpu.serving.scheduler.PoolSaturated` shed.
 """
 
 from __future__ import annotations
@@ -65,10 +81,11 @@ import time
 
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
+from .kv_cache import affinity_hash, prefix_chain_key
 from .scheduler import (BlockPoolExhausted, EngineDraining,
-                        HandoffRefused, QueueFull, ReplicaCrashed,
-                        RequestShed, RequestTimeout, ServingError,
-                        budget_remaining, deadline_in)
+                        HandoffRefused, PoolSaturated, QueueFull,
+                        ReplicaCrashed, RequestShed, RequestTimeout,
+                        ServingError, budget_remaining, deadline_in)
 
 # the drain exit code: intentional, successful, do-not-relaunch — the
 # 0 row of the README's supervisor exit-code contract table
@@ -128,6 +145,13 @@ class ServingReplica:
     def draining(self):
         return self.engine.draining
 
+    @property
+    def pool_role(self):
+        """This replica's disaggregated-pool role (``prefill`` |
+        ``decode`` | ``colocated`` — the engine's ``pool_role``
+        build option; engines that predate pools read colocated)."""
+        return getattr(self.engine, "pool_role", None) or "colocated"
+
     def queue_depth(self):
         return len(self.engine.queue)
 
@@ -140,6 +164,7 @@ class ServingReplica:
             "status": ("crashed" if eng._crashed is not None
                        else "draining" if eng.draining
                        else "serving"),
+            "pool_role": self.pool_role,
             "queue_depth": len(eng.queue),
             "active_slots": getattr(eng, "active_slots",
                                     lambda: None)(),
@@ -374,6 +399,9 @@ class FleetFuture:
             self._result = result
             self._error = error
             self._event.set()
+        # terminal: release any decode-holder record this request
+        # pinned (pool transfers track the replica holding the KV)
+        self._router._forget_trace(self._kwargs.get("trace_id"))
 
     def done(self):
         return self._event.is_set()
@@ -498,8 +526,12 @@ class FleetFuture:
                     self._redispatch(type(e).__name__, e)
                 except _REPLICA_FAILURES as e:
                     # the holding replica died with the request
-                    # admitted (the stranded shape)
+                    # admitted (the stranded shape); for a transferred
+                    # request the DECODE replica holding the KV gets
+                    # the breaker blame, not just the placement slot
                     rt._record_failure(self._idx, type(e).__name__)
+                    rt._fail_holder(self._kwargs.get("trace_id"),
+                                    type(e).__name__)
                     self._redispatch(type(e).__name__, e)
                 except ServingError as e:
                     # request-shaped failure: it would fail the same
@@ -535,7 +567,9 @@ class FleetRouter:
     def __init__(self, replicas, registry=None, *,
                  breaker_threshold=3, breaker_backoff=0.25,
                  breaker_backoff_cap=30.0, per_try_timeout=None,
-                 max_redispatch=2, shed_policy=None, clock=None):
+                 max_redispatch=2, shed_policy=None, clock=None,
+                 affinity_block_size=None, pool_shed=None,
+                 affinity_routing=True):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = list(replicas)
@@ -601,6 +635,30 @@ class FleetRouter:
             labels=("replica",))
         for i in range(len(self.replicas)):
             self._breaker_state.set(0, replica=self._name(i))
+        # -- disaggregated prefill/decode pool state -----------------
+        # trace_id → decode slot index while a transferred request is
+        # decoding away from the replica its FleetFuture points at —
+        # crash recovery and breaker blame must follow the KV, not the
+        # prefill replica that long since forgot the request
+        self._decode_holder = {}
+        # prefix chain key → decode replica name that last served it
+        # (affinity hit/miss accounting; rendezvous hashing does the
+        # actual placement so this is observation, not state)
+        self._prefix_owner = {}
+        self._aff_bs = (int(affinity_block_size)
+                        if affinity_block_size is not None else None)
+        # affinity_routing=False is the A/B baseline knob: decode
+        # placement round-robins instead of rendezvous-hashing (hit /
+        # miss accounting unchanged, so the two legs compare directly)
+        self._affinity = bool(affinity_routing)
+        self._rr = 0
+        # decode-pool pressure window: feeds the ladder (brownout →
+        # colocate → typed PoolSaturated); separate from shed_policy
+        # so generic overload and pool saturation stay distinguishable
+        self._pool_pressure = pool_shed if pool_shed is not None \
+            else ShedPolicy(window_s=5.0, threshold=4, retry_after=1.0)
+        self._pool_metrics_ready = False
+        self._arm_transfers()
 
     def _name(self, idx):
         r = self.replicas[idx]
@@ -621,6 +679,10 @@ class FleetRouter:
             self._breakers.append(CircuitBreaker(*self._breaker_params))
             idx = len(self.replicas) - 1
             self._set_state_gauge(idx)
+        # membership changed: re-arm transfer hooks (a new prefill
+        # replica starts transferring; a new decode replica enters
+        # every prefill replica's rendezvous candidate set)
+        self._arm_transfers()
         _spans.event("fleet.replica_added",
                      replica=self._name(idx), slot=idx)
         return idx
@@ -695,14 +757,355 @@ class FleetRouter:
                     for i, br in enumerate(self._breakers)
                     if self.replicas[i] is not None}
 
+    # -- disaggregated prefill/decode pools --------------------------------
+    def _role(self, idx):
+        """Pool role of slot ``idx`` ('prefill' | 'decode' |
+        'colocated'). Reads the replica's ``pool_role`` first (wire
+        replicas carry a plain attribute), then the engine's; anything
+        unset is colocated. Lock-free (attribute reads only), safe
+        under ``_blk``."""
+        r = self.replicas[idx]
+        if r is None:
+            return "colocated"
+        role = getattr(r, "pool_role", None)
+        if not role:
+            role = getattr(getattr(r, "engine", None), "pool_role",
+                           None)
+        return role or "colocated"
+
+    def pools_enabled(self):
+        """True when at least one live replica is decode-role — the
+        switch that turns on role-aware placement, KV transfer, and
+        the decode-pool degradation ladder."""
+        with self._blk:
+            return any(r is not None and self._role(i) == "decode"
+                       for i, r in enumerate(self.replicas))
+
+    def _arm_transfers(self):
+        """Arm every live prefill-role engine's transfer hook (the
+        engine calls it after each prefill pass with the finished
+        slot's sealed snapshot). Idempotent; re-run whenever
+        membership changes so a scaled-up prefill replica starts
+        transferring immediately."""
+        if not self.pools_enabled():
+            return
+        for i, r in self.live_replicas():
+            if self._role(i) != "prefill":
+                continue
+            eng = getattr(r, "engine", r)
+            set_transfer = getattr(eng, "set_transfer", None)
+            if set_transfer is not None:
+                set_transfer(self._make_transfer(i))
+
+    def _ensure_pool_metrics(self):
+        if self._pool_metrics_ready:
+            return
+        reg = self._reg
+        self._pool_transfers = reg.counter(
+            "serve_pool_transfer_total",
+            "prefill→decode KV transfers that a decode replica "
+            "accepted (slot freed on the prefill side without "
+            "fulfilling the future)")
+        self._pool_retries = reg.counter(
+            "serve_pool_transfer_retry_total",
+            "transfer attempts retried on the next-best decode peer "
+            "(CRC refusal with a fresh re-snapshot, or a dropped "
+            "frame)")
+        self._pool_colocates = reg.counter(
+            "serve_pool_colocate_fallback_total",
+            "transfers that fell back to colocated decode on the "
+            "prefill replica (decode pool refused / saturated)")
+        self._pool_dups = reg.counter(
+            "serve_pool_dup_discarded_total",
+            "duplicate transfer deliveries discarded by the "
+            "exactly-once guard (second copy never injected)")
+        self._pool_aff_hits = reg.counter(
+            "serve_pool_affinity_hit_total",
+            "transfers routed to the decode replica that last served "
+            "the same block-aligned prefix chain")
+        self._pool_aff_misses = reg.counter(
+            "serve_pool_affinity_miss_total",
+            "transfers whose prefix chain was cold or owned by "
+            "another decode replica")
+        self._pool_brownouts = reg.counter(
+            "serve_pool_brownout_total",
+            "requests stepped down (max_new halved) under sustained "
+            "decode-pool pressure — ladder rung one")
+        self._pool_saturated = reg.counter(
+            "serve_pool_saturated_total",
+            "requests refused typed PoolSaturated after the "
+            "degradation ladder ran dry")
+        self._pool_depth = reg.gauge(
+            "serve_pool_depth",
+            "summed queue depth per pool role", labels=("pool",))
+        self._pool_metrics_ready = True
+
+    def _affinity_block(self):
+        """Block size the affinity hash chunks prompts by. Must match
+        the decode pool's paged ``kv_block_size`` so the chain key IS
+        the BlockManager's prefix-cache key; falls back to the
+        constructor override, then 16 (ring engines have no block
+        size but still benefit from stable prefix→replica pinning)."""
+        if self._aff_bs is not None:
+            return self._aff_bs
+        for i, r in self.live_replicas():
+            if self._role(i) != "decode":
+                continue
+            bs = getattr(getattr(r, "engine", None), "kv_block_size",
+                         None)
+            if bs:
+                self._aff_bs = int(bs)
+                return self._aff_bs
+        return 16
+
+    def _decode_order(self, key, now, exclude=()):
+        """Decode-pool candidate indices for a prefix chain ``key``.
+
+        Warm prefix (key not None): rendezvous/HRW order — each
+        candidate scores ``affinity_hash(key, salt=name)`` and the
+        list sorts highest-score first. Stable across router restarts
+        (sha1 of the chain key, not per-process ``hash()``), and when
+        membership changes only the keys whose top scorer joined or
+        left move — every other prefix keeps its replica, which is
+        exactly what keeps the decode-side prefix caches warm. The
+        sorted tail doubles as the natural "next-best peer" retry
+        order. Cold prefix (key None): least-loaded first. With
+        ``affinity_routing=False`` (the measurement baseline) the key
+        is ignored and candidates round-robin."""
+        with self._blk:
+            cands = [i for i, r in enumerate(self.replicas)
+                     if r is not None and i not in exclude
+                     and self._role(i) == "decode"
+                     and self._breakers[i].admits(now)]
+            if not self._affinity and cands:
+                k = self._rr % len(cands)
+                self._rr += 1
+                return cands[k:] + cands[:k]
+            if key is None:
+                return sorted(cands,
+                              key=lambda i: (self._depth(
+                                  self.replicas[i]), i))
+            return sorted(
+                cands,
+                key=lambda i: affinity_hash(key, salt=self._name(i)),
+                reverse=True)
+
+    def _make_transfer(self, pidx):
+        """Build prefill replica ``pidx``'s transfer callable:
+        ``cb(req, snapshot, resnap) -> bool`` (True = some decode
+        replica owns the request now; False = colocate fallback, the
+        prefill engine decodes it end-to-end). Every edge is
+        defended:
+
+        - CRC refusal / dropped frame → retry ONCE on the next-best
+          rendezvous peer with a FRESH re-snapshot (``resnap`` —
+          corruption happens at sealing, so resending the same bytes
+          would refuse everywhere);
+        - duplicate delivery (``dup_transfer`` fault) → the second
+          copy is discarded by the exactly-once guard, never
+          injected;
+        - decode backpressure / no decode pool → pressure evidence
+          for the ladder + colocate fallback;
+        - decode replica death at inject → breaker failure, next
+          peer."""
+
+        def _transfer(req, snapshot, resnap):
+            self._ensure_pool_metrics()
+            trace = req.trace_id
+            with self._blk:
+                already = trace in self._decode_holder
+            if already:
+                # a duplicated EARLIER transfer already owns this
+                # request downstream — discard, never double-inject
+                self._pool_dups.inc()
+                return True
+            src = getattr(self.replicas[pidx], "engine",
+                          self.replicas[pidx])
+            now = self._clock()
+            key = prefix_chain_key(req.prompt, self._affinity_block())
+            order = self._decode_order(key, now)
+            snap = snapshot
+            saw_pressure = False
+            hard_fails = 0
+            for didx in order:
+                if hard_fails > 1:
+                    break       # retry once on next-best, then ladder
+                r = self.replicas[didx]
+                if r is None:
+                    continue
+                eng = getattr(r, "engine", r)
+                inject = getattr(eng, "inject_snapshot", None)
+                if inject is None:
+                    continue
+                # the transfer wire: faults may delay, drop, or
+                # duplicate the sealed frame here
+                frames = src.transfer_deliveries(snap["frame"]) \
+                    if hasattr(src, "transfer_deliveries") \
+                    else [snap["frame"]]
+                if not frames:      # dropped in flight
+                    self._pool_retries.inc()
+                    hard_fails += 1
+                    continue
+                fut = None
+                refused = False
+                for frame in frames:
+                    if fut is not None:
+                        # duplicated delivery: first copy was
+                        # accepted — discard the second
+                        self._pool_dups.inc()
+                        continue
+                    try:
+                        fut = inject(snap["meta"], frame,
+                                     timeout=budget_remaining(
+                                         req.deadline))
+                    except HandoffRefused:
+                        # CRC/geometry refusal: re-seal FRESH (a new
+                        # handoff seq — a times=1 corruption fault
+                        # will not re-fire) and try the next peer
+                        refused = True
+                        break
+                    except _BACKPRESSURE:
+                        saw_pressure = True
+                        break
+                    except _REPLICA_FAILURES as e:
+                        self._record_failure(didx, type(e).__name__)
+                        break
+                if refused:
+                    self._pool_retries.inc()
+                    hard_fails += 1
+                    try:
+                        snap = resnap()
+                    except Exception:   # noqa: BLE001 — slot gone
+                        return False
+                    if snap is None:
+                        return False
+                    continue
+                if fut is None:
+                    if saw_pressure:
+                        break
+                    continue
+                self._record_success(didx)
+                with self._blk:
+                    self._decode_holder[trace] = didx
+                    owner = self._prefix_owner.get(key) \
+                        if key is not None else None
+                    if key is not None:
+                        self._prefix_owner[key] = self._name(didx)
+                if key is not None and owner == self._name(didx):
+                    self._pool_aff_hits.inc()
+                else:
+                    self._pool_aff_misses.inc()
+                self._pool_transfers.inc()
+                _spans.event("request.transfer",
+                             from_replica=self._name(pidx),
+                             to_replica=self._name(didx),
+                             request=trace,
+                             affinity=key is not None)
+                self._relay_transfer(fut, req.future, trace)
+                return True
+            if saw_pressure or not order:
+                # decode pool refused or does not exist: ladder
+                # evidence — sustained pressure escalates submit-time
+                # brownout and, past that, typed PoolSaturated
+                self._pool_pressure.record_backpressure(now)
+            self._pool_colocates.inc()
+            return False
+
+        return _transfer
+
+    def _relay_transfer(self, src, dst, trace_id):
+        """Pipe the decode replica's future into the original
+        request's future, releasing the decode-holder record once the
+        response lands (successfully or not — a failed relay leaves
+        re-dispatch to the FleetFuture drive loop, which consults the
+        holder first)."""
+
+        def _pipe():
+            try:
+                res = src.result(timeout=None)
+            except BaseException as e:      # noqa: BLE001 — relayed
+                if not dst.done():
+                    dst.set_error(e)
+            else:
+                self._forget_trace(trace_id)
+                if not dst.done():
+                    dst.set_result(res)
+
+        threading.Thread(target=_pipe, name="kv-transfer-relay",
+                         daemon=True).start()
+
+    def _forget_trace(self, trace_id):
+        if not trace_id:
+            return
+        with self._blk:
+            self._decode_holder.pop(trace_id, None)
+
+    def _fail_holder(self, trace_id, reason):
+        """Blame the decode replica actually holding a transferred
+        request (the FleetFuture's ``_idx`` still points at the
+        prefill replica that placed it)."""
+        if not trace_id:
+            return
+        with self._blk:
+            idx = self._decode_holder.get(trace_id)
+        if idx is not None:
+            self._record_failure(idx, reason)
+
+    def pools_summary(self):
+        """Per-pool depth + transfer/affinity counters (the
+        gateway's ``/healthz`` ``pools`` block and the heartbeat's
+        ``serving_pools`` summary). None when pools are disabled."""
+        if not self.pools_enabled():
+            return None
+        self._ensure_pool_metrics()
+        pools = {}
+        for i, r in self.live_replicas():
+            role = self._role(i)
+            p = pools.setdefault(role,
+                                 {"replicas": 0, "queue_depth": 0})
+            p["replicas"] += 1
+            d = self._depth(r)
+            p["queue_depth"] += int(d) if d != float("inf") else 0
+        for role, p in pools.items():
+            self._pool_depth.set(p["queue_depth"], pool=role)
+        hits = self._pool_aff_hits.total()
+        misses = self._pool_aff_misses.total()
+        routed = hits + misses
+        return {
+            "pools": pools,
+            "transfers": {
+                "transferred": self._pool_transfers.total(),
+                "retries": self._pool_retries.total(),
+                "colocate_fallback": self._pool_colocates.total(),
+                "dup_discarded": self._pool_dups.total(),
+            },
+            "affinity": {
+                "hits": hits, "misses": misses,
+                "hit_ratio": (hits / routed) if routed else 0.0,
+            },
+        }
+
+    def decode_placement(self, prompt):
+        """Decode replica names in the order the affinity hash would
+        try them for ``prompt`` — introspection for tests and
+        capacity planning (stable-hash, minimal-movement, and
+        cold-prefix assertions read this instead of poking
+        internals)."""
+        key = prefix_chain_key(prompt, self._affinity_block())
+        return [self._name(i)
+                for i in self._decode_order(key, self._clock())]
+
     # -- placement ---------------------------------------------------------
-    def _order(self, now, exclude=()):
+    def _order(self, now, exclude=(), roles=None):
         """Breaker-admitted replicas, least-depth first, draining
-        last; open-but-probe-due replicas carry probing=True."""
+        last; open-but-probe-due replicas carry probing=True.
+        ``roles`` (optional set of pool roles) filters candidates."""
         out = []
         with self._blk:
             for i, r in enumerate(self.replicas):
                 if i in exclude or r is None:
+                    continue
+                if roles is not None and self._role(i) not in roles:
                     continue
                 br = self._breakers[i]
                 if not br.admits(now):
@@ -719,7 +1122,19 @@ class FleetRouter:
         now = self._clock()
         last_exc = None
         saw_replica_failure = False
-        order = self._order(now, exclude)
+        if self.pools_enabled():
+            # fresh prompts land on the prefill pool (decode peers
+            # receive work by KV transfer, not admission) — but a
+            # starved prefill pool may still spill onto decode
+            # replicas as a last resort before refusing outright
+            order = self._order(now, exclude,
+                                roles=("prefill", "colocated"))
+            seen = {i for i, _p in order}
+            order += [(i, p) for i, p
+                      in self._order(now, exclude, roles=("decode",))
+                      if i not in seen]
+        else:
+            order = self._order(now, exclude)
         for idx, probing in order:
             r = self.replicas[idx]
             if probing:
@@ -765,6 +1180,20 @@ class FleetRouter:
         if not order:
             last_exc = last_exc or ServingError(
                 "every replica is ejected (breaker open) or excluded")
+        if not saw_replica_failure and self.pools_enabled() \
+                and self._pool_pressure.sustained(now):
+            # ladder's last rung: brownout stepped down, colocate
+            # absorbed what it could, and placement STILL failed —
+            # refuse typed so dashboards and callers can tell pool
+            # saturation from generic overload
+            self._ensure_pool_metrics()
+            self._pool_saturated.inc()
+            raise PoolSaturated(
+                f"decode pool saturated: degradation ladder "
+                f"exhausted (brownout + colocate fallback) and no "
+                f"replica can place the request (last: {last_exc}); "
+                f"retry after {self._pool_pressure.retry_after}s",
+                retry_after=self._pool_pressure.retry_after)
         if not saw_replica_failure and self.shed_policy is not None \
                 and self.shed_policy.sustained(now):
             self._sheds.inc()
@@ -796,6 +1225,15 @@ class FleetRouter:
         ``ServeFuture``). Under a sustained shed the brownout hook gets
         one chance to step the request down before a typed
         :class:`RequestShed` refusal."""
+        if self.pools_enabled() \
+                and self._pool_pressure.sustained(self._clock()):
+            # decode-pool ladder rung one: shrink generation before
+            # anything is refused — shorter decodes drain the pool
+            stepped = brownout_shrink_generation(kwargs)
+            if stepped is not None:
+                self._ensure_pool_metrics()
+                self._pool_brownouts.inc()
+                kwargs = stepped
         if self.shed_policy is not None \
                 and self.shed_policy.sustained(self._clock()):
             stepped = self.shed_policy.apply_brownout(kwargs)
@@ -916,7 +1354,12 @@ class FleetRouter:
         trace_id = ffut._kwargs.get("trace_id")
         if not trace_id or ffut._idx is None:
             return None
-        dead = self.replicas[ffut._idx]
+        # a transferred request's newest checkpoints live on the
+        # DECODE replica that held it, not the prefill replica the
+        # FleetFuture placed it on — follow the KV
+        with self._blk:
+            src_idx = self._decode_holder.get(trace_id, ffut._idx)
+        dead = self.replicas[src_idx]
         if dead is None:        # tombstoned slot: no checkpoint access
             return None
         eng = getattr(dead, "engine", dead)
@@ -930,7 +1373,21 @@ class FleetRouter:
         if snap is None:
             return None
         now = self._clock()
-        for sidx, _probing in self._order(now, exclude=(ffut._idx,)):
+        if self.pools_enabled():
+            # resume onto the decode pool in affinity order (next-best
+            # rendezvous peer keeps the prefix pinned), then anyone
+            cands = [(i, False) for i in self._decode_order(
+                prefix_chain_key(ffut._args[0]
+                                 if ffut._args else (),
+                                 self._affinity_block()),
+                now, exclude=(src_idx,))]
+            seen = {i for i, _p in cands}
+            cands += [(i, p) for i, p in self._order(
+                now, exclude=(ffut._idx, src_idx))
+                if i not in seen]
+        else:
+            cands = self._order(now, exclude=(ffut._idx, src_idx))
+        for sidx, _probing in cands:
             seng = getattr(self.replicas[sidx], "engine",
                            self.replicas[sidx])
             inject = getattr(seng, "inject_snapshot", None)
@@ -950,8 +1407,11 @@ class FleetRouter:
                 continue
             self._resumes.inc()
             self._submitted.inc()
+            with self._blk:
+                if trace_id in self._decode_holder:
+                    self._decode_holder[trace_id] = sidx
             _spans.event("request.resume_from_checkpoint",
-                         from_replica=self._name(ffut._idx),
+                         from_replica=self._name(src_idx),
                          to_replica=self._name(sidx),
                          request=trace_id)
             return sidx, fut
@@ -980,6 +1440,6 @@ class FleetRouter:
 
 
 __all__ = ["ServingReplica", "FleetRouter", "FleetFuture",
-           "CircuitBreaker", "ShedPolicy",
+           "CircuitBreaker", "ShedPolicy", "PoolSaturated",
            "brownout_shrink_generation", "EXIT_DRAINED",
            "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN"]

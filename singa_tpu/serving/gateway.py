@@ -127,9 +127,15 @@ def serve_gateway(engine, host="127.0.0.1", port=0, replica=None,
             docs = engine.health()
             n_ok = sum(1 for d in docs if isinstance(d, dict)
                        and d.get("status") == "serving")
-            return {"status": "serving" if n_ok else "unavailable",
-                    "replicas": docs,
-                    "breakers": engine.breaker_states()}
+            doc = {"status": "serving" if n_ok else "unavailable",
+                   "replicas": docs,
+                   "breakers": engine.breaker_states()}
+            # disaggregated prefill/decode view: per-pool depth +
+            # transfer/affinity counters (absent when pools are off)
+            pools = getattr(engine, "pools_summary", lambda: None)()
+            if pools is not None:
+                doc["pools"] = pools
+            return doc
         return {"status": ("crashed" if engine._crashed is not None
                            else "draining" if engine.draining
                            else "serving"),

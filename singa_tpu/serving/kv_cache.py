@@ -58,9 +58,57 @@ no flags, no per-slot host state, just arithmetic on ``p``.
 
 from __future__ import annotations
 
+import hashlib
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# chained prefix content keys (shared by the block manager's prefix
+# cache and the fleet router's prefix-affinity placement)
+# ---------------------------------------------------------------------------
+
+def chain_keys(prompt, block_size):
+    """Chained content keys for each FULL block of ``prompt``: key
+    ``b`` covers block ``b``'s tokens AND everything before it, so a
+    key match guarantees the whole preceding context matches. The ONE
+    key construction — :class:`BlockManager`'s prefix cache and the
+    fleet router's prefix-affinity hash both build keys here, so
+    "lands on the replica holding the blocks" is true by construction,
+    never by parallel reimplementation."""
+    bs = int(block_size)
+    keys, prev = [], ()
+    for b in range(len(prompt) // bs):
+        prev = (prev, tuple(int(t) for t in prompt[b*bs:(b+1)*bs]))
+        keys.append(prev)
+    return keys
+
+
+def prefix_chain_key(prompt, block_size):
+    """The chained content key of ``prompt``'s longest CACHEABLE
+    full-block prefix — capped one token short of the whole prompt
+    (``match_prefix``'s cap: the last token is always prefilled so its
+    logits exist). ``None`` for a prompt too short to share even one
+    block (a *cold* prefix — affinity routing falls back to
+    least-loaded)."""
+    cap = (len(prompt) - 1) // int(block_size)
+    if cap <= 0:
+        return None
+    return chain_keys(prompt, block_size)[cap - 1]
+
+
+def affinity_hash(key, salt=""):
+    """Stable 64-bit digest of a chain key (optionally salted with a
+    replica name for rendezvous/HRW scoring). Deliberately NOT python
+    ``hash()``: that is randomized per process, and the affinity
+    contract is *same prefix → same decode replica across router
+    restarts*. sha1 over the key's canonical repr is stable across
+    processes, platforms, and time."""
+    h = hashlib.sha1(
+        (repr(key) + "\x00" + str(salt)).encode()).digest()
+    return int.from_bytes(h[:8], "big")
 
 
 def init_cache(n_slots, n_heads, length, head_dim, dtype=jnp.float32):
@@ -472,12 +520,7 @@ class BlockManager:
     # -- prefix cache -------------------------------------------------------
     def _chain_keys(self, prompt):
         """Chained content keys for each FULL block of ``prompt``."""
-        bs = self.block_size
-        keys, prev = [], ()
-        for b in range(len(prompt) // bs):
-            prev = (prev, tuple(int(t) for t in prompt[b*bs:(b+1)*bs]))
-            keys.append(prev)
-        return keys
+        return chain_keys(prompt, self.block_size)
 
     def match_prefix(self, prompt):
         """Longest cached full-block prefix of ``prompt``, capped one
@@ -630,4 +673,5 @@ class BlockManager:
 __all__ = ["init_cache", "ring_positions", "ring_mask", "write_token",
            "write_prompt", "attend", "init_pool", "write_rows",
            "gather_pages", "attend_pages", "SlotAlloc", "BlockManager",
-           "HostSpillTier"]
+           "HostSpillTier", "chain_keys", "prefix_chain_key",
+           "affinity_hash"]

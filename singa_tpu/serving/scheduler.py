@@ -70,6 +70,16 @@ class RequestShed(ServingError):
         self.retry_after = float(retry_after)
 
 
+class PoolSaturated(RequestShed):
+    """The disaggregated decode pool refused this request AFTER the
+    degradation ladder ran dry: brownout stepped generation down,
+    colocate fallback (prefill replicas serving decode end-to-end)
+    absorbed what it could, and the fleet still cannot place the
+    request. A :class:`RequestShed` subclass, so the gateway's 503 +
+    ``Retry-After`` contract applies unchanged — but typed, so tests
+    and dashboards can tell pool saturation from generic overload."""
+
+
 def deadline_in(timeout, now=None):
     """Monotonic deadline for a timeout budget; ``None`` timeout means
     no deadline. The single clock a request lives on: the gateway and
@@ -285,6 +295,6 @@ class RequestQueue:
 
 __all__ = ["ServingError", "QueueFull", "EngineDraining",
            "RequestTimeout", "ReplicaCrashed", "RequestShed",
-           "BlockPoolExhausted", "HandoffRefused", "ServeFuture",
-           "Request", "RequestQueue", "deadline_in",
+           "PoolSaturated", "BlockPoolExhausted", "HandoffRefused",
+           "ServeFuture", "Request", "RequestQueue", "deadline_in",
            "budget_remaining"]

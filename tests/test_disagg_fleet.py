@@ -1,0 +1,524 @@
+"""Disaggregated prefill/decode pool suite (CPU, fast tier).
+
+The PR's acceptance matrix:
+
+- a request routed through role-tagged pools (prefill replica seals +
+  transfers its finished slot's KV to an affinity-chosen decode
+  replica) produces a token list BITWISE identical to a single
+  colocated replica — for the ring, paged, int8-KV, and speculative
+  engines — and the decode side never prefills a token;
+- every transfer failure resolves through the typed ladder with zero
+  hung or double-fulfilled futures: a corrupt frame retries once on
+  the next-best decode peer with a FRESH re-snapshot; a dropped frame
+  retries on the next peer; a duplicated delivery's second copy is
+  discarded by the exactly-once guard; a decode replica dying with
+  injected-but-unfinished work re-dispatches through the FleetFuture
+  budget and resumes from its newest KV checkpoint (never token
+  zero); a saturated decode pool degrades brownout → colocate →
+  typed ``PoolSaturated``;
+- the affinity hash is a real rendezvous hash: the same prefix maps
+  to the same decode replica across router restarts, membership
+  changes move only the keys whose top scorer changed, and a cold
+  prefix falls back to least-loaded;
+- observability: per-replica ``pool_role`` in health docs and
+  heartbeats, a ``serving_pools`` heartbeat block, and a ``pools``
+  block on the fleet gateway's ``/healthz``.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device
+from singa_tpu.models import transformer
+from singa_tpu.observability import metrics as obs_metrics
+from singa_tpu.resilience.faults import FaultPlan
+from singa_tpu.serving import (FleetRouter, PoolSaturated, RequestShed,
+                               ServingReplica, ShedPolicy, serve_gateway)
+from singa_tpu.serving.kv_cache import (affinity_hash, chain_keys,
+                                        prefix_chain_key)
+from singa_tpu.serving.scheduler import ReplicaCrashed
+from singa_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.serving
+
+DEV = device.create_cpu_device()
+
+PROMPT = [3, 1, 4, 1, 5]
+PAGED = dict(kv_layout="paged", kv_block_size=4, kv_blocks=24)
+
+
+def _reg():
+    return obs_metrics.MetricsRegistry()
+
+
+def tiny_lm(vocab=19, max_len=64):
+    """Deterministic tiny LM (device PRNG re-seeded) so separately
+    built engines are weight-identical and cross-engine token
+    comparisons are meaningful."""
+    DEV.set_rand_seed(0)
+    np.random.seed(0)
+    m = transformer.TransformerLM(vocab, d_model=16, n_heads=2,
+                                  n_layers=2, max_len=max_len, tp=False)
+    m.eval()
+    m(Tensor(data=np.zeros((1, 4), np.float32), device=DEV,
+             requires_grad=False))
+    return m
+
+
+def _engine(m, reg, **kw):
+    return m.compile_serving(slots=2, max_len=48, prefill_len=8,
+                             registry=reg, **kw)
+
+
+def _serving_kw(name):
+    if name == "ring":
+        return {}
+    if name == "paged":
+        return dict(PAGED)
+    if name == "int8":
+        from singa_tpu import mixed_precision as mp
+        return dict(policy=mp.resolve("int8_weight_only"))
+    if name == "spec":
+        return dict(PAGED, speculative_k=3)
+    raise ValueError(name)
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _reference(m, kw, max_new=12):
+    """Uninterrupted colocated greedy run — the bitwise target."""
+    reg = _reg()
+    eng = _engine(m, reg, **kw)
+    fut = eng.submit(PROMPT, max_new_tokens=max_new)
+    eng.run_until_idle()
+    ref = fut.result(timeout=10)["tokens"]
+    eng.stop()
+    return ref
+
+
+def _pool_fleet(m, kw, n_decode=2, prefill_faults=None,
+                decode_kw=None, pool_shed=None):
+    """1 prefill + N decode replicas behind a router. Nothing is
+    started — tests control the tick-by-tick schedule or start
+    replicas themselves."""
+    pkw = dict(kw)
+    if prefill_faults is not None:
+        pkw["faults"] = prefill_faults
+    regs = [_reg() for _ in range(1 + n_decode)]
+    pe = _engine(m, regs[0], pool_role="prefill", **pkw)
+    des = [_engine(m, regs[1 + i], pool_role="decode",
+                   **dict(kw, **(decode_kw or {})))
+           for i in range(n_decode)]
+    reps = [ServingReplica(pe, name="p0", registry=regs[0])]
+    reps += [ServingReplica(d, name=f"d{i}", registry=regs[1 + i])
+             for i, d in enumerate(des)]
+    rreg = _reg()
+    rt = FleetRouter(reps, registry=rreg, pool_shed=pool_shed)
+    return pe, des, reps, regs, rreg, rt
+
+
+class TestTransferBitwiseIdentity:
+    @pytest.mark.parametrize("cfg", ["ring", "paged", "int8", "spec"])
+    def test_pool_route_matches_colocated(self, cfg):
+        """THE disaggregation pin: prefill-pool admit → KV transfer →
+        decode-pool continuation equals a single colocated replica's
+        greedy run token for token, and the decode side never
+        prefills (migrate, don't recompute)."""
+        kw = _serving_kw(cfg)
+        m = tiny_lm()
+        ref = _reference(m, kw)
+        pe, des, reps, regs, rreg, rt = _pool_fleet(m, kw, n_decode=1)
+        for r in reps:
+            r.start()
+        try:
+            ff = rt.submit(PROMPT, max_new_tokens=12, timeout=60,
+                           trace_id=f"parity-{cfg}")
+            out = ff.result(timeout=60)
+            assert out["tokens"] == ref, (cfg, out["tokens"], ref)
+            assert ff.deliveries == 1
+            assert rreg.get("serve_pool_transfer_total").value() == 1
+            assert regs[0].get("serve_pool_transfer_out_total") \
+                .value() == 1
+            # the decode replica continued the KV: zero re-prefill
+            assert regs[1].get("serve_prefill_tokens_total") \
+                .value() == 0
+            assert regs[1].get("serve_handoff_in_total").value() == 1
+        finally:
+            for r in reps:
+                r.drain(timeout=30)
+
+
+class TestTransferFaultLadder:
+    def test_corrupt_frame_retries_next_peer_with_fresh_snapshot(self):
+        """``corrupt_handoff`` flips a bit in the FIRST sealed frame:
+        the affinity-first decode peer refuses it typed (CRC), the
+        router re-seals FRESH (new handoff seq — the times=1 fault
+        cannot re-fire) and the next-best peer accepts — still
+        bitwise identical, delivered exactly once, corrupt KV never
+        written anywhere."""
+        m = tiny_lm()
+        ref = _reference(m, PAGED)
+        faults = FaultPlan()
+        faults.corrupt_handoff(1, times=1)
+        pe, des, reps, regs, rreg, rt = _pool_fleet(
+            m, PAGED, n_decode=2, prefill_faults=faults)
+        for r in reps:
+            r.start()
+        try:
+            ff = rt.submit(PROMPT, max_new_tokens=12, timeout=60,
+                           trace_id="corrupt-xfer")
+            out = ff.result(timeout=60)
+            assert out["tokens"] == ref
+            assert ff.deliveries == 1
+            assert rreg.get("serve_pool_transfer_retry_total") \
+                .value() >= 1
+            assert rreg.get("serve_pool_transfer_total").value() == 1
+            refused = sum(
+                regs[i].get("serve_handoff_refused_total").value()
+                if regs[i].get("serve_handoff_refused_total")
+                is not None else 0 for i in (1, 2))
+            assert refused >= 1
+            # exactly ONE decode replica owns the continuation
+            landed = sum(
+                regs[i].get("serve_handoff_in_total").value()
+                if regs[i].get("serve_handoff_in_total") is not None
+                else 0 for i in (1, 2))
+            assert landed == 1
+        finally:
+            for r in reps:
+                r.drain(timeout=30)
+
+    def test_dropped_frame_retries_next_peer(self):
+        """``drop_transfer`` eats the first delivery on the wire: the
+        router counts a retry and the next-best peer gets a fresh
+        delivery — same bitwise contract."""
+        m = tiny_lm()
+        ref = _reference(m, PAGED)
+        faults = FaultPlan()
+        faults.drop_transfer(1, times=1)
+        pe, des, reps, regs, rreg, rt = _pool_fleet(
+            m, PAGED, n_decode=2, prefill_faults=faults)
+        for r in reps:
+            r.start()
+        try:
+            ff = rt.submit(PROMPT, max_new_tokens=12, timeout=60,
+                           trace_id="drop-xfer")
+            out = ff.result(timeout=60)
+            assert out["tokens"] == ref
+            assert ff.deliveries == 1
+            assert rreg.get("serve_pool_transfer_retry_total") \
+                .value() >= 1
+            assert rreg.get("serve_pool_transfer_total").value() == 1
+        finally:
+            for r in reps:
+                r.drain(timeout=30)
+
+    def test_dup_delivery_discarded_exactly_once(self):
+        """``dup_transfer`` delivers the sealed frame twice: the
+        second copy is DISCARDED by the exactly-once guard (counted),
+        only one decode replica ever receives the continuation, and
+        the future fulfills once."""
+        m = tiny_lm()
+        ref = _reference(m, PAGED)
+        faults = FaultPlan()
+        faults.dup_transfer(1, times=1)
+        pe, des, reps, regs, rreg, rt = _pool_fleet(
+            m, PAGED, n_decode=2, prefill_faults=faults)
+        for r in reps:
+            r.start()
+        try:
+            ff = rt.submit(PROMPT, max_new_tokens=12, timeout=60,
+                           trace_id="dup-xfer")
+            out = ff.result(timeout=60)
+            assert out["tokens"] == ref
+            assert ff.deliveries == 1
+            assert rreg.get("serve_pool_dup_discarded_total") \
+                .value() >= 1
+            landed = sum(
+                regs[i].get("serve_handoff_in_total").value()
+                if regs[i].get("serve_handoff_in_total") is not None
+                else 0 for i in (1, 2))
+            assert landed == 1
+        finally:
+            for r in reps:
+                r.drain(timeout=30)
+
+    def test_dead_decode_peer_resumes_from_checkpoint(self):
+        """A decode replica dying with injected-but-unfinished work:
+        the relay surfaces ``ReplicaCrashed``, the FleetFuture
+        re-dispatches inside its budget, and the surviving decode
+        peer resumes from the dead one's newest KV checkpoint —
+        token-identical, exactly once, NEVER from token zero."""
+        m = tiny_lm()
+        ref = _reference(m, PAGED, max_new=24)
+        pe, des, reps, regs, rreg, rt = _pool_fleet(
+            m, PAGED, n_decode=2, decode_kw=dict(snapshot_every=1))
+        # pin which decode replica the transfer will choose, start
+        # only the OTHER one — the target is stepped by hand into a
+        # deterministic mid-flight state before it dies
+        target_name = rt.decode_placement(PROMPT)[0]
+        tidx = 1 if target_name == "d0" else 2
+        oidx = 3 - tidx
+        target, other = des[tidx - 1], des[oidx - 1]
+        reps[oidx].start()
+        try:
+            ff = rt.submit(PROMPT, max_new_tokens=24, timeout=60,
+                           trace_id="dead-decode")
+            for _ in range(12):         # prefill + transfer, by hand
+                pe.step()
+                if rreg.get("serve_pool_transfer_total").value():
+                    break
+            assert rreg.get("serve_pool_transfer_total").value() == 1
+            # drive the target mid-flight (checkpoints each tick)
+            for _ in range(12):
+                target.step()
+                slots = [s for s in target._slots if s is not None]
+                if slots and len(slots[0]["req"].tokens) >= 3:
+                    break
+            assert target.take_kv_checkpoint("dead-decode") is not None
+            target._crashed = RuntimeError("injected decode death")
+            target._fail_inflight(ReplicaCrashed("injected"))
+            other_pf = regs[oidx].get(
+                "serve_prefill_tokens_total").value()
+            out = ff.result(timeout=60)
+            assert out["tokens"] == ref
+            assert ff.deliveries == 1
+            assert rreg.get("serve_fleet_resume_total").value() >= 1
+            # resumed mid-stream, not recomputed: the survivor never
+            # prefilled this request
+            assert regs[oidx].get("serve_prefill_tokens_total") \
+                .value() == other_pf
+        finally:
+            pe.stop()
+            target.stop()
+            reps[oidx].drain(timeout=30)
+
+    def test_saturated_pool_ladder_brownout_colocate_shed(self):
+        """The degradation ladder in order. A draining decode pool
+        refuses every transfer: (rung 0) colocate fallback — the
+        prefill replica serves decode end-to-end, responses intact;
+        (rung 1) once pressure is sustained, submits brown out
+        (max_new halved); (rung 2) when the prefill side drains too
+        and placement fails outright, the refusal is typed
+        ``PoolSaturated`` (a RequestShed — the gateway's 503 +
+        Retry-After contract) — zero hung or double-fulfilled
+        futures anywhere."""
+        m = tiny_lm()
+        pe, des, reps, regs, rreg, rt = _pool_fleet(
+            m, PAGED, n_decode=1,
+            pool_shed=ShedPolicy(window_s=60.0, threshold=4,
+                                 retry_after=2.0))
+        reps[0].start()             # prefill serves; decode drains
+        reps[1].request_drain()
+        futs = []
+        try:
+            for k in range(4):
+                futs.append(rt.submit(PROMPT, max_new_tokens=8,
+                                      timeout=60,
+                                      trace_id=f"sat-{k}"))
+            for f in futs:
+                assert len(f.result(timeout=60)["tokens"]) == 8
+            assert rreg.get("serve_pool_colocate_fallback_total") \
+                .value() == 4
+            assert regs[0].get("serve_pool_colocate_total") \
+                .value() == 4
+            # rung 1: sustained pressure browns out the next submit
+            fb = rt.submit(PROMPT, max_new_tokens=8, timeout=60,
+                           trace_id="sat-brown")
+            assert len(fb.result(timeout=60)["tokens"]) == 4
+            assert rreg.get("serve_pool_brownout_total").value() >= 1
+            futs.append(fb)
+            # rung 2: prefill drains too — placement fails, typed
+            reps[0].request_drain()
+            with pytest.raises(PoolSaturated) as ei:
+                rt.submit(PROMPT, max_new_tokens=8, timeout=5,
+                          trace_id="sat-shed")
+            assert isinstance(ei.value, RequestShed)
+            assert ei.value.retry_after == 2.0
+            assert rreg.get("serve_pool_saturated_total").value() >= 1
+            for f in futs:
+                assert f.deliveries == 1
+        finally:
+            for r in reps:
+                r.drain(timeout=30)
+
+
+class _FakeReplica:
+    """Routing-only stand-in: a name, a role, a depth."""
+
+    def __init__(self, name, role="decode", depth=0):
+        self.name = name
+        self.pool_role = role
+        self.depth = depth
+        self.draining = False
+
+    def queue_depth(self):
+        return self.depth
+
+
+def _routing_fleet(decode_names, depths=None):
+    reps = [_FakeReplica("p0", role="prefill")]
+    reps += [_FakeReplica(n, depth=(depths or {}).get(n, 0))
+             for n in decode_names]
+    return FleetRouter(reps, registry=_reg(), affinity_block_size=4)
+
+
+class TestAffinityHash:
+    def _prompts(self, n=200, length=12, seed=11):
+        rng = np.random.RandomState(seed)
+        return [list(map(int, rng.randint(1, 97, (length,))))
+                for _ in range(n)]
+
+    def test_same_prefix_same_replica_across_restarts(self):
+        """The hash is content-derived (sha1 of the block-aligned
+        chain key), not process state: a freshly built router with
+        the same member names places every prefix identically."""
+        prompts = self._prompts(50)
+        a = _routing_fleet(["d0", "d1", "d2"])
+        b = _routing_fleet(["d0", "d1", "d2"])
+        for p in prompts:
+            assert a.decode_placement(p) == b.decode_placement(p)
+        # and it actually spreads: no single replica owns everything
+        tops = {a.decode_placement(p)[0] for p in prompts}
+        assert len(tops) >= 2
+
+    def test_chain_key_is_the_prefix_cache_key(self):
+        """The affinity key IS the BlockManager's chained content
+        key — same construction, so a repeated prefix lands where
+        the decode-side prefix cache is already warm by definition."""
+        from singa_tpu.serving.kv_cache import BlockManager
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        mgr = BlockManager(8, 4)
+        assert chain_keys(prompt, 4) == mgr._chain_keys(prompt)
+        assert prefix_chain_key(prompt, 4) == mgr._chain_keys(prompt)[
+            (len(prompt) - 1) // 4 - 1]
+        # sub-block prompts have no chain (cold): key is None
+        assert prefix_chain_key([1, 2, 3], 4) is None
+
+    def test_membership_change_moves_only_new_winners(self):
+        """Rendezvous property: adding a decode replica moves ONLY
+        the keys whose top scorer is the newcomer — every other
+        prefix keeps its replica (the decode caches stay warm), and
+        the moved fraction is roughly 1/n, not a full reshuffle."""
+        prompts = self._prompts(200)
+        rt = _routing_fleet(["d0", "d1", "d2"])
+        before = {tuple(p): rt.decode_placement(p)[0]
+                  for p in prompts}
+        rt.add_replica(_FakeReplica("d3"))
+        moved = 0
+        for p in prompts:
+            now = rt.decode_placement(p)[0]
+            if now != before[tuple(p)]:
+                moved += 1
+                assert now == "d3", (
+                    "a key moved to an OLD replica: not rendezvous")
+        assert 0 < moved < len(prompts) * 0.5
+        # removal is symmetric: evicted keys scatter, survivors stay
+        with_d3 = {tuple(p): rt.decode_placement(p)[0]
+                   for p in prompts}
+        rt.remove_replica(4)        # d3's slot (p0,d0,d1,d2,d3)
+        for p in prompts:
+            if with_d3[tuple(p)] != "d3":
+                assert rt.decode_placement(p)[0] == with_d3[tuple(p)]
+
+    def test_cold_prefix_goes_least_loaded(self):
+        """A prompt too short for a block-aligned chain has no
+        affinity signal — placement falls back to least queue
+        depth."""
+        rt = _routing_fleet(["d0", "d1", "d2"],
+                            depths={"d0": 5, "d1": 0, "d2": 3})
+        assert rt.decode_placement([1, 2, 3]) == ["d1", "d2", "d0"]
+
+    def test_affinity_hash_stable_value(self):
+        """sha1-derived, salt-separated: equal inputs agree, either
+        input differing disagrees (process-randomized ``hash()``
+        would break cross-restart stability)."""
+        k = prefix_chain_key(list(range(8)), 4)
+        assert affinity_hash(k, salt="a") == affinity_hash(k, salt="a")
+        assert affinity_hash(k, salt="a") != affinity_hash(k, salt="b")
+        k2 = prefix_chain_key(list(range(1, 9)), 4)
+        assert affinity_hash(k, salt="a") != affinity_hash(k2, salt="a")
+
+
+class TestTransferFaultShapes:
+    def test_transfer_fault_hooks(self):
+        """``on_transfer_send`` is the wire: slow sleeps then passes,
+        drop eats the delivery, dup doubles it; each ``times=1``
+        registration fires once and later sends are clean."""
+        plan = FaultPlan()
+        plan.slow_transfer(1, seconds=0.01, times=1)
+        plan.drop_transfer(2, times=1)
+        plan.dup_transfer(3, times=1)
+        t0 = time.monotonic()
+        assert plan.on_transfer_send(1, b"f") == [b"f"]
+        assert time.monotonic() - t0 >= 0.01
+        assert plan.on_transfer_send(2, b"f") == []
+        assert plan.on_transfer_send(3, b"f") == [b"f", b"f"]
+        assert plan.on_transfer_send(4, b"f") == [b"f"]
+        kinds = [k for _s, k in plan.fired]
+        assert kinds == ["transfer_slow", "transfer_drop",
+                         "transfer_dup"]
+
+
+class TestPoolObservability:
+    def test_health_heartbeat_and_gateway_pools(self):
+        """Per-replica ``pool_role`` rides health docs and
+        heartbeats; the router's ``pools_summary`` and the fleet
+        gateway's ``/healthz`` expose per-pool depth, transfer
+        counters, and the affinity hit ratio."""
+        m = tiny_lm()
+        pe, des, reps, regs, rreg, rt = _pool_fleet(m, PAGED,
+                                                    n_decode=1)
+        for r in reps:
+            r.start()
+        server = None
+        try:
+            assert reps[0].health()["pool_role"] == "prefill"
+            assert reps[1].health()["pool_role"] == "decode"
+            assert obs_metrics.heartbeat_summary(
+                regs[0])["pool_role"] == "prefill"
+            assert obs_metrics.heartbeat_summary(
+                regs[1])["pool_role"] == "decode"
+            # two identical prompts: a miss then a hit
+            for k in range(2):
+                rt.submit(PROMPT, max_new_tokens=6, timeout=60,
+                          trace_id=f"obs-{k}").result(timeout=60)
+            summary = rt.pools_summary()
+            assert summary["pools"]["prefill"]["replicas"] == 1
+            assert summary["pools"]["decode"]["replicas"] == 1
+            assert summary["transfers"]["transferred"] == 2
+            assert summary["affinity"]["hits"] == 1
+            assert summary["affinity"]["hit_ratio"] == 0.5
+            hb = obs_metrics.heartbeat_summary(rreg)
+            assert hb["serving_pools"]["transferred"] == 2
+            assert hb["serving_pools"]["affinity"]["hits"] == 1
+            import http.client
+            server, port = serve_gateway(rt)
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=30)
+            try:
+                c.request("GET", "/healthz")
+                r = c.getresponse()
+                doc = json.loads(r.read().decode())
+            finally:
+                c.close()
+            assert doc["pools"]["transfers"]["transferred"] == 2
+            roles = {d["name"]: d["pool_role"]
+                     for d in doc["replicas"] if isinstance(d, dict)}
+            assert roles == {"p0": "prefill", "d0": "decode"}
+        finally:
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+            for r in reps:
+                r.drain(timeout=30)

@@ -432,3 +432,21 @@ class TestServeGatewayExample:
         spec.loader.exec_module(chaos_smoke)
         chaos_smoke.scenario_serve_preempt(
             str(tmp_path), chaos_smoke.Budget(300))
+
+    @pytest.mark.chaos
+    def test_serve_disagg_pool_drill(self, tmp_path):
+        """The disaggregated-pool drill, end to end in real
+        subprocesses: a prefill gateway transferring sealed KV to two
+        decode gateways by prefix affinity, one decode peer SIGKILLed
+        holding injected work plus one corrupted frame — zero failed
+        responses, every answer bitwise identical to colocated
+        greedy, and the affinity leg's hit counter strictly above a
+        round-robin baseline (shared with ``tools/chaos_smoke.py
+        --only serve-disagg`` — one source of truth)."""
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "chaos_smoke", os.path.join(ROOT, "tools", "chaos_smoke.py"))
+        chaos_smoke = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(chaos_smoke)
+        chaos_smoke.scenario_serve_disagg(
+            str(tmp_path), chaos_smoke.Budget(300))

@@ -72,6 +72,14 @@ harness) against ``examples/train_elastic.py``:
     loop is quarantined after the threshold instead of burning spawns
     forever. Banks spawn-to-ready p50/p99 and the recovered-request
     count.
+12. **serve-disagg** — disaggregated prefill/decode pools across
+    processes: a ``--pool-role prefill`` gateway transfers every
+    sealed KV snapshot to one of two ``--pool-role decode`` gateways
+    by prefix affinity; one decode peer is SIGKILLed holding injected
+    work and one frame is corrupted on seal. Zero failed responses,
+    every answer bitwise identical to colocated greedy, the transfer
+    ladder's retry counters move, and the affinity leg's hit counter
+    sits strictly above a round-robin baseline leg.
 
 Every subprocess gets the REMAINING budget as its timeout, so the whole
 smoke is bounded by ``--budget`` seconds end to end (default 600) —
@@ -1689,6 +1697,244 @@ def _probe(r):
         return False
 
 
+def scenario_serve_disagg(root, budget):
+    """Disaggregated prefill/decode pools across real gateway
+    processes: one ``--pool-role prefill`` gateway fronts the clients
+    and transfers every sealed KV snapshot to one of two
+    ``--pool-role decode`` gateways, chosen by prefix affinity. Two
+    legs, identical Poisson workload and fault schedule, differing
+    ONLY in ``--no-affinity``:
+
+    - **phase 1 (clean)** — K distinct prompts, each repeated, under
+      Poisson arrivals: zero failed responses, every answer bitwise
+      identical to an uninterrupted colocated greedy run, every
+      continuation decoded by a pool peer (``serve_handoff_in_total``
+      moves, the prefill side's decode stays home);
+    - **phase 2 (faulted)** — ``--fault-corrupt-transfer`` flips a bit
+      in one sealed frame (the receiving peer refuses it typed and
+      the ladder's recompute rung serves it) while one decode peer is
+      SIGKILLed holding injected work (dead-socket rung: the relay
+      moves to the surviving peer). Still ZERO failed responses,
+      still bitwise.
+
+    Finally the affinity leg's phase-1 hit counter must sit STRICTLY
+    above the no-affinity baseline's — the rendezvous hash is worth
+    actual cache locality, not just plumbing. Banks hits, transfers,
+    and retries."""
+    import http.client
+    import signal as _signal
+    import threading
+
+    serve = os.path.join(REPO, "examples", "serve_transformer.py")
+    base = ["--cpu", "--slots", "2", "--max-len", "48",
+            "--prefill-len", "8", "--vocab", "32", "--d-model", "16",
+            "--layers", "1", "--kv-layout", "paged",
+            "--kv-block-size", "4", "--kv-blocks", "24"]
+
+    def _get_json(port, path, timeout=10):
+        c = http.client.HTTPConnection("127.0.0.1", port,
+                                       timeout=timeout)
+        try:
+            c.request("GET", path)
+            r = c.getresponse()
+            return r.status, json.loads(r.read().decode() or "{}")
+        finally:
+            c.close()
+
+    def _counter_total(port, name):
+        _st, doc = _get_json(port, "/metrics.json")
+        for m in doc.get("metrics", []):
+            if m.get("name") == name:
+                return sum(s.get("value", 0)
+                           for s in m.get("series", []))
+        return 0
+
+    def _wait_ready(ports_up):
+        deadline = time.monotonic() + min(150, budget.remaining())
+        up = set()
+        while len(up) < len(ports_up) and time.monotonic() < deadline:
+            for p in ports_up:
+                if p in up:
+                    continue
+                try:
+                    st, _ = _get_json(p, "/healthz", timeout=2)
+                    if st == 200:
+                        up.add(p)
+                except OSError:
+                    time.sleep(0.2)
+        return len(up) == len(ports_up)
+
+    def _gen(port, prompt, max_new, timeout=120):
+        c = http.client.HTTPConnection("127.0.0.1", port,
+                                       timeout=timeout)
+        try:
+            c.request("POST", "/v1/generate",
+                      json.dumps({"prompt": prompt,
+                                  "max_new_tokens": max_new,
+                                  "temperature": 0.0,
+                                  "timeout": float(timeout)}))
+            r = c.getresponse()
+            return r.status, json.loads(r.read().decode() or "{}")
+        finally:
+            c.close()
+
+    rng = np.random.RandomState(23)
+    # phase 1: 4 distinct block-aligned prompts x 4 repeats (the
+    # affinity signal); phase 2: 8 distinct prompts with longer
+    # decodes (in-flight work on the peer that dies)
+    p1_prompts = [rng.randint(1, 32, (8,)).tolist() for _ in range(4)]
+    p1_sched = [p1_prompts[i % 4] for i in range(16)]
+    p2_prompts = [rng.randint(1, 32, (8,)).tolist() for _ in range(8)]
+    P1_NEW, P2_NEW = 12, 24
+    # phase 1 seals exactly one frame per request (16), so the 18th
+    # seal is deterministically phase 2's second transfer
+    corrupt_seq = len(p1_sched) + 2
+
+    def _fire(port, sched, max_new, gaps):
+        results = [None] * len(sched)
+
+        def one(i):
+            try:
+                results[i] = _gen(port, sched[i], max_new)
+            except OSError as e:
+                results[i] = ("conn", str(e))
+
+        threads = []
+        for i in range(len(sched)):
+            t = threading.Thread(target=one, args=(i,))
+            t.start()
+            threads.append(t)
+            time.sleep(gaps[i])
+        for t in threads:
+            t.join(timeout=budget.remaining())
+        return results
+
+    def run_leg(name, affinity):
+        dports = [_free_port(), _free_port()]
+        pport = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, serve, "--port", str(p), "--pool-role",
+             "decode"] + base,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for p in dports]
+        pf_extra = ["--pool-role", "prefill", "--decode-peers",
+                    ",".join(str(p) for p in dports),
+                    "--fault-corrupt-transfer", str(corrupt_seq)]
+        if not affinity:
+            pf_extra.append("--no-affinity")
+        procs.append(subprocess.Popen(
+            [sys.executable, serve, "--port", str(pport)] + base
+            + pf_extra,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+        try:
+            _check(_wait_ready(dports + [pport]),
+                   f"serve-disagg/{name}: all three gateways READY")
+            # ---- phase 1: clean Poisson load, 2 live decode peers --
+            res1 = _fire(pport, p1_sched, P1_NEW,
+                         rng.exponential(0.05, len(p1_sched)))
+            bad = [(i, r) for i, r in enumerate(res1)
+                   if not isinstance(r, tuple) or r[0] != 200
+                   or len(r[1].get("tokens", [])) != P1_NEW]
+            _check(not bad,
+                   f"serve-disagg/{name}: phase 1 zero failed "
+                   f"responses ({len(bad)} bad)", repr(bad[:3]))
+            landed = sum(_counter_total(p, "serve_handoff_in_total")
+                         for p in dports)
+            _check(landed >= len(p1_sched),
+                   f"serve-disagg/{name}: continuations decoded by "
+                   f"the pool ({landed} injected)")
+            hits1 = _counter_total(pport,
+                                   "serve_pool_affinity_hit_total")
+            # ---- phase 2: corrupt frame + SIGKILL a decode peer ----
+            res2_box = {}
+            ph2 = threading.Thread(
+                target=lambda: res2_box.update(r=_fire(
+                    pport, p2_prompts, P2_NEW,
+                    rng.exponential(0.05, len(p2_prompts)))))
+            ph2.start()
+            victim = None
+            kill_by = time.monotonic() + 20
+            while victim is None and time.monotonic() < kill_by:
+                for k, p in enumerate(dports):
+                    try:
+                        _st, h = _get_json(p, "/healthz", timeout=2)
+                    except OSError:
+                        continue
+                    if (h.get("active_slots") or 0) >= 1:
+                        victim = k
+                        break
+                time.sleep(0.01)
+            _check(victim is not None,
+                   f"serve-disagg/{name}: a decode peer holds "
+                   f"injected work to kill")
+            procs[victim].send_signal(_signal.SIGKILL)
+            ph2.join(timeout=budget.remaining())
+            procs[victim].wait(timeout=budget.remaining())
+            res2 = res2_box.get("r") or []
+            bad = [(i, r) for i, r in enumerate(res2)
+                   if not isinstance(r, tuple) or r[0] != 200
+                   or len(r[1].get("tokens", [])) != P2_NEW]
+            _check(not bad,
+                   f"serve-disagg/{name}: phase 2 zero failed "
+                   f"responses through the fault ladder "
+                   f"({len(bad)} bad)", repr(bad[:3]))
+            retries = _counter_total(
+                pport, "serve_pool_transfer_retry_total")
+            _check(retries >= 1,
+                   f"serve-disagg/{name}: the ladder retried "
+                   f"(corrupt frame / dead peer, {retries} retries)")
+            xfers = _counter_total(pport,
+                                   "serve_pool_transfer_out_total")
+            # ---- bitwise: every answer == an uninterrupted greedy
+            # run on the surviving decode peer (same seed-0 weights)
+            sport = dports[1 - victim]
+            for sched, max_new, res in ((p1_sched, P1_NEW, res1),
+                                        (p2_prompts, P2_NEW, res2)):
+                for i, prompt in enumerate(sched):
+                    st, ref = _gen(sport, prompt, max_new)
+                    _check(st == 200,
+                           f"serve-disagg/{name}: reference run "
+                           f"served ({st})")
+                    if res[i][1]["tokens"] != ref["tokens"]:
+                        raise AssertionError(
+                            f"serve-disagg/{name}: request {i} "
+                            f"diverged from the colocated run: "
+                            f"{res[i][1]['tokens']} != "
+                            f"{ref['tokens']}")
+            print(f"  ok: serve-disagg/{name}: all "
+                  f"{len(res1) + len(res2)} responses bitwise "
+                  f"identical to colocated greedy runs")
+            # prefill-pool drain is still the clean exit path
+            procs[-1].send_signal(_signal.SIGTERM)
+            rc = procs[-1].wait(timeout=budget.remaining())
+            _check(rc == 0,
+                   f"serve-disagg/{name}: prefill gateway drained "
+                   f"clean (exit {rc})")
+            return hits1, xfers, retries
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    hits_aff, xfers, retries = run_leg("affinity", affinity=True)
+    hits_base, _x, _r = run_leg("baseline", affinity=False)
+    _check(hits_aff > hits_base,
+           f"serve-disagg: affinity hits strictly above the "
+           f"no-affinity baseline ({hits_aff} > {hits_base})")
+    BANK["serve-disagg"] = {
+        "affinity_hits": int(hits_aff),
+        "baseline_hits": int(hits_base),
+        "transfers": int(xfers),
+        "ladder_retries": int(retries),
+    }
+
+
 SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("commit-hole", scenario_commit_hole),
              ("barrier-missing", scenario_barrier_missing),
@@ -1699,7 +1945,8 @@ SCENARIOS = [("dead-rank-elastic", scenario_dead_rank_elastic),
              ("serve-crash", scenario_serve_crash),
              ("serve-preempt", scenario_serve_preempt),
              ("warm-restart", scenario_warm_restart),
-             ("serve-autoscale", scenario_serve_autoscale)]
+             ("serve-autoscale", scenario_serve_autoscale),
+             ("serve-disagg", scenario_serve_disagg)]
 
 
 def main():
